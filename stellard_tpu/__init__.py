@@ -1,0 +1,17 @@
+"""stellard_tpu — a TPU-native replicated-ledger framework.
+
+A ground-up reimplementation of the capabilities of hfeeki/stellard
+(Stellar's original C++ ledger daemon, a rippled fork): a replicated
+Merkle-radix ledger with Ed25519-signed transactions, UNL-quorum consensus,
+pluggable content-addressed storage, P2P overlay, and JSON-RPC/WebSocket API.
+
+Architecture (not a port):
+- host-side protocol runtime: canonical serialization, SHAMap bookkeeping,
+  transaction engine, consensus state machine, overlay, RPC
+- device-side crypto/hash plane: batched Ed25519 verification and SHA-512
+  tree hashing as JAX/Pallas kernels behind a pluggable backend registry
+  (``signature_backend = cpu|tpu``), mirroring the NodeStore factory seam
+  of the reference (/root/reference/src/ripple_core/nodestore/api/Factory.h).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,336 @@
+"""STObject — self-describing typed object serialization.
+
+The canonical container of the protocol: a mapping of SField -> typed value
+that serializes to sorted, tagged binary (reference:
+src/ripple_data/protocol/SerializedObject.cpp, SerializedTypes.cpp).
+
+Python value representation per serialized type:
+  UINT8/16/32/64    int
+  HASH128/160/256   bytes (fixed width)
+  AMOUNT            STAmount
+  VL                bytes
+  ACCOUNT           bytes (20-byte account ID; wire form is VL-encoded)
+  OBJECT            STObject
+  ARRAY             STArray
+  PATHSET           STPathSet
+  VECTOR256         list[bytes]
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as _dcfield
+from typing import Any, Iterator
+
+from ..utils.hashes import prefix_hash
+from .serializer import BinaryParser, Serializer
+from .sfields import STI, SField, field_by_code, sort_key
+from .stamount import STAmount
+
+_OBJECT_END = (int(STI.OBJECT), 1)  # 0xE1 marker
+_ARRAY_END = (int(STI.ARRAY), 1)  # 0xF1 marker
+
+# Path-element type bits (reference SerializedTypes.h STPathElement)
+PATH_ACCOUNT = 0x01
+PATH_CURRENCY = 0x10
+PATH_ISSUER = 0x20
+
+
+@dataclass(frozen=True)
+class PathElement:
+    account: bytes | None = None
+    currency: bytes | None = None
+    issuer: bytes | None = None
+
+    @property
+    def kind(self) -> int:
+        k = 0
+        if self.account is not None:
+            k |= PATH_ACCOUNT
+        if self.currency is not None:
+            k |= PATH_CURRENCY
+        if self.issuer is not None:
+            k |= PATH_ISSUER
+        return k
+
+
+@dataclass
+class STPathSet:
+    paths: list[list[PathElement]] = _dcfield(default_factory=list)
+
+    def serialize(self, s: Serializer) -> None:
+        for i, path in enumerate(self.paths):
+            if i:
+                s.add8(0xFF)  # path boundary
+            for el in path:
+                s.add8(el.kind)
+                if el.account is not None:
+                    s.add_bits(el.account, 20)
+                if el.currency is not None:
+                    s.add_bits(el.currency, 20)
+                if el.issuer is not None:
+                    s.add_bits(el.issuer, 20)
+        s.add8(0x00)  # end of path set
+
+    @classmethod
+    def deserialize(cls, p: BinaryParser) -> "STPathSet":
+        paths: list[list[PathElement]] = [[]]
+        while True:
+            kind = p.read8()
+            if kind == 0x00:
+                break
+            if kind == 0xFF:
+                paths.append([])
+                continue
+            account = p.read(20) if kind & PATH_ACCOUNT else None
+            currency = p.read(20) if kind & PATH_CURRENCY else None
+            issuer = p.read(20) if kind & PATH_ISSUER else None
+            paths[-1].append(PathElement(account, currency, issuer))
+        if paths == [[]]:
+            paths = []
+        return cls(paths)
+
+    def __len__(self) -> int:
+        return len(self.paths)
+
+    def to_json(self):
+        from .keys import encode_account_id
+        from .stamount import iso_from_currency
+
+        out = []
+        for path in self.paths:
+            jp = []
+            for el in path:
+                je: dict[str, Any] = {"type": el.kind, "type_hex": f"{el.kind:016X}"}
+                if el.account is not None:
+                    je["account"] = encode_account_id(el.account)
+                if el.currency is not None:
+                    je["currency"] = iso_from_currency(el.currency)
+                if el.issuer is not None:
+                    je["issuer"] = encode_account_id(el.issuer)
+                jp.append(je)
+            out.append(jp)
+        return out
+
+
+_HASH_WIDTH = {STI.HASH128: 16, STI.HASH160: 20, STI.HASH256: 32}
+_INT_WIDTH = {STI.UINT8: 1, STI.UINT16: 2, STI.UINT32: 4, STI.UINT64: 8}
+
+
+def _serialize_value(s: Serializer, f: SField, v: Any) -> None:
+    t = f.type_id
+    if t == STI.UINT8:
+        s.add8(v)
+    elif t == STI.UINT16:
+        s.add16(v)
+    elif t == STI.UINT32:
+        s.add32(v)
+    elif t == STI.UINT64:
+        s.add64(v)
+    elif t in _HASH_WIDTH:
+        s.add_bits(v, _HASH_WIDTH[t])
+    elif t == STI.AMOUNT:
+        v.serialize(s)
+    elif t == STI.VL:
+        s.add_vl(v)
+    elif t == STI.ACCOUNT:
+        if len(v) != 20:
+            raise ValueError("account field must be 20 bytes")
+        s.add_vl(v)
+    elif t == STI.OBJECT:
+        v.serialize_to(s)
+        s.add_field_id(*_OBJECT_END)
+    elif t == STI.ARRAY:
+        v.serialize_to(s)
+        s.add_field_id(*_ARRAY_END)
+    elif t == STI.PATHSET:
+        v.serialize(s)
+    elif t == STI.VECTOR256:
+        s.add_vl(b"".join(v))
+    else:
+        raise ValueError(f"cannot serialize field type {t}")
+
+
+def _deserialize_value(p: BinaryParser, f: SField) -> Any:
+    t = f.type_id
+    if t in _INT_WIDTH:
+        return int.from_bytes(p.read(_INT_WIDTH[t]), "big")
+    if t in _HASH_WIDTH:
+        return p.read(_HASH_WIDTH[t])
+    if t == STI.AMOUNT:
+        return STAmount.deserialize(p)
+    if t == STI.VL:
+        return p.read_vl()
+    if t == STI.ACCOUNT:
+        v = p.read_vl()
+        if len(v) != 20:
+            raise ValueError("account field must be 20 bytes")
+        return v
+    if t == STI.OBJECT:
+        return STObject.deserialize(p, inner=True)
+    if t == STI.ARRAY:
+        return STArray.deserialize(p)
+    if t == STI.PATHSET:
+        return STPathSet.deserialize(p)
+    if t == STI.VECTOR256:
+        raw = p.read_vl()
+        if len(raw) % 32:
+            raise ValueError("bad vector256 length")
+        return [raw[i : i + 32] for i in range(0, len(raw), 32)]
+    raise ValueError(f"cannot deserialize field type {t}")
+
+
+class STObject:
+    """Ordered-by-canon field map."""
+
+    __slots__ = ("_fields",)
+
+    def __init__(self, fields: dict[SField, Any] | None = None):
+        self._fields: dict[SField, Any] = dict(fields or {})
+
+    # -- mapping interface -------------------------------------------------
+
+    def __contains__(self, f: SField) -> bool:
+        return f in self._fields
+
+    def __getitem__(self, f: SField) -> Any:
+        return self._fields[f]
+
+    def __setitem__(self, f: SField, v: Any) -> None:
+        self._fields[f] = v
+
+    def __delitem__(self, f: SField) -> None:
+        del self._fields[f]
+
+    def get(self, f: SField, default: Any = None) -> Any:
+        return self._fields.get(f, default)
+
+    def pop(self, f: SField, default: Any = None) -> Any:
+        return self._fields.pop(f, default)
+
+    def fields(self) -> Iterator[tuple[SField, Any]]:
+        return iter(sorted(self._fields.items(), key=lambda kv: sort_key(kv[0])))
+
+    def copy(self) -> "STObject":
+        out = STObject()
+        out._fields = dict(self._fields)
+        return out
+
+    def __eq__(self, other):
+        return isinstance(other, STObject) and self._fields == other._fields
+
+    def __repr__(self):
+        inner = ", ".join(f"{f!r}={v!r}" for f, v in self.fields())
+        return f"STObject({inner})"
+
+    # -- serialization -----------------------------------------------------
+
+    def serialize_to(self, s: Serializer, *, signing: bool = False) -> None:
+        """Canonical serialization: fields sorted by (type, value); when
+        ``signing``, non-signing fields (signatures) are omitted
+        (reference STObject::getSerializer / getSigningHash,
+        SerializedObject.cpp:444)."""
+        for f, v in self.fields():
+            if signing and not f.signing:
+                continue
+            s.add_field_id(int(f.type_id), f.value)
+            _serialize_value(s, f, v)
+
+    def serialize(self, *, signing: bool = False) -> bytes:
+        s = Serializer()
+        self.serialize_to(s, signing=signing)
+        return s.data()
+
+    def signing_hash(self, prefix: int) -> bytes:
+        return prefix_hash(prefix, self.serialize(signing=True))
+
+    def hash(self, prefix: int) -> bytes:
+        return prefix_hash(prefix, self.serialize())
+
+    @classmethod
+    def deserialize(cls, p: BinaryParser, *, inner: bool = False) -> "STObject":
+        obj = cls()
+        while not p.empty():
+            type_id, name = p.read_field_id()
+            if inner and (type_id, name) == _OBJECT_END:
+                return obj
+            f = field_by_code(type_id, name)
+            if f is None:
+                raise ValueError(f"unknown field ({type_id}, {name})")
+            obj._fields[f] = _deserialize_value(p, f)
+        if inner:
+            raise ValueError("unterminated inner object")
+        return obj
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "STObject":
+        return cls.deserialize(BinaryParser(data))
+
+    # -- JSON --------------------------------------------------------------
+
+    def to_json(self) -> dict:
+        from .keys import encode_account_id
+
+        out: dict[str, Any] = {}
+        for f, v in self.fields():
+            t = f.type_id
+            if t in _INT_WIDTH:
+                out[f.name] = v
+            elif t in _HASH_WIDTH:
+                out[f.name] = v.hex().upper()
+            elif t == STI.AMOUNT:
+                out[f.name] = v.to_json()
+            elif t == STI.VL:
+                out[f.name] = v.hex().upper()
+            elif t == STI.ACCOUNT:
+                out[f.name] = encode_account_id(v)
+            elif t == STI.OBJECT:
+                out[f.name] = v.to_json()
+            elif t == STI.ARRAY:
+                out[f.name] = v.to_json()
+            elif t == STI.PATHSET:
+                out[f.name] = v.to_json()
+            elif t == STI.VECTOR256:
+                out[f.name] = [h.hex().upper() for h in v]
+        return out
+
+
+class STArray:
+    """Array of named inner objects."""
+
+    __slots__ = ("items",)
+
+    def __init__(self, items: list[tuple[SField, STObject]] | None = None):
+        self.items: list[tuple[SField, STObject]] = list(items or [])
+
+    def append(self, f: SField, obj: STObject) -> None:
+        self.items.append((f, obj))
+
+    def __len__(self):
+        return len(self.items)
+
+    def __iter__(self):
+        return iter(self.items)
+
+    def __eq__(self, other):
+        return isinstance(other, STArray) and self.items == other.items
+
+    def serialize_to(self, s: Serializer) -> None:
+        for f, obj in self.items:
+            s.add_field_id(int(f.type_id), f.value)
+            obj.serialize_to(s)
+            s.add_field_id(*_OBJECT_END)
+
+    @classmethod
+    def deserialize(cls, p: BinaryParser) -> "STArray":
+        arr = cls()
+        while True:
+            type_id, name = p.read_field_id()
+            if (type_id, name) == _ARRAY_END:
+                return arr
+            f = field_by_code(type_id, name)
+            if f is None or f.type_id != STI.OBJECT:
+                raise ValueError(f"bad array element field ({type_id}, {name})")
+            arr.items.append((f, STObject.deserialize(p, inner=True)))
+
+    def to_json(self):
+        return [{f.name: obj.to_json()} for f, obj in self.items]

@@ -1,0 +1,2 @@
+from .hashes import sha512_half, prefix_hash, hash160, sha256d_checksum
+from .base58 import b58_encode, b58_decode, b58check_encode, b58check_decode

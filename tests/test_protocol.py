@@ -1,0 +1,362 @@
+"""Protocol-kernel tests: hashing, base58, serializer, amounts, objects, keys.
+
+Golden values are derived from the reference's algorithms
+(SHA-512-half, Base58Check with the Stellar alphabet, canonical field
+ordering) and from independently-computable crypto primitives.
+"""
+
+import hashlib
+
+import pytest
+
+from stellard_tpu.protocol import (
+    BinaryParser,
+    KeyPair,
+    STAmount,
+    STArray,
+    STObject,
+    STPathSet,
+    PathElement,
+    Serializer,
+    TER,
+    TX_FORMATS,
+    TxType,
+    currency_from_iso,
+    decode_account_id,
+    encode_account_id,
+    encode_vl_length,
+    iso_from_currency,
+    passphrase_to_seed,
+    validate_against,
+    verify_signature,
+)
+from stellard_tpu.protocol import sfields as sf
+from stellard_tpu.utils.hashes import (
+    HP_INNER_NODE,
+    HP_TX_SIGN,
+    prefix_hash,
+    sha512_half,
+    hash160,
+)
+from stellard_tpu.utils.base58 import b58_decode, b58_encode, b58check_encode, b58check_decode
+
+
+class TestHashes:
+    def test_sha512_half(self):
+        assert sha512_half(b"") == hashlib.sha512(b"").digest()[:32]
+        assert len(sha512_half(b"abc")) == 32
+
+    def test_prefix_hash_domain_separation(self):
+        # prefix is 3 chars + zero byte, big-endian prepended
+        assert prefix_hash(HP_INNER_NODE, b"x") == hashlib.sha512(b"MIN\x00x").digest()[:32]
+        assert prefix_hash(HP_TX_SIGN, b"x") == hashlib.sha512(b"STX\x00x").digest()[:32]
+        assert prefix_hash(HP_TX_SIGN, b"x") != prefix_hash(HP_INNER_NODE, b"x")
+
+    def test_hash160(self):
+        inner = hashlib.sha256(b"pubkey").digest()
+        h = hashlib.new("ripemd160")
+        h.update(inner)
+        assert hash160(b"pubkey") == h.digest()
+
+
+class TestBase58:
+    def test_roundtrip(self):
+        for data in [b"", b"\x00", b"\x00\x00abc", b"hello world", bytes(range(32))]:
+            assert b58_decode(b58_encode(data)) == data
+
+    def test_leading_zeros_use_g(self):
+        # Stellar alphabet zero char is 'g'
+        assert b58_encode(b"\x00\x00\x01").startswith("gg")
+
+    def test_check_roundtrip(self):
+        s = b58check_encode(0, b"\x01" * 20)
+        ver, payload = b58check_decode(s)
+        assert ver == 0 and payload == b"\x01" * 20
+        assert s.startswith("g")  # version-0 account IDs render g...
+
+    def test_check_detects_corruption(self):
+        s = b58check_encode(33, b"\x02" * 32)
+        corrupted = s[:-1] + ("g" if s[-1] != "g" else "s")
+        with pytest.raises(ValueError):
+            b58check_decode(corrupted)
+
+
+class TestSerializer:
+    def test_integers_big_endian(self):
+        s = Serializer()
+        s.add8(0xAB)
+        s.add16(0x1234)
+        s.add32(0xDEADBEEF)
+        s.add64(0x0102030405060708)
+        assert s.data() == bytes.fromhex("ab1234deadbeef0102030405060708")
+
+    def test_vl_length_boundaries(self):
+        # reference Serializer.cpp addEncoded: 1/2/3-byte prefixes
+        assert encode_vl_length(0) == b"\x00"
+        assert encode_vl_length(192) == bytes([192])
+        assert encode_vl_length(193) == bytes([193, 0])
+        assert encode_vl_length(12480) == bytes([240, 255])
+        assert encode_vl_length(12481) == bytes([241, 0, 0])
+        assert encode_vl_length(918744) == bytes([254, 0xD4, 0x17])
+        with pytest.raises(ValueError):
+            encode_vl_length(918745)
+
+    @pytest.mark.parametrize("n", [0, 1, 192, 193, 300, 12480, 12481, 20000, 918744])
+    def test_vl_roundtrip(self, n):
+        s = Serializer()
+        payload = bytes(n % 256 for n in range(n))
+        s.add_vl(payload)
+        p = BinaryParser(s.data())
+        assert p.read_vl() == payload
+        assert p.empty()
+
+    def test_field_id_packing(self):
+        # common/common -> 1 byte; the rest per Serializer.cpp:193-223
+        s = Serializer()
+        s.add_field_id(2, 4)  # UINT32 Sequence
+        assert s.data() == bytes([0x24])
+        s = Serializer()
+        s.add_field_id(2, 26)  # UINT32 InflateSeq
+        assert s.data() == bytes([0x20, 26])
+        s = Serializer()
+        s.add_field_id(16, 1)  # UINT8 CloseResolution
+        assert s.data() == bytes([0x01, 16])
+        s = Serializer()
+        s.add_field_id(17, 16)
+        assert s.data() == bytes([0x00, 17, 16])
+
+    def test_field_id_roundtrip(self):
+        for t, n in [(1, 1), (2, 15), (2, 16), (14, 1), (15, 1), (16, 3), (17, 16), (19, 255)]:
+            s = Serializer()
+            s.add_field_id(t, n)
+            assert BinaryParser(s.data()).read_field_id() == (t, n)
+
+
+class TestSTAmount:
+    def test_native_roundtrip(self):
+        for drops in [0, 1, 10**6, 10**17 - 1, -5, -(10**12)]:
+            a = STAmount.from_drops(drops)
+            s = Serializer()
+            a.serialize(s)
+            b = STAmount.deserialize(BinaryParser(s.data()))
+            assert b.drops() == drops
+
+    def test_native_wire_positive_bit(self):
+        s = Serializer()
+        STAmount.from_drops(1).serialize(s)
+        assert s.data() == (1 | (1 << 62)).to_bytes(8, "big")
+        s = Serializer()
+        STAmount.from_drops(-1).serialize(s)
+        assert s.data() == (1).to_bytes(8, "big")
+
+    def test_iou_roundtrip(self):
+        usd = currency_from_iso("USD")
+        issuer = b"\x07" * 20
+        for mant, off, neg in [
+            (10**15, 0, False),
+            (9999999999999999, 80, False),
+            (10**15, -96, True),
+            (123456789, -5, False),  # non-canonical input, canonicalized
+        ]:
+            a = STAmount.from_iou(usd, issuer, mant, off, neg)
+            s = Serializer()
+            a.serialize(s)
+            b = STAmount.deserialize(BinaryParser(s.data()))
+            assert a == b
+
+    def test_iou_zero_encoding(self):
+        usd = currency_from_iso("USD")
+        a = STAmount.zero_like(usd, b"\x01" * 20)
+        s = Serializer()
+        a.serialize(s)
+        assert s.data()[:8] == bytes.fromhex("8000000000000000")
+
+    def test_currency_iso_roundtrip(self):
+        usd = currency_from_iso("USD")
+        assert usd[12:15] == b"USD"
+        assert iso_from_currency(usd) == "USD"
+        assert iso_from_currency(currency_from_iso("STR")) == "STR"
+
+    def test_canonicalization(self):
+        usd = currency_from_iso("USD")
+        a = STAmount.from_iou(usd, b"\x01" * 20, 1, 0)  # 1 -> 1e15 * 10^-15
+        assert a.mantissa == 10**15 and a.offset == -15
+        assert a.value_text() == "1"
+
+    def test_add_sub_native(self):
+        a = STAmount.from_drops(100)
+        b = STAmount.from_drops(42)
+        assert (a + b).drops() == 142
+        assert (a - b).drops() == 58
+        assert (b - a).drops() == -58
+
+    def test_multiply_divide_reference_rounding(self):
+        usd = currency_from_iso("USD")
+        one = STAmount.from_iou(usd, b"\x01" * 20, 10**15, -15)  # 1.0
+        three = STAmount.from_iou(usd, b"\x01" * 20, 3 * 10**15, -15)
+        q = STAmount.divide(one, three, usd, b"\x01" * 20)
+        # (1e15 * 10^17) / 3e15 + 5 = 33333333333333338 -> canonicalized
+        assert q.mantissa == 3333333333333333 and q.offset == -16
+        p = STAmount.multiply(three, three, usd, b"\x01" * 20)
+        assert p.value_text() == "9"
+
+    def test_tiny_cancelling_sum_is_zero(self):
+        # reference operator+ collapses |aligned sum| <= 10 to canonical zero
+        usd = currency_from_iso("USD")
+        a = STAmount.from_iou(usd, b"\x01" * 20, 10**15 + 5, -15)
+        b = STAmount.from_iou(usd, b"\x01" * 20, 10**15 - 2, -15, negative=True)
+        assert (a + b).is_zero()
+        assert (a + b).offset == -100  # canonical IOU zero
+
+    def test_native_exponent_notation(self):
+        # reference setValue normalizes the exponent away for native amounts
+        assert STAmount.from_json("1e3").drops() == 1000
+        assert STAmount.from_json("100.0").drops() == 100
+        with pytest.raises(ValueError):
+            STAmount.from_json("1.5")
+
+    def test_ripemd160_fallback_matches_openssl(self):
+        from stellard_tpu.utils.ripemd160 import ripemd160
+
+        h = hashlib.new("ripemd160")
+        h.update(b"stellard")
+        assert ripemd160(b"stellard") == h.digest()
+
+    def test_json_forms(self):
+        assert STAmount.from_json("1000000").drops() == 1000000
+        j = {"value": "2.5", "currency": "USD", "issuer": encode_account_id(b"\x09" * 20)}
+        a = STAmount.from_json(j)
+        assert not a.is_native and a.value_text() == "2.5"
+        back = a.to_json()
+        assert back["value"] == "2.5" and back["currency"] == "USD"
+
+    def test_compare(self):
+        assert STAmount.from_drops(5) < STAmount.from_drops(6)
+        usd = currency_from_iso("USD")
+        a = STAmount.from_json({"value": "1", "currency": "USD"})
+        b = STAmount.from_json({"value": "10", "currency": "USD"})
+        assert a < b and b > a and a == STAmount.from_json({"value": "1.0", "currency": "USD"})
+
+
+class TestSTObject:
+    def _payment(self):
+        obj = STObject()
+        obj[sf.sfTransactionType] = int(TxType.ttPAYMENT)
+        obj[sf.sfAccount] = b"\x01" * 20
+        obj[sf.sfDestination] = b"\x02" * 20
+        obj[sf.sfAmount] = STAmount.from_drops(10**6)
+        obj[sf.sfFee] = STAmount.from_drops(10)
+        obj[sf.sfSequence] = 1
+        obj[sf.sfSigningPubKey] = b"\x03" * 32
+        obj[sf.sfTxnSignature] = b"\x04" * 64
+        return obj
+
+    def test_roundtrip(self):
+        obj = self._payment()
+        data = obj.serialize()
+        back = STObject.from_bytes(data)
+        assert back == obj
+
+    def test_canonical_order_independent_of_insertion(self):
+        a = self._payment()
+        b = STObject()
+        for f, v in reversed(list(a.fields())):
+            b[f] = v
+        assert a.serialize() == b.serialize()
+
+    def test_signing_serialization_omits_signature(self):
+        obj = self._payment()
+        signed = obj.serialize()
+        unsigned = obj.serialize(signing=True)
+        assert len(unsigned) < len(signed)
+        no_sig = obj.copy()
+        del no_sig[sf.sfTxnSignature]
+        assert unsigned == no_sig.serialize()
+
+    def test_wire_layout_starts_with_tx_type(self):
+        # first canonical field is (UINT16, 2) TransactionType -> header 0x12
+        data = self._payment().serialize()
+        assert data[0] == 0x12
+        assert data[1:3] == (0).to_bytes(2, "big")
+
+    def test_inner_object_and_array(self):
+        memo = STObject({sf.sfMemoType: b"hi", sf.sfMemoData: b"there"})
+        arr = STArray([(sf.sfMemo, memo)])
+        obj = self._payment()
+        obj[sf.sfMemos] = arr
+        back = STObject.from_bytes(obj.serialize())
+        assert back[sf.sfMemos] == arr
+
+    def test_pathset_roundtrip(self):
+        usd = currency_from_iso("USD")
+        ps = STPathSet(
+            [
+                [PathElement(account=b"\x05" * 20), PathElement(currency=usd, issuer=b"\x06" * 20)],
+                [PathElement(account=b"\x07" * 20)],
+            ]
+        )
+        obj = self._payment()
+        obj[sf.sfPaths] = ps
+        back = STObject.from_bytes(obj.serialize())
+        assert back[sf.sfPaths] == ps
+
+    def test_template_validation(self):
+        obj = self._payment()
+        fmt = TX_FORMATS[int(TxType.ttPAYMENT)]
+        assert validate_against(obj, fmt) == []
+        del obj[sf.sfDestination]
+        assert any("Destination" in p for p in validate_against(obj, fmt))
+        obj[sf.sfDestination] = b"\x02" * 20
+        obj[sf.sfOfferSequence] = 3  # not a Payment field
+        assert any("OfferSequence" in p for p in validate_against(obj, fmt))
+
+
+class TestKeys:
+    def test_passphrase_seed(self):
+        assert passphrase_to_seed("masterpassphrase") == sha512_half(b"masterpassphrase")
+
+    def test_keypair_deterministic(self):
+        k1 = KeyPair.from_passphrase("alice")
+        k2 = KeyPair.from_passphrase("alice")
+        assert k1.public == k2.public
+        assert len(k1.public) == 32
+        assert len(k1.account_id) == 20
+
+    def test_account_id_encoding(self):
+        k = KeyPair.from_passphrase("bob")
+        human = k.human_account_id
+        assert human.startswith("g")
+        assert decode_account_id(human) == k.account_id
+
+    def test_sign_verify(self):
+        k = KeyPair.from_passphrase("carol")
+        h = sha512_half(b"message")
+        sig = k.sign(h)
+        assert len(sig) == 64
+        assert verify_signature(k.public, h, sig)
+        assert not verify_signature(k.public, sha512_half(b"other"), sig)
+        bad = bytearray(sig)
+        bad[0] ^= 1
+        assert not verify_signature(k.public, h, bytes(bad))
+
+    def test_non_canonical_s_rejected(self):
+        from stellard_tpu.protocol.keys import ED25519_L
+
+        k = KeyPair.from_passphrase("dave")
+        h = sha512_half(b"message")
+        sig = bytearray(k.sign(h))
+        # add group order l to S: same point equation, non-canonical encoding
+        s = int.from_bytes(sig[32:], "little") + ED25519_L
+        if s < (1 << 512):
+            sig[32:] = s.to_bytes(32, "little") if s < (1 << 256) else sig[32:]
+        assert not verify_signature(k.public, h, bytes(sig))
+
+
+class TestTER:
+    def test_ranges(self):
+        assert TER.tesSUCCESS.is_tes and TER.tesSUCCESS.applied
+        assert TER.tecPATH_DRY.is_tec and TER.tecPATH_DRY.applied
+        assert TER.temBAD_SIGNATURE.is_tem and not TER.temBAD_SIGNATURE.applied
+        assert TER.terPRE_SEQ.is_ter
+        assert TER.tefPAST_SEQ.is_tef
+        assert TER.telINSUF_FEE_P.is_tel
