@@ -1,0 +1,9 @@
+from .backend import (
+    BatchVerifier,
+    BatchHasher,
+    register_verifier,
+    register_hasher,
+    make_verifier,
+    make_hasher,
+    VerifyRequest,
+)

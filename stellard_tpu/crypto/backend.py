@@ -1,0 +1,178 @@
+"""The crypto-plane backend seam: pluggable batched verifier/hasher.
+
+This is the factory-registry pattern the reference uses for NodeStore
+backends (/root/reference/src/ripple_core/nodestore/api/Factory.h:27-44,
+Manager::make_Database), applied to the crypto hot path per the north
+star: `signature_backend = cpu|tpu` in the node config selects which
+implementation coalesced JobQueue-style verification batches run on.
+
+- ``cpu``: per-signature verification via the host library (the libsodium
+  role), threaded over the batch.
+- ``tpu``: the batched JAX kernel (ops.ed25519_jax) — one device program
+  over the whole batch.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class VerifyRequest:
+    public: bytes  # 32-byte Ed25519 public key
+    signing_hash: bytes  # 32-byte message (prefixed SHA-512-half)
+    signature: bytes  # 64-byte detached signature
+
+
+class BatchVerifier:
+    """Interface: verify a batch of Ed25519 signatures."""
+
+    name = "abstract"
+
+    def verify_batch(self, batch: Sequence[VerifyRequest]) -> np.ndarray:
+        raise NotImplementedError
+
+
+class BatchHasher:
+    """Interface: batched SHA-512-half with 4-byte domain prefixes."""
+
+    name = "abstract"
+
+    def prefix_hash_batch(self, prefixes: Sequence[int], payloads: Sequence[bytes]) -> list[bytes]:
+        raise NotImplementedError
+
+
+_VERIFIERS: dict[str, Callable[..., BatchVerifier]] = {}
+_HASHERS: dict[str, Callable[..., BatchHasher]] = {}
+
+
+def register_verifier(name: str, factory: Callable[..., BatchVerifier]) -> None:
+    _VERIFIERS[name] = factory
+
+
+def register_hasher(name: str, factory: Callable[..., BatchHasher]) -> None:
+    _HASHERS[name] = factory
+
+
+def make_verifier(name: str, **kwargs) -> BatchVerifier:
+    if name not in _VERIFIERS:
+        raise KeyError(f"unknown signature backend {name!r}; have {sorted(_VERIFIERS)}")
+    return _VERIFIERS[name](**kwargs)
+
+
+def make_hasher(name: str, **kwargs) -> BatchHasher:
+    if name not in _HASHERS:
+        raise KeyError(f"unknown hash backend {name!r}; have {sorted(_HASHERS)}")
+    return _HASHERS[name](**kwargs)
+
+
+# --------------------------------------------------------------------------
+# cpu backend
+
+
+class CpuVerifier(BatchVerifier):
+    """Host-library per-signature verification (the libsodium role of the
+    reference: StellarPublicKey::verifySignature), threaded over the batch."""
+
+    name = "cpu"
+
+    _shared_pool: ThreadPoolExecutor | None = None
+
+    def __init__(self, threads: int = 4):
+        if threads > 1:
+            if CpuVerifier._shared_pool is None:
+                CpuVerifier._shared_pool = ThreadPoolExecutor(
+                    max_workers=threads, thread_name_prefix="cpu-verify"
+                )
+            self._pool = CpuVerifier._shared_pool
+        else:
+            self._pool = None
+
+    def verify_batch(self, batch: Sequence[VerifyRequest]) -> np.ndarray:
+        from ..protocol.keys import verify_signature
+
+        def one(req: VerifyRequest) -> bool:
+            return verify_signature(req.public, req.signing_hash, req.signature)
+
+        if self._pool is None or len(batch) < 64:
+            return np.array([one(r) for r in batch], bool)
+        return np.array(list(self._pool.map(one, batch)), bool)
+
+
+class CpuHasher(BatchHasher):
+    name = "cpu"
+
+    def prefix_hash_batch(self, prefixes, payloads):
+        from ..utils.hashes import prefix_hash
+
+        return [prefix_hash(p, d) for p, d in zip(prefixes, payloads)]
+
+
+# --------------------------------------------------------------------------
+# tpu backend
+
+
+class TpuVerifier(BatchVerifier):
+    """Batched JAX Ed25519 kernel (ops.ed25519_jax.verify_kernel).
+
+    Batches are padded to power-of-two sizes to bound XLA recompiles.
+    """
+
+    name = "tpu"
+
+    def __init__(self, min_batch: int = 256, max_batch: int = 16384):
+        self.min_batch = min_batch
+        self.max_batch = max_batch
+
+    @staticmethod
+    def _pad_size(n: int, lo: int, hi: int) -> int:
+        size = lo
+        while size < n and size < hi:
+            size *= 2
+        return size
+
+    def verify_batch(self, batch: Sequence[VerifyRequest]) -> np.ndarray:
+        from ..ops.ed25519_jax import prepare_batch, verify_kernel
+
+        out = np.zeros(len(batch), bool)
+        for start in range(0, len(batch), self.max_batch):
+            chunk = batch[start : start + self.max_batch]
+            size = self._pad_size(len(chunk), self.min_batch, self.max_batch)
+            pubs = [r.public for r in chunk] + [b"\x00" * 32] * (size - len(chunk))
+            msgs = [r.signing_hash for r in chunk] + [b""] * (size - len(chunk))
+            sigs = [r.signature for r in chunk] + [b"\x00" * 64] * (size - len(chunk))
+            inputs = prepare_batch(pubs, msgs, sigs)
+            res = np.asarray(verify_kernel(**inputs))
+            out[start : start + len(chunk)] = res[: len(chunk)]
+        return out
+
+
+class TpuHasher(BatchHasher):
+    """Batched JAX SHA-512 (ops.sha512_jax), bucketed by block count."""
+
+    name = "tpu"
+
+    def prefix_hash_batch(self, prefixes, payloads):
+        from ..ops.sha512_jax import padded_block_count, sha512_half_batch
+
+        msgs = [p.to_bytes(4, "big") + d for p, d in zip(prefixes, payloads)]
+        # bucket by padded block count to keep shapes static
+        buckets: dict[int, list[int]] = {}
+        for i, m in enumerate(msgs):
+            buckets.setdefault(padded_block_count(len(m)), []).append(i)
+        out: list[bytes | None] = [None] * len(msgs)
+        for nb, idxs in buckets.items():
+            digests = sha512_half_batch([msgs[i] for i in idxs])
+            for i, d in zip(idxs, digests):
+                out[i] = d
+        return out  # type: ignore[return-value]
+
+
+register_verifier("cpu", CpuVerifier)
+register_verifier("tpu", TpuVerifier)
+register_hasher("cpu", CpuHasher)
+register_hasher("tpu", TpuHasher)

@@ -1,0 +1,5 @@
+"""Device-side kernels: batched SHA-512 and Ed25519 over JAX/XLA (Pallas
+variants where profitable). These fill the role of the reference's
+libsodium/OpenSSL hot calls (SerializedTransaction::checkSign,
+SHAMapTreeNode::updateHash) as batched, device-resident primitives.
+"""

@@ -1,0 +1,288 @@
+"""Batched Ed25519 signature verification in JAX — the north-star kernel.
+
+Replaces the per-signature libsodium calls on the reference's hot paths
+(SerializedTransaction::checkSign, SerializedValidation::isValid —
+/root/reference/src/ripple_app/misc/SerializedTransaction.cpp:192-230,
+/root/reference/src/ripple_app/ledger/SerializedValidation.cpp:90-108)
+with one data-parallel kernel over the whole batch:
+
+    R' = [S]B + [h](-A),  accept iff encode(R') == R  and  S < l
+
+Design notes (TPU-first):
+- Points are [..., 4, 20] int32 (X, Y, Z, T extended coords over the
+  13-bit-limb field of fe25519). The batch dim feeds the vector lanes.
+- The twisted-Edwards addition law is COMPLETE for ed25519 (a = -1 is a
+  square mod p, d is a non-square), so one branch-free formula covers
+  identity/doubling/adversarial small-order inputs — exactly what a
+  lock-step SIMD batch needs.
+- [S]B uses a 64-window fixed-base comb (no doublings, table built host-side
+  once); [h](-A) uses 4-bit windowed double-and-add with a per-element
+  16-entry table. All loops are lax.fori_loop (rolled: fast XLA compile).
+- h = SHA512(R||A||M) mod l and the 4-bit window decomposition are computed
+  host-side (cheap C-backed hashlib; the device does the ~3k field muls).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import ed25519_ref as ref
+from .fe25519 import (
+    D2,
+    L,
+    NLIMB,
+    P,
+    SQRT_M1,
+    fe_add,
+    fe_const,
+    fe_eq,
+    fe_invert,
+    fe_is_odd,
+    fe_is_zero,
+    fe_mul,
+    fe_neg,
+    fe_pow,
+    fe_reduce_full,
+    fe_select,
+    fe_square,
+    fe_sub,
+    int_to_limbs_np,
+    limbs_from_words_le,
+    limbs_to_words_le,
+)
+
+WINDOW = 4
+NWINDOWS = 64  # ceil(256/4); scalars are < l < 2^253
+
+
+# --------------------------------------------------------------------------
+# point helpers: points are [..., 4, 20] int32 stacks of (X, Y, Z, T)
+
+
+def pt_stack(x, y, z, t):
+    return jnp.stack([x, y, z, t], axis=-2)
+
+
+def pt_identity(batch_shape=()):
+    return pt_stack(
+        fe_const(0, batch_shape),
+        fe_const(1, batch_shape),
+        fe_const(1, batch_shape),
+        fe_const(0, batch_shape),
+    )
+
+
+def pt_add(p, q):
+    """Complete unified addition (extended coords, a=-1, k=2d)."""
+    x1, y1, z1, t1 = p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
+    x2, y2, z2, t2 = q[..., 0, :], q[..., 1, :], q[..., 2, :], q[..., 3, :]
+    a = fe_mul(fe_sub(y1, x1), fe_sub(y2, x2))
+    b = fe_mul(fe_add(y1, x1), fe_add(y2, x2))
+    c = fe_mul(fe_mul(t1, t2), fe_const(D2))
+    d = fe_mul(z1, z2)
+    d = fe_add(d, d)
+    e = fe_sub(b, a)
+    f = fe_sub(d, c)
+    g = fe_add(d, c)
+    h = fe_add(b, a)
+    return pt_stack(fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+
+
+def pt_double(p):
+    """Dedicated doubling (dbl-2008-hwcd, a=-1): 4S + 4M."""
+    x1, y1, z1 = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    a = fe_square(x1)
+    b = fe_square(y1)
+    zz = fe_square(z1)
+    c = fe_add(zz, zz)
+    e = fe_sub(fe_sub(fe_square(fe_add(x1, y1)), a), b)
+    g = fe_sub(b, a)  # a_coeff=-1: G = aA + B = B - A
+    f = fe_sub(g, c)  # note: F = G - C
+    h = fe_sub(fe_neg(a), b)  # H = aA - B = -A - B
+    return pt_stack(fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+
+
+def pt_neg(p):
+    return pt_stack(
+        fe_neg(p[..., 0, :]), p[..., 1, :], p[..., 2, :], fe_neg(p[..., 3, :])
+    )
+
+
+def pt_encode_words(p):
+    """-> [..., 8] uint32 LE words of the canonical compressed encoding."""
+    zi = fe_invert(p[..., 2, :])
+    x = fe_reduce_full(fe_mul(p[..., 0, :], zi))
+    y = fe_reduce_full(fe_mul(p[..., 1, :], zi))
+    words = limbs_to_words_le(y)
+    sign = (x[..., 0] & 1).astype(jnp.uint32)
+    return words.at[..., 7].set(words[..., 7] | (sign << 31))
+
+
+# --------------------------------------------------------------------------
+# decompression
+
+
+def pt_decompress(words_u32):
+    """[..., 8] u32 LE encoding -> (point [..., 4, 20], valid [...])."""
+    y = limbs_from_words_le(words_u32, mask_high=True)
+    sign = (words_u32[..., 7] >> 31).astype(jnp.int32)
+    y2 = fe_square(y)
+    u = fe_sub(y2, fe_const(1))
+    v = fe_add(fe_mul(y2, fe_const(ref.D)), fe_const(1))
+    v3 = fe_mul(fe_square(v), v)
+    v7 = fe_mul(fe_square(v3), v)
+    x = fe_mul(fe_mul(u, v3), fe_pow(fe_mul(u, v7), (P - 5) // 8))
+    vxx = fe_mul(fe_square(x), v)
+    ok1 = fe_eq(vxx, u)
+    ok2 = fe_eq(vxx, fe_neg(u))
+    x = fe_select(ok1, x, fe_mul(x, fe_const(SQRT_M1)))
+    valid = ok1 | ok2
+    x_zero = fe_is_zero(x)
+    valid = valid & ~(x_zero & (sign == 1))
+    flip = fe_is_odd(x) != (sign == 1)
+    x = fe_select(flip, fe_neg(x), x)
+    point = pt_stack(x, y, fe_const(1, x.shape[:-1]), fe_mul(x, y))
+    return point, valid
+
+
+# --------------------------------------------------------------------------
+# fixed-base comb table for B (host-side, Python ints, computed once)
+
+_COMB_NP: np.ndarray | None = None
+
+
+def _comb_table_np() -> np.ndarray:
+    """[NWINDOWS, 16, 4, 20] int32: T[j][w] = (w << 4j) * B, extended Z=1."""
+    global _COMB_NP
+    if _COMB_NP is None:
+        out = np.zeros((NWINDOWS, 16, 4, NLIMB), np.int32)
+        base = ref.BASE
+        step = base  # 2^(4j) * B
+        for j in range(NWINDOWS):
+            acc = ref.IDENTITY
+            for w in range(16):
+                x, y, z, t = acc
+                zi = pow(z, P - 2, P)
+                xa, ya = x * zi % P, y * zi % P
+                out[j, w, 0] = int_to_limbs_np(xa)
+                out[j, w, 1] = int_to_limbs_np(ya)
+                out[j, w, 2] = int_to_limbs_np(1)
+                out[j, w, 3] = int_to_limbs_np(xa * ya % P)
+                acc = ref.pt_add(acc, step)
+            for _ in range(4):
+                step = ref.pt_double(step)
+        _COMB_NP = out
+    return _COMB_NP
+
+
+def _comb_mult(s_windows):
+    """[S]B via the comb: s_windows [..., 64] int32 (4-bit, LSB window
+    first). 64 complete additions, no doublings."""
+    table = jnp.asarray(_comb_table_np())
+
+    def body(j, acc):
+        tj = lax.dynamic_index_in_dim(table, j, axis=0, keepdims=False)  # [16,4,20]
+        w = s_windows[..., j]  # [...]
+        entry = tj[w]  # gather -> [..., 4, 20]
+        return pt_add(acc, entry)
+
+    return lax.fori_loop(0, NWINDOWS, body, pt_identity(s_windows.shape[:-1]))
+
+
+def _windowed_mult(h_windows, point):
+    """[h]P via 4-bit windows, MSB window first: h_windows [..., 64]."""
+    batch = h_windows.shape[:-1]
+    # per-element table [..., 16, 4, 20]: 0P..15P
+    tbl0 = jnp.broadcast_to(pt_identity(batch)[..., None, :, :], batch + (16, 4, NLIMB))
+
+    def build(i, tbl):
+        prev = lax.dynamic_index_in_dim(tbl, i - 1, axis=-3, keepdims=False)
+        nxt = pt_add(prev, point)[..., None, :, :]
+        return lax.dynamic_update_slice_in_dim(tbl, nxt, i, axis=-3)
+
+    tbl = lax.fori_loop(1, 16, build, tbl0)
+
+    def body(i, acc):
+        for _ in range(WINDOW):
+            acc = pt_double(acc)
+        w = h_windows[..., NWINDOWS - 1 - i]  # windows LSB-first; walk MSB->LSB
+        entry = jnp.take_along_axis(
+            tbl, w[..., None, None, None], axis=-3
+        ).squeeze(-3)
+        return pt_add(acc, entry)
+
+    return lax.fori_loop(0, NWINDOWS, body, pt_identity(batch))
+
+
+# --------------------------------------------------------------------------
+# the batched verify kernel
+
+
+@jax.jit
+def verify_kernel(a_words, r_words, s_windows, h_windows, s_canonical):
+    """Batched core: all inputs leading dim B.
+
+    a_words: [B, 8] u32 public keys (LE words)
+    r_words: [B, 8] u32 signature R
+    s_windows/h_windows: [B, 64] int32 4-bit windows (LSB window first)
+    s_canonical: [B] bool (S < l, checked host-side)
+    -> [B] bool
+    """
+    a_point, a_valid = pt_decompress(a_words)
+    sb = _comb_mult(s_windows)
+    ha = _windowed_mult(h_windows, pt_neg(a_point))
+    rp = pt_add(sb, ha)
+    enc = pt_encode_words(rp)
+    eq = jnp.all(enc == r_words, axis=-1)
+    return eq & a_valid & s_canonical
+
+
+# --------------------------------------------------------------------------
+# host-side preparation
+
+
+def _le_words(b: bytes) -> np.ndarray:
+    return np.frombuffer(b, dtype="<u4").astype(np.uint32)
+
+
+def _windows_of(x: int) -> np.ndarray:
+    return np.array([(x >> (4 * j)) & 0xF for j in range(NWINDOWS)], np.int32)
+
+
+def prepare_batch(publics, messages, signatures):
+    """Host prep: pack keys/sigs, compute h = SHA512(R||A||M) mod l and the
+    window decompositions. Returns dict of numpy arrays for verify_kernel."""
+    B = len(publics)
+    a_words = np.zeros((B, 8), np.uint32)
+    r_words = np.zeros((B, 8), np.uint32)
+    s_windows = np.zeros((B, NWINDOWS), np.int32)
+    h_windows = np.zeros((B, NWINDOWS), np.int32)
+    s_canonical = np.zeros((B,), bool)
+    for i, (pk, msg, sig) in enumerate(zip(publics, messages, signatures)):
+        if len(pk) != 32 or len(sig) != 64:
+            continue  # leaves flags false -> verify fails
+        a_words[i] = _le_words(pk)
+        r_words[i] = _le_words(sig[:32])
+        s = int.from_bytes(sig[32:], "little")
+        s_canonical[i] = s < L
+        s_windows[i] = _windows_of(s)
+        h = int.from_bytes(hashlib.sha512(sig[:32] + pk + msg).digest(), "little") % L
+        h_windows[i] = _windows_of(h)
+    return dict(
+        a_words=jnp.asarray(a_words),
+        r_words=jnp.asarray(r_words),
+        s_windows=jnp.asarray(s_windows),
+        h_windows=jnp.asarray(h_windows),
+        s_canonical=jnp.asarray(s_canonical),
+    )
+
+
+def verify_batch(publics, messages, signatures) -> np.ndarray:
+    """End-to-end batched verification -> [B] bool numpy array."""
+    inputs = prepare_batch(publics, messages, signatures)
+    return np.asarray(verify_kernel(**inputs))

@@ -1,0 +1,235 @@
+"""Field arithmetic mod p = 2^255 - 19 for batched Ed25519 on TPU.
+
+Representation: 20 limbs x 13 bits, int32, little-endian limb order, shape
+[..., 20]. All ops are batched over leading axes — the batch dimension is
+the vector-lane parallelism; limb loops are tiny and static.
+
+Why 13-bit limbs in int32: schoolbook products are < 2^26.1 and a 20-term
+column sum stays < 2^31, so the whole multiply runs in native int32 lanes
+(TPU VPU width) with no 64-bit emulation. Reduction uses
+2^260 ≡ 608 (mod p) folding (608 = 19 * 2^5, since 13*20 = 260 = 255 + 5).
+
+Invariant maintained by every op: limbs in [0, 8192] ("bounded redundant",
+mul-safe since 20 * 8192^2 < 2^31) and value < 2^255 + 2^19 < 2p.
+Canonical form (value in [0, p), limbs < 2^13) only where bytes/equality
+are produced (`fe_reduce_full`).
+
+This fills the role of libsodium's ref10 fe25519 used by the reference's
+crypto_sign_verify_detached path
+(/root/reference/src/ripple_data/protocol/RippleAddress.cpp:190-252).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+NLIMB = 20
+BITS = 13
+MASK = (1 << BITS) - 1
+P = (1 << 255) - 19
+FOLD = 608  # 2^260 mod p = 19 * 2^5
+
+D = (-121665 * pow(121666, P - 2, P)) % P  # Edwards d
+D2 = (2 * D) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)  # sqrt(-1)
+L = (1 << 252) + 27742317777372353535851937790883648493  # group order l
+
+
+def int_to_limbs_np(x: int, n: int = NLIMB) -> np.ndarray:
+    out = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        out[i] = x & MASK
+        x >>= BITS
+    if x:
+        raise ValueError("value does not fit in limbs")
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    limbs = np.asarray(limbs)
+    return sum(int(v) << (BITS * i) for i, v in enumerate(limbs))
+
+
+_P_LIMBS = int_to_limbs_np(P)
+# Subtraction bias: 33p, laid out limb-wise as 33 * (limbs of p) so every
+# bias limb (min 33*255 = 8415) dominates any normalized limb (<= 8192).
+# a + bias - b is then limb-wise non-negative: carries stay positive.
+_BIAS_LIMBS = (33 * _P_LIMBS).astype(np.int32)
+
+
+def fe_const(x: int, batch_shape=()) -> jnp.ndarray:
+    limbs = jnp.asarray(int_to_limbs_np(x % P))
+    return jnp.broadcast_to(limbs, tuple(batch_shape) + (NLIMB,))
+
+
+def _carry(c: jnp.ndarray, steps: int) -> jnp.ndarray:
+    """Global carry-propagation steps (arithmetic shifts, so signed values
+    borrow correctly). Does not change the represented value; callers size
+    buffers so the top limb never overflows."""
+    for _ in range(steps):
+        hi = c >> BITS
+        c = (c & MASK) + jnp.concatenate(
+            [jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1
+        )
+    return c
+
+
+def _fold_top(c: jnp.ndarray, over: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Fold bits >= 2^255 of a 20-limb value (plus an optional 2^260-weight
+    overflow limb) back onto limb 0: 2^255 ≡ 19, 2^260 ≡ 608 (mod p)."""
+    h = c[..., 19] >> 8
+    c = c.at[..., 19].set(c[..., 19] & 0xFF)
+    add = 19 * h
+    if over is not None:
+        add = add + FOLD * over
+    return c.at[..., 0].add(add)
+
+
+def _fold260(acc: jnp.ndarray) -> jnp.ndarray:
+    """Reduce a 39-limb (< 2^511) non-negative value to the invariant form."""
+    pad = 40 - acc.shape[-1]
+    if pad:
+        acc = jnp.concatenate(
+            [acc, jnp.zeros(acc.shape[:-1] + (pad,), acc.dtype)], axis=-1
+        )
+    acc = _carry(acc, 3)  # limbs <= 8192
+    lo, hi = acc[..., :20], acc[..., 20:]
+    c = lo + FOLD * hi  # <= 8192 + 608*8192 < 2^22.3
+    c = jnp.concatenate([c, jnp.zeros(c.shape[:-1] + (1,), c.dtype)], axis=-1)
+    c = _carry(c, 2)  # limbs <= 8192, over-limb <= 2^9.3
+    c = _fold_top(c[..., :20], over=c[..., 20])
+    return _carry(c, 2)
+
+
+def fe_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    acc = jnp.zeros(shape + (39,), jnp.int32)
+    for i in range(NLIMB):  # static 20-step schoolbook, vectorized over batch
+        acc = acc.at[..., i : i + 20].add(a[..., i : i + 1] * b)
+    return _fold260(acc)
+
+
+def fe_square(a: jnp.ndarray) -> jnp.ndarray:
+    return fe_mul(a, a)
+
+
+def _finish21(c: jnp.ndarray) -> jnp.ndarray:
+    """Normalize a 21-limb non-negative value (< 2^261, limbs < 2^19)."""
+    c = _carry(c, 2)
+    c = _fold_top(c[..., :20], over=c[..., 20])
+    return _carry(c, 2)
+
+
+def fe_add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    c = a + b
+    c = jnp.concatenate([c, jnp.zeros(c.shape[:-1] + (1,), c.dtype)], axis=-1)
+    return _finish21(c)
+
+
+def fe_sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    c = a + jnp.asarray(_BIAS_LIMBS) - b  # limb-wise >= 0; value = a-b+33p
+    c = jnp.concatenate([c, jnp.zeros(c.shape[:-1] + (1,), c.dtype)], axis=-1)
+    return _finish21(c)
+
+
+def fe_neg(a: jnp.ndarray) -> jnp.ndarray:
+    return fe_sub(jnp.zeros_like(a), a)
+
+
+def fe_reduce_full(a: jnp.ndarray) -> jnp.ndarray:
+    """Exact canonical form: value in [0, p), limbs < 2^13.
+
+    Input satisfies the invariant (value < 2p). Exact long carry chains are
+    possible here, so propagation runs the full limb count.
+    """
+    c = _fold_top(a)  # clears bits >= 255; adds <= 19*32 to limb 0
+    c = _carry(c, NLIMB + 1)
+    # now limbs < 2^13 exactly and value < 2^255 + eps; subtract p once if >= p
+    ge = (
+        (c[..., 19] >= 0x100)
+        | (
+            (c[..., 19] == 0xFF)
+            & jnp.all(c[..., 1:19] == MASK, axis=-1)
+            & (c[..., 0] >= MASK - 18)
+        )
+    )
+    p_limbs = jnp.asarray(_P_LIMBS)
+    c = c - jnp.where(ge[..., None], p_limbs, jnp.zeros_like(p_limbs))
+    return _carry(c, NLIMB + 1)
+
+
+def fe_pow(a: jnp.ndarray, e: int) -> jnp.ndarray:
+    """a^e for a static exponent, rolled as a fori_loop over bits (keeps the
+    XLA graph small — unrolled 255-bit chains explode CPU compile time)."""
+    bits = [int(b) for b in bin(e)[2:]]
+    bits_arr = jnp.asarray(np.array(bits, dtype=np.int32))
+    nbits = len(bits)
+
+    def body(i, r):
+        r = fe_square(r)
+        return jnp.where(bits_arr[i][..., None] == 1, fe_mul(r, a), r)
+
+    return lax.fori_loop(1, nbits, body, a)
+
+
+def fe_invert(a: jnp.ndarray) -> jnp.ndarray:
+    return fe_pow(a, P - 2)
+
+
+def fe_is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(fe_reduce_full(a) == 0, axis=-1)
+
+
+def fe_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(fe_reduce_full(a) == fe_reduce_full(b), axis=-1)
+
+
+def fe_is_odd(a: jnp.ndarray) -> jnp.ndarray:
+    return (fe_reduce_full(a)[..., 0] & 1) == 1
+
+
+def fe_select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a where cond else b; cond is [...] bool."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def limbs_from_words_le(words_u32: jnp.ndarray, mask_high: bool = True) -> jnp.ndarray:
+    """[..., 8] uint32 little-endian words -> [..., 20] int32 limbs.
+
+    With mask_high, bit 255 (the point-compression sign bit) is dropped.
+    """
+    w = words_u32
+    out = []
+    for k in range(NLIMB):
+        bit = BITS * k
+        a, r = divmod(bit, 32)
+        lo = w[..., a] >> r
+        if r + BITS > 32 and a + 1 < 8:
+            lo = lo | (w[..., a + 1] << (32 - r))
+        out.append((lo & MASK).astype(jnp.int32))
+    limbs = jnp.stack(out, axis=-1)
+    if mask_high:
+        limbs = limbs.at[..., 19].set(limbs[..., 19] & 0xFF)
+    return limbs
+
+
+def limbs_to_words_le(limbs: jnp.ndarray) -> jnp.ndarray:
+    """Canonical [..., 20] limbs -> [..., 8] uint32 little-endian words."""
+    l = limbs.astype(jnp.uint32)
+    words = []
+    for wi in range(8):
+        bit0 = 32 * wi
+        w = jnp.zeros(limbs.shape[:-1], jnp.uint32)
+        for k in range(NLIMB):
+            lb = BITS * k
+            if lb + BITS <= bit0 or lb >= bit0 + 32:
+                continue
+            sh = lb - bit0
+            if sh >= 0:
+                w = w | (l[..., k] << sh)
+            else:
+                w = w | (l[..., k] >> (-sh))
+        words.append(w)
+    return jnp.stack(words, axis=-1)
