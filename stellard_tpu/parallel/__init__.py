@@ -1,0 +1,6 @@
+from .mesh import (
+    make_mesh,
+    sharded_verify_kernel,
+    sharded_sha512_blocks,
+    verify_and_count,
+)

@@ -1,0 +1,77 @@
+"""Multi-chip sharding of the crypto plane.
+
+The reference has no collectives (its 'distributed backend' is the TCP
+overlay between validators — SURVEY.md §2.9); chips within one validator
+host are the new, TPU-idiomatic parallel axis. The batch dimension of the
+verify/hash kernels shards data-parallel over ICI via a 1-D
+``jax.sharding.Mesh``; cross-chip aggregation (e.g. "did every signature
+in the consensus set verify") is an ICI collective (psum), not host code.
+
+Validator-to-validator traffic stays on the overlay (DCN/TCP): the mesh is
+intra-node only, matching SURVEY.md §5's "overlay inter-node, ICI
+intra-node" design.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.ed25519_jax import verify_kernel
+from ..ops.sha512_jax import sha512_blocks
+
+BATCH_AXIS = "batch"
+
+
+def make_mesh(devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (BATCH_AXIS,))
+
+
+def _batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(BATCH_AXIS))
+
+
+def sharded_verify_kernel(mesh: Mesh):
+    """jit of the batched Ed25519 verify with the batch dim sharded over the
+    mesh. XLA partitions the whole point-arithmetic pipeline; no host-side
+    scatter/gather is involved beyond the initial device_put."""
+    shard = _batch_sharding(mesh)
+    return jax.jit(
+        verify_kernel,
+        in_shardings=(shard, shard, shard, shard, shard),
+        out_shardings=shard,
+    )
+
+
+def sharded_sha512_blocks(mesh: Mesh):
+    shard = _batch_sharding(mesh)
+    return jax.jit(sha512_blocks, in_shardings=(shard,), out_shardings=shard)
+
+
+def verify_and_count(mesh: Mesh):
+    """shard_map pipeline: verify local shard, psum the per-chip valid
+    counts over ICI -> (flags [B], total_valid scalar replicated).
+
+    This is the consensus-path shape: 'all validations in this quorum batch
+    verified' is a cross-chip reduction, kept on-device.
+    """
+
+    def local(a_words, r_words, s_windows, h_windows, s_canonical):
+        flags = verify_kernel(a_words, r_words, s_windows, h_windows, s_canonical)
+        total = jax.lax.psum(jnp.sum(flags.astype(jnp.int32)), BATCH_AXIS)
+        return flags, total
+
+    pspec = P(BATCH_AXIS)
+    return jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(pspec, pspec, pspec, pspec, pspec),
+            out_specs=(pspec, P()),
+        )
+    )
