@@ -1,0 +1,27 @@
+"""NodeStore: content-addressed object store (hash → NodeObject).
+
+Reference scope: src/ripple_core/nodestore ({api,impl,backend}).
+The pluggable Backend/Factory registry is the same seam the crypto plane
+copies for `signature_backend` (nodestore/api/Factory.h:27-44).
+"""
+
+from .core import (
+    NodeObject,
+    NodeObjectType,
+    Backend,
+    Database,
+    register_backend,
+    make_backend,
+    make_database,
+)
+from . import backends as _backends  # noqa: F401  (registers built-ins)
+
+__all__ = [
+    "NodeObject",
+    "NodeObjectType",
+    "Backend",
+    "Database",
+    "register_backend",
+    "make_backend",
+    "make_database",
+]

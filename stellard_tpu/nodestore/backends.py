@@ -1,0 +1,111 @@
+"""Built-in NodeStore backends: memory, null, sqlite.
+
+Reference: src/ripple_core/nodestore/backend/{Memory,Null}Factory.cpp and
+src/ripple_app/node/SqliteFactory.cpp. The reference's LevelDB/RocksDB
+roles are filled by sqlite-WAL here (stdlib, zero deps); the Backend seam
+means a real LSM store can be registered without touching callers.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Iterator, Optional
+
+from .core import Backend, NodeObject, NodeObjectType, register_backend
+
+__all__ = ["MemoryBackend", "NullBackend", "SqliteBackend"]
+
+
+class MemoryBackend(Backend):
+    """reference: backend/MemoryFactory.cpp"""
+
+    name = "memory"
+
+    def __init__(self, **_):
+        self._map: dict[bytes, NodeObject] = {}
+        self._lock = threading.Lock()
+
+    def fetch(self, hash: bytes) -> Optional[NodeObject]:
+        with self._lock:
+            return self._map.get(hash)
+
+    def store_batch(self, batch: list[NodeObject]) -> None:
+        with self._lock:
+            for obj in batch:
+                self._map[obj.hash] = obj
+
+    def iterate(self) -> Iterator[NodeObject]:
+        with self._lock:
+            objs = list(self._map.values())
+        yield from objs
+
+
+class NullBackend(Backend):
+    """Discards everything (reference: backend/NullFactory.cpp)."""
+
+    name = "null"
+
+    def __init__(self, **_):
+        pass
+
+    def fetch(self, hash: bytes) -> Optional[NodeObject]:
+        return None
+
+    def store_batch(self, batch: list[NodeObject]) -> None:
+        pass
+
+    def iterate(self) -> Iterator[NodeObject]:
+        return iter(())
+
+
+class SqliteBackend(Backend):
+    """Durable backend over sqlite WAL (reference:
+    src/ripple_app/node/SqliteFactory.cpp — same schema shape: one table,
+    hash primary key, type + blob columns)."""
+
+    name = "sqlite"
+
+    def __init__(self, path: str = ":memory:", **_):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA synchronous=NORMAL")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS nodes ("
+                " hash BLOB PRIMARY KEY, type INTEGER, data BLOB)"
+            )
+            self._conn.commit()
+
+    def fetch(self, hash: bytes) -> Optional[NodeObject]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT type, data FROM nodes WHERE hash=?", (hash,)
+            ).fetchone()
+        if row is None:
+            return None
+        return NodeObject(NodeObjectType(row[0]), hash, row[1])
+
+    def store_batch(self, batch: list[NodeObject]) -> None:
+        with self._lock:
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO nodes (hash, type, data) VALUES (?,?,?)",
+                [(o.hash, int(o.type), o.data) for o in batch],
+            )
+            self._conn.commit()
+
+    def iterate(self) -> Iterator[NodeObject]:
+        with self._lock:
+            rows = self._conn.execute("SELECT hash, type, data FROM nodes").fetchall()
+        for h, t, d in rows:
+            yield NodeObject(NodeObjectType(t), h, d)
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+register_backend("memory", MemoryBackend)
+register_backend("null", NullBackend)
+register_backend("sqlite", SqliteBackend)

@@ -1,0 +1,204 @@
+"""NodeStore core: NodeObject, Backend interface, factory registry,
+Database façade with cache + async batch writer.
+
+Reference: src/ripple_core/nodestore/api/{Backend,Factory,Manager}.h,
+impl/{DatabaseImp.h,BatchWriter.cpp}. The write path preserves the
+reference's shape — callers store synchronously into a pending map while a
+writer thread drains batches to the backend (BatchWriter.cpp) — because
+that's also the right shape for TPU-adjacent IO: large sequential batches,
+no per-object fsync.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from enum import IntEnum
+from typing import Callable, Iterator, Optional
+
+__all__ = [
+    "NodeObjectType",
+    "NodeObject",
+    "Backend",
+    "Database",
+    "register_backend",
+    "make_backend",
+    "make_database",
+]
+
+
+class NodeObjectType(IntEnum):
+    """reference: nodestore/api/NodeObject.h:30-36"""
+
+    UNKNOWN = 0
+    LEDGER = 1
+    TRANSACTION = 2
+    ACCOUNT_NODE = 3
+    TRANSACTION_NODE = 4
+
+
+@dataclass(frozen=True)
+class NodeObject:
+    type: NodeObjectType
+    hash: bytes  # 32-byte content hash (the key)
+    data: bytes  # payload (prefix-format SHAMap node / ledger header)
+
+
+class Backend:
+    """Key-value backend interface (reference: nodestore/api/Backend.h:35-85)."""
+
+    name = "abstract"
+
+    def fetch(self, hash: bytes) -> Optional[NodeObject]:
+        raise NotImplementedError
+
+    def store(self, obj: NodeObject) -> None:
+        self.store_batch([obj])
+
+    def store_batch(self, batch: list[NodeObject]) -> None:
+        raise NotImplementedError
+
+    def iterate(self) -> Iterator[NodeObject]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+_FACTORIES: dict[str, Callable[..., Backend]] = {}
+
+
+def register_backend(name: str, factory: Callable[..., Backend]) -> None:
+    """reference: nodestore/api/Factory.h + Manager::addFactory"""
+    _FACTORIES[name] = factory
+
+
+def make_backend(type: str = "memory", **kwargs) -> Backend:
+    if type not in _FACTORIES:
+        raise KeyError(f"unknown nodestore backend {type!r}; have {sorted(_FACTORIES)}")
+    return _FACTORIES[type](**kwargs)
+
+
+class Database:
+    """Backend + in-memory cache + async batched write-behind
+    (reference: nodestore/impl/DatabaseImp.h, BatchWriter.cpp).
+
+    Writes land synchronously in `_pending` (so reads always see them) and
+    a background thread drains them to the backend in batches of up to
+    `batch_size`.
+    """
+
+    def __init__(self, backend: Backend, cache_size: int = 65536,
+                 batch_size: int = 256, async_writes: bool = True):
+        self.backend = backend
+        self._cache: dict[bytes, NodeObject] = {}
+        self._cache_size = cache_size
+        self._pending: dict[bytes, NodeObject] = {}
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._batch_size = batch_size
+        self._stopping = False
+        self._write_error: Optional[BaseException] = None
+        self._writer: Optional[threading.Thread] = None
+        if async_writes:
+            self._writer = threading.Thread(
+                target=self._write_loop, name="nodestore-writer", daemon=True
+            )
+            self._writer.start()
+
+    # -- public api -------------------------------------------------------
+
+    def fetch(self, hash: bytes) -> Optional[NodeObject]:
+        with self._lock:
+            obj = self._pending.get(hash) or self._cache.get(hash)
+        if obj is not None:
+            return obj
+        obj = self.backend.fetch(hash)
+        if obj is not None:
+            self._cache_put(obj)
+        return obj
+
+    def store(self, type: NodeObjectType, hash: bytes, data: bytes) -> None:
+        obj = NodeObject(type, hash, data)
+        with self._lock:
+            self._pending[hash] = obj
+            if self._writer is None:
+                self.backend.store(obj)
+                self._pending.pop(hash)
+                self._cache_unlocked(obj)
+            else:
+                self._wake.notify()
+
+    def store_fn(self, type: NodeObjectType) -> Callable[[bytes, bytes], None]:
+        """Adapter with the (hash, blob) signature SHAMap.flush expects."""
+        return lambda h, d: self.store(type, h, d)
+
+    def sync(self) -> None:
+        """Block until all pending writes hit the backend. Raises the
+        writer thread's error if the backend failed (otherwise a dead
+        writer would make this hang forever)."""
+        with self._lock:
+            while self._pending:
+                if self._write_error is not None:
+                    raise RuntimeError("nodestore writer failed") from self._write_error
+                self._wake.notify()
+                self._wake.wait(0.01)
+            if self._write_error is not None:
+                raise RuntimeError("nodestore writer failed") from self._write_error
+
+    def close(self) -> None:
+        self.sync()
+        with self._lock:
+            self._stopping = True
+            self._wake.notify()
+        if self._writer:
+            self._writer.join(timeout=5)
+        self.backend.close()
+
+    # -- internals --------------------------------------------------------
+
+    def _cache_put(self, obj: NodeObject) -> None:
+        with self._lock:
+            self._cache_unlocked(obj)
+
+    def _cache_unlocked(self, obj: NodeObject) -> None:
+        if len(self._cache) >= self._cache_size:
+            # simple clock-less eviction: drop ~25% oldest-inserted
+            drop = len(self._cache) // 4 or 1
+            for k in list(self._cache)[:drop]:
+                del self._cache[k]
+        self._cache[obj.hash] = obj
+
+    def _write_loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._pending and not self._stopping:
+                    self._wake.wait(0.1)
+                if self._stopping and not self._pending:
+                    return
+                keys = list(self._pending)[: self._batch_size]
+                batch = [self._pending[k] for k in keys]
+            try:
+                self.backend.store_batch(batch)
+            except BaseException as exc:  # surface via sync(); keep pending
+                with self._lock:
+                    self._write_error = exc
+                    self._wake.notify_all()
+                return
+            with self._lock:
+                for k, o in zip(keys, batch):
+                    if self._pending.get(k) is o:
+                        del self._pending[k]
+                    self._cache_unlocked(o)
+                self._wake.notify_all()
+
+
+def make_database(type: str = "memory", *, cache_size: int = 65536,
+                  async_writes: bool = True, **backend_kwargs) -> Database:
+    """reference: NodeStore::Manager::make_Database; `type=` is the config
+    knob ([node_db] type=..., doc/stellard-example.cfg:795-802)."""
+    return Database(
+        make_backend(type, **backend_kwargs),
+        cache_size=cache_size,
+        async_writes=async_writes,
+    )

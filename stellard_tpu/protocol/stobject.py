@@ -215,6 +215,9 @@ class STObject:
         out._fields = dict(self._fields)
         return out
 
+    def __len__(self) -> int:
+        return len(self._fields)
+
     def __eq__(self, other):
         return isinstance(other, STObject) and self._fields == other._fields
 

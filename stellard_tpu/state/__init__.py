@@ -1,0 +1,17 @@
+"""Ledger state plane: SHAMap Merkle-radix tree, ledger, entry views.
+
+Reference scope: src/ripple_app/shamap, src/ripple_app/ledger.
+Design is TPU-first: the tree is a *persistent* (structurally shared)
+functional radix tree — snapshots are O(1) and copy-on-write falls out of
+immutability instead of the reference's sequence-number scheme
+(src/ripple_app/shamap/SHAMap.h mSeq) — and node re-hashing is deferred and
+level-synchronous so every close flushes one batched SHA-512 device call
+per tree level instead of the reference's single-threaded recursive
+updateHash (src/ripple_app/shamap/SHAMapTreeNode.cpp:253-295).
+"""
+
+from .shamap import SHAMap, SHAMapItem, TNType
+from .ledger import Ledger
+from .entryset import LedgerEntrySet
+
+__all__ = ["SHAMap", "SHAMapItem", "TNType", "Ledger", "LedgerEntrySet"]

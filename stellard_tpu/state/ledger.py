@@ -1,0 +1,317 @@
+"""Ledger: one version of the replicated state.
+
+Header + two SHAMaps (transaction map, account-state map), hash-compatible
+with the reference (src/ripple_app/ledger/Ledger.cpp):
+
+- header serialization: Ledger::addRaw (Ledger.cpp:1182-1196) — seq,
+  totCoins, feePool, inflationSeq, parentHash, txHash, accountHash,
+  parentCloseTime, closeTime, closeResolution, closeFlags,
+- ledger hash = SHA512half(HP_LEDGER_MASTER || header),
+- genesis: root account funded with SYSTEM_CURRENCY_START = 10^17 stroops
+  (Config.h:37-40), seq 1 (Ledger.cpp:29-66).
+
+Closing a ledger is functional: `close()` snapshots into an immutable
+closed ledger and the caller opens a successor with `open_successor()` —
+the persistent SHAMap makes both O(1).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..nodestore.core import Database, NodeObjectType
+from ..protocol.serializer import Serializer
+from ..protocol.sfields import (
+    sfBalance,
+    sfSequence,
+)
+from ..protocol.stobject import STObject
+from ..utils.hashes import HP_LEDGER_MASTER, HP_TXN_ID, prefix_hash
+from . import indexes
+from .shamap import SHAMap, SHAMapItem, TNType
+
+__all__ = ["Ledger", "SYSTEM_CURRENCY_START", "LEDGER_TIME_ACCURACY"]
+
+# reference: Config.h:37-40
+SYSTEM_CURRENCY_START = 1000 * 100_000_000 * 1_000_000
+# reference: LedgerTiming.h:47
+LEDGER_TIME_ACCURACY = 30
+
+# default fee schedule (reference: Config.cpp:30-34,127-139)
+DEFAULT_BASE_FEE = 10
+DEFAULT_REFERENCE_FEE_UNITS = 10
+DEFAULT_RESERVE_BASE = 200 * 1_000_000
+DEFAULT_RESERVE_INCREMENT = 50 * 1_000_000
+
+
+class Ledger:
+    def __init__(
+        self,
+        seq: int,
+        parent_hash: bytes = b"\x00" * 32,
+        tot_coins: int = SYSTEM_CURRENCY_START,
+        fee_pool: int = 0,
+        inflation_seq: int = 1,
+        close_time: int = 0,
+        parent_close_time: int = 0,
+        close_resolution: int = LEDGER_TIME_ACCURACY,
+        close_flags: int = 0,
+        tx_map: Optional[SHAMap] = None,
+        state_map: Optional[SHAMap] = None,
+        hash_batch: Optional[Callable] = None,
+    ):
+        self.seq = seq
+        self.parent_hash = parent_hash
+        self.tot_coins = tot_coins
+        self.fee_pool = fee_pool
+        self.inflation_seq = inflation_seq
+        self.close_time = close_time
+        self.parent_close_time = parent_close_time
+        self.close_resolution = close_resolution
+        self.close_flags = close_flags
+        kw = {"hash_batch": hash_batch} if hash_batch else {}
+        self.tx_map = tx_map or SHAMap(TNType.TX_MD, **kw)
+        self.state_map = state_map or SHAMap(TNType.ACCOUNT_STATE, **kw)
+        self.closed = False
+        self.accepted = False
+        self.validated = False
+        # fee schedule (reference: Ledger::updateFees)
+        self.base_fee = DEFAULT_BASE_FEE
+        self.reference_fee_units = DEFAULT_REFERENCE_FEE_UNITS
+        self.reserve_base = DEFAULT_RESERVE_BASE
+        self.reserve_increment = DEFAULT_RESERVE_INCREMENT
+
+    # -- genesis ----------------------------------------------------------
+
+    @classmethod
+    def genesis(cls, root_account_id: bytes,
+                start_amount: int = SYSTEM_CURRENCY_START,
+                close_time: int = 0,
+                hash_batch: Optional[Callable] = None) -> "Ledger":
+        """First ledger: all coins in the root account
+        (reference: Ledger.cpp:29-66, Application.cpp startNewLedger)."""
+        led = cls(seq=1, tot_coins=start_amount, close_time=close_time,
+                  hash_batch=hash_batch)
+        sle = STObject()
+        from ..protocol.sfields import sfAccount, sfLedgerEntryType
+        from ..protocol.formats import LedgerEntryType
+        from ..protocol.stamount import STAmount
+
+        sle[sfLedgerEntryType] = int(LedgerEntryType.ltACCOUNT_ROOT)
+        sle[sfAccount] = root_account_id
+        sle[sfBalance] = STAmount.from_drops(start_amount)
+        sle[sfSequence] = 1
+        from ..protocol.sfields import sfFlags, sfOwnerCount, sfPreviousTxnID, sfPreviousTxnLgrSeq
+
+        sle[sfFlags] = 0
+        sle[sfOwnerCount] = 0
+        sle[sfPreviousTxnID] = b"\x00" * 32
+        sle[sfPreviousTxnLgrSeq] = 0
+        led.write_entry(indexes.account_root_index(root_account_id), sle)
+        return led
+
+    # -- header / hashing -------------------------------------------------
+
+    def header_bytes(self) -> bytes:
+        """reference: Ledger::addRaw (Ledger.cpp:1182-1196)"""
+        s = Serializer()
+        s.add32(self.seq)
+        s.add64(self.tot_coins)
+        s.add64(self.fee_pool)
+        s.add32(self.inflation_seq)
+        s.add_raw(self.parent_hash)
+        s.add_raw(self.tx_map.get_hash())
+        s.add_raw(self.state_map.get_hash())
+        s.add32(self.parent_close_time)
+        s.add32(self.close_time)
+        s.add8(self.close_resolution)
+        s.add8(self.close_flags)
+        return s.data()
+
+    def hash(self) -> bytes:
+        return prefix_hash(HP_LEDGER_MASTER, self.header_bytes())
+
+    @property
+    def tx_hash(self) -> bytes:
+        return self.tx_map.get_hash()
+
+    @property
+    def account_hash(self) -> bytes:
+        return self.state_map.get_hash()
+
+    # -- state entries (SLEs) --------------------------------------------
+
+    def read_entry(self, index: bytes) -> Optional[STObject]:
+        item = self.state_map.get(index)
+        if item is None:
+            return None
+        return STObject.from_bytes(item.data)
+
+    def write_entry(self, index: bytes, sle: STObject) -> None:
+        self.state_map.set_item(SHAMapItem(index, sle.serialize()))
+
+    def delete_entry(self, index: bytes) -> None:
+        self.state_map.del_item(index)
+
+    def account_root(self, account_id: bytes) -> Optional[STObject]:
+        return self.read_entry(indexes.account_root_index(account_id))
+
+    # -- transactions -----------------------------------------------------
+
+    def add_transaction(self, tx_blob: bytes, metadata: bytes) -> bytes:
+        """Insert a tx + its metadata into the tx map (reference:
+        Ledger::addTransaction w/ metadata — item data is
+        VL(tx) || VL(metadata), tag is the tx ID)."""
+        txid = prefix_hash(HP_TXN_ID, tx_blob)
+        s = Serializer()
+        s.add_vl(tx_blob)
+        s.add_vl(metadata)
+        self.tx_map.set_item(SHAMapItem(txid, s.data()), TNType.TX_MD)
+        return txid
+
+    def get_transaction(self, txid: bytes) -> Optional[tuple[bytes, bytes]]:
+        """-> (tx_blob, metadata) or None."""
+        item = self.tx_map.get(txid)
+        if item is None:
+            return None
+        from ..protocol.serializer import BinaryParser
+
+        p = BinaryParser(item.data)
+        return p.read_vl(), p.read_vl()
+
+    # -- lifecycle --------------------------------------------------------
+
+    @staticmethod
+    def round_close_time(close_time: int, close_resolution: int) -> int:
+        """Round to the NEAREST resolution step
+        (reference: Ledger::roundCloseTime, Ledger.cpp:1966-1973)."""
+        if close_time == 0:
+            return 0
+        close_time += close_resolution // 2
+        return close_time - (close_time % close_resolution)
+
+    def close(self, close_time: int, close_resolution: int,
+              correct_close_time: bool = True) -> None:
+        """Seal this ledger (reference: Ledger::setAccepted,
+        Ledger.cpp:330-340 — rounds the close time to the ledger's
+        resolution unless consensus did not agree on a close time, in
+        which case sLCF_NoConsensusTime is flagged)."""
+        if correct_close_time:
+            self.close_time = self.round_close_time(close_time, close_resolution)
+        else:
+            self.close_time = close_time
+        self.close_resolution = close_resolution
+        self.close_flags = 0 if correct_close_time else 1
+        self.closed = True
+
+    def open_successor(self) -> "Ledger":
+        """Open ledger on top of this closed one (reference:
+        Ledger::Ledger(bool, Ledger&) — shares the state map snapshot,
+        fresh tx map)."""
+        child = Ledger(
+            seq=self.seq + 1,
+            parent_hash=self.hash(),
+            tot_coins=self.tot_coins,
+            fee_pool=self.fee_pool,
+            inflation_seq=self.inflation_seq,
+            parent_close_time=self.close_time,
+            close_resolution=self.close_resolution,
+            tx_map=SHAMap(TNType.TX_MD, hash_batch=self.tx_map.hash_batch),
+            state_map=self.state_map.snapshot(),
+        )
+        child.base_fee = self.base_fee
+        child.reference_fee_units = self.reference_fee_units
+        child.reserve_base = self.reserve_base
+        child.reserve_increment = self.reserve_increment
+        return child
+
+    def snapshot(self) -> "Ledger":
+        """O(1) copy (both maps persistent)."""
+        led = Ledger(
+            seq=self.seq,
+            parent_hash=self.parent_hash,
+            tot_coins=self.tot_coins,
+            fee_pool=self.fee_pool,
+            inflation_seq=self.inflation_seq,
+            close_time=self.close_time,
+            parent_close_time=self.parent_close_time,
+            close_resolution=self.close_resolution,
+            close_flags=self.close_flags,
+            tx_map=self.tx_map.snapshot(),
+            state_map=self.state_map.snapshot(),
+        )
+        led.closed = self.closed
+        led.accepted = self.accepted
+        led.validated = self.validated
+        led.base_fee = self.base_fee
+        led.reference_fee_units = self.reference_fee_units
+        led.reserve_base = self.reserve_base
+        led.reserve_increment = self.reserve_increment
+        return led
+
+    # -- persistence ------------------------------------------------------
+
+    def save(self, db: Database) -> bytes:
+        """Persist both trees + the header into the NodeStore (reference:
+        consensus flushDirty + Ledger::pendSaveValidated; header stored as
+        hotLEDGER under the ledger hash)."""
+        self.state_map.flush(db.store_fn(NodeObjectType.ACCOUNT_NODE))
+        self.tx_map.flush(db.store_fn(NodeObjectType.TRANSACTION_NODE))
+        h = self.hash()
+        db.store(NodeObjectType.LEDGER, h,
+                 HP_LEDGER_MASTER.to_bytes(4, "big") + self.header_bytes())
+        return h
+
+    @classmethod
+    def load(cls, db: Database, ledger_hash: bytes,
+             hash_batch: Optional[Callable] = None) -> "Ledger":
+        """Rebuild a ledger (header + both trees) from the NodeStore —
+        the checkpoint/resume path (reference: Application loadOldLedger,
+        Ledger::Ledger(blob) Ledger.cpp:120-175)."""
+        obj = db.fetch(ledger_hash)
+        if obj is None:
+            raise KeyError(f"missing ledger {ledger_hash.hex()}")
+        from ..protocol.serializer import BinaryParser
+
+        body = obj.data
+        if int.from_bytes(body[:4], "big") == HP_LEDGER_MASTER:
+            body = body[4:]
+        p = BinaryParser(body)
+        seq = p.read32()
+        tot_coins = p.read64()
+        fee_pool = p.read64()
+        inflation_seq = p.read32()
+        parent_hash = p.read(32)
+        tx_hash = p.read(32)
+        account_hash = p.read(32)
+        parent_close_time = p.read32()
+        close_time = p.read32()
+        close_resolution = p.read8()
+        close_flags = p.read8()
+
+        def fetch(h: bytes) -> Optional[bytes]:
+            o = db.fetch(h)
+            return o.data if o else None
+
+        kw = {"hash_batch": hash_batch} if hash_batch else {}
+        led = cls(
+            seq=seq,
+            parent_hash=parent_hash,
+            tot_coins=tot_coins,
+            fee_pool=fee_pool,
+            inflation_seq=inflation_seq,
+            close_time=close_time,
+            parent_close_time=parent_close_time,
+            close_resolution=close_resolution,
+            close_flags=close_flags,
+            tx_map=SHAMap.from_store(tx_hash, fetch, TNType.TX_MD, **kw),
+            state_map=SHAMap.from_store(account_hash, fetch,
+                                        TNType.ACCOUNT_STATE, **kw),
+        )
+        led.closed = True
+        if led.hash() != ledger_hash:
+            raise ValueError(
+                f"ledger hash mismatch after load: want {ledger_hash.hex()} "
+                f"got {led.hash().hex()}"
+            )
+        return led

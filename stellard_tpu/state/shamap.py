@@ -1,0 +1,583 @@
+"""SHAMap: 16-ary Merkle-radix tree over 256-bit keys.
+
+Hash/wire compatible with the reference
+(src/ripple_app/shamap/SHAMapTreeNode.cpp:253-295 updateHash,
+:305-395 addRaw; src/ripple_app/shamap/SHAMapNodeID.cpp:147-176
+selectBranch):
+
+- inner node hash  = SHA512half(HP_INNER_NODE || 16 child hashes);
+  an inner with no branches hashes to zero,
+- tx leaf (no md)  = SHA512half(HP_TXN_ID || data)          (== the tx ID),
+- tx leaf (w/ md)  = SHA512half(HP_TX_NODE || data || tag),
+- state leaf       = SHA512half(HP_LEAF_NODE || data || tag).
+
+Architecture differences from the reference (deliberate, TPU-first):
+
+- **Persistent tree.** Nodes are immutable; every mutation returns a new
+  root sharing unchanged subtrees. `snapshot()` is O(1); the reference's
+  copy-on-write sequence numbers (SHAMap.h mSeq) and its mutable-node
+  locking disappear.
+- **Deferred, level-synchronous hashing.** Mutations never hash. Hashes are
+  computed on demand by grouping all unhashed nodes by tree depth and
+  hashing each level in ONE batched call through a pluggable `BatchHasher`
+  (crypto.backend) — deepest level first, so parents always see hashed
+  children. On TPU that is one device program per level over thousands of
+  nodes, replacing the reference's per-node OpenSSL calls inside recursive
+  flushDirty.
+"""
+
+from __future__ import annotations
+
+from enum import IntEnum
+from typing import Callable, Iterator, Optional
+
+from ..utils.hashes import (
+    HP_INNER_NODE,
+    HP_LEAF_NODE,
+    HP_TXN_ID,
+    HP_TX_NODE,
+    prefix_hash,
+)
+
+__all__ = ["TNType", "SHAMapItem", "SHAMap", "Leaf", "Inner"]
+
+ZERO256 = b"\x00" * 32
+
+
+class TNType(IntEnum):
+    """Node types (reference: SHAMapTreeNode.h:47-53). The numeric values
+    double as the wire-format trailer byte for leaves (addRaw snfWIRE)."""
+
+    INNER = 1
+    TX_NM = 2  # transaction, no metadata (tx map of an open ledger)
+    TX_MD = 3  # transaction + metadata (tx map of a closed ledger)
+    ACCOUNT_STATE = 4  # state map leaf
+
+
+# wire-format trailer bytes (reference addRaw: snfWIRE)
+_WIRE_TX_NM = 0
+_WIRE_STATE = 1
+_WIRE_INNER_FULL = 2
+_WIRE_INNER_COMPRESSED = 3
+_WIRE_TX_MD = 4
+
+_LEAF_PREFIX = {
+    TNType.TX_NM: HP_TXN_ID,
+    TNType.TX_MD: HP_TX_NODE,
+    TNType.ACCOUNT_STATE: HP_LEAF_NODE,
+}
+
+
+class SHAMapItem:
+    """A keyed blob: 32-byte tag (index) + serialized payload
+    (reference: src/ripple_app/shamap/SHAMapItem.h)."""
+
+    __slots__ = ("tag", "data")
+
+    def __init__(self, tag: bytes, data: bytes):
+        assert len(tag) == 32
+        self.tag = tag
+        self.data = data
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, SHAMapItem)
+            and self.tag == other.tag
+            and self.data == other.data
+        )
+
+    def __repr__(self):
+        return f"SHAMapItem({self.tag.hex()[:16]}…, {len(self.data)}B)"
+
+
+class Leaf:
+    """Immutable leaf node. `_hash` (lazily-filled) and `_stored`
+    (set once by flush) are the only mutable slots — both are write-once
+    monotone caches, so sharing across snapshots stays safe."""
+
+    __slots__ = ("item", "type", "_hash", "_stored")
+
+    def __init__(self, item: SHAMapItem, type: TNType, hash: Optional[bytes] = None):
+        self.item = item
+        self.type = type
+        self._hash = hash
+        self._stored = False
+
+    def hash_payload(self) -> tuple[int, bytes]:
+        """(prefix, payload) whose prefixed SHA-512-half is this node's hash
+        (reference: SHAMapTreeNode.cpp updateHash leaf arms)."""
+        prefix = _LEAF_PREFIX[self.type]
+        if self.type == TNType.TX_NM:
+            return prefix, self.item.data
+        return prefix, self.item.data + self.item.tag
+
+
+class Inner:
+    """Immutable inner node: 16 child slots."""
+
+    __slots__ = ("children", "_hash", "_stored")
+
+    def __init__(self, children: tuple, hash: Optional[bytes] = None):
+        self.children = children  # tuple of 16 × (Leaf | Inner | None)
+        self._hash = hash
+        self._stored = False
+
+    def is_empty(self) -> bool:
+        return all(c is None for c in self.children)
+
+    def branch_count(self) -> int:
+        return sum(1 for c in self.children if c is not None)
+
+
+EMPTY_INNER = Inner((None,) * 16, hash=ZERO256)
+
+
+def _nibble(key: bytes, depth: int) -> int:
+    """Branch index at `depth` (reference: SHAMapNodeID::selectBranch —
+    high nibble at even depths, low nibble at odd)."""
+    b = key[depth // 2]
+    return b & 0xF if depth & 1 else b >> 4
+
+
+# --------------------------------------------------------------------------
+# persistent-tree primitives (each returns a NEW node; inputs untouched)
+
+
+def _set_item(node, key: bytes, leaf: Leaf, depth: int):
+    if node is None:
+        return leaf
+    if isinstance(node, Leaf):
+        if node.item.tag == key:
+            return leaf  # replace
+        # leaf collision: grow inner nodes until the two keys diverge
+        other = node
+        branch_new = _nibble(key, depth)
+        branch_old = _nibble(other.item.tag, depth)
+        children = [None] * 16
+        if branch_new == branch_old:
+            children[branch_new] = _set_item(other, key, leaf, depth + 1)
+        else:
+            children[branch_new] = leaf
+            children[branch_old] = other
+        return Inner(tuple(children))
+    # inner
+    b = _nibble(key, depth)
+    child = node.children[b]
+    new_child = _set_item(child, key, leaf, depth + 1)
+    children = list(node.children)
+    children[b] = new_child
+    return Inner(tuple(children))
+
+
+def _del_item(node, key: bytes, depth: int):
+    """Returns the replacement node (None if subtree empty), or raises
+    KeyError. Collapses single-leaf inners on the way up (reference:
+    SHAMap::delItem single-child fold-up)."""
+    if node is None:
+        raise KeyError(key.hex())
+    if isinstance(node, Leaf):
+        if node.item.tag != key:
+            raise KeyError(key.hex())
+        return None
+    b = _nibble(key, depth)
+    new_child = _del_item(node.children[b], key, depth + 1)
+    children = list(node.children)
+    children[b] = new_child
+    live = [c for c in children if c is not None]
+    if len(live) == 1 and isinstance(live[0], Leaf):
+        return live[0]
+    if not live:
+        return None
+    return Inner(tuple(children))
+
+
+def _get(node, key: bytes, depth: int) -> Optional[SHAMapItem]:
+    while node is not None:
+        if isinstance(node, Leaf):
+            return node.item if node.item.tag == key else None
+        node = node.children[_nibble(key, depth)]
+        depth += 1
+    return None
+
+
+def _walk_leaves(node) -> Iterator[Leaf]:
+    """Leaves in ascending key order (radix order == numeric order)."""
+    if node is None:
+        return
+    if isinstance(node, Leaf):
+        yield node
+        return
+    for c in node.children:
+        yield from _walk_leaves(c)
+
+
+# --------------------------------------------------------------------------
+# batched hashing
+
+
+def _collect_unhashed(root) -> list[list]:
+    """Unhashed nodes grouped by depth (index = depth). A node whose hash is
+    cached is a sealed subtree — nothing below it can be unhashed, because
+    mutation always rebuilds the whole path from the root with fresh
+    (hashless) nodes."""
+    levels: list[list] = []
+
+    def visit(node, depth):
+        if node is None or node._hash is not None:
+            return
+        while len(levels) <= depth:
+            levels.append([])
+        levels[depth].append(node)
+        if isinstance(node, Inner):
+            for c in node.children:
+                visit(c, depth + 1)
+
+    visit(root, 0)
+    return levels
+
+
+def _default_hasher(prefixes, payloads):
+    return [prefix_hash(p, d) for p, d in zip(prefixes, payloads)]
+
+
+def compute_hashes(root, hash_batch: Callable = _default_hasher) -> int:
+    """Fill every missing node hash, one batched call per tree level,
+    deepest level first. Returns the number of nodes hashed.
+
+    This is the flushDirty replacement (reference:
+    LedgerConsensus.cpp:993-996 → SHAMap::flushDirty): on TPU,
+    `hash_batch` is the device SHA-512 kernel and each level is one
+    device program over all dirty nodes of that level.
+    """
+    levels = _collect_unhashed(root)
+    n = 0
+    for level in reversed(levels):
+        prefixes, payloads = [], []
+        for node in level:
+            if isinstance(node, Leaf):
+                p, d = node.hash_payload()
+            else:
+                if node.is_empty():
+                    node._hash = ZERO256
+                    continue
+                p = HP_INNER_NODE
+                d = b"".join(
+                    (c._hash if c is not None else ZERO256) for c in node.children
+                )
+            prefixes.append(p)
+            payloads.append(d)
+        digests = hash_batch(prefixes, payloads) if prefixes else []
+        i = 0
+        for node in level:
+            if node._hash is None:
+                node._hash = digests[i]
+                i += 1
+        n += len(prefixes)
+    return n
+
+
+# --------------------------------------------------------------------------
+# node (de)serialization — NodeStore uses the prefix format, the wire
+# protocol the compressed format (reference addRaw/make from snfPREFIX /
+# snfWIRE)
+
+
+def serialize_node_prefix(node) -> bytes:
+    if isinstance(node, Inner):
+        out = HP_INNER_NODE.to_bytes(4, "big")
+        return out + b"".join(
+            (c._hash if c is not None else ZERO256) for c in node.children
+        )
+    prefix, payload = node.hash_payload()
+    return prefix.to_bytes(4, "big") + payload
+
+
+def serialize_node_wire(node) -> bytes:
+    if isinstance(node, Inner):
+        if node.branch_count() < 12:
+            out = b""
+            for i, c in enumerate(node.children):
+                if c is not None:
+                    out += c._hash + bytes([i])
+            return out + bytes([_WIRE_INNER_COMPRESSED])
+        return (
+            b"".join((c._hash if c is not None else ZERO256) for c in node.children)
+            + bytes([_WIRE_INNER_FULL])
+        )
+    item, t = node.item, node.type
+    if t == TNType.TX_NM:
+        return item.data + bytes([_WIRE_TX_NM])
+    trailer = _WIRE_STATE if t == TNType.ACCOUNT_STATE else _WIRE_TX_MD
+    return item.data + item.tag + bytes([trailer])
+
+
+class InnerStub:
+    """Parse-time placeholder: an inner node known only by child hashes.
+    Resolved against a fetch source when the tree is materialized."""
+
+    __slots__ = ("child_hashes",)
+
+    def __init__(self, child_hashes: list[bytes]):
+        self.child_hashes = child_hashes
+
+
+def deserialize_node_prefix(blob: bytes):
+    """Parse a NodeStore/prefix-format node → Leaf | InnerStub
+    (reference: SHAMapTreeNode ctor, snfPREFIX arm)."""
+    if len(blob) < 4:
+        raise ValueError("short node blob")
+    prefix = int.from_bytes(blob[:4], "big")
+    body = blob[4:]
+    if prefix == HP_INNER_NODE:
+        if len(body) != 512:
+            raise ValueError(f"bad inner node length {len(body)}")
+        return InnerStub([body[i * 32 : (i + 1) * 32] for i in range(16)])
+    if prefix == HP_TXN_ID:
+        item = SHAMapItem(prefix_hash(HP_TXN_ID, body), body)
+        return Leaf(item, TNType.TX_NM)
+    if prefix == HP_TX_NODE:
+        item = SHAMapItem(body[-32:], body[:-32])
+        return Leaf(item, TNType.TX_MD)
+    if prefix == HP_LEAF_NODE:
+        item = SHAMapItem(body[-32:], body[:-32])
+        return Leaf(item, TNType.ACCOUNT_STATE)
+    raise ValueError(f"unknown node prefix {prefix:#x}")
+
+
+def deserialize_node_wire(blob: bytes):
+    """Parse a wire-format node (reference: SHAMapTreeNode ctor, snfWIRE)."""
+    if not blob:
+        raise ValueError("empty node blob")
+    trailer, body = blob[-1], blob[:-1]
+    if trailer == _WIRE_INNER_FULL:
+        if len(body) != 512:
+            raise ValueError("bad full inner length")
+        return InnerStub([body[i * 32 : (i + 1) * 32] for i in range(16)])
+    if trailer == _WIRE_INNER_COMPRESSED:
+        if len(body) % 33:
+            raise ValueError("bad compressed inner length")
+        hashes = [ZERO256] * 16
+        for i in range(0, len(body), 33):
+            branch = body[i + 32]
+            if branch >= 16:
+                raise ValueError(f"bad branch index {branch}")
+            hashes[branch] = body[i : i + 32]
+        return InnerStub(hashes)
+    if trailer == _WIRE_TX_NM:
+        return Leaf(SHAMapItem(prefix_hash(HP_TXN_ID, body), body), TNType.TX_NM)
+    if trailer == _WIRE_STATE:
+        return Leaf(SHAMapItem(body[-32:], body[:-32]), TNType.ACCOUNT_STATE)
+    if trailer == _WIRE_TX_MD:
+        return Leaf(SHAMapItem(body[-32:], body[:-32]), TNType.TX_MD)
+    raise ValueError(f"unknown wire trailer {trailer}")
+
+
+# --------------------------------------------------------------------------
+
+
+class SHAMap:
+    """Mutable handle over a persistent radix tree.
+
+    Mirrors the reference SHAMap surface (src/ripple_app/shamap/SHAMap.h):
+    add/update/del items, hash, snapshot, compare, flush to a NodeStore,
+    rebuild from a NodeStore by root hash.
+    """
+
+    def __init__(self, leaf_type: TNType = TNType.ACCOUNT_STATE, root=None,
+                 hash_batch: Callable = _default_hasher):
+        self.leaf_type = leaf_type
+        self.root = root if root is not None else EMPTY_INNER
+        self.hash_batch = hash_batch
+
+    # -- queries ----------------------------------------------------------
+
+    def get(self, key: bytes) -> Optional[SHAMapItem]:
+        return _get(self.root, key, 0)
+
+    def __contains__(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def __len__(self) -> int:
+        return sum(1 for _ in _walk_leaves(self.root))
+
+    def items(self) -> Iterator[SHAMapItem]:
+        for leaf in _walk_leaves(self.root):
+            yield leaf.item
+
+    def peek_first_item(self) -> Optional[SHAMapItem]:
+        for leaf in _walk_leaves(self.root):
+            return leaf.item
+        return None
+
+    def succ(self, key: bytes) -> Optional[SHAMapItem]:
+        """First item with tag strictly greater than `key` (reference:
+        SHAMap::peekNextItem — order-book/directory iteration). Key-guided
+        descent, O(depth): at each inner node, recurse into the key's own
+        branch first, then scan higher branches for their smallest leaf."""
+
+        def smallest(node) -> Optional[SHAMapItem]:
+            while isinstance(node, Inner):
+                node = next((c for c in node.children if c is not None), None)
+            return node.item if node is not None else None
+
+        def descend(node, depth) -> Optional[SHAMapItem]:
+            if node is None:
+                return None
+            if isinstance(node, Leaf):
+                return node.item if node.item.tag > key else None
+            b = _nibble(key, depth)
+            found = descend(node.children[b], depth + 1)
+            if found is not None:
+                return found
+            for c in node.children[b + 1 :]:
+                if c is not None:
+                    return smallest(c)
+            return None
+
+        return descend(self.root, 0)
+
+    # -- mutation ---------------------------------------------------------
+
+    def set_item(self, item: SHAMapItem, leaf_type: Optional[TNType] = None) -> None:
+        leaf = Leaf(item, leaf_type or self.leaf_type)
+        self.root = _set_item(self.root, item.tag, leaf, 0)
+
+    def del_item(self, key: bytes) -> None:
+        root = _del_item(self.root, key, 0)
+        if root is None:
+            root = EMPTY_INNER
+        elif isinstance(root, Leaf):
+            # the tree root is always an inner node (reference keeps a root
+            # inner even for a single item)
+            children = [None] * 16
+            children[_nibble(root.item.tag, 0)] = root
+            root = Inner(tuple(children))
+        self.root = root
+
+    # -- hashing / snapshots ---------------------------------------------
+
+    def get_hash(self) -> bytes:
+        if isinstance(self.root, Inner) and self.root.is_empty():
+            return ZERO256
+        if self.root._hash is None:
+            compute_hashes(self.root, self.hash_batch)
+        return self.root._hash
+
+    def snapshot(self) -> "SHAMap":
+        """O(1) immutable snapshot: share the persistent root."""
+        return SHAMap(self.leaf_type, self.root, self.hash_batch)
+
+    # -- delta ------------------------------------------------------------
+
+    def compare(self, other: "SHAMap", limit: int = 2**31) -> dict[bytes, tuple]:
+        """Key → (this_item|None, other_item|None) for keys that differ
+        (reference: SHAMapDelta.cpp SHAMap::compare). Shared subtrees are
+        skipped by object identity / node hash, so the cost is proportional
+        to the delta, not the tree."""
+        delta: dict[bytes, tuple] = {}
+
+        def same(a, b) -> bool:
+            if a is b:
+                return True
+            if a is None or b is None:
+                return False
+            if a._hash is not None and a._hash == b._hash:
+                return True
+            return False
+
+        def walk(a, b):
+            if len(delta) > limit or same(a, b):
+                return
+            if a is None or isinstance(a, Leaf):
+                a_items = {a.item.tag: a.item} if isinstance(a, Leaf) else {}
+            else:
+                a_items = None
+            if b is None or isinstance(b, Leaf):
+                b_items = {b.item.tag: b.item} if isinstance(b, Leaf) else {}
+            else:
+                b_items = None
+            if a_items is not None or b_items is not None:
+                if a_items is None:
+                    a_items = {l.item.tag: l.item for l in _walk_leaves(a)}
+                if b_items is None:
+                    b_items = {l.item.tag: l.item for l in _walk_leaves(b)}
+                for tag in set(a_items) | set(b_items):
+                    ia, ib = a_items.get(tag), b_items.get(tag)
+                    if ia != ib:
+                        delta[tag] = (ia, ib)
+                return
+            for ca, cb in zip(a.children, b.children):
+                walk(ca, cb)
+
+        walk(self.root, other.root)
+        if len(delta) > limit:
+            raise ValueError("delta exceeds limit")
+        return delta
+
+    # -- NodeStore integration -------------------------------------------
+
+    def flush(self, store: Callable[[bytes, bytes], None]) -> int:
+        """Hash everything, then persist every not-yet-stored node as
+        (hash → prefix-format blob). `store` is NodeStore.Database.store or
+        compatible. Returns the number of nodes written.
+
+        The reference interleaves hashing and storing per dirty node
+        (SHAMap::flushDirty); here hashing is one batched pass and storage
+        a second sweep. A `_stored` node's whole subtree was flushed by an
+        earlier call (flush marks bottom-up), so shared subtrees across
+        ledger versions are skipped — the write cost per close is
+        proportional to the delta, not total state.
+        """
+        self.get_hash()
+        count = 0
+
+        def visit(node):
+            nonlocal count
+            if node is None or node._stored:
+                return
+            if isinstance(node, Inner):
+                for c in node.children:
+                    visit(c)
+            store(node._hash, serialize_node_prefix(node))
+            node._stored = True
+            count += 1
+
+        if not (isinstance(self.root, Inner) and self.root.is_empty()):
+            visit(self.root)
+        return count
+
+    @classmethod
+    def from_store(
+        cls,
+        root_hash: bytes,
+        fetch: Callable[[bytes], Optional[bytes]],
+        leaf_type: TNType = TNType.ACCOUNT_STATE,
+        hash_batch: Callable = _default_hasher,
+    ) -> "SHAMap":
+        """Materialize a full tree from a content-addressed store
+        (reference: SHAMap fetchNodeExternal path). Raises KeyError on a
+        missing node (the seam where network acquisition hooks in)."""
+        if root_hash == ZERO256:
+            return cls(leaf_type, EMPTY_INNER, hash_batch)
+
+        def load(h: bytes):
+            blob = fetch(h)
+            if blob is None:
+                raise KeyError(f"missing node {h.hex()}")
+            node = deserialize_node_prefix(blob)
+            if isinstance(node, InnerStub):
+                children = tuple(
+                    load(ch) if ch != ZERO256 else None for ch in node.child_hashes
+                )
+                node = Inner(children, hash=h)
+            else:
+                node._hash = h
+            node._stored = True  # it came from the store
+            return node
+
+        root = load(root_hash)
+        if isinstance(root, Leaf):
+            children = [None] * 16
+            children[_nibble(root.item.tag, 0)] = root
+            root = Inner(tuple(children))
+        return cls(leaf_type, root, hash_batch)

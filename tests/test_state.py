@@ -1,0 +1,540 @@
+"""State-plane tests: SHAMap, NodeStore, Ledger, LedgerEntrySet.
+
+Mirrors the reference's suites: RadixMapTest.cpp (randomized radix ops),
+nodestore/tests/{BackendTests,BasicTests} (random batch round-trips),
+ledger save/load, and directory/metadata behavior of LedgerEntrySet.
+"""
+
+import hashlib
+import os
+
+import pytest
+
+from stellard_tpu.nodestore import NodeObjectType, make_database
+from stellard_tpu.protocol.formats import LedgerEntryType
+from stellard_tpu.protocol.sfields import (
+    sfAffectedNodes,
+    sfBalance,
+    sfIndexes,
+    sfLedgerEntryType,
+    sfSequence,
+)
+from stellard_tpu.protocol.stobject import STObject
+from stellard_tpu.protocol.ter import TER
+from stellard_tpu.state import Ledger, LedgerEntrySet, SHAMap, SHAMapItem, TNType
+from stellard_tpu.state import indexes
+from stellard_tpu.state.shamap import (
+    ZERO256,
+    compute_hashes,
+    deserialize_node_prefix,
+    deserialize_node_wire,
+    serialize_node_prefix,
+    serialize_node_wire,
+)
+from stellard_tpu.utils.hashes import HP_INNER_NODE, HP_TXN_ID, prefix_hash
+
+
+def h(i: int) -> bytes:
+    return hashlib.sha256(i.to_bytes(8, "big")).digest()
+
+
+# --------------------------------------------------------------------------
+# SHAMap
+
+
+class TestSHAMap:
+    def test_empty_hash_is_zero(self):
+        assert SHAMap().get_hash() == ZERO256
+
+    def test_single_item_roundtrip(self):
+        m = SHAMap()
+        m.set_item(SHAMapItem(h(1), b"payload"))
+        assert m.get(h(1)).data == b"payload"
+        assert m.get(h(2)) is None
+        assert len(m) == 1
+
+    def test_insert_order_independence(self):
+        """Same items in any order -> same root hash (Merkle determinism)."""
+        items = [(h(i), bytes([i]) * 10) for i in range(50)]
+        m1, m2 = SHAMap(), SHAMap()
+        for tag, data in items:
+            m1.set_item(SHAMapItem(tag, data))
+        for tag, data in reversed(items):
+            m2.set_item(SHAMapItem(tag, data))
+        assert m1.get_hash() == m2.get_hash()
+
+    def test_update_changes_hash(self):
+        m = SHAMap()
+        m.set_item(SHAMapItem(h(1), b"a"))
+        h1 = m.get_hash()
+        m.set_item(SHAMapItem(h(1), b"b"))
+        assert m.get_hash() != h1
+        m.set_item(SHAMapItem(h(1), b"a"))
+        assert m.get_hash() == h1
+
+    def test_delete_restores_hash(self):
+        """Mirror of RadixMapTest: add/remove returns to prior state."""
+        m = SHAMap()
+        for i in range(40):
+            m.set_item(SHAMapItem(h(i), h(i) + h(i)))
+        before = m.get_hash()
+        m.set_item(SHAMapItem(h(999), b"x"))
+        assert m.get_hash() != before
+        m.del_item(h(999))
+        assert m.get_hash() == before
+
+    def test_delete_missing_raises(self):
+        m = SHAMap()
+        m.set_item(SHAMapItem(h(1), b"a"))
+        with pytest.raises(KeyError):
+            m.del_item(h(2))
+
+    def test_snapshot_isolation(self):
+        m = SHAMap()
+        for i in range(20):
+            m.set_item(SHAMapItem(h(i), b"v%d" % i))
+        snap = m.snapshot()
+        snap_hash = snap.get_hash()
+        m.set_item(SHAMapItem(h(100), b"new"))
+        m.del_item(h(3))
+        assert snap.get_hash() == snap_hash
+        assert snap.get(h(3)) is not None
+        assert m.get(h(3)) is None
+
+    def test_iteration_sorted(self):
+        m = SHAMap()
+        tags = [h(i) for i in range(30)]
+        for t in tags:
+            m.set_item(SHAMapItem(t, b"d"))
+        walked = [it.tag for it in m.items()]
+        assert walked == sorted(tags)
+
+    def test_succ(self):
+        m = SHAMap()
+        tags = sorted(h(i) for i in range(10))
+        for t in tags:
+            m.set_item(SHAMapItem(t, b"d"))
+        assert m.succ(tags[0]).tag == tags[1]
+        assert m.succ(b"\x00" * 32).tag == tags[0]
+        assert m.succ(tags[-1]) is None
+
+    def test_compare_delta(self):
+        m1 = SHAMap()
+        for i in range(100):
+            m1.set_item(SHAMapItem(h(i), b"v"))
+        m2 = m1.snapshot()
+        m2.set_item(SHAMapItem(h(100), b"new"))  # added
+        m2.set_item(SHAMapItem(h(5), b"changed"))  # modified
+        m2.del_item(h(7))  # deleted
+        delta = m1.compare(m2)
+        assert set(delta) == {h(100), h(5), h(7)}
+        assert delta[h(100)] == (None, m2.get(h(100)))
+        assert delta[h(5)][0].data == b"v" and delta[h(5)][1].data == b"changed"
+        assert delta[h(7)][1] is None
+
+    def test_inner_node_hash_formula(self):
+        """Inner hash = prefixed SHA-512-half over 16 child hashes
+        (reference: SHAMapTreeNode.cpp:253-260)."""
+        m = SHAMap()
+        m.set_item(SHAMapItem(h(1), b"a"))
+        m.set_item(SHAMapItem(h(2), b"b"))
+        m.get_hash()
+        root = m.root
+        manual = prefix_hash(
+            HP_INNER_NODE,
+            b"".join((c._hash if c else ZERO256) for c in root.children),
+        )
+        assert manual == m.get_hash()
+
+    def test_tx_leaf_hash_is_txid(self):
+        """TX_NM leaf hash = SHA512half(TXN prefix || tx) == the tx ID."""
+        m = SHAMap(TNType.TX_NM)
+        blob = b"fake transaction bytes"
+        txid = prefix_hash(HP_TXN_ID, blob)
+        m.set_item(SHAMapItem(txid, blob))
+        compute_hashes(m.root)
+        leaf = m.root.children[txid[0] >> 4]
+        assert leaf._hash == txid
+
+    def test_node_serialization_roundtrip(self):
+        m = SHAMap()
+        for i in range(20):
+            m.set_item(SHAMapItem(h(i), b"data%d" % i))
+        m.get_hash()
+        # leaf round-trip, both formats
+        leaf = next(iter(_leaves(m.root)))
+        for ser, deser in [
+            (serialize_node_prefix, deserialize_node_prefix),
+            (serialize_node_wire, deserialize_node_wire),
+        ]:
+            out = deser(ser(leaf))
+            assert out.item.tag == leaf.item.tag
+            assert out.item.data == leaf.item.data
+            assert out.type == leaf.type
+        # inner round-trip, both formats
+        for ser, deser in [
+            (serialize_node_prefix, deserialize_node_prefix),
+            (serialize_node_wire, deserialize_node_wire),
+        ]:
+            stub = deser(ser(m.root))
+            want = [(c._hash if c else ZERO256) for c in m.root.children]
+            assert stub.child_hashes == want
+
+    def test_wire_compressed_inner(self):
+        """<12 branches uses the compressed wire encoding."""
+        m = SHAMap()
+        m.set_item(SHAMapItem(h(1), b"a"))
+        m.set_item(SHAMapItem(h(2), b"b"))
+        m.get_hash()
+        blob = serialize_node_wire(m.root)
+        assert blob[-1] == 3  # compressed trailer
+        stub = deserialize_node_wire(blob)
+        want = [(c._hash if c else ZERO256) for c in m.root.children]
+        assert stub.child_hashes == want
+
+    def test_flush_and_rebuild_from_store(self):
+        db = make_database("memory", async_writes=False)
+        m = SHAMap()
+        for i in range(200):
+            m.set_item(SHAMapItem(h(i), h(i) * 2))
+        root_hash = m.get_hash()
+        m.flush(db.store_fn(NodeObjectType.ACCOUNT_NODE))
+
+        def fetch(hh):
+            o = db.fetch(hh)
+            return o.data if o else None
+
+        m2 = SHAMap.from_store(root_hash, fetch)
+        assert m2.get_hash() == root_hash
+        assert len(m2) == 200
+        for i in range(200):
+            assert m2.get(h(i)).data == h(i) * 2
+
+    def test_batched_hashing_matches_sequential(self):
+        """Level-batched hashing == per-node hashing."""
+        calls = []
+
+        def spy_hasher(prefixes, payloads):
+            calls.append(len(prefixes))
+            return [prefix_hash(p, d) for p, d in zip(prefixes, payloads)]
+
+        m = SHAMap(hash_batch=spy_hasher)
+        ref = SHAMap()
+        for i in range(300):
+            m.set_item(SHAMapItem(h(i), b"x" * 40))
+            ref.set_item(SHAMapItem(h(i), b"x" * 40))
+        assert m.get_hash() == ref.get_hash()
+        assert len(calls) > 1  # one call per level, not per node
+        assert max(calls) > 50  # leaves batched together
+
+
+def _leaves(node):
+    from stellard_tpu.state.shamap import Inner, Leaf
+
+    if isinstance(node, Leaf):
+        yield node
+    elif isinstance(node, Inner):
+        for c in node.children:
+            if c is not None:
+                yield from _leaves(c)
+
+
+# --------------------------------------------------------------------------
+# NodeStore
+
+
+class TestNodeStore:
+    @pytest.mark.parametrize("backend", ["memory", "sqlite"])
+    def test_roundtrip(self, backend, tmp_path):
+        kwargs = {}
+        if backend == "sqlite":
+            kwargs["path"] = str(tmp_path / "nodes.db")
+        db = make_database(backend, async_writes=False, **kwargs)
+        blobs = {h(i): os.urandom(64) for i in range(100)}
+        for k, v in blobs.items():
+            db.store(NodeObjectType.ACCOUNT_NODE, k, v)
+        for k, v in blobs.items():
+            obj = db.fetch(k)
+            assert obj is not None and obj.data == v
+        assert db.fetch(h(10_000)) is None
+        db.close()
+
+    def test_async_writer_visibility(self):
+        db = make_database("memory")
+        for i in range(500):
+            db.store(NodeObjectType.TRANSACTION_NODE, h(i), h(i))
+        for i in range(500):  # reads see pending writes immediately
+            assert db.fetch(h(i)).data == h(i)
+        db.sync()
+        assert db.backend.fetch(h(0)) is not None
+        db.close()
+
+    def test_sqlite_persistence(self, tmp_path):
+        path = str(tmp_path / "n.db")
+        db = make_database("sqlite", path=path)
+        db.store(NodeObjectType.LEDGER, h(1), b"header")
+        db.close()
+        db2 = make_database("sqlite", path=path, async_writes=False)
+        assert db2.fetch(h(1)).data == b"header"
+        db2.close()
+
+    def test_null_backend(self):
+        db = make_database("null", async_writes=False)
+        db.store(NodeObjectType.LEDGER, h(1), b"x")
+        db.sync()
+        assert db.backend.fetch(h(1)) is None
+
+    def test_unknown_backend(self):
+        with pytest.raises(KeyError):
+            make_database("levelddb")
+
+
+# --------------------------------------------------------------------------
+# Ledger
+
+
+ROOT = hashlib.sha256(b"root account").digest()[:20]
+
+
+class TestLedger:
+    def test_genesis(self):
+        led = Ledger.genesis(ROOT)
+        acct = led.account_root(ROOT)
+        assert acct is not None
+        assert acct[sfBalance].mantissa == led.tot_coins
+        assert acct[sfSequence] == 1
+        assert led.seq == 1
+
+    def test_header_hash_changes_with_state(self):
+        led = Ledger.genesis(ROOT)
+        h1 = led.hash()
+        led.write_entry(h(42), _mk_sle())
+        assert led.hash() != h1
+
+    def test_open_successor_chain(self):
+        g = Ledger.genesis(ROOT)
+        g.close(close_time=1000, close_resolution=30)
+        child = g.open_successor()
+        assert child.seq == 2
+        assert child.parent_hash == g.hash()
+        assert child.account_root(ROOT) is not None
+        assert child.tx_map.get_hash() == ZERO256
+
+    def test_tx_roundtrip(self):
+        led = Ledger.genesis(ROOT)
+        txid = led.add_transaction(b"txbytes", b"metabytes")
+        assert txid == prefix_hash(HP_TXN_ID, b"txbytes")
+        blob, meta = led.get_transaction(txid)
+        assert (blob, meta) == (b"txbytes", b"metabytes")
+
+    def test_save_load_roundtrip(self):
+        db = make_database("memory", async_writes=False)
+        led = Ledger.genesis(ROOT)
+        for i in range(50):
+            led.write_entry(h(i), _mk_sle(i))
+        led.add_transaction(b"tx1", b"meta1")
+        lh = led.save(db)
+        led2 = Ledger.load(db, lh)
+        assert led2.hash() == lh
+        assert led2.seq == led.seq
+        assert led2.tot_coins == led.tot_coins
+        assert led2.read_entry(h(7)) == led.read_entry(h(7))
+        assert led2.get_transaction(led.add_transaction(b"tx1", b"meta1"))
+
+
+def _mk_sle(i: int = 0) -> STObject:
+    sle = STObject()
+    sle[sfLedgerEntryType] = int(LedgerEntryType.ltDIR_NODE)
+    sle[sfSequence] = i
+    return sle
+
+
+# --------------------------------------------------------------------------
+# LedgerEntrySet
+
+
+class TestLedgerEntrySet:
+    def test_peek_modify_apply(self):
+        led = Ledger.genesis(ROOT)
+        les = LedgerEntrySet(led)
+        idx = indexes.account_root_index(ROOT)
+        sle = les.peek(idx)
+        sle[sfSequence] = 5
+        les.modify(idx)
+        les.apply()
+        assert led.account_root(ROOT)[sfSequence] == 5
+
+    def test_unapplied_changes_invisible(self):
+        led = Ledger.genesis(ROOT)
+        les = LedgerEntrySet(led)
+        idx = indexes.account_root_index(ROOT)
+        les.peek(idx)[sfSequence] = 99
+        les.modify(idx)
+        assert led.account_root(ROOT)[sfSequence] == 1  # not applied
+
+    def test_create_then_erase_is_noop(self):
+        led = Ledger.genesis(ROOT)
+        les = LedgerEntrySet(led)
+        les.create(LedgerEntryType.ltDIR_NODE, h(1))
+        les.erase(h(1))
+        les.apply()
+        assert led.read_entry(h(1)) is None
+
+    def test_dir_add_and_iterate(self):
+        led = Ledger.genesis(ROOT)
+        les = LedgerEntrySet(led)
+        root_idx = indexes.owner_dir_index(ROOT)
+        added = []
+        for i in range(70):  # spans 3 pages (32 per page)
+            ter, page = les.dir_add(root_idx, h(i))
+            assert ter == TER.tesSUCCESS
+            added.append((h(i), page))
+        assert {p for _, p in added} == {0, 1, 2}
+        assert set(les.dir_entries(root_idx)) == {h(i) for i in range(70)}
+
+    def test_dir_delete(self):
+        led = Ledger.genesis(ROOT)
+        les = LedgerEntrySet(led)
+        root_idx = indexes.owner_dir_index(ROOT)
+        pages = {}
+        for i in range(40):
+            _, page = les.dir_add(root_idx, h(i))
+            pages[h(i)] = page
+        for i in range(40):
+            assert les.dir_delete(root_idx, pages[h(i)], h(i)) == TER.tesSUCCESS
+        les.apply()
+        assert led.read_entry(root_idx) is None  # empty root deleted
+
+    def test_metadata_created_modified_deleted(self):
+        led = Ledger.genesis(ROOT)
+        led.write_entry(h(2), _mk_sle(2))
+        led.write_entry(h(3), _mk_sle(3))
+        les = LedgerEntrySet(led)
+        sle = les.create(LedgerEntryType.ltDIR_NODE, h(1))
+        sle[sfSequence] = 1
+        m = les.peek(h(2))
+        m[sfSequence] = 22
+        les.modify(h(2))
+        les.erase(h(3))
+        meta = les.calc_meta(TER.tesSUCCESS, 0, led.seq, h(99))
+        nodes = {f.name: obj for f, obj in meta[sfAffectedNodes]}
+        assert set(nodes) == {"CreatedNode", "ModifiedNode", "DeletedNode"}
+        from stellard_tpu.protocol.sfields import (
+            sfFinalFields,
+            sfNewFields,
+            sfPreviousFields,
+        )
+
+        assert nodes["CreatedNode"][sfNewFields][sfSequence] == 1
+        assert nodes["ModifiedNode"][sfPreviousFields][sfSequence] == 2
+        assert nodes["ModifiedNode"][sfFinalFields][sfSequence] == 22
+        assert nodes["DeletedNode"][sfFinalFields][sfSequence] == 3
+        # metadata serializes canonically
+        blob = meta.serialize()
+        assert STObject.from_bytes(blob) == meta
+
+    def test_index_formulas_stable(self):
+        """Golden stability of index namespaces (cross-checked against the
+        reference construction: 2-byte space tag || fields, SHA-512-half)."""
+        a = bytes(range(20))
+        b = bytes(range(20, 40))
+        cur = b"\x00" * 12 + b"USD\x00" + b"\x00" * 4  # 20-byte currency
+        assert indexes.account_root_index(a) == prefix_hash_raw(b"\x00a" + a)
+        assert indexes.ripple_state_index(a, b, cur) == indexes.ripple_state_index(
+            b, a, cur
+        )
+        q = indexes.quality_index(h(5), 7)
+        assert indexes.get_quality(q) == 7
+        assert indexes.quality_next(q) > q
+
+
+def prefix_hash_raw(data: bytes) -> bytes:
+    return hashlib.sha512(data).digest()[:32]
+
+
+# --------------------------------------------------------------------------
+# regression tests for review findings
+
+
+class TestReviewFindings:
+    def test_delete_then_recreate_in_same_set(self):
+        """Create-after-delete collapses to modify (LedgerEntrySet.cpp:176)."""
+        led = Ledger.genesis(ROOT)
+        les = LedgerEntrySet(led)
+        root_idx = indexes.owner_dir_index(ROOT)
+        ter, page = les.dir_add(root_idx, h(1))
+        les.apply()
+        les2 = LedgerEntrySet(led)
+        assert les2.dir_delete(root_idx, 0, h(1)) == TER.tesSUCCESS
+        assert les2.peek(root_idx) is None  # deleted reads as absent
+        ter, page = les2.dir_add(root_idx, h(2))  # recreate in same set
+        assert ter == TER.tesSUCCESS
+        les2.apply()
+        assert set(LedgerEntrySet(led).dir_entries(root_idx)) == {h(2)}
+
+    def test_round_close_time_nearest(self):
+        """reference Ledger::roundCloseTime rounds to NEAREST step."""
+        assert Ledger.round_close_time(0, 30) == 0
+        assert Ledger.round_close_time(29, 30) == 30
+        assert Ledger.round_close_time(14, 30) == 0
+        assert Ledger.round_close_time(15, 30) == 30
+        assert Ledger.round_close_time(45, 30) == 60
+
+    def test_succ_matches_walk(self):
+        import random
+
+        rng = random.Random(7)
+        m = SHAMap()
+        tags = sorted(h(rng.randrange(10**9)) for _ in range(200))
+        for t in tags:
+            m.set_item(SHAMapItem(t, b"d"))
+        for probe in [b"\x00" * 32, tags[0], tags[57], tags[-1], b"\xff" * 32]:
+            walk = next((t for t in tags if t > probe), None)
+            got = m.succ(probe)
+            assert (got.tag if got else None) == walk
+
+    def test_flush_is_incremental(self):
+        writes = []
+        m = SHAMap()
+        for i in range(100):
+            m.set_item(SHAMapItem(h(i), b"v"))
+        m.flush(lambda hh, d: writes.append(hh))
+        first = len(writes)
+        assert first > 100  # leaves + inners
+        writes.clear()
+        m.flush(lambda hh, d: writes.append(hh))
+        assert writes == []  # nothing dirty
+        m.set_item(SHAMapItem(h(0), b"changed"))
+        m.flush(lambda hh, d: writes.append(hh))
+        assert 0 < len(writes) <= 10  # just the changed path
+
+    def test_writer_error_surfaces(self):
+        from stellard_tpu.nodestore.core import Backend, Database
+
+        class Boom(Backend):
+            def store_batch(self, batch):
+                raise OSError("disk full")
+
+            def fetch(self, hash):
+                return None
+
+        db = Database(Boom())
+        db.store(NodeObjectType.LEDGER, h(1), b"x")
+        with pytest.raises(RuntimeError, match="writer failed"):
+            db.sync()
+
+    def test_wire_bad_branch_raises_valueerror(self):
+        blob = b"\x00" * 32 + bytes([200]) + bytes([3])  # branch 200 invalid
+        with pytest.raises(ValueError):
+            deserialize_node_wire(blob)
+
+    def test_load_corrupt_header_raises(self):
+        db = make_database("memory", async_writes=False)
+        led = Ledger.genesis(ROOT)
+        lh = led.save(db)
+        obj = db.fetch(lh)
+        bad = bytearray(obj.data)
+        bad[8] ^= 0xFF  # corrupt totCoins in stored header
+        db.store(NodeObjectType.LEDGER, lh, bytes(bad))
+        with pytest.raises(ValueError, match="hash mismatch"):
+            Ledger.load(db, lh)
