@@ -1,0 +1,143 @@
+"""TransactionEngine: applies one transaction to a ledger.
+
+Reference: src/ripple_app/tx/TransactionEngine.cpp:94-253 —
+applyTransaction dispatches to a transactor, handles the tec
+claim-fee-only reprocess, checks invariants, records the tx into the
+ledger's tx map (open: blob only; closing: blob + metadata + fee burn).
+"""
+
+from __future__ import annotations
+
+from enum import IntFlag
+
+from ..protocol.sfields import sfBalance, sfSequence
+from ..protocol.stamount import STAmount
+from ..protocol.sttx import SerializedTransaction
+from ..protocol.ter import TER
+from ..state import LedgerEntrySet, indexes
+from ..state.ledger import Ledger
+
+__all__ = ["TransactionEngine", "TxParams"]
+
+
+class TxParams(IntFlag):
+    """reference: TransactionEngineParams (TransactionEngine.h)"""
+
+    NONE = 0
+    OPEN_LEDGER = 0x10  # tapOPEN_LEDGER
+    RETRY = 0x20  # tapRETRY
+    ADMIN = 0x400  # tapADMIN
+    NO_CHECK_SIGN = 0x01  # tapNO_CHECK_SIGN
+
+
+def _is_tec(ter: TER) -> bool:
+    return 100 <= int(ter) < 300
+
+
+def _is_tem(ter: TER) -> bool:
+    return -299 <= int(ter) < -200
+
+
+class TransactionEngine:
+    def __init__(self, ledger: Ledger):
+        self.ledger = ledger
+        self.les: LedgerEntrySet | None = None
+        self.tx_seq = 0  # metadata TransactionIndex within the closing ledger
+
+    def apply_transaction(
+        self, tx: SerializedTransaction, params: TxParams
+    ) -> tuple[TER, bool]:
+        """-> (TER, did_apply). reference: applyTransaction
+        (TransactionEngine.cpp:94-253)."""
+        from .transactor import make_transactor
+
+        self.les = LedgerEntrySet(self.ledger)
+
+        ok, why = tx.passes_local_checks()
+        if not ok:
+            return TER.temINVALID, False
+
+        transactor = make_transactor(tx, params, self)
+        if transactor is None:
+            return TER.temUNKNOWN, False
+
+        ter = transactor.apply()
+        did_apply = False
+
+        if ter == TER.tesSUCCESS:
+            did_apply = True
+        elif _is_tec(ter) and not (params & TxParams.RETRY):
+            # claim only the fee (reference: TransactionEngine.cpp:146-185)
+            self.les = LedgerEntrySet(self.ledger)
+            idx = indexes.account_root_index(tx.account)
+            acct = self.les.peek(idx)
+            if acct is None:
+                ter = TER.terNO_ACCOUNT
+            else:
+                t_seq, a_seq = tx.sequence, acct[sfSequence]
+                if a_seq < t_seq:
+                    ter = TER.terPRE_SEQ
+                elif a_seq > t_seq:
+                    ter = TER.tefPAST_SEQ
+                else:
+                    fee = tx.fee
+                    balance = acct[sfBalance]
+                    if balance < fee:
+                        ter = TER.terINSUF_FEE_B
+                    else:
+                        acct[sfBalance] = balance - fee
+                        acct[sfSequence] = t_seq + 1
+                        self.les.modify(idx)
+                        did_apply = True
+
+        if did_apply:
+            minted = getattr(transactor, "minted_coins", 0)
+            if not self._check_invariants(tx, params, minted):
+                return TER.tefINTERNAL, False
+            blob = tx.serialize()
+            if params & TxParams.OPEN_LEDGER:
+                txid, added = self.ledger.add_open_transaction(blob)
+                if not added:
+                    return TER.tefALREADY, False
+                # open ledger records the tx only; no state write
+                # (the transactor returned before do_apply)
+            else:
+                meta = self.les.calc_meta(ter, self.tx_seq, self.ledger.seq, tx.txid())
+                self.tx_seq += 1
+                self.ledger.add_transaction(blob, meta.serialize())
+                # burn the fee (reference: destroyCoins)
+                self.ledger.tot_coins -= tx.fee.mantissa
+                self.ledger.fee_pool += tx.fee.mantissa
+                self.les.apply()
+
+        return ter, did_apply
+
+    def _check_invariants(self, tx: SerializedTransaction, params: TxParams,
+                          minted: int = 0) -> bool:
+        """Native-coin conservation across the entry set: total STR balance
+        change must equal minted coins minus the fee. The reference's
+        checkInvariants is an empty stub (TransactionCheck.cpp:26-32); this
+        enforces the conservation law it gestures at."""
+        if params & TxParams.OPEN_LEDGER:
+            return True
+        from ..protocol.sfields import sfBalance as _bal
+        from ..state.entryset import Action
+
+        delta = 0
+        for idx, sle, action in self.les.entries():
+            cur = sle.get(_bal) if sle is not None else None
+            e = self.les._entries[idx]
+            old = e.orig.get(_bal) if e.orig is not None else None
+
+            def drops(v):
+                if v is None or not isinstance(v, STAmount) or not v.is_native:
+                    return 0
+                return -v.mantissa if v.negative else v.mantissa
+
+            if action == Action.CREATED:
+                delta += drops(cur)
+            elif action == Action.DELETED:
+                delta -= drops(old)
+            elif action == Action.MODIFIED:
+                delta += drops(cur) - drops(old)
+        return delta == minted - tx.fee.mantissa

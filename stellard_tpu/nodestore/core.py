@@ -91,6 +91,9 @@ class Database:
     def __init__(self, backend: Backend, cache_size: int = 65536,
                  batch_size: int = 256, async_writes: bool = True):
         self.backend = backend
+        # hashes known to be durably in THIS store — the `known` set for
+        # SHAMap.flush incremental writes
+        self.flushed: set[bytes] = set()
         self._cache: dict[bytes, NodeObject] = {}
         self._cache_size = cache_size
         self._pending: dict[bytes, NodeObject] = {}
@@ -121,6 +124,8 @@ class Database:
     def store(self, type: NodeObjectType, hash: bytes, data: bytes) -> None:
         obj = NodeObject(type, hash, data)
         with self._lock:
+            if self._write_error is not None:
+                raise RuntimeError("nodestore writer failed") from self._write_error
             self._pending[hash] = obj
             if self._writer is None:
                 self.backend.store(obj)
@@ -147,13 +152,15 @@ class Database:
                 raise RuntimeError("nodestore writer failed") from self._write_error
 
     def close(self) -> None:
-        self.sync()
-        with self._lock:
-            self._stopping = True
-            self._wake.notify()
-        if self._writer:
-            self._writer.join(timeout=5)
-        self.backend.close()
+        try:
+            self.sync()
+        finally:
+            with self._lock:
+                self._stopping = True
+                self._wake.notify()
+            if self._writer:
+                self._writer.join(timeout=5)
+            self.backend.close()
 
     # -- internals --------------------------------------------------------
 

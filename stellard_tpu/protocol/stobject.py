@@ -179,6 +179,16 @@ def _deserialize_value(p: BinaryParser, f: SField) -> Any:
     raise ValueError(f"cannot deserialize field type {t}")
 
 
+def _copy_value(v: Any) -> Any:
+    if isinstance(v, list):
+        return [_copy_value(x) for x in v]
+    if isinstance(v, STObject):
+        return v.copy()
+    if isinstance(v, STArray):
+        return STArray([(f, o.copy()) for f, o in v])
+    return v  # scalars / bytes / STAmount are value-like
+
+
 class STObject:
     """Ordered-by-canon field map."""
 
@@ -211,8 +221,10 @@ class STObject:
         return iter(sorted(self._fields.items(), key=lambda kv: sort_key(kv[0])))
 
     def copy(self) -> "STObject":
+        """Copy that detaches container values (lists, nested objects,
+        arrays) so mutating the copy never aliases the original."""
         out = STObject()
-        out._fields = dict(self._fields)
+        out._fields = {f: _copy_value(v) for f, v in self._fields.items()}
         return out
 
     def __len__(self) -> int:

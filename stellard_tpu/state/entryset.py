@@ -176,6 +176,17 @@ class LedgerEntrySet:
                     node[sfNewFields] = fields
                 affected.append(sfCreatedNode, node)
             elif e.action == Action.DELETED:
+                # PreviousFields: original values that were changed before
+                # the delete (reference calcRawMeta DeletedNode arm)
+                prevs = STObject()
+                if e.orig is not None:
+                    for f, v in e.orig.fields():
+                        if f in _META_SKIP:
+                            continue
+                        if e.sle is not None and e.sle.get(f) != v:
+                            prevs[f] = v
+                if len(prevs):
+                    node[sfPreviousFields] = prevs
                 finals = STObject()
                 for f, v in e.sle.fields():
                     if f not in _META_SKIP:
@@ -234,7 +245,8 @@ class LedgerEntrySet:
         page = root.get(sfIndexPrevious, 0)
         node_index = indexes.dir_node_index(root_index, page)
         node = self.peek(node_index) if page else root
-        assert node is not None
+        if node is None:  # corrupt chain: root points at a missing page
+            return TER.tefBAD_LEDGER, 0
         idxs = list(node.get(sfIndexes, []))
         if len(idxs) < DIR_NODE_MAX:
             idxs.append(entry_index)

@@ -80,6 +80,7 @@ class Ledger:
         self.reference_fee_units = DEFAULT_REFERENCE_FEE_UNITS
         self.reserve_base = DEFAULT_RESERVE_BASE
         self.reserve_increment = DEFAULT_RESERVE_INCREMENT
+        self.load_factor = 256  # 256 = no load escalation (LoadFeeTrack)
 
     # -- genesis ----------------------------------------------------------
 
@@ -156,7 +157,38 @@ class Ledger:
     def account_root(self, account_id: bytes) -> Optional[STObject]:
         return self.read_entry(indexes.account_root_index(account_id))
 
+    # -- fees / reserves --------------------------------------------------
+
+    def reserve(self, owner_count: int) -> int:
+        """reference: Ledger::getReserve (Ledger.h:446-451)"""
+        return self.reserve_base + owner_count * self.reserve_increment
+
+    def scale_fee_base(self, fee: int) -> int:
+        """reference: Ledger::scaleFeeBase — fee units → drops. With the
+        default schedule (base_fee == reference_fee_units scaling) this is
+        identity; kept as the seam for fee voting."""
+        return fee
+
+    def scale_fee_load(self, fee: int, admin: bool = False) -> int:
+        """reference: Ledger::scaleFeeLoad via LoadFeeTrack — the load
+        multiplier hooks in here (node runtime, stage 5); admin traffic is
+        never load-scaled."""
+        if admin:
+            return fee
+        return fee * self.load_factor // 256 if self.load_factor > 256 else fee
+
     # -- transactions -----------------------------------------------------
+
+    def add_open_transaction(self, tx_blob: bytes) -> tuple[bytes, bool]:
+        """Record a tx (no metadata) in an OPEN ledger's tx map
+        (reference: Ledger::addTransaction(txID, s) — item data is the raw
+        blob, node type tnTRANSACTION_NM). Returns (txid, added) — added is
+        False if already present (tefALREADY race)."""
+        txid = prefix_hash(HP_TXN_ID, tx_blob)
+        if self.tx_map.get(txid) is not None:
+            return txid, False
+        self.tx_map.set_item(SHAMapItem(txid, tx_blob), TNType.TX_NM)
+        return txid, True
 
     def add_transaction(self, tx_blob: bytes, metadata: bytes) -> bytes:
         """Insert a tx + its metadata into the tx map (reference:
@@ -170,13 +202,16 @@ class Ledger:
         return txid
 
     def get_transaction(self, txid: bytes) -> Optional[tuple[bytes, bytes]]:
-        """-> (tx_blob, metadata) or None."""
-        item = self.tx_map.get(txid)
-        if item is None:
+        """-> (tx_blob, metadata) or None. Open-ledger items (raw blob, no
+        metadata) return (blob, b"")."""
+        leaf = self.tx_map.get_leaf(txid)
+        if leaf is None:
             return None
+        if leaf.type == TNType.TX_NM:
+            return leaf.item.data, b""
         from ..protocol.serializer import BinaryParser
 
-        p = BinaryParser(item.data)
+        p = BinaryParser(leaf.item.data)
         return p.read_vl(), p.read_vl()
 
     # -- lifecycle --------------------------------------------------------
@@ -223,6 +258,7 @@ class Ledger:
         child.reference_fee_units = self.reference_fee_units
         child.reserve_base = self.reserve_base
         child.reserve_increment = self.reserve_increment
+        child.load_factor = self.load_factor
         return child
 
     def snapshot(self) -> "Ledger":
@@ -247,6 +283,7 @@ class Ledger:
         led.reference_fee_units = self.reference_fee_units
         led.reserve_base = self.reserve_base
         led.reserve_increment = self.reserve_increment
+        led.load_factor = self.load_factor
         return led
 
     # -- persistence ------------------------------------------------------
@@ -254,9 +291,10 @@ class Ledger:
     def save(self, db: Database) -> bytes:
         """Persist both trees + the header into the NodeStore (reference:
         consensus flushDirty + Ledger::pendSaveValidated; header stored as
-        hotLEDGER under the ledger hash)."""
-        self.state_map.flush(db.store_fn(NodeObjectType.ACCOUNT_NODE))
-        self.tx_map.flush(db.store_fn(NodeObjectType.TRANSACTION_NODE))
+        hotLEDGER under the ledger hash). Uses the store's `flushed` set so
+        repeated saves only write the delta."""
+        self.state_map.flush(db.store_fn(NodeObjectType.ACCOUNT_NODE), db.flushed)
+        self.tx_map.flush(db.store_fn(NodeObjectType.TRANSACTION_NODE), db.flushed)
         h = self.hash()
         db.store(NodeObjectType.LEDGER, h,
                  HP_LEDGER_MASTER.to_bytes(4, "big") + self.header_bytes())
@@ -291,6 +329,8 @@ class Ledger:
 
         def fetch(h: bytes) -> Optional[bytes]:
             o = db.fetch(h)
+            if o is not None:
+                db.flushed.add(h)  # node verifiably present in this store
             return o.data if o else None
 
         kw = {"hash_batch": hash_batch} if hash_batch else {}

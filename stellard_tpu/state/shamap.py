@@ -91,17 +91,15 @@ class SHAMapItem:
 
 
 class Leaf:
-    """Immutable leaf node. `_hash` (lazily-filled) and `_stored`
-    (set once by flush) are the only mutable slots — both are write-once
-    monotone caches, so sharing across snapshots stays safe."""
+    """Immutable leaf node. `_hash` is a lazily-filled, write-once cache —
+    the only mutable slot, so sharing across snapshots stays safe."""
 
-    __slots__ = ("item", "type", "_hash", "_stored")
+    __slots__ = ("item", "type", "_hash")
 
     def __init__(self, item: SHAMapItem, type: TNType, hash: Optional[bytes] = None):
         self.item = item
         self.type = type
         self._hash = hash
-        self._stored = False
 
     def hash_payload(self) -> tuple[int, bytes]:
         """(prefix, payload) whose prefixed SHA-512-half is this node's hash
@@ -115,12 +113,11 @@ class Leaf:
 class Inner:
     """Immutable inner node: 16 child slots."""
 
-    __slots__ = ("children", "_hash", "_stored")
+    __slots__ = ("children", "_hash")
 
     def __init__(self, children: tuple, hash: Optional[bytes] = None):
         self.children = children  # tuple of 16 × (Leaf | Inner | None)
         self._hash = hash
-        self._stored = False
 
     def is_empty(self) -> bool:
         return all(c is None for c in self.children)
@@ -394,6 +391,16 @@ class SHAMap:
     def get(self, key: bytes) -> Optional[SHAMapItem]:
         return _get(self.root, key, 0)
 
+    def get_leaf(self, key: bytes) -> Optional[Leaf]:
+        """Typed leaf lookup, O(depth)."""
+        node, depth = self.root, 0
+        while node is not None:
+            if isinstance(node, Leaf):
+                return node if node.item.tag == key else None
+            node = node.children[_nibble(key, depth)]
+            depth += 1
+        return None
+
     def __contains__(self, key: bytes) -> bool:
         return self.get(key) is not None
 
@@ -403,6 +410,11 @@ class SHAMap:
     def items(self) -> Iterator[SHAMapItem]:
         for leaf in _walk_leaves(self.root):
             yield leaf.item
+
+    def leaves(self) -> Iterator[Leaf]:
+        """Typed leaves in key order (callers that must distinguish raw-tx
+        vs tx+metadata items, reference visitLeaves)."""
+        yield from _walk_leaves(self.root)
 
     def peek_first_item(self) -> Optional[SHAMapItem]:
         for leaf in _walk_leaves(self.root):
@@ -516,30 +528,34 @@ class SHAMap:
 
     # -- NodeStore integration -------------------------------------------
 
-    def flush(self, store: Callable[[bytes, bytes], None]) -> int:
-        """Hash everything, then persist every not-yet-stored node as
-        (hash → prefix-format blob). `store` is NodeStore.Database.store or
-        compatible. Returns the number of nodes written.
+    def flush(self, store: Callable[[bytes, bytes], None],
+              known: Optional[set] = None) -> int:
+        """Hash everything, then persist every node the target store does
+        not yet have, as (hash → prefix-format blob). Returns the number of
+        nodes written.
 
-        The reference interleaves hashing and storing per dirty node
-        (SHAMap::flushDirty); here hashing is one batched pass and storage
-        a second sweep. A `_stored` node's whole subtree was flushed by an
-        earlier call (flush marks bottom-up), so shared subtrees across
-        ledger versions are skipped — the write cost per close is
-        proportional to the delta, not total state.
+        `known` is the per-store set of already-flushed hashes (e.g.
+        nodestore.Database.flushed); a hash in `known` seals its whole
+        subtree (flush adds bottom-up), so shared subtrees across ledger
+        versions are skipped and the write cost per close is proportional
+        to the delta, not total state. The set is per-store — flushing the
+        same tree into a second store writes everything again there
+        (the reference's flushDirty dirty-list behaves the same way).
         """
         self.get_hash()
+        if known is None:
+            known = set()
         count = 0
 
         def visit(node):
             nonlocal count
-            if node is None or node._stored:
+            if node is None or node._hash in known:
                 return
             if isinstance(node, Inner):
                 for c in node.children:
                     visit(c)
             store(node._hash, serialize_node_prefix(node))
-            node._stored = True
+            known.add(node._hash)
             count += 1
 
         if not (isinstance(self.root, Inner) and self.root.is_empty()):
@@ -553,10 +569,14 @@ class SHAMap:
         fetch: Callable[[bytes], Optional[bytes]],
         leaf_type: TNType = TNType.ACCOUNT_STATE,
         hash_batch: Callable = _default_hasher,
+        verify: bool = True,
     ) -> "SHAMap":
         """Materialize a full tree from a content-addressed store
         (reference: SHAMap fetchNodeExternal path). Raises KeyError on a
-        missing node (the seam where network acquisition hooks in)."""
+        missing node (the seam where network acquisition hooks in) and,
+        with `verify` (default), ValueError when a fetched blob does not
+        hash to its key (the reference verifies fetched nodes the same
+        way, SHAMapTreeNode ctor hashValid path)."""
         if root_hash == ZERO256:
             return cls(leaf_type, EMPTY_INNER, hash_batch)
 
@@ -565,6 +585,16 @@ class SHAMap:
             if blob is None:
                 raise KeyError(f"missing node {h.hex()}")
             node = deserialize_node_prefix(blob)
+            if verify:
+                # prefix-format blob == exactly the hashed bytes
+                from ..utils.hashes import sha512_half
+
+                actual = sha512_half(blob)
+                if actual != h:
+                    raise ValueError(
+                        f"node content hash mismatch: key {h.hex()[:16]} "
+                        f"content {actual.hex()[:16]}"
+                    )
             if isinstance(node, InnerStub):
                 children = tuple(
                     load(ch) if ch != ZERO256 else None for ch in node.child_hashes
@@ -572,7 +602,6 @@ class SHAMap:
                 node = Inner(children, hash=h)
             else:
                 node._hash = h
-            node._stored = True  # it came from the store
             return node
 
         root = load(root_hash)

@@ -1,0 +1,539 @@
+"""Transaction-engine tests: the transactor pipeline and every tx type.
+
+Workload shapes mirror the reference's JS integration tests
+(test/send-test.js payments, test/gateway-test.js trust+IOU,
+test/offer-test.js offers, test/account_merge-test.js, inflation-test.js)
+run against the engine directly (no node/RPC yet).
+"""
+
+import hashlib
+
+import pytest
+
+from stellard_tpu.engine import TransactionEngine, TxParams
+from stellard_tpu.engine import views
+from stellard_tpu.engine.flags import tfSell, tfSetNoRipple
+from stellard_tpu.engine.inflation import (
+    INFLATION_FREQUENCY,
+    INFLATION_START_TIME,
+)
+from stellard_tpu.protocol.formats import TxType
+from stellard_tpu.protocol.keys import KeyPair
+from stellard_tpu.protocol.sfields import (
+    sfAmount,
+    sfBalance,
+    sfDestination,
+    sfInflateSeq,
+    sfInflationDest,
+    sfLimitAmount,
+    sfOfferSequence,
+    sfOwnerCount,
+    sfRegularKey,
+    sfSequence,
+    sfSetFlag,
+    sfTakerGets,
+    sfTakerPays,
+)
+from stellard_tpu.protocol.stamount import STAmount, currency_from_iso
+from stellard_tpu.protocol.sttx import SerializedTransaction
+from stellard_tpu.protocol.ter import TER
+from stellard_tpu.state import indexes
+from stellard_tpu.state.ledger import Ledger
+
+USD = currency_from_iso("USD")
+FEE = 10
+START = 10_000 * 1_000_000  # 10k STR each
+
+ROOT_KEY = KeyPair.from_passphrase("masterpassphrase")
+ALICE = KeyPair.from_seed(b"\x11" * 32)
+BOB = KeyPair.from_seed(b"\x22" * 32)
+CAROL = KeyPair.from_seed(b"\x33" * 32)
+GATEWAY = KeyPair.from_seed(b"\x44" * 32)
+
+
+def build_tx(key: KeyPair, tx_type: TxType, seq: int, fee: int = FEE,
+             fields: dict | None = None) -> SerializedTransaction:
+    tx = SerializedTransaction.build(tx_type, key.account_id, seq, fee)
+    for f, v in (fields or {}).items():
+        tx.obj[f] = v
+    tx.sign(key)
+    return tx
+
+
+class Net:
+    """A closed-ledger test harness: genesis + funded accounts, applying
+    transactions directly in closing mode (the standalone-node shape)."""
+
+    def __init__(self, *keys: KeyPair, fund: int = START):
+        self.ledger = Ledger.genesis(ROOT_KEY.account_id)
+        self.ledger.parent_close_time = 700_000_000
+        self.engine = TransactionEngine(self.ledger)
+        self.seqs: dict[bytes, int] = {ROOT_KEY.account_id: 1}
+        for k in keys:
+            self.pay(ROOT_KEY, k.account_id, STAmount.from_drops(fund))
+
+    def seq(self, key: KeyPair) -> int:
+        return self.seqs.setdefault(key.account_id, 1)
+
+    def apply(self, key: KeyPair, tx_type: TxType, expect=TER.tesSUCCESS,
+              fee: int = FEE, fields: dict | None = None):
+        tx = build_tx(key, tx_type, self.seq(key), fee, fields)
+        ter, did = self.engine.apply_transaction(tx, TxParams.NONE)
+        assert ter == expect, f"{tx_type.name}: got {ter!r} want {expect!r}"
+        if did:
+            self.seqs[key.account_id] = self.seq(key) + 1
+        return ter, did
+
+    def pay(self, key: KeyPair, dst: bytes, amount: STAmount, expect=TER.tesSUCCESS):
+        return self.apply(key, TxType.ttPAYMENT, expect,
+                          fields={sfDestination: dst, sfAmount: amount})
+
+    def balance(self, key: KeyPair) -> int:
+        acct = self.ledger.account_root(key.account_id)
+        return acct[sfBalance].mantissa if acct else 0
+
+    def iou_balance(self, holder: KeyPair, issuer: KeyPair,
+                    currency: bytes = USD) -> STAmount:
+        from stellard_tpu.state.entryset import LedgerEntrySet
+
+        les = LedgerEntrySet(self.ledger)
+        return views.ripple_balance(
+            les, holder.account_id, issuer.account_id, currency
+        )
+
+    def trust(self, key: KeyPair, issuer: KeyPair, limit: int,
+              currency: bytes = USD, flags: int = 0, expect=TER.tesSUCCESS):
+        from stellard_tpu.protocol.sfields import sfFlags
+
+        fields = {
+            sfLimitAmount: STAmount.from_iou(
+                currency, issuer.account_id, limit, 0
+            )
+        }
+        if flags:
+            fields[sfFlags] = flags
+        return self.apply(key, TxType.ttTRUST_SET, expect, fields=fields)
+
+
+# --------------------------------------------------------------------------
+# payments
+
+
+class TestPayments:
+    def test_create_account_via_payment(self):
+        net = Net()
+        assert net.ledger.account_root(ALICE.account_id) is None
+        net.pay(ROOT_KEY, ALICE.account_id, STAmount.from_drops(START))
+        acct = net.ledger.account_root(ALICE.account_id)
+        assert acct is not None
+        assert acct[sfBalance].mantissa == START
+        assert acct[sfSequence] == 1
+
+    def test_payment_below_reserve_fails(self):
+        net = Net()
+        net.pay(ROOT_KEY, ALICE.account_id, STAmount.from_drops(100),
+                expect=TER.tecNO_DST_INSUF_STR)
+
+    def test_direct_payment_moves_funds_and_burns_fee(self):
+        net = Net(ALICE, BOB)
+        coins_before = net.ledger.tot_coins
+        a0, b0 = net.balance(ALICE), net.balance(BOB)
+        net.pay(ALICE, BOB.account_id, STAmount.from_drops(1_000_000))
+        assert net.balance(ALICE) == a0 - 1_000_000 - FEE
+        assert net.balance(BOB) == b0 + 1_000_000
+        assert net.ledger.tot_coins == coins_before - FEE
+        assert net.ledger.fee_pool >= FEE
+
+    def test_tx_recorded_with_metadata(self):
+        net = Net(ALICE, BOB)
+        tx = build_tx(ALICE, TxType.ttPAYMENT, net.seq(ALICE),
+                      fields={sfDestination: BOB.account_id,
+                         sfAmount: STAmount.from_drops(500)})
+        ter, did = net.engine.apply_transaction(tx, TxParams.NONE)
+        assert did
+        stored = net.ledger.get_transaction(tx.txid())
+        assert stored is not None
+        blob, meta = stored
+        assert blob == tx.serialize()
+        assert len(meta) > 10
+
+    def test_bad_signature_rejected(self):
+        net = Net(ALICE, BOB)
+        tx = build_tx(ALICE, TxType.ttPAYMENT, net.seq(ALICE),
+                      fields={sfDestination: BOB.account_id,
+                         sfAmount: STAmount.from_drops(500)})
+        from stellard_tpu.protocol.sfields import sfTxnSignature
+
+        sig = bytearray(tx.obj[sfTxnSignature])
+        sig[5] ^= 0xFF
+        tx.obj[sfTxnSignature] = bytes(sig)
+        ter, did = net.engine.apply_transaction(tx, TxParams.NONE)
+        assert ter == TER.temINVALID and not did
+
+    def test_wrong_sequence(self):
+        net = Net(ALICE, BOB)
+        tx = build_tx(ALICE, TxType.ttPAYMENT, 99,
+                      fields={sfDestination: BOB.account_id,
+                         sfAmount: STAmount.from_drops(500)})
+        ter, _ = net.engine.apply_transaction(tx, TxParams.NONE)
+        assert ter == TER.terPRE_SEQ
+        tx2 = build_tx(ALICE, TxType.ttPAYMENT, 0,
+                       fields={sfDestination: BOB.account_id,
+                          sfAmount: STAmount.from_drops(500)})
+        ter, _ = net.engine.apply_transaction(tx2, TxParams.NONE)
+        assert ter == TER.tefPAST_SEQ
+
+    def test_unfunded_payment_claims_fee(self):
+        net = Net(ALICE, BOB)
+        a0 = net.balance(ALICE)
+        net.pay(ALICE, BOB.account_id,
+                STAmount.from_drops(START * 2),
+                expect=TER.tecUNFUNDED_PAYMENT)
+        # fee still burned (tec semantics)
+        assert net.balance(ALICE) == a0 - FEE
+
+    def test_self_payment_rejected(self):
+        net = Net(ALICE)
+        net.pay(ALICE, ALICE.account_id, STAmount.from_drops(100),
+                expect=TER.temREDUNDANT)
+
+    def test_open_ledger_mode_records_but_does_not_apply(self):
+        net = Net(ALICE, BOB)
+        b0 = net.balance(BOB)
+        tx = build_tx(ALICE, TxType.ttPAYMENT, net.seq(ALICE),
+                      fields={sfDestination: BOB.account_id,
+                         sfAmount: STAmount.from_drops(777)})
+        ter, did = net.engine.apply_transaction(tx, TxParams.OPEN_LEDGER)
+        assert ter == TER.tesSUCCESS and did
+        assert net.balance(BOB) == b0  # no state change yet
+        assert net.ledger.tx_map.get(tx.txid()) is not None
+        # same tx again: tefALREADY
+        ter, did = net.engine.apply_transaction(tx, TxParams.OPEN_LEDGER)
+        assert ter == TER.tefALREADY and not did
+        # next tx with the following seq passes open-ledger seq prediction
+        tx2 = build_tx(ALICE, TxType.ttPAYMENT, net.seq(ALICE) + 1,
+                       fields={sfDestination: BOB.account_id,
+                          sfAmount: STAmount.from_drops(1)})
+        ter, did = net.engine.apply_transaction(tx2, TxParams.OPEN_LEDGER)
+        assert ter == TER.tesSUCCESS and did
+
+
+# --------------------------------------------------------------------------
+# trust lines + IOU payments (gateway-test.js shape)
+
+
+class TestTrustAndIOU:
+    def make_gateway_net(self):
+        net = Net(ALICE, BOB, GATEWAY)
+        net.trust(ALICE, GATEWAY, 1000)
+        net.trust(BOB, GATEWAY, 1000)
+        return net
+
+    def test_trust_line_created(self):
+        net = self.make_gateway_net()
+        line = net.ledger.read_entry(indexes.ripple_state_index(
+            ALICE.account_id, GATEWAY.account_id, USD
+        ))
+        assert line is not None
+        acct = net.ledger.account_root(ALICE.account_id)
+        assert acct[sfOwnerCount] == 1
+
+    def test_issue_and_pay_iou(self):
+        net = self.make_gateway_net()
+        # gateway issues 100 USD to alice
+        net.pay(GATEWAY, ALICE.account_id,
+                STAmount.from_iou(USD, GATEWAY.account_id, 100, 0))
+        bal = net.iou_balance(ALICE, GATEWAY)
+        assert bal == STAmount.from_iou(USD, GATEWAY.account_id, 100, 0)
+        # alice pays bob 30 USD (through the gateway)
+        net.pay(ALICE, BOB.account_id,
+                STAmount.from_iou(USD, GATEWAY.account_id, 30, 0))
+        assert not net.iou_balance(BOB, GATEWAY).is_zero()
+
+    def test_issue_beyond_limit_fails(self):
+        net = self.make_gateway_net()
+        net.pay(GATEWAY, ALICE.account_id,
+                STAmount.from_iou(USD, GATEWAY.account_id, 5000, 0),
+                expect=TER.tecPATH_DRY)
+
+    def test_redeem_iou(self):
+        net = self.make_gateway_net()
+        net.pay(GATEWAY, ALICE.account_id,
+                STAmount.from_iou(USD, GATEWAY.account_id, 100, 0))
+        net.pay(ALICE, GATEWAY.account_id,
+                STAmount.from_iou(USD, GATEWAY.account_id, 40, 0))
+        bal = net.iou_balance(ALICE, GATEWAY)
+        assert bal == STAmount.from_iou(USD, GATEWAY.account_id, 60, 0)
+
+    def test_redeem_more_than_held_fails(self):
+        net = self.make_gateway_net()
+        net.pay(GATEWAY, ALICE.account_id,
+                STAmount.from_iou(USD, GATEWAY.account_id, 10, 0))
+        net.pay(ALICE, GATEWAY.account_id,
+                STAmount.from_iou(USD, GATEWAY.account_id, 40, 0),
+                expect=TER.tecPATH_PARTIAL)
+
+    def test_trust_line_delete_on_default(self):
+        net = Net(ALICE, GATEWAY)
+        net.trust(ALICE, GATEWAY, 1000)
+        net.trust(ALICE, GATEWAY, 0)  # reset to default -> deleted
+        line = net.ledger.read_entry(indexes.ripple_state_index(
+            ALICE.account_id, GATEWAY.account_id, USD
+        ))
+        assert line is None
+        assert net.ledger.account_root(ALICE.account_id)[sfOwnerCount] == 0
+
+    def test_no_line_redundant(self):
+        net = Net(ALICE, GATEWAY)
+        net.trust(ALICE, GATEWAY, 0, expect=TER.tecNO_LINE_REDUNDANT)
+
+    def test_third_party_transfer_through_issuer(self):
+        net = self.make_gateway_net()
+        net.pay(GATEWAY, ALICE.account_id,
+                STAmount.from_iou(USD, GATEWAY.account_id, 100, 0))
+        net.pay(ALICE, BOB.account_id,
+                STAmount.from_iou(USD, GATEWAY.account_id, 25, 0))
+        assert net.iou_balance(ALICE, GATEWAY) == STAmount.from_iou(
+            USD, GATEWAY.account_id, 75, 0
+        )
+        assert net.iou_balance(BOB, GATEWAY) == STAmount.from_iou(
+            USD, GATEWAY.account_id, 25, 0
+        )
+
+
+# --------------------------------------------------------------------------
+# offers (offer-test.js shape)
+
+
+class TestOffers:
+    def net_with_book(self):
+        net = Net(ALICE, BOB, GATEWAY)
+        net.trust(ALICE, GATEWAY, 10_000)
+        net.trust(BOB, GATEWAY, 10_000)
+        net.pay(GATEWAY, ALICE.account_id,
+                STAmount.from_iou(USD, GATEWAY.account_id, 1000, 0))
+        return net
+
+    def test_offer_placed(self):
+        net = self.net_with_book()
+        # alice sells 100 USD for 50 STR
+        ter, _ = net.apply(
+            ALICE, TxType.ttOFFER_CREATE,
+            fields={sfTakerPays: STAmount.from_drops(50_000_000),
+               sfTakerGets: STAmount.from_iou(USD, GATEWAY.account_id, 100, 0)})
+        offer_idx = indexes.offer_index(ALICE.account_id, net.seq(ALICE) - 1)
+        offer = net.ledger.read_entry(offer_idx)
+        assert offer is not None
+        assert offer[sfTakerGets] == STAmount.from_iou(
+            USD, GATEWAY.account_id, 100, 0
+        )
+        # owner count rose (reserve)
+        assert net.ledger.account_root(ALICE.account_id)[sfOwnerCount] == 2
+
+    def test_offer_crossing_full(self):
+        net = self.net_with_book()
+        # alice sells 100 USD for 50 STR
+        net.apply(ALICE, TxType.ttOFFER_CREATE,
+                  fields={sfTakerPays: STAmount.from_drops(50_000_000),
+                     sfTakerGets: STAmount.from_iou(USD, GATEWAY.account_id, 100, 0)})
+        alice_seq = net.seq(ALICE) - 1
+        b_str0 = net.balance(BOB)
+        a_str0 = net.balance(ALICE)
+        # bob buys 100 USD paying 50 STR -> crosses fully
+        net.apply(BOB, TxType.ttOFFER_CREATE,
+                  fields={sfTakerPays: STAmount.from_iou(USD, GATEWAY.account_id, 100, 0),
+                     sfTakerGets: STAmount.from_drops(50_000_000)})
+        # alice's offer fully consumed
+        assert net.ledger.read_entry(
+            indexes.offer_index(ALICE.account_id, alice_seq)
+        ) is None
+        assert net.iou_balance(BOB, GATEWAY) == STAmount.from_iou(
+            USD, GATEWAY.account_id, 100, 0
+        )
+        assert net.balance(ALICE) == a_str0 + 50_000_000
+        assert net.balance(BOB) == b_str0 - 50_000_000 - FEE
+        # bob's crossing offer fully filled: no resting offer
+        assert net.ledger.read_entry(
+            indexes.offer_index(BOB.account_id, net.seq(BOB) - 1)
+        ) is None
+
+    def test_offer_crossing_partial(self):
+        net = self.net_with_book()
+        net.apply(ALICE, TxType.ttOFFER_CREATE,
+                  fields={sfTakerPays: STAmount.from_drops(50_000_000),
+                     sfTakerGets: STAmount.from_iou(USD, GATEWAY.account_id, 100, 0)})
+        alice_seq = net.seq(ALICE) - 1
+        # bob only wants 40 USD (pays up to 20 STR, same price)
+        net.apply(BOB, TxType.ttOFFER_CREATE,
+                  fields={sfTakerPays: STAmount.from_iou(USD, GATEWAY.account_id, 40, 0),
+                     sfTakerGets: STAmount.from_drops(20_000_000)})
+        rest = net.ledger.read_entry(
+            indexes.offer_index(ALICE.account_id, alice_seq)
+        )
+        assert rest is not None
+        assert rest[sfTakerGets] == STAmount.from_iou(
+            USD, GATEWAY.account_id, 60, 0
+        )
+        assert rest[sfTakerPays] == STAmount.from_drops(30_000_000)
+        assert net.iou_balance(BOB, GATEWAY) == STAmount.from_iou(
+            USD, GATEWAY.account_id, 40, 0
+        )
+
+    def test_offer_no_cross_below_price(self):
+        net = self.net_with_book()
+        net.apply(ALICE, TxType.ttOFFER_CREATE,
+                  fields={sfTakerPays: STAmount.from_drops(50_000_000),
+                     sfTakerGets: STAmount.from_iou(USD, GATEWAY.account_id, 100, 0)})
+        # bob bids too little: wants 100 USD for only 10 STR
+        net.apply(BOB, TxType.ttOFFER_CREATE,
+                  fields={sfTakerPays: STAmount.from_iou(USD, GATEWAY.account_id, 100, 0),
+                     sfTakerGets: STAmount.from_drops(10_000_000)})
+        # both offers rest; no trade
+        assert net.iou_balance(BOB, GATEWAY).is_zero()
+        assert net.ledger.read_entry(
+            indexes.offer_index(BOB.account_id, net.seq(BOB) - 1)
+        ) is not None
+
+    def test_offer_cancel(self):
+        net = self.net_with_book()
+        net.apply(ALICE, TxType.ttOFFER_CREATE,
+                  fields={sfTakerPays: STAmount.from_drops(50_000_000),
+                     sfTakerGets: STAmount.from_iou(USD, GATEWAY.account_id, 100, 0)})
+        alice_seq = net.seq(ALICE) - 1
+        net.apply(ALICE, TxType.ttOFFER_CANCEL,
+                  fields={sfOfferSequence: alice_seq})
+        assert net.ledger.read_entry(
+            indexes.offer_index(ALICE.account_id, alice_seq)
+        ) is None
+        assert net.ledger.account_root(ALICE.account_id)[sfOwnerCount] == 1
+
+    def test_unfunded_offer_rejected(self):
+        net = Net(ALICE, BOB)  # alice holds no USD
+        net.apply(ALICE, TxType.ttOFFER_CREATE,
+                  expect=TER.tecUNFUNDED_OFFER,
+                  fields={sfTakerPays: STAmount.from_drops(50_000_000),
+                     sfTakerGets: STAmount.from_iou(USD, GATEWAY.account_id, 100, 0)})
+
+    def test_str_for_str_rejected(self):
+        net = Net(ALICE)
+        net.apply(ALICE, TxType.ttOFFER_CREATE,
+                  expect=TER.temBAD_OFFER,
+                  fields={sfTakerPays: STAmount.from_drops(100),
+                     sfTakerGets: STAmount.from_drops(50)})
+
+
+# --------------------------------------------------------------------------
+# account ops
+
+
+class TestAccountOps:
+    def test_set_regular_key_and_sign_with_it(self):
+        net = Net(ALICE, BOB)
+        regular = KeyPair.from_seed(b"\x55" * 32)
+        net.apply(ALICE, TxType.ttREGULAR_KEY_SET,
+                  fields={sfRegularKey: regular.account_id})
+        acct = net.ledger.account_root(ALICE.account_id)
+        assert acct[sfRegularKey] == regular.account_id
+        # sign a payment with the regular key
+        tx = SerializedTransaction.build(
+            TxType.ttPAYMENT, ALICE.account_id, net.seq(ALICE), FEE,
+            fields={sfDestination: BOB.account_id,
+                    sfAmount: STAmount.from_drops(100)})
+        tx.sign(regular)
+        ter, did = net.engine.apply_transaction(tx, TxParams.NONE)
+        assert ter == TER.tesSUCCESS and did
+
+    def test_wrong_key_rejected(self):
+        net = Net(ALICE, BOB)
+        tx = SerializedTransaction.build(
+            TxType.ttPAYMENT, ALICE.account_id, net.seq(ALICE), FEE,
+            fields={sfDestination: BOB.account_id,
+                    sfAmount: STAmount.from_drops(100)})
+        tx.sign(BOB)  # bob's key, alice's account, no regular key set
+        ter, _ = net.engine.apply_transaction(tx, TxParams.NONE)
+        assert ter == TER.temBAD_AUTH_MASTER
+
+    def test_account_set_inflation_dest(self):
+        net = Net(ALICE, BOB)
+        net.apply(ALICE, TxType.ttACCOUNT_SET,
+                  fields={sfInflationDest: BOB.account_id})
+        acct = net.ledger.account_root(ALICE.account_id)
+        assert acct[sfInflationDest] == BOB.account_id
+
+    def test_account_merge(self):
+        net = Net(ALICE, BOB)
+        a_bal = net.balance(ALICE)
+        b_bal = net.balance(BOB)
+        net.apply(ALICE, TxType.ttACCOUNT_MERGE,
+                  fields={sfDestination: BOB.account_id})
+        assert net.ledger.account_root(ALICE.account_id) is None
+        assert net.balance(BOB) == b_bal + a_bal - FEE
+
+    def test_account_merge_with_iou(self):
+        net = Net(ALICE, BOB, GATEWAY)
+        net.trust(ALICE, GATEWAY, 1000)
+        net.trust(BOB, GATEWAY, 1000)
+        net.pay(GATEWAY, ALICE.account_id,
+                STAmount.from_iou(USD, GATEWAY.account_id, 100, 0))
+        net.apply(ALICE, TxType.ttACCOUNT_MERGE,
+                  fields={sfDestination: BOB.account_id})
+        assert net.ledger.account_root(ALICE.account_id) is None
+        assert net.iou_balance(BOB, GATEWAY) == STAmount.from_iou(
+            USD, GATEWAY.account_id, 100, 0
+        )
+        # alice's line is gone
+        assert net.ledger.read_entry(indexes.ripple_state_index(
+            ALICE.account_id, GATEWAY.account_id, USD
+        )) is None
+
+
+# --------------------------------------------------------------------------
+# inflation (inflation-test.js shape)
+
+
+class TestInflation:
+    def test_inflation_dole(self):
+        net = Net(ALICE, BOB, fund=10**15)  # big voters
+        net.apply(ALICE, TxType.ttACCOUNT_SET,
+                  fields={sfInflationDest: BOB.account_id})
+        net.apply(ROOT_KEY, TxType.ttACCOUNT_SET,
+                  fields={sfInflationDest: BOB.account_id})
+        # advance time so inflation is due
+        net.ledger.parent_close_time = (
+            INFLATION_START_TIME + 1 * INFLATION_FREQUENCY + 10
+        )
+        coins0 = net.ledger.tot_coins
+        fee_pool0 = net.ledger.fee_pool
+        b0 = net.balance(BOB)
+        net.apply(ALICE, TxType.ttINFLATION, fee=0,
+                  fields={sfInflateSeq: 1})
+        assert net.ledger.inflation_seq == 2
+        assert net.ledger.fee_pool == 0
+        gained = net.balance(BOB) - b0
+        expected_new = coins0 * 190_721_000 // 10**12
+        assert gained > 0
+        assert abs(gained - (expected_new + fee_pool0)) <= 2
+        # the fee pool returns to circulation; new coins on top
+        assert net.ledger.tot_coins == coins0 + gained
+
+    def test_inflation_too_early(self):
+        net = Net(ALICE, fund=10**15)
+        net.ledger.parent_close_time = 1000  # way before start
+        net.apply(ALICE, TxType.ttINFLATION, fee=0,
+                  expect=TER.telNOT_TIME, fields={sfInflateSeq: 1})
+
+    def test_inflation_wrong_seq(self):
+        net = Net(ALICE, fund=10**15)
+        net.ledger.parent_close_time = (
+            INFLATION_START_TIME + INFLATION_FREQUENCY * 5
+        )
+        net.apply(ALICE, TxType.ttINFLATION, fee=0,
+                  expect=TER.telNOT_TIME, fields={sfInflateSeq: 7})
+
+    def test_inflation_with_fee_rejected(self):
+        net = Net(ALICE, fund=10**15)
+        net.ledger.parent_close_time = (
+            INFLATION_START_TIME + INFLATION_FREQUENCY + 10
+        )
+        net.apply(ALICE, TxType.ttINFLATION, fee=10,
+                  expect=TER.temBAD_FEE, fields={sfInflateSeq: 1})

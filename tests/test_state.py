@@ -495,17 +495,18 @@ class TestReviewFindings:
 
     def test_flush_is_incremental(self):
         writes = []
+        known: set = set()
         m = SHAMap()
         for i in range(100):
             m.set_item(SHAMapItem(h(i), b"v"))
-        m.flush(lambda hh, d: writes.append(hh))
+        m.flush(lambda hh, d: writes.append(hh), known)
         first = len(writes)
         assert first > 100  # leaves + inners
         writes.clear()
-        m.flush(lambda hh, d: writes.append(hh))
+        m.flush(lambda hh, d: writes.append(hh), known)
         assert writes == []  # nothing dirty
         m.set_item(SHAMapItem(h(0), b"changed"))
-        m.flush(lambda hh, d: writes.append(hh))
+        m.flush(lambda hh, d: writes.append(hh), known)
         assert 0 < len(writes) <= 10  # just the changed path
 
     def test_writer_error_surfaces(self):
@@ -538,3 +539,58 @@ class TestReviewFindings:
         db.store(NodeObjectType.LEDGER, lh, bytes(bad))
         with pytest.raises(ValueError, match="hash mismatch"):
             Ledger.load(db, lh)
+
+    def test_flush_to_second_store_writes_everything(self):
+        """flush tracks stored-ness per store, not per node."""
+        m = SHAMap()
+        for i in range(50):
+            m.set_item(SHAMapItem(h(i), b"v"))
+        db_a = make_database("memory", async_writes=False)
+        db_b = make_database("memory", async_writes=False)
+        root = m.get_hash()
+        m.flush(db_a.store_fn(NodeObjectType.ACCOUNT_NODE), db_a.flushed)
+        n_b = m.flush(db_b.store_fn(NodeObjectType.ACCOUNT_NODE), db_b.flushed)
+        assert n_b > 50  # everything written to the second store too
+
+        def fetch_b(hh):
+            o = db_b.fetch(hh)
+            return o.data if o else None
+
+        assert SHAMap.from_store(root, fetch_b).get_hash() == root
+
+    def test_from_store_detects_corrupt_node(self):
+        db = make_database("memory", async_writes=False)
+        m = SHAMap()
+        for i in range(20):
+            m.set_item(SHAMapItem(h(i), b"v"))
+        root = m.get_hash()
+        m.flush(db.store_fn(NodeObjectType.ACCOUNT_NODE), db.flushed)
+        # corrupt one stored leaf blob
+        victim = next(o for o in db.backend.iterate()
+                      if o.data[:4] == b"MLN\x00")
+        bad = bytearray(victim.data)
+        bad[-1] ^= 0xFF
+        db.backend.store_batch([type(victim)(victim.type, victim.hash, bytes(bad))])
+        db._cache.clear()
+
+        def fetch(hh):
+            o = db.fetch(hh)
+            return o.data if o else None
+
+        with pytest.raises(ValueError, match="content hash mismatch"):
+            SHAMap.from_store(root, fetch)
+
+    def test_stobject_copy_detaches_containers(self):
+        sle = STObject()
+        sle[sfLedgerEntryType] = 100
+        sle[sfIndexes] = [h(1)]
+        cp = sle.copy()
+        cp[sfIndexes].append(h(2))
+        assert sle[sfIndexes] == [h(1)]  # original untouched
+
+    def test_open_tx_get_transaction(self):
+        led = Ledger.genesis(ROOT)
+        txid, added = led.add_open_transaction(b"\x12\x00\x34raw-tx")
+        assert added
+        blob, meta = led.get_transaction(txid)
+        assert blob == b"\x12\x00\x34raw-tx" and meta == b""
