@@ -1,0 +1,89 @@
+"""CLI entry point: `python -m stellard_tpu [options]`.
+
+Reference: src/ripple_app/main/Main.cpp:157-412 — server mode,
+`--standalone`/`-a`, `--conf`, `--start` (fresh genesis), plus an RPC
+client mode (`python -m stellard_tpu ping`, Main.cpp:400-405 RPCCall).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="stellard-tpu")
+    ap.add_argument("--conf", default="", help="config file (INI sections)")
+    ap.add_argument("-a", "--standalone", action="store_true",
+                    help="no network; manual ledger closes")
+    ap.add_argument("--start", action="store_true", help="fresh genesis")
+    ap.add_argument("--rpc_ip", default=None)
+    ap.add_argument("--rpc_port", type=int, default=None)
+    ap.add_argument("--websocket_port", type=int, default=None)
+    ap.add_argument("command", nargs="*", help="RPC client command")
+    args = ap.parse_args(argv)
+
+    from .node.config import Config
+
+    if args.conf:
+        with open(args.conf) as fh:
+            cfg = Config.from_ini(fh.read())
+    else:
+        cfg = Config()
+    if args.standalone:
+        cfg.standalone = True
+    if args.start:
+        cfg.start_up = "fresh"
+    if args.rpc_ip:
+        cfg.rpc_ip = args.rpc_ip
+    if args.rpc_port is not None:
+        cfg.rpc_port = args.rpc_port
+    if args.websocket_port is not None:
+        cfg.websocket_port = args.websocket_port
+
+    if args.command:
+        # RPC client mode (reference: RPCCall::fromCommandLine)
+        method, *rest = args.command
+        params: dict = {}
+        for arg in rest:
+            if "=" in arg:
+                k, v = arg.split("=", 1)
+                params[k] = v
+            else:
+                params.setdefault("args", []).append(arg)
+        url = f"http://{cfg.rpc_ip}:{cfg.rpc_port or 5005}/"
+        body = json.dumps({"method": method, "params": [params]}).encode()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"}
+        )
+        with urllib.request.urlopen(req) as resp:
+            print(json.dumps(json.load(resp), indent=2))
+        return 0
+
+    from .node.node import Node
+
+    if cfg.rpc_port is None:
+        cfg.rpc_port = 5005
+    if cfg.websocket_port is None:
+        cfg.websocket_port = 6006
+    node = Node(cfg).setup().serve()
+    print(
+        f"stellard-tpu: rpc http://{cfg.rpc_ip}:{node.http_server.port} "
+        f"ws ws://{cfg.websocket_ip}:{node.ws_server.port} "
+        f"(standalone={cfg.standalone}, "
+        f"signature_backend={cfg.signature_backend})",
+        file=sys.stderr,
+    )
+    try:
+        node.run()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        node.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,7 +21,7 @@ from ..protocol.sfields import (
     sfSetFlag,
     sfTransferRate,
 )
-from ..protocol.stamount import STAmount
+from ..protocol.stamount import ACCOUNT_ZERO, STAmount
 from ..protocol.ter import TER
 from ..state import indexes
 from .flags import (
@@ -43,7 +43,6 @@ from .flags import (
 from .transactor import Transactor, register_transactor
 from .views import QUALITY_ONE, offer_delete, trust_delete
 
-ACCOUNT_ZERO = b"\x00" * 20
 
 
 @register_transactor(TxType.ttACCOUNT_SET)

@@ -17,10 +17,9 @@ from ..protocol.sfields import (
     sfReserveIncrement,
 )
 from ..protocol.ter import TER
+from ..protocol.stamount import ACCOUNT_ZERO
 from ..state import indexes
 from .transactor import Transactor, register_transactor
-
-ACCOUNT_ZERO = b"\x00" * 20
 
 
 class _ChangeBase(Transactor):
@@ -63,16 +62,18 @@ class EnableAmendmentTransactor(_ChangeBase):
         (reference: Change.cpp applyAmendment)."""
         idx = indexes.amendment_index()
         sle = self.les.peek(idx)
+        created = False
         if sle is None:
             sle = self.les.create(LedgerEntryType.ltAMENDMENTS, idx)
             sle[sfAmendments] = []
+            created = True
         amendments = list(sle.get(sfAmendments, []))
         amendment = self.tx.obj[sfAmendment]
         if amendment in amendments:
             return TER.tefALREADY
         amendments.append(amendment)
         sle[sfAmendments] = amendments
-        if self.les._entries[idx].action.name != "CREATED":
+        if not created:
             self.les.modify(idx)
         return TER.tesSUCCESS
 
@@ -95,9 +96,12 @@ class SetFeeTransactor(_ChangeBase):
         sle[sfReserveIncrement] = tx[sfReserveIncrement]
         if not created:
             self.les.modify(idx)
-        ledger = self.engine.ledger
-        ledger.base_fee = tx[sfBaseFee]
-        ledger.reference_fee_units = tx[sfReferenceFeeUnits]
-        ledger.reserve_base = tx[sfReserveBase]
-        ledger.reserve_increment = tx[sfReserveIncrement]
+        # fee-schedule switch is deferred to the engine's header_changes
+        # application (post-invariants) like Inflation's header writes
+        self.header_changes = {
+            "base_fee": tx[sfBaseFee],
+            "reference_fee_units": tx[sfReferenceFeeUnits],
+            "reserve_base": tx[sfReserveBase],
+            "reserve_increment": tx[sfReserveIncrement],
+        }
         return TER.tesSUCCESS

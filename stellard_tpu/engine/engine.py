@@ -34,10 +34,6 @@ def _is_tec(ter: TER) -> bool:
     return 100 <= int(ter) < 300
 
 
-def _is_tem(ter: TER) -> bool:
-    return -299 <= int(ter) < -200
-
-
 class TransactionEngine:
     def __init__(self, ledger: Ledger):
         self.ledger = ledger
@@ -101,10 +97,23 @@ class TransactionEngine:
                     return TER.tefALREADY, False
                 # open ledger records the tx only; no state write
                 # (the transactor returned before do_apply)
+                self.ledger.note_open_tx(tx.account, tx.sequence)
             else:
                 meta = self.les.calc_meta(ter, self.tx_seq, self.ledger.seq, tx.txid())
                 self.tx_seq += 1
                 self.ledger.add_transaction(blob, meta.serialize())
+                # deferred header mutations (Inflation/SetFee), applied
+                # only now that the invariant gate has passed
+                hc = getattr(transactor, "header_changes", {})
+                if hc and ter == TER.tesSUCCESS:
+                    self.ledger.tot_coins += hc.get("tot_coins_delta", 0)
+                    self.ledger.inflation_seq += hc.get("inflation_seq_delta", 0)
+                    if "fee_pool" in hc:
+                        self.ledger.fee_pool = hc["fee_pool"]
+                    for k in ("base_fee", "reference_fee_units",
+                              "reserve_base", "reserve_increment"):
+                        if k in hc:
+                            setattr(self.ledger, k, hc[k])
                 # burn the fee (reference: destroyCoins)
                 self.ledger.tot_coins -= tx.fee.mantissa
                 self.ledger.fee_pool += tx.fee.mantissa

@@ -71,8 +71,7 @@ class InflationTransactor(Transactor):
             votes[dest] += bal.mantissa
 
         if not votes:
-            ledger.inflation_seq += 1
-            ledger.fee_pool = 0
+            self.header_changes = {"inflation_seq_delta": 1, "fee_pool": 0}
             return TER.tesSUCCESS
 
         ranked = sorted(votes.items(), key=lambda kv: kv[1], reverse=True)
@@ -106,8 +105,14 @@ class InflationTransactor(Transactor):
             self.les.modify(idx)
             minted += doled
 
-        ledger.tot_coins += minted
-        ledger.inflation_seq += 1
-        ledger.fee_pool = 0
+        # header mutations are deferred to the engine until after the
+        # invariant gate passes (header_changes convention) so a
+        # tefINTERNAL abort can't leave tot_coins/inflation_seq advanced
+        # with no matching balance credits
+        self.header_changes = {
+            "tot_coins_delta": minted,
+            "inflation_seq_delta": 1,
+            "fee_pool": 0,
+        }
         self.minted_coins = minted  # engine invariant hook
         return TER.tesSUCCESS

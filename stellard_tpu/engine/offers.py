@@ -42,7 +42,7 @@ from ..protocol.sfields import (
     sfTakerPaysCurrency,
     sfTakerPaysIssuer,
 )
-from ..protocol.stamount import STAmount
+from ..protocol.stamount import ACCOUNT_ZERO, STAmount
 from ..protocol.ter import TER
 from ..state import indexes
 from .flags import (
@@ -60,8 +60,6 @@ from .flags import (
 from .transactor import Transactor, register_transactor
 from . import views
 
-ACCOUNT_ZERO = b"\x00" * 20
-CURRENCY_NATIVE = b"\x00" * 20
 # a non-zero currency marker for rate arithmetic (reference CURRENCY_ONE)
 CURRENCY_ONE = (1).to_bytes(20, "big")
 
@@ -78,13 +76,6 @@ def get_rate(offer_out: STAmount, offer_in: STAmount) -> int:
     if r.is_zero():
         return 0
     return ((r.offset + 100) << 56) | r.mantissa
-
-
-def amount_from_rate(rate: int, currency: bytes, issuer: bytes) -> STAmount:
-    """Inverse of get_rate (reference: STAmount::setRate)."""
-    mantissa = rate & ~(255 << 56)
-    exponent = (rate >> 56) - 100
-    return STAmount.from_iou(currency, issuer, mantissa, exponent)
 
 
 @dataclass

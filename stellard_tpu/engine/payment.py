@@ -26,7 +26,7 @@ from ..protocol.sfields import (
     sfSendMax,
     sfSequence,
 )
-from ..protocol.stamount import STAmount
+from ..protocol.stamount import ACCOUNT_ZERO, STAmount
 from ..protocol.ter import TER
 from ..state import indexes
 from .flags import (
@@ -39,7 +39,6 @@ from .flags import (
 from .transactor import Transactor, register_transactor
 from . import views
 
-ACCOUNT_ZERO = b"\x00" * 20
 
 
 @register_transactor(TxType.ttPAYMENT)

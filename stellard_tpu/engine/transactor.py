@@ -69,6 +69,11 @@ class Transactor:
         self.source_balance = STAmount.from_drops(0)
         self.has_auth_key = False
         self.sig_master = False
+        # ledger-header mutations requested by do_apply; the engine applies
+        # them only after the invariant gate passes (keys: tot_coins_delta,
+        # inflation_seq_delta, fee_pool, base_fee, reference_fee_units,
+        # reserve_base, reserve_increment)
+        self.header_changes: dict = {}
         self._TxParams = TxParams
 
     # -- hooks ------------------------------------------------------------
@@ -105,19 +110,12 @@ class Transactor:
         a_seq = self.account[sfSequence]
 
         if self.params & self._TxParams.OPEN_LEDGER:
-            from ..protocol.serializer import BinaryParser
-            from ..state.shamap import TNType
-
-            max_tx = 0
-            for leaf in self.engine.ledger.tx_map.leaves():
-                blob = leaf.item.data
-                if leaf.type == TNType.TX_MD:  # VL(tx) || VL(meta)
-                    blob = BinaryParser(blob).read_vl()
-                held = SerializedTransaction.from_bytes(blob)
-                if held.account == self.account_id and held.sequence > max_tx:
-                    max_tx = held.sequence
-            if max_tx + 1 > a_seq:
-                a_seq = max_tx + 1
+            # predicted seq from the open ledger's per-account cache —
+            # O(1), maintained by add_open_transaction (the reference
+            # walks the open tx map per tx, which is quadratic)
+            cached = self.engine.ledger.open_tx_seqs.get(self.account_id)
+            if cached is not None and cached + 1 > a_seq:
+                a_seq = cached + 1
 
         if t_seq != a_seq:
             if a_seq < t_seq:

@@ -21,7 +21,7 @@ from ..protocol.sfields import (
     sfQualityIn,
     sfQualityOut,
 )
-from ..protocol.stamount import STAmount
+from ..protocol.stamount import ACCOUNT_ZERO, STAmount
 from ..protocol.ter import TER
 from ..state import indexes
 from .flags import (
@@ -41,7 +41,6 @@ from .flags import (
 from .transactor import Transactor, register_transactor
 from .views import ACCOUNT_ONE, QUALITY_ONE, trust_create, trust_delete
 
-ACCOUNT_ZERO = b"\x00" * 20
 
 
 @register_transactor(TxType.ttTRUST_SET)

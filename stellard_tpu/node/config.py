@@ -1,0 +1,162 @@
+"""Node configuration: INI-style sections, CLI-friendly overrides.
+
+Reference: src/ripple_core/functional/Config.cpp (816 LoC) parses
+``stellard.cfg`` sections listed in ConfigSections.h:39-98. This config
+keeps the same section names where they exist and adds the TPU-native
+knobs the north star requires (``[signature_backend]``, ``[hash_backend]``,
+batch-window tuning) following the same pattern as the reference's
+``[node_db] type=...`` pluggable-factory selection
+(doc/stellard-example.cfg:795-802).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+__all__ = ["Config", "parse_ini_sections"]
+
+
+def parse_ini_sections(text: str) -> dict[str, list[str]]:
+    """Parse the reference's cfg format: ``[section]`` headers followed by
+    value lines; ``#``/``;`` comments; later duplicate sections extend
+    earlier ones (reference: Config::load / ParseSection)."""
+    sections: dict[str, list[str]] = {}
+    current: Optional[str] = None
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#") or line.startswith(";"):
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            current = line[1:-1].strip().lower()
+            sections.setdefault(current, [])
+            continue
+        if current is not None:
+            sections[current].append(line)
+    return sections
+
+
+def _kv(lines: list[str]) -> dict[str, str]:
+    out = {}
+    for line in lines:
+        if "=" in line:
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+@dataclass
+class Config:
+    # -- run modes (reference Config.h RUN_STANDALONE / START_UP) ---------
+    standalone: bool = True
+    start_up: str = "fresh"  # fresh | load
+    ledger_history: int = 256  # reference [ledger_history]
+
+    # -- storage ([node_db], [database_path]) ------------------------------
+    node_db_type: str = "memory"
+    node_db_path: str = ""
+    database_path: str = ""
+
+    # -- crypto plane (TPU-native knobs; pattern of [node_db] type=) -------
+    signature_backend: str = "cpu"  # cpu | tpu
+    hash_backend: str = "cpu"  # cpu | tpu
+    verify_batch_window_ms: float = 2.0  # coalescing window
+    verify_max_batch: int = 16384
+    verify_min_device_batch: int = 64  # below this, CPU path is used
+
+    # -- network identity / trust ([validation_seed], [validators]) --------
+    validation_seed: str = ""  # base58 seed; empty = not a validator
+    validators: list[str] = field(default_factory=list)  # node public keys
+    validation_quorum: int = 1  # reference Config.h:406 default sizing
+    consensus_threshold: int = 0  # Stellar addition (Config.h:407)
+
+    # -- API doors ([rpc_*], [websocket_*]) --------------------------------
+    rpc_ip: str = "127.0.0.1"
+    rpc_port: Optional[int] = None  # None = disabled, 0 = ephemeral
+    # connections from these source IPs get ADMIN role (reference:
+    # [rpc_admin_allow]); everything else is GUEST
+    admin_ips: list[str] = field(default_factory=lambda: ["127.0.0.1", "::1"])
+    websocket_ip: str = "127.0.0.1"
+    websocket_port: Optional[int] = None  # None = disabled, 0 = ephemeral
+
+    # -- overlay ([peer_ip]/[peer_port]/[ips]) -----------------------------
+    peer_ip: str = "127.0.0.1"
+    peer_port: int = 0  # 0 = disabled
+    ips: list[str] = field(default_factory=list)  # bootstrap peers host:port
+
+    # -- ops ([node_size], fees) ------------------------------------------
+    node_size: str = "tiny"  # tiny|small|medium|large|huge (thread sizing)
+    fee_default: int = 10
+    network_time_offset: int = 0
+
+    @classmethod
+    def from_ini(cls, text: str) -> "Config":
+        s = parse_ini_sections(text)
+        cfg = cls()
+
+        def one(name: str, default: str = "") -> str:
+            vals = s.get(name, [])
+            return vals[0] if vals else default
+
+        if "standalone" in s:
+            cfg.standalone = one("standalone", "1") not in ("0", "false", "no")
+        cfg.start_up = one("start_up", cfg.start_up).lower()
+        if one("ledger_history"):
+            cfg.ledger_history = int(one("ledger_history"))
+
+        node_db = _kv(s.get("node_db", []))
+        cfg.node_db_type = node_db.get("type", cfg.node_db_type).lower()
+        cfg.node_db_path = node_db.get("path", cfg.node_db_path)
+        cfg.database_path = one("database_path", cfg.database_path)
+
+        sig = _kv(s.get("signature_backend", []))
+        cfg.signature_backend = sig.get("type", one("signature_backend",
+                                                    cfg.signature_backend)).lower()
+        if "window_ms" in sig:
+            cfg.verify_batch_window_ms = float(sig["window_ms"])
+        if "max_batch" in sig:
+            cfg.verify_max_batch = int(sig["max_batch"])
+        if "min_device_batch" in sig:
+            cfg.verify_min_device_batch = int(sig["min_device_batch"])
+        cfg.hash_backend = one("hash_backend", cfg.hash_backend).lower()
+
+        cfg.validation_seed = one("validation_seed", cfg.validation_seed)
+        cfg.validators = [
+            line.split()[0] for line in s.get("validators", [])
+        ]  # reference allows trailing comments per line
+        if one("validation_quorum"):
+            cfg.validation_quorum = int(one("validation_quorum"))
+        if one("consensus_threshold"):
+            cfg.consensus_threshold = int(one("consensus_threshold"))
+
+        if one("rpc_ip"):
+            cfg.rpc_ip = one("rpc_ip")
+        if s.get("rpc_admin_allow"):
+            cfg.admin_ips = list(s["rpc_admin_allow"])
+        if one("rpc_port"):
+            cfg.rpc_port = int(one("rpc_port"))
+        if one("websocket_ip"):
+            cfg.websocket_ip = one("websocket_ip")
+        if one("websocket_port"):
+            cfg.websocket_port = int(one("websocket_port"))
+        if one("peer_ip"):
+            cfg.peer_ip = one("peer_ip")
+        if one("peer_port"):
+            cfg.peer_port = int(one("peer_port"))
+        cfg.ips = list(s.get("ips", []))
+
+        cfg.node_size = one("node_size", cfg.node_size).lower()
+        if one("fee_default"):
+            cfg.fee_default = int(one("fee_default"))
+        return cfg
+
+    def thread_count(self) -> int:
+        """reference: JobQueue thread heuristic from [node_size]
+        (Config::getSize / Application.cpp). Standalone uses a small pool
+        (the reference uses 0=caller-runs; we keep one worker so async
+        submission still works)."""
+        if self.standalone:
+            return 1
+        return {"tiny": 2, "small": 4, "medium": 6, "large": 8, "huge": 12}.get(
+            self.node_size, 4
+        )

@@ -1,0 +1,228 @@
+"""LedgerMaster: the ledger-chain state machine.
+
+Reference: src/ripple_app/ledger/LedgerMaster.cpp (1469 LoC) — tracks the
+current open ledger, last closed ledger and last validated ledger
+(LedgerHolder triples), holds transactions that can't apply yet
+(terPRE_SEQ et al.) for retry on the next ledger, and accepts a ledger as
+validated once a quorum of trusted validations arrives (checkAccept,
+:705-750). Also CanonicalTXSet (misc/CanonicalTXSet.cpp): the salted
+canonical application order used when a closed ledger's tx set is
+applied.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from ..engine.engine import TransactionEngine, TxParams
+from ..protocol.sttx import SerializedTransaction
+from ..protocol.ter import TER
+from ..state.ledger import Ledger
+
+__all__ = ["LedgerMaster", "CanonicalTXSet", "LEDGER_TOTAL_PASSES"]
+
+# reference: applyTransactions retry sizing (LedgerConsensus.cpp:1935-2070)
+LEDGER_TOTAL_PASSES = 4
+
+
+class CanonicalTXSet:
+    """Salted canonical ordering (reference: misc/CanonicalTXSet.{h,cpp}):
+    sort key = (account XOR salt, sequence, txid); the salt is the parent
+    ledger hash so the order is unpredictable to submitters but identical
+    on every node."""
+
+    def __init__(self, salt: bytes):
+        self.salt = salt
+        self._map: dict[tuple, SerializedTransaction] = {}
+
+    def insert(self, tx: SerializedTransaction) -> None:
+        acct = int.from_bytes(tx.account, "big")
+        salt = int.from_bytes(self.salt[:20], "big")
+        self._map[(acct ^ salt, tx.sequence, tx.txid())] = tx
+
+    def erase(self, key: tuple) -> None:
+        self._map.pop(key, None)
+
+    def __len__(self):
+        return len(self._map)
+
+    def items_sorted(self) -> list[tuple[tuple, SerializedTransaction]]:
+        return sorted(self._map.items())
+
+
+class LedgerMaster:
+    """Holds the chain: validated ←closed ←current(open)."""
+
+    def __init__(self, hash_batch: Optional[Callable] = None):
+        self._lock = threading.RLock()
+        self.hash_batch = hash_batch
+        self.current: Optional[Ledger] = None  # open
+        self.closed: Optional[Ledger] = None  # last closed (LCL)
+        self.validated: Optional[Ledger] = None
+        self.ledger_history: dict[int, bytes] = {}  # seq -> hash
+        self.ledgers_by_hash: dict[bytes, Ledger] = {}  # closed-ledger cache
+        # txns held for a future ledger (reference: mHeldTransactions)
+        self.held: dict[tuple[bytes, int], SerializedTransaction] = {}
+        self.min_validations = 0  # quorum for checkAccept
+        self.on_validated: Optional[Callable[[Ledger], None]] = None
+
+    # -- bootstrap --------------------------------------------------------
+
+    def start_new_ledger(self, root_account_id: bytes, close_time: int = 0) -> None:
+        """Fresh genesis chain (reference: Application::startNewLedger —
+        builds the seq-1 genesis, closes it, opens seq 2 on top)."""
+        with self._lock:
+            genesis = Ledger.genesis(root_account_id, close_time=close_time,
+                                     hash_batch=self.hash_batch)
+            genesis.close(close_time, genesis.close_resolution)
+            genesis.accepted = True
+            self._push_closed(genesis)
+            self.validated = genesis
+            self.current = genesis.open_successor()
+
+    def load_ledger(self, ledger: Ledger) -> None:
+        """Resume from a stored closed ledger (reference: loadOldLedger)."""
+        with self._lock:
+            ledger.accepted = True
+            self._push_closed(ledger)
+            self.validated = ledger
+            self.current = ledger.open_successor()
+
+    def _push_closed(self, ledger: Ledger) -> None:
+        self.closed = ledger
+        h = ledger.hash()
+        self.ledger_history[ledger.seq] = h
+        self.ledgers_by_hash[h] = ledger
+
+    # -- accessors --------------------------------------------------------
+
+    def current_ledger(self) -> Ledger:
+        with self._lock:
+            assert self.current is not None, "LedgerMaster not started"
+            return self.current
+
+    def closed_ledger(self) -> Ledger:
+        with self._lock:
+            assert self.closed is not None, "LedgerMaster not started"
+            return self.closed
+
+    def get_ledger_by_seq(self, seq: int) -> Optional[Ledger]:
+        with self._lock:
+            h = self.ledger_history.get(seq)
+            return self.ledgers_by_hash.get(h) if h else None
+
+    def get_ledger_by_hash(self, h: bytes) -> Optional[Ledger]:
+        with self._lock:
+            return self.ledgers_by_hash.get(h)
+
+    # -- held transactions (reference: addHeldTransaction) ----------------
+
+    def add_held_transaction(self, tx: SerializedTransaction) -> None:
+        with self._lock:
+            self.held[(tx.account, tx.sequence)] = tx
+
+    def take_held_transactions(self) -> list[SerializedTransaction]:
+        with self._lock:
+            txs = list(self.held.values())
+            self.held.clear()
+            return txs
+
+    # -- apply to the open ledger (reference: doTransaction) --------------
+
+    def do_transaction(self, tx: SerializedTransaction, params: TxParams) -> tuple[TER, bool]:
+        with self._lock:
+            engine = TransactionEngine(self.current_ledger())
+            return engine.apply_transaction(tx, params)
+
+    # -- close (standalone / consensus-accept share this tail) ------------
+
+    def close_and_advance(
+        self,
+        close_time: int,
+        close_resolution: int,
+        correct_close_time: bool = True,
+        extra_txs: Optional[list[SerializedTransaction]] = None,
+    ) -> tuple[Ledger, dict[bytes, TER]]:
+        """Build the next closed ledger from the open ledger's tx set and
+        advance the chain. This is the shared tail of the reference's
+        LedgerConsensus::accept (:931-1127) and the standalone
+        `ledger_accept` path (NetworkOPs::acceptLedger):
+
+        1. collect the open ledger's txns (+ any consensus extras) into a
+           CanonicalTXSet salted by the parent hash,
+        2. re-apply them to a successor of the LCL with retry passes
+           (applyTransactions, LedgerConsensus.cpp:1935-2070),
+        3. seal it, open the next ledger, re-apply held txns.
+
+        Returns (new closed ledger, per-txid results).
+        """
+        with self._lock:
+            prev = self.closed_ledger()
+            open_ledger = self.current_ledger()
+
+            # 1. canonical set from the open ledger's recorded blobs
+            txset = CanonicalTXSet(prev.hash())
+            for _txid, blob, _meta in open_ledger.tx_entries():
+                txset.insert(SerializedTransaction.from_bytes(blob))
+            for tx in extra_txs or []:
+                txset.insert(tx)
+
+            # 2. successor of the LCL; apply with retry passes
+            new_lcl = prev.open_successor()
+            results = self._apply_transactions(new_lcl, txset)
+
+            # 3. seal + advance
+            new_lcl.close(close_time, close_resolution, correct_close_time)
+            new_lcl.accepted = True
+            self._push_closed(new_lcl)
+            self.current = new_lcl.open_successor()
+
+            # standalone trusts its own closes (reference: standalone mode
+            # skips validations; checkAccept quorum handles the net case)
+            if self.min_validations == 0:
+                self.validated = new_lcl
+                if self.on_validated:
+                    self.on_validated(new_lcl)
+
+            # re-apply held txns to the new open ledger
+            for tx in self.take_held_transactions():
+                engine = TransactionEngine(self.current)
+                ter, _ = engine.apply_transaction(
+                    tx, TxParams.OPEN_LEDGER | TxParams.RETRY
+                )
+                if ter == TER.terPRE_SEQ:
+                    self.add_held_transaction(tx)
+            return new_lcl, results
+
+    def _apply_transactions(self, ledger: Ledger, txset: CanonicalTXSet) -> dict[bytes, TER]:
+        """reference: LedgerConsensus::applyTransactions — passes over the
+        canonical set, retrying ter* failures (which may succeed once an
+        earlier tx lands), claiming fees on tec*."""
+        results: dict[bytes, TER] = {}
+        engine = TransactionEngine(ledger)
+        remaining = txset.items_sorted()
+        for pass_no in range(LEDGER_TOTAL_PASSES):
+            final_pass = pass_no == LEDGER_TOTAL_PASSES - 1
+            retry: list = []
+            changes = 0
+            for key, tx in remaining:
+                params = TxParams.NONE if final_pass else TxParams.RETRY
+                ter, did_apply = engine.apply_transaction(tx, params)
+                results[tx.txid()] = ter
+                if did_apply or ter == TER.tesSUCCESS:
+                    changes += 1
+                elif -99 <= int(ter) < 0 and not final_pass:  # ter* retry band
+                    retry.append((key, tx))
+                elif 100 <= int(ter) < 200 and not did_apply and not final_pass:
+                    retry.append((key, tx))  # tec w/o fee claim under RETRY
+            remaining = retry
+            if not remaining or changes == 0:
+                # no progress → another pass can't help (final pass already
+                # recorded non-retry results)
+                if remaining and not final_pass:
+                    for key, tx in remaining:
+                        ter, _ = engine.apply_transaction(tx, TxParams.NONE)
+                        results[tx.txid()] = ter
+                break
+        return results

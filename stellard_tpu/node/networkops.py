@@ -1,0 +1,226 @@
+"""NetworkOPs: the application brain.
+
+Reference: src/ripple_app/misc/NetworkOPs.cpp (2923 LoC) — operating-mode
+state machine (NetworkOPs.h:76-84), transaction submission/processing
+(:274-558), standalone ledger close (acceptLedger), and the pub/sub
+fan-out (pubLedger / pubProposedTransaction / pubAcceptedTransaction).
+
+TPU shape: signature checks route through the VerifyPlane (coalesced
+device batches) with HashRouter SF_SIGGOOD/SF_BAD memoization, so the
+apply path under the master lock never re-verifies.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from enum import IntEnum
+from typing import Callable, Optional
+
+from ..crypto.backend import VerifyRequest
+from ..engine.engine import TxParams
+from ..protocol.sttx import SerializedTransaction
+from ..protocol.ter import TER
+from ..state.ledger import Ledger
+from .hashrouter import SF_BAD, SF_RELAYED, SF_SIGGOOD, HashRouter
+from .jobqueue import JobQueue, JobType
+from .ledgermaster import LedgerMaster
+from .verifyplane import VerifyPlane
+
+__all__ = ["NetworkOPs", "OperatingMode", "TxStatus"]
+
+# seconds between 1970-01-01 and 2000-01-01 (reference: iToSeconds /
+# NetClock epoch) — ledger close times are seconds since 2000.
+EPOCH_OFFSET = 946_684_800
+
+
+class OperatingMode(IntEnum):
+    """reference: NetworkOPs.h:76-84"""
+
+    DISCONNECTED = 0
+    CONNECTED = 1
+    SYNCING = 2
+    TRACKING = 3
+    FULL = 4
+
+
+class TxStatus(IntEnum):
+    """reference: Transaction.h TransStatus"""
+
+    NEW = 0
+    INVALID = 1
+    INCLUDED = 2
+    CONFLICTED = 3
+    COMMITTED = 4
+    HELD = 5
+    REMOVED = 6
+    OBSOLETE = 7
+    INCOMPLETE = 8
+
+
+class NetworkOPs:
+    def __init__(
+        self,
+        ledger_master: LedgerMaster,
+        job_queue: JobQueue,
+        verify_plane: VerifyPlane,
+        hash_router: HashRouter,
+        standalone: bool = True,
+    ):
+        self.lm = ledger_master
+        self.jq = job_queue
+        self.vp = verify_plane
+        self.router = hash_router
+        self.standalone = standalone
+        self.mode = OperatingMode.FULL if standalone else OperatingMode.DISCONNECTED
+        self.master_lock = threading.RLock()  # reference: getApp().getMasterLock()
+        self.net_time_offset = 0
+        # pub/sub sinks (wired by InfoSub manager; reference NetworkOPsImp
+        # mSubLedger / mSubTransactions / ...)
+        self.on_ledger_closed: list[Callable[[Ledger, dict], None]] = []
+        self.on_proposed_tx: list[Callable[[SerializedTransaction, TER], None]] = []
+        # bounded status map (insertion-ordered; oldest evicted) — the
+        # HashRouter equivalent of this sweeps on a hold timer
+        self.on_tx_result: dict[bytes, TxStatus] = {}
+        self.max_tx_results = 100_000
+        self.stats = {"processed": 0, "bad_sig": 0, "held": 0}
+
+    # -- time (reference: getNetworkTimeNC via SNTP offset) ---------------
+
+    def network_time(self) -> int:
+        return int(time.time()) - EPOCH_OFFSET + self.net_time_offset
+
+    # -- transaction intake ----------------------------------------------
+
+    def submit_transaction(
+        self, tx: SerializedTransaction, cb: Optional[Callable] = None
+    ) -> None:
+        """Async submission: verify (coalesced) off the master lock, then
+        process on a jtTRANSACTION job (reference:
+        NetworkOPs::submitTransaction :274-321)."""
+        txid = tx.txid()
+        flags = self.router.get_flags(txid)
+        if flags & SF_BAD:
+            if cb:
+                cb(tx, TER.temINVALID, False)
+            return
+        if flags & SF_SIGGOOD:
+            tx.set_sig_verdict(True)
+            self.jq.add_job(
+                JobType.jtTRANSACTION, "processTx",
+                lambda: self._process_cb(tx, cb),
+            )
+            return
+        fut = self.vp.submit(
+            VerifyRequest(tx.signing_pub_key, tx.signing_hash(), tx.signature)
+        )
+
+        def when_done(f):
+            good = bool(f.result()) if not f.exception() else False
+            tx.set_sig_verdict(good)
+            self.router.set_flag(txid, SF_SIGGOOD if good else SF_BAD)
+            if not good:
+                self.stats["bad_sig"] += 1
+                if cb:
+                    cb(tx, TER.temINVALID, False)
+                return
+            self.jq.add_job(
+                JobType.jtTRANSACTION, "processTx",
+                lambda: self._process_cb(tx, cb),
+            )
+
+        fut.add_done_callback(when_done)
+
+    def _process_cb(self, tx, cb):
+        ter, applied = self.process_transaction(tx)
+        if cb:
+            cb(tx, ter, applied)
+
+    def process_transaction(
+        self, tx: SerializedTransaction, admin: bool = False
+    ) -> tuple[TER, bool]:
+        """Synchronous path (reference: NetworkOPs::processTransaction
+        :444-558): router flags → checkSign (memoized / pre-batched) →
+        apply to open ledger under the master lock → status bookkeeping
+        → relay."""
+        txid = tx.txid()
+        flags = self.router.get_flags(txid)
+        if flags & SF_BAD:
+            self._record_status(txid, TxStatus.INVALID)
+            return TER.temINVALID, False
+        if flags & SF_SIGGOOD:
+            tx.set_sig_verdict(True)
+        elif not tx.check_sign():
+            self.router.set_flag(txid, SF_BAD)
+            self.stats["bad_sig"] += 1
+            self._record_status(txid, TxStatus.INVALID)
+            return TER.temINVALID, False
+        else:
+            self.router.set_flag(txid, SF_SIGGOOD)
+
+        params = TxParams.OPEN_LEDGER
+        if admin:
+            params |= TxParams.ADMIN
+        with self.master_lock:
+            ter, did_apply = self.lm.do_transaction(tx, params)
+        self.stats["processed"] += 1
+
+        # status bookkeeping (reference :500-533). Only tem (malformed) is
+        # permanently bad — tel (transient local, e.g. telINSUF_FEE_P under
+        # load) and tef must stay resubmittable.
+        if ter == TER.tesSUCCESS or did_apply:
+            status = TxStatus.INCLUDED
+        elif ter.is_tem:
+            status = TxStatus.INVALID
+            self.router.set_flag(txid, SF_BAD)
+        elif ter == TER.terPRE_SEQ:
+            # future sequence: hold for the next ledger (reference :516-524)
+            self.lm.add_held_transaction(tx)
+            status = TxStatus.HELD
+            self.stats["held"] += 1
+        else:
+            status = TxStatus.INVALID if int(ter) < 0 else TxStatus.INCLUDED
+        self._record_status(txid, status)
+
+        for sink in self.on_proposed_tx:
+            sink(tx, ter)
+
+        # relay seam (overlay broadcast; no-op in standalone)
+        self.router.swap_set(txid, set(), SF_RELAYED)
+        return ter, did_apply
+
+    # -- standalone close (reference: NetworkOPs::acceptLedger) ------------
+
+    def accept_ledger(self) -> tuple[Ledger, dict[bytes, TER]]:
+        """Close the open ledger immediately (standalone `ledger_accept`
+        admin RPC; the JS integration tests drive closes this way,
+        SURVEY §4.3)."""
+        with self.master_lock:
+            closed, results = self.lm.close_and_advance(
+                close_time=self.network_time(),
+                close_resolution=self.lm.closed_ledger().close_resolution,
+            )
+        for txid, ter in results.items():
+            if self.on_tx_result.get(txid) == TxStatus.INCLUDED:
+                self._record_status(txid, TxStatus.COMMITTED)
+        for sink in self.on_ledger_closed:
+            sink(closed, results)
+        return closed, results
+
+    def _record_status(self, txid: bytes, status: TxStatus) -> None:
+        m = self.on_tx_result
+        m.pop(txid, None)
+        m[txid] = status
+        while len(m) > self.max_tx_results:
+            m.pop(next(iter(m)))
+
+    # -- introspection ----------------------------------------------------
+
+    def server_state(self) -> str:
+        return {
+            OperatingMode.DISCONNECTED: "disconnected",
+            OperatingMode.CONNECTED: "connected",
+            OperatingMode.SYNCING: "syncing",
+            OperatingMode.TRACKING: "tracking",
+            OperatingMode.FULL: "full",
+        }[self.mode]

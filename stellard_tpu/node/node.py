@@ -1,0 +1,177 @@
+"""Node: the application container.
+
+Reference: src/ripple_app/main/Application.cpp — ApplicationImp owns ~35
+subsystems wired in constructor order (:257-365) with setup() (:659-917)
+and run(); here the container is small because the TPU build splits into
+a host protocol machine + a device crypto plane, but the wiring order
+(storage → crypto plane → executor → ledger chain → brain → API doors)
+mirrors the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..crypto.backend import make_hasher
+from ..nodestore.core import make_database
+from ..protocol.keys import KeyPair, decode_seed
+from ..protocol.sttx import SerializedTransaction
+from ..protocol.ter import TER
+from ..state.ledger import Ledger
+from .config import Config
+from .hashrouter import HashRouter
+from .jobqueue import JobQueue
+from .ledgermaster import LedgerMaster
+from .networkops import NetworkOPs, TxStatus
+from .txdb import TxDatabase
+from .verifyplane import VerifyPlane
+
+__all__ = ["Node"]
+
+# reference: the well-known test genesis passphrase ("masterpassphrase")
+MASTER_PASSPHRASE = "masterpassphrase"
+
+
+class Node:
+    """One stellard-tpu node. Construct → setup() → (serve / drive)."""
+
+    def __init__(self, config: Optional[Config] = None):
+        self.config = config or Config()
+        cfg = self.config
+
+        # storage plane (reference: NodeStore Manager + main db :330)
+        self.nodestore = make_database(
+            type=cfg.node_db_type,
+            **({"path": cfg.node_db_path} if cfg.node_db_path else {}),
+        )
+        self.txdb = TxDatabase(cfg.database_path or ":memory:")
+
+        # crypto plane (north star: pluggable cpu|tpu batch backends)
+        self.hasher = make_hasher(cfg.hash_backend)
+        self.verify_plane = VerifyPlane(
+            backend=cfg.signature_backend,
+            window_ms=cfg.verify_batch_window_ms,
+            max_batch=cfg.verify_max_batch,
+            min_device_batch=cfg.verify_min_device_batch,
+        )
+
+        # executor (reference: JobQueue :287)
+        self.job_queue = JobQueue(threads=cfg.thread_count())
+        self.hash_router = HashRouter()
+
+        # ledger chain + brain
+        self.ledger_master = LedgerMaster(
+            hash_batch=self.hasher.prefix_hash_batch
+        )
+        self.ops = NetworkOPs(
+            self.ledger_master,
+            self.job_queue,
+            self.verify_plane,
+            self.hash_router,
+            standalone=cfg.standalone,
+        )
+        self.ops.on_ledger_closed.append(self._persist_closed_ledger)
+
+        # node identity (reference: LocalCredentials; validators sign with
+        # [validation_seed])
+        self.validation_keys: Optional[KeyPair] = None
+        if cfg.validation_seed:
+            self.validation_keys = KeyPair.from_seed(decode_seed(cfg.validation_seed))
+
+        self.master_keys = KeyPair.from_passphrase(MASTER_PASSPHRASE)
+        self._running = threading.Event()
+
+        # API doors (started by serve(); reference: WSDoors/RPCDoor
+        # Application.cpp:817-891)
+        self.http_server = None
+        self.ws_server = None
+        self.subs = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def setup(self) -> "Node":
+        """reference: ApplicationImp::setup — START_UP switch
+        (Application.cpp:733-762)."""
+        if self.config.start_up == "fresh":
+            self.ledger_master.start_new_ledger(self.master_keys.account_id)
+        elif self.config.start_up == "load":
+            raise NotImplementedError("load: wire Ledger.load from nodestore")
+        return self
+
+    def serve(self) -> "Node":
+        """Open the configured API doors (reference: ApplicationImp::setup
+        WSDoors :817-868, RPCDoor :877-891)."""
+        from ..rpc.infosub import SubscriptionManager
+
+        self.subs = SubscriptionManager(self.ops)
+        if self.config.rpc_port is not None:
+            from ..rpc.http_server import HttpRpcServer
+
+            self.http_server = HttpRpcServer(
+                self, self.config.rpc_ip, self.config.rpc_port
+            ).start()
+        if self.config.websocket_port is not None:
+            from ..rpc.ws_server import WsRpcServer
+
+            self.ws_server = WsRpcServer(
+                self, self.config.websocket_ip, self.config.websocket_port,
+                subs=self.subs,
+            ).start()
+        self._running.set()
+        return self
+
+    def run(self) -> None:
+        """Block until stopped (reference: ApplicationImp::run)."""
+        import time as _time
+
+        while self._running.is_set():
+            _time.sleep(0.2)
+
+    def stop(self) -> None:
+        self._running.clear()
+        if self.http_server:
+            self.http_server.stop()
+        if self.ws_server:
+            self.ws_server.stop()
+        self.job_queue.stop()
+        self.verify_plane.stop()
+        self.nodestore.close()
+        self.txdb.close()
+
+    # -- persistence on close (reference: pendSaveValidated + CLF commit) --
+
+    def _persist_closed_ledger(self, ledger: Ledger, results: dict) -> None:
+        ledger.save(self.nodestore)
+        self.txdb.save_ledger_header(ledger)
+        from ..protocol.meta import affected_accounts
+
+        with self.txdb.batch():
+            for txn_seq, (txid, blob, meta) in enumerate(ledger.tx_entries()):
+                tx = SerializedTransaction.from_bytes(blob)
+                affected = affected_accounts(meta) if meta else [tx.account]
+                self.txdb.save_transaction(
+                    txid,
+                    tx.tx_type.name,
+                    tx.account,
+                    tx.sequence,
+                    ledger.seq,
+                    TER(results.get(txid, TER.tesSUCCESS)).token,
+                    blob,
+                    meta,
+                    affected,
+                    txn_seq,
+                )
+
+    # -- convenience driving (tests / CLI) --------------------------------
+
+    def submit(self, tx: SerializedTransaction) -> tuple[TER, bool]:
+        return self.ops.process_transaction(tx)
+
+    def close_ledger(self):
+        return self.ops.accept_ledger()
+
+    def tx_status(self, txid: bytes) -> Optional[TxStatus]:
+        return self.ops.on_tx_result.get(txid)
+
+

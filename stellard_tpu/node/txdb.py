@@ -1,0 +1,247 @@
+"""Relational history store: transactions + account index + ledger headers.
+
+Reference: src/ripple_app/data (DatabaseCon over SQLite, schemas in
+DBInit.cpp) — transaction.db holds Transactions and AccountTransactions
+(the `account_tx` / `tx` RPC backing), ledger.db holds Ledgers headers.
+SQLite here too (stdlib), WAL mode, single writer thread via the
+JobQueue's jtWAL seam when file-backed.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Optional
+
+__all__ = ["TxDatabase"]
+
+
+class TxDatabase:
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        self._in_batch = False
+        cur = self._conn.cursor()
+        cur.execute("PRAGMA journal_mode=WAL")
+        # reference: DBInit.cpp TxnDBInit / LedgerDBInit
+        cur.execute(
+            """CREATE TABLE IF NOT EXISTS Transactions (
+                 TransID TEXT PRIMARY KEY, TransType TEXT, FromAcct TEXT,
+                 FromSeq INTEGER, LedgerSeq INTEGER, Status TEXT,
+                 RawTxn BLOB, TxnMeta BLOB)"""
+        )
+        cur.execute(
+            """CREATE TABLE IF NOT EXISTS AccountTransactions (
+                 TransID TEXT, Account TEXT, LedgerSeq INTEGER,
+                 TxnSeq INTEGER)"""
+        )
+        cur.execute(
+            """CREATE INDEX IF NOT EXISTS AcctTxIndex ON
+                 AccountTransactions(Account, LedgerSeq, TxnSeq)"""
+        )
+        cur.execute(
+            """CREATE TABLE IF NOT EXISTS Ledgers (
+                 LedgerHash TEXT PRIMARY KEY, LedgerSeq INTEGER,
+                 PrevHash TEXT, TotalCoins INTEGER, ClosingTime INTEGER,
+                 PrevClosingTime INTEGER, CloseTimeRes INTEGER,
+                 CloseFlags INTEGER, AccountSetHash TEXT, TransSetHash TEXT)"""
+        )
+        cur.execute(
+            """CREATE TABLE IF NOT EXISTS Validations (
+                 LedgerHash TEXT, NodePubKey TEXT, SignTime INTEGER,
+                 RawData BLOB)"""
+        )
+        self._conn.commit()
+
+    def batch(self):
+        """One commit for many writes (a closed ledger's tx set persists as
+        a single SQLite transaction instead of a commit/fsync per tx)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _batch():
+            with self._lock:
+                self._in_batch = True
+            try:
+                yield self
+                with self._lock:
+                    self._conn.commit()
+            finally:
+                with self._lock:
+                    self._in_batch = False
+
+        return _batch()
+
+    def _commit(self) -> None:
+        if not self._in_batch:
+            self._conn.commit()
+
+    # -- transactions -----------------------------------------------------
+
+    def save_transaction(
+        self,
+        txid: bytes,
+        tx_type: str,
+        account: bytes,
+        seq: int,
+        ledger_seq: int,
+        status: str,
+        raw: bytes,
+        meta: bytes,
+        affected_accounts: list[bytes],
+        txn_seq: int = 0,
+    ) -> None:
+        with self._lock:
+            cur = self._conn.cursor()
+            cur.execute(
+                "INSERT OR REPLACE INTO Transactions VALUES (?,?,?,?,?,?,?,?)",
+                (txid.hex(), tx_type, account.hex(), seq, ledger_seq, status,
+                 raw, meta),
+            )
+            cur.execute(
+                "DELETE FROM AccountTransactions WHERE TransID = ?", (txid.hex(),)
+            )
+            for acct in affected_accounts:
+                cur.execute(
+                    "INSERT INTO AccountTransactions VALUES (?,?,?,?)",
+                    (txid.hex(), acct.hex(), ledger_seq, txn_seq),
+                )
+            self._commit()
+
+    def get_transaction(self, txid: bytes) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT TransType, FromAcct, FromSeq, LedgerSeq, Status, "
+                "RawTxn, TxnMeta FROM Transactions WHERE TransID = ?",
+                (txid.hex(),),
+            ).fetchone()
+        if row is None:
+            return None
+        return {
+            "type": row[0],
+            "account": bytes.fromhex(row[1]),
+            "seq": row[2],
+            "ledger_seq": row[3],
+            "status": row[4],
+            "raw": row[5],
+            "meta": row[6],
+        }
+
+    def account_transactions(
+        self,
+        account: bytes,
+        min_ledger: int = -1,
+        max_ledger: int = 1 << 62,
+        limit: int = 200,
+        forward: bool = True,
+    ) -> list[dict]:
+        """reference: handlers/AccountTx.cpp SQL walk"""
+        order = "ASC" if forward else "DESC"
+        with self._lock:
+            rows = self._conn.execute(
+                f"""SELECT T.TransID, T.TransType, T.FromAcct, T.FromSeq,
+                     T.LedgerSeq, T.Status, T.RawTxn, T.TxnMeta
+                    FROM AccountTransactions A JOIN Transactions T
+                      ON A.TransID = T.TransID
+                    WHERE A.Account = ? AND A.LedgerSeq BETWEEN ? AND ?
+                    ORDER BY A.LedgerSeq {order}, A.TxnSeq {order} LIMIT ?""",
+                (account.hex(), min_ledger, max_ledger, limit),
+            ).fetchall()
+        return [
+            {
+                "txid": bytes.fromhex(r[0]),
+                "type": r[1],
+                "account": bytes.fromhex(r[2]),
+                "seq": r[3],
+                "ledger_seq": r[4],
+                "status": r[5],
+                "raw": r[6],
+                "meta": r[7],
+            }
+            for r in rows
+        ]
+
+    def tx_history(self, start: int = 0, limit: int = 20) -> list[dict]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT TransID, TransType, FromAcct, FromSeq, LedgerSeq, "
+                "Status, RawTxn, TxnMeta FROM Transactions "
+                "ORDER BY LedgerSeq DESC LIMIT ? OFFSET ?",
+                (limit, start),
+            ).fetchall()
+        return [
+            {
+                "txid": bytes.fromhex(r[0]),
+                "type": r[1],
+                "account": bytes.fromhex(r[2]),
+                "seq": r[3],
+                "ledger_seq": r[4],
+                "status": r[5],
+                "raw": r[6],
+                "meta": r[7],
+            }
+            for r in rows
+        ]
+
+    # -- ledger headers ---------------------------------------------------
+
+    def save_ledger_header(self, ledger) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO Ledgers VALUES (?,?,?,?,?,?,?,?,?,?)",
+                (
+                    ledger.hash().hex(),
+                    ledger.seq,
+                    ledger.parent_hash.hex(),
+                    ledger.tot_coins,
+                    ledger.close_time,
+                    ledger.parent_close_time,
+                    ledger.close_resolution,
+                    ledger.close_flags,
+                    ledger.account_hash.hex(),
+                    ledger.tx_hash.hex(),
+                ),
+            )
+            self._commit()
+
+    def get_ledger_header(self, seq: Optional[int] = None,
+                          ledger_hash: Optional[bytes] = None) -> Optional[dict]:
+        q = "SELECT LedgerHash, LedgerSeq, PrevHash, TotalCoins, ClosingTime, \
+             PrevClosingTime, CloseTimeRes, CloseFlags, AccountSetHash, \
+             TransSetHash FROM Ledgers WHERE "
+        arg: tuple
+        if ledger_hash is not None:
+            q += "LedgerHash = ?"
+            arg = (ledger_hash.hex(),)
+        else:
+            q += "LedgerSeq = ?"
+            arg = (seq,)
+        with self._lock:
+            row = self._conn.execute(q, arg).fetchone()
+        if row is None:
+            return None
+        return {
+            "hash": bytes.fromhex(row[0]),
+            "seq": row[1],
+            "parent_hash": bytes.fromhex(row[2]),
+            "total_coins": row[3],
+            "close_time": row[4],
+            "parent_close_time": row[5],
+            "close_resolution": row[6],
+            "close_flags": row[7],
+            "account_hash": bytes.fromhex(row[8]),
+            "tx_hash": bytes.fromhex(row[9]),
+        }
+
+    def save_validation(self, ledger_hash: bytes, node_public: bytes,
+                        sign_time: int, raw: bytes) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO Validations VALUES (?,?,?,?)",
+                (ledger_hash.hex(), node_public.hex(), sign_time, raw),
+            )
+            self._commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

@@ -1,0 +1,117 @@
+"""VerifyPlane: the coalescing device-batched signature pipeline.
+
+This is the north-star seam (SURVEY §2.9 mapping #1): the reference
+verifies each signature synchronously inside its own job
+(PeerImp::checkTransaction → STTx::checkSign → libsodium); here,
+verification requests from concurrent jobs are coalesced across an
+adaptive window and dispatched as ONE device program over the whole
+batch (crypto.backend.BatchVerifier), with a CPU fast path for small
+batches so standalone latency stays flat (SURVEY §7 "Batching vs
+latency").
+
+Callers either:
+- `submit(req) -> Future[bool]` — async, coalesced (the JobQueue path),
+- `verify_many(reqs) -> ndarray` — blocking whole-batch (consensus close
+  verifying a round's validations at once).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..crypto.backend import BatchVerifier, VerifyRequest, make_verifier
+
+__all__ = ["VerifyPlane"]
+
+
+class VerifyPlane:
+    def __init__(
+        self,
+        backend: str = "cpu",
+        window_ms: float = 2.0,
+        max_batch: int = 16384,
+        min_device_batch: int = 64,
+        cpu_fallback: Optional[BatchVerifier] = None,
+    ):
+        self.backend_name = backend
+        self.verifier: BatchVerifier = make_verifier(backend)
+        self.cpu: BatchVerifier = cpu_fallback or (
+            self.verifier if backend == "cpu" else make_verifier("cpu")
+        )
+        self.window = window_ms / 1000.0
+        self.max_batch = max_batch
+        self.min_device_batch = min_device_batch
+
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._pending: list[tuple[VerifyRequest, Future]] = []
+        self._stopping = False
+        self.batches = 0
+        self.verified = 0
+        self._flusher = threading.Thread(
+            target=self._flush_loop, name="verify-plane", daemon=True
+        )
+        self._flusher.start()
+
+    # -- async coalesced path --------------------------------------------
+
+    def submit(self, req: VerifyRequest) -> "Future[bool]":
+        fut: Future = Future()
+        with self._lock:
+            self._pending.append((req, fut))
+            if len(self._pending) >= self.max_batch:
+                self._cv.notify()
+        return fut
+
+    def _flush_loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._pending and not self._stopping:
+                    self._cv.wait(timeout=0.05)
+                if self._stopping and not self._pending:
+                    return
+                # open the coalescing window: wait for more arrivals
+                if len(self._pending) < self.max_batch:
+                    self._cv.wait(timeout=self.window)
+                batch = self._pending[: self.max_batch]
+                self._pending = self._pending[self.max_batch :]
+            reqs = [r for r, _ in batch]
+            try:
+                results = self.verify_many(reqs)
+            except Exception as exc:  # noqa: BLE001 — fail the futures, not the plane
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(exc)
+                continue
+            for (_, fut), ok in zip(batch, results):
+                fut.set_result(bool(ok))
+
+    # -- blocking whole-batch path ---------------------------------------
+
+    def verify_many(self, reqs: Sequence[VerifyRequest]) -> np.ndarray:
+        if not reqs:
+            return np.zeros(0, bool)
+        use_cpu = len(reqs) < self.min_device_batch
+        verifier = self.cpu if use_cpu else self.verifier
+        out = verifier.verify_batch(reqs)
+        self.batches += 1
+        self.verified += len(reqs)
+        return out
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopping = True
+            self._cv.notify_all()
+        self._flusher.join(timeout=10)
+
+    def get_json(self) -> dict:
+        return {
+            "backend": self.backend_name,
+            "batches": self.batches,
+            "verified": self.verified,
+            "pending": len(self._pending),
+        }

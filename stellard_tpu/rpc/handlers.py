@@ -1,0 +1,779 @@
+"""RPC method handlers + dispatch.
+
+Reference: src/ripple_rpc/handlers/*.cpp (60 handlers) dispatched by
+RPCHandler::doCommand (src/ripple_app/rpc/RPCHandler.cpp) with per-method
+role requirements (ADMIN/GUEST). The same handler table serves HTTP
+JSON-RPC and WebSocket commands, as in the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Any, Callable, Optional
+
+from ..protocol.formats import LedgerEntryType
+from ..protocol.keys import (
+    KeyPair,
+    decode_account_id,
+    encode_account_id,
+    encode_node_public,
+    encode_seed,
+)
+from ..protocol.sfields import (
+    sfAccount,
+    sfBalance,
+    sfFlags,
+    sfHighLimit,
+    sfLedgerEntryType,
+    sfLowLimit,
+    sfOwnerCount,
+    sfRegularKey,
+    sfSequence,
+    sfTakerGets,
+    sfTakerPays,
+)
+from ..protocol.stamount import STAmount, currency_from_iso, iso_from_currency
+from ..protocol.stobject import STObject
+from ..protocol.sttx import SerializedTransaction
+from ..protocol.ter import TER
+from ..state import indexes
+from ..state.entryset import LedgerEntrySet
+from ..state.ledger import Ledger
+from .errors import RPCError
+from .infosub import InfoSub, SubscriptionManager
+from .txsign import transaction_sign
+
+__all__ = ["Role", "HANDLERS", "dispatch", "Context"]
+
+
+class Role(IntEnum):
+    GUEST = 0
+    ADMIN = 1
+
+
+@dataclass
+class Context:
+    node: Any
+    params: dict
+    role: Role = Role.ADMIN
+    infosub: Optional[InfoSub] = None
+    subs: Optional[SubscriptionManager] = None
+
+
+HANDLERS: dict[str, tuple[Callable[[Context], dict], Role]] = {}
+
+
+def handler(name: str, role: Role = Role.GUEST):
+    def deco(fn):
+        HANDLERS[name] = (fn, role)
+        return fn
+
+    return deco
+
+
+def dispatch(ctx: Context, method: str) -> dict:
+    """-> result dict; error results carry {"error": ...} (reference:
+    RPCHandler::doCommand wraps into status:error)."""
+    entry = HANDLERS.get(method)
+    if entry is None:
+        return RPCError("unknownCmd").to_json()
+    fn, need_role = entry
+    if need_role == Role.ADMIN and ctx.role != Role.ADMIN:
+        return RPCError("noPermission").to_json()
+    try:
+        return fn(ctx)
+    except RPCError as exc:
+        return exc.to_json()
+    except Exception as exc:  # noqa: BLE001 — handler bug must not kill the door
+        import traceback
+
+        traceback.print_exc()
+        return RPCError("internal", str(exc)).to_json()
+
+
+# -- helpers ---------------------------------------------------------------
+
+
+def _parse_account(params: dict, key: str = "account") -> bytes:
+    v = params.get(key)
+    if not v:
+        raise RPCError("srcActMissing" if key == "account" else "invalidParams")
+    try:
+        return decode_account_id(v)
+    except (ValueError, KeyError) as exc:
+        raise RPCError("actMalformed") from exc
+
+
+def _select_ledger(ctx: Context) -> Ledger:
+    """reference: RPC::lookupLedger (impl/LookupLedger.cpp) — by
+    ledger_hash, numeric ledger_index, or current|closed|validated."""
+    lm = ctx.node.ledger_master
+    p = ctx.params
+    if p.get("ledger_hash"):
+        led = lm.get_ledger_by_hash(bytes.fromhex(p["ledger_hash"]))
+        if led is None:
+            raise RPCError("lgrNotFound")
+        return led
+    idx = p.get("ledger_index", "current")
+    if isinstance(idx, int) or (isinstance(idx, str) and idx.isdigit()):
+        led = lm.get_ledger_by_seq(int(idx))
+        if led is None:
+            raise RPCError("lgrNotFound")
+        return led
+    if idx == "current":
+        return lm.current_ledger()
+    if idx == "closed":
+        return lm.closed_ledger()
+    if idx == "validated":
+        if lm.validated is None:
+            raise RPCError("lgrNotFound")
+        return lm.validated
+    raise RPCError("invalidParams", f"bad ledger_index {idx!r}")
+
+
+def _ledger_ident(led: Ledger) -> dict:
+    out: dict[str, Any] = {"ledger_index": led.seq}
+    if led.closed:
+        out["ledger_hash"] = led.hash().hex().upper()
+    else:
+        out["ledger_current_index"] = led.seq
+    return out
+
+
+def _tx_entries(led: Ledger):
+    """Yield (txid, tx, meta_blob) from a ledger's tx map."""
+    for txid, blob, meta in led.tx_entries():
+        yield txid, SerializedTransaction.from_bytes(blob), meta
+
+
+# -- basics ----------------------------------------------------------------
+
+
+@handler("ping")
+def do_ping(ctx: Context) -> dict:
+    return {}
+
+
+@handler("random")
+def do_random(ctx: Context) -> dict:
+    return {"random": os.urandom(32).hex().upper()}
+
+
+@handler("wallet_propose")
+def do_wallet_propose(ctx: Context) -> dict:
+    """reference: handlers/WalletPropose.cpp — random or passphrase seed."""
+    passphrase = ctx.params.get("passphrase")
+    kp = (
+        KeyPair.from_passphrase(passphrase) if passphrase else KeyPair.random()
+    )
+    return {
+        "master_seed": kp.human_seed,
+        "master_seed_hex": kp.seed.hex().upper(),
+        "account_id": kp.human_account_id,
+        "public_key": kp.human_account_public,
+        "public_key_hex": kp.public.hex().upper(),
+    }
+
+
+@handler("validation_create", Role.ADMIN)
+def do_validation_create(ctx: Context) -> dict:
+    """reference: handlers/ValidationCreate.cpp"""
+    passphrase = ctx.params.get("secret")
+    kp = (
+        KeyPair.from_passphrase(passphrase) if passphrase else KeyPair.random()
+    )
+    return {
+        "validation_key": passphrase or "",
+        "validation_public_key": kp.human_node_public,
+        "validation_seed": kp.human_seed,
+    }
+
+
+@handler("validation_seed", Role.ADMIN)
+def do_validation_seed(ctx: Context) -> dict:
+    node = ctx.node
+    if not node.validation_keys:
+        return {"message": "not a validator"}
+    return {
+        "validation_public_key": node.validation_keys.human_node_public,
+        "validation_seed": node.validation_keys.human_seed,
+    }
+
+
+# -- server introspection --------------------------------------------------
+
+
+@handler("server_info")
+def do_server_info(ctx: Context) -> dict:
+    """reference: handlers/ServerInfo.cpp via NetworkOPs::getServerInfo"""
+    node = ctx.node
+    lcl = node.ledger_master.closed_ledger()
+    info = {
+        "build_version": "stellard-tpu 0.1.0",
+        "server_state": node.ops.server_state(),
+        "complete_ledgers": _complete_ledgers(node),
+        "peers": 0,
+        "load_factor": 1.0,
+        "signature_backend": node.config.signature_backend,
+        "validation_quorum": node.config.validation_quorum,
+        "validated_ledger": {
+            "seq": lcl.seq,
+            "hash": lcl.hash().hex().upper(),
+            "close_time": lcl.close_time,
+            "base_fee_str": str(lcl.base_fee),
+            "reserve_base_str": str(lcl.reserve_base),
+            "reserve_inc_str": str(lcl.reserve_increment),
+        },
+        "pubkey_node": (
+            node.validation_keys.human_node_public
+            if node.validation_keys
+            else ""
+        ),
+    }
+    return {"info": info}
+
+
+def _complete_ledgers(node) -> str:
+    seqs = sorted(node.ledger_master.ledger_history)
+    if not seqs:
+        return "empty"
+    return f"{seqs[0]}-{seqs[-1]}" if len(seqs) > 1 else str(seqs[0])
+
+
+@handler("server_state")
+def do_server_state(ctx: Context) -> dict:
+    node = ctx.node
+    return {
+        "state": {
+            "server_state": node.ops.server_state(),
+            "complete_ledgers": _complete_ledgers(node),
+            "peers": 0,
+            "load_base": 256,
+            "load_factor": 256,
+        }
+    }
+
+
+@handler("get_counts", Role.ADMIN)
+def do_get_counts(ctx: Context) -> dict:
+    """reference: handlers/GetCounts.cpp — object/op counters."""
+    node = ctx.node
+    out = {
+        "jobq": node.job_queue.get_json(),
+        "verify_plane": node.verify_plane.get_json(),
+        "hash_router": node.hash_router.size(),
+        "ledgers_cached": len(node.ledger_master.ledgers_by_hash),
+    }
+    return out
+
+
+@handler("consensus_info", Role.ADMIN)
+def do_consensus_info(ctx: Context) -> dict:
+    node = ctx.node
+    return {
+        "info": {
+            "standalone": node.config.standalone,
+            "validation_quorum": node.config.validation_quorum,
+        }
+    }
+
+
+@handler("peers", Role.ADMIN)
+def do_peers(ctx: Context) -> dict:
+    overlay = getattr(ctx.node, "overlay", None)
+    return {"peers": overlay.peers_json() if overlay else []}
+
+
+@handler("stop", Role.ADMIN)
+def do_stop(ctx: Context) -> dict:
+    ctx.node._running.clear()
+    return {"message": "stellard server stopping"}
+
+
+@handler("log_level", Role.ADMIN)
+def do_log_level(ctx: Context) -> dict:
+    import logging
+
+    severity = ctx.params.get("severity")
+    if severity:
+        level = {
+            "trace": logging.DEBUG,
+            "debug": logging.DEBUG,
+            "info": logging.INFO,
+            "warning": logging.WARNING,
+            "error": logging.ERROR,
+            "fatal": logging.CRITICAL,
+        }.get(severity, logging.INFO)
+        logging.getLogger("stellard_tpu").setLevel(level)
+    return {}
+
+
+@handler("feature", Role.ADMIN)
+def do_feature(ctx: Context) -> dict:
+    return {"features": {}}
+
+
+# -- ledger inspection -----------------------------------------------------
+
+
+@handler("ledger_current")
+def do_ledger_current(ctx: Context) -> dict:
+    return {
+        "ledger_current_index": ctx.node.ledger_master.current_ledger().seq
+    }
+
+
+@handler("ledger_closed")
+def do_ledger_closed(ctx: Context) -> dict:
+    lcl = ctx.node.ledger_master.closed_ledger()
+    return {
+        "ledger_index": lcl.seq,
+        "ledger_hash": lcl.hash().hex().upper(),
+    }
+
+
+def _ledger_header_json(led: Ledger, full_txs: bool = False) -> dict:
+    out = {
+        "seqNum": str(led.seq),
+        "ledger_index": str(led.seq),
+        "parent_hash": led.parent_hash.hex().upper(),
+        "total_coins": str(led.tot_coins),
+        "fee_pool": str(led.fee_pool),
+        "inflation_seq": str(led.inflation_seq),
+        "close_time": led.close_time,
+        "parent_close_time": led.parent_close_time,
+        "close_time_resolution": led.close_resolution,
+        "close_flags": led.close_flags,
+        "closed": led.closed,
+        "transaction_hash": led.tx_hash.hex().upper(),
+        "account_hash": led.account_hash.hex().upper(),
+    }
+    if led.closed:
+        out["ledger_hash"] = led.hash().hex().upper()
+        out["hash"] = out["ledger_hash"]
+        out["accepted"] = led.accepted
+    return out
+
+
+@handler("ledger")
+def do_ledger(ctx: Context) -> dict:
+    led = _select_ledger(ctx)
+    out = {"ledger": _ledger_header_json(led)}
+    if ctx.params.get("transactions"):
+        expand = bool(ctx.params.get("expand"))
+        txs = []
+        for txid, tx, meta in _tx_entries(led):
+            if expand:
+                j = tx.obj.to_json()
+                j["hash"] = txid.hex().upper()
+                if meta:
+                    j["metaData"] = STObject.from_bytes(meta).to_json()
+                txs.append(j)
+            else:
+                txs.append(txid.hex().upper())
+        out["ledger"]["transactions"] = txs
+    if ctx.params.get("accounts"):
+        out["ledger"]["accountState"] = [
+            STObject.from_bytes(leaf.item.data).to_json()
+            for leaf in led.state_map.leaves()
+        ]
+    return out
+
+
+@handler("ledger_data")
+def do_ledger_data(ctx: Context) -> dict:
+    """Paginated full-state dump (reference: handlers/LedgerData.cpp)."""
+    led = _select_ledger(ctx)
+    limit = min(int(ctx.params.get("limit", 256)), 2048)
+    marker = ctx.params.get("marker")
+    start = bytes.fromhex(marker) if marker else b"\x00" * 32
+    out_state = []
+    next_marker = None
+    cursor = start if marker else None
+    n = 0
+    while n < limit:
+        item = led.state_map.succ(cursor) if cursor is not None else led.state_map.succ(b"\x00" * 32)
+        # succ is strictly-greater; seed the first call one below
+        if item is None:
+            break
+        cursor = item.tag
+        out_state.append(
+            {
+                "index": item.tag.hex().upper(),
+                "data": item.data.hex().upper(),
+            }
+        )
+        n += 1
+    if n == limit:
+        nxt = led.state_map.succ(cursor)
+        if nxt is not None:
+            next_marker = cursor.hex().upper()
+    out = _ledger_ident(led)
+    out["state"] = out_state
+    if next_marker:
+        out["marker"] = next_marker
+    return out
+
+
+@handler("ledger_entry")
+def do_ledger_entry(ctx: Context) -> dict:
+    """reference: handlers/LedgerEntry.cpp — fetch one SLE by index or by
+    typed locator (account_root, offer, ripple_state)."""
+    led = _select_ledger(ctx)
+    p = ctx.params
+    if p.get("index"):
+        idx = bytes.fromhex(p["index"])
+    elif p.get("account_root"):
+        idx = indexes.account_root_index(
+            decode_account_id(p["account_root"])
+        )
+    elif p.get("offer"):
+        o = p["offer"]
+        idx = indexes.offer_index(decode_account_id(o["account"]), int(o["seq"]))
+    elif p.get("ripple_state"):
+        rs = p["ripple_state"]
+        a = decode_account_id(rs["accounts"][0])
+        b = decode_account_id(rs["accounts"][1])
+        cur = currency_from_iso(rs["currency"])
+        idx = indexes.ripple_state_index(a, b, cur)
+    else:
+        raise RPCError("invalidParams", "no ledger_entry locator")
+    item = led.state_map.get(idx)
+    if item is None:
+        raise RPCError("lgrNotFound", "entryNotFound")
+    out = _ledger_ident(led)
+    out["index"] = idx.hex().upper()
+    out["node_binary"] = item.data.hex().upper()
+    out["node"] = STObject.from_bytes(item.data).to_json()
+    return out
+
+
+@handler("ledger_accept", Role.ADMIN)
+def do_ledger_accept(ctx: Context) -> dict:
+    """Standalone manual close (reference: handlers/LedgerAccept.cpp —
+    rejected unless RUN_STANDALONE)."""
+    node = ctx.node
+    if not node.config.standalone:
+        raise RPCError("notStandalone")
+    node.ops.accept_ledger()
+    return {
+        "ledger_current_index": node.ledger_master.current_ledger().seq
+    }
+
+
+@handler("tx")
+def do_tx(ctx: Context) -> dict:
+    """reference: handlers/Tx.cpp — by transaction hash, from the SQL
+    history DB, with metadata."""
+    h = ctx.params.get("transaction")
+    if not h:
+        raise RPCError("invalidParams", "missing transaction")
+    row = ctx.node.txdb.get_transaction(bytes.fromhex(h))
+    if row is None:
+        raise RPCError("txnNotFound")
+    tx = SerializedTransaction.from_bytes(row["raw"])
+    out = tx.obj.to_json()
+    out["hash"] = h.upper()
+    out["ledger_index"] = row["ledger_seq"]
+    out["validated"] = True
+    if row["meta"]:
+        out["meta"] = STObject.from_bytes(row["meta"]).to_json()
+    return out
+
+
+@handler("tx_history")
+def do_tx_history(ctx: Context) -> dict:
+    start = int(ctx.params.get("start", 0))
+    rows = ctx.node.txdb.tx_history(start=start, limit=20)
+    txs = []
+    for r in rows:
+        tx = SerializedTransaction.from_bytes(r["raw"])
+        j = tx.obj.to_json()
+        j["hash"] = r["txid"].hex().upper()
+        j["ledger_index"] = r["ledger_seq"]
+        txs.append(j)
+    return {"index": start, "txs": txs}
+
+
+# -- account inspection ----------------------------------------------------
+
+
+@handler("account_info")
+def do_account_info(ctx: Context) -> dict:
+    """reference: handlers/AccountInfo.cpp"""
+    led = _select_ledger(ctx)
+    account_id = _parse_account(ctx.params)
+    root = led.account_root(account_id)
+    if root is None:
+        raise RPCError("actNotFound", account=ctx.params.get("account"))
+    j = root.to_json()
+    j["Balance"] = root[sfBalance].to_json()
+    j["index"] = indexes.account_root_index(account_id).hex().upper()
+    out = _ledger_ident(led)
+    out["account_data"] = j
+    return out
+
+
+@handler("account_lines")
+def do_account_lines(ctx: Context) -> dict:
+    """reference: handlers/AccountLines.cpp — walk the owner directory for
+    ltRIPPLE_STATE entries; render from this account's perspective."""
+    led = _select_ledger(ctx)
+    account_id = _parse_account(ctx.params)
+    if led.account_root(account_id) is None:
+        raise RPCError("actNotFound")
+    peer = None
+    if ctx.params.get("peer"):
+        peer = decode_account_id(ctx.params["peer"])
+    les = LedgerEntrySet(led)
+    lines = []
+    for entry_idx in les.dir_entries(indexes.owner_dir_index(account_id)):
+        sle = les.peek(entry_idx)
+        if sle is None or sle.get(sfLedgerEntryType) != int(
+            LedgerEntryType.ltRIPPLE_STATE
+        ):
+            continue
+        low = sle[sfLowLimit]
+        high = sle[sfHighLimit]
+        balance = sle[sfBalance]
+        is_low = low.issuer == account_id
+        other = high.issuer if is_low else low.issuer
+        if peer is not None and other != peer:
+            continue
+        bal = balance if is_low else -balance
+        limit = low if is_low else high
+        limit_peer = high if is_low else low
+        lines.append(
+            {
+                "account": encode_account_id(other),
+                "balance": bal.value_text(),
+                "currency": iso_from_currency(balance.currency),
+                "limit": limit.value_text(),
+                "limit_peer": limit_peer.value_text(),
+                "quality_in": 0,
+                "quality_out": 0,
+            }
+        )
+    out = _ledger_ident(led)
+    out["account"] = ctx.params["account"]
+    out["lines"] = lines
+    return out
+
+
+@handler("account_offers")
+def do_account_offers(ctx: Context) -> dict:
+    """reference: handlers/AccountOffers.cpp"""
+    led = _select_ledger(ctx)
+    account_id = _parse_account(ctx.params)
+    if led.account_root(account_id) is None:
+        raise RPCError("actNotFound")
+    les = LedgerEntrySet(led)
+    offers = []
+    for entry_idx in les.dir_entries(indexes.owner_dir_index(account_id)):
+        sle = les.peek(entry_idx)
+        if sle is None or sle.get(sfLedgerEntryType) != int(
+            LedgerEntryType.ltOFFER
+        ):
+            continue
+        offers.append(
+            {
+                "flags": sle.get(sfFlags, 0),
+                "seq": sle[sfSequence],
+                "taker_gets": sle[sfTakerGets].to_json(),
+                "taker_pays": sle[sfTakerPays].to_json(),
+            }
+        )
+    out = _ledger_ident(led)
+    out["account"] = ctx.params["account"]
+    out["offers"] = offers
+    return out
+
+
+@handler("account_tx")
+def do_account_tx(ctx: Context) -> dict:
+    """reference: handlers/AccountTx.cpp over the SQL index."""
+    account_id = _parse_account(ctx.params)
+    p = ctx.params
+    min_l = int(p.get("ledger_index_min", -1))
+    max_l = int(p.get("ledger_index_max", -1))
+    if min_l < 0:
+        min_l = 0
+    if max_l < 0:
+        max_l = 1 << 62
+    forward = bool(p.get("forward", False))
+    limit = min(int(p.get("limit", 200)), 500)
+    rows = ctx.node.txdb.account_transactions(
+        account_id, min_l, max_l, limit, forward
+    )
+    txs = []
+    for r in rows:
+        tx = SerializedTransaction.from_bytes(r["raw"])
+        j = tx.obj.to_json()
+        j["hash"] = r["txid"].hex().upper()
+        j["ledger_index"] = r["ledger_seq"]
+        entry = {"tx": j, "validated": True}
+        if r["meta"]:
+            entry["meta"] = STObject.from_bytes(r["meta"]).to_json()
+        txs.append(entry)
+    return {
+        "account": p["account"],
+        "ledger_index_min": min_l,
+        "ledger_index_max": max_l if max_l < (1 << 62) else -1,
+        "transactions": txs,
+    }
+
+
+# -- order books -----------------------------------------------------------
+
+
+def _parse_book_side(p: dict, key: str) -> tuple[bytes, bytes]:
+    side = p.get(key)
+    if not isinstance(side, dict) or "currency" not in side:
+        raise RPCError("invalidParams", f"missing {key}")
+    iso = side["currency"]
+    currency = bytes.fromhex(iso) if len(iso) == 40 else currency_from_iso(iso)
+    issuer = b"\x00" * 20
+    if side.get("issuer"):
+        issuer = decode_account_id(side["issuer"])
+    return currency, issuer
+
+
+@handler("book_offers")
+def do_book_offers(ctx: Context) -> dict:
+    """reference: handlers/BookOffers.cpp — walk the book's quality
+    directories in order, rendering resting offers."""
+    led = _select_ledger(ctx)
+    pays_currency, pays_issuer = _parse_book_side(ctx.params, "taker_pays")
+    gets_currency, gets_issuer = _parse_book_side(ctx.params, "taker_gets")
+    limit = min(int(ctx.params.get("limit", 256)), 512)
+
+    les = LedgerEntrySet(led)
+    base = indexes.book_base(
+        pays_currency, pays_issuer, gets_currency, gets_issuer
+    )
+    end = indexes.quality_next(base)
+    offers = []
+    cursor = base
+    while len(offers) < limit:
+        item = led.state_map.succ(cursor)
+        if item is None or item.tag >= end:
+            break
+        cursor = item.tag
+        dir_sle = les.peek(item.tag)
+        if dir_sle is None:
+            continue
+        if dir_sle.get(sfLedgerEntryType) != int(LedgerEntryType.ltDIR_NODE):
+            continue
+        for offer_idx in les.dir_entries(item.tag):
+            sle = les.peek(offer_idx)
+            if sle is None or sle.get(sfLedgerEntryType) != int(
+                LedgerEntryType.ltOFFER
+            ):
+                continue
+            j = sle.to_json()
+            j["index"] = offer_idx.hex().upper()
+            j["quality"] = str(indexes.get_quality(item.tag))
+            offers.append(j)
+            if len(offers) >= limit:
+                break
+    out = _ledger_ident(led)
+    out["offers"] = offers
+    return out
+
+
+# -- submission ------------------------------------------------------------
+
+
+def _engine_result(ter: TER, tx: SerializedTransaction) -> dict:
+    return {
+        "engine_result": ter.token,
+        "engine_result_code": int(ter),
+        "engine_result_message": ter.human,
+        "tx_blob": tx.serialize().hex().upper(),
+        "tx_json": {
+            **tx.obj.to_json(),
+            "hash": tx.txid().hex().upper(),
+        },
+    }
+
+
+@handler("submit")
+def do_submit(ctx: Context) -> dict:
+    """reference: handlers/Submit.cpp:26-80 — tx_blob path or
+    sign-and-submit tx_json path."""
+    p = ctx.params
+    if "tx_blob" in p:
+        try:
+            tx = SerializedTransaction.from_bytes(bytes.fromhex(p["tx_blob"]))
+        except Exception as exc:  # noqa: BLE001
+            raise RPCError("invalidTransaction", str(exc)) from exc
+    elif "tx_json" in p:
+        if "secret" not in p:
+            raise RPCError("invalidParams", "missing secret")
+        tx = transaction_sign(ctx.node, p["tx_json"], p["secret"])
+    else:
+        raise RPCError("invalidParams", "need tx_blob or tx_json")
+    ter, _applied = ctx.node.ops.process_transaction(
+        tx, admin=(ctx.role == Role.ADMIN)
+    )
+    return _engine_result(ter, tx)
+
+
+@handler("sign")
+def do_sign(ctx: Context) -> dict:
+    """reference: handlers/Sign.cpp → RPC::transactionSign (no submit)."""
+    p = ctx.params
+    if "tx_json" not in p or "secret" not in p:
+        raise RPCError("invalidParams", "need tx_json and secret")
+    tx = transaction_sign(ctx.node, p["tx_json"], p["secret"])
+    return {
+        "tx_blob": tx.serialize().hex().upper(),
+        "tx_json": {**tx.obj.to_json(), "hash": tx.txid().hex().upper()},
+    }
+
+
+# -- pub/sub ---------------------------------------------------------------
+
+
+@handler("subscribe")
+def do_subscribe(ctx: Context) -> dict:
+    """reference: handlers/Subscribe.cpp:86-112"""
+    if ctx.infosub is None or ctx.subs is None:
+        raise RPCError("notSupported", "subscribe requires a websocket")
+    p = ctx.params
+    result = {}
+    if p.get("streams"):
+        result.update(ctx.subs.subscribe_streams(ctx.infosub, p["streams"]))
+    if p.get("accounts"):
+        accts = [decode_account_id(a) for a in p["accounts"]]
+        ctx.subs.subscribe_accounts(ctx.infosub, accts)
+    if p.get("accounts_proposed") or p.get("rt_accounts"):
+        accts = [
+            decode_account_id(a)
+            for a in (p.get("accounts_proposed") or p.get("rt_accounts"))
+        ]
+        ctx.subs.subscribe_accounts(ctx.infosub, accts, proposed=True)
+    return result
+
+
+@handler("unsubscribe")
+def do_unsubscribe(ctx: Context) -> dict:
+    if ctx.infosub is None or ctx.subs is None:
+        raise RPCError("notSupported", "unsubscribe requires a websocket")
+    p = ctx.params
+    if p.get("streams"):
+        ctx.subs.unsubscribe_streams(ctx.infosub, p["streams"])
+    if p.get("accounts"):
+        ctx.subs.unsubscribe_accounts(
+            ctx.infosub, [decode_account_id(a) for a in p["accounts"]]
+        )
+    if p.get("accounts_proposed"):
+        ctx.subs.unsubscribe_accounts(
+            ctx.infosub,
+            [decode_account_id(a) for a in p["accounts_proposed"]],
+            proposed=True,
+        )
+    return {}

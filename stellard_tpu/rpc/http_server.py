@@ -1,0 +1,148 @@
+"""HTTP JSON-RPC door.
+
+Reference: src/ripple/http (async HTTP server framework) bound to the RPC
+handler table by RPCHTTPServer (Application.cpp:325); request format is
+JSON-RPC 1.0-style {"method": ..., "params": [{...}]} and responses wrap
+the handler result as {"result": {..., "status": "success"|"error"}}
+(reference: RPCServerHandler::processRequest).
+
+asyncio protocol implementation — no external HTTP library.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Optional
+
+from .errors import RPCError
+from .handlers import Context, Role, dispatch
+
+__all__ = ["HttpRpcServer", "process_http_request"]
+
+_MAX_BODY = 10 * 1024 * 1024
+
+
+def process_http_request(node, body: bytes, role: Role = Role.ADMIN) -> dict:
+    """Decode one JSON-RPC request body → response object."""
+    try:
+        req = json.loads(body)
+    except ValueError:
+        return {"result": RPCError("invalidParams", "malformed JSON").to_json()
+                | {"status": "error"}}
+    method = req.get("method")
+    params_list = req.get("params") or [{}]
+    params = params_list[0] if isinstance(params_list, list) and params_list else {}
+    if not isinstance(params, dict):
+        params = {}
+    if not isinstance(method, str):
+        return {"result": RPCError("unknownCmd").to_json() | {"status": "error"}}
+    result = dispatch(Context(node=node, params=params, role=role), method)
+    result["status"] = "error" if "error" in result else "success"
+    out = {"result": result}
+    if "id" in req:
+        out["id"] = req["id"]
+    return out
+
+
+def _role_for_peer(node, writer) -> Role:
+    """ADMIN only for connections from [rpc_admin_allow] source IPs
+    (reference: RPCHandler role gating by admin-allowed IP)."""
+    peer = writer.get_extra_info("peername")
+    ip = peer[0] if peer else ""
+    return Role.ADMIN if ip in node.config.admin_ips else Role.GUEST
+
+
+class HttpRpcServer:
+    """Minimal threaded asyncio HTTP/1.1 server for the RPC door."""
+
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
+        self.node = node
+        self.host = host
+        self.port = port
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._server = None
+
+    # -- protocol ---------------------------------------------------------
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                header = await reader.readuntil(b"\r\n\r\n")
+                lines = header.decode("latin-1").split("\r\n")
+                request_line = lines[0]
+                headers = {}
+                for line in lines[1:]:
+                    if ":" in line:
+                        k, v = line.split(":", 1)
+                        headers[k.strip().lower()] = v.strip()
+                length = int(headers.get("content-length", 0))
+                if length > _MAX_BODY:
+                    writer.write(b"HTTP/1.1 413 Payload Too Large\r\n\r\n")
+                    await writer.drain()
+                    return
+                body = await reader.readexactly(length) if length else b""
+                if request_line.startswith("GET"):
+                    payload = b'{"status": "ok"}'
+                else:
+                    payload = json.dumps(
+                        process_http_request(
+                            self.node, body, _role_for_peer(self.node, writer)
+                        )
+                    ).encode()
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\n"
+                    b"Content-Type: application/json\r\n"
+                    + f"Content-Length: {len(payload)}\r\n".encode()
+                    + b"Connection: keep-alive\r\n\r\n"
+                    + payload
+                )
+                await writer.drain()
+                if headers.get("connection", "").lower() == "close":
+                    break
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.LimitOverrunError):
+            pass
+        finally:
+            writer.close()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "HttpRpcServer":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="rpc-http")
+        self._thread.start()
+        self._started.wait(timeout=10)
+        return self
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def boot():
+            self._server = await asyncio.start_server(
+                self._handle, self.host, self.port, limit=_MAX_BODY
+            )
+            self.port = self._server.sockets[0].getsockname()[1]
+            self._started.set()
+
+        self._loop.run_until_complete(boot())
+        try:
+            self._loop.run_forever()
+        finally:
+            self._loop.close()
+
+    def stop(self) -> None:
+        if self._loop and self._loop.is_running():
+            def _shutdown():
+                if self._server:
+                    self._server.close()
+                self._loop.stop()
+
+            self._loop.call_soon_threadsafe(_shutdown)
+        if self._thread:
+            self._thread.join(timeout=5)

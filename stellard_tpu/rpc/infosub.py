@@ -1,0 +1,191 @@
+"""InfoSub: pub/sub subscriber abstraction + subscription manager.
+
+Reference: src/ripple_net/rpc/InfoSub.cpp + NetworkOPsImp's mSub* maps
+(NetworkOPsImp.h:372-392) — streams: `ledger`, `server`, `transactions`,
+`transactions_proposed` (rt_transactions), per-`accounts` and per-`books`
+subscriptions. WS connections implement the InfoSub sink; closes fan out
+from the close path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from ..protocol.keys import encode_account_id
+from ..protocol.sttx import SerializedTransaction
+from ..protocol.ter import TER
+from ..state.ledger import Ledger
+
+__all__ = ["InfoSub", "SubscriptionManager"]
+
+
+class InfoSub:
+    """One subscriber (a WS connection or an in-process test sink)."""
+
+    _next_id = 0
+
+    def __init__(self, send: Callable[[dict], None]):
+        self.send = send
+        InfoSub._next_id += 1
+        self.id = InfoSub._next_id
+        self.streams: set[str] = set()
+        self.accounts: set[bytes] = set()
+        self.accounts_proposed: set[bytes] = set()
+
+
+class SubscriptionManager:
+    """Fan-out hub wired into NetworkOPs' close/tx hooks."""
+
+    def __init__(self, ops):
+        self.ops = ops
+        self._lock = threading.Lock()
+        self._subs: dict[int, InfoSub] = {}
+        ops.on_ledger_closed.append(self._pub_ledger)
+        ops.on_proposed_tx.append(self._pub_proposed)
+
+    # -- subscribe / unsubscribe (reference: handlers/Subscribe.cpp) ------
+
+    def add(self, sub: InfoSub) -> None:
+        with self._lock:
+            self._subs[sub.id] = sub
+
+    def remove(self, sub_id: int) -> None:
+        with self._lock:
+            self._subs.pop(sub_id, None)
+
+    def subscribe_streams(self, sub: InfoSub, streams: list[str]) -> dict:
+        """Returns the initial result payload (ledger stream returns the
+        current state snapshot, reference Subscribe.cpp:86-112)."""
+        result: dict = {}
+        for stream in streams:
+            if stream not in ("ledger", "server", "transactions",
+                              "transactions_proposed", "rt_transactions"):
+                continue
+            sub.streams.add(stream)
+            if stream == "ledger":
+                result.update(self._ledger_snapshot())
+        self.add(sub)
+        return result
+
+    def unsubscribe_streams(self, sub: InfoSub, streams: list[str]) -> None:
+        for stream in streams:
+            sub.streams.discard(stream)
+
+    def subscribe_accounts(self, sub: InfoSub, accounts: list[bytes],
+                           proposed: bool = False) -> None:
+        target = sub.accounts_proposed if proposed else sub.accounts
+        target.update(accounts)
+        self.add(sub)
+
+    def unsubscribe_accounts(self, sub: InfoSub, accounts: list[bytes],
+                             proposed: bool = False) -> None:
+        target = sub.accounts_proposed if proposed else sub.accounts
+        target.difference_update(accounts)
+
+    def _ledger_snapshot(self) -> dict:
+        lcl = self.ops.lm.closed_ledger()
+        return {
+            "ledger_index": lcl.seq,
+            "ledger_hash": lcl.hash().hex().upper(),
+            "ledger_time": lcl.close_time,
+            "fee_base": lcl.base_fee,
+            "fee_ref": lcl.reference_fee_units,
+            "reserve_base": lcl.reserve_base,
+            "reserve_inc": lcl.reserve_increment,
+        }
+
+    # -- fan-out ----------------------------------------------------------
+
+    def _each(self):
+        with self._lock:
+            return list(self._subs.values())
+
+    def _pub_ledger(self, ledger: Ledger, results: dict) -> None:
+        """reference: NetworkOPs::pubLedger — ledgerClosed stream msg,
+        then per-tx accepted messages."""
+        msg = {
+            "type": "ledgerClosed",
+            "ledger_index": ledger.seq,
+            "ledger_hash": ledger.hash().hex().upper(),
+            "ledger_time": ledger.close_time,
+            "fee_base": ledger.base_fee,
+            "fee_ref": ledger.reference_fee_units,
+            "reserve_base": ledger.reserve_base,
+            "reserve_inc": ledger.reserve_increment,
+            "txn_count": len(results),
+        }
+        for sub in self._each():
+            if "ledger" in sub.streams:
+                self._safe_send(sub, msg)
+        # accepted transactions (reference: pubAcceptedTransaction)
+        for txid, blob, meta in ledger.tx_entries():
+            tx = SerializedTransaction.from_bytes(blob)
+            ter = results.get(txid, TER.tesSUCCESS)
+            self._pub_tx(tx, ter, ledger=ledger, validated=True, meta=meta)
+
+    def _pub_proposed(self, tx: SerializedTransaction, ter: TER) -> None:
+        self._pub_tx(tx, ter, ledger=None, validated=False)
+
+    def _pub_tx(self, tx: SerializedTransaction, ter: TER,
+                ledger: Optional[Ledger], validated: bool,
+                meta: bytes = b"") -> None:
+        msg = {
+            "type": "transaction",
+            "transaction": _tx_json_with_hash(tx),
+            "status": "closed" if validated else "proposed",
+            "engine_result": ter.token,
+            "engine_result_code": int(ter),
+            "engine_result_message": ter.human,
+            "validated": validated,
+        }
+        if ledger is not None:
+            msg["ledger_index"] = ledger.seq
+            msg["ledger_hash"] = ledger.hash().hex().upper()
+        if meta:
+            from ..protocol.stobject import STObject
+
+            msg["meta"] = STObject.from_bytes(meta).to_json()
+
+        # accounts touched: from the metadata when we have it (covers
+        # crossed offers, trust-line counterparties, issuers — reference
+        # getAffectedAccounts); fall back to Account/Destination for
+        # proposed txns that carry no meta yet
+        touched = {tx.account}
+        from ..protocol.sfields import sfDestination
+
+        dest = tx.obj.get(sfDestination)
+        if dest:
+            touched.add(dest)
+        if meta:
+            from ..protocol.meta import affected_accounts
+
+            touched.update(affected_accounts(meta))
+
+        for sub in self._each():
+            wants = False
+            if validated and "transactions" in sub.streams:
+                wants = True
+            if not validated and (
+                "transactions_proposed" in sub.streams
+                or "rt_transactions" in sub.streams
+            ):
+                wants = True
+            if sub.accounts & touched and validated:
+                wants = True
+            if sub.accounts_proposed & touched:
+                wants = True
+            if wants:
+                self._safe_send(sub, msg)
+
+    def _safe_send(self, sub: InfoSub, msg: dict) -> None:
+        try:
+            sub.send(msg)
+        except Exception:  # noqa: BLE001 — a dead subscriber must not break the pub path
+            self.remove(sub.id)
+
+
+def _tx_json_with_hash(tx: SerializedTransaction) -> dict:
+    j = tx.obj.to_json()
+    j["hash"] = tx.txid().hex().upper()
+    return j

@@ -75,6 +75,10 @@ class Ledger:
         self.closed = False
         self.accepted = False
         self.validated = False
+        # per-account highest open-ledger tx sequence (O(1) seq prediction
+        # for Transactor::checkSeq; maintained by the engine via
+        # note_open_tx)
+        self.open_tx_seqs: dict[bytes, int] = {}
         # fee schedule (reference: Ledger::updateFees)
         self.base_fee = DEFAULT_BASE_FEE
         self.reference_fee_units = DEFAULT_REFERENCE_FEE_UNITS
@@ -190,6 +194,12 @@ class Ledger:
         self.tx_map.set_item(SHAMapItem(txid, tx_blob), TNType.TX_NM)
         return txid, True
 
+    def note_open_tx(self, account: bytes, sequence: int) -> None:
+        """Record an accepted open-ledger tx for O(1) sequence prediction."""
+        cur = self.open_tx_seqs.get(account)
+        if cur is None or sequence > cur:
+            self.open_tx_seqs[account] = sequence
+
     def add_transaction(self, tx_blob: bytes, metadata: bytes) -> bytes:
         """Insert a tx + its metadata into the tx map (reference:
         Ledger::addTransaction w/ metadata — item data is
@@ -200,6 +210,19 @@ class Ledger:
         s.add_vl(metadata)
         self.tx_map.set_item(SHAMapItem(txid, s.data()), TNType.TX_MD)
         return txid
+
+    def tx_entries(self):
+        """Yield (txid, tx_blob, meta_blob) for every tx in this ledger —
+        the one place that knows the TX_MD item layout VL(tx) || VL(meta)
+        (open-ledger TX_NM items yield meta b\"\")."""
+        from ..protocol.serializer import BinaryParser
+
+        for leaf in self.tx_map.leaves():
+            blob, meta = leaf.item.data, b""
+            if leaf.type == TNType.TX_MD:
+                p = BinaryParser(blob)
+                blob, meta = p.read_vl(), p.read_vl()
+            yield leaf.item.tag, blob, meta
 
     def get_transaction(self, txid: bytes) -> Optional[tuple[bytes, bytes]]:
         """-> (tx_blob, metadata) or None. Open-ledger items (raw blob, no
@@ -279,6 +302,7 @@ class Ledger:
         led.closed = self.closed
         led.accepted = self.accepted
         led.validated = self.validated
+        led.open_tx_seqs = dict(self.open_tx_seqs)
         led.base_fee = self.base_fee
         led.reference_fee_units = self.reference_fee_units
         led.reserve_base = self.reserve_base
@@ -327,10 +351,12 @@ class Ledger:
         close_resolution = p.read8()
         close_flags = p.read8()
 
+        fetched: set[bytes] = set()
+
         def fetch(h: bytes) -> Optional[bytes]:
             o = db.fetch(h)
             if o is not None:
-                db.flushed.add(h)  # node verifiably present in this store
+                fetched.add(h)
             return o.data if o else None
 
         kw = {"hash_batch": hash_batch} if hash_batch else {}
@@ -354,4 +380,7 @@ class Ledger:
                 f"ledger hash mismatch after load: want {ledger_hash.hex()} "
                 f"got {led.hash().hex()}"
             )
+        # only after the full tree verified do the fetched nodes count as
+        # known-good in this store (a corrupt node must stay rewritable)
+        db.flushed.update(fetched)
         return led

@@ -1,0 +1,374 @@
+"""Node runtime tests: standalone open/close loop, held txns, RPC
+handlers in-process — the shape of the reference's JS integration tests
+(test/send-test.js, test/account_tx-test.js) without the sockets.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from stellard_tpu.node import Config, Node
+from stellard_tpu.node.jobqueue import JobQueue, JobType
+from stellard_tpu.protocol.formats import TxType
+from stellard_tpu.protocol.keys import KeyPair, encode_account_id
+from stellard_tpu.protocol.sfields import (
+    sfAmount,
+    sfBalance,
+    sfDestination,
+    sfLimitAmount,
+    sfSequence,
+)
+from stellard_tpu.protocol.stamount import STAmount, currency_from_iso
+from stellard_tpu.protocol.sttx import SerializedTransaction
+from stellard_tpu.protocol.ter import TER
+from stellard_tpu.rpc.handlers import Context, Role, dispatch
+
+XRP = 1_000_000  # drops per unit
+
+
+@pytest.fixture()
+def node():
+    n = Node(Config()).setup()
+    yield n
+    n.stop()
+
+
+def payment(key: KeyPair, seq: int, dest: bytes, drops: int,
+            fee: int = 10) -> SerializedTransaction:
+    tx = SerializedTransaction.build(
+        TxType.ttPAYMENT, key.account_id, seq, fee,
+        {sfAmount: STAmount.from_drops(drops), sfDestination: dest},
+    )
+    tx.sign(key)
+    return tx
+
+
+def fund(node: Node, dest: KeyPair, drops: int = 1000 * XRP):
+    from stellard_tpu.rpc.txsign import predicted_sequence
+
+    master = node.master_keys
+    led = node.ledger_master.current_ledger()
+    seq = predicted_sequence(
+        led, master.account_id,
+        led.account_root(master.account_id)[sfSequence],
+    )
+    ter, _ = node.submit(payment(master, seq, dest.account_id, drops))
+    assert ter == TER.tesSUCCESS, ter
+
+
+class TestStandaloneClose:
+    def test_payment_and_close(self, node):
+        alice = KeyPair.from_passphrase("alice")
+        fund(node, alice)
+        node.close_ledger()
+        led = node.ledger_master.current_ledger()
+        assert led.account_root(alice.account_id)[sfBalance].drops() == 1000 * XRP
+
+    def test_chain_of_closes(self, node):
+        alice = KeyPair.from_passphrase("alice")
+        bob = KeyPair.from_passphrase("bob")
+        fund(node, alice)
+        fund(node, bob)  # above-reserve funding; below-reserve can't create
+        node.close_ledger()
+        for i in range(3):
+            tx = payment(alice, i + 1, bob.account_id, 10 * XRP)
+            ter, _ = node.submit(tx)
+            assert ter == TER.tesSUCCESS
+            node.close_ledger()
+        led = node.ledger_master.current_ledger()
+        assert (
+            led.account_root(bob.account_id)[sfBalance].drops()
+            == 1030 * XRP
+        )
+        # chain integrity: each close advanced seq by 1 and linked hashes
+        lm = node.ledger_master
+        assert lm.closed_ledger().seq == 5
+        l4 = lm.get_ledger_by_seq(4)
+        assert lm.closed_ledger().parent_hash == l4.hash()
+
+    def test_held_future_seq_applies_after_close(self, node):
+        alice = KeyPair.from_passphrase("alice")
+        bob = KeyPair.from_passphrase("bob")
+        fund(node, alice)
+        fund(node, bob)
+        node.close_ledger()
+        # seq 2 before seq 1: held
+        tx2 = payment(alice, 2, bob.account_id, 5 * XRP)
+        ter, applied = node.submit(tx2)
+        assert ter == TER.terPRE_SEQ and not applied
+        tx1 = payment(alice, 1, bob.account_id, 5 * XRP)
+        ter, applied = node.submit(tx1)
+        assert ter == TER.tesSUCCESS
+        node.close_ledger()  # applies tx1, re-applies held tx2 to next open
+        node.close_ledger()  # commits tx2
+        led = node.ledger_master.current_ledger()
+        assert (
+            led.account_root(bob.account_id)[sfBalance].drops()
+            == 1010 * XRP
+        )
+
+    def test_bad_signature_rejected(self, node):
+        alice = KeyPair.from_passphrase("alice")
+        tx = payment(node.master_keys, 1, alice.account_id, XRP)
+        from stellard_tpu.protocol.sfields import sfTxnSignature
+
+        sig = bytearray(tx.obj[sfTxnSignature])
+        sig[5] ^= 0xFF
+        tx.obj[sfTxnSignature] = bytes(sig)
+        tx.set_sig_verdict(None) if False else None
+        tx._sig_good = None
+        ter, applied = node.submit(tx)
+        assert ter == TER.temINVALID and not applied
+
+    def test_async_submit_batches(self, node):
+        """submit_transaction routes through the VerifyPlane coalescer."""
+        alice = KeyPair.from_passphrase("alice")
+        fund(node, alice)
+        node.close_ledger()
+        results = []
+        import threading
+
+        done = threading.Event()
+        bob = KeyPair.from_passphrase("bob")
+        n = 20
+        for i in range(n):
+            def cb(tx, ter, applied, _res=results):
+                _res.append(ter)
+                if len(_res) == n:
+                    done.set()
+
+            node.ops.submit_transaction(
+                payment(alice, i + 1, bob.account_id, XRP), cb
+            )
+        assert done.wait(timeout=30)
+        assert all(t == TER.tesSUCCESS for t in results)
+        assert node.verify_plane.verified >= n
+
+
+class TestPersistence:
+    def test_closed_ledger_saved_and_loadable(self, node):
+        from stellard_tpu.state.ledger import Ledger
+
+        alice = KeyPair.from_passphrase("alice")
+        fund(node, alice)
+        closed, _ = node.close_ledger()
+        loaded = Ledger.load(node.nodestore, closed.hash())
+        assert loaded.hash() == closed.hash()
+        assert loaded.account_root(alice.account_id)[sfBalance].drops() == 1000 * XRP
+
+    def test_tx_history_indexed(self, node):
+        alice = KeyPair.from_passphrase("alice")
+        fund(node, alice)
+        node.close_ledger()
+        rows = node.txdb.account_transactions(alice.account_id)
+        assert len(rows) == 1
+        assert rows[0]["status"] == "tesSUCCESS"
+        hdr = node.txdb.get_ledger_header(seq=2)
+        assert hdr is not None and hdr["seq"] == 2
+
+
+class TestJobQueue:
+    def test_priority_order(self):
+        jq = JobQueue(threads=0)
+        ran = []
+        jq.add_job(JobType.jtCLIENT, "low", lambda: ran.append("low"))
+        jq.add_job(JobType.jtACCEPT, "high", lambda: ran.append("high"))
+        jq.set_thread_count(1)
+        assert jq.drain()
+        jq.stop()
+        assert ran == ["high", "low"]
+
+    def test_concurrency_limit(self):
+        import threading
+        import time
+
+        jq = JobQueue(threads=4)
+        active = []
+        peak = []
+        lock = threading.Lock()
+
+        def work():
+            with lock:
+                active.append(1)
+                peak.append(len(active))
+            time.sleep(0.05)
+            with lock:
+                active.pop()
+
+        for _ in range(6):
+            jq.add_job(JobType.jtLEDGER_DATA, "limited", work)  # limit 2
+        assert jq.drain()
+        jq.stop()
+        assert max(peak) <= 2
+
+
+class TestRpcHandlers:
+    def call(self, node, method, **params):
+        return dispatch(Context(node=node, params=params), method)
+
+    def test_server_info(self, node):
+        r = self.call(node, "server_info")
+        assert r["info"]["server_state"] == "full"
+        assert r["info"]["complete_ledgers"] == "1"
+
+    def test_wallet_propose_roundtrip(self, node):
+        r = self.call(node, "wallet_propose", passphrase="alice")
+        alice = KeyPair.from_passphrase("alice")
+        assert r["account_id"] == alice.human_account_id
+        assert r["master_seed"] == alice.human_seed
+
+    def test_account_info_and_not_found(self, node):
+        master = node.master_keys
+        r = self.call(node, "account_info", account=master.human_account_id)
+        assert r["account_data"]["Sequence"] == 1
+        ghost = KeyPair.from_passphrase("ghost")
+        r = self.call(node, "account_info", account=ghost.human_account_id)
+        assert r["error"] == "actNotFound"
+
+    def test_submit_tx_json_and_close(self, node):
+        alice = KeyPair.from_passphrase("alice")
+        r = self.call(
+            node, "submit",
+            secret="masterpassphrase",
+            tx_json={
+                "TransactionType": "Payment",
+                "Account": node.master_keys.human_account_id,
+                "Destination": alice.human_account_id,
+                "Amount": str(500 * XRP),
+            },
+        )
+        assert r["engine_result"] == "tesSUCCESS", r
+        self.call(node, "ledger_accept")
+        r = self.call(node, "account_info", account=alice.human_account_id)
+        assert r["account_data"]["Balance"] == str(500 * XRP)
+
+    def test_submit_tx_blob(self, node):
+        alice = KeyPair.from_passphrase("alice")
+        tx = payment(node.master_keys, 1, alice.account_id, 100 * XRP)
+        r = self.call(node, "submit", tx_blob=tx.serialize().hex())
+        assert r["engine_result"] == "tesSUCCESS"
+
+    def test_sign_only_does_not_apply(self, node):
+        alice = KeyPair.from_passphrase("alice")
+        r = self.call(
+            node, "sign",
+            secret="masterpassphrase",
+            tx_json={
+                "TransactionType": "Payment",
+                "Account": node.master_keys.human_account_id,
+                "Destination": alice.human_account_id,
+                "Amount": "1000000",
+            },
+        )
+        assert "tx_blob" in r
+        assert (
+            self.call(node, "account_info", account=alice.human_account_id)[
+                "error"
+            ]
+            == "actNotFound"
+        )
+
+    def test_ledger_handlers(self, node):
+        alice = KeyPair.from_passphrase("alice")
+        fund(node, alice)
+        node.close_ledger()
+        r = self.call(node, "ledger_closed")
+        assert r["ledger_index"] == 2
+        r = self.call(node, "ledger", ledger_index="closed", transactions=True)
+        assert len(r["ledger"]["transactions"]) == 1
+        r = self.call(node, "ledger", ledger_index=2, transactions=True,
+                      expand=True)
+        assert r["ledger"]["transactions"][0]["TransactionType"] == 0
+        r = self.call(node, "ledger_current")
+        assert r["ledger_current_index"] == 3
+
+    def test_tx_and_account_tx(self, node):
+        alice = KeyPair.from_passphrase("alice")
+        tx = payment(node.master_keys, 1, alice.account_id, 1000 * XRP)
+        node.submit(tx)
+        node.close_ledger()
+        r = self.call(node, "tx", transaction=tx.txid().hex())
+        assert r["ledger_index"] == 2 and "meta" in r
+        r = self.call(node, "account_tx", account=alice.human_account_id)
+        assert len(r["transactions"]) == 1
+        assert r["transactions"][0]["tx"]["hash"] == tx.txid().hex().upper()
+
+    def test_account_lines(self, node):
+        alice = KeyPair.from_passphrase("alice")
+        gw = KeyPair.from_passphrase("gateway")
+        fund(node, alice)
+        fund(node, gw)
+        node.close_ledger()
+        trust = SerializedTransaction.build(
+            TxType.ttTRUST_SET, alice.account_id, 1, 10,
+            {sfLimitAmount: STAmount.from_iou(
+                currency_from_iso("USD"), gw.account_id, 100, 0
+            )},
+        )
+        trust.sign(alice)
+        ter, _ = node.submit(trust)
+        assert ter == TER.tesSUCCESS
+        node.close_ledger()
+        r = self.call(node, "account_lines", account=alice.human_account_id)
+        assert len(r["lines"]) == 1
+        line = r["lines"][0]
+        assert line["account"] == gw.human_account_id
+        assert line["currency"] == "USD"
+        assert line["limit"] == "100"
+
+    def test_ledger_entry(self, node):
+        r = self.call(
+            node, "ledger_entry",
+            account_root=node.master_keys.human_account_id,
+        )
+        assert r["node"]["Account"] == node.master_keys.human_account_id
+
+    def test_unknown_method(self, node):
+        assert self.call(node, "bogus")["error"] == "unknownCmd"
+
+    def test_get_counts(self, node):
+        r = self.call(node, "get_counts")
+        assert "verify_plane" in r
+
+
+class TestSubscriptions:
+    def test_ledger_and_tx_streams(self, node):
+        from stellard_tpu.rpc.infosub import InfoSub, SubscriptionManager
+
+        subs = SubscriptionManager(node.ops)
+        got = []
+        sub = InfoSub(got.append)
+        result = subs.subscribe_streams(sub, ["ledger", "transactions"])
+        assert result["ledger_index"] == 1
+        alice = KeyPair.from_passphrase("alice")
+        fund(node, alice)
+        node.close_ledger()
+        types = [m["type"] for m in got]
+        assert "ledgerClosed" in types and "transaction" in types
+        txmsg = next(m for m in got if m["type"] == "transaction")
+        assert txmsg["engine_result"] == "tesSUCCESS"
+        assert txmsg["validated"] is True
+
+    def test_account_subscription(self, node):
+        from stellard_tpu.rpc.infosub import InfoSub, SubscriptionManager
+
+        subs = SubscriptionManager(node.ops)
+        got = []
+        sub = InfoSub(got.append)
+        alice = KeyPair.from_passphrase("alice")
+        subs.subscribe_accounts(sub, [alice.account_id])
+        bob = KeyPair.from_passphrase("bob")
+        fund(node, bob)  # not alice — no message for this one
+        node.close_ledger()
+        fund(node, alice)
+        node.close_ledger()
+        touched = [
+            m for m in got
+            if m["type"] == "transaction"
+            and m["transaction"]["Destination"] == alice.human_account_id
+        ]
+        assert len(touched) == 1
+        assert not any(
+            m["transaction"].get("Destination") == bob.human_account_id
+            for m in got if m["type"] == "transaction"
+        )

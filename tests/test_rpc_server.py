@@ -1,0 +1,199 @@
+"""Over-the-wire API tests: HTTP JSON-RPC + WebSocket doors.
+
+The shape of the reference's JS tests (test/jsonrpc-test.js,
+test/websocket-test.js): spin a standalone node with real sockets,
+drive it via the client API, assert on responses and streams.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import socket
+import struct
+import urllib.request
+
+import pytest
+
+from stellard_tpu.node import Config, Node
+from stellard_tpu.protocol.keys import KeyPair
+
+
+@pytest.fixture(scope="module")
+def node():
+    cfg = Config()
+    cfg.rpc_port = 0  # ephemeral
+    cfg.websocket_port = 0
+    n = Node(cfg).setup().serve()
+    yield n
+    n.stop()
+
+
+def rpc(node: Node, method: str, **params) -> dict:
+    url = f"http://127.0.0.1:{node.http_server.port}/"
+    body = json.dumps({"method": method, "params": [params]}).encode()
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"}
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.load(resp)["result"]
+
+
+class WsClient:
+    """Minimal RFC 6455 client for tests."""
+
+    def __init__(self, port: int):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        key = base64.b64encode(os.urandom(16)).decode()
+        self.sock.sendall(
+            (
+                f"GET / HTTP/1.1\r\nHost: localhost\r\nUpgrade: websocket\r\n"
+                f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+                f"Sec-WebSocket-Version: 13\r\n\r\n"
+            ).encode()
+        )
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            buf += self.sock.recv(4096)
+        assert b"101" in buf.split(b"\r\n")[0]
+        accept = base64.b64encode(
+            hashlib.sha1(
+                (key + "258EAFA5-E914-47DA-95CA-C5AB0DC85B11").encode()
+            ).digest()
+        ).decode()
+        assert accept.encode() in buf
+
+    def send(self, obj: dict) -> None:
+        payload = json.dumps(obj).encode()
+        mask = os.urandom(4)
+        head = bytes([0x81])
+        n = len(payload)
+        if n < 126:
+            head += bytes([0x80 | n])
+        elif n < 65536:
+            head += bytes([0x80 | 126]) + struct.pack(">H", n)
+        else:
+            head += bytes([0x80 | 127]) + struct.pack(">Q", n)
+        masked = bytes(b ^ mask[i & 3] for i, b in enumerate(payload))
+        self.sock.sendall(head + mask + masked)
+
+    def _read_exact(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = self.sock.recv(n - len(out))
+            if not chunk:
+                raise ConnectionError("closed")
+            out += chunk
+        return out
+
+    def recv(self) -> dict:
+        b1, b2 = self._read_exact(2)
+        n = b2 & 0x7F
+        if n == 126:
+            (n,) = struct.unpack(">H", self._read_exact(2))
+        elif n == 127:
+            (n,) = struct.unpack(">Q", self._read_exact(8))
+        payload = self._read_exact(n)
+        opcode = b1 & 0x0F
+        if opcode == 0x9:  # ping → pong, keep reading
+            return self.recv()
+        return json.loads(payload)
+
+    def call(self, command: str, **params) -> dict:
+        params["command"] = command
+        params.setdefault("id", 1)
+        self.send(params)
+        while True:
+            msg = self.recv()
+            if msg.get("type") == "response":
+                return msg
+
+    def close(self):
+        self.sock.close()
+
+
+class TestHttpDoor:
+    def test_server_info(self, node):
+        r = rpc(node, "server_info")
+        assert r["status"] == "success"
+        assert r["info"]["server_state"] == "full"
+
+    def test_submit_and_close_flow(self, node):
+        alice = KeyPair.from_passphrase("http-alice")
+        r = rpc(
+            node, "submit",
+            secret="masterpassphrase",
+            tx_json={
+                "TransactionType": "Payment",
+                "Account": node.master_keys.human_account_id,
+                "Destination": alice.human_account_id,
+                "Amount": "1000000000",
+            },
+        )
+        assert r["engine_result"] == "tesSUCCESS", r
+        r = rpc(node, "ledger_accept")
+        assert r["status"] == "success"
+        r = rpc(node, "account_info", account=alice.human_account_id)
+        assert r["account_data"]["Balance"] == "1000000000"
+
+    def test_error_shape(self, node):
+        r = rpc(node, "account_info", account="garbage")
+        assert r["status"] == "error"
+        assert r["error"] == "actMalformed"
+
+    def test_unknown_method(self, node):
+        r = rpc(node, "definitely_not_a_method")
+        assert r["error"] == "unknownCmd"
+
+
+class TestWsDoor:
+    def test_command_response(self, node):
+        ws = WsClient(node.ws_server.port)
+        try:
+            resp = ws.call("ledger_current")
+            assert resp["status"] == "success"
+            assert "ledger_current_index" in resp["result"]
+        finally:
+            ws.close()
+
+    def test_subscribe_stream_delivery(self, node):
+        ws = WsClient(node.ws_server.port)
+        try:
+            resp = ws.call("subscribe", streams=["ledger", "transactions"])
+            assert resp["status"] == "success"
+            assert "ledger_index" in resp["result"]
+
+            bob = KeyPair.from_passphrase("ws-bob")
+            r = rpc(
+                node, "submit",
+                secret="masterpassphrase",
+                tx_json={
+                    "TransactionType": "Payment",
+                    "Account": node.master_keys.human_account_id,
+                    "Destination": bob.human_account_id,
+                    "Amount": "500000000",
+                },
+            )
+            assert r["engine_result"] == "tesSUCCESS"
+            rpc(node, "ledger_accept")
+
+            got_types = set()
+            ws.sock.settimeout(10)
+            while not {"ledgerClosed", "transaction"} <= got_types:
+                msg = ws.recv()
+                if "type" in msg:
+                    got_types.add(msg["type"])
+            assert {"ledgerClosed", "transaction"} <= got_types
+        finally:
+            ws.close()
+
+    def test_wallet_propose_over_ws(self, node):
+        ws = WsClient(node.ws_server.port)
+        try:
+            resp = ws.call("wallet_propose", passphrase="ws-carol")
+            kp = KeyPair.from_passphrase("ws-carol")
+            assert resp["result"]["account_id"] == kp.human_account_id
+        finally:
+            ws.close()
