@@ -1,0 +1,366 @@
+"""LedgerConsensus: one consensus round, driven by a periodic timer.
+
+Reference: src/ripple_app/consensus/LedgerConsensus.cpp — states
+(:36-47), timerEntry (:589), statePreClose (:637), stateEstablish
+(:713), closeLedger/takeInitialPosition (:1761-1813), peerPosition,
+updateOurPositions, accept (:931-1127).
+
+TPU shape: the round's signature work — every peer proposal and every
+round of validations — is handed to the VerifyPlane as whole batches
+(`verify_many`), one device program per burst, instead of the
+reference's one-job-per-signature libsodium calls. Tx-set hashing rides
+the same level-batched BatchHasher as the ledger SHAMaps.
+
+The round talks to the outside world only through a `ConsensusAdapter`,
+so the deterministic in-process simnet (overlay.simnet) and the real
+TCP overlay drive identical logic.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from enum import IntEnum
+from typing import Callable, Optional
+
+from ..node.ledgermaster import LedgerMaster
+from ..protocol.keys import KeyPair
+from ..state.ledger import Ledger
+from .disputed import DisputedTx
+from .proposal import LedgerProposal
+from .timing import (
+    AV_CT_CONSENSUS_PCT,
+    LEDGER_IDLE_INTERVAL,
+    LEDGER_MIN_CONSENSUS_MS,
+    have_consensus,
+    next_close_resolution,
+    should_close,
+)
+from .txset import TxSet
+from .validation import STValidation
+from .validations import ValidationsStore
+
+__all__ = ["LedgerConsensus", "ConsensusAdapter", "ConsensusState"]
+
+
+class ConsensusState(IntEnum):
+    """reference: LedgerConsensus.cpp:36-47"""
+
+    PRE_CLOSE = 0  # open ledger accumulating txns
+    ESTABLISH = 1  # we closed; exchanging positions
+    FINISHED = 2  # consensus reached; accept scheduled
+    ACCEPTED = 3  # new LCL built and validated
+
+
+class ConsensusAdapter:
+    """Round I/O seam. The simnet and the TCP overlay both implement
+    this; LedgerConsensus never touches a socket."""
+
+    def propose(self, proposal: LedgerProposal) -> None:
+        raise NotImplementedError
+
+    def share_tx_set(self, txset: TxSet) -> None:
+        raise NotImplementedError
+
+    def acquire_tx_set(self, set_hash: bytes) -> Optional[TxSet]:
+        """Return the set if already known; else start acquisition and
+        deliver later via LedgerConsensus.have_tx_set."""
+        raise NotImplementedError
+
+    def send_validation(self, val: STValidation) -> None:
+        raise NotImplementedError
+
+    def on_accepted(self, ledger: Ledger, round_ms: int) -> None:
+        """New LCL built; the node should start the next round."""
+
+
+class LedgerConsensus:
+    def __init__(
+        self,
+        prev_ledger: Ledger,
+        ledger_master: LedgerMaster,
+        adapter: ConsensusAdapter,
+        validations: ValidationsStore,
+        key: KeyPair,
+        unl: set[bytes],
+        network_time: Callable[[], int],
+        clock: Callable[[], float] = _time.monotonic,
+        prev_proposers: int = 0,
+        prev_round_ms: int = LEDGER_MIN_CONSENSUS_MS,
+        proposing: bool = True,
+        hash_batch: Optional[Callable] = None,
+        idle_interval: int = LEDGER_IDLE_INTERVAL,
+    ):
+        self.lm = ledger_master
+        self.adapter = adapter
+        self.validations = validations
+        self.key = key
+        self.unl = unl  # trusted node public keys (not including us)
+        self.network_time = network_time
+        self.clock = clock
+        self.proposing = proposing
+        self.hash_batch = hash_batch
+        self.idle_interval = idle_interval
+
+        self.prev_ledger = prev_ledger
+        self.prev_hash = prev_ledger.hash()
+        self.seq = prev_ledger.seq + 1
+        self.prev_proposers = prev_proposers
+        self.prev_round_ms = max(prev_round_ms, LEDGER_MIN_CONSENSUS_MS)
+
+        # close-time resolution for the ledger being built (reference:
+        # getNextLedgerTimeResolution; close_flags bit 0 = no agreement)
+        self.resolution = next_close_resolution(
+            prev_ledger.close_resolution,
+            (prev_ledger.close_flags & 1) == 0,
+            self.seq,
+        )
+
+        self.state = ConsensusState.PRE_CLOSE
+        self.round_start = self.clock()
+        self.consensus_start: Optional[float] = None
+
+        self.peer_positions: dict[bytes, LedgerProposal] = {}
+        self.acquired: dict[bytes, TxSet] = {}
+        self.disputes: dict[bytes, DisputedTx] = {}
+        self.compared: set[bytes] = set()  # set hashes diffed vs ours
+        self.our_position: Optional[LedgerProposal] = None
+        self.our_set: Optional[TxSet] = None
+        self.our_close_time = 0
+        self.round_ms = 0  # set on accept
+
+    # -- timer ------------------------------------------------------------
+
+    def timer_entry(self) -> None:
+        """reference: LedgerConsensus::timerEntry (:589)"""
+        if self.state == ConsensusState.PRE_CLOSE:
+            self._state_pre_close()
+        elif self.state == ConsensusState.ESTABLISH:
+            self._state_establish()
+
+    def _ms_since(self, t0: Optional[float]) -> int:
+        return int((self.clock() - (t0 if t0 is not None else 0)) * 1000)
+
+    # -- PRE_CLOSE --------------------------------------------------------
+
+    def _state_pre_close(self) -> None:
+        open_ledger = self.lm.current_ledger()
+        any_tx = any(True for _ in open_ledger.tx_entries())
+        proposers_closed = len(self.peer_positions)
+        open_ms = self._ms_since(self.round_start)
+        if should_close(
+            any_tx,
+            max(self.prev_proposers, proposers_closed + 1),
+            proposers_closed,
+            open_ms,  # since our round began == since prev close
+            open_ms,
+            self.idle_interval,
+        ):
+            self.close_ledger()
+
+    def close_ledger(self) -> None:
+        """Take our initial position (reference: closeLedger +
+        takeInitialPosition :1761-1813)."""
+        open_ledger = self.lm.current_ledger()
+        self.our_set = TxSet(self.hash_batch)
+        for txid, blob, _meta in open_ledger.tx_entries():
+            self.our_set.add(txid, blob)
+        self.our_close_time = Ledger.round_close_time(
+            self.network_time(), self.resolution
+        )
+        self.our_position = LedgerProposal(
+            self.prev_hash, 0, self.our_set.hash(), self.our_close_time
+        )
+        if self.proposing:
+            self.our_position.sign(self.key)
+            self.adapter.propose(self.our_position)
+        self.adapter.share_tx_set(self.our_set)
+        self.acquired[self.our_set.hash()] = self.our_set
+        self.state = ConsensusState.ESTABLISH
+        self.consensus_start = self.clock()
+        # fold in positions that arrived before we closed
+        for prop in list(self.peer_positions.values()):
+            ts = self.acquired.get(prop.tx_set_hash)
+            if ts is None:
+                ts = self.adapter.acquire_tx_set(prop.tx_set_hash)
+                if ts is not None:
+                    self.acquired[prop.tx_set_hash] = ts
+            if ts is not None:
+                self._compare_set(ts)
+
+    # -- peer input -------------------------------------------------------
+
+    def peer_proposal(self, prop: LedgerProposal) -> bool:
+        """A signature-checked proposal from a trusted peer. Returns True
+        if it changed our view (and should be relayed)."""
+        if prop.prev_ledger != self.prev_hash:
+            return False  # different LCL — not our round
+        peer = prop.node_public
+        if peer not in self.unl or peer == self.key.public:
+            return False
+        if prop.is_bowout():
+            self.peer_positions.pop(peer, None)
+            for d in self.disputes.values():
+                d.unvote(peer)
+            return True
+        prev = self.peer_positions.get(peer)
+        if prev is not None and prev.propose_seq >= prop.propose_seq:
+            return False  # stale
+        self.peer_positions[peer] = prop
+        ts = self.acquired.get(prop.tx_set_hash)
+        if ts is None:
+            ts = self.adapter.acquire_tx_set(prop.tx_set_hash)
+            if ts is not None:
+                self.have_tx_set(prop.tx_set_hash, ts)
+        if ts is not None:
+            self._update_peer_votes(peer, ts)
+        return True
+
+    def have_tx_set(self, set_hash: bytes, txset: TxSet) -> None:
+        """An acquired peer tx set arrived (reference: mapComplete)."""
+        self.acquired[set_hash] = txset
+        if self.our_set is not None:
+            self._compare_set(txset)
+
+    def _compare_set(self, txset: TxSet) -> None:
+        h = txset.hash()
+        if h in self.compared or self.our_set is None:
+            return
+        self.compared.add(h)
+        # new disputes from the symmetric difference with our set
+        # (reference: createDisputes via SHAMap::compare)
+        for txid in self.our_set.differences(txset):
+            if txid not in self.disputes:
+                blob = self.our_set.get(txid) or txset.get(txid) or b""
+                self.disputes[txid] = DisputedTx(
+                    txid, blob, our_vote=txid in self.our_set
+                )
+        # (re)vote every peer whose position references a known set
+        for peer, prop in self.peer_positions.items():
+            ts = self.acquired.get(prop.tx_set_hash)
+            if ts is not None:
+                self._update_peer_votes(peer, ts)
+
+    def _update_peer_votes(self, peer: bytes, txset: TxSet) -> None:
+        for d in self.disputes.values():
+            d.set_vote(peer, d.txid in txset)
+
+    # -- ESTABLISH --------------------------------------------------------
+
+    def _time_pct(self) -> int:
+        return (self._ms_since(self.consensus_start) * 100) // self.prev_round_ms
+
+    def _effective_close_time(self) -> tuple[int, bool]:
+        """Close-time consensus: the most-voted rounded close time among
+        current proposers (incl. us); agreement requires
+        AV_CT_CONSENSUS_PCT percent (reference: updateOurPositions
+        close-time buckets)."""
+        votes: dict[int, int] = {self.our_close_time: 1}
+        for prop in self.peer_positions.values():
+            ct = Ledger.round_close_time(prop.close_time, self.resolution)
+            votes[ct] = votes.get(ct, 0) + 1
+        total = 1 + len(self.peer_positions)
+        best_ct, best_n = max(votes.items(), key=lambda kv: (kv[1], kv[0]))
+        if best_n * 100 >= AV_CT_CONSENSUS_PCT * total:
+            return best_ct, True
+        return self.our_close_time, False
+
+    def _state_establish(self) -> None:
+        """reference: stateEstablish (:713) → updateOurPositions +
+        haveConsensus check."""
+        if self._ms_since(self.consensus_start) < LEDGER_MIN_CONSENSUS_MS:
+            # participation window: collect positions before judging
+            self._update_our_position()
+            return
+        self._update_our_position()
+        ct, ct_agree = self._effective_close_time()
+        agree = 0
+        our_hash = self.our_position.tx_set_hash
+        for prop in self.peer_positions.values():
+            if prop.tx_set_hash == our_hash:
+                agree += 1
+        target = max(self.prev_proposers, len(self.peer_positions) + 1)
+        if have_consensus(target, len(self.peer_positions), agree):
+            self.state = ConsensusState.FINISHED
+            self.accept(ct, ct_agree)
+
+    def _update_our_position(self) -> None:
+        """Avalanche vote switching; on any change, advance and re-propose
+        (reference: updateOurPositions)."""
+        if self.our_set is None:
+            return
+        time_pct = self._time_pct()
+        changed = False
+        for d in self.disputes.values():
+            if d.update_vote(time_pct, self.proposing):
+                changed = True
+        ct, _agree = self._effective_close_time()
+        if ct != self.our_close_time:
+            self.our_close_time = ct
+            changed = True
+        if changed:
+            new_set = self.our_set.copy()
+            for d in self.disputes.values():
+                if d.our_vote and d.txid not in new_set and d.blob:
+                    new_set.add(d.txid, d.blob)
+                elif not d.our_vote and d.txid in new_set:
+                    new_set.remove(d.txid)
+            self.our_set = new_set
+            self.acquired[new_set.hash()] = new_set
+            self.our_position = self.our_position.advanced(
+                new_set.hash(), self.our_close_time
+            )
+            if self.proposing:
+                self.our_position.sign(self.key)
+                self.adapter.propose(self.our_position)
+            self.adapter.share_tx_set(new_set)
+            self._compare_set(new_set)
+
+    # -- accept -----------------------------------------------------------
+
+    def accept(self, close_time: int, ct_agree: bool) -> None:
+        """Build the new LCL from the agreed set, sign and broadcast our
+        validation (reference: accept :931-1127)."""
+        consensus_set = self.acquired.get(
+            self.our_position.tx_set_hash if self.our_position else b"",
+            self.our_set,
+        )
+        txs = consensus_set.transactions() if consensus_set else []
+        new_lcl, _results = self.lm.close_with_txset(
+            txs, close_time, self.resolution, correct_close_time=ct_agree
+        )
+        self.round_ms = self._ms_since(self.consensus_start)
+
+        if self.proposing:
+            val = STValidation.build(
+                ledger_hash=new_lcl.hash(),
+                signing_time=self.network_time(),
+                full=True,
+                ledger_seq=new_lcl.seq,
+            )
+            val.sign(self.key)
+            # count our own validation toward quorum (reference: accept
+            # stores its own validation before broadcasting :1023-1045)
+            self.validations.add(val)
+            self.adapter.send_validation(val)
+        self.lm.check_accept(
+            new_lcl.hash(), self.validations.trusted_count_for(new_lcl.hash())
+        )
+        self.state = ConsensusState.ACCEPTED
+        self.adapter.on_accepted(new_lcl, self.round_ms)
+
+    # -- introspection ----------------------------------------------------
+
+    def get_json(self) -> dict:
+        return {
+            "state": self.state.name,
+            "ledger_seq": self.seq,
+            "prev_ledger": self.prev_hash.hex(),
+            "proposers": len(self.peer_positions),
+            "disputes": len(self.disputes),
+            "our_position": (
+                self.our_position.tx_set_hash.hex()
+                if self.our_position
+                else None
+            ),
+            "close_resolution": self.resolution,
+        }

@@ -195,6 +195,72 @@ class LedgerMaster:
                     self.add_held_transaction(tx)
             return new_lcl, results
 
+    def close_with_txset(
+        self,
+        txs: list[SerializedTransaction],
+        close_time: int,
+        close_resolution: int,
+        correct_close_time: bool = True,
+    ) -> tuple[Ledger, dict[bytes, TER]]:
+        """Consensus-accept path (reference: LedgerConsensus::accept,
+        :931-1127): close the chain with the *agreed* tx set — which may
+        differ from our open ledger's — then re-apply to the new open
+        ledger anything we had locally that didn't make the consensus set
+        (reference: reapply of local/disputed txns :1050-1127)."""
+        with self._lock:
+            prev = self.closed_ledger()
+            open_ledger = self.current_ledger()
+
+            txset = CanonicalTXSet(prev.hash())
+            for tx in txs:
+                txset.insert(tx)
+
+            new_lcl = prev.open_successor()
+            results = self._apply_transactions(new_lcl, txset)
+
+            new_lcl.close(close_time, close_resolution, correct_close_time)
+            new_lcl.accepted = True
+            self._push_closed(new_lcl)
+            self.current = new_lcl.open_successor()
+
+            # re-apply: our open-ledger txns that missed consensus, then held
+            engine = TransactionEngine(self.current)
+            consensus_ids = {tx.txid() for tx in txs}
+            leftovers = [
+                SerializedTransaction.from_bytes(blob)
+                for txid, blob, _meta in open_ledger.tx_entries()
+                if txid not in consensus_ids
+            ] + self.take_held_transactions()
+            for tx in leftovers:
+                ter, _ = engine.apply_transaction(
+                    tx, TxParams.OPEN_LEDGER | TxParams.RETRY
+                )
+                if ter == TER.terPRE_SEQ:
+                    self.add_held_transaction(tx)
+            return new_lcl, results
+
+    def set_validated(self, ledger: Ledger) -> None:
+        """A quorum of trusted validations arrived for this ledger
+        (reference: LedgerMaster::checkAccept tail, :705-750)."""
+        with self._lock:
+            if self.validated is not None and ledger.seq <= self.validated.seq:
+                return
+            self.validated = ledger
+        if self.on_validated:
+            self.on_validated(ledger)
+
+    def check_accept(self, ledger_hash: bytes, trusted_count: int) -> bool:
+        """Quorum test for a closed ledger we know about (reference:
+        checkAccept) — promotes it to validated when `trusted_count`
+        meets `min_validations`."""
+        if trusted_count < max(self.min_validations, 1):
+            return False
+        ledger = self.get_ledger_by_hash(ledger_hash)
+        if ledger is None:
+            return False
+        self.set_validated(ledger)
+        return True
+
     def _apply_transactions(self, ledger: Ledger, txset: CanonicalTXSet) -> dict[bytes, TER]:
         """reference: LedgerConsensus::applyTransactions — passes over the
         canonical set, retrying ter* failures (which may succeed once an

@@ -1,0 +1,251 @@
+"""Consensus plane unit tests: timing rules, disputed-tx avalanche,
+validation/proposal signing, tx sets, validations store quorum."""
+
+import hashlib
+
+import pytest
+
+from stellard_tpu.consensus import (
+    DisputedTx,
+    LedgerProposal,
+    STValidation,
+    TxSet,
+    ValidationsStore,
+    have_consensus,
+    next_close_resolution,
+    should_close,
+)
+from stellard_tpu.consensus.timing import (
+    LEDGER_VAL_INTERVAL,
+    avalanche_threshold,
+)
+from stellard_tpu.protocol.keys import KeyPair
+
+
+def kp(n: int) -> KeyPair:
+    return KeyPair.from_seed(hashlib.sha256(bytes([n]) * 4).digest())
+
+
+H = lambda n: hashlib.sha256(bytes([n])).digest()
+
+
+# -- timing ---------------------------------------------------------------
+
+
+class TestShouldClose:
+    def test_minimum_open_window(self):
+        # even with txns, never close inside LEDGER_MIN_CLOSE
+        assert not should_close(True, 4, 0, 1000, 1000)
+
+    def test_tx_after_window_closes(self):
+        assert should_close(True, 4, 0, 3000, 3000)
+
+    def test_idle_waits_for_interval(self):
+        assert not should_close(False, 4, 0, 9000, 9000, idle_interval=15)
+        assert should_close(False, 4, 0, 15000, 15000, idle_interval=15)
+
+    def test_majority_closed_forces_close(self):
+        # 3 of 4 proposers already closed → follow even inside min window
+        assert should_close(False, 4, 3, 500, 500)
+
+
+class TestHaveConsensus:
+    def test_requires_enough_proposers(self):
+        assert not have_consensus(4, 2, 2)
+
+    def test_eighty_pct_locks(self):
+        # 3 peers + us, all agree: (3*100+100)/4 = 100
+        assert have_consensus(4, 3, 3)
+        # 3 peers, only 2 agree: (200+100)/4 = 75 < 80
+        assert not have_consensus(4, 3, 2)
+
+    def test_single_node_network(self):
+        assert have_consensus(1, 0, 0)
+
+
+class TestCloseResolution:
+    def test_agree_tightens_on_stride(self):
+        assert next_close_resolution(30, True, 8) == 20
+        assert next_close_resolution(30, True, 7) == 30
+
+    def test_disagree_loosens_every_seq(self):
+        assert next_close_resolution(30, False, 5) == 60
+
+    def test_clamped_at_ends(self):
+        assert next_close_resolution(10, True, 8) == 10
+        assert next_close_resolution(120, False, 3) == 120
+
+    def test_avalanche_ladder(self):
+        assert avalanche_threshold(0) == 50
+        assert avalanche_threshold(50) == 65
+        assert avalanche_threshold(85) == 70
+        assert avalanche_threshold(200) == 95
+
+
+# -- disputed tx ----------------------------------------------------------
+
+
+class TestDisputedTx:
+    def test_holds_yes_with_majority(self):
+        d = DisputedTx(H(1), b"blob", our_vote=True)
+        for i in range(3):
+            d.set_vote(H(10 + i), True)
+        d.set_vote(H(20), False)
+        assert not d.update_vote(10, proposing=True)
+        assert d.our_vote
+
+    def test_flips_no_when_outvoted(self):
+        d = DisputedTx(H(1), b"blob", our_vote=True)
+        for i in range(4):
+            d.set_vote(H(10 + i), False)
+        # weight = 100/5 = 20 < 50
+        assert d.update_vote(10, proposing=True)
+        assert not d.our_vote
+
+    def test_escalating_threshold_flips_marginal_yes(self):
+        # 60% yes passes at the start (>50) but fails late (>70)
+        d = DisputedTx(H(1), b"blob", our_vote=True)
+        for i in range(6):
+            d.set_vote(H(10 + i), True)
+        for i in range(4):
+            d.set_vote(H(30 + i), False)
+        assert not d.update_vote(10, proposing=True)  # 63% > 50
+        assert d.update_vote(90, proposing=True)  # 63% < 70 → flip
+        assert not d.our_vote
+
+    def test_observer_adopts_majority(self):
+        d = DisputedTx(H(1), b"", our_vote=False)
+        d.set_vote(H(2), True)
+        assert d.update_vote(0, proposing=False)
+        assert d.our_vote
+
+
+# -- proposal / validation signing ---------------------------------------
+
+
+class TestLedgerProposal:
+    def test_sign_verify_roundtrip(self):
+        p = LedgerProposal(H(1), 0, H(2), 1234)
+        p.sign(kp(1))
+        assert p.check_sign()
+
+    def test_tamper_detected(self):
+        p = LedgerProposal(H(1), 0, H(2), 1234)
+        p.sign(kp(1))
+        q = LedgerProposal(H(1), 0, H(3), 1234, p.node_public, p.signature)
+        assert not q.check_sign()
+
+    def test_advanced_increments_seq(self):
+        p = LedgerProposal(H(1), 0, H(2), 30)
+        q = p.advanced(H(3), 60)
+        assert q.propose_seq == 1 and q.tx_set_hash == H(3)
+        assert p.bowout().is_bowout()
+
+
+class TestSTValidation:
+    def test_sign_verify_roundtrip(self):
+        v = STValidation.build(H(5), signing_time=999, ledger_seq=7)
+        v.sign(kp(2))
+        assert v.is_valid()
+        assert v.ledger_hash == H(5)
+        assert v.ledger_seq == 7
+        assert v.is_full
+
+    def test_wire_roundtrip(self):
+        v = STValidation.build(H(5), signing_time=999, ledger_seq=7)
+        v.sign(kp(2))
+        w = STValidation.from_bytes(v.serialize())
+        assert w.is_valid()
+        assert w.signer == kp(2).public
+        assert w.signing_hash() == v.signing_hash()
+
+    def test_bad_sig_rejected(self):
+        v = STValidation.build(H(5), signing_time=999)
+        v.sign(kp(2))
+        v.obj[__import__("stellard_tpu.protocol.sfields", fromlist=["sfSigningTime"]).sfSigningTime] = 1000
+        assert not STValidation.from_bytes(v.serialize()).is_valid()
+
+
+# -- tx set ---------------------------------------------------------------
+
+
+class TestTxSet:
+    def test_hash_is_order_independent(self):
+        a, b = TxSet(), TxSet()
+        items = [(H(i), b"tx%d" % i) for i in range(8)]
+        for t, blob in items:
+            a.add(t, blob)
+        for t, blob in reversed(items):
+            b.add(t, blob)
+        assert a.hash() == b.hash()
+
+    def test_differences(self):
+        a, b = TxSet(), TxSet()
+        for i in range(4):
+            a.add(H(i), b"x")
+        for i in range(2, 6):
+            b.add(H(i), b"x")
+        assert a.differences(b) == {H(0), H(1), H(4), H(5)}
+
+    def test_copy_and_remove(self):
+        a = TxSet()
+        a.add(H(1), b"x")
+        c = a.copy()
+        c.remove(H(1))
+        assert H(1) in a and H(1) not in c and a.hash() != c.hash()
+
+
+# -- validations store ----------------------------------------------------
+
+
+class TestValidationsStore:
+    def _store(self, trusted: set, now: list):
+        return ValidationsStore(lambda pk: pk in trusted, lambda: now[0])
+
+    def test_quorum_counts_trusted_only(self):
+        keys = [kp(i) for i in range(4)]
+        trusted = {k.public for k in keys[:3]}
+        now = [10_000]
+        store = self._store(trusted, now)
+        for k in keys:
+            v = STValidation.build(H(9), signing_time=now[0], ledger_seq=3)
+            v.sign(k)
+            store.add(v)
+        assert store.trusted_count_for(H(9)) == 3
+        assert len(store.validations_for(H(9))) == 4
+
+    def test_stale_validations_expire_from_current(self):
+        k = kp(1)
+        now = [10_000]
+        store = self._store({k.public}, now)
+        v = STValidation.build(H(9), signing_time=now[0])
+        v.sign(k)
+        assert store.add(v)
+        assert len(store.current_trusted()) == 1
+        now[0] += LEDGER_VAL_INTERVAL + 1
+        assert store.current_trusted() == []
+
+    def test_ledger_weights_election(self):
+        keys = [kp(i) for i in range(4)]
+        now = [10_000]
+        store = self._store({k.public for k in keys}, now)
+        for i, k in enumerate(keys):
+            h = H(1) if i < 3 else H(2)
+            v = STValidation.build(h, signing_time=now[0])
+            v.sign(k)
+            store.add(v)
+        w = store.current_ledger_weights()
+        assert w[H(1)] == 3 and w[H(2)] == 1
+
+    def test_newer_validation_replaces_current(self):
+        k = kp(1)
+        now = [10_000]
+        store = self._store({k.public}, now)
+        v1 = STValidation.build(H(1), signing_time=now[0])
+        v1.sign(k)
+        store.add(v1)
+        now[0] += 5
+        v2 = STValidation.build(H(2), signing_time=now[0])
+        v2.sign(k)
+        store.add(v2)
+        assert store.current_ledger_weights() == {H(2): 1}
