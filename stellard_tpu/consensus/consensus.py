@@ -35,6 +35,11 @@ from .timing import (
     next_close_resolution,
     should_close,
 )
+
+# keep our proposal fresh / drop stale peer positions, in seconds
+# (reference: PROPOSE_INTERVAL / PROPOSE_FRESHNESS, LedgerTiming.h:64-67)
+PROPOSE_INTERVAL = 12
+PROPOSE_FRESHNESS = 20
 from .txset import TxSet
 from .validation import STValidation
 from .validations import ValidationsStore
@@ -68,6 +73,10 @@ class ConsensusAdapter:
 
     def send_validation(self, val: STValidation) -> None:
         raise NotImplementedError
+
+    def relay_disputed_tx(self, blob: bytes) -> None:
+        """Flood a disputed tx so peers missing it can include it next
+        round (reference: DisputedTx creation relays TMTransaction)."""
 
     def on_accepted(self, ledger: Ledger, round_ms: int) -> None:
         """New LCL built; the node should start the next round."""
@@ -120,11 +129,18 @@ class LedgerConsensus:
         self.consensus_start: Optional[float] = None
 
         self.peer_positions: dict[bytes, LedgerProposal] = {}
+        self.position_times: dict[bytes, float] = {}  # peer -> recv clock
+        # highest propose_seq ever seen per peer — survives bow-outs and
+        # staleness prunes so a replayed old proposal can't re-register a
+        # departed proposer
+        self.max_seen_seq: dict[bytes, int] = {}
+        self.last_propose: Optional[float] = None
         self.acquired: dict[bytes, TxSet] = {}
         self.disputes: dict[bytes, DisputedTx] = {}
         self.compared: set[bytes] = set()  # set hashes diffed vs ours
         self.our_position: Optional[LedgerProposal] = None
         self.our_set: Optional[TxSet] = None
+        self._pre_close_open_ids: set[bytes] = set()
         self.our_close_time = 0
         self.round_ms = 0  # set on accept
 
@@ -164,6 +180,9 @@ class LedgerConsensus:
         self.our_set = TxSet(self.hash_batch)
         for txid, blob, _meta in open_ledger.tx_entries():
             self.our_set.add(txid, blob)
+        # remembered for accept(): these are re-applied (when left out) by
+        # close_with_txset, so the dispute-reapply loop must skip them
+        self._pre_close_open_ids = self.our_set.txids()
         self.our_close_time = Ledger.round_close_time(
             self.network_time(), self.resolution
         )
@@ -177,6 +196,7 @@ class LedgerConsensus:
         self.acquired[self.our_set.hash()] = self.our_set
         self.state = ConsensusState.ESTABLISH
         self.consensus_start = self.clock()
+        self.last_propose = self.clock()
         # fold in positions that arrived before we closed
         for prop in list(self.peer_positions.values()):
             ts = self.acquired.get(prop.tx_set_hash)
@@ -199,13 +219,15 @@ class LedgerConsensus:
             return False
         if prop.is_bowout():
             self.peer_positions.pop(peer, None)
+            self.max_seen_seq[peer] = prop.propose_seq  # nothing tops this
             for d in self.disputes.values():
                 d.unvote(peer)
             return True
-        prev = self.peer_positions.get(peer)
-        if prev is not None and prev.propose_seq >= prop.propose_seq:
-            return False  # stale
+        if prop.propose_seq <= self.max_seen_seq.get(peer, -1):
+            return False  # stale or replayed
+        self.max_seen_seq[peer] = prop.propose_seq
         self.peer_positions[peer] = prop
+        self.position_times[peer] = self.clock()
         ts = self.acquired.get(prop.tx_set_hash)
         if ts is None:
             ts = self.adapter.acquire_tx_set(prop.tx_set_hash)
@@ -234,6 +256,8 @@ class LedgerConsensus:
                 self.disputes[txid] = DisputedTx(
                     txid, blob, our_vote=txid in self.our_set
                 )
+                if blob:
+                    self.adapter.relay_disputed_tx(blob)
         # (re)vote every peer whose position references a known set
         for peer, prop in self.peer_positions.items():
             ts = self.acquired.get(prop.tx_set_hash)
@@ -268,10 +292,10 @@ class LedgerConsensus:
         """reference: stateEstablish (:713) → updateOurPositions +
         haveConsensus check."""
         if self._ms_since(self.consensus_start) < LEDGER_MIN_CONSENSUS_MS:
-            # participation window: collect positions before judging
-            self._update_our_position()
-            return
+            return  # participation window: collect positions before judging
+        self._prune_stale_positions()
         self._update_our_position()
+        self._keep_proposal_fresh()
         ct, ct_agree = self._effective_close_time()
         agree = 0
         our_hash = self.our_position.tx_set_hash
@@ -282,6 +306,42 @@ class LedgerConsensus:
         if have_consensus(target, len(self.peer_positions), agree):
             self.state = ConsensusState.FINISHED
             self.accept(ct, ct_agree)
+
+    def _prune_stale_positions(self) -> None:
+        """Drop peer positions older than PROPOSE_FRESHNESS so a vanished
+        (partitioned/crashed) proposer stops counting toward agreement
+        (reference: peerPosition staleness via PROPOSE_FRESHNESS)."""
+        now = self.clock()
+        for peer in [
+            p
+            for p, t in self.position_times.items()
+            if now - t > PROPOSE_FRESHNESS
+        ]:
+            self.peer_positions.pop(peer, None)
+            self.position_times.pop(peer, None)
+            for d in self.disputes.values():
+                d.unvote(peer)
+
+    def _keep_proposal_fresh(self) -> None:
+        """Re-broadcast (with a bumped position number) every
+        PROPOSE_INTERVAL so late-joining or re-connected peers learn our
+        position — without this a healed partition can never rejoin a
+        stuck round (reference: PROPOSE_INTERVAL forced re-propose)."""
+        if not self.proposing or self.our_position is None:
+            return
+        if (
+            self.last_propose is not None
+            and self.clock() - self.last_propose < PROPOSE_INTERVAL
+        ):
+            return
+        self.our_position = self.our_position.advanced(
+            self.our_position.tx_set_hash, self.our_close_time
+        )
+        self.our_position.sign(self.key)
+        self.adapter.propose(self.our_position)
+        if self.our_set is not None:
+            self.adapter.share_tx_set(self.our_set)
+        self.last_propose = self.clock()
 
     def _update_our_position(self) -> None:
         """Avalanche vote switching; on any change, advance and re-propose
@@ -312,6 +372,7 @@ class LedgerConsensus:
             if self.proposing:
                 self.our_position.sign(self.key)
                 self.adapter.propose(self.our_position)
+                self.last_propose = self.clock()
             self.adapter.share_tx_set(new_set)
             self._compare_set(new_set)
 
@@ -329,6 +390,28 @@ class LedgerConsensus:
             txs, close_time, self.resolution, correct_close_time=ct_agree
         )
         self.round_ms = self._ms_since(self.consensus_start)
+
+        # disputed txns that lost get another shot in the new open ledger
+        # (reference: accept reapply :1050-1127). Skip those that were in
+        # our own open ledger — close_with_txset already re-applied them —
+        # and never skip signature checking: dispute blobs can come from a
+        # peer's tx set, which is only root-hash-verified in transit.
+        from ..engine.engine import TxParams
+        from ..protocol.sttx import SerializedTransaction
+        from ..protocol.ter import TER
+
+        skip = {tx.txid() for tx in txs} | self._pre_close_open_ids
+        for d in self.disputes.values():
+            if d.txid not in skip and d.blob:
+                tx = SerializedTransaction.from_bytes(d.blob)
+                ok, _why = tx.passes_local_checks()
+                if not ok or not tx.check_sign():
+                    continue
+                ter, _ = self.lm.do_transaction(
+                    tx, TxParams.OPEN_LEDGER | TxParams.RETRY
+                )
+                if ter == TER.terPRE_SEQ:
+                    self.lm.add_held_transaction(tx)
 
         if self.proposing:
             val = STValidation.build(

@@ -1,0 +1,221 @@
+"""ValidatorNode: the consensus-facing orchestration of one validator —
+round lifecycle, peer message handling, and quorum acceptance.
+
+Reference: this is the slice of NetworkOPs that owns consensus
+(tryStartConsensus/beginConsensus, NetworkOPs.cpp:741-975; recvValidation
+:1668; processTrustedProposal) plus LedgerMaster::checkAccept. It is
+transport-agnostic: the deterministic simnet (overlay.simnet) and the
+TCP overlay both drive it through the same entry points, mirroring how
+the reference tests consensus through testoverlay without sockets.
+
+TPU shape: bursts of peer validations/proposals are signature-checked
+through the VerifyPlane as one device batch per timer tick rather than
+one libsodium call per message.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Callable, Optional
+
+from ..consensus.consensus import ConsensusAdapter, LedgerConsensus
+from ..consensus.proposal import LedgerProposal
+from ..consensus.timing import LEDGER_IDLE_INTERVAL, LEDGER_MIN_CONSENSUS_MS
+from ..consensus.txset import TxSet
+from ..consensus.validation import STValidation
+from ..consensus.validations import ValidationsStore
+from ..engine.engine import TxParams
+from ..protocol.keys import KeyPair
+from ..protocol.sttx import SerializedTransaction
+from ..protocol.ter import TER
+from ..state.ledger import Ledger
+from .hashrouter import SF_BAD, SF_SIGGOOD, HashRouter
+from .ledgermaster import LedgerMaster
+
+__all__ = ["ValidatorNode"]
+
+
+class ValidatorNode:
+    def __init__(
+        self,
+        key: KeyPair,
+        unl: set[bytes],
+        adapter: ConsensusAdapter,
+        quorum: int,
+        network_time: Callable[[], int],
+        clock: Callable[[], float] = _time.monotonic,
+        hash_batch: Optional[Callable] = None,
+        verify_many: Optional[Callable] = None,
+        proposing: bool = True,
+        idle_interval: int = LEDGER_IDLE_INTERVAL,
+    ):
+        self.key = key
+        self.unl = set(unl) | {key.public}  # we trust ourselves
+        self.adapter = adapter
+        self.network_time = network_time
+        self.clock = clock
+        self.hash_batch = hash_batch
+        self.verify_many = verify_many  # VerifyPlane.verify_many or None
+        self.proposing = proposing
+        self.idle_interval = idle_interval
+
+        self.lm = LedgerMaster(hash_batch=hash_batch)
+        self.lm.min_validations = quorum
+        self.validations = ValidationsStore(
+            is_trusted=lambda pk: pk in self.unl, now=network_time
+        )
+        self.router = HashRouter()
+        self.round: Optional[LedgerConsensus] = None
+        self.prev_proposers = 0
+        self.prev_round_ms = LEDGER_MIN_CONSENSUS_MS
+        self.rounds_completed = 0
+        # peer tx sets seen this round (simnet share / TMHaveTransactionSet)
+        self.txset_cache: dict[bytes, TxSet] = {}
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self, root_account_id: bytes, close_time: int = 0) -> None:
+        self.lm.start_new_ledger(root_account_id, close_time)
+        self.begin_round()
+
+    def begin_round(self) -> None:
+        """reference: NetworkOPs::beginConsensus → make_LedgerConsensus"""
+        self.txset_cache.clear()
+        self.round = LedgerConsensus(
+            prev_ledger=self.lm.closed_ledger(),
+            ledger_master=self.lm,
+            adapter=self.adapter,
+            validations=self.validations,
+            key=self.key,
+            unl=self.unl,
+            network_time=self.network_time,
+            clock=self.clock,
+            prev_proposers=self.prev_proposers,
+            prev_round_ms=self.prev_round_ms,
+            proposing=self.proposing,
+            hash_batch=self.hash_batch,
+            idle_interval=self.idle_interval,
+        )
+
+    def on_timer(self) -> None:
+        """Heartbeat → consensus timer (reference:
+        processHeartbeatTimer → timerEntry)."""
+        if self.round is not None:
+            self.round.timer_entry()
+
+    def round_accepted(self, ledger: Ledger, round_ms: int) -> None:
+        """Adapter callback after accept(): record stats and start the
+        next round (reference: endConsensus → NetworkOPs::endConsensus)."""
+        self.prev_proposers = (
+            len(self.round.peer_positions) + 1 if self.round else 1
+        )
+        self.prev_round_ms = max(round_ms, LEDGER_MIN_CONSENSUS_MS)
+        self.rounds_completed += 1
+        self.begin_round()
+
+    # -- transaction submission ------------------------------------------
+
+    def submit(self, tx: SerializedTransaction) -> tuple[TER, bool]:
+        txid = tx.txid()
+        flags = self.router.get_flags(txid)
+        if flags & SF_BAD:
+            return TER.temINVALID, False
+        if not (flags & SF_SIGGOOD):
+            ok, _ = tx.passes_local_checks()
+            if not ok or not tx.check_sign():
+                self.router.set_flag(txid, SF_BAD)
+                return TER.temINVALID, False
+            self.router.set_flag(txid, SF_SIGGOOD)
+        tx.set_sig_verdict(True)
+        ter, applied = self.lm.do_transaction(
+            tx, TxParams.OPEN_LEDGER | TxParams.RETRY
+        )
+        if ter == TER.terPRE_SEQ:
+            self.lm.add_held_transaction(tx)
+        return ter, applied
+
+    # -- peer message handlers -------------------------------------------
+
+    def handle_tx(self, tx: SerializedTransaction) -> bool:
+        """Relayed network tx (reference: PeerImp::checkTransaction).
+        Returns True when it should be re-relayed."""
+        ter, _ = self.submit(tx)
+        return int(ter) == 0 or -99 <= int(ter) < 0
+
+    def handle_proposal(self, prop: LedgerProposal) -> bool:
+        """reference: PeerImp::checkPropose → peerPosition. Signature is
+        verified once per suppression id, then routed to the round."""
+        pid = prop.suppression_id()
+        flags = self.router.get_flags(pid)
+        if flags & SF_BAD:
+            return False
+        if not (flags & SF_SIGGOOD):
+            if not self._verify([prop]):
+                self.router.set_flag(pid, SF_BAD)
+                return False
+            self.router.set_flag(pid, SF_SIGGOOD)
+        prop.set_sig_verdict(True)
+        if self.round is None:
+            return False
+        return self.round.peer_proposal(prop)
+
+    def handle_validation(self, val: STValidation) -> bool:
+        """reference: PeerImp::checkValidation → recvValidation →
+        Validations::addValidation → LedgerMaster::checkAccept."""
+        vid = val.validation_id()
+        flags = self.router.get_flags(vid)
+        if flags & SF_BAD:
+            return False
+        if not (flags & SF_SIGGOOD):
+            if not self._verify([val]):
+                self.router.set_flag(vid, SF_BAD)
+                return False
+            self.router.set_flag(vid, SF_SIGGOOD)
+        val.set_sig_verdict(True)
+        current = self.validations.add(val)
+        self.lm.check_accept(
+            val.ledger_hash,
+            self.validations.trusted_count_for(val.ledger_hash),
+        )
+        return current
+
+    def handle_txset(self, txset: TxSet) -> None:
+        """A shared/acquired candidate set arrived
+        (reference: TMHaveTransactionSet/TransactionAcquire completion)."""
+        h = txset.hash()
+        self.txset_cache[h] = txset
+        if self.round is not None:
+            self.round.have_tx_set(h, txset)
+
+    def _verify(self, objs) -> bool:
+        """Verify a burst of signed consensus objects (proposals or
+        validations); batched on the VerifyPlane when available. Returns
+        True only when every signature in the burst is good."""
+        if self.verify_many is not None:
+            from ..crypto.backend import VerifyRequest
+
+            reqs = [
+                VerifyRequest(
+                    public=getattr(o, "node_public", None) or o.signer,
+                    signing_hash=o.signing_hash(),
+                    signature=o.signature,
+                )
+                for o in objs
+            ]
+            return bool(all(self.verify_many(reqs)))
+        ok = True
+        for o in objs:
+            good = o.is_valid() if hasattr(o, "is_valid") else o.check_sign()
+            ok = ok and good
+        return ok
+
+    # -- introspection ----------------------------------------------------
+
+    def consensus_info(self) -> dict:
+        info = {
+            "rounds_completed": self.rounds_completed,
+            "validation_count": self.validations.size(),
+        }
+        if self.round is not None:
+            info["round"] = self.round.get_json()
+        return info

@@ -1,0 +1,24 @@
+"""Overlay plane: the distributed communication backend.
+
+Reference: src/ripple_overlay (peer sessions, flooding),
+src/ripple/proto/ripple.proto (wire schema), src/ripple/testoverlay
+(deterministic in-process network for consensus tests).
+
+Two transports drive identical node logic (node.validator.ValidatorNode):
+
+- `simnet` — deterministic discrete-time in-process network, the unit-test
+  substrate (reference: testoverlay; SURVEY §4.2);
+- `tcp` — length-prefixed frames over real sockets for the 4-validator
+  private net on DCN (reference: PeerImp framing).
+"""
+
+from .simnet import SimNet, SimValidator
+from .wire import MessageType, decode_message, encode_message
+
+__all__ = [
+    "MessageType",
+    "SimNet",
+    "SimValidator",
+    "decode_message",
+    "encode_message",
+]

@@ -1,0 +1,238 @@
+"""SimNet: a deterministic, discrete-time, in-process validator network.
+
+Reference: src/ripple/testoverlay (templated in-memory P2P net with
+discrete time steps; SURVEY §4.2) and the peerfinder sim — the way the
+reference tests multi-node behavior without sockets. Messages travel as
+real wire frames (overlay.wire), so the codec and the consensus logic are
+exercised together; only the transport is simulated.
+
+Topology is a full mesh by default; links can be cut (partitions) and
+given per-step latency. Time advances only via `step()`, so every run is
+bit-for-bit reproducible.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Callable, Optional
+
+from ..consensus.consensus import ConsensusAdapter
+from ..consensus.txset import TxSet
+from ..consensus.validation import STValidation
+from ..node.validator import ValidatorNode
+from ..protocol.keys import KeyPair
+from ..protocol.sttx import SerializedTransaction
+from ..state.ledger import Ledger
+from .wire import (
+    FrameReader,
+    ProposeSet,
+    TxMessage,
+    TxSetData,
+    ValidationMessage,
+    frame,
+)
+
+__all__ = ["SimNet", "SimValidator"]
+
+# network-epoch start time for simulations (seconds since 2000)
+SIM_START_NTIME = 10_000_000
+
+
+class SimValidator(ConsensusAdapter):
+    """One simulated validator: a real ValidatorNode wired to the SimNet
+    through the ConsensusAdapter seam."""
+
+    def __init__(
+        self,
+        net: "SimNet",
+        nid: int,
+        key: KeyPair,
+        unl: set[bytes],
+        quorum: int,
+        idle_interval: int,
+        proposing: bool = True,
+    ):
+        self.net = net
+        self.nid = nid
+        self.reader = FrameReader()
+        self.node = ValidatorNode(
+            key=key,
+            unl=unl,
+            adapter=self,
+            quorum=quorum,
+            network_time=net.network_time,
+            clock=net.clock,
+            idle_interval=idle_interval,
+            proposing=proposing,
+        )
+
+    # -- ConsensusAdapter -------------------------------------------------
+
+    def propose(self, proposal) -> None:
+        self.net.broadcast(self.nid, frame(ProposeSet.from_proposal(proposal)))
+
+    def share_tx_set(self, txset: TxSet) -> None:
+        blobs = [blob for _txid, blob in txset.blobs()]
+        self.net.broadcast(self.nid, frame(TxSetData(txset.hash(), blobs)))
+
+    def acquire_tx_set(self, set_hash: bytes) -> Optional[TxSet]:
+        return self.node.txset_cache.get(set_hash)
+
+    def send_validation(self, val: STValidation) -> None:
+        self.net.broadcast(self.nid, frame(ValidationMessage(val.serialize())))
+
+    def relay_disputed_tx(self, blob: bytes) -> None:
+        self.net.broadcast(self.nid, frame(TxMessage(blob)))
+
+    def on_accepted(self, ledger: Ledger, round_ms: int) -> None:
+        self.net.on_ledger_accepted(self.nid, ledger)
+        self.node.round_accepted(ledger, round_ms)
+
+    # -- client side ------------------------------------------------------
+
+    def submit_client_tx(self, tx: SerializedTransaction) -> None:
+        """Client submission: apply locally, flood to peers
+        (reference: NetworkOPs::processTransaction relay tail)."""
+        self.node.submit(tx)
+        self.net.broadcast(self.nid, frame(TxMessage(tx.serialize())))
+
+    # -- delivery ---------------------------------------------------------
+
+    def deliver(self, data: bytes) -> None:
+        for msg in self.reader.feed(data):
+            self._dispatch(msg)
+
+    def _dispatch(self, msg) -> None:
+        node = self.node
+        if isinstance(msg, TxMessage):
+            tx = SerializedTransaction.from_bytes(msg.blob)
+            node.handle_tx(tx)
+        elif isinstance(msg, ProposeSet):
+            node.handle_proposal(msg.to_proposal())
+        elif isinstance(msg, ValidationMessage):
+            node.handle_validation(STValidation.from_bytes(msg.blob))
+        elif isinstance(msg, TxSetData):
+            ts = TxSet(node.hash_batch)
+            for blob in msg.tx_blobs:
+                tx = SerializedTransaction.from_bytes(blob)
+                ts.add(tx.txid(), blob)
+            if ts.hash() == msg.set_hash:  # integrity: recomputed root
+                node.handle_txset(ts)
+
+
+class SimNet:
+    def __init__(
+        self,
+        n_validators: int = 4,
+        quorum: Optional[int] = None,
+        latency_steps: int = 1,
+        step_ms: int = 1000,
+        idle_interval: int = 4,
+        genesis_account: Optional[bytes] = None,
+    ):
+        self.step_ms = step_ms
+        self.latency_ms = latency_steps * step_ms
+        self.time_ms = 0
+        self._seq = itertools.count()
+        # (deliver_at_ms, seq, dst, bytes)
+        self._queue: list = []
+        self._links_down: set[tuple[int, int]] = set()
+        self.accept_log: list[tuple[int, int, bytes]] = []  # (nid, seq, hash)
+
+        self.keys = [
+            KeyPair.from_passphrase(f"sim-validator-{i}")
+            for i in range(n_validators)
+        ]
+        unl = {k.public for k in self.keys}
+        q = quorum if quorum is not None else (n_validators * 3 + 3) // 4
+        self.validators = [
+            SimValidator(self, i, self.keys[i], unl, q, idle_interval)
+            for i in range(n_validators)
+        ]
+        self.genesis_account = genesis_account
+
+    # -- clocks -----------------------------------------------------------
+
+    def clock(self) -> float:
+        return self.time_ms / 1000.0
+
+    def network_time(self) -> int:
+        return SIM_START_NTIME + self.time_ms // 1000
+
+    # -- topology ---------------------------------------------------------
+
+    def cut_link(self, a: int, b: int) -> None:
+        self._links_down.add((a, b))
+        self._links_down.add((b, a))
+
+    def heal_link(self, a: int, b: int) -> None:
+        self._links_down.discard((a, b))
+        self._links_down.discard((b, a))
+
+    def partition(self, group_a: set[int], group_b: set[int]) -> None:
+        for a in group_a:
+            for b in group_b:
+                self.cut_link(a, b)
+
+    # -- transport --------------------------------------------------------
+
+    def broadcast(self, src: int, data: bytes) -> None:
+        for dst in range(len(self.validators)):
+            if dst != src and (src, dst) not in self._links_down:
+                heapq.heappush(
+                    self._queue,
+                    (self.time_ms + self.latency_ms, next(self._seq), dst, data),
+                )
+
+    def on_ledger_accepted(self, nid: int, ledger: Ledger) -> None:
+        self.accept_log.append((nid, ledger.seq, ledger.hash()))
+
+    # -- simulation loop --------------------------------------------------
+
+    def start(self) -> None:
+        if self.genesis_account is None:
+            # the well-known test genesis account (node.MASTER_PASSPHRASE)
+            self.genesis_account = KeyPair.from_passphrase(
+                "masterpassphrase"
+            ).account_id
+        root = self.genesis_account
+        for v in self.validators:
+            v.node.start(root, close_time=self.network_time())
+
+    def step(self, n: int = 1) -> None:
+        for _ in range(n):
+            self.time_ms += self.step_ms
+            while self._queue and self._queue[0][0] <= self.time_ms:
+                _at, _seq, dst, data = heapq.heappop(self._queue)
+                self.validators[dst].deliver(data)
+            for v in self.validators:
+                v.node.on_timer()
+
+    def run_until(
+        self, pred: Callable[[], bool], max_steps: int = 200
+    ) -> bool:
+        for _ in range(max_steps):
+            if pred():
+                return True
+            self.step()
+        return pred()
+
+    # -- assertions helpers ----------------------------------------------
+
+    def validated_seqs(self) -> list[int]:
+        return [
+            v.node.lm.validated.seq if v.node.lm.validated else 0
+            for v in self.validators
+        ]
+
+    def validated_hashes_at(self, seq: int) -> set[bytes]:
+        out = set()
+        for v in self.validators:
+            h = v.node.lm.ledger_history.get(seq)
+            if h is not None:
+                out.add(h)
+        return out
+
+    def all_validated_at_least(self, seq: int) -> bool:
+        return all(s >= seq for s in self.validated_seqs())

@@ -1,0 +1,416 @@
+"""Wire schema for peer-to-peer messages.
+
+Reference: src/ripple/proto/ripple.proto (TM* messages over a 6-byte
+length+type header, framed in ripple_overlay/impl/Message.cpp). Same
+semantics, different encoding: rather than vendoring protobuf we reuse
+the protocol plane's canonical Serializer (VL fields), which the node
+already has hot paths for, under the same header layout:
+
+    4 bytes big-endian payload length | 2 bytes big-endian message type
+
+Payloads are field-lists; every field is a VL blob or fixed-width int,
+so the schema stays self-describing enough for version skew while
+avoiding a second serialization stack.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Optional
+
+from ..consensus.proposal import LedgerProposal
+from ..protocol.serializer import BinaryParser, Serializer
+
+__all__ = [
+    "MessageType",
+    "Hello",
+    "Ping",
+    "TxMessage",
+    "ProposeSet",
+    "ValidationMessage",
+    "HaveTxSet",
+    "GetTxSet",
+    "TxSetData",
+    "GetLedger",
+    "LedgerData",
+    "StatusChange",
+    "Endpoints",
+    "GetObjects",
+    "ObjectsData",
+    "encode_message",
+    "decode_message",
+    "frame",
+    "FrameReader",
+]
+
+HEADER_LEN = 6
+MAX_FRAME = 64 * 1024 * 1024
+
+
+class MessageType(IntEnum):
+    """Wire ids (role-parity with ripple.proto MessageType:3-39)."""
+
+    HELLO = 1
+    PING = 2
+    TRANSACTION = 10
+    PROPOSE_SET = 11
+    VALIDATION = 12
+    HAVE_TX_SET = 13
+    GET_TX_SET = 14
+    TX_SET_DATA = 15
+    GET_LEDGER = 20
+    LEDGER_DATA = 21
+    STATUS_CHANGE = 22
+    ENDPOINTS = 30
+    GET_OBJECTS = 40
+    OBJECTS_DATA = 41
+
+
+@dataclass
+class Hello:
+    """Session handshake: protocol version, our node key, a signature of
+    the session's shared fingerprint proving key ownership, and our
+    chain tip (reference: TMHello + PeerImp hello proof)."""
+
+    proto_version: int
+    net_time: int
+    node_public: bytes
+    session_sig: bytes
+    ledger_seq: int
+    closed_ledger: bytes
+
+
+@dataclass
+class Ping:
+    is_pong: bool
+    seq: int
+
+
+@dataclass
+class TxMessage:
+    blob: bytes  # serialized STTx
+
+
+@dataclass
+class ProposeSet:
+    propose_seq: int
+    close_time: int
+    prev_ledger: bytes
+    tx_set_hash: bytes
+    node_public: bytes
+    signature: bytes
+
+    @classmethod
+    def from_proposal(cls, p: LedgerProposal) -> "ProposeSet":
+        return cls(
+            p.propose_seq,
+            p.close_time,
+            p.prev_ledger,
+            p.tx_set_hash,
+            p.node_public,
+            p.signature,
+        )
+
+    def to_proposal(self) -> LedgerProposal:
+        return LedgerProposal(
+            self.prev_ledger,
+            self.propose_seq,
+            self.tx_set_hash,
+            self.close_time,
+            self.node_public,
+            self.signature,
+        )
+
+
+@dataclass
+class ValidationMessage:
+    blob: bytes  # serialized STValidation
+
+
+@dataclass
+class HaveTxSet:
+    set_hash: bytes
+
+
+@dataclass
+class GetTxSet:
+    set_hash: bytes
+
+
+@dataclass
+class TxSetData:
+    set_hash: bytes
+    tx_blobs: list = field(default_factory=list)
+
+
+@dataclass
+class GetLedger:
+    ledger_hash: bytes
+    ledger_seq: int  # 0 = by hash
+    what: int  # 0=base header, 1=tx tree, 2=state tree
+    node_ids: list = field(default_factory=list)  # wire node-id blobs
+
+
+@dataclass
+class LedgerData:
+    ledger_hash: bytes
+    ledger_seq: int
+    what: int
+    nodes: list = field(default_factory=list)  # (node_id, node_blob)
+
+
+@dataclass
+class StatusChange:
+    status: int  # OperatingMode value
+    ledger_seq: int
+    ledger_hash: bytes
+    network_time: int
+
+
+@dataclass
+class Endpoints:
+    endpoints: list = field(default_factory=list)  # (host, port, hops)
+
+
+@dataclass
+class GetObjects:
+    hashes: list = field(default_factory=list)
+
+
+@dataclass
+class ObjectsData:
+    objects: list = field(default_factory=list)  # (hash, blob)
+
+
+# -- encoding -------------------------------------------------------------
+
+
+def _enc_hello(s: Serializer, m: Hello):
+    s.add32(m.proto_version)
+    s.add32(m.net_time)
+    s.add_vl(m.node_public)
+    s.add_vl(m.session_sig)
+    s.add32(m.ledger_seq)
+    s.add_raw(m.closed_ledger)
+
+
+def _dec_hello(p: BinaryParser) -> Hello:
+    return Hello(
+        p.read32(), p.read32(), p.read_vl(), p.read_vl(), p.read32(), p.read(32)
+    )
+
+
+def _enc_ping(s: Serializer, m: Ping):
+    s.add8(1 if m.is_pong else 0)
+    s.add32(m.seq)
+
+
+def _dec_ping(p: BinaryParser) -> Ping:
+    return Ping(p.read8() == 1, p.read32())
+
+
+def _enc_tx(s: Serializer, m: TxMessage):
+    s.add_vl(m.blob)
+
+
+def _dec_tx(p: BinaryParser) -> TxMessage:
+    return TxMessage(p.read_vl())
+
+
+def _enc_propose(s: Serializer, m: ProposeSet):
+    s.add32(m.propose_seq)
+    s.add32(m.close_time)
+    s.add_raw(m.prev_ledger)
+    s.add_raw(m.tx_set_hash)
+    s.add_vl(m.node_public)
+    s.add_vl(m.signature)
+
+
+def _dec_propose(p: BinaryParser) -> ProposeSet:
+    return ProposeSet(
+        p.read32(), p.read32(), p.read(32), p.read(32), p.read_vl(), p.read_vl()
+    )
+
+
+def _enc_validation(s: Serializer, m: ValidationMessage):
+    s.add_vl(m.blob)
+
+
+def _dec_validation(p: BinaryParser) -> ValidationMessage:
+    return ValidationMessage(p.read_vl())
+
+
+def _enc_have_set(s: Serializer, m: HaveTxSet):
+    s.add_raw(m.set_hash)
+
+
+def _dec_have_set(p: BinaryParser) -> HaveTxSet:
+    return HaveTxSet(p.read(32))
+
+
+def _enc_get_set(s: Serializer, m: GetTxSet):
+    s.add_raw(m.set_hash)
+
+
+def _dec_get_set(p: BinaryParser) -> GetTxSet:
+    return GetTxSet(p.read(32))
+
+
+def _enc_set_data(s: Serializer, m: TxSetData):
+    s.add_raw(m.set_hash)
+    s.add32(len(m.tx_blobs))
+    for blob in m.tx_blobs:
+        s.add_vl(blob)
+
+
+def _dec_set_data(p: BinaryParser) -> TxSetData:
+    h = p.read(32)
+    n = p.read32()
+    return TxSetData(h, [p.read_vl() for _ in range(n)])
+
+
+def _enc_get_ledger(s: Serializer, m: GetLedger):
+    s.add_raw(m.ledger_hash)
+    s.add32(m.ledger_seq)
+    s.add8(m.what)
+    s.add32(len(m.node_ids))
+    for nid in m.node_ids:
+        s.add_vl(nid)
+
+
+def _dec_get_ledger(p: BinaryParser) -> GetLedger:
+    h = p.read(32)
+    seq = p.read32()
+    what = p.read8()
+    n = p.read32()
+    return GetLedger(h, seq, what, [p.read_vl() for _ in range(n)])
+
+
+def _enc_ledger_data(s: Serializer, m: LedgerData):
+    s.add_raw(m.ledger_hash)
+    s.add32(m.ledger_seq)
+    s.add8(m.what)
+    s.add32(len(m.nodes))
+    for nid, blob in m.nodes:
+        s.add_vl(nid)
+        s.add_vl(blob)
+
+
+def _dec_ledger_data(p: BinaryParser) -> LedgerData:
+    h = p.read(32)
+    seq = p.read32()
+    what = p.read8()
+    n = p.read32()
+    return LedgerData(h, seq, what, [(p.read_vl(), p.read_vl()) for _ in range(n)])
+
+
+def _enc_status(s: Serializer, m: StatusChange):
+    s.add8(m.status)
+    s.add32(m.ledger_seq)
+    s.add_raw(m.ledger_hash)
+    s.add32(m.network_time)
+
+
+def _dec_status(p: BinaryParser) -> StatusChange:
+    return StatusChange(p.read8(), p.read32(), p.read(32), p.read32())
+
+
+def _enc_endpoints(s: Serializer, m: Endpoints):
+    s.add32(len(m.endpoints))
+    for host, port, hops in m.endpoints:
+        s.add_vl(host.encode())
+        s.add16(port)
+        s.add8(hops)
+
+
+def _dec_endpoints(p: BinaryParser) -> Endpoints:
+    n = p.read32()
+    return Endpoints(
+        [(p.read_vl().decode(), p.read16(), p.read8()) for _ in range(n)]
+    )
+
+
+def _enc_get_objects(s: Serializer, m: GetObjects):
+    s.add32(len(m.hashes))
+    for h in m.hashes:
+        s.add_raw(h)
+
+
+def _dec_get_objects(p: BinaryParser) -> GetObjects:
+    return GetObjects([p.read(32) for _ in range(p.read32())])
+
+
+def _enc_objects_data(s: Serializer, m: ObjectsData):
+    s.add32(len(m.objects))
+    for h, blob in m.objects:
+        s.add_raw(h)
+        s.add_vl(blob)
+
+
+def _dec_objects_data(p: BinaryParser) -> ObjectsData:
+    return ObjectsData([(p.read(32), p.read_vl()) for _ in range(p.read32())])
+
+
+_CODECS = {
+    MessageType.HELLO: (Hello, _enc_hello, _dec_hello),
+    MessageType.PING: (Ping, _enc_ping, _dec_ping),
+    MessageType.TRANSACTION: (TxMessage, _enc_tx, _dec_tx),
+    MessageType.PROPOSE_SET: (ProposeSet, _enc_propose, _dec_propose),
+    MessageType.VALIDATION: (ValidationMessage, _enc_validation, _dec_validation),
+    MessageType.HAVE_TX_SET: (HaveTxSet, _enc_have_set, _dec_have_set),
+    MessageType.GET_TX_SET: (GetTxSet, _enc_get_set, _dec_get_set),
+    MessageType.TX_SET_DATA: (TxSetData, _enc_set_data, _dec_set_data),
+    MessageType.GET_LEDGER: (GetLedger, _enc_get_ledger, _dec_get_ledger),
+    MessageType.LEDGER_DATA: (LedgerData, _enc_ledger_data, _dec_ledger_data),
+    MessageType.STATUS_CHANGE: (StatusChange, _enc_status, _dec_status),
+    MessageType.ENDPOINTS: (Endpoints, _enc_endpoints, _dec_endpoints),
+    MessageType.GET_OBJECTS: (GetObjects, _enc_get_objects, _dec_get_objects),
+    MessageType.OBJECTS_DATA: (ObjectsData, _enc_objects_data, _dec_objects_data),
+}
+
+_TYPE_OF = {cls: mt for mt, (cls, _e, _d) in _CODECS.items()}
+
+
+def encode_message(msg) -> bytes:
+    """Payload bytes (no frame header)."""
+    mt = _TYPE_OF[type(msg)]
+    s = Serializer()
+    _CODECS[mt][1](s, msg)
+    return s.data()
+
+
+def decode_message(mt: int, payload: bytes):
+    cls, _enc, dec = _CODECS[MessageType(mt)]
+    return dec(BinaryParser(payload))
+
+
+def frame(msg) -> bytes:
+    """Full wire frame: 4-byte length + 2-byte type + payload
+    (reference: Message.cpp 6-byte header)."""
+    payload = encode_message(msg)
+    mt = _TYPE_OF[type(msg)]
+    return len(payload).to_bytes(4, "big") + int(mt).to_bytes(2, "big") + payload
+
+
+class FrameReader:
+    """Incremental frame decoder for a TCP byte stream."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> list:
+        """Append stream bytes; return completed messages."""
+        self._buf.extend(data)
+        out = []
+        while len(self._buf) >= HEADER_LEN:
+            length = int.from_bytes(self._buf[:4], "big")
+            if length > MAX_FRAME:
+                raise ValueError("oversized frame")
+            if len(self._buf) < HEADER_LEN + length:
+                break
+            mt = int.from_bytes(self._buf[4:6], "big")
+            payload = bytes(self._buf[HEADER_LEN : HEADER_LEN + length])
+            del self._buf[: HEADER_LEN + length]
+            out.append(decode_message(mt, payload))
+        return out

@@ -249,3 +249,54 @@ class TestValidationsStore:
         v2.sign(k)
         store.add(v2)
         assert store.current_ledger_weights() == {H(2): 1}
+
+
+# -- VerifyPlane integration ----------------------------------------------
+
+
+class TestBatchedVerifySeam:
+    def test_validator_verifies_proposals_via_verify_plane(self):
+        from stellard_tpu.consensus.consensus import ConsensusAdapter
+        from stellard_tpu.node.validator import ValidatorNode
+        from stellard_tpu.node.verifyplane import VerifyPlane
+
+        class NullAdapter(ConsensusAdapter):
+            def propose(self, proposal):
+                pass
+
+            def share_tx_set(self, txset):
+                pass
+
+            def acquire_tx_set(self, set_hash):
+                return None
+
+            def send_validation(self, val):
+                pass
+
+        plane = VerifyPlane(backend="cpu")
+        keys = [kp(i) for i in range(3)]
+        unl = {k.public for k in keys}
+        now = [10_000]
+        node = ValidatorNode(
+            key=keys[0],
+            unl=unl,
+            adapter=NullAdapter(),
+            quorum=2,
+            network_time=lambda: now[0],
+            clock=lambda: now[0] / 1.0,
+            verify_many=plane.verify_many,
+        )
+        node.start(b"\x07" * 20, close_time=now[0])
+        prev = node.lm.closed_ledger().hash()
+        good = LedgerProposal(prev, 0, H(2), 30)
+        good.sign(keys[1])
+        assert node.handle_proposal(good)
+        bad = LedgerProposal(prev, 1, H(3), 30)
+        bad.sign(keys[2])
+        bad.tx_set_hash = H(4)  # tamper
+        assert not node.handle_proposal(bad)
+        val = STValidation.build(prev, signing_time=now[0], ledger_seq=1)
+        val.sign(keys[1])
+        assert node.handle_validation(val) in (True, False)  # no crash
+        assert node.validations.trusted_count_for(prev) == 1
+        plane.stop()

@@ -1,0 +1,115 @@
+"""4-validator private net over real TCP sockets (reference: the
+Vagrant one-box testnet / 4-validator private net, SURVEY §4.4 and
+BASELINE config #4). Clocks are accelerated 5× so consensus windows
+(2s close, 3s establish) pass in ~1s real time each."""
+
+from __future__ import annotations
+
+import socket
+import time
+
+import pytest
+
+from stellard_tpu.overlay.tcp import TcpOverlay
+from stellard_tpu.protocol.formats import TxType
+from stellard_tpu.protocol.keys import KeyPair
+from stellard_tpu.protocol.sfields import sfAmount, sfBalance, sfDestination
+from stellard_tpu.protocol.stamount import STAmount
+from stellard_tpu.protocol.sttx import SerializedTransaction
+
+XRP = 1_000_000
+MASTER = KeyPair.from_passphrase("masterpassphrase")
+SPEED = 5.0  # virtual seconds per real second
+
+
+def free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.fixture()
+def net():
+    n = 4
+    ports = free_ports(n)
+    keys = [KeyPair.from_passphrase(f"tcp-val-{i}") for i in range(n)]
+    unl = {k.public for k in keys}
+    t0 = time.monotonic()
+    clock = lambda: (time.monotonic() - t0) * SPEED
+    ntime = lambda: 20_000_000 + int(clock())
+    overlays = []
+    for i in range(n):
+        peer_addrs = [("127.0.0.1", ports[j]) for j in range(n) if j != i]
+        ov = TcpOverlay(
+            key=keys[i],
+            unl=unl,
+            quorum=3,
+            port=ports[i],
+            peer_addrs=peer_addrs,
+            network_time=ntime,
+            clock=clock,
+            timer_interval=0.15,
+            idle_interval=4,
+        )
+        overlays.append(ov)
+    for ov in overlays:
+        ov.start(MASTER.account_id, close_time=ntime())
+    yield overlays
+    for ov in overlays:
+        ov.stop()
+
+
+def wait_until(pred, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    return pred()
+
+
+class TestTcpPrivateNet:
+    def test_connects_closes_and_agrees(self, net):
+        assert wait_until(lambda: all(ov.peer_count() == 3 for ov in net), 15)
+        assert wait_until(
+            lambda: all(
+                ov.node.lm.validated and ov.node.lm.validated.seq >= 3
+                for ov in net
+            ),
+            30,
+        ), [ov.node.lm.validated and ov.node.lm.validated.seq for ov in net]
+        # same hash at a common validated seq on every node
+        seq = min(ov.node.lm.validated.seq for ov in net)
+        hashes = {ov.node.lm.ledger_history[seq] for ov in net}
+        assert len(hashes) == 1
+
+    def test_payment_commits_network_wide(self, net):
+        assert wait_until(lambda: all(ov.peer_count() == 3 for ov in net), 15)
+        alice = KeyPair.from_passphrase("alice")
+        tx = SerializedTransaction.build(
+            TxType.ttPAYMENT, MASTER.account_id, 1, 10,
+            {
+                sfAmount: STAmount.from_drops(1000 * XRP),
+                sfDestination: alice.account_id,
+            },
+        )
+        tx.sign(MASTER)
+        net[2].submit_client_tx(tx)
+
+        def landed():
+            for ov in net:
+                led = ov.node.lm.validated
+                if led is None:
+                    return False
+                root = led.account_root(alice.account_id)
+                if root is None or root[sfBalance].drops() != 1000 * XRP:
+                    return False
+            return True
+
+        assert wait_until(landed, 30)
