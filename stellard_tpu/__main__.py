@@ -22,6 +22,16 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--rpc_ip", default=None)
     ap.add_argument("--rpc_port", type=int, default=None)
     ap.add_argument("--websocket_port", type=int, default=None)
+    ap.add_argument("--dump_ledger", metavar="SEQ", type=int, default=None,
+                    help="print stored ledger SEQ as JSON and exit")
+    ap.add_argument("--dump_transactions", metavar="FILE", default=None,
+                    help="stream stored txns to FILE as JSON lines and exit")
+    ap.add_argument("--load_transactions", metavar="FILE", default=None,
+                    help="re-drive a transaction dump through a fresh chain")
+    ap.add_argument("--ledger", metavar="SEQ", type=int, default=None,
+                    help="with --replay: the ledger to re-close")
+    ap.add_argument("--replay", action="store_true",
+                    help="replay stored ledger --ledger and verify its hash")
     ap.add_argument("command", nargs="*", help="RPC client command")
     args = ap.parse_args(argv)
 
@@ -62,6 +72,14 @@ def main(argv: list[str] | None = None) -> int:
             print(json.dumps(json.load(resp), indent=2))
         return 0
 
+    if (
+        args.dump_ledger is not None
+        or args.dump_transactions
+        or args.load_transactions
+        or args.replay
+    ):
+        return _offline_tools(args, cfg)
+
     from .node.node import Node
 
     if cfg.rpc_port is None:
@@ -82,6 +100,84 @@ def main(argv: list[str] | None = None) -> int:
         pass
     finally:
         node.stop()
+    return 0
+
+
+def _offline_tools(args, cfg) -> int:
+    """Offline modes (reference: LedgerDump.cpp entry points)."""
+    from .node.ledgertools import (
+        dump_ledger,
+        dump_transactions,
+        load_transactions,
+        replay_ledger,
+    )
+    from .node.txdb import TxDatabase
+    from .nodestore.core import make_database
+    from .state.ledger import Ledger
+
+    db = make_database(
+        type=cfg.node_db_type,
+        **({"path": cfg.node_db_path} if cfg.node_db_path else {}),
+    )
+    txdb = TxDatabase(cfg.database_path or ":memory:")
+
+    def ledger_by_seq(seq: int) -> Ledger:
+        hdr = txdb.get_ledger_header(seq=seq)
+        if hdr is None:
+            raise SystemExit(f"no stored ledger {seq}")
+        return Ledger.load(db, hdr["hash"])
+
+    if args.dump_ledger is not None:
+        print(json.dumps(dump_ledger(ledger_by_seq(args.dump_ledger)), indent=2))
+        return 0
+    if args.dump_transactions:
+        seqs = [s for s in txdb.ledger_seqs() if s >= 2]
+        gaps = [
+            (a, b) for a, b in zip(seqs, seqs[1:]) if b != a + 1
+        ]
+        for a, b in gaps:
+            print(f"warning: ledger gap {a} → {b} (catch-up switch?)",
+                  file=sys.stderr)
+
+        def ledgers():
+            for seq in seqs:
+                hdr = txdb.get_ledger_header(seq=seq)
+                if hdr is not None:
+                    yield Ledger.load(db, hdr["hash"])
+
+        with open(args.dump_transactions, "w") as fh:
+            n = dump_transactions(ledgers(), fh)
+        print(f"dumped {n} transactions from {len(seqs)} ledgers",
+              file=sys.stderr)
+        return 0
+    if args.load_transactions:
+        from .node.ledgermaster import LedgerMaster
+        from .node.node import MASTER_PASSPHRASE
+        from .protocol.keys import KeyPair
+
+        lm = LedgerMaster()
+        lm.start_new_ledger(
+            KeyPair.from_passphrase(MASTER_PASSPHRASE).account_id
+        )
+        with open(args.load_transactions) as fh:
+            applied, failed = load_transactions(fh, lm)
+        print(f"applied {applied}, failed {failed}", file=sys.stderr)
+        return 0
+    if args.replay:
+        if args.ledger is None:
+            raise SystemExit("--replay requires --ledger SEQ")
+        hdr = txdb.get_ledger_header(seq=args.ledger)
+        if hdr is None:
+            raise SystemExit(f"no stored ledger {args.ledger}")
+        # replay through the CONFIGURED hash backend — this is the
+        # BASELINE #5 harness, so it must measure the device pipeline
+        from .crypto.backend import make_hasher
+
+        hasher = make_hasher(cfg.hash_backend)
+        stats = replay_ledger(db, hdr["hash"],
+                              hash_batch=hasher.prefix_hash_batch)
+        print(json.dumps(stats, indent=2))
+        return 0 if stats["ok"] else 1
     return 0
 
 

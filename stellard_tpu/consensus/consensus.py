@@ -78,6 +78,10 @@ class ConsensusAdapter:
         """Flood a disputed tx so peers missing it can include it next
         round (reference: DisputedTx creation relays TMTransaction)."""
 
+    def request_ledger_data(self, msg) -> None:
+        """Send a GetLedger request toward peers (catch-up acquisition;
+        reference: PeerSet::sendRequest)."""
+
     def on_accepted(self, ledger: Ledger, round_ms: int) -> None:
         """New LCL built; the node should start the next round."""
 

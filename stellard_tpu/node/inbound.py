@@ -1,0 +1,241 @@
+"""InboundLedger: network acquisition of a ledger by hash, and the
+serving side that answers peers' requests.
+
+Reference: src/ripple_app/ledger/InboundLedger.cpp (state machine: base
+header → tx tree → state tree; trigger/takeNodes) and InboundLedgers.cpp
+(container with dedup). Used for catch-up: when validations show the
+network is on a ledger we don't have, we acquire it and switch
+(reference: NetworkOPs::checkLastClosedLedger → switchLastClosedLedger).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..overlay.wire import GetLedger, LedgerData
+from ..state.ledger import Ledger, parse_header
+from ..state.shamap import SHAMap, TNType, ZERO256
+from ..state.shamapsync import IncompleteMap, SHAMapNodeID, make_fetch_pack
+from ..utils.hashes import HP_LEDGER_MASTER, prefix_hash
+
+__all__ = ["InboundLedger", "InboundLedgers", "serve_get_ledger"]
+
+# GetLedger.what codes
+W_HEADER = 0
+W_TX_TREE = 1
+W_STATE_TREE = 2
+
+
+class InboundLedger:
+    """One acquisition session (reference: InboundLedger.cpp:93-265)."""
+
+    def __init__(self, ledger_hash: bytes, hash_batch: Optional[Callable] = None):
+        self.hash = ledger_hash
+        self.hash_batch = hash_batch
+        self.header: Optional[bytes] = None
+        self.fields: Optional[dict] = None
+        self.tx_map: Optional[IncompleteMap] = None
+        self.state_map: Optional[IncompleteMap] = None
+        self.failed = False
+
+    # -- progress ---------------------------------------------------------
+
+    def is_complete(self) -> bool:
+        return (
+            self.header is not None
+            and self.tx_map is not None
+            and self.state_map is not None
+            and self.tx_map.is_complete()
+            and self.state_map.is_complete()
+        )
+
+    def next_requests(self, per_tree: int = 256) -> list[GetLedger]:
+        """What to ask peers for next (reference: trigger)."""
+        if self.header is None:
+            return [GetLedger(self.hash, 0, W_HEADER, [])]
+        out = []
+        for what, imap in (
+            (W_TX_TREE, self.tx_map),
+            (W_STATE_TREE, self.state_map),
+        ):
+            if imap is not None and not imap.is_complete():
+                missing = imap.missing_nodes(per_tree)
+                out.append(
+                    GetLedger(
+                        self.hash, 0, what, [nid.encode() for nid, _h in missing]
+                    )
+                )
+        return out
+
+    # -- data intake ------------------------------------------------------
+
+    def take_header(self, blob: bytes) -> bool:
+        """Verify and accept the ledger header (the 'base' in the
+        reference). The header IS the hashed content: LWR-prefixed
+        SHA-512-half must equal the ledger hash we're acquiring."""
+        if self.header is not None:
+            return False  # duplicate — no progress
+        if prefix_hash(HP_LEDGER_MASTER, blob) != self.hash:
+            return False
+        self.header = blob
+        f = parse_header(blob)
+        self.fields = f
+        self.tx_map = IncompleteMap(f["tx_hash"], TNType.TX_MD)
+        self.state_map = IncompleteMap(f["account_hash"], TNType.ACCOUNT_STATE)
+        return True
+
+    def take_nodes(self, what: int, pairs: list[tuple[bytes, bytes]]) -> int:
+        """Accept LedgerData nodes: (node_id_wire, blob) pairs. Node
+        position ids route the request; integrity comes from the
+        hash-verified attach inside IncompleteMap (reference: takeNodes →
+        SHAMapSync::addKnownNode)."""
+        imap = self.tx_map if what == W_TX_TREE else self.state_map
+        if imap is None:
+            return 0
+        by_id: dict[SHAMapNodeID, bytes] = {}
+        for nid_wire, blob in pairs:
+            try:
+                by_id[SHAMapNodeID.decode(nid_wire)] = blob
+            except ValueError:
+                continue
+        # a reply can contain several tree levels; every accepted level
+        # exposes new positions, so keep matching until nothing new lands
+        n = 0
+        progressed = True
+        while progressed and by_id:
+            progressed = False
+            want = {
+                nid: h
+                for nid, h in imap.missing_nodes(limit=4 * len(by_id) + 16)
+            }
+            batch = [
+                (h, by_id[nid])
+                for nid, h in want.items()
+                if nid in by_id and not imap.have_node(h)
+            ]
+            if batch:
+                got = imap.add_nodes(batch)
+                n += got
+                progressed = got > 0
+        return n
+
+    # -- completion -------------------------------------------------------
+
+    def build_ledger(self) -> Ledger:
+        assert self.is_complete()
+        f = self.fields
+        led = Ledger(
+            seq=f["seq"],
+            parent_hash=f["parent_hash"],
+            tot_coins=f["tot_coins"],
+            fee_pool=f["fee_pool"],
+            inflation_seq=f["inflation_seq"],
+            close_time=f["close_time"],
+            parent_close_time=f["parent_close_time"],
+            close_resolution=f["close_resolution"],
+            close_flags=f["close_flags"],
+            tx_map=self.tx_map.to_shamap(self.hash_batch),
+            state_map=self.state_map.to_shamap(self.hash_batch),
+        )
+        led.closed = True
+        led.accepted = True
+        if led.hash() != self.hash:
+            raise ValueError("acquired ledger does not hash to target")
+        return led
+
+
+class InboundLedgers:
+    """Dedup container of running acquisitions
+    (reference: InboundLedgers.cpp)."""
+
+    def __init__(self, send: Callable[[GetLedger], None],
+                 hash_batch: Optional[Callable] = None):
+        self.send = send  # broadcast/anycast a GetLedger to peers
+        self.hash_batch = hash_batch
+        self.live: dict[bytes, InboundLedger] = {}
+        self.on_complete: Optional[Callable[[Ledger], None]] = None
+
+    def acquire(self, ledger_hash: bytes) -> InboundLedger:
+        il = self.live.get(ledger_hash)
+        if il is None:
+            il = InboundLedger(ledger_hash, self.hash_batch)
+            self.live[ledger_hash] = il
+            self.trigger(il)
+        return il
+
+    def trigger(self, il: InboundLedger) -> None:
+        for req in il.next_requests():
+            self.send(req)
+
+    def take_ledger_data(self, msg: LedgerData) -> Optional[Ledger]:
+        """Route a LedgerData reply; returns the finished ledger when an
+        acquisition completes. Only replies that made progress re-trigger
+        requests — a duplicate reply from a second peer must not fan out
+        another request wave (the reference throttles the same way via
+        PeerSet progress timeouts)."""
+        il = self.live.get(msg.ledger_hash)
+        if il is None:
+            return None
+        progressed = 0
+        if msg.what == W_HEADER:
+            for _nid, blob in msg.nodes:
+                if il.take_header(blob):
+                    progressed += 1
+        else:
+            progressed = il.take_nodes(msg.what, msg.nodes)
+        if il.is_complete():
+            try:
+                ledger = il.build_ledger()
+            except (ValueError, KeyError):
+                il.failed = True
+                del self.live[msg.ledger_hash]
+                return None
+            del self.live[msg.ledger_hash]
+            if self.on_complete is not None:
+                self.on_complete(ledger)
+            return ledger
+        if progressed:
+            self.trigger(il)
+        return None
+
+
+def serve_get_ledger(ledger: Optional[Ledger], msg: GetLedger) -> Optional[LedgerData]:
+    """Answer a peer's GetLedger from a closed ledger we hold
+    (reference: PeerImp::getLedger → TMLedgerData reply)."""
+    if ledger is None:
+        return None
+    if msg.what == W_HEADER:
+        return LedgerData(
+            msg.ledger_hash, ledger.seq, W_HEADER, [(b"", ledger.header_bytes())]
+        )
+    tree = ledger.tx_map if msg.what == W_TX_TREE else ledger.state_map
+    nodes: list[tuple[bytes, bytes]] = []
+    if not msg.node_ids:
+        # no specific request → send the root
+        ids = [SHAMapNodeID.root()]
+    else:
+        ids = []
+        for nid_wire in msg.node_ids:
+            try:
+                ids.append(SHAMapNodeID.decode(nid_wire))
+            except ValueError:
+                continue
+    tree.get_hash()
+    for nid in ids:
+        node = _descend(tree, nid)
+        if node is not None:
+            from ..state.shamap import serialize_node_prefix
+
+            nodes.append((nid.encode(), serialize_node_prefix(node)))
+    if not nodes:
+        return None
+    return LedgerData(msg.ledger_hash, ledger.seq, msg.what, nodes)
+
+
+def _descend(tree: SHAMap, nid: SHAMapNodeID):
+    node = tree.root
+    for nb in nid.nibbles():
+        if node is None or not hasattr(node, "children"):
+            return None
+        node = node.children[nb]
+    return node

@@ -239,6 +239,16 @@ class LedgerMaster:
                     self.add_held_transaction(tx)
             return new_lcl, results
 
+    def switch_lcl(self, ledger: Ledger) -> None:
+        """Adopt a different (acquired) last-closed ledger — the network
+        moved on without us (reference: switchLastClosedLedger,
+        NetworkOPs.cpp:930). Our open-ledger txns are NOT carried over;
+        anything still valid will be re-relayed by peers."""
+        with self._lock:
+            ledger.accepted = True
+            self._push_closed(ledger)
+            self.current = ledger.open_successor()
+
     def set_validated(self, ledger: Ledger) -> None:
         """A quorum of trusted validations arrived for this ledger
         (reference: LedgerMaster::checkAccept tail, :705-750)."""

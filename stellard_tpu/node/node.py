@@ -95,8 +95,24 @@ class Node:
         (Application.cpp:733-762)."""
         if self.config.start_up == "fresh":
             self.ledger_master.start_new_ledger(self.master_keys.account_id)
+            # persist the genesis close so later offline replay can load
+            # every ledger's parent (reference: startNewLedger saves the
+            # seq-1 ledger before opening seq 2)
+            genesis = self.ledger_master.closed_ledger()
+            genesis.save(self.nodestore)
+            self.txdb.save_ledger_header(genesis)
         elif self.config.start_up == "load":
-            raise NotImplementedError("load: wire Ledger.load from nodestore")
+            # resume from the newest persisted ledger (reference:
+            # loadOldLedger, Application.cpp:737-758)
+            hdr = self.txdb.get_ledger_header()
+            if hdr is None:
+                self.ledger_master.start_new_ledger(self.master_keys.account_id)
+            else:
+                led = Ledger.load(
+                    self.nodestore, hdr["hash"],
+                    hash_batch=self.hasher.prefix_hash_batch,
+                )
+                self.ledger_master.load_ledger(led)
         return self
 
     def serve(self) -> "Node":

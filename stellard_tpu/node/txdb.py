@@ -213,9 +213,13 @@ class TxDatabase:
         if ledger_hash is not None:
             q += "LedgerHash = ?"
             arg = (ledger_hash.hex(),)
-        else:
+        elif seq is not None:
             q += "LedgerSeq = ?"
             arg = (seq,)
+        else:
+            # newest stored ledger (reference: getNewestLedgerInfo)
+            q += "LedgerSeq = (SELECT MAX(LedgerSeq) FROM Ledgers)"
+            arg = ()
         with self._lock:
             row = self._conn.execute(q, arg).fetchone()
         if row is None:
@@ -232,6 +236,15 @@ class TxDatabase:
             "account_hash": bytes.fromhex(row[8]),
             "tx_hash": bytes.fromhex(row[9]),
         }
+
+    def ledger_seqs(self) -> list[int]:
+        """All stored ledger sequences, ascending (gaps possible after an
+        LCL switch — callers must not assume contiguity)."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT LedgerSeq FROM Ledgers ORDER BY LedgerSeq"
+            ).fetchall()
+        return [r[0] for r in rows]
 
     def save_validation(self, ledger_hash: bytes, node_public: bytes,
                         sign_time: int, raw: bytes) -> None:

@@ -71,6 +71,13 @@ class ValidatorNode:
         self.rounds_completed = 0
         # peer tx sets seen this round (simnet share / TMHaveTransactionSet)
         self.txset_cache: dict[bytes, TxSet] = {}
+        # catch-up: ledger acquisition sessions (reference: InboundLedgers)
+        from .inbound import InboundLedgers
+
+        self.inbound = InboundLedgers(
+            send=adapter.request_ledger_data, hash_batch=hash_batch
+        )
+        self.inbound.on_complete = self._ledger_acquired
 
     # -- lifecycle --------------------------------------------------------
 
@@ -98,10 +105,70 @@ class ValidatorNode:
         )
 
     def on_timer(self) -> None:
-        """Heartbeat → consensus timer (reference:
-        processHeartbeatTimer → timerEntry)."""
+        """Heartbeat → consensus timer + catch-up check (reference:
+        processHeartbeatTimer → timerEntry / checkLastClosedLedger)."""
         if self.round is not None:
             self.round.timer_entry()
+        self._check_lcl()
+        # re-trigger stalled acquisitions every other tick (reference:
+        # PeerSet timeouts); progress-driven triggers do the steady-state
+        self._tick = getattr(self, "_tick", 0) + 1
+        if self._tick % 2 == 0:
+            for il in list(self.inbound.live.values()):
+                self.inbound.trigger(il)
+
+    # -- catch-up ---------------------------------------------------------
+
+    def _check_lcl(self) -> None:
+        """Elect the network LCL from current trusted validations and
+        switch if another ledger has strictly more weight than ours —
+        this is both the lag (we're behind) and the fork (same seq,
+        different hash) repair path (reference: checkLastClosedLedger,
+        NetworkOPs.cpp:776-925). A candidate must win two consecutive
+        ticks before we act, so a healthy node mid-accept doesn't churn
+        on the transient where peer validations beat its own close."""
+        ours = self.lm.closed_ledger()
+        ours_hash = ours.hash()
+        votes: dict[bytes, int] = {}
+        for v in self.validations.current_trusted():
+            if v.ledger_seq is None or v.ledger_seq < ours.seq:
+                continue  # never move backwards
+            votes[v.ledger_hash] = votes.get(v.ledger_hash, 0) + 1
+        # our implicit vote for our own LCL (our stored validation may
+        # already be counted; the +1 is the reference's home-field bias)
+        our_weight = votes.get(ours_hash, 0) + 1
+        votes.pop(ours_hash, None)
+        if not votes:
+            self._lcl_candidate = None
+            return
+        best, weight = max(votes.items(), key=lambda kv: (kv[1], kv[0]))
+        if weight <= our_weight:
+            self._lcl_candidate = None
+            return
+        if getattr(self, "_lcl_candidate", None) != best:
+            self._lcl_candidate = best  # hysteresis: confirm next tick
+            return
+        led = self.lm.get_ledger_by_hash(best)
+        if led is not None:
+            self._adopt_network_lcl(led)
+        else:
+            self.inbound.acquire(best)
+
+    def _ledger_acquired(self, ledger: Ledger) -> None:
+        """Acquisition finished (reference: InboundLedger LADispatch →
+        checkAccept)."""
+        self._adopt_network_lcl(ledger)
+
+    def _adopt_network_lcl(self, ledger: Ledger) -> None:
+        ours = self.lm.closed_ledger()
+        if ledger.seq < ours.seq or ledger.hash() == ours.hash():
+            return
+        self.lm.switch_lcl(ledger)
+        self._lcl_candidate = None
+        self.lm.check_accept(
+            ledger.hash(), self.validations.trusted_count_for(ledger.hash())
+        )
+        self.begin_round()
 
     def round_accepted(self, ledger: Ledger, round_ms: int) -> None:
         """Adapter callback after accept(): record stats and start the
@@ -178,6 +245,16 @@ class ValidatorNode:
             self.validations.trusted_count_for(val.ledger_hash),
         )
         return current
+
+    def handle_ledger_data(self, msg) -> None:
+        """Route a LedgerData reply into the acquisition machinery."""
+        self.inbound.take_ledger_data(msg)
+
+    def serve_get_ledger(self, msg):
+        """Answer a peer's GetLedger from our closed-ledger cache."""
+        from .inbound import serve_get_ledger
+
+        return serve_get_ledger(self.lm.get_ledger_by_hash(msg.ledger_hash), msg)
 
     def handle_txset(self, txset: TxSet) -> None:
         """A shared/acquired candidate set arrived

@@ -26,6 +26,8 @@ from ..protocol.sttx import SerializedTransaction
 from ..state.ledger import Ledger
 from .wire import (
     FrameReader,
+    GetLedger,
+    LedgerData,
     ProposeSet,
     TxMessage,
     TxSetData,
@@ -85,6 +87,17 @@ class SimValidator(ConsensusAdapter):
     def relay_disputed_tx(self, blob: bytes) -> None:
         self.net.broadcast(self.nid, frame(TxMessage(blob)))
 
+    def request_ledger_data(self, msg: GetLedger) -> None:
+        # anycast to one peer, rotating (reference: PeerSet picks a peer
+        # per request); broadcasting would multiply reply waves by N-1
+        self._acq_rr = getattr(self, "_acq_rr", 0) + 1
+        n = len(self.net.validators)
+        for step in range(1, n):
+            dst = (self.nid + self._acq_rr + step) % n
+            if dst != self.nid:
+                self.net.send(self.nid, dst, frame(msg))
+                return
+
     def on_accepted(self, ledger: Ledger, round_ms: int) -> None:
         self.net.on_ledger_accepted(self.nid, ledger)
         self.node.round_accepted(ledger, round_ms)
@@ -99,11 +112,11 @@ class SimValidator(ConsensusAdapter):
 
     # -- delivery ---------------------------------------------------------
 
-    def deliver(self, data: bytes) -> None:
+    def deliver(self, src: int, data: bytes) -> None:
         for msg in self.reader.feed(data):
-            self._dispatch(msg)
+            self._dispatch(src, msg)
 
-    def _dispatch(self, msg) -> None:
+    def _dispatch(self, src: int, msg) -> None:
         node = self.node
         if isinstance(msg, TxMessage):
             tx = SerializedTransaction.from_bytes(msg.blob)
@@ -119,6 +132,12 @@ class SimValidator(ConsensusAdapter):
                 ts.add(tx.txid(), blob)
             if ts.hash() == msg.set_hash:  # integrity: recomputed root
                 node.handle_txset(ts)
+        elif isinstance(msg, GetLedger):
+            reply = node.serve_get_ledger(msg)
+            if reply is not None:
+                self.net.send(self.nid, src, frame(reply))
+        elif isinstance(msg, LedgerData):
+            node.handle_ledger_data(msg)
 
 
 class SimNet:
@@ -179,11 +198,16 @@ class SimNet:
 
     def broadcast(self, src: int, data: bytes) -> None:
         for dst in range(len(self.validators)):
-            if dst != src and (src, dst) not in self._links_down:
-                heapq.heappush(
-                    self._queue,
-                    (self.time_ms + self.latency_ms, next(self._seq), dst, data),
-                )
+            if dst != src:
+                self.send(src, dst, data)
+
+    def send(self, src: int, dst: int, data: bytes) -> None:
+        if (src, dst) in self._links_down:
+            return
+        heapq.heappush(
+            self._queue,
+            (self.time_ms + self.latency_ms, next(self._seq), dst, src, data),
+        )
 
     def on_ledger_accepted(self, nid: int, ledger: Ledger) -> None:
         self.accept_log.append((nid, ledger.seq, ledger.hash()))
@@ -204,8 +228,8 @@ class SimNet:
         for _ in range(n):
             self.time_ms += self.step_ms
             while self._queue and self._queue[0][0] <= self.time_ms:
-                _at, _seq, dst, data = heapq.heappop(self._queue)
-                self.validators[dst].deliver(data)
+                _at, _seq, dst, src, data = heapq.heappop(self._queue)
+                self.validators[dst].deliver(src, data)
             for v in self.validators:
                 v.node.on_timer()
 

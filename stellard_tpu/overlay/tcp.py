@@ -34,8 +34,10 @@ from ..state.ledger import Ledger
 from ..utils.hashes import prefix_hash
 from .wire import (
     FrameReader,
+    GetLedger,
     GetTxSet,
     Hello,
+    LedgerData,
     Ping,
     ProposeSet,
     TxMessage,
@@ -284,6 +286,12 @@ class TcpOverlay(ConsensusAdapter):
             if ts is not None:
                 blobs = [blob for _t, blob in ts.blobs()]
                 peer.send(frame(TxSetData(msg.set_hash, blobs)))
+        elif isinstance(msg, GetLedger):
+            reply = node.serve_get_ledger(msg)
+            if reply is not None:
+                peer.send(frame(reply))
+        elif isinstance(msg, LedgerData):
+            node.handle_ledger_data(msg)
         elif isinstance(msg, Ping) and not msg.is_pong:
             peer.send(frame(Ping(True, msg.seq)))
 
@@ -330,6 +338,15 @@ class TcpOverlay(ConsensusAdapter):
 
     def relay_disputed_tx(self, blob: bytes) -> None:
         self._broadcast(TxMessage(blob))
+
+    def request_ledger_data(self, msg: GetLedger) -> None:
+        # anycast to one connected peer, rotating (reference: PeerSet)
+        with self._peers_lock:
+            peers = sorted(self.peers.items())
+        if not peers:
+            return
+        self._acq_rr = getattr(self, "_acq_rr", 0) + 1
+        peers[self._acq_rr % len(peers)][1].send(frame(msg))
 
     def on_accepted(self, ledger: Ledger, round_ms: int) -> None:
         self.node.round_accepted(ledger, round_ms)

@@ -30,7 +30,33 @@ from ..utils.hashes import HP_LEDGER_MASTER, HP_TXN_ID, prefix_hash
 from . import indexes
 from .shamap import SHAMap, SHAMapItem, TNType
 
-__all__ = ["Ledger", "SYSTEM_CURRENCY_START", "LEDGER_TIME_ACCURACY"]
+__all__ = [
+    "Ledger",
+    "SYSTEM_CURRENCY_START",
+    "LEDGER_TIME_ACCURACY",
+    "parse_header",
+]
+
+
+def parse_header(blob: bytes) -> dict:
+    """Decode Ledger::addRaw header bytes — the single reader for the
+    layout header_bytes() writes (reference: Ledger.cpp:1182-1196)."""
+    from ..protocol.serializer import BinaryParser
+
+    p = BinaryParser(blob)
+    return {
+        "seq": p.read32(),
+        "tot_coins": p.read64(),
+        "fee_pool": p.read64(),
+        "inflation_seq": p.read32(),
+        "parent_hash": p.read(32),
+        "tx_hash": p.read(32),
+        "account_hash": p.read(32),
+        "parent_close_time": p.read32(),
+        "close_time": p.read32(),
+        "close_resolution": p.read8(),
+        "close_flags": p.read8(),
+    }
 
 # reference: Config.h:37-40
 SYSTEM_CURRENCY_START = 1000 * 100_000_000 * 1_000_000
@@ -333,23 +359,10 @@ class Ledger:
         obj = db.fetch(ledger_hash)
         if obj is None:
             raise KeyError(f"missing ledger {ledger_hash.hex()}")
-        from ..protocol.serializer import BinaryParser
-
         body = obj.data
         if int.from_bytes(body[:4], "big") == HP_LEDGER_MASTER:
             body = body[4:]
-        p = BinaryParser(body)
-        seq = p.read32()
-        tot_coins = p.read64()
-        fee_pool = p.read64()
-        inflation_seq = p.read32()
-        parent_hash = p.read(32)
-        tx_hash = p.read(32)
-        account_hash = p.read(32)
-        parent_close_time = p.read32()
-        close_time = p.read32()
-        close_resolution = p.read8()
-        close_flags = p.read8()
+        f = parse_header(body)
 
         fetched: set[bytes] = set()
 
@@ -361,17 +374,17 @@ class Ledger:
 
         kw = {"hash_batch": hash_batch} if hash_batch else {}
         led = cls(
-            seq=seq,
-            parent_hash=parent_hash,
-            tot_coins=tot_coins,
-            fee_pool=fee_pool,
-            inflation_seq=inflation_seq,
-            close_time=close_time,
-            parent_close_time=parent_close_time,
-            close_resolution=close_resolution,
-            close_flags=close_flags,
-            tx_map=SHAMap.from_store(tx_hash, fetch, TNType.TX_MD, **kw),
-            state_map=SHAMap.from_store(account_hash, fetch,
+            seq=f["seq"],
+            parent_hash=f["parent_hash"],
+            tot_coins=f["tot_coins"],
+            fee_pool=f["fee_pool"],
+            inflation_seq=f["inflation_seq"],
+            close_time=f["close_time"],
+            parent_close_time=f["parent_close_time"],
+            close_resolution=f["close_resolution"],
+            close_flags=f["close_flags"],
+            tx_map=SHAMap.from_store(f["tx_hash"], fetch, TNType.TX_MD, **kw),
+            state_map=SHAMap.from_store(f["account_hash"], fetch,
                                         TNType.ACCOUNT_STATE, **kw),
         )
         led.closed = True
