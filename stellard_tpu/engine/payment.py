@@ -123,13 +123,24 @@ class PaymentTransactor(Transactor):
 
     def _ripple_payment(self, dst_id: bytes, dst_amount: STAmount,
                         max_amount: STAmount, flags: int) -> TER:
-        """Default-path IOU delivery (sender → [issuer] → receiver).
-        Explicit paths route here too until RippleCalc lands."""
-        if self.account_id == dst_id:
+        """IOU / cross-currency delivery. Explicit paths and currency
+        conversions run through the flow engine (paths.flow — the
+        RippleCalc replacement); the plain same-currency default path
+        keeps the direct rippleSend fast path below."""
+        has_paths = sfPaths in self.tx.obj and len(self.tx.obj[sfPaths]) > 0
+        if (
+            self.account_id == dst_id
+            and not has_paths
+            and max_amount.currency == dst_amount.currency
+        ):
+            # same-currency self-payment is a no-op; cross-currency
+            # self-payment is a legitimate conversion (reference:
+            # Payment.cpp redundancy check keys on currency too)
             return TER.temREDUNDANT
-        if max_amount.currency != dst_amount.currency:
-            # cross-currency needs the path engine / order books
-            return TER.tecPATH_DRY
+        if has_paths or max_amount.currency != dst_amount.currency or (
+            self.account_id == dst_id
+        ):
+            return self._flow_payment(dst_id, dst_amount, max_amount, flags)
 
         # funds check: what can the sender actually deliver?
         funds = views.account_funds(self.les, self.account_id, max_amount)
@@ -191,4 +202,37 @@ class PaymentTransactor(Transactor):
         )
         if ter in (TER.terRETRY,):
             ter = TER.tecPATH_DRY
+        return ter
+
+    def _flow_payment(self, dst_id: bytes, dst_amount: STAmount,
+                      max_amount: STAmount, flags: int) -> TER:
+        """Path-engine delivery (reference: Payment.cpp:185-248 calling
+        RippleCalc::rippleCalc with the tx's paths/flags)."""
+        from ..paths.flow import flow
+
+        tx_paths = (
+            self.tx.obj[sfPaths].paths if sfPaths in self.tx.obj else []
+        )
+        paths = list(tx_paths)
+        if not (flags & tfNoRippleDirect):
+            paths.append([])  # the default path
+        partial = bool(flags & tfPartialPayment)
+        limit_quality = None
+        if flags & tfLimitQuality:
+            # the tx's implied quality (Amount out per SendMax in) is the
+            # worst rate the sender accepts (reference: uQualityLimit)
+            from ..paths.flow import _ratio
+
+            limit_quality = _ratio(dst_amount, max_amount)
+        ter, _spent, _delivered = flow(
+            self.les,
+            self.account_id,
+            dst_id,
+            dst_amount,
+            max_amount,
+            paths,
+            partial,
+            self.engine.ledger.parent_close_time,
+            limit_quality=limit_quality,
+        )
         return ter

@@ -1,0 +1,95 @@
+"""OrderBookDB: index of the order books that exist in a ledger.
+
+Reference: src/ripple_app/ledger/OrderBookDB.cpp (326 LoC) — rebuilt on
+ledger switch (jtOB_SETUP), consulted by the Pathfinder for which
+currency conversions are available, and by book subscriptions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..protocol.formats import LedgerEntryType
+from ..protocol.sfields import sfLedgerEntryType, sfTakerGets, sfTakerPays
+from ..protocol.stamount import ACCOUNT_ZERO
+from ..protocol.stobject import STObject
+from ..state.ledger import Ledger
+
+__all__ = ["Book", "OrderBookDB"]
+
+CURRENCY_XRP = b"\x00" * 20
+
+
+@dataclass(frozen=True)
+class Book:
+    """One direction of one market (reference: OrderBook)."""
+
+    in_currency: bytes  # what the taker pays (book's TakerPays)
+    in_issuer: bytes
+    out_currency: bytes  # what the taker gets (book's TakerGets)
+    out_issuer: bytes
+
+
+class OrderBookDB:
+    # (ledger seq, state root) -> OrderBookDB; tiny LRU so repeated
+    # pathfinding against the same ledger doesn't rescan the state map
+    # (reference: rebuilt once per ledger switch on jtOB_SETUP)
+    _cache: dict[tuple[int, bytes], "OrderBookDB"] = {}
+    _CACHE_MAX = 4
+
+    def __init__(self):
+        self.books: set[Book] = set()
+        # in-asset -> books consuming it (the pathfinder's fan-out edge)
+        self.by_in: dict[tuple[bytes, bytes], set[Book]] = {}
+        self.by_out: dict[tuple[bytes, bytes], set[Book]] = {}
+
+    @classmethod
+    def for_ledger(cls, ledger: Ledger) -> "OrderBookDB":
+        key = (ledger.seq, ledger.state_map.get_hash())
+        db = cls._cache.get(key)
+        if db is None:
+            db = cls().setup(ledger)
+            cls._cache[key] = db
+            while len(cls._cache) > cls._CACHE_MAX:
+                cls._cache.pop(next(iter(cls._cache)))
+        return db
+
+    def setup(self, ledger: Ledger) -> "OrderBookDB":
+        """Scan the state map's offers (reference: OrderBookDB::setup
+        walks ltOFFER entries)."""
+        self.books.clear()
+        self.by_in.clear()
+        self.by_out.clear()
+        for item in ledger.state_map.items():
+            sle = STObject.from_bytes(item.data)
+            if sle.get(sfLedgerEntryType) != int(LedgerEntryType.ltOFFER):
+                continue
+            pays = sle[sfTakerPays]  # offer owner receives this = taker in
+            gets = sle[sfTakerGets]  # offer owner gives this = taker out
+            book = Book(
+                pays.currency,
+                ACCOUNT_ZERO if pays.is_native else pays.issuer,
+                gets.currency,
+                ACCOUNT_ZERO if gets.is_native else gets.issuer,
+            )
+            self.add(book)
+        return self
+
+    def add(self, book: Book) -> None:
+        if book not in self.books:
+            self.books.add(book)
+            self.by_in.setdefault(
+                (book.in_currency, book.in_issuer), set()
+            ).add(book)
+            self.by_out.setdefault(
+                (book.out_currency, book.out_issuer), set()
+            ).add(book)
+
+    def books_taking(self, currency: bytes, issuer: bytes) -> set[Book]:
+        return self.by_in.get((currency, issuer), set())
+
+    def books_delivering(self, currency: bytes, issuer: bytes) -> set[Book]:
+        return self.by_out.get((currency, issuer), set())
+
+    def __len__(self) -> int:
+        return len(self.books)

@@ -777,3 +777,45 @@ def do_unsubscribe(ctx: Context) -> dict:
             proposed=True,
         )
     return {}
+
+
+@handler("ripple_path_find")
+def do_ripple_path_find(ctx: Context) -> dict:
+    """reference: handlers/RipplePathFind.cpp — one-shot path search:
+    source_account, destination_account, destination_amount
+    [, send_max] -> ranked alternatives."""
+    from ..paths import find_paths
+    from ..protocol.stamount import STAmount as _STA
+    from ..protocol.stobject import STPathSet
+
+    led = _select_ledger(ctx)
+    p = ctx.params
+    try:
+        src = decode_account_id(p["source_account"])
+        dst = decode_account_id(p["destination_account"])
+        dst_amount = _STA.from_json(p["destination_amount"])
+        send_max = _STA.from_json(p["send_max"]) if "send_max" in p else None
+    except (KeyError, ValueError) as e:
+        raise RPCError("invalidParams", str(e))
+    alts = find_paths(led, src, dst, dst_amount, send_max=send_max)
+    out = _ledger_ident(led)
+    out["source_account"] = p["source_account"]
+    out["destination_account"] = p["destination_account"]
+    out["destination_amount"] = p["destination_amount"]
+    out["alternatives"] = [
+        {
+            "paths_computed": STPathSet(a["paths"]).to_json(),
+            "source_amount": a["source_amount"].to_json(),
+        }
+        for a in alts
+    ]
+    return out
+
+
+@handler("path_find")
+def do_path_find(ctx: Context) -> dict:
+    """reference: handlers/PathFind.cpp — the WebSocket subscription form;
+    the one-shot 'create' sub-command maps to a single search here."""
+    if ctx.params.get("subcommand", "create") != "create":
+        return {"closed": True}
+    return do_ripple_path_find(ctx)

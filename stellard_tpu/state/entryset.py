@@ -133,6 +133,20 @@ class LedgerEntrySet:
         for idx, e in self._entries.items():
             yield idx, e.sle, e.action
 
+    # -- trial execution (reference: duplicate/swapWith, used by
+    # RippleCalc to attempt a path and discard it on failure) -------------
+
+    def duplicate(self) -> "LedgerEntrySet":
+        dup = LedgerEntrySet(self.ledger)
+        for idx, e in self._entries.items():
+            dup._entries[idx] = _Entry(
+                e.sle.copy() if e.sle is not None else None, e.action, e.orig
+            )
+        return dup
+
+    def swap_with(self, other: "LedgerEntrySet") -> None:
+        self._entries, other._entries = other._entries, self._entries
+
     # -- commit -----------------------------------------------------------
 
     def apply(self) -> None:

@@ -1,0 +1,287 @@
+"""Path engine tests: flow execution, pathfinding, cross-currency
+payments through order books (reference coverage: test/path-test.js,
+new-path-test.coffee, indirect-test.js)."""
+
+from __future__ import annotations
+
+import pytest
+
+from stellard_tpu.engine import views
+from stellard_tpu.paths import OrderBookDB, find_paths, flow
+from stellard_tpu.paths.flow import plan_strand, AccountHop, BookHop, PathError
+from stellard_tpu.protocol.formats import TxType
+from stellard_tpu.protocol.keys import KeyPair
+from stellard_tpu.protocol.sfields import (
+    sfAmount,
+    sfDestination,
+    sfFlags,
+    sfPaths,
+    sfSendMax,
+    sfTakerGets,
+    sfTakerPays,
+    sfTransferRate,
+)
+from stellard_tpu.protocol.stamount import ACCOUNT_ZERO, STAmount, currency_from_iso
+from stellard_tpu.protocol.stobject import PathElement, STPathSet
+from stellard_tpu.protocol.ter import TER
+from stellard_tpu.state.entryset import LedgerEntrySet
+
+from test_engine import ALICE, BOB, CAROL, GATEWAY, Net, USD
+
+EUR = currency_from_iso("EUR")
+XRP = b"\x00" * 20
+M = 1_000_000
+
+
+def iou(v, issuer, cur=USD):
+    return STAmount.from_iou(cur, issuer.account_id, v, 0)
+
+
+class TestPlanStrand:
+    def test_default_iou_path_inserts_issuer(self):
+        hops = plan_strand(
+            ALICE.account_id, BOB.account_id, iou(10, GATEWAY),
+            USD, GATEWAY.account_id, [],
+        )
+        assert [type(h) for h in hops] == [AccountHop, AccountHop]
+        assert hops[0].dst == GATEWAY.account_id
+        assert hops[1].dst == BOB.account_id
+
+    def test_explicit_gateway_path(self):
+        hops = plan_strand(
+            ALICE.account_id, BOB.account_id, iou(10, CAROL),
+            USD, CAROL.account_id, [PathElement(account=GATEWAY.account_id)],
+        )
+        # alice -> G -> ... -> bob; final delivery may add the issuer
+        assert hops[0].dst == GATEWAY.account_id
+        assert hops[-1].dst == BOB.account_id
+
+    def test_cross_currency_inserts_book(self):
+        hops = plan_strand(
+            ALICE.account_id, BOB.account_id, iou(10, GATEWAY, EUR),
+            USD, GATEWAY.account_id, [],
+        )
+        assert any(isinstance(h, BookHop) for h in hops)
+
+    def test_xrp_cannot_ripple(self):
+        with pytest.raises(PathError):
+            plan_strand(
+                ALICE.account_id, BOB.account_id, iou(10, GATEWAY),
+                XRP, ACCOUNT_ZERO,
+                [PathElement(account=CAROL.account_id),
+                 PathElement(account=BOB.account_id)],
+            )
+
+
+class TestFlowSameCurrency:
+    def _net(self):
+        net = Net(ALICE, BOB, CAROL, GATEWAY)
+        net.trust(ALICE, GATEWAY, 10_000)
+        net.trust(BOB, GATEWAY, 10_000)
+        net.pay(GATEWAY, ALICE.account_id, iou(500, GATEWAY))
+        return net
+
+    def test_payment_through_issuer(self):
+        net = self._net()
+        net.pay(ALICE, BOB.account_id, iou(120, GATEWAY))
+        assert net.iou_balance(BOB, GATEWAY) == iou(120, GATEWAY)
+        assert net.iou_balance(ALICE, GATEWAY) == iou(380, GATEWAY)
+
+    def test_transfer_fee_charged_at_gateway(self):
+        net = self._net()
+        # gateway charges 0.2% (reference: TransferRate 1e9*1.002)
+        net.apply(GATEWAY, TxType.ttACCOUNT_SET,
+                  fields={sfTransferRate: 1_002_000_000})
+        tx_fields = {
+            sfDestination: BOB.account_id,
+            sfAmount: iou(100, GATEWAY),
+            sfSendMax: iou(101, GATEWAY),
+        }
+        net.apply(ALICE, TxType.ttPAYMENT, fields=tx_fields)
+        assert net.iou_balance(BOB, GATEWAY) == iou(100, GATEWAY)
+        # alice paid 100 * 1.002 = 100.2
+        bal = net.iou_balance(ALICE, GATEWAY)
+        assert iou(399, GATEWAY) < bal < iou(400, GATEWAY)
+
+    def test_multihop_gateway_chain(self):
+        # alice -USD/G-> G ... carol trusts G too; pay carol via G
+        net = self._net()
+        net.trust(CAROL, GATEWAY, 10_000)
+        net.pay(ALICE, CAROL.account_id, iou(50, GATEWAY))
+        assert net.iou_balance(CAROL, GATEWAY) == iou(50, GATEWAY)
+
+    def test_insufficient_liquidity_fails_dry(self):
+        net = self._net()
+        net.pay(ALICE, BOB.account_id, iou(600, GATEWAY),
+                expect=TER.tecPATH_PARTIAL)
+
+
+class TestFlowCrossCurrency:
+    def _net_with_book(self):
+        """carol places an offer selling EUR/G for USD/G."""
+        net = Net(ALICE, BOB, CAROL, GATEWAY)
+        for k in (ALICE, BOB, CAROL):
+            net.trust(k, GATEWAY, 100_000)
+            net.trust(k, GATEWAY, 100_000, currency=EUR)
+            net.apply(GATEWAY, TxType.ttPAYMENT, fields={
+                sfDestination: k.account_id, sfAmount: iou(1000, GATEWAY)})
+            net.apply(GATEWAY, TxType.ttPAYMENT, fields={
+                sfDestination: k.account_id, sfAmount: iou(1000, GATEWAY, EUR)})
+        # carol: pays USD 100, gets EUR 80 => price 1.25 USD/EUR
+        net.apply(CAROL, TxType.ttOFFER_CREATE, fields={
+            sfTakerPays: iou(100, GATEWAY),
+            sfTakerGets: iou(80, GATEWAY, EUR),
+        })
+        return net
+
+    def test_cross_currency_payment_via_book(self):
+        net = self._net_with_book()
+        # alice sends EUR 40 to bob paying in USD (sendmax 60)
+        net.apply(ALICE, TxType.ttPAYMENT, fields={
+            sfDestination: BOB.account_id,
+            sfAmount: iou(40, GATEWAY, EUR),
+            sfSendMax: iou(60, GATEWAY),
+        })
+        assert net.iou_balance(BOB, GATEWAY, EUR) == iou(1040, GATEWAY, EUR)
+        # alice paid 40 * 1.25 = 50 USD
+        assert net.iou_balance(ALICE, GATEWAY) == iou(950, GATEWAY)
+        # carol's offer was half consumed
+        assert net.iou_balance(CAROL, GATEWAY, EUR) == iou(960, GATEWAY, EUR)
+        assert net.iou_balance(CAROL, GATEWAY) == iou(1050, GATEWAY)
+
+    def test_sendmax_respected(self):
+        net = self._net_with_book()
+        # 40 EUR costs 50 USD; cap at 45 -> fails without partial flag
+        net.apply(ALICE, TxType.ttPAYMENT, expect=TER.tecPATH_PARTIAL,
+                  fields={
+                      sfDestination: BOB.account_id,
+                      sfAmount: iou(40, GATEWAY, EUR),
+                      sfSendMax: iou(45, GATEWAY),
+                  })
+
+    def test_partial_payment_delivers_what_it_can(self):
+        from stellard_tpu.engine.flags import tfPartialPayment
+
+        net = self._net_with_book()
+        net.apply(ALICE, TxType.ttPAYMENT, fields={
+            sfDestination: BOB.account_id,
+            sfAmount: iou(40, GATEWAY, EUR),
+            sfSendMax: iou(45, GATEWAY),
+            sfFlags: tfPartialPayment,
+        })
+        got = net.iou_balance(BOB, GATEWAY, EUR) - iou(1000, GATEWAY, EUR)
+        assert iou(0, GATEWAY, EUR) < got < iou(40, GATEWAY, EUR)
+        assert net.iou_balance(ALICE, GATEWAY) >= iou(955, GATEWAY)
+
+    def test_xrp_to_iou_via_book(self):
+        net = self._net_with_book()
+        # carol sells USD for STR: pays 10 STR gets 100 USD? (taker view:
+        # taker pays STR 10, taker gets USD 100)
+        net.apply(CAROL, TxType.ttOFFER_CREATE, fields={
+            sfTakerPays: STAmount.from_drops(10 * M),
+            sfTakerGets: iou(100, GATEWAY),
+        })
+        net.apply(ALICE, TxType.ttPAYMENT, fields={
+            sfDestination: BOB.account_id,
+            sfAmount: iou(50, GATEWAY),
+            sfSendMax: STAmount.from_drops(20 * M),
+        })
+        assert net.iou_balance(BOB, GATEWAY) == iou(1050, GATEWAY)
+
+
+class TestPathfinder:
+    def test_finds_gateway_path(self):
+        net = Net(ALICE, BOB, GATEWAY)
+        net.trust(ALICE, GATEWAY, 10_000)
+        net.trust(BOB, GATEWAY, 10_000)
+        net.pay(GATEWAY, ALICE.account_id, iou(500, GATEWAY))
+        alts = find_paths(
+            net.ledger, ALICE.account_id, BOB.account_id, iou(100, GATEWAY)
+        )
+        assert alts, "expected at least the default path"
+        assert alts[0]["source_amount"] == iou(100, GATEWAY)
+
+    def test_finds_book_path_cross_currency(self):
+        net = TestFlowCrossCurrency()._net_with_book()
+        alts = find_paths(
+            net.ledger, ALICE.account_id, BOB.account_id,
+            iou(40, GATEWAY, EUR), send_max=iou(60, GATEWAY),
+        )
+        assert alts
+        # best source amount: 40 EUR at 1.25 = 50 USD
+        assert alts[0]["source_amount"] == iou(50, GATEWAY)
+
+    def test_no_path_returns_empty(self):
+        net = Net(ALICE, BOB)
+        alts = find_paths(
+            net.ledger, ALICE.account_id, BOB.account_id, iou(10, CAROL)
+        )
+        assert alts == []
+
+
+class TestOrderBookDB:
+    def test_indexes_books(self):
+        net = TestFlowCrossCurrency()._net_with_book()
+        db = OrderBookDB().setup(net.ledger)
+        assert len(db) == 1
+        books = db.books_taking(USD, GATEWAY.account_id)
+        assert len(books) == 1
+        b = next(iter(books))
+        assert b.out_currency == EUR
+
+
+class TestReviewRegressions:
+    def test_pathed_payment_without_sendmax(self):
+        # paths + no SendMax: the placeholder source issuer (the sender)
+        # must not imply a book hop
+        net = Net(ALICE, BOB, CAROL, GATEWAY)
+        for k in (ALICE, BOB, CAROL):
+            net.trust(k, GATEWAY, 10_000)
+        net.pay(GATEWAY, ALICE.account_id, iou(500, GATEWAY))
+        net.apply(ALICE, TxType.ttPAYMENT, fields={
+            sfDestination: BOB.account_id,
+            sfAmount: iou(100, GATEWAY),
+            sfPaths: STPathSet([[PathElement(account=GATEWAY.account_id)]]),
+        })
+        assert net.iou_balance(BOB, GATEWAY) == iou(100, GATEWAY)
+
+    def test_cross_currency_self_conversion(self):
+        net = TestFlowCrossCurrency()._net_with_book()
+        # alice converts her own USD into EUR via the book
+        net.apply(ALICE, TxType.ttPAYMENT, fields={
+            sfDestination: ALICE.account_id,
+            sfAmount: iou(40, GATEWAY, EUR),
+            sfSendMax: iou(60, GATEWAY),
+        })
+        assert net.iou_balance(ALICE, GATEWAY, EUR) == iou(1040, GATEWAY, EUR)
+        assert net.iou_balance(ALICE, GATEWAY) == iou(950, GATEWAY)
+
+    def test_no_ripple_pair_blocks_intermediary(self):
+        from stellard_tpu.engine.flags import tfSetNoRipple
+
+        net = Net(ALICE, BOB, CAROL)
+        # carol is the middle: alice and bob each trust carol's USD.
+        # carol must set NoRipple while her balances are still >= 0
+        # (the reference refuses the flag on a negative balance)
+        net.trust(ALICE, CAROL, 1000)
+        net.trust(BOB, CAROL, 1000)
+        net.trust(CAROL, ALICE, 0, flags=tfSetNoRipple)
+        net.trust(CAROL, BOB, 0, flags=tfSetNoRipple)
+        net.pay(CAROL, ALICE.account_id, iou(100, CAROL))
+        net.apply(ALICE, TxType.ttPAYMENT, expect=TER.tecPATH_DRY, fields={
+            sfDestination: BOB.account_id,
+            sfAmount: iou(50, CAROL),
+            sfPaths: STPathSet([[PathElement(account=CAROL.account_id)]]),
+        })
+
+    def test_limit_quality_rejects_bad_rate(self):
+        from stellard_tpu.engine.flags import tfLimitQuality
+
+        net = TestFlowCrossCurrency()._net_with_book()
+        # book price is 1.25 USD/EUR; sender demands 1:1 via LimitQuality
+        net.apply(ALICE, TxType.ttPAYMENT, expect=TER.tecPATH_DRY, fields={
+            sfDestination: BOB.account_id,
+            sfAmount: iou(40, GATEWAY, EUR),
+            sfSendMax: iou(40, GATEWAY),
+            sfFlags: tfLimitQuality,
+        })
