@@ -186,44 +186,49 @@ def _batch_zero(ref_arr):
     return (ref_arr[..., :1] * 0)[..., None]
 
 
+def _onehot16(w):
+    """[...] int32 in [0,16) -> [..., 16] int32 one-hot. Table selection
+    by one-hot contraction instead of gather: per-lane gathers serialize
+    on TPU, while the contraction is a dense (MXU/VPU) op."""
+    return (w[..., None] == jnp.arange(16, dtype=w.dtype)).astype(jnp.int32)
+
+
 def _comb_mult(s_windows):
     """[S]B via the comb: s_windows [..., 64] int32 (4-bit, LSB window
-    first). 64 complete additions, no doublings."""
-    table = jnp.asarray(_comb_table_np())
+    first). 64 complete additions, no doublings; each table entry is
+    selected with a [B,16] x [16,80] one-hot matmul (shared table → this
+    rides the MXU)."""
+    table = jnp.asarray(_comb_table_np())  # [64, 16, 4, 20]
+    flat = table.reshape(NWINDOWS, 16, 4 * NLIMB)
     acc0 = pt_identity(s_windows.shape[:-1]) + _batch_zero(s_windows)
 
     def body(j, acc):
-        tj = lax.dynamic_index_in_dim(table, j, axis=0, keepdims=False)  # [16,4,20]
-        w = s_windows[..., j]  # [...]
-        entry = tj[w]  # gather -> [..., 4, 20]
+        tj = lax.dynamic_index_in_dim(flat, j, axis=0, keepdims=False)  # [16,80]
+        onehot = _onehot16(s_windows[..., j])  # [..., 16]
+        entry = jnp.matmul(onehot, tj).reshape(onehot.shape[:-1] + (4, NLIMB))
         return pt_add(acc, entry)
 
     return lax.fori_loop(0, NWINDOWS, body, acc0)
 
 
 def _windowed_mult(h_windows, point):
-    """[h]P via 4-bit windows, MSB window first: h_windows [..., 64]."""
+    """[h]P via 4-bit windows, MSB window first: h_windows [..., 64].
+    The per-element multiples table is built with an unrolled chain of 14
+    additions; selection is a one-hot weighted sum over the table axis
+    (again: no gathers)."""
     batch = h_windows.shape[:-1]
-    # per-element table [..., 16, 4, 20]: 0P..15P
-    tbl0 = (
-        jnp.broadcast_to(pt_identity(batch)[..., None, :, :], batch + (16, 4, NLIMB))
-        + _batch_zero(h_windows)[..., None]
-    )
-
-    def build(i, tbl):
-        prev = lax.dynamic_index_in_dim(tbl, i - 1, axis=-3, keepdims=False)
-        nxt = pt_add(prev, point)[..., None, :, :]
-        return lax.dynamic_update_slice_in_dim(tbl, nxt, i, axis=-3)
-
-    tbl = lax.fori_loop(1, 16, build, tbl0)
+    # unrolled per-element table 0P..15P: [..., 16, 4, 20]
+    entries = [pt_identity(batch) + _batch_zero(h_windows), point]
+    for _ in range(14):
+        entries.append(pt_add(entries[-1], point))
+    tbl = jnp.stack(entries, axis=-3)  # [..., 16, 4, 20]
 
     def body(i, acc):
         for _ in range(WINDOW):
             acc = pt_double(acc)
         w = h_windows[..., NWINDOWS - 1 - i]  # windows LSB-first; walk MSB->LSB
-        entry = jnp.take_along_axis(
-            tbl, w[..., None, None, None], axis=-3
-        ).squeeze(-3)
+        onehot = _onehot16(w)[..., :, None, None]  # [..., 16, 1, 1]
+        entry = jnp.sum(onehot * tbl, axis=-3)  # [..., 4, 20]
         return pt_add(acc, entry)
 
     acc0 = pt_identity(batch) + _batch_zero(h_windows)
