@@ -1,0 +1,193 @@
+// cpplog: a log-structured content-addressed NodeStore backend.
+//
+// Role parity: the reference vendors LevelDB/HyperLevelDB/RocksDB as
+// NodeStore backends (SURVEY §2.8). A ledger NodeStore is a much easier
+// case than a general KV store: keys are 32-byte content hashes
+// (immutable, never overwritten, no range scans), so an append-only
+// data log plus an open-addressed hash index gives O(1) reads/writes
+// with one fsync per batch — the same role, a fraction of the machinery.
+//
+// File layout:
+//   <path>.log : [u32 len | u8 type | 32B key | blob] records, appended
+//   index      : in-memory open addressing, rebuilt by scanning the log
+//                on open (the log IS the database; crash-safe by replay)
+//
+// C ABI consumed via ctypes from stellard_tpu/nodestore/cpplog.py.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <unistd.h>  // fsync, ftruncate
+
+namespace {
+
+struct Slot {
+  uint8_t key[32];
+  uint64_t offset;  // offset of the record body in the log, +1 (0 = empty)
+};
+
+struct Store {
+  FILE* f = nullptr;
+  std::string path;
+  std::vector<Slot> slots;
+  uint64_t count = 0;
+  uint64_t file_size = 0;
+
+  size_t mask() const { return slots.size() - 1; }
+};
+
+static inline uint64_t key_hash(const uint8_t* key) {
+  // keys are uniform hashes already: take 8 bytes
+  uint64_t h;
+  memcpy(&h, key, 8);
+  return h;
+}
+
+static void index_put(Store* s, const uint8_t* key, uint64_t offset_plus1) {
+  size_t i = key_hash(key) & s->mask();
+  while (s->slots[i].offset != 0) {
+    if (memcmp(s->slots[i].key, key, 32) == 0) return;  // content-addressed
+    i = (i + 1) & s->mask();
+  }
+  memcpy(s->slots[i].key, key, 32);
+  s->slots[i].offset = offset_plus1;
+  s->count++;
+}
+
+static void index_grow(Store* s) {
+  std::vector<Slot> old = std::move(s->slots);
+  s->slots.assign(old.size() * 2, Slot{});
+  s->count = 0;
+  for (const Slot& sl : old)
+    if (sl.offset) index_put(s, sl.key, sl.offset);
+}
+
+static bool read_exact(FILE* f, void* buf, size_t n) {
+  return fread(buf, 1, n, f) == n;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* cpplog_open(const char* path) {
+  Store* s = new Store();
+  s->path = path;
+  s->slots.assign(1 << 16, Slot{});
+  FILE* f = fopen(path, "ab+");
+  if (!f) {
+    delete s;
+    return nullptr;
+  }
+  s->f = f;
+  // replay the log to rebuild the index; a torn tail (crash mid-append)
+  // is truncated away so new appends land exactly where the last VALID
+  // record ends — otherwise the torn header's length would desynchronize
+  // every later replay
+  fseek(f, 0, SEEK_END);
+  uint64_t end = (uint64_t)ftell(f);
+  fseek(f, 0, SEEK_SET);
+  uint64_t off = 0;
+  for (;;) {
+    uint8_t hdr[5];
+    if (!read_exact(f, hdr, 5)) break;
+    uint32_t len;
+    memcpy(&len, hdr, 4);
+    uint64_t body = off + 5 + 32;
+    if (body + len > end) break;  // torn record: header claims past EOF
+    uint8_t key[32];
+    if (!read_exact(f, key, 32)) break;
+    if (fseek(f, (long)len, SEEK_CUR) != 0) break;
+    if (s->count * 10 >= s->slots.size() * 7) index_grow(s);
+    index_put(s, key, body + 1);
+    off = body + len;
+  }
+  if (off < end) {
+    fflush(f);
+    if (ftruncate(fileno(f), (off_t)off) != 0) {
+      fclose(f);
+      delete s;
+      return nullptr;
+    }
+  }
+  fseek(f, 0, SEEK_END);
+  s->file_size = (uint64_t)ftell(f);
+  return s;
+}
+
+// store one record; returns 0 on success
+int cpplog_put(void* handle, const uint8_t* key, uint8_t type,
+               const uint8_t* blob, uint32_t len) {
+  Store* s = (Store*)handle;
+  {
+    // dedup: content-addressed, second write is a no-op
+    size_t i = key_hash(key) & s->mask();
+    while (s->slots[i].offset != 0) {
+      if (memcmp(s->slots[i].key, key, 32) == 0) return 0;
+      i = (i + 1) & s->mask();
+    }
+  }
+  uint8_t hdr[5];
+  uint32_t body_len = len + 1;  // type byte + blob
+  memcpy(hdr, &body_len, 4);
+  hdr[4] = 0;  // reserved
+  fseek(s->f, 0, SEEK_END);
+  uint64_t off = (uint64_t)ftell(s->f);
+  if (fwrite(hdr, 1, 5, s->f) != 5) return -1;
+  if (fwrite(key, 1, 32, s->f) != 32) return -1;
+  if (fwrite(&type, 1, 1, s->f) != 1) return -1;
+  if (len && fwrite(blob, 1, len, s->f) != len) return -1;
+  if (s->count * 10 >= s->slots.size() * 7) index_grow(s);
+  index_put(s, key, off + 5 + 32 + 1);
+  s->file_size = off + 5 + 32 + body_len;
+  return 0;
+}
+
+// fetch: returns blob length (incl. type byte at out[0]); -1 if absent;
+// when the caller's buffer is too small, returns -2 - needed_length so
+// the caller can resize exactly and retry
+int64_t cpplog_get(void* handle, const uint8_t* key, uint8_t* out,
+                   uint64_t out_cap) {
+  Store* s = (Store*)handle;
+  size_t i = key_hash(key) & s->mask();
+  while (s->slots[i].offset != 0) {
+    if (memcmp(s->slots[i].key, key, 32) == 0) {
+      uint64_t body = s->slots[i].offset - 1;
+      // record header sits 37 bytes before the body
+      fseek(s->f, (long)(body - 37), SEEK_SET);
+      uint8_t hdr[5];
+      if (!read_exact(s->f, hdr, 5)) return -1;
+      uint32_t body_len;
+      memcpy(&body_len, hdr, 4);
+      if (body_len > out_cap) return -2 - (int64_t)body_len;
+      fseek(s->f, (long)body, SEEK_SET);
+      if (!read_exact(s->f, out, body_len)) return -1;
+      return (int64_t)body_len;
+    }
+    i = (i + 1) & s->mask();
+  }
+  return -1;
+}
+
+uint64_t cpplog_count(void* handle) { return ((Store*)handle)->count; }
+
+int cpplog_sync(void* handle) {
+  FILE* f = ((Store*)handle)->f;
+  if (fflush(f) != 0) return -1;
+  return fsync(fileno(f));  // page cache → disk: the durability promise
+}
+
+void cpplog_close(void* handle) {
+  Store* s = (Store*)handle;
+  if (s->f) {
+    fflush(s->f);
+    fclose(s->f);
+  }
+  delete s;
+}
+
+}  // extern "C"

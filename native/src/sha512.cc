@@ -1,0 +1,180 @@
+// Batched SHA-512 / SHA-512-half for the host hashing plane.
+//
+// Role parity: the reference computes every tree/identity hash with
+// OpenSSL SHA-512 one call at a time (Serializer.cpp:342-390). Here the
+// batch API hashes N independent messages in one C call (OpenMP-style
+// threading left to the caller; the Python side slices batches across a
+// thread pool with the GIL released by ctypes).
+//
+// Implementation is from the FIPS 180-4 specification.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+typedef uint64_t u64;
+
+static const u64 K[80] = {
+    0x428a2f98d728ae22ULL, 0x7137449123ef65cdULL, 0xb5c0fbcfec4d3b2fULL,
+    0xe9b5dba58189dbbcULL, 0x3956c25bf348b538ULL, 0x59f111f1b605d019ULL,
+    0x923f82a4af194f9bULL, 0xab1c5ed5da6d8118ULL, 0xd807aa98a3030242ULL,
+    0x12835b0145706fbeULL, 0x243185be4ee4b28cULL, 0x550c7dc3d5ffb4e2ULL,
+    0x72be5d74f27b896fULL, 0x80deb1fe3b1696b1ULL, 0x9bdc06a725c71235ULL,
+    0xc19bf174cf692694ULL, 0xe49b69c19ef14ad2ULL, 0xefbe4786384f25e3ULL,
+    0x0fc19dc68b8cd5b5ULL, 0x240ca1cc77ac9c65ULL, 0x2de92c6f592b0275ULL,
+    0x4a7484aa6ea6e483ULL, 0x5cb0a9dcbd41fbd4ULL, 0x76f988da831153b5ULL,
+    0x983e5152ee66dfabULL, 0xa831c66d2db43210ULL, 0xb00327c898fb213fULL,
+    0xbf597fc7beef0ee4ULL, 0xc6e00bf33da88fc2ULL, 0xd5a79147930aa725ULL,
+    0x06ca6351e003826fULL, 0x142929670a0e6e70ULL, 0x27b70a8546d22ffcULL,
+    0x2e1b21385c26c926ULL, 0x4d2c6dfc5ac42aedULL, 0x53380d139d95b3dfULL,
+    0x650a73548baf63deULL, 0x766a0abb3c77b2a8ULL, 0x81c2c92e47edaee6ULL,
+    0x92722c851482353bULL, 0xa2bfe8a14cf10364ULL, 0xa81a664bbc423001ULL,
+    0xc24b8b70d0f89791ULL, 0xc76c51a30654be30ULL, 0xd192e819d6ef5218ULL,
+    0xd69906245565a910ULL, 0xf40e35855771202aULL, 0x106aa07032bbd1b8ULL,
+    0x19a4c116b8d2d0c8ULL, 0x1e376c085141ab53ULL, 0x2748774cdf8eeb99ULL,
+    0x34b0bcb5e19b48a8ULL, 0x391c0cb3c5c95a63ULL, 0x4ed8aa4ae3418acbULL,
+    0x5b9cca4f7763e373ULL, 0x682e6ff3d6b2b8a3ULL, 0x748f82ee5defb2fcULL,
+    0x78a5636f43172f60ULL, 0x84c87814a1f0ab72ULL, 0x8cc702081a6439ecULL,
+    0x90befffa23631e28ULL, 0xa4506cebde82bde9ULL, 0xbef9a3f7b2c67915ULL,
+    0xc67178f2e372532bULL, 0xca273eceea26619cULL, 0xd186b8c721c0c207ULL,
+    0xeada7dd6cde0eb1eULL, 0xf57d4f7fee6ed178ULL, 0x06f067aa72176fbaULL,
+    0x0a637dc5a2c898a6ULL, 0x113f9804bef90daeULL, 0x1b710b35131c471bULL,
+    0x28db77f523047d84ULL, 0x32caab7b40c72493ULL, 0x3c9ebe0a15c9bebcULL,
+    0x431d67c49c100d4cULL, 0x4cc5d4becb3e42b6ULL, 0x597f299cfc657e2aULL,
+    0x5fcb6fab3ad6faecULL, 0x6c44198c4a475817ULL};
+
+static inline u64 rotr(u64 x, int n) { return (x >> n) | (x << (64 - n)); }
+static inline u64 load64(const uint8_t* p) {
+  u64 v = 0;
+  for (int i = 0; i < 8; i++) v = (v << 8) | p[i];
+  return v;
+}
+static inline void store64(uint8_t* p, u64 v) {
+  for (int i = 7; i >= 0; i--) {
+    p[i] = (uint8_t)(v & 0xff);
+    v >>= 8;
+  }
+}
+
+struct State {
+  u64 h[8];
+};
+
+static void init(State* s) {
+  static const u64 H0[8] = {0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL,
+                            0x3c6ef372fe94f82bULL, 0xa54ff53a5f1d36f1ULL,
+                            0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+                            0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+  memcpy(s->h, H0, sizeof(H0));
+}
+
+static void compress(State* s, const uint8_t* block) {
+  u64 w[80];
+  for (int t = 0; t < 16; t++) w[t] = load64(block + 8 * t);
+  for (int t = 16; t < 80; t++) {
+    u64 s0 = rotr(w[t - 15], 1) ^ rotr(w[t - 15], 8) ^ (w[t - 15] >> 7);
+    u64 s1 = rotr(w[t - 2], 19) ^ rotr(w[t - 2], 61) ^ (w[t - 2] >> 6);
+    w[t] = w[t - 16] + s0 + w[t - 7] + s1;
+  }
+  u64 a = s->h[0], b = s->h[1], c = s->h[2], d = s->h[3];
+  u64 e = s->h[4], f = s->h[5], g = s->h[6], h = s->h[7];
+  for (int t = 0; t < 80; t++) {
+    u64 S1 = rotr(e, 14) ^ rotr(e, 18) ^ rotr(e, 41);
+    u64 ch = (e & f) ^ (~e & g);
+    u64 t1 = h + S1 + ch + K[t] + w[t];
+    u64 S0 = rotr(a, 28) ^ rotr(a, 34) ^ rotr(a, 39);
+    u64 maj = (a & b) ^ (a & c) ^ (b & c);
+    u64 t2 = S0 + maj;
+    h = g;
+    g = f;
+    f = e;
+    e = d + t1;
+    d = c;
+    c = b;
+    b = a;
+    a = t1 + t2;
+  }
+  s->h[0] += a;
+  s->h[1] += b;
+  s->h[2] += c;
+  s->h[3] += d;
+  s->h[4] += e;
+  s->h[5] += f;
+  s->h[6] += g;
+  s->h[7] += h;
+}
+
+static void sha512_one(const uint8_t* prefix, size_t prefix_len,
+                       const uint8_t* msg, size_t len, uint8_t* out,
+                       size_t out_len) {
+  State s;
+  init(&s);
+  uint8_t block[128];
+  size_t total = prefix_len + len;
+  size_t fill = 0;
+  // stream prefix then message through 128-byte blocks
+  const uint8_t* parts[2] = {prefix, msg};
+  size_t lens[2] = {prefix_len, len};
+  for (int p = 0; p < 2; p++) {
+    const uint8_t* data = parts[p];
+    size_t n = lens[p];
+    while (n > 0) {
+      size_t take = 128 - fill;
+      if (take > n) take = n;
+      memcpy(block + fill, data, take);
+      fill += take;
+      data += take;
+      n -= take;
+      if (fill == 128) {
+        compress(&s, block);
+        fill = 0;
+      }
+    }
+  }
+  // padding
+  block[fill++] = 0x80;
+  if (fill > 112) {
+    memset(block + fill, 0, 128 - fill);
+    compress(&s, block);
+    fill = 0;
+  }
+  memset(block + fill, 0, 128 - fill);
+  store64(block + 112, 0);  // length high (messages < 2^61 bytes)
+  store64(block + 120, (u64)total * 8);
+  compress(&s, block);
+  uint8_t digest[64];
+  for (int i = 0; i < 8; i++) store64(digest + 8 * i, s.h[i]);
+  memcpy(out, digest, out_len);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Batched prefixed SHA-512-half: for each i, out[i] = first `out_len`
+// bytes of SHA512(prefix_i ‖ msg_i). Prefixes are 4-byte big-endian
+// values in `prefixes`; pass NULL for unprefixed hashing. A zero prefix
+// IS hashed as four zero bytes — identical to the python/tpu backends,
+// so the pluggable hashers stay bit-interchangeable.
+void sha512h_batch(const uint8_t* data, const uint64_t* offsets,
+                   const uint32_t* prefixes, uint8_t* out, uint64_t n,
+                   uint64_t out_len) {
+  for (uint64_t i = 0; i < n; i++) {
+    uint8_t pfx[4];
+    size_t pfx_len = 0;
+    if (prefixes) {
+      uint32_t p = prefixes[i];
+      pfx[0] = (uint8_t)(p >> 24);
+      pfx[1] = (uint8_t)(p >> 16);
+      pfx[2] = (uint8_t)(p >> 8);
+      pfx[3] = (uint8_t)p;
+      pfx_len = 4;
+    }
+    sha512_one(pfx, pfx_len, data + offsets[i],
+               (size_t)(offsets[i + 1] - offsets[i]), out + i * out_len,
+               (size_t)out_len);
+  }
+}
+
+}  // extern "C"

@@ -176,3 +176,25 @@ register_verifier("cpu", CpuVerifier)
 register_verifier("tpu", TpuVerifier)
 register_hasher("cpu", CpuHasher)
 register_hasher("tpu", TpuHasher)
+
+
+class CppHasher(BatchHasher):
+    """Native batched SHA-512-half (native/src/sha512.cc) — one C call
+    per batch, filling the reference's OpenSSL-hashing role for the host
+    path when the device hasher isn't warranted."""
+
+    name = "cpp"
+
+    def __init__(self, **_):
+        from ..native import Sha512Native
+
+        self._impl = Sha512Native()
+
+    def prefix_hash_batch(self, prefixes, payloads):
+        return self._impl.prefix_hash_batch(prefixes, payloads)
+
+
+# registered unconditionally: CppHasher.__init__ raises a clean error on
+# a toolchain-less box, and the (one-time) native build cost lands only
+# on callers that actually select the cpp backend — never at import
+register_hasher("cpp", CppHasher)

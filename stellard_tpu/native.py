@@ -1,0 +1,177 @@
+"""Loader for the native C++ components (native/libstellard_native.so).
+
+The reference's performance-critical host components are C++ (NodeStore
+backends, OpenSSL hashing — SURVEY §2 [native-perf]); this module builds
+and binds their equivalents. The library is compiled on first use with
+`make` (toolchain is in the image) and cached; every consumer degrades
+gracefully to the pure-Python path when the toolchain or build is
+unavailable, mirroring the pluggable-backend seam.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+__all__ = ["load_native", "native_available", "Sha512Native", "CppLogLib"]
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libstellard_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """Build (once) and dlopen the native library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH):
+            if not os.path.isdir(_NATIVE_DIR):
+                return None
+            try:
+                subprocess.run(
+                    ["make", "-s"],
+                    cwd=_NATIVE_DIR,
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except (OSError, subprocess.SubprocessError):
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        _bind(lib)
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return load_native() is not None
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.sha512h_batch.argtypes = [
+        ctypes.c_char_p,  # packed data
+        ctypes.POINTER(ctypes.c_uint64),  # offsets[n+1]
+        ctypes.POINTER(ctypes.c_uint32),  # prefixes[n]
+        u8p,  # out
+        ctypes.c_uint64,  # n
+        ctypes.c_uint64,  # out_len
+    ]
+    lib.sha512h_batch.restype = None
+
+    lib.cpplog_open.argtypes = [ctypes.c_char_p]
+    lib.cpplog_open.restype = ctypes.c_void_p
+    lib.cpplog_put.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint8,
+        ctypes.c_char_p, ctypes.c_uint32,
+    ]
+    lib.cpplog_put.restype = ctypes.c_int
+    lib.cpplog_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, u8p, ctypes.c_uint64,
+    ]
+    lib.cpplog_get.restype = ctypes.c_int64
+    lib.cpplog_count.argtypes = [ctypes.c_void_p]
+    lib.cpplog_count.restype = ctypes.c_uint64
+    lib.cpplog_sync.argtypes = [ctypes.c_void_p]
+    lib.cpplog_sync.restype = ctypes.c_int
+    lib.cpplog_close.argtypes = [ctypes.c_void_p]
+    lib.cpplog_close.restype = None
+
+
+class Sha512Native:
+    """Batched prefixed SHA-512-half over the C kernel."""
+
+    def __init__(self):
+        self.lib = load_native()
+        if self.lib is None:
+            raise RuntimeError("native library unavailable")
+
+    def prefix_hash_batch(self, prefixes, payloads, out_len: int = 32) -> list[bytes]:
+        n = len(payloads)
+        if n == 0:
+            return []
+        data = b"".join(payloads)
+        offsets = (ctypes.c_uint64 * (n + 1))()
+        pos = 0
+        for i, p in enumerate(payloads):
+            offsets[i] = pos
+            pos += len(p)
+        offsets[n] = pos
+        pfx = (ctypes.c_uint32 * n)(*[int(p) & 0xFFFFFFFF for p in prefixes])
+        out = (ctypes.c_uint8 * (n * out_len))()
+        self.lib.sha512h_batch(
+            data, offsets, pfx, out, n, out_len
+        )
+        raw = bytes(out)
+        return [raw[i * out_len : (i + 1) * out_len] for i in range(n)]
+
+
+class CppLogLib:
+    """ctypes handle for one cpplog store. Thread-safe via a Python lock
+    (the C side shares one FILE* between reads and appends)."""
+
+    def __init__(self, path: str):
+        self.lib = load_native()
+        if self.lib is None:
+            raise RuntimeError("native library unavailable")
+        self._handle = self.lib.cpplog_open(path.encode())
+        if not self._handle:
+            raise OSError(f"cpplog_open failed: {path}")
+        self._lock = threading.Lock()
+        self._buf = (ctypes.c_uint8 * 65536)()
+
+    def put(self, key: bytes, type_byte: int, blob: bytes) -> None:
+        assert len(key) == 32
+        with self._lock:
+            rc = self.lib.cpplog_put(
+                self._handle, key, type_byte, blob, len(blob)
+            )
+        if rc != 0:
+            raise OSError("cpplog_put failed")
+
+    def get(self, key: bytes) -> Optional[tuple[int, bytes]]:
+        assert len(key) == 32
+        with self._lock:
+            n = self.lib.cpplog_get(
+                self._handle, key, self._buf, len(self._buf)
+            )
+            if n <= -2:
+                # -2 - needed_length: retry with an exact-size buffer
+                # (one-off; the shared buffer keeps its normal size)
+                need = int(-2 - n)
+                big = (ctypes.c_uint8 * need)()
+                n = self.lib.cpplog_get(self._handle, key, big, need)
+                if n < 0:
+                    raise OSError("cpplog_get failed after resize")
+                raw = bytes(big[: int(n)])
+                return raw[0], raw[1:]
+            if n < 0:
+                return None
+            raw = bytes(self._buf[: int(n)])
+        return raw[0], raw[1:]
+
+    def count(self) -> int:
+        with self._lock:
+            return int(self.lib.cpplog_count(self._handle))
+
+    def sync(self) -> None:
+        with self._lock:
+            self.lib.cpplog_sync(self._handle)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._handle:
+                self.lib.cpplog_close(self._handle)
+                self._handle = None

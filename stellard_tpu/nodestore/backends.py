@@ -109,3 +109,40 @@ class SqliteBackend(Backend):
 register_backend("memory", MemoryBackend)
 register_backend("null", NullBackend)
 register_backend("sqlite", SqliteBackend)
+
+
+class CppLogBackend(Backend):
+    """Native log-structured backend (native/src/nodestore.cc) — the
+    C++ store filling the LevelDB/RocksDB role (SURVEY §2.8): append-only
+    data log + in-memory hash index, replayed on open."""
+
+    name = "cpplog"
+
+    def __init__(self, path: str = "nodestore.cpplog", **_):
+        from ..native import CppLogLib
+
+        self._db = CppLogLib(path)
+
+    def fetch(self, hash: bytes) -> Optional[NodeObject]:
+        got = self._db.get(hash)
+        if got is None:
+            return None
+        type_byte, blob = got
+        return NodeObject(NodeObjectType(type_byte), hash, blob)
+
+    def store_batch(self, batch: list[NodeObject]) -> None:
+        for obj in batch:
+            self._db.put(obj.hash, int(obj.type), obj.data)
+        self._db.sync()
+
+    def iterate(self):
+        raise NotImplementedError("cpplog iteration not supported")
+
+    def close(self) -> None:
+        self._db.close()
+
+
+# registered unconditionally: construction raises a clean error when the
+# native toolchain is unavailable, and the one-time build cost lands on
+# first use, never at import
+register_backend("cpplog", CppLogBackend)
