@@ -123,6 +123,7 @@ void* cpplog_open(const char* path) {
 int cpplog_put(void* handle, const uint8_t* key, uint8_t type,
                const uint8_t* blob, uint32_t len) {
   Store* s = (Store*)handle;
+  if (!s->f) return -1;  // store previously failed; refuse further puts
   {
     // dedup: content-addressed, second write is a no-op
     size_t i = key_hash(key) & s->mask();
@@ -137,10 +138,23 @@ int cpplog_put(void* handle, const uint8_t* key, uint8_t type,
   hdr[4] = 0;  // reserved
   fseek(s->f, 0, SEEK_END);
   uint64_t off = (uint64_t)ftell(s->f);
-  if (fwrite(hdr, 1, 5, s->f) != 5) return -1;
-  if (fwrite(key, 1, 32, s->f) != 32) return -1;
-  if (fwrite(&type, 1, 1, s->f) != 1) return -1;
-  if (len && fwrite(blob, 1, len, s->f) != len) return -1;
+  bool ok = fwrite(hdr, 1, 5, s->f) == 5 && fwrite(key, 1, 32, s->f) == 32 &&
+            fwrite(&type, 1, 1, s->f) == 1 &&
+            (len == 0 || fwrite(blob, 1, len, s->f) == len);
+  if (!ok) {
+    // a torn record would desynchronize the reopen replay at its header,
+    // silently dropping every later record — truncate it away so a
+    // subsequent successful put appends at a clean boundary. If either
+    // the flush or the truncate fails we cannot guarantee a clean tail
+    // (stale stdio-buffered bytes could later be flushed past the
+    // truncated EOF): mark the store failed and refuse further puts.
+    if (fflush(s->f) != 0 ||
+        ftruncate(fileno(s->f), (off_t)off) != 0) {
+      fclose(s->f);
+      s->f = nullptr;
+    }
+    return -1;
+  }
   if (s->count * 10 >= s->slots.size() * 7) index_grow(s);
   index_put(s, key, off + 5 + 32 + 1);
   s->file_size = off + 5 + 32 + body_len;
@@ -153,6 +167,7 @@ int cpplog_put(void* handle, const uint8_t* key, uint8_t type,
 int64_t cpplog_get(void* handle, const uint8_t* key, uint8_t* out,
                    uint64_t out_cap) {
   Store* s = (Store*)handle;
+  if (!s->f) return -1;
   size_t i = key_hash(key) & s->mask();
   while (s->slots[i].offset != 0) {
     if (memcmp(s->slots[i].key, key, 32) == 0) {
@@ -177,7 +192,7 @@ uint64_t cpplog_count(void* handle) { return ((Store*)handle)->count; }
 
 int cpplog_sync(void* handle) {
   FILE* f = ((Store*)handle)->f;
-  if (fflush(f) != 0) return -1;
+  if (!f || fflush(f) != 0) return -1;
   return fsync(fileno(f));  // page cache → disk: the durability promise
 }
 

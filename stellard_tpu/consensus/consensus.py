@@ -307,7 +307,13 @@ class LedgerConsensus:
             if prop.tx_set_hash == our_hash:
                 agree += 1
         target = max(self.prev_proposers, len(self.peer_positions) + 1)
-        if have_consensus(target, len(self.peer_positions), agree):
+        if have_consensus(
+            target,
+            len(self.peer_positions),
+            agree,
+            self._ms_since(self.consensus_start),
+            self.prev_round_ms,
+        ):
             self.state = ConsensusState.FINISHED
             self.accept(ct, ct_agree)
 

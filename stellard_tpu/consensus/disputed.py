@@ -37,7 +37,11 @@ class DisputedTx:
         vote flips (→ we must advance our position)
         (reference: DisputedTx::updateVote — our current vote is weighted
         in with the peers', then compared to the escalating threshold)."""
-        if proposing:
+        if self.our_vote and self.nays == 0:
+            new_vote = True  # unanimous agreement with us: keep
+        elif not self.our_vote and self.yays == 0:
+            new_vote = False  # nobody disagrees with our NO: keep
+        elif proposing:
             weight = (self.yays * 100 + (100 if self.our_vote else 0)) // (
                 self.yays + self.nays + 1
             )

@@ -101,14 +101,27 @@ def have_consensus(
     target_proposers: int,
     current_proposers: int,
     current_agree: int,
+    since_consensus_ms: int = 10**9,
+    prev_round_ms: int = 0,
 ) -> bool:
     """Decide whether our position has won
     (reference: ContinuousLedgerTiming::haveConsensus,
     LedgerTiming.cpp:95-141). `current_agree` counts proposers whose
     position matches ours; we count ourselves on top.
+
+    When fewer than 3/4 of last round's proposers are present we only
+    *slow down* (wait one previous-round-time plus the minimum window, as
+    the reference does) — a hard wait would deadlock the network forever
+    after a validator crash, since the straggler count never recovers
+    until a round completes.
     """
-    if current_proposers + 1 < target_proposers:
-        return False  # wait for stragglers
+    # truncating division exactly as the reference: for 3 proposers the
+    # bar is 2, so a healthy small net (2 of 3 peers present) does NOT
+    # slow down — only a real shortfall does
+    if current_proposers < (target_proposers * 3) // 4 and (
+        since_consensus_ms < prev_round_ms + LEDGER_MIN_CONSENSUS_MS
+    ):
+        return False  # give stragglers one extra round-time to appear
     in_consensus = (current_agree * 100 + 100) // (current_proposers + 1)
     return in_consensus >= CONSENSUS_PCT
 

@@ -168,7 +168,11 @@ class CppLogLib:
 
     def sync(self) -> None:
         with self._lock:
-            self.lib.cpplog_sync(self._handle)
+            rc = self.lib.cpplog_sync(self._handle)
+        if rc != 0:
+            # the store is failed (earlier torn write) or fsync failed:
+            # callers must NOT believe the batch is durable
+            raise OSError("cpplog_sync failed")
 
     def close(self) -> None:
         with self._lock:

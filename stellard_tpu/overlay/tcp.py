@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import os
 import socket
+import struct
 import threading
 import time
 from typing import Callable, Optional
@@ -54,13 +55,18 @@ HP_SESSION = (ord("S") << 24) | (ord("S") << 16) | (ord("N") << 8)
 
 
 class _Peer:
-    def __init__(self, sock: socket.socket, inbound: bool):
+    def __init__(self, sock: socket.socket, inbound: bool,
+                 addr: Optional[tuple[str, int]] = None):
         self.sock = sock
         self.inbound = inbound
+        self.addr = addr  # configured dial address (outbound only)
         self.reader = FrameReader()
         self.node_public: bytes = b""
         self.send_lock = threading.Lock()
         self.alive = True
+        self.established_at = 0.0
+        # real wall-clock (not the node's virtual clock): socket liveness
+        self.last_recv = time.monotonic()
 
     def send(self, data: bytes) -> None:
         try:
@@ -93,11 +99,15 @@ class TcpOverlay(ConsensusAdapter):
         timer_interval: float = 1.0,
         idle_interval: int = 15,
         hash_batch: Optional[Callable] = None,
+        peer_idle_ping: float = 9.0,
+        peer_idle_drop: float = 30.0,
     ):
         self.key = key
         self.port = port
         self.peer_addrs = peer_addrs
         self.timer_interval = timer_interval
+        self.peer_idle_ping = peer_idle_ping
+        self.peer_idle_drop = peer_idle_drop
         self._clock = clock or time.monotonic
         self._ntime = network_time or (lambda: int(time.time()) - 946_684_800)
         self.node = ValidatorNode(
@@ -111,9 +121,11 @@ class TcpOverlay(ConsensusAdapter):
             hash_batch=hash_batch,
         )
         self.peers: dict[bytes, _Peer] = {}  # node pubkey -> session
+        self._dialing: set[tuple[str, int]] = set()  # dials in flight
         self._peers_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        self._threads_lock = threading.Lock()
         self._listener: Optional[socket.socket] = None
 
     # -- lifecycle --------------------------------------------------------
@@ -138,13 +150,17 @@ class TcpOverlay(ConsensusAdapter):
         with self._peers_lock:
             for p in list(self.peers.values()):
                 p.close()
-        for t in self._threads:
+        with self._threads_lock:
+            threads = list(self._threads)
+        for t in threads:
             t.join(timeout=2.0)
 
     def _spawn(self, fn, *args) -> None:
         t = threading.Thread(target=fn, args=args, daemon=True)
         t.start()
-        self._threads.append(t)
+        with self._threads_lock:
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
 
     # -- session establishment -------------------------------------------
 
@@ -158,22 +174,43 @@ class TcpOverlay(ConsensusAdapter):
 
     def _connect_loop(self) -> None:
         """Dial configured peers; redial on loss (reference: OverlayImpl
-        autoconnect via PeerFinder). Deterministic tie-break: only the
-        lexically-smaller node key dials, so each pair has one session."""
+        autoconnect via PeerFinder). Addresses with a live session (or a
+        dial in flight) are skipped so an established connection is never
+        churned by the redial timer."""
         while not self._stop.is_set():
-            for host, port in self.peer_addrs:
-                try:
-                    sock = socket.create_connection((host, port), timeout=2.0)
-                except OSError:
-                    continue
-                self._spawn(self._session, sock, False)
+            for addr in self.peer_addrs:
+                with self._peers_lock:
+                    if addr in self._dialing:
+                        continue
+                    if any(
+                        p.addr == addr and p.alive
+                        for p in self.peers.values()
+                    ):
+                        continue
+                    self._dialing.add(addr)
+                self._spawn(self._dial, addr)
             self._stop.wait(2.0)
 
-    def _session(self, sock: socket.socket, inbound: bool) -> None:
+    def _dial(self, addr: tuple[str, int]) -> None:
+        try:
+            sock = socket.create_connection(addr, timeout=2.0)
+        except OSError:
+            with self._peers_lock:
+                self._dialing.discard(addr)
+            return
+        self._session(sock, False, addr)
+
+    def _session(
+        self,
+        sock: socket.socket,
+        inbound: bool,
+        addr: Optional[tuple[str, int]] = None,
+    ) -> None:
         """Nonce exchange → signed hello → message pump
         (reference: PeerImp::onHandshake/recvHello)."""
-        peer = _Peer(sock, inbound)
+        peer = _Peer(sock, inbound, addr)
         try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
             sock.settimeout(5.0)
             nonce = os.urandom(32)
             sock.sendall(nonce)
@@ -201,23 +238,65 @@ class TcpOverlay(ConsensusAdapter):
                 peer.close()
                 return
             peer.node_public = their_hello.node_public
+            now = self._clock()
             with self._peers_lock:
                 existing = self.peers.get(peer.node_public)
                 if existing is not None:
-                    # one session per pair: the smaller key's dial wins
-                    if (self.key.public < peer.node_public) == inbound:
+                    young = (
+                        existing.alive
+                        and now - existing.established_at <= 5.0
+                    )
+                    fresh = (
+                        existing.alive
+                        and time.monotonic() - existing.last_recv
+                        <= self.peer_idle_ping
+                    )
+                    if young:
+                        # simultaneous-connect race: the smaller key's dial
+                        # wins, deterministically on both sides
+                        if (self.key.public < peer.node_public) == inbound:
+                            if existing.addr is None:
+                                existing.addr = peer.addr
+                            peer.close()
+                            return
+                    elif fresh:
+                        # existing session demonstrably alive (recent recv):
+                        # keep it; learn the dial addr so _connect_loop stops
+                        # redialing an inbound-only pair
+                        if existing.addr is None:
+                            existing.addr = peer.addr
                         peer.close()
                         return
+                    # else: existing is likely half-open (crashed peer) —
+                    # the fresh authenticated session displaces it; worst
+                    # case a restarted peer waits one idle-ping window
+                    if peer.addr is None:
+                        peer.addr = existing.addr
                     existing.close()
+                peer.established_at = now
                 self.peers[peer.node_public] = peer
             sock.settimeout(None)
+            # bounded sends only (SO_SNDTIMEO applies to send, not recv):
+            # a stalled peer with a full kernel buffer must never block the
+            # heartbeat/relay threads forever — sendall times out, send()
+            # marks the peer dead, the session cleans up
+            sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                struct.pack("ll", 10, 0),
+            )
             self._pump(peer)
         except OSError:
+            pass
+        except ValueError:
+            # malformed frame / unknown message type (version skew): close
+            # this peer cleanly instead of killing the reader thread
             pass
         finally:
             with self._peers_lock:
                 if self.peers.get(peer.node_public) is peer:
                     del self.peers[peer.node_public]
+                if peer.addr is not None:
+                    self._dialing.discard(peer.addr)
             peer.close()
 
     @staticmethod
@@ -249,6 +328,7 @@ class TcpOverlay(ConsensusAdapter):
                 return
             if not data:
                 return
+            peer.last_recv = time.monotonic()
             for msg in peer.reader.feed(data):
                 self._dispatch(peer, msg)
 
@@ -314,8 +394,24 @@ class TcpOverlay(ConsensusAdapter):
     # -- timer ------------------------------------------------------------
 
     def _timer_loop(self) -> None:
+        ping_seq = 0
         while not self._stop.wait(self.timer_interval):
             self.node.on_timer()
+            # Half-open detection: a crashed peer (no FIN/RST) leaves our
+            # reader blocked in recv with alive=True forever, which would
+            # also suppress redials. Ping idle peers; drop ones silent past
+            # the real-time threshold so the session cleans up and the
+            # connect loop can redial (reference: PeerImp NO_PING timeout).
+            now = time.monotonic()
+            with self._peers_lock:
+                peers = list(self.peers.values())
+            for p in peers:
+                idle = now - p.last_recv
+                if idle > self.peer_idle_drop:
+                    p.close()
+                elif idle > self.peer_idle_ping:
+                    ping_seq += 1
+                    p.send(frame(Ping(False, ping_seq)))
 
     # -- ConsensusAdapter -------------------------------------------------
 

@@ -17,6 +17,7 @@ through identical code.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from fractions import Fraction
 from typing import Optional, Union
 
 from ..engine import views
@@ -410,17 +411,23 @@ def execute_strand(
 # -- multi-path combiner --------------------------------------------------
 
 
-def _ratio(delivered: STAmount, cost: STAmount) -> float:
-    """Quality for ranking strands (higher = cheaper)."""
-    c = cost.mantissa * (10.0 ** cost.offset) if not cost.is_native else float(
-        cost.mantissa
-    )
-    d = (
-        delivered.mantissa * (10.0 ** delivered.offset)
-        if not delivered.is_native
-        else float(delivered.mantissa)
-    )
-    return d / c if c > 0 else 0.0
+def _ratio(delivered: STAmount, cost: STAmount) -> Fraction:
+    """Quality for ranking strands (higher = cheaper), as an exact rational
+    so edge-rate limit-quality comparisons match the reference's exact
+    STAmount::getRate arithmetic (no float precision boundary)."""
+    c_m = cost.mantissa
+    c_off = 0 if cost.is_native else cost.offset
+    d_m = delivered.mantissa
+    d_off = 0 if delivered.is_native else delivered.offset
+    if c_m <= 0:
+        return Fraction(0)
+    num, den = d_m, c_m
+    e = d_off - c_off
+    if e >= 0:
+        num *= 10**e
+    else:
+        den *= 10 ** (-e)
+    return Fraction(num, den)
 
 
 def flow(
@@ -433,7 +440,7 @@ def flow(
     partial: bool,
     parent_close_time: int,
     max_iterations: int = 30,
-    limit_quality: Optional[float] = None,
+    limit_quality: Optional[Fraction] = None,
 ) -> tuple[TER, STAmount, STAmount]:
     """Deliver `dst_amount` to dst using the given strands, best quality
     first, spending at most `send_max` (reference: rippleCalc multi-path

@@ -50,8 +50,15 @@ class TestShouldClose:
 
 
 class TestHaveConsensus:
-    def test_requires_enough_proposers(self):
-        assert not have_consensus(4, 2, 2)
+    def test_missing_proposers_slow_down_but_cannot_deadlock(self):
+        # <3/4 of last round's proposers present: wait one extra
+        # prev-round-time for stragglers...
+        assert not have_consensus(4, 2, 2, since_consensus_ms=3500,
+                                  prev_round_ms=3000)
+        # ...then judge on who is actually here (a crashed validator must
+        # not halt the network forever)
+        assert have_consensus(4, 2, 2, since_consensus_ms=6500,
+                              prev_round_ms=3000)
 
     def test_eighty_pct_locks(self):
         # 3 peers + us, all agree: (3*100+100)/4 = 100
