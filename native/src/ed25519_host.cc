@@ -1,0 +1,194 @@
+// Batched Ed25519 host preparation: h = SHA512(R || A || M) mod l.
+//
+// Role: the per-signature host work feeding the TPU verify kernel
+// (stellard_tpu/ops/ed25519_jax.py). Round-1 did this in a Python loop
+// (hashlib + bigint % l) which capped end-to-end throughput; this C++
+// kernel does the hash and the scalar reduction in one threaded pass so
+// host prep stays far ahead of the device.
+//
+// The reduction uses the standard fold identity for the Ed25519 group
+// order l = 2^252 + delta (RFC 8032): 2^252 === -delta (mod l), applied
+// on 28-bit limbs (252 = 9*28, so the split is limb-aligned). Values are
+// carried as signed limbs between folds; a final canonicalization brings
+// the result into [0, l).
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+typedef __int128 i128;
+typedef int64_t i64;
+
+constexpr int LB = 28;                 // limb bits
+constexpr i64 LIMB_MASK = (1LL << LB) - 1;
+constexpr int NL = 19;                 // limbs to hold 512 + slack bits
+
+struct Limbs {
+  i64 v[NL];  // signed 28-bit limbs, little-endian
+};
+
+// load a little-endian byte string into 28-bit limbs
+void load_le(const uint8_t* b, int nbytes, Limbs* out) {
+  for (int i = 0; i < NL; i++) out->v[i] = 0;
+  for (int bit = 0, i = 0; i < nbytes; i++) {
+    int limb = (i * 8) / LB;
+    int off = (i * 8) % LB;
+    out->v[limb] |= ((i64)b[i] << off) & LIMB_MASK;
+    if (off + 8 > LB && limb + 1 < NL)
+      out->v[limb + 1] |= (i64)b[i] >> (LB - off);
+    (void)bit;
+  }
+}
+
+// propagate carries so every limb is in [0, 2^28) except possibly the
+// top (which carries the overall sign); arithmetic >> gives floor
+void normalize(Limbs* x) {
+  i64 carry = 0;
+  for (int i = 0; i < NL; i++) {
+    i64 t = x->v[i] + carry;
+    carry = t >> LB;
+    x->v[i] = t - (carry << LB);
+  }
+  x->v[NL - 1] += carry << LB;  // keep any residual in the top limb
+}
+
+bool is_negative(const Limbs* x) { return x->v[NL - 1] < 0; }
+
+// x >= l ?  (x must be normalized, non-negative)
+bool geq_l(const Limbs* x, const i64* l_limbs) {
+  for (int i = NL - 1; i >= 0; i--) {
+    i64 li = i < 10 ? l_limbs[i] : 0;
+    if (x->v[i] != li) return x->v[i] > li;
+  }
+  return true;  // equal
+}
+
+void add_l(Limbs* x, const i64* l_limbs) {
+  for (int i = 0; i < 10; i++) x->v[i] += l_limbs[i];
+  normalize(x);
+}
+
+void sub_l(Limbs* x, const i64* l_limbs) {
+  for (int i = 0; i < 10; i++) x->v[i] -= l_limbs[i];
+  normalize(x);
+}
+
+// one fold: x = lo_252(x) - delta * (x >> 252); delta_limbs has 5 limbs
+void fold(Limbs* x, const i64* delta_limbs) {
+  i64 b[NL - 9];
+  for (int i = 9; i < NL; i++) b[i - 9] = x->v[i];
+  i128 acc[NL];
+  for (int i = 0; i < NL; i++) acc[i] = i < 9 ? (i128)x->v[i] : 0;
+  for (int i = 0; i < NL - 9; i++) {
+    if (b[i] == 0) continue;
+    for (int j = 0; j < 5; j++) {
+      if (i + j < NL) acc[i + j] -= (i128)b[i] * delta_limbs[j];
+    }
+  }
+  // carry the 128-bit accumulators back into signed 28-bit limbs
+  i128 carry = 0;
+  for (int i = 0; i < NL; i++) {
+    i128 t = acc[i] + carry;
+    carry = t >> LB;
+    x->v[i] = (i64)(t - (carry << LB));
+  }
+  x->v[NL - 1] += (i64)(carry << LB);
+}
+
+struct Consts {
+  i64 delta[5];
+  i64 l[10];
+};
+
+Consts make_consts() {
+  // delta and l from their big-endian hex forms, limb-decomposed at
+  // runtime (no hand-packed tables to get wrong)
+  static const uint8_t DELTA_LE[16] = {
+      0xED, 0xD3, 0xF5, 0x5C, 0x1A, 0x63, 0x12, 0x58,
+      0xD6, 0x9C, 0xF7, 0xA2, 0xDE, 0xF9, 0xDE, 0x14};
+  static const uint8_t L_LE[33] = {
+      0xED, 0xD3, 0xF5, 0x5C, 0x1A, 0x63, 0x12, 0x58,
+      0xD6, 0x9C, 0xF7, 0xA2, 0xDE, 0xF9, 0xDE, 0x14,
+      0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00,
+      0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x10, 0x00};
+  Consts c;
+  Limbs d, l;
+  load_le(DELTA_LE, 16, &d);
+  load_le(L_LE, 33, &l);
+  for (int i = 0; i < 5; i++) c.delta[i] = d.v[i];
+  for (int i = 0; i < 10; i++) c.l[i] = l.v[i];
+  return c;
+}
+
+// h (64 bytes LE) -> h mod l (32 bytes LE)
+void sc_reduce(const uint8_t* h, uint8_t* out, const Consts& c) {
+  Limbs x;
+  load_le(h, 64, &x);
+  fold(&x, c.delta);  // 512 -> ~406 bits
+  fold(&x, c.delta);  // -> ~294
+  fold(&x, c.delta);  // -> ~253
+  fold(&x, c.delta);  // -> within +-2^168 of [0, 2^252)
+  normalize(&x);
+  while (is_negative(&x)) add_l(&x, c.l);
+  while (geq_l(&x, c.l)) sub_l(&x, c.l);
+  memset(out, 0, 32);
+  for (int i = 0; i < 10; i++) {
+    i64 v = x.v[i];
+    for (int bit = 0; bit < LB; bit++) {
+      int pos = i * LB + bit;
+      if (pos >= 256) break;
+      out[pos / 8] |= (uint8_t)(((v >> bit) & 1) << (pos % 8));
+    }
+  }
+}
+
+}  // namespace
+
+// three-part streaming SHA-512, exported by sha512.cc
+extern "C" void sha512_parts(const uint8_t* p1, size_t n1, const uint8_t* p2,
+                             size_t n2, const uint8_t* p3, size_t n3,
+                             uint8_t* out, size_t out_len);
+
+extern "C" {
+
+// For each i: out[i*32..] = SHA512(R_i || A_i || M_i) mod l, little-endian.
+// rs/as are packed 32-byte arrays; messages are packed with offsets[n+1].
+void ed25519_h_batch(const uint8_t* rs, const uint8_t* as,
+                     const uint8_t* msgs, const uint64_t* offsets,
+                     uint8_t* out, uint64_t n) {
+  static const Consts c = make_consts();
+  auto work = [&](uint64_t lo, uint64_t hi) {
+    uint8_t digest[64];
+    for (uint64_t i = lo; i < hi; i++) {
+      sha512_parts(rs + 32 * i, 32, as + 32 * i, 32, msgs + offsets[i],
+                   (size_t)(offsets[i + 1] - offsets[i]), digest, 64);
+      sc_reduce(digest, out + 32 * i, c);
+    }
+  };
+  unsigned nt = std::thread::hardware_concurrency();
+  if (nt > 8) nt = 8;
+  if (nt < 2 || n < 512) {
+    work(0, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  uint64_t chunk = (n + nt - 1) / nt;
+  for (unsigned t = 0; t < nt; t++) {
+    uint64_t lo = t * chunk, hi = lo + chunk > n ? n : lo + chunk;
+    if (lo >= hi) break;
+    ts.emplace_back(work, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+// standalone batched scalar reduction (64B LE in, 32B LE out) — used by
+// tests to differential-check sc_reduce against Python ints
+void sc_reduce_batch(const uint8_t* h, uint8_t* out, uint64_t n) {
+  static const Consts c = make_consts();
+  for (uint64_t i = 0; i < n; i++) sc_reduce(h + 64 * i, out + 32 * i, c);
+}
+
+}  // extern "C"

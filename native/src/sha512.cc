@@ -105,18 +105,15 @@ static void compress(State* s, const uint8_t* block) {
   s->h[7] += h;
 }
 
-static void sha512_one(const uint8_t* prefix, size_t prefix_len,
-                       const uint8_t* msg, size_t len, uint8_t* out,
-                       size_t out_len) {
+static void sha512_multi(const uint8_t* const* parts, const size_t* lens,
+                         int nparts, uint8_t* out, size_t out_len) {
   State s;
   init(&s);
   uint8_t block[128];
-  size_t total = prefix_len + len;
+  size_t total = 0;
+  for (int p = 0; p < nparts; p++) total += lens[p];
   size_t fill = 0;
-  // stream prefix then message through 128-byte blocks
-  const uint8_t* parts[2] = {prefix, msg};
-  size_t lens[2] = {prefix_len, len};
-  for (int p = 0; p < 2; p++) {
+  for (int p = 0; p < nparts; p++) {
     const uint8_t* data = parts[p];
     size_t n = lens[p];
     while (n > 0) {
@@ -148,9 +145,26 @@ static void sha512_one(const uint8_t* prefix, size_t prefix_len,
   memcpy(out, digest, out_len);
 }
 
+static void sha512_one(const uint8_t* prefix, size_t prefix_len,
+                       const uint8_t* msg, size_t len, uint8_t* out,
+                       size_t out_len) {
+  const uint8_t* parts[2] = {prefix, msg};
+  size_t lens[2] = {prefix_len, len};
+  sha512_multi(parts, lens, 2, out, out_len);
+}
+
 }  // namespace
 
 extern "C" {
+
+// three-part streaming hash (R || A || M for Ed25519 host prep)
+void sha512_parts(const uint8_t* p1, size_t n1, const uint8_t* p2, size_t n2,
+                  const uint8_t* p3, size_t n3, uint8_t* out,
+                  size_t out_len) {
+  const uint8_t* parts[3] = {p1, p2, p3};
+  size_t lens[3] = {n1, n2, n3};
+  sha512_multi(parts, lens, 3, out, out_len);
+}
 
 // Batched prefixed SHA-512-half: for each i, out[i] = first `out_len`
 // bytes of SHA512(prefix_i ‖ msg_i). Prefixes are 4-byte big-endian

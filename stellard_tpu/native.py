@@ -33,9 +33,12 @@ def load_native() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH):
-            if not os.path.isdir(_NATIVE_DIR):
-                return None
+        if not os.path.isdir(_NATIVE_DIR) and not os.path.exists(_LIB_PATH):
+            return None
+        # always let make run its (cheap) up-to-date check: a prebuilt .so
+        # from an older source tree must be refreshed, or newly added
+        # symbols would be missing from the dlopened library
+        if os.path.isdir(_NATIVE_DIR):
             try:
                 subprocess.run(
                     ["make", "-s"],
@@ -45,12 +48,18 @@ def load_native() -> Optional[ctypes.CDLL]:
                     timeout=120,
                 )
             except (OSError, subprocess.SubprocessError):
-                return None
+                if not os.path.exists(_LIB_PATH):
+                    return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
             return None
-        _bind(lib)
+        try:
+            _bind(lib)
+        except AttributeError:
+            # stale library missing newer symbols and unrebuildable:
+            # degrade to the pure-Python paths rather than crash consumers
+            return None
         _lib = lib
         return _lib
 
@@ -70,6 +79,18 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.c_uint64,  # out_len
     ]
     lib.sha512h_batch.restype = None
+
+    lib.ed25519_h_batch.argtypes = [
+        ctypes.c_char_p,  # packed 32B R values
+        ctypes.c_char_p,  # packed 32B A (public key) values
+        ctypes.c_char_p,  # packed messages
+        ctypes.POINTER(ctypes.c_uint64),  # offsets[n+1]
+        u8p,  # out: packed 32B h-scalars (LE, already mod l)
+        ctypes.c_uint64,  # n
+    ]
+    lib.ed25519_h_batch.restype = None
+    lib.sc_reduce_batch.argtypes = [ctypes.c_char_p, u8p, ctypes.c_uint64]
+    lib.sc_reduce_batch.restype = None
 
     lib.cpplog_open.argtypes = [ctypes.c_char_p]
     lib.cpplog_open.restype = ctypes.c_void_p
@@ -116,6 +137,43 @@ class Sha512Native:
         )
         raw = bytes(out)
         return [raw[i * out_len : (i + 1) * out_len] for i in range(n)]
+
+
+class Ed25519HostPrep:
+    """Batched h = SHA512(R||A||M) mod l over the C kernel (threaded).
+
+    The per-signature host work feeding ops.ed25519_jax.verify_kernel,
+    done in one ctypes call instead of a Python loop."""
+
+    def __init__(self):
+        self.lib = load_native()
+        if self.lib is None:
+            raise RuntimeError("native library unavailable")
+
+    def h_batch(self, rs: bytes, pubs: bytes, messages, n: int) -> "np.ndarray":
+        """rs/pubs: packed 32-byte-per-element buffers; messages: sequence
+        of bytes. Returns [n, 32] uint8 h-scalars (LE, reduced mod l)."""
+        import numpy as np
+
+        messages = list(messages)  # may be a generator; we iterate twice
+        if len(messages) != n or len(rs) != 32 * n or len(pubs) != 32 * n:
+            raise ValueError(
+                f"h_batch: inconsistent batch (n={n}, msgs={len(messages)}, "
+                f"rs={len(rs)}, pubs={len(pubs)})"
+            )
+        offsets = (ctypes.c_uint64 * (n + 1))()
+        pos = 0
+        for i, m in enumerate(messages):
+            offsets[i] = pos
+            pos += len(m)
+        offsets[n] = pos
+        packed = b"".join(messages)
+        out = np.empty((n, 32), np.uint8)
+        self.lib.ed25519_h_batch(
+            rs, pubs, packed, offsets,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n,
+        )
+        return out
 
 
 class CppLogLib:

@@ -262,39 +262,94 @@ def verify_kernel(a_words, r_words, s_windows, h_windows, s_canonical):
 # host-side preparation
 
 
-def _le_words(b: bytes) -> np.ndarray:
-    return np.frombuffer(b, dtype="<u4").astype(np.uint32)
+_L_BYTES = np.frombuffer(L.to_bytes(32, "little"), np.uint8)
+_NATIVE_PREP = None
+_NATIVE_PREP_TRIED = False
 
 
-def _windows_of(x: int) -> np.ndarray:
-    return np.array([(x >> (4 * j)) & 0xF for j in range(NWINDOWS)], np.int32)
+def _native_prep():
+    """Cached native host-prep kernel, or None when unavailable."""
+    global _NATIVE_PREP, _NATIVE_PREP_TRIED
+    if not _NATIVE_PREP_TRIED:
+        _NATIVE_PREP_TRIED = True
+        try:
+            from ..native import Ed25519HostPrep
+
+            _NATIVE_PREP = Ed25519HostPrep()
+        except Exception:
+            _NATIVE_PREP = None
+    return _NATIVE_PREP
 
 
-def prepare_batch(publics, messages, signatures):
+def _nibbles_le(b: np.ndarray) -> np.ndarray:
+    """[B, 32] uint8 LE scalar bytes -> [B, 64] int32 4-bit windows,
+    LSB window first."""
+    lo = b & 0xF
+    hi = b >> 4
+    return np.stack([lo, hi], axis=-1).reshape(b.shape[0], 64).astype(np.int32)
+
+
+def prepare_batch(publics, messages, signatures, device_put: bool = True):
     """Host prep: pack keys/sigs, compute h = SHA512(R||A||M) mod l and the
-    window decompositions. Returns dict of numpy arrays for verify_kernel."""
+    window decompositions. Returns dict of arrays for verify_kernel.
+
+    Fully vectorized: byte packing / window extraction / canonical checks
+    are numpy over the whole batch; the SHA-512 + mod-l per-signature work
+    runs in one threaded native call (native/src/ed25519_host.cc), with a
+    hashlib+bigint fallback when the native library is unavailable.
+    """
     B = len(publics)
-    a_words = np.zeros((B, 8), np.uint32)
-    r_words = np.zeros((B, 8), np.uint32)
-    s_windows = np.zeros((B, NWINDOWS), np.int32)
-    h_windows = np.zeros((B, NWINDOWS), np.int32)
-    s_canonical = np.zeros((B,), bool)
-    for i, (pk, msg, sig) in enumerate(zip(publics, messages, signatures)):
-        if len(pk) != 32 or len(sig) != 64:
-            continue  # leaves flags false -> verify fails
-        a_words[i] = _le_words(pk)
-        r_words[i] = _le_words(sig[:32])
-        s = int.from_bytes(sig[32:], "little")
-        s_canonical[i] = s < L
-        s_windows[i] = _windows_of(s)
-        h = int.from_bytes(hashlib.sha512(sig[:32] + pk + msg).digest(), "little") % L
-        h_windows[i] = _windows_of(h)
+    # sanitize malformed entries to zero-filled rows; s_canonical stays
+    # False for them so verification fails without branching later
+    bad = [
+        i
+        for i, (pk, sig) in enumerate(zip(publics, signatures))
+        if len(pk) != 32 or len(sig) != 64
+    ]
+    if bad:
+        publics = list(publics)
+        signatures = list(signatures)
+        for i in bad:
+            publics[i] = b"\x00" * 32
+            signatures[i] = b"\x00" * 64
+    pk_packed = b"".join(publics)
+    sig_arr = np.frombuffer(b"".join(signatures), np.uint8).reshape(B, 64)
+    a_words = np.frombuffer(pk_packed, np.uint8).reshape(B, 32)
+    a_words = np.ascontiguousarray(a_words).view("<u4").astype(np.uint32)
+    r_bytes = np.ascontiguousarray(sig_arr[:, :32])
+    s_bytes = np.ascontiguousarray(sig_arr[:, 32:])
+    r_words = r_bytes.view("<u4").astype(np.uint32)
+
+    # canonical S < l: lexicographic compare from the most significant byte
+    rev_diff = (s_bytes != _L_BYTES)[:, ::-1]
+    any_diff = rev_diff.any(axis=1)
+    msb = 31 - np.argmax(rev_diff, axis=1)
+    s_canonical = any_diff & (s_bytes[np.arange(B), msb] < _L_BYTES[msb])
+    if bad:
+        s_canonical[bad] = False
+    s_windows = _nibbles_le(s_bytes)
+
+    native = _native_prep()
+    if native is not None:
+        h_scalars = native.h_batch(r_bytes.tobytes(), pk_packed, messages, B)
+    else:
+        h_scalars = np.empty((B, 32), np.uint8)
+        r_packed = r_bytes.tobytes()
+        for i, (pk, msg) in enumerate(zip(publics, messages)):
+            h = int.from_bytes(
+                hashlib.sha512(r_packed[32 * i : 32 * i + 32] + pk + msg).digest(),
+                "little",
+            ) % L
+            h_scalars[i] = np.frombuffer(h.to_bytes(32, "little"), np.uint8)
+    h_windows = _nibbles_le(h_scalars)
+
+    put = jnp.asarray if device_put else (lambda x: x)
     return dict(
-        a_words=jnp.asarray(a_words),
-        r_words=jnp.asarray(r_words),
-        s_windows=jnp.asarray(s_windows),
-        h_windows=jnp.asarray(h_windows),
-        s_canonical=jnp.asarray(s_canonical),
+        a_words=put(a_words),
+        r_words=put(r_words),
+        s_windows=put(s_windows),
+        h_windows=put(h_windows),
+        s_canonical=put(s_canonical),
     )
 
 
@@ -302,3 +357,23 @@ def verify_batch(publics, messages, signatures) -> np.ndarray:
     """End-to-end batched verification -> [B] bool numpy array."""
     inputs = prepare_batch(publics, messages, signatures)
     return np.asarray(verify_kernel(**inputs))
+
+
+def verify_stream(batches):
+    """Double-buffered end-to-end verification over an iterable of
+    (publics, messages, signatures) tuples.
+
+    JAX dispatch is asynchronous, so the host prep (native SHA-512 +
+    mod-l + numpy packing) of batch i+1 runs while the device executes
+    batch i — the steady-state pipeline the round-1 bench only asserted.
+    Yields [B] bool numpy arrays in submission order.
+    """
+    pending = None
+    for batch in batches:
+        inputs = prepare_batch(*batch)
+        out = verify_kernel(**inputs)  # async dispatch
+        if pending is not None:
+            yield np.asarray(pending)  # blocks on batch i-1 only
+        pending = out
+    if pending is not None:
+        yield np.asarray(pending)
