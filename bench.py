@@ -71,6 +71,305 @@ def _init_device_backend() -> str:
     return jax.devices()[0].platform
 
 
+# --------------------------------------------------------------------------
+# BASELINE.md configs 1-5: each runs the same workload generator under
+# signature_backend/hash_backend = cpu then tpu, so the cpu leg IS the
+# reference baseline (the reference publishes no numbers, BASELINE.md).
+
+
+def _payments(master, n, start_seq=1, dests=16):
+    from stellard_tpu.protocol.formats import TxType
+    from stellard_tpu.protocol.keys import KeyPair
+    from stellard_tpu.protocol.sfields import sfAmount, sfDestination
+    from stellard_tpu.protocol.stamount import STAmount
+    from stellard_tpu.protocol.sttx import SerializedTransaction
+
+    outs = [KeyPair.from_passphrase(f"bench-dest-{i}").account_id
+            for i in range(dests)]
+    txs = []
+    for i in range(n):
+        tx = SerializedTransaction.build(
+            TxType.ttPAYMENT, master.account_id, start_seq + i, 10,
+            {sfAmount: STAmount.from_drops(1_000_000),
+             sfDestination: outs[i % dests]},
+        )
+        tx.sign(master)
+        txs.append(tx)
+    return txs
+
+
+def _fresh(txs):
+    """Re-deserialize txs so per-object memoized signature verdicts
+    (SerializedTransaction._sig_good) can't leak between backend legs."""
+    from stellard_tpu.protocol.sttx import SerializedTransaction
+
+    return [SerializedTransaction.from_bytes(t.serialize()) for t in txs]
+
+
+def _drive_node(backend, txs, chunk=500, setup_phases=()):
+    """Submit pre-signed txs through the full async pipeline (verify plane
+    -> job queue -> open ledger), closing every `chunk`; -> wall seconds.
+    `setup_phases` run first, one ledger close per phase, unmeasured."""
+    import threading
+
+    from stellard_tpu.node.config import Config
+    from stellard_tpu.node.node import Node
+
+    node = Node(Config(signature_backend=backend)).setup()
+    done = threading.Semaphore(0)
+
+    def cb(tx, ter, applied):
+        done.release()
+
+    for phase in setup_phases:
+        phase = _fresh(phase)
+        for tx in phase:
+            node.ops.submit_transaction(tx, cb)
+        for _ in phase:
+            done.acquire()
+        node.ops.accept_ledger()
+
+    txs = _fresh(txs)
+    t0 = time.perf_counter()
+    for start in range(0, len(txs), chunk):
+        part = txs[start : start + chunk]
+        for tx in part:
+            node.ops.submit_transaction(tx, cb)
+        for _ in part:
+            done.acquire()
+        node.ops.accept_ledger()
+    dt = time.perf_counter() - t0
+    committed = node.ledger_master.closed_ledger().seq
+    node.stop()
+    return dt, committed
+
+
+def bench_payment_flood(backends):
+    """BASELINE config #1: standalone payment flood (test/send-test.js
+    load, /root/reference/test/send-test.js)."""
+    from stellard_tpu.protocol.keys import KeyPair
+
+    n = int(os.environ.get("BENCH_FLOOD_N", "3000"))
+    master = KeyPair.from_passphrase("masterpassphrase")
+    txs = _payments(master, n)
+    rates = {}
+    for b in backends:
+        dt, _ = _drive_node(b, txs)  # _drive_node re-deserializes per leg
+        rates[b] = n / dt
+    _emit_config("payment_flood_tx_per_sec", rates)
+    return rates
+
+
+def _offer_workload(n):
+    """-> (setup_txs, work_txs): funding + trustlines, then an
+    OfferCreate/OfferCancel mix with crossing price ladders."""
+    from stellard_tpu.protocol.formats import TxType
+    from stellard_tpu.protocol.keys import KeyPair
+    from stellard_tpu.protocol.sfields import (
+        sfAmount,
+        sfDestination,
+        sfLimitAmount,
+        sfOfferSequence,
+        sfTakerGets,
+        sfTakerPays,
+    )
+    from stellard_tpu.protocol.stamount import STAmount
+    from stellard_tpu.protocol.sttx import SerializedTransaction
+
+    master = KeyPair.from_passphrase("masterpassphrase")
+    gateway = KeyPair.from_passphrase("bench-gateway")
+    traders = [KeyPair.from_passphrase(f"bench-trader-{i}") for i in range(8)]
+    USD = b"USD" + b"\x00" * 17
+
+    fund = []
+    seq = 1
+    for who in [gateway] + traders:
+        tx = SerializedTransaction.build(
+            TxType.ttPAYMENT, master.account_id, seq, 10,
+            {sfAmount: STAmount.from_drops(1_000_000_000),
+             sfDestination: who.account_id},
+        )
+        tx.sign(master)
+        fund.append(tx)
+        seq += 1
+    trust = []
+    seqs = {}
+    for t in traders:
+        tx = SerializedTransaction.build(
+            TxType.ttTRUST_SET, t.account_id, 1, 10,
+            {sfLimitAmount: STAmount.from_iou(USD, gateway.account_id, 10**9, 0)},
+        )
+        tx.sign(t)
+        trust.append(tx)
+        seqs[t.account_id] = 2
+    # phases must be separated by closes: the open ledger runs checks
+    # only, so a tx depending on another account's same-ledger creation
+    # would fail rather than hold
+    setup = [fund, trust]
+
+    seqs[gateway.account_id] = 1
+    work = []
+    live_offers = []  # (account, seq) for cancels
+    for i in range(n):
+        if i % 5 == 4 and live_offers:
+            who, oseq = live_offers.pop(0)
+            tx = SerializedTransaction.build(
+                TxType.ttOFFER_CANCEL, who.account_id,
+                seqs[who.account_id], 10, {sfOfferSequence: oseq},
+            )
+            tx.sign(who)
+            seqs[who.account_id] += 1
+        elif i % 2 == 0:
+            # gateway sells its own USD for XRP (always funded)
+            price = 50 + (i % 20)
+            gw_seq = seqs[gateway.account_id]
+            tx = SerializedTransaction.build(
+                TxType.ttOFFER_CREATE, gateway.account_id, gw_seq, 10,
+                {sfTakerPays: STAmount.from_drops(price * 1_000_000),
+                 sfTakerGets: STAmount.from_iou(USD, gateway.account_id, 100, 0)},
+            )
+            tx.sign(gateway)
+            live_offers.append((gateway, gw_seq))
+            seqs[gateway.account_id] += 1
+        else:
+            who = traders[i % len(traders)]
+            price = 40 + (i % 25)  # overlaps the ask ladder -> crossings
+            tx = SerializedTransaction.build(
+                TxType.ttOFFER_CREATE, who.account_id,
+                seqs[who.account_id], 10,
+                {sfTakerPays: STAmount.from_iou(USD, gateway.account_id, 100, 0),
+                 sfTakerGets: STAmount.from_drops(price * 1_000_000)},
+            )
+            tx.sign(who)
+            live_offers.append((who, seqs[who.account_id]))
+            seqs[who.account_id] += 1
+        work.append(tx)
+    return setup, work
+
+
+def bench_offer_mix(backends):
+    """BASELINE config #2: OfferCreate/OfferCancel order-book mix
+    (test/offer-test.js)."""
+    n = int(os.environ.get("BENCH_OFFER_N", "1500"))
+    setup, work = _offer_workload(n)
+
+    rates = {}
+    for b in backends:
+        dt, _ = _drive_node(b, work, chunk=300, setup_phases=setup)
+        rates[b] = len(work) / dt
+    _emit_config("offer_mix_tx_per_sec", rates)
+    return rates
+
+
+def bench_consensus_close(backends):
+    """BASELINE config #4: 4-validator private net, wall-clock p50 compute
+    time per consensus round (virtual protocol waits cost nothing in the
+    deterministic simnet, so wall time IS the verify/hash/apply work)."""
+    from stellard_tpu.node.verifyplane import VerifyPlane
+    from stellard_tpu.overlay.simnet import SimNet
+    from stellard_tpu.protocol.keys import KeyPair
+
+    rounds = int(os.environ.get("BENCH_CONSENSUS_ROUNDS", "10"))
+    per_round = int(os.environ.get("BENCH_CONSENSUS_TXS", "100"))
+    master = KeyPair.from_passphrase("masterpassphrase")
+    txs = _payments(master, rounds * per_round)
+
+    p50s = {}
+    for b in backends:
+        plane = VerifyPlane(backend=b, window_ms=1.0)
+        net = SimNet(4)
+        for v in net.validators:
+            v.node.verify_many = plane.verify_many
+        net.start()
+        net.run_until(lambda: net.all_validated_at_least(2), 30)
+        times = []
+        submitted = 0
+        leg_txs = _fresh(txs)  # no memoized-signature leak across legs
+        base = net.validators[0].node.lm.validated.seq
+        for r in range(rounds):
+            for tx in leg_txs[submitted : submitted + per_round]:
+                net.validators[0].submit_client_tx(tx)
+            submitted += per_round
+            t0 = time.perf_counter()
+            target = base + r + 1
+            ok = net.run_until(
+                lambda: net.all_validated_at_least(target), 120
+            )
+            if not ok:
+                break
+            times.append((time.perf_counter() - t0) * 1000.0)
+        plane.stop()
+        times.sort()
+        if times:  # a leg that never closed is omitted, not Infinity
+            p50s[b] = times[len(times) // 2]
+    _emit_config(
+        "consensus_close_p50_ms", p50s, lower_is_better=True, unit="ms"
+    )
+    return p50s
+
+
+def bench_replay(backends):
+    """BASELINE config #5: ledger replay / catch-up throughput with
+    hash_backend = cpu vs tpu (full SHAMap re-hash + tx re-apply)."""
+    from stellard_tpu.crypto import make_hasher
+    from stellard_tpu.node.config import Config
+    from stellard_tpu.node.ledgertools import replay_ledger
+    from stellard_tpu.node.node import Node
+    from stellard_tpu.protocol.keys import KeyPair
+
+    ledgers = int(os.environ.get("BENCH_REPLAY_LEDGERS", "6"))
+    per = int(os.environ.get("BENCH_REPLAY_TXS", "300"))
+    master = KeyPair.from_passphrase("masterpassphrase")
+    txs = _payments(master, ledgers * per)
+
+    node = Node(Config()).setup()
+    hashes = []
+    for i in range(ledgers):
+        for tx in txs[i * per : (i + 1) * per]:
+            node.ops.process_transaction(tx)
+        closed, _ = node.ops.accept_ledger()
+        closed.save(node.nodestore)
+        hashes.append(closed.hash())
+    db = node.nodestore
+
+    rates = {}
+    for b in backends:
+        hasher = make_hasher(b)
+        total_tx = 0
+        t0 = time.perf_counter()
+        for h in hashes:
+            stats = replay_ledger(db, h, hash_batch=hasher.prefix_hash_batch)
+            total_tx += stats.get("tx_count", per)
+        rates[b] = total_tx / (time.perf_counter() - t0)
+    node.stop()
+    _emit_config("replay_tx_per_sec", rates)
+    return rates
+
+
+def _emit_config(metric, rates, lower_is_better=False, unit="tx/s"):
+    cpu = rates.get("cpu")
+    dev = rates.get("tpu")
+    value = dev if dev is not None else cpu
+    if value is None:  # no leg produced a number
+        _emit({"metric": metric, "value": 0.0, "unit": "error",
+               "vs_baseline": 0.0, "error": "no backend leg completed"})
+        return
+    if cpu and dev:
+        vs = (cpu / dev) if lower_is_better else (dev / cpu)
+    else:
+        vs = 0.0
+    _emit(
+        {
+            "metric": metric,
+            "value": round(value, 2),
+            "unit": unit,
+            "vs_baseline": round(vs, 3),
+            "cpu_baseline": round(cpu, 2) if cpu else None,
+            "fallback": dev is None,
+        }
+    )
+
+
 def main() -> None:
     platform = _init_device_backend()
 
@@ -84,6 +383,24 @@ def main() -> None:
 
     batch = int(os.environ.get("BENCH_BATCH", "4096"))
     seconds = float(os.environ.get("BENCH_SECONDS", "10"))
+
+    # BASELINE configs 1-5 (one JSON line each); the headline metric
+    # prints LAST so a single-line consumer reads the north-star number
+    if os.environ.get("BENCH_ONLY", "") != "headline":
+        # when no device is reachable the tpu legs are meaningless
+        # (JAX-on-one-cpu-core); run cpu-only and flag the fallback
+        backends = ["cpu"] + (["tpu"] if platform != "cpu" else [])
+        for fn in (
+            bench_payment_flood,
+            bench_offer_mix,
+            bench_consensus_close,
+            bench_replay,
+        ):
+            try:
+                fn(backends)
+            except Exception as e:  # a failed config must not kill the rest
+                _emit({"metric": fn.__name__, "value": 0.0, "unit": "error",
+                       "vs_baseline": 0.0, "error": repr(e)[:300]})
 
     rng = np.random.default_rng(42)
     keys = [KeyPair.from_seed(bytes(rng.integers(0, 256, 32, dtype=np.uint8))) for _ in range(64)]

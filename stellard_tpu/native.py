@@ -80,17 +80,23 @@ def _bind(lib: ctypes.CDLL) -> None:
     ]
     lib.sha512h_batch.restype = None
 
-    lib.ed25519_h_batch.argtypes = [
-        ctypes.c_char_p,  # packed 32B R values
-        ctypes.c_char_p,  # packed 32B A (public key) values
-        ctypes.c_char_p,  # packed messages
-        ctypes.POINTER(ctypes.c_uint64),  # offsets[n+1]
-        u8p,  # out: packed 32B h-scalars (LE, already mod l)
-        ctypes.c_uint64,  # n
-    ]
-    lib.ed25519_h_batch.restype = None
-    lib.sc_reduce_batch.argtypes = [ctypes.c_char_p, u8p, ctypes.c_uint64]
-    lib.sc_reduce_batch.restype = None
+    # newer symbols bind leniently: a stale prebuilt .so on a box where
+    # `make` can't run keeps its older components (sha512/cpplog) usable
+    try:
+        lib.ed25519_h_batch.argtypes = [
+            ctypes.c_char_p,  # packed 32B R values
+            ctypes.c_char_p,  # packed 32B A (public key) values
+            ctypes.c_char_p,  # packed messages
+            ctypes.POINTER(ctypes.c_uint64),  # offsets[n+1]
+            u8p,  # out: packed 32B h-scalars (LE, already mod l)
+            ctypes.c_uint64,  # n
+        ]
+        lib.ed25519_h_batch.restype = None
+        lib.sc_reduce_batch.argtypes = [ctypes.c_char_p, u8p, ctypes.c_uint64]
+        lib.sc_reduce_batch.restype = None
+        lib.has_ed25519_prep = True
+    except AttributeError:
+        lib.has_ed25519_prep = False
 
     lib.cpplog_open.argtypes = [ctypes.c_char_p]
     lib.cpplog_open.restype = ctypes.c_void_p
@@ -149,6 +155,8 @@ class Ed25519HostPrep:
         self.lib = load_native()
         if self.lib is None:
             raise RuntimeError("native library unavailable")
+        if not getattr(self.lib, "has_ed25519_prep", False):
+            raise RuntimeError("native library predates ed25519_h_batch")
 
     def h_batch(self, rs: bytes, pubs: bytes, messages, n: int) -> "np.ndarray":
         """rs/pubs: packed 32-byte-per-element buffers; messages: sequence
