@@ -102,6 +102,7 @@ class LedgerConsensus:
         proposing: bool = True,
         hash_batch: Optional[Callable] = None,
         idle_interval: int = LEDGER_IDLE_INTERVAL,
+        voting=None,
     ):
         self.lm = ledger_master
         self.adapter = adapter
@@ -113,6 +114,7 @@ class LedgerConsensus:
         self.proposing = proposing
         self.hash_batch = hash_batch
         self.idle_interval = idle_interval
+        self.voting = voting  # consensus.voting.VotingBox or None
 
         self.prev_ledger = prev_ledger
         self.prev_hash = prev_ledger.hash()
@@ -184,6 +186,19 @@ class LedgerConsensus:
         self.our_set = TxSet(self.hash_batch)
         for txid, blob, _meta in open_ledger.tx_entries():
             self.our_set.add(txid, blob)
+        if self.voting is not None:
+            # flag-ledger voting: amendment/fee pseudo-txs join our initial
+            # position (reference: takeInitialPosition → doVoting,
+            # LedgerConsensus.cpp:1033-1038). Votes are tallied over the
+            # validations of the flag ledger's parent, which every honest
+            # node has seen, so positions agree.
+            parent_vals = self.validations.validations_for(
+                self.prev_ledger.parent_hash
+            )
+            for ptx in self.voting.position_injections(
+                self.prev_ledger, parent_vals
+            ):
+                self.our_set.add(ptx.txid(), ptx.serialize())
         # remembered for accept(): these are re-applied (when left out) by
         # close_with_txset, so the dispute-reapply loop must skip them
         self._pre_close_open_ids = self.our_set.txids()
@@ -423,12 +438,20 @@ class LedgerConsensus:
                 if ter == TER.terPRE_SEQ:
                     self.lm.add_held_transaction(tx)
 
+        if self.voting is not None:
+            self.voting.on_ledger_closed(new_lcl)
         if self.proposing:
+            extra = (
+                self.voting.validation_fields(new_lcl)
+                if self.voting is not None
+                else {}
+            )
             val = STValidation.build(
                 ledger_hash=new_lcl.hash(),
                 signing_time=self.network_time(),
                 full=True,
                 ledger_seq=new_lcl.seq,
+                **extra,
             )
             val.sign(self.key)
             # count our own validation toward quorum (reference: accept

@@ -100,6 +100,26 @@ class STValidation:
         return bool(self.flags & VF_FULL)
 
     @property
+    def load_fee(self) -> Optional[int]:
+        return self.obj.get(sfLoadFee)
+
+    @property
+    def base_fee(self) -> Optional[int]:
+        return self.obj.get(sfBaseFee)
+
+    @property
+    def reserve_base(self) -> Optional[int]:
+        return self.obj.get(sfReserveBase)
+
+    @property
+    def reserve_increment(self) -> Optional[int]:
+        return self.obj.get(sfReserveIncrement)
+
+    @property
+    def amendments(self) -> Optional[list[bytes]]:
+        return self.obj.get(sfAmendments)
+
+    @property
     def signer(self) -> bytes:
         """The validator's node public key (raw Ed25519)."""
         return self.obj.get(sfSigningPubKey, b"")

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from enum import IntFlag
 
+from ..protocol.formats import TxType
 from ..protocol.sfields import sfBalance, sfSequence
 from ..protocol.stamount import STAmount
 from ..protocol.sttx import SerializedTransaction
@@ -49,9 +50,23 @@ class TransactionEngine:
 
         self.les = LedgerEntrySet(self.ledger)
 
-        ok, why = tx.passes_local_checks()
-        if not ok:
-            return TER.temINVALID, False
+        # pseudo-transactions (zero account, no fee/signature) only enter
+        # through a consensus set; their own pre_check enforces the
+        # closing-ledger + zero-account rules, but the required-field
+        # template must still hold or do_apply would crash the close.
+        # Client/peer intake paths call passes_local_checks themselves and
+        # still reject pseudo-txs (reference: passesLocalChecks runs in
+        # Transaction::checkCoherent, not TransactionEngine::applyTransaction).
+        if tx.tx_type in (TxType.ttAMENDMENT, TxType.ttFEE):
+            from ..protocol.formats import TX_FORMATS, validate_against
+
+            fmt = TX_FORMATS.get(tx.tx_type)
+            if fmt is None or validate_against(tx.obj, fmt):
+                return TER.temINVALID, False
+        else:
+            ok, _why = tx.passes_local_checks()
+            if not ok:
+                return TER.temINVALID, False
 
         transactor = make_transactor(tx, params, self)
         if transactor is None:

@@ -48,6 +48,7 @@ class ValidatorNode:
         verify_many: Optional[Callable] = None,
         proposing: bool = True,
         idle_interval: int = LEDGER_IDLE_INTERVAL,
+        voting=None,
     ):
         self.key = key
         self.unl = set(unl) | {key.public}  # we trust ourselves
@@ -58,6 +59,7 @@ class ValidatorNode:
         self.verify_many = verify_many  # VerifyPlane.verify_many or None
         self.proposing = proposing
         self.idle_interval = idle_interval
+        self.voting = voting  # consensus.voting.VotingBox or None
 
         self.lm = LedgerMaster(hash_batch=hash_batch)
         self.lm.min_validations = quorum
@@ -102,6 +104,7 @@ class ValidatorNode:
             proposing=self.proposing,
             hash_batch=self.hash_batch,
             idle_interval=self.idle_interval,
+            voting=self.voting,
         )
 
     def on_timer(self) -> None:

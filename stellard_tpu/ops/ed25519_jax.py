@@ -15,11 +15,20 @@ Design notes (TPU-first):
   square mod p, d is a non-square), so one branch-free formula covers
   identity/doubling/adversarial small-order inputs — exactly what a
   lock-step SIMD batch needs.
-- [S]B uses a 64-window fixed-base comb (no doublings, table built host-side
-  once); [h](-A) uses 4-bit windowed double-and-add with a per-element
-  16-entry table. All loops are lax.fori_loop (rolled: fast XLA compile).
-- h = SHA512(R||A||M) mod l and the 4-bit window decomposition are computed
-  host-side (cheap C-backed hashlib; the device does the ~3k field muls).
+- [S]B uses a 64-window fixed-base comb (no doublings; table host-built
+  once in precomputed "niels" form (y+x, y-x, 2dxy), so each comb step
+  is a 7M mixed addition). Table entries are selected with a
+  [B,16] x [16,60] one-hot f32 matmul — a dense MXU op; per-lane gathers
+  serialize on TPU.
+- [h](-A) uses SIGNED 4-bit windows (digits in [-8, 7], recoded
+  host-side): the per-element table holds only 9 cached multiples
+  0..8, negation is a (y+x)/(y-x) swap plus a t2d negation. 256
+  doublings + 64 cached additions (8M each).
+- Both scalar walks share ONE fori_loop (64 iterations), halving loop
+  overhead vs separate comb/windowed loops.
+- h = SHA512(R||A||M) mod l and both digit decompositions are computed
+  host-side (native C prep when available; the device does the ~3k
+  field muls).
 """
 
 from __future__ import annotations
@@ -33,6 +42,7 @@ from jax import lax
 
 from . import ed25519_ref as ref
 from .fe25519 import (
+    D,
     D2,
     L,
     NLIMB,
@@ -46,7 +56,7 @@ from .fe25519 import (
     fe_is_zero,
     fe_mul,
     fe_neg,
-    fe_pow,
+    fe_pow_p58,
     fe_reduce_full,
     fe_select,
     fe_square,
@@ -61,7 +71,14 @@ NWINDOWS = 64  # ceil(256/4); scalars are < l < 2^253
 
 
 # --------------------------------------------------------------------------
-# point helpers: points are [..., 4, 20] int32 stacks of (X, Y, Z, T)
+# point helpers
+#
+# extended point: [..., 4, 20] stack of (X, Y, Z, T), x = X/Z, y = Y/Z,
+#                 T = XY/Z
+# cached point:   [..., 4, 20] stack of (Y+X, Y-X, 2d*T, 2Z) — the
+#                 precomputed operand form of add-2008-hwcd
+# niels point:    [..., 3, 20] stack of (y+x, y-x, 2d*x*y) — cached with
+#                 Z = 1, so the 2Z slot is the constant 2
 
 
 def pt_stack(x, y, z, t):
@@ -77,20 +94,53 @@ def pt_identity(batch_shape=()):
     )
 
 
-def pt_add(p, q):
-    """Complete unified addition (extended coords, a=-1, k=2d)."""
+def pt_to_cached(p):
+    """extended -> cached: 1M + 3 add."""
+    x, y, z, t = p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
+    return jnp.stack(
+        [fe_add(y, x), fe_sub(y, x), fe_mul(t, fe_const(D2)), fe_add(z, z)],
+        axis=-2,
+    )
+
+
+def pt_add_cached(p, q_cached):
+    """Complete unified addition, q in cached form: 8M."""
     x1, y1, z1, t1 = p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
-    x2, y2, z2, t2 = q[..., 0, :], q[..., 1, :], q[..., 2, :], q[..., 3, :]
-    a = fe_mul(fe_sub(y1, x1), fe_sub(y2, x2))
-    b = fe_mul(fe_add(y1, x1), fe_add(y2, x2))
-    c = fe_mul(fe_mul(t1, t2), fe_const(D2))
-    d = fe_mul(z1, z2)
-    d = fe_add(d, d)
+    ypx2, ymx2, t2d2, z22 = (
+        q_cached[..., 0, :],
+        q_cached[..., 1, :],
+        q_cached[..., 2, :],
+        q_cached[..., 3, :],
+    )
+    a = fe_mul(fe_sub(y1, x1), ymx2)
+    b = fe_mul(fe_add(y1, x1), ypx2)
+    c = fe_mul(t1, t2d2)
+    d = fe_mul(z1, z22)
     e = fe_sub(b, a)
     f = fe_sub(d, c)
     g = fe_add(d, c)
     h = fe_add(b, a)
     return pt_stack(fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+
+
+def pt_add_mixed(p, q_niels):
+    """Complete unified addition, q in niels form (Z2 = 1): 7M."""
+    x1, y1, z1, t1 = p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
+    ypx2, ymx2, t2d2 = q_niels[..., 0, :], q_niels[..., 1, :], q_niels[..., 2, :]
+    a = fe_mul(fe_sub(y1, x1), ymx2)
+    b = fe_mul(fe_add(y1, x1), ypx2)
+    c = fe_mul(t1, t2d2)
+    d = fe_add(z1, z1)
+    e = fe_sub(b, a)
+    f = fe_sub(d, c)
+    g = fe_add(d, c)
+    h = fe_add(b, a)
+    return pt_stack(fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+
+
+def pt_add(p, q):
+    """Complete unified addition, both extended: 9M (one-off uses)."""
+    return pt_add_cached(p, pt_to_cached(q))
 
 
 def pt_double(p):
@@ -102,7 +152,7 @@ def pt_double(p):
     c = fe_add(zz, zz)
     e = fe_sub(fe_sub(fe_square(fe_add(x1, y1)), a), b)
     g = fe_sub(b, a)  # a_coeff=-1: G = aA + B = B - A
-    f = fe_sub(g, c)  # note: F = G - C
+    f = fe_sub(g, c)  # F = G - C
     h = fe_sub(fe_neg(a), b)  # H = aA - B = -A - B
     return pt_stack(fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
 
@@ -133,10 +183,10 @@ def pt_decompress(words_u32):
     sign = (words_u32[..., 7] >> 31).astype(jnp.int32)
     y2 = fe_square(y)
     u = fe_sub(y2, fe_const(1))
-    v = fe_add(fe_mul(y2, fe_const(ref.D)), fe_const(1))
+    v = fe_add(fe_mul(y2, fe_const(D)), fe_const(1))
     v3 = fe_mul(fe_square(v), v)
     v7 = fe_mul(fe_square(v3), v)
-    x = fe_mul(fe_mul(u, v3), fe_pow(fe_mul(u, v7), (P - 5) // 8))
+    x = fe_mul(fe_mul(u, v3), fe_pow_p58(fe_mul(u, v7)))
     vxx = fe_mul(fe_square(x), v)
     ok1 = fe_eq(vxx, u)
     ok2 = fe_eq(vxx, fe_neg(u))
@@ -151,32 +201,93 @@ def pt_decompress(words_u32):
 
 
 # --------------------------------------------------------------------------
+# per-element cached table of 0..8 multiples (for signed 4-bit windows)
+
+
+def _build_cached_table(p):
+    """p extended [..., 4, 20] -> [..., 9, 4, 20] cached multiples 0..8P.
+
+    4 doublings + 3 cached adds + 8 cached conversions; the doubling-
+    based ladder keeps the dependency chain at 4 instead of 14."""
+    batch = p.shape[:-2]
+    ident = jnp.stack(
+        [
+            fe_const(1, batch),
+            fe_const(1, batch),
+            fe_const(0, batch),
+            fe_const(2, batch),
+        ],
+        axis=-2,
+    )
+    m1 = p
+    c1 = pt_to_cached(m1)
+    m2 = pt_double(m1)
+    c2 = pt_to_cached(m2)
+    m3 = pt_add_cached(m2, c1)
+    c3 = pt_to_cached(m3)
+    m4 = pt_double(m2)
+    c4 = pt_to_cached(m4)
+    m5 = pt_add_cached(m4, c1)
+    m6 = pt_double(m3)
+    m7 = pt_add_cached(m6, c1)
+    m8 = pt_double(m4)
+    cached = [ident, c1, c2, c3, c4] + [pt_to_cached(m) for m in (m5, m6, m7, m8)]
+    return jnp.stack(cached, axis=-3)
+
+
+def _select_cached(tbl, digit):
+    """tbl [..., 9, 4, 20], digit [...] int32 in [-8, 7] -> cached entry.
+
+    |digit| selects by one-hot contraction (no gathers); a negative digit
+    swaps (Y+X)/(Y-X) and negates 2dT — point negation in cached form."""
+    mag = jnp.abs(digit)
+    neg = digit < 0
+    onehot = (mag[..., None] == jnp.arange(9, dtype=mag.dtype)).astype(jnp.int32)
+    entry = jnp.sum(onehot[..., :, None, None] * tbl, axis=-3)  # [..., 4, 20]
+    ypx, ymx, t2d, z2 = (
+        entry[..., 0, :],
+        entry[..., 1, :],
+        entry[..., 2, :],
+        entry[..., 3, :],
+    )
+    return jnp.stack(
+        [
+            fe_select(neg, ymx, ypx),
+            fe_select(neg, ypx, ymx),
+            fe_select(neg, fe_neg(t2d), t2d),
+            z2,
+        ],
+        axis=-2,
+    )
+
+
+# --------------------------------------------------------------------------
 # fixed-base comb table for B (host-side, Python ints, computed once)
 
 _COMB_NP: np.ndarray | None = None
 
 
 def _comb_table_np() -> np.ndarray:
-    """[NWINDOWS, 16, 4, 20] int32: T[j][w] = (w << 4j) * B, extended Z=1."""
+    """[NWINDOWS, 16, 60] f32: row (j, w) = niels form (y+x, y-x, 2dxy)
+    of (w * 16^j) * B. f32 is exact for 13-bit limbs and routes the
+    one-hot selection through the MXU."""
     global _COMB_NP
     if _COMB_NP is None:
-        out = np.zeros((NWINDOWS, 16, 4, NLIMB), np.int32)
-        base = ref.BASE
-        step = base  # 2^(4j) * B
+        out = np.zeros((NWINDOWS, 16, 3, NLIMB), np.int32)
+        step = ref.BASE  # 16^j * B
         for j in range(NWINDOWS):
             acc = ref.IDENTITY
             for w in range(16):
-                x, y, z, t = acc
+                x, y, z, _t = acc
                 zi = pow(z, P - 2, P)
                 xa, ya = x * zi % P, y * zi % P
-                out[j, w, 0] = int_to_limbs_np(xa)
-                out[j, w, 1] = int_to_limbs_np(ya)
-                out[j, w, 2] = int_to_limbs_np(1)
-                out[j, w, 3] = int_to_limbs_np(xa * ya % P)
+                out[j, w, 0] = int_to_limbs_np((ya + xa) % P)
+                out[j, w, 1] = int_to_limbs_np((ya - xa) % P)
+                out[j, w, 2] = int_to_limbs_np(D2 * xa % P * ya % P)
                 acc = ref.pt_add(acc, step)
             for _ in range(4):
                 step = ref.pt_double(step)
-        _COMB_NP = out
+        _COMB_NP = out.reshape(NWINDOWS, 16, 3 * NLIMB).astype(np.float32)
     return _COMB_NP
 
 
@@ -186,73 +297,53 @@ def _batch_zero(ref_arr):
     return (ref_arr[..., :1] * 0)[..., None]
 
 
-def _onehot16(w):
-    """[...] int32 in [0,16) -> [..., 16] int32 one-hot. Table selection
-    by one-hot contraction instead of gather: per-lane gathers serialize
-    on TPU, while the contraction is a dense (MXU/VPU) op."""
-    return (w[..., None] == jnp.arange(16, dtype=w.dtype)).astype(jnp.int32)
-
-
-def _comb_mult(s_windows):
-    """[S]B via the comb: s_windows [..., 64] int32 (4-bit, LSB window
-    first). 64 complete additions, no doublings; each table entry is
-    selected with a [B,16] x [16,80] one-hot matmul (shared table → this
-    rides the MXU)."""
-    table = jnp.asarray(_comb_table_np())  # [64, 16, 4, 20]
-    flat = table.reshape(NWINDOWS, 16, 4 * NLIMB)
-    acc0 = pt_identity(s_windows.shape[:-1]) + _batch_zero(s_windows)
-
-    def body(j, acc):
-        tj = lax.dynamic_index_in_dim(flat, j, axis=0, keepdims=False)  # [16,80]
-        onehot = _onehot16(s_windows[..., j])  # [..., 16]
-        entry = jnp.matmul(onehot, tj).reshape(onehot.shape[:-1] + (4, NLIMB))
-        return pt_add(acc, entry)
-
-    return lax.fori_loop(0, NWINDOWS, body, acc0)
-
-
-def _windowed_mult(h_windows, point):
-    """[h]P via 4-bit windows, MSB window first: h_windows [..., 64].
-    The per-element multiples table is built with an unrolled chain of 14
-    additions; selection is a one-hot weighted sum over the table axis
-    (again: no gathers)."""
-    batch = h_windows.shape[:-1]
-    # unrolled per-element table 0P..15P: [..., 16, 4, 20]
-    entries = [pt_identity(batch) + _batch_zero(h_windows), point]
-    for _ in range(14):
-        entries.append(pt_add(entries[-1], point))
-    tbl = jnp.stack(entries, axis=-3)  # [..., 16, 4, 20]
-
-    def body(i, acc):
-        for _ in range(WINDOW):
-            acc = pt_double(acc)
-        w = h_windows[..., NWINDOWS - 1 - i]  # windows LSB-first; walk MSB->LSB
-        onehot = _onehot16(w)[..., :, None, None]  # [..., 16, 1, 1]
-        entry = jnp.sum(onehot * tbl, axis=-3)  # [..., 4, 20]
-        return pt_add(acc, entry)
-
-    acc0 = pt_identity(batch) + _batch_zero(h_windows)
-    return lax.fori_loop(0, NWINDOWS, body, acc0)
-
-
 # --------------------------------------------------------------------------
 # the batched verify kernel
 
 
 @jax.jit
-def verify_kernel(a_words, r_words, s_windows, h_windows, s_canonical):
+def verify_kernel(a_words, r_words, s_windows, h_digits, s_canonical):
     """Batched core: all inputs leading dim B.
 
     a_words: [B, 8] u32 public keys (LE words)
     r_words: [B, 8] u32 signature R
-    s_windows/h_windows: [B, 64] int32 4-bit windows (LSB window first)
+    s_windows: [B, 64] int32 unsigned 4-bit windows of S (LSB first)
+    h_digits: [B, 64] int32 SIGNED 4-bit digits of h in [-8, 7] (LSB first)
     s_canonical: [B] bool (S < l, checked host-side)
     -> [B] bool
     """
     a_point, a_valid = pt_decompress(a_words)
-    sb = _comb_mult(s_windows)
-    ha = _windowed_mult(h_windows, pt_neg(a_point))
-    rp = pt_add(sb, ha)
+    htbl = _build_cached_table(pt_neg(a_point))  # [B, 9, 4, 20]
+    comb = jnp.asarray(_comb_table_np())  # [64, 16, 60] f32
+
+    zero = _batch_zero(s_windows)
+    acc0_h = pt_identity(s_windows.shape[:-1]) + zero
+    acc0_s = pt_identity(s_windows.shape[:-1]) + zero
+
+    def body(j, accs):
+        acc_h, acc_s = accs
+        # [h](-A): MSB-first windows, 4 doublings + 1 cached add
+        for _ in range(WINDOW):
+            acc_h = pt_double(acc_h)
+        d = lax.dynamic_index_in_dim(h_digits, NWINDOWS - 1 - j, axis=-1, keepdims=False)
+        acc_h = pt_add_cached(acc_h, _select_cached(htbl, d))
+        # [S]B: comb window j, one MXU one-hot matmul + mixed add
+        tj = lax.dynamic_index_in_dim(comb, j, axis=0, keepdims=False)  # [16, 60]
+        w = lax.dynamic_index_in_dim(s_windows, j, axis=-1, keepdims=False)
+        onehot = (w[..., None] == jnp.arange(16, dtype=w.dtype)).astype(jnp.float32)
+        # HIGHEST precision: default-precision TPU matmuls truncate f32
+        # operands to bf16 (8-bit mantissa) in the MXU, which corrupts
+        # 13-bit limbs; full-precision f32 is exact for these magnitudes
+        entry = (
+            jnp.matmul(onehot, tj, precision=lax.Precision.HIGHEST)
+            .astype(jnp.int32)
+            .reshape(onehot.shape[:-1] + (3, NLIMB))
+        )
+        acc_s = pt_add_mixed(acc_s, entry)
+        return acc_h, acc_s
+
+    acc_h, acc_s = lax.fori_loop(0, NWINDOWS, body, (acc0_h, acc0_s))
+    rp = pt_add_cached(acc_s, pt_to_cached(acc_h))
     enc = pt_encode_words(rp)
     eq = jnp.all(enc == r_words, axis=-1)
     return eq & a_valid & s_canonical
@@ -260,7 +351,6 @@ def verify_kernel(a_words, r_words, s_windows, h_windows, s_canonical):
 
 # --------------------------------------------------------------------------
 # host-side preparation
-
 
 _L_BYTES = np.frombuffer(L.to_bytes(32, "little"), np.uint8)
 _NATIVE_PREP = None
@@ -289,9 +379,24 @@ def _nibbles_le(b: np.ndarray) -> np.ndarray:
     return np.stack([lo, hi], axis=-1).reshape(b.shape[0], 64).astype(np.int32)
 
 
+def _signed_digits_le(b: np.ndarray) -> np.ndarray:
+    """[B, 32] uint8 LE scalar bytes -> [B, 64] int32 signed 4-bit digits
+    in [-8, 7], LSB first. Valid for scalars < 2^253 (top digit + final
+    carry stays < 8, so no 65th digit is needed)."""
+    nib = _nibbles_le(b)
+    out = np.empty_like(nib)
+    carry = np.zeros(nib.shape[0], np.int32)
+    for i in range(64):
+        v = nib[:, i] + carry
+        ge = v >= 8
+        out[:, i] = v - (ge << 4)
+        carry = ge.astype(np.int32)
+    return out
+
+
 def prepare_batch(publics, messages, signatures, device_put: bool = True):
     """Host prep: pack keys/sigs, compute h = SHA512(R||A||M) mod l and the
-    window decompositions. Returns dict of arrays for verify_kernel.
+    digit decompositions. Returns dict of arrays for verify_kernel.
 
     Fully vectorized: byte packing / window extraction / canonical checks
     are numpy over the whole batch; the SHA-512 + mod-l per-signature work
@@ -341,14 +446,14 @@ def prepare_batch(publics, messages, signatures, device_put: bool = True):
                 "little",
             ) % L
             h_scalars[i] = np.frombuffer(h.to_bytes(32, "little"), np.uint8)
-    h_windows = _nibbles_le(h_scalars)
+    h_digits = _signed_digits_le(h_scalars)
 
     put = jnp.asarray if device_put else (lambda x: x)
     return dict(
         a_words=put(a_words),
         r_words=put(r_words),
         s_windows=put(s_windows),
-        h_windows=put(h_windows),
+        h_digits=put(h_digits),
         s_canonical=put(s_canonical),
     )
 

@@ -54,6 +54,7 @@ class SimValidator(ConsensusAdapter):
         quorum: int,
         idle_interval: int,
         proposing: bool = True,
+        voting=None,
     ):
         self.net = net
         self.nid = nid
@@ -67,6 +68,7 @@ class SimValidator(ConsensusAdapter):
             clock=net.clock,
             idle_interval=idle_interval,
             proposing=proposing,
+            voting=voting,
         )
 
     # -- ConsensusAdapter -------------------------------------------------
@@ -149,6 +151,7 @@ class SimNet:
         step_ms: int = 1000,
         idle_interval: int = 4,
         genesis_account: Optional[bytes] = None,
+        voting_factory=None,
     ):
         self.step_ms = step_ms
         self.latency_ms = latency_steps * step_ms
@@ -166,7 +169,15 @@ class SimNet:
         unl = {k.public for k in self.keys}
         q = quorum if quorum is not None else (n_validators * 3 + 3) // 4
         self.validators = [
-            SimValidator(self, i, self.keys[i], unl, q, idle_interval)
+            SimValidator(
+                self,
+                i,
+                self.keys[i],
+                unl,
+                q,
+                idle_interval,
+                voting=voting_factory(i) if voting_factory else None,
+            )
             for i in range(n_validators)
         ]
         self.genesis_account = genesis_account

@@ -33,7 +33,17 @@ from ..protocol.keys import KeyPair, verify_signature
 from ..protocol.sttx import SerializedTransaction
 from ..state.ledger import Ledger
 from ..utils.hashes import prefix_hash
+from .peerfinder import GOSSIP_INTERVAL, PeerFinder
+from .resource import (
+    Disposition,
+    FEE_BAD_DATA,
+    FEE_INVALID_REQUEST,
+    FEE_INVALID_SIGNATURE,
+    FEE_UNWANTED_DATA,
+    ResourceManager,
+)
 from .wire import (
+    Endpoints,
     FrameReader,
     GetLedger,
     GetTxSet,
@@ -67,6 +77,13 @@ class _Peer:
         self.established_at = 0.0
         # real wall-clock (not the node's virtual clock): socket liveness
         self.last_recv = time.monotonic()
+        try:
+            self.remote: tuple[str, int] = sock.getpeername()[:2]
+        except OSError:
+            self.remote = ("?", 0)
+        # (remote_ip, their_listen_port) once the hello arrives — the
+        # dialable identity of this peer for discovery
+        self.advertised: Optional[tuple[str, int]] = None
 
     def send(self, data: bytes) -> None:
         try:
@@ -101,6 +118,11 @@ class TcpOverlay(ConsensusAdapter):
         hash_batch: Optional[Callable] = None,
         peer_idle_ping: float = 9.0,
         peer_idle_drop: float = 30.0,
+        out_desired: int = 8,
+        max_peers: int = 21,
+        bootcache_path: Optional[str] = None,
+        resource_key_fn: Optional[Callable] = None,
+        gossip_interval: float = GOSSIP_INTERVAL,
     ):
         self.key = key
         self.port = port
@@ -122,6 +144,15 @@ class TcpOverlay(ConsensusAdapter):
         )
         self.peers: dict[bytes, _Peer] = {}  # node pubkey -> session
         self._dialing: set[tuple[str, int]] = set()  # dials in flight
+        self.peerfinder = PeerFinder(
+            fixed=peer_addrs,
+            out_desired=out_desired,
+            max_peers=max_peers,
+            bootcache_path=bootcache_path,
+        )
+        self.resources = ResourceManager(key_fn=resource_key_fn)
+        self.gossip_interval = gossip_interval
+        self._last_gossip = 0.0
         self._peers_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -142,6 +173,10 @@ class TcpOverlay(ConsensusAdapter):
 
     def stop(self) -> None:
         self._stop.set()
+        try:
+            self.peerfinder.bootcache.save()
+        except OSError:
+            pass
         if self._listener is not None:
             try:
                 self._listener.close()
@@ -173,19 +208,33 @@ class TcpOverlay(ConsensusAdapter):
             self._spawn(self._session, sock, True)
 
     def _connect_loop(self) -> None:
-        """Dial configured peers; redial on loss (reference: OverlayImpl
-        autoconnect via PeerFinder). Addresses with a live session (or a
-        dial in flight) are skipped so an established connection is never
-        churned by the redial timer."""
+        """Fill outbound slots from the PeerFinder's connect policy
+        (reference: OverlayImpl autoconnect via PeerFinder::autoconnect):
+        fixed seeds always, then gossip-discovered endpoints. Addresses
+        with a live session (or a dial in flight) are skipped so an
+        established connection is never churned by the redial timer."""
         while not self._stop.is_set():
-            for addr in self.peer_addrs:
+            with self._peers_lock:
+                connected = {
+                    a
+                    for p in self.peers.values()
+                    if p.alive
+                    for a in (p.addr, p.advertised)
+                    if a is not None
+                }
+                dialing = set(self._dialing)
+                out_count = sum(
+                    1 for p in self.peers.values() if not p.inbound and p.alive
+                )
+                total = len(self.peers)
+            # never dial ourselves (our own gossiped hop-0 endpoint)
+            connected.add(("127.0.0.1", self.port))
+            targets = self.peerfinder.dial_targets(
+                connected, dialing, out_count, total
+            )
+            for addr in targets:
                 with self._peers_lock:
                     if addr in self._dialing:
-                        continue
-                    if any(
-                        p.addr == addr and p.alive
-                        for p in self.peers.values()
-                    ):
                         continue
                     self._dialing.add(addr)
                 self._spawn(self._dial, addr)
@@ -195,6 +244,7 @@ class TcpOverlay(ConsensusAdapter):
         try:
             sock = socket.create_connection(addr, timeout=2.0)
         except OSError:
+            self.peerfinder.on_failure(addr)
             with self._peers_lock:
                 self._dialing.discard(addr)
             return
@@ -210,6 +260,11 @@ class TcpOverlay(ConsensusAdapter):
         (reference: PeerImp::onHandshake/recvHello)."""
         peer = _Peer(sock, inbound, addr)
         try:
+            if inbound and not self.resources.should_admit(peer.remote):
+                # endpoint balance still above the drop line: refuse
+                # reconnects until it decays (reference Logic::newInboundEndpoint)
+                peer.close()
+                return
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
             sock.settimeout(5.0)
             nonce = os.urandom(32)
@@ -226,6 +281,7 @@ class TcpOverlay(ConsensusAdapter):
                 self.key.sign(session_hash),
                 lcl.seq,
                 lcl.hash(),
+                self.port,
             )
             peer.send(frame(hello))
             their_hello = self._read_hello(sock, peer)
@@ -235,9 +291,22 @@ class TcpOverlay(ConsensusAdapter):
             if not verify_signature(
                 their_hello.node_public, session_hash, their_hello.session_sig
             ):
+                self._charge(peer, FEE_INVALID_SIGNATURE)
+                peer.close()
+                return
+            if their_hello.node_public == self.key.public:
+                # connected to ourselves via a gossiped address: drop and
+                # blacklist the address in the bootcache
+                if addr is not None:
+                    self.peerfinder.on_failure(addr)
                 peer.close()
                 return
             peer.node_public = their_hello.node_public
+            if 0 < their_hello.listen_port < 65536:
+                peer.advertised = (peer.remote[0], their_hello.listen_port)
+                self.peerfinder.bootcache.insert(peer.advertised)
+            if not inbound and addr is not None:
+                self.peerfinder.on_success(addr)
             now = self._clock()
             with self._peers_lock:
                 existing = self.peers.get(peer.node_public)
@@ -288,9 +357,10 @@ class TcpOverlay(ConsensusAdapter):
         except OSError:
             pass
         except ValueError:
-            # malformed frame / unknown message type (version skew): close
-            # this peer cleanly instead of killing the reader thread
-            pass
+            # malformed frame / unknown message type (version skew): charge
+            # and close this peer cleanly instead of killing the reader
+            # thread (reference: PeerImp charge(feeInvalidRequest))
+            self._charge(peer, FEE_INVALID_REQUEST)
         finally:
             with self._peers_lock:
                 if self.peers.get(peer.node_public) is peer:
@@ -332,26 +402,55 @@ class TcpOverlay(ConsensusAdapter):
             for msg in peer.reader.feed(data):
                 self._dispatch(peer, msg)
 
+    def _charge(self, peer: _Peer, fee) -> None:
+        """Charge the peer's endpoint; disconnect on DROP (reference:
+        PeerImp.cpp:129-131 charge(feeInvalidSignature) → Logic drop)."""
+        if self.resources.charge(peer.remote, fee) == Disposition.DROP:
+            peer.close()
+
+    def _charge_if_bad(self, peer: _Peer, suppression_id: bytes) -> None:
+        """After a handler rejected a message: if the HashRouter marked it
+        SF_BAD the signature was invalid (not merely duplicate) — that is
+        the chargeable offense."""
+        from ..node.hashrouter import SF_BAD
+
+        if self.node.router.get_flags(suppression_id) & SF_BAD:
+            self._charge(peer, FEE_INVALID_SIGNATURE)
+
     def _dispatch(self, peer: _Peer, msg) -> None:
         """reference: PeerImp message switch (PeerImp.cpp:1459-1738) —
-        verify → apply → relay-if-new."""
+        verify → apply → relay-if-new, charging abusive senders."""
         node = self.node
         if isinstance(msg, TxMessage):
             tx = SerializedTransaction.from_bytes(msg.blob)
-            if self._first_seen(tx.txid(), peer) and node.handle_tx(tx):
-                self._relay(msg, except_peer=peer)
+            txid = tx.txid()
+            if self._first_seen(txid, peer):
+                if node.handle_tx(tx):
+                    self._relay(msg, except_peer=peer)
+                else:
+                    self._charge_if_bad(peer, txid)
         elif isinstance(msg, ProposeSet):
             prop = msg.to_proposal()
-            if self._first_seen(prop.suppression_id(), peer) and (
-                node.handle_proposal(prop)
-            ):
-                self._relay(msg, except_peer=peer)
+            pid = prop.suppression_id()
+            if self._first_seen(pid, peer):
+                if node.handle_proposal(prop):
+                    self._relay(msg, except_peer=peer)
+                else:
+                    self._charge_if_bad(peer, pid)
         elif isinstance(msg, ValidationMessage):
             val = STValidation.from_bytes(msg.blob)
-            if self._first_seen(val.validation_id(), peer) and (
-                node.handle_validation(val)
-            ):
-                self._relay(msg, except_peer=peer)
+            vid = val.validation_id()
+            if self._first_seen(vid, peer):
+                if node.handle_validation(val):
+                    self._relay(msg, except_peer=peer)
+                else:
+                    self._charge_if_bad(peer, vid)
+        elif isinstance(msg, Endpoints):
+            accepted = self.peerfinder.on_endpoints(
+                msg.endpoints, sender=peer.remote
+            )
+            if accepted <= 0:  # oversized (-1) or all-garbage (0)
+                self._charge(peer, FEE_UNWANTED_DATA)
         elif isinstance(msg, TxSetData):
             ts = TxSet(node.hash_batch)
             for blob in msg.tx_blobs:
@@ -359,6 +458,8 @@ class TcpOverlay(ConsensusAdapter):
                 ts.add(tx.txid(), blob)
             if ts.hash() == msg.set_hash:
                 node.handle_txset(ts)
+            else:
+                self._charge(peer, FEE_BAD_DATA)
         elif isinstance(msg, GetTxSet):
             ts = node.txset_cache.get(msg.set_hash)
             if ts is None and node.round is not None:
@@ -397,6 +498,17 @@ class TcpOverlay(ConsensusAdapter):
         ping_seq = 0
         while not self._stop.wait(self.timer_interval):
             self.node.on_timer()
+            # ENDPOINTS gossip: advertise our own listener (hop 0, host
+            # rewritten to the observed IP by the receiver) plus a bounded
+            # re-share of fresh livecache entries (reference mtENDPOINTS,
+            # PeerSlotLogic::sendEndpoints)
+            mono = time.monotonic()
+            if mono - self._last_gossip >= self.gossip_interval:
+                self._last_gossip = mono
+                sample = self.peerfinder.gossip_sample(("0.0.0.0", self.port))
+                if sample:
+                    self._broadcast(Endpoints(sample))
+                self.resources.sweep()
             # Half-open detection: a crashed peer (no FIN/RST) leaves our
             # reader blocked in recv with alive=True forever, which would
             # also suppress redials. Ping idle peers; drop ones silent past

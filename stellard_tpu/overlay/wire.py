@@ -70,8 +70,10 @@ class MessageType(IntEnum):
 @dataclass
 class Hello:
     """Session handshake: protocol version, our node key, a signature of
-    the session's shared fingerprint proving key ownership, and our
-    chain tip (reference: TMHello + PeerImp hello proof)."""
+    the session's shared fingerprint proving key ownership, our chain
+    tip, and the port our own listener accepts on — inbound sessions
+    arrive from an ephemeral port, so discovery (PeerFinder) needs the
+    listen port advertised explicitly (reference: TMHello ipv4Port)."""
 
     proto_version: int
     net_time: int
@@ -79,6 +81,7 @@ class Hello:
     session_sig: bytes
     ledger_seq: int
     closed_ledger: bytes
+    listen_port: int = 0
 
 
 @dataclass
@@ -193,11 +196,18 @@ def _enc_hello(s: Serializer, m: Hello):
     s.add_vl(m.session_sig)
     s.add32(m.ledger_seq)
     s.add_raw(m.closed_ledger)
+    s.add16(m.listen_port)
 
 
 def _dec_hello(p: BinaryParser) -> Hello:
     return Hello(
-        p.read32(), p.read32(), p.read_vl(), p.read_vl(), p.read32(), p.read(32)
+        p.read32(),
+        p.read32(),
+        p.read_vl(),
+        p.read_vl(),
+        p.read32(),
+        p.read(32),
+        p.read16(),
     )
 
 

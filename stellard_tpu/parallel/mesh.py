@@ -61,8 +61,8 @@ def verify_and_count(mesh: Mesh):
     verified' is a cross-chip reduction, kept on-device.
     """
 
-    def local(a_words, r_words, s_windows, h_windows, s_canonical):
-        flags = verify_kernel(a_words, r_words, s_windows, h_windows, s_canonical)
+    def local(a_words, r_words, s_windows, h_digits, s_canonical):
+        flags = verify_kernel(a_words, r_words, s_windows, h_digits, s_canonical)
         total = jax.lax.psum(jnp.sum(flags.astype(jnp.int32)), BATCH_AXIS)
         return flags, total
 

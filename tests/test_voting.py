@@ -1,0 +1,145 @@
+"""Amendment + fee voting through real consensus rounds.
+
+Reference behavior covered (SURVEY §2.5 AmendmentTable / FeeVote):
+- validators carry amendment votes and fee targets in their validations
+  (AmendmentTableImpl::doValidation, FeeVoteImpl::doValidation),
+- on a flag-ledger boundary the winning votes become ttAMENDMENT/ttFEE
+  pseudo-transactions in the next initial position
+  (doVoting, LedgerConsensus.cpp:1033-1038),
+- the pseudo-txs apply through the Change transactors, so the amendment
+  lands in ltAMENDMENTS and the fee schedule actually changes — on every
+  validator identically.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from stellard_tpu.consensus.validation import STValidation
+from stellard_tpu.consensus.voting import (
+    AmendmentTable,
+    FeeVote,
+    VotingBox,
+    make_amendment_tx,
+)
+from stellard_tpu.overlay.simnet import SimNet
+from stellard_tpu.protocol.sfields import sfAmendments
+from stellard_tpu.state import indexes
+
+AMENDMENT_X = hashlib.sha256(b"featureX").digest()
+AMENDMENT_Y = hashlib.sha256(b"featureY").digest()
+
+
+def make_box(flag_interval=4, support=(AMENDMENT_X,), base_fee=None):
+    at = AmendmentTable(majority_time=0)
+    for a in support:
+        at.add_known(a, supported=True)
+    fv = None
+    if base_fee is not None:
+        fv = FeeVote(target_base_fee=base_fee)
+    return VotingBox(amendments=at, fees=fv, flag_interval=flag_interval)
+
+
+class TestUnits:
+    def test_amendment_majority_tracking(self):
+        at = AmendmentTable(majority_time=100, majority_fraction=204)
+        at.add_known(AMENDMENT_X)
+
+        def vals(n_for, n_total):
+            out = []
+            for i in range(n_total):
+                v = STValidation.build(
+                    ledger_hash=b"\x01" * 32,
+                    signing_time=0,
+                    amendments=[AMENDMENT_X] if i < n_for else None,
+                )
+                v.trusted = True
+                out.append(v)
+            return out
+
+        # 2 of 4 voters: below the ~80% line — no majority recorded
+        assert at.do_voting(1000, vals(2, 4)) == []
+        assert AMENDMENT_X not in at.majorities
+        # 4 of 4: majority starts, but must HOLD for majority_time
+        assert at.do_voting(1000, vals(4, 4)) == []
+        assert at.do_voting(1050, vals(4, 4)) == []
+        txs = at.do_voting(1101, vals(4, 4))
+        assert len(txs) == 1 and txs[0].txid() == make_amendment_tx(AMENDMENT_X).txid()
+        # a lapse resets the clock
+        at2 = AmendmentTable(majority_time=100)
+        at2.add_known(AMENDMENT_Y)
+        at2.do_voting(1000, vals(4, 4))
+        at2.do_voting(1050, vals(0, 4))  # lost majority
+        assert at2.do_voting(1101, vals(4, 4)) == []
+
+    def test_fee_plurality(self):
+        fv = FeeVote(target_base_fee=15)
+
+        class L:
+            base_fee = 10
+            reference_fee_units = 10
+            reserve_base = 20_000_000
+            reserve_increment = 5_000_000
+
+        def vals(fees):
+            out = []
+            for f in fees:
+                v = STValidation.build(
+                    ledger_hash=b"\x01" * 32, signing_time=0, base_fee=f
+                )
+                v.trusted = True
+                out.append(v)
+            return out
+
+        # majority votes 15 -> SetFee pseudo-tx at 15
+        txs = fv.do_voting(L(), vals([15, 15, 15, None]))
+        assert len(txs) == 1
+        from stellard_tpu.protocol.sfields import sfBaseFee
+
+        assert txs[0].obj[sfBaseFee] == 15
+        # split vote: current value wins by incumbent bias -> no change
+        assert fv.do_voting(L(), vals([15, 15, None, None])) == []
+
+
+class TestConsensusVoting:
+    def test_amendment_and_fee_enacted_via_consensus(self):
+        net = SimNet(
+            4,
+            voting_factory=lambda i: make_box(
+                flag_interval=4, support=(AMENDMENT_X,), base_fee=15
+            ),
+        )
+        net.start()
+        # run well past the first flag boundary (seq 4) + enactment (seq 5)
+        assert net.run_until(lambda: net.all_validated_at_least(6), 120)
+        for v in net.validators:
+            led = v.node.lm.validated
+            sle = led.read_entry(indexes.amendment_index())
+            assert sle is not None, "ltAMENDMENTS missing"
+            assert AMENDMENT_X in list(sle.get(sfAmendments, []))
+            assert led.base_fee == 15
+            # voting box sees it enabled now -> no longer voted for
+            assert v.node.voting.amendments.do_validation() is None
+        # no forks anywhere along the way
+        for seq in range(2, 6):
+            assert len(net.validated_hashes_at(seq)) == 1
+
+    def test_vetoed_amendment_never_enacts(self):
+        def factory(i):
+            box = make_box(flag_interval=4, support=(AMENDMENT_X,))
+            if i == 0:
+                box.amendments.veto(AMENDMENT_X)
+            return box
+
+        net = SimNet(4, voting_factory=factory)
+        net.start()
+        assert net.run_until(lambda: net.all_validated_at_least(6), 120)
+        # 3 of 4 vote for it — below the 204/256 (~80%) threshold, so the
+        # ledger stays clean and there is no fork
+        for v in net.validators:
+            led = v.node.lm.validated
+            sle = led.read_entry(indexes.amendment_index())
+            enabled = list(sle.get(sfAmendments, [])) if sle else []
+            assert AMENDMENT_X not in enabled
+        for seq in range(2, 6):
+            assert len(net.validated_hashes_at(seq)) == 1
