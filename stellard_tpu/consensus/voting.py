@@ -179,8 +179,8 @@ class FeeVote:
         self,
         target_base_fee: int = 10,
         target_reference_fee_units: int = 10,
-        target_reserve_base: int = 20_000_000,
-        target_reserve_increment: int = 5_000_000,
+        target_reserve_base: int = 200_000_000,
+        target_reserve_increment: int = 50_000_000,
     ):
         self.base_fee = target_base_fee
         self.reference_fee_units = target_reference_fee_units

@@ -105,7 +105,10 @@ class Job:
 
 
 class _TypeStats:
-    __slots__ = ("queued", "running", "finished", "dropped", "total_ms", "peak_ms")
+    __slots__ = (
+        "queued", "running", "finished", "dropped", "total_ms", "peak_ms",
+        "ewma_ms",
+    )
 
     def __init__(self):
         self.queued = 0
@@ -114,6 +117,9 @@ class _TypeStats:
         self.dropped = 0
         self.total_ms = 0.0
         self.peak_ms = 0.0
+        # recent latency incl. queue wait (LoadMonitor role: the load
+        # signal must react to the present, not the lifetime average)
+        self.ewma_ms = 0.0
 
 
 class JobQueue:
@@ -165,6 +171,18 @@ class JobQueue:
             s = self._stats[jtype]
             return s.queued + s.running
 
+    def is_overloaded(self) -> bool:
+        """Any latency-targeted job type running over its average target
+        (reference: JobQueue::isOverloaded → LoadMonitor::isOver). The
+        EWMA includes queue wait, so a deep backlog trips this even while
+        individual jobs are fast."""
+        with self._lock:
+            for t, s in self._stats.items():
+                target = JOB_LIMITS[t].avg_ms
+                if target and s.ewma_ms > target and (s.queued or s.running):
+                    return True
+        return False
+
     # -- worker loop ------------------------------------------------------
 
     def _next_runnable(self) -> Optional[Job]:
@@ -203,12 +221,17 @@ class JobQueue:
                 import traceback
 
                 traceback.print_exc()
-            ms = (time.monotonic() - t0) * 1000
+            now = time.monotonic()
+            ms = (now - t0) * 1000
+            # load signal includes the time spent waiting in the queue
+            # (reference: LoadMonitor::addSamples measures from queue entry)
+            wait_ms = (now - job.queued_at) * 1000
             with self._lock:
                 st.running -= 1
                 st.finished += 1
                 st.total_ms += ms
                 st.peak_ms = max(st.peak_ms, ms)
+                st.ewma_ms += 0.25 * (wait_ms - st.ewma_ms)
                 # a slot freed for a limited type may unblock a deferred job
                 self._cv.notify()
 

@@ -1,0 +1,162 @@
+"""Load-scaled fees + the load/deadlock watchdog.
+
+Role parity with the reference's three-piece load plane:
+- LoadFeeTrack (/root/reference/src/ripple_core/functional/LoadFeeTrack.h:51,
+  LoadFeeTrackImp.cpp): a fee multiplier in 1/256 units that rises while
+  the node is overloaded and decays back to normal, applied to the
+  open-ledger required fee (telINSUF_FEE_P when a tx pays less);
+- LoadManager (/root/reference/src/ripple_app/main/LoadManager.cpp:81-223):
+  a watchdog thread that samples the job queue each second, raising or
+  lowering the local fee, plus the deadlock canary — if the heartbeat
+  fails to reset it for ``deadlock_timeout`` seconds the node is wedged
+  and ``on_deadlock`` fires (the reference aborts after 500s);
+- the peer-transaction backlog shed (reference PeerImp.cpp:64-66): relay
+  transaction intake is dropped outright while more than
+  ``TX_BACKLOG_SHED`` jtTRANSACTION jobs are queued.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["LoadFeeTrack", "LoadManager", "TX_BACKLOG_SHED"]
+
+NORMAL_FEE = 256  # lftNormalFee: multiplier denominator ("no escalation")
+MAX_FEE = 256 * 1_000_000  # safety ceiling on escalation
+TX_BACKLOG_SHED = 100  # reference: drop peer txs at >100 queued jobs
+
+
+class LoadFeeTrack:
+    """Local + remote load-fee multipliers, 1/256 units.
+
+    raise/lower follow the reference's quarter-step dynamics: each raise
+    adds ~25%, each lower removes ~25% of the distance toward normal, so
+    sustained overload escalates geometrically and recovery is smooth.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._local = NORMAL_FEE
+        self._remote = NORMAL_FEE
+        self.raise_count = 0
+
+    def raise_local_fee(self) -> None:
+        with self._lock:
+            self._local = min(MAX_FEE, self._local + max(1, self._local // 4))
+            self.raise_count += 1
+
+    def lower_local_fee(self) -> None:
+        with self._lock:
+            if self._local > NORMAL_FEE:
+                self._local = max(NORMAL_FEE, self._local - max(1, self._local // 4))
+
+    def set_remote_fee(self, fee: int) -> None:
+        """From cluster/peer load reports (sfLoadFee in validations)."""
+        with self._lock:
+            self._remote = max(NORMAL_FEE, min(MAX_FEE, int(fee)))
+
+    @property
+    def load_factor(self) -> int:
+        with self._lock:
+            return max(self._local, self._remote)
+
+    @property
+    def is_loaded(self) -> bool:
+        return self.load_factor > NORMAL_FEE
+
+    def get_json(self) -> dict:
+        with self._lock:
+            return {
+                "load_factor": max(self._local, self._remote),
+                "load_base": NORMAL_FEE,
+                "local_fee": self._local,
+                "remote_fee": self._remote,
+            }
+
+
+class LoadManager:
+    """Watchdog thread: job-queue load → fee escalation; deadlock canary.
+
+    The heartbeat (NetworkOPs timer / Node.run loop) must call
+    ``reset_deadlock_detector()`` regularly; if it stops for
+    ``deadlock_timeout`` seconds, ``on_deadlock`` fires once (reference
+    LoadManager.cpp:81-204 aborts the process; embedders decide here).
+    """
+
+    def __init__(
+        self,
+        job_queue,
+        fee_track: LoadFeeTrack,
+        clock: Optional[Callable[[], float]] = None,
+        interval: float = 1.0,
+        deadlock_timeout: float = 500.0,
+        on_deadlock: Optional[Callable[[], None]] = None,
+    ):
+        self.jq = job_queue
+        self.fee_track = fee_track
+        self.clock = clock or time.monotonic
+        self.interval = interval
+        self.deadlock_timeout = deadlock_timeout
+        self.on_deadlock = on_deadlock
+        self._armed = False
+        self._canary = self.clock()
+        self._deadlock_fired = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- deadlock canary --------------------------------------------------
+
+    def reset_deadlock_detector(self) -> None:
+        """Called from the heartbeat (reference: resetDeadlockDetector)."""
+        self._canary = self.clock()
+
+    def arm(self) -> None:
+        """Start watching for deadlock (reference: activateDeadlockDetector,
+        armed only once the application is fully up)."""
+        self._canary = self.clock()
+        self._armed = True
+
+    # -- periodic work ----------------------------------------------------
+
+    def tick(self) -> None:
+        """One watchdog pass — called by the background thread, or directly
+        by tests with a fake clock."""
+        now = self.clock()
+        if (
+            self._armed
+            and not self._deadlock_fired
+            and now - self._canary > self.deadlock_timeout
+        ):
+            self._deadlock_fired = True
+            if self.on_deadlock is not None:
+                self.on_deadlock()
+        if self.jq is not None and self.jq.is_overloaded():
+            self.fee_track.raise_local_fee()
+        else:
+            self.fee_track.lower_local_fee()
+
+    def start(self) -> "LoadManager":
+        self._thread = threading.Thread(
+            target=self._run, name="load-manager", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.tick()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def get_json(self) -> dict:
+        return {
+            "armed": self._armed,
+            "deadlock_fired": self._deadlock_fired,
+            "seconds_since_heartbeat": round(self.clock() - self._canary, 1),
+            **self.fee_track.get_json(),
+        }

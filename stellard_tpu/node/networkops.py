@@ -66,11 +66,13 @@ class NetworkOPs:
         verify_plane: VerifyPlane,
         hash_router: HashRouter,
         standalone: bool = True,
+        fee_track=None,
     ):
         self.lm = ledger_master
         self.jq = job_queue
         self.vp = verify_plane
         self.router = hash_router
+        self.fee_track = fee_track  # loadmgr.LoadFeeTrack or None
         self.standalone = standalone
         self.mode = OperatingMode.FULL if standalone else OperatingMode.DISCONNECTED
         self.master_lock = threading.RLock()  # reference: getApp().getMasterLock()
@@ -98,6 +100,17 @@ class NetworkOPs:
         """Async submission: verify (coalesced) off the master lock, then
         process on a jtTRANSACTION job (reference:
         NetworkOPs::submitTransaction :274-321)."""
+        # relay backlog shed (reference: PeerImp.cpp:64-66 — drop new
+        # network transactions outright past a 100-job backlog). A caller
+        # that asked for a result still gets one (telINSUF_FEE_P: transient
+        # local overload, resubmittable) so local clients never hang.
+        from .loadmgr import TX_BACKLOG_SHED
+
+        if self.jq.get_job_count(JobType.jtTRANSACTION) > TX_BACKLOG_SHED:
+            self.stats["shed"] = self.stats.get("shed", 0) + 1
+            if cb:
+                cb(tx, TER.telINSUF_FEE_P, False)
+            return
         txid = tx.txid()
         flags = self.router.get_flags(txid)
         if flags & SF_BAD:
@@ -162,6 +175,11 @@ class NetworkOPs:
         if admin:
             params |= TxParams.ADMIN
         with self.master_lock:
+            if self.fee_track is not None:
+                # load-scaled open-ledger fee: Transactor::payFee reads the
+                # ledger's load_factor (reference: scaleFeeLoad via
+                # LoadFeeTrack) and rejects under-payers with telINSUF_FEE_P
+                self.lm.current_ledger().load_factor = self.fee_track.load_factor
             ter, did_apply = self.lm.do_transaction(tx, params)
         self.stats["processed"] += 1
 
@@ -196,6 +214,11 @@ class NetworkOPs:
         admin RPC; the JS integration tests drive closes this way,
         SURVEY §4.3)."""
         with self.master_lock:
+            if self.fee_track is not None:
+                # refresh before close: held-tx retries inside
+                # close_and_advance must see the CURRENT load, not the
+                # factor stamped by the last submission
+                self.lm.current_ledger().load_factor = self.fee_track.load_factor
             closed, results = self.lm.close_and_advance(
                 close_time=self.network_time(),
                 close_resolution=self.lm.closed_ledger().close_resolution,

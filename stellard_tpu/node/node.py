@@ -47,6 +47,15 @@ class Node:
         )
         self.txdb = TxDatabase(cfg.database_path or ":memory:")
 
+        # stellar CLF plane: SQL mirror + LCL pointer (reference:
+        # stellar::gLedgerMaster + workingledger.db, Application.cpp:716)
+        from ..state.clf import CLFMirror, LedgerSqlDatabase
+
+        clf_path = (
+            cfg.database_path + ".clf" if cfg.database_path else ":memory:"
+        )
+        self.clf = CLFMirror(LedgerSqlDatabase(clf_path))
+
         # crypto plane (north star: pluggable cpu|tpu batch backends)
         self.hasher = make_hasher(cfg.hash_backend)
         self.verify_plane = VerifyPlane(
@@ -60,6 +69,12 @@ class Node:
         self.job_queue = JobQueue(threads=cfg.thread_count())
         self.hash_router = HashRouter()
 
+        # load plane (reference: LoadFeeTrack :346, LoadManager :354)
+        from .loadmgr import LoadFeeTrack, LoadManager
+
+        self.fee_track = LoadFeeTrack()
+        self.load_manager = LoadManager(self.job_queue, self.fee_track)
+
         # ledger chain + brain
         self.ledger_master = LedgerMaster(
             hash_batch=self.hasher.prefix_hash_batch
@@ -70,6 +85,7 @@ class Node:
             self.verify_plane,
             self.hash_router,
             standalone=cfg.standalone,
+            fee_track=self.fee_track,
         )
         self.ops.on_ledger_closed.append(self._persist_closed_ledger)
 
@@ -102,16 +118,23 @@ class Node:
             genesis.save(self.nodestore)
             self.txdb.save_ledger_header(genesis)
         elif self.config.start_up == "load":
-            # resume from the newest persisted ledger (reference:
-            # loadOldLedger, Application.cpp:737-758)
-            hdr = self.txdb.get_ledger_header()
-            if hdr is None:
+            # resume preference order (reference: loadLastKnownCLF
+            # Application.cpp:729, then loadOldLedger :737-758): the CLF
+            # state pointer is the atomically-committed source of truth;
+            # the txdb header index is the fallback
+            led = self.clf.load_last_known(
+                self.nodestore, hash_batch=self.hasher.prefix_hash_batch
+            )
+            if led is None:
+                hdr = self.txdb.get_ledger_header()
+                if hdr is not None:
+                    led = Ledger.load(
+                        self.nodestore, hdr["hash"],
+                        hash_batch=self.hasher.prefix_hash_batch,
+                    )
+            if led is None:
                 self.ledger_master.start_new_ledger(self.master_keys.account_id)
             else:
-                led = Ledger.load(
-                    self.nodestore, hdr["hash"],
-                    hash_batch=self.hasher.prefix_hash_batch,
-                )
                 self.ledger_master.load_ledger(led)
         return self
 
@@ -135,17 +158,38 @@ class Node:
                 subs=self.subs,
             ).start()
         self._running.set()
+        self.load_manager.start()
         return self
 
     def run(self) -> None:
         """Block until stopped (reference: ApplicationImp::run)."""
         import time as _time
 
+        from .jobqueue import JobType
+
+        # watchdog armed only once the run loop drives heartbeats
+        # (reference: activateDeadlockDetector from ApplicationImp::run
+        # :1028); embedders that drive the node directly never arm it
+        self.load_manager.arm()
+        last_beat = 0.0
         while self._running.is_set():
+            # the heartbeat must flow THROUGH the job queue: a wedged
+            # worker pool or master lock then starves the canary reset and
+            # the detector fires (reference: the heartbeat is itself a
+            # jtNETOP_TIMER job)
+            now = _time.monotonic()
+            if now - last_beat >= 1.0:
+                last_beat = now
+                self.job_queue.add_job(
+                    JobType.jtNETOP_TIMER,
+                    "heartbeat",
+                    self.load_manager.reset_deadlock_detector,
+                )
             _time.sleep(0.2)
 
     def stop(self) -> None:
         self._running.clear()
+        self.load_manager.stop()
         if self.http_server:
             self.http_server.stop()
         if self.ws_server:
@@ -160,6 +204,10 @@ class Node:
     def _persist_closed_ledger(self, ledger: Ledger, results: dict) -> None:
         ledger.save(self.nodestore)
         self.txdb.save_ledger_header(ledger)
+        # CLF commit: one scoped SQL transaction — entry-row delta + LCL
+        # pointer (reference: stellar::LedgerMaster::commitLedgerClose)
+        prev = self.ledger_master.get_ledger_by_hash(ledger.parent_hash)
+        self.clf.commit_ledger_close(ledger, prev)
         from ..protocol.meta import affected_accounts
 
         with self.txdb.batch():

@@ -216,7 +216,8 @@ def do_server_info(ctx: Context) -> dict:
         "server_state": node.ops.server_state(),
         "complete_ledgers": _complete_ledgers(node),
         "peers": 0,
-        "load_factor": 1.0,
+        "load_factor": node.fee_track.load_factor / 256.0,
+        "load_base": 256,
         "signature_backend": node.config.signature_backend,
         "validation_quorum": node.config.validation_quorum,
         "validated_ledger": {
@@ -252,7 +253,7 @@ def do_server_state(ctx: Context) -> dict:
             "complete_ledgers": _complete_ledgers(node),
             "peers": 0,
             "load_base": 256,
-            "load_factor": 256,
+            "load_factor": node.fee_track.load_factor,
         }
     }
 

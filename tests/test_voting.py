@@ -78,8 +78,8 @@ class TestUnits:
         class L:
             base_fee = 10
             reference_fee_units = 10
-            reserve_base = 20_000_000
-            reserve_increment = 5_000_000
+            reserve_base = 200_000_000
+            reserve_increment = 50_000_000
 
         def vals(fees):
             out = []
