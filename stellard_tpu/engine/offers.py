@@ -20,6 +20,7 @@ OfferStream,Taker,Quality}.h):
 from __future__ import annotations
 
 from dataclasses import dataclass
+from fractions import Fraction
 from typing import Optional
 
 from ..protocol.formats import LedgerEntryType, TxType
@@ -62,6 +63,9 @@ from . import views
 
 # a non-zero currency marker for rate arithmetic (reference CURRENCY_ONE)
 CURRENCY_ONE = (1).to_bytes(20, "big")
+
+# maximal 64-bit quality encoding: accepts any tip price (bridge legs)
+PERMISSIVE_RATE = (1 << 64) - 1
 
 
 def get_rate(offer_out: STAmount, offer_in: STAmount) -> int:
@@ -123,6 +127,8 @@ def cross_offers(
     sell: bool,
     passive: bool,
     parent_close_time: int,
+    max_quality_levels: Optional[int] = None,
+    threshold_rate: Optional[int] = None,
 ) -> tuple[TER, STAmount, STAmount]:
     """Cross the book base(in_currency, out_currency) as a taker; returns
     (TER, paid_in_total, got_out_total).
@@ -130,13 +136,23 @@ def cross_offers(
     reference: process_order/Taker loop (CreateOfferDirect.cpp:29-175,
     Taker.h:120-290). Consumed / unfunded / expired / self offers are
     deleted as encountered (BookTip::step deletes stepped-past tips).
+
+    ``max_quality_levels`` bounds how many distinct price levels may be
+    consumed — the auto-bridge uses 1 so it can re-compare the direct
+    book against the two-leg composite after every level.
+    ``threshold_rate`` overrides the worst-acceptable price (the bridge
+    legs enforce the COMPOSITE price themselves, so a leg must not be
+    capped by the in/out ratio of its bounding amounts).
     """
     book_base = indexes.book_base(
         taker_pays_in.currency, taker_pays_in.issuer,
         taker_wants_out.currency, taker_wants_out.issuer,
     )
     book_end = indexes.quality_next(book_base)
-    threshold = get_rate(taker_wants_out, taker_pays_in)  # my in/out price
+    if threshold_rate is not None:
+        threshold = threshold_rate  # caller-enforced price cap
+    else:
+        threshold = get_rate(taker_wants_out, taker_pays_in)  # in/out price
 
     paid = STAmount.zero_like(taker_pays_in.currency, taker_pays_in.issuer)
     got = STAmount.zero_like(taker_wants_out.currency, taker_wants_out.issuer)
@@ -149,6 +165,7 @@ def cross_offers(
     out_left = taker_wants_out
 
     cursor = book_base
+    levels_used = 0
     while True:
         # done? (reference: Taker::done)
         if sell:
@@ -171,6 +188,10 @@ def cross_offers(
         # reject: quality worse than my threshold (passive: or equal)
         if quality > threshold or (passive and quality == threshold):
             break
+        if max_quality_levels is not None:
+            levels_used += 1
+            if levels_used > max_quality_levels:
+                break
 
         for offer_idx in list(les.dir_entries(dir_idx)):
             offer = les.peek(offer_idx)
@@ -263,6 +284,199 @@ def cross_offers(
     return TER.tesSUCCESS, paid, got
 
 
+# --------------------------------------------------------------------------
+# auto-bridging (IOU/IOU offers crossing through the two STR books)
+#
+# The reference planned this seam (transactors/CreateOffer.cpp:21
+# "Autobridging is only in effect when an offer does not involve STR")
+# but its CreateOfferBridged transactor is an empty placeholder and it
+# always falls back to the direct book. Here the bridge is real: each
+# step compares the direct tip price against the composite of the
+# IN->STR and STR->OUT tips and consumes one price level from the
+# cheaper source, which is the modern FlowCross behavior.
+
+
+def _exact_price(pay: STAmount, get: STAmount) -> Fraction:
+    """in-per-out as an exact rational (lower = cheaper for the taker)."""
+    p_m, p_off = pay.mantissa, (0 if pay.is_native else pay.offset)
+    g_m, g_off = get.mantissa, (0 if get.is_native else get.offset)
+    if g_m <= 0:
+        return Fraction(0)
+    num, den = p_m, g_m
+    e = p_off - g_off
+    if e >= 0:
+        num *= 10**e
+    else:
+        den *= 10 ** (-e)
+    return Fraction(num, den)
+
+
+def _tip_info(
+    les, taker_id: bytes, want_in: STAmount, want_out: STAmount,
+    parent_close_time: int,
+):
+    """Peek the best live, funded, non-self tip of a book WITHOUT mutating:
+    -> (price Fraction in-per-out, in_capacity, out_capacity) or None.
+    Mirrors the skip rules of the consuming loop (unfunded / expired /
+    self offers are ignored here, deleted there)."""
+    base = indexes.book_base(
+        want_in.currency, want_in.issuer, want_out.currency, want_out.issuer
+    )
+    end = indexes.quality_next(base)
+    cursor = base
+    while True:
+        item = les.ledger.state_map.succ(cursor)
+        if item is None or item.tag >= end:
+            return None
+        dir_idx = item.tag
+        cursor = dir_idx
+        if les.peek(dir_idx) is None:
+            continue
+        for offer_idx in les.dir_entries(dir_idx):
+            offer = les.peek(offer_idx)
+            if offer is None:
+                continue
+            if offer[sfAccount] == taker_id:
+                continue
+            if (
+                sfExpiration in offer
+                and parent_close_time >= offer[sfExpiration]
+            ):
+                continue
+            rest = Amounts(offer[sfTakerPays], offer[sfTakerGets])
+            funds = views.account_funds(les, offer[sfAccount], rest.o)
+            if funds.signum() <= 0:
+                continue
+            flow = _scale_to_out(rest, funds)
+            if flow.i.signum() <= 0 or flow.o.signum() <= 0:
+                continue
+            return (_exact_price(flow.i, flow.o), flow.i, flow.o)
+
+
+def cross_offers_auto_bridged(
+    les,
+    taker_id: bytes,
+    taker_pays_in: STAmount,  # IOU the taker pays
+    taker_wants_out: STAmount,  # IOU the taker wants
+    sell: bool,
+    passive: bool,
+    parent_close_time: int,
+    max_steps: int = 64,
+) -> tuple[TER, STAmount, STAmount]:
+    """Best-execution crossing for an IOU/IOU taker over three books:
+    direct IN->OUT, plus the IN->STR / STR->OUT bridge."""
+    threshold = _exact_price(taker_pays_in, taker_wants_out)
+    # 64-bit encoding of the taker's ORIGINAL limit: sub-steps must use
+    # this, not a limit recomputed from the partially-consumed remainders
+    # (in sell mode out_left never shrinks, so a recomputed in/out ratio
+    # would tighten below the taker's actual limit and refuse good fills)
+    threshold_enc = get_rate(taker_wants_out, taker_pays_in)
+    xrp_zero = STAmount.from_drops(0)
+    paid = STAmount.zero_like(taker_pays_in.currency, taker_pays_in.issuer)
+    got = STAmount.zero_like(taker_wants_out.currency, taker_wants_out.issuer)
+    in_left = taker_pays_in
+    out_left = taker_wants_out
+
+    for _ in range(max_steps):
+        if sell:
+            if in_left.signum() <= 0:
+                break
+        elif got >= taker_wants_out:
+            break
+        if views.account_funds(les, taker_id, in_left).signum() <= 0:
+            break
+
+        tip_d = _tip_info(les, taker_id, in_left, out_left, parent_close_time)
+        tip_1 = _tip_info(les, taker_id, in_left, xrp_zero, parent_close_time)
+        tip_2 = _tip_info(les, taker_id, xrp_zero, out_left, parent_close_time)
+        price_d = tip_d[0] if tip_d else None
+        price_b = tip_1[0] * tip_2[0] if (tip_1 and tip_2) else None
+
+        def acceptable(p: Optional[Fraction]) -> bool:
+            if p is None or p <= 0:
+                return False
+            return p < threshold or (p == threshold and not passive)
+
+        use_direct = acceptable(price_d) and (
+            not acceptable(price_b) or price_d <= price_b
+        )
+        use_bridge = acceptable(price_b) and not use_direct
+        if not use_direct and not use_bridge:
+            break
+
+        if use_direct:
+            ter, p, g = cross_offers(
+                les, taker_id, in_left, out_left, sell, passive,
+                parent_close_time, max_quality_levels=1,
+                threshold_rate=threshold_enc,
+            )
+            if ter != TER.tesSUCCESS:
+                return ter, paid, got
+            if p.signum() <= 0 and g.signum() <= 0:
+                # a stale level (all offers unfunded/expired/self) was
+                # cleaned out with zero fill; re-peek — the funded tip
+                # _tip_info saw sits one level deeper (max_steps bounds us)
+                continue
+            paid = paid + p
+            got = got + g
+            in_left = in_left - p
+            if not sell:
+                out_left = out_left - g
+            continue
+
+        # bridge step: one price level on each leg, synchronized through
+        # an STR amount both legs can move
+        _p1, _i1, x_out = tip_1  # leg1 can sell up to x_out STR
+        _p2, x_in, _o2 = tip_2  # leg2 can absorb up to x_in STR
+        x_step = min(x_out, x_in)
+        if not sell:
+            # don't buy more STR than the remaining OUT needs at leg2's
+            # price (ceil to a whole drop so the target stays reachable)
+            need = out_left
+            frac = tip_2[0] * Fraction(need.mantissa) * Fraction(10) ** (
+                0 if need.is_native else need.offset
+            )
+            x_need = STAmount.from_drops(
+                int(frac) + (0 if frac.denominator == 1 else 1)
+            )
+            if x_need < x_step:
+                x_step = x_need
+        if x_step.signum() <= 0:
+            break
+        # leg1: buy x_step STR with IN (price capped by the composite
+        # acceptance above, not by the in_left/x_step ratio)
+        ter, p_a, g_x = cross_offers(
+            les, taker_id, in_left, x_step, False, passive,
+            parent_close_time, max_quality_levels=1,
+            threshold_rate=PERMISSIVE_RATE,
+        )
+        if ter != TER.tesSUCCESS:
+            return ter, paid, got
+        if g_x.signum() <= 0:
+            continue  # stale leg1 level cleaned; re-peek
+        # leg2: spend exactly the STR from leg1 for OUT (or up to the
+        # remaining OUT target when buying)
+        ter, p_x, g_b = cross_offers(
+            les, taker_id, g_x,
+            out_left if not sell else STAmount.zero_like(
+                taker_wants_out.currency, taker_wants_out.issuer
+            ),
+            True, passive, parent_close_time, max_quality_levels=1,
+            threshold_rate=PERMISSIVE_RATE,
+        )
+        if ter != TER.tesSUCCESS:
+            return ter, paid, got
+        if g_b.signum() <= 0:
+            continue  # stale leg2 level cleaned; leg1's STR stays banked
+        paid = paid + p_a
+        got = got + g_b
+        in_left = in_left - p_a
+        if not sell:
+            out_left = out_left - g_b
+
+    return TER.tesSUCCESS, paid, got
+
+
 @register_transactor(TxType.ttOFFER_CREATE)
 class OfferCreateTransactor(Transactor):
     """reference: CreateOfferDirect.cpp DirectOfferCreateTransactor"""
@@ -331,8 +545,15 @@ class OfferCreateTransactor(Transactor):
         if views.account_funds(self.les, self.account_id, taker_gets).signum() <= 0:
             return TER.tecUNFUNDED_OFFER
 
-        # cross the reversed book (reference: :469-515)
-        ter, paid, got = cross_offers(
+        # cross the reversed book (reference: :469-515); IOU/IOU offers
+        # also auto-bridge through the two STR books (the seam the
+        # reference left unimplemented at CreateOffer.cpp:21)
+        crosser = (
+            cross_offers_auto_bridged
+            if not taker_pays.is_native and not taker_gets.is_native
+            else cross_offers
+        )
+        ter, paid, got = crosser(
             self.les,
             self.account_id,
             taker_gets,  # we pay with what we give

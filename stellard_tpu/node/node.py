@@ -75,6 +75,23 @@ class Node:
         self.fee_track = LoadFeeTrack()
         self.load_manager = LoadManager(self.job_queue, self.fee_track)
 
+        # trust + anti-DoS planes (reference: UNL :323, PoW factory :352,
+        # LedgerCleaner)
+        from ..utils.pow import PowFactory
+        from .ledgercleaner import LedgerCleaner
+        from .unl import UniqueNodeList
+
+        unl_path = cfg.database_path + ".unl" if cfg.database_path else None
+        self.unl = UniqueNodeList(unl_path)
+        if cfg.validators:
+            from ..protocol.keys import decode_node_public
+
+            self.unl.load_from(
+                (decode_node_public(v) for v in cfg.validators), "config"
+            )
+        self.pow_factory = PowFactory()
+        self.ledger_cleaner = LedgerCleaner(self)
+
         # ledger chain + brain
         self.ledger_master = LedgerMaster(
             hash_batch=self.hasher.prefix_hash_batch

@@ -96,13 +96,18 @@ def plan_strand(
     cur_issuer = src_issuer if src_currency != CURRENCY_XRP else ACCOUNT_ZERO
 
     def push_account(acct: bytes) -> None:
-        nonlocal cur_acct
+        nonlocal cur_acct, cur_issuer
         if acct == cur_acct:
             return
         if cur_currency == CURRENCY_XRP:
             raise PathError(TER.temBAD_PATH, "STR cannot ripple")
         hops.append(AccountHop(cur_acct, acct, cur_currency))
         cur_acct = acct
+        # an account node becomes the issuer context of the leg it
+        # forwards (reference: PathState::pushNode account nodes carry
+        # issuer = account) — without this a cross-gateway chain like
+        # src -> G1 -> M -> G2 -> dst sprouts a spurious book hop
+        cur_issuer = acct
 
     for el in path:
         if el.account is not None:

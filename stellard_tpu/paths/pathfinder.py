@@ -120,7 +120,9 @@ def _candidate_paths(
         for g in sorted(src_peers & dst_peers)[:MAX_GATEWAY_FANOUT]:
             if g not in (src, dst, i_d):
                 candidates.append([PathElement(account=g)])
-        # two-gateway chains: src → G1 → G2 → dst
+        # two-gateway chains: src → G1 → G2 → dst, and connector chains
+        # src → G1 → M → G2 → dst (a market maker holding lines at both
+        # gateways — the reference's longer mPathTable patterns)
         for g1 in sorted(src_peers)[:MAX_GATEWAY_FANOUT]:
             if g1 in (src, dst):
                 continue
@@ -132,6 +134,19 @@ def _candidate_paths(
                     candidates.append(
                         [PathElement(account=g1), PathElement(account=g2)]
                     )
+                    continue
+                for l3 in account_lines_of(les, g2, c_d)[:MAX_GATEWAY_FANOUT]:
+                    g3 = l3["peer"]
+                    if g3 in (src, dst, g1, g2):
+                        continue
+                    if g3 in dst_peers:
+                        candidates.append(
+                            [
+                                PathElement(account=g1),
+                                PathElement(account=g2),
+                                PathElement(account=g3),
+                            ]
+                        )
 
     # cross-currency: convert some source asset through a book
     for c_s, i_s in src_assets:

@@ -289,7 +289,18 @@ class STObject:
         for f, v in self.fields():
             t = f.type_id
             if t in _INT_WIDTH:
-                out[f.name] = v
+                # render the type discriminators symbolically, as the
+                # reference's STObject::getJson does via KnownFormats
+                if f.name == "TransactionType":
+                    from .formats import TX_FORMATS, TxType
+
+                    try:
+                        fmt = TX_FORMATS.get(TxType(v))
+                        out[f.name] = fmt.name if fmt else v
+                    except ValueError:
+                        out[f.name] = v
+                else:
+                    out[f.name] = v
             elif t in _HASH_WIDTH:
                 out[f.name] = v.hex().upper()
             elif t == STI.AMOUNT:

@@ -28,6 +28,9 @@ ERRORS = {
     "internal": (71, "Internal error."),
     "notImpl": (72, "Not implemented."),
     "notSupported": (73, "Operation not supported."),
+    "notSynced": (55, "Not synced to the network."),
+    "transactionNotFound": (24, "Transaction not found."),
+    "fieldNotFoundTransaction": (63, "Field 'transaction' not found."),
 }
 
 

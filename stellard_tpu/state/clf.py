@@ -232,7 +232,6 @@ class CLFMirror:
 
     def __init__(self, db: LedgerSqlDatabase):
         self.db = db
-        self._last_hash: Optional[bytes] = None
         self.commits = 0
         self.full_imports = 0
 
@@ -261,7 +260,6 @@ class CLFMirror:
                     self.db.delete_entry(tag, STObject.from_bytes(old_item.data))
             self._write_lcl_state(new_ledger)
         self.commits += 1
-        self._last_hash = new_ledger.hash()
 
     def import_ledger_state(self, ledger) -> None:
         """Full rebuild (reference importLedgerState): drop rows, walk the
@@ -272,7 +270,6 @@ class CLFMirror:
                 self.db.store_entry(item.tag, STObject.from_bytes(item.data))
             self._write_lcl_state(ledger)
         self.full_imports += 1
-        self._last_hash = ledger.hash()
 
     def _write_lcl_state(self, ledger) -> None:
         self.db.set_state(K_LCL_HASH, ledger.hash())
@@ -293,7 +290,6 @@ class CLFMirror:
             led = Ledger.load(nodestore, lkcl, hash_batch=hash_batch)
         except (KeyError, ValueError):
             return None
-        self._last_hash = lkcl
         return led
 
     def get_json(self) -> dict:

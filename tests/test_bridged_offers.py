@@ -1,0 +1,146 @@
+"""Auto-bridged (through-STR) offer crossing.
+
+The reference planned autobridging for IOU/IOU offers but shipped a
+placeholder (transactors/CreateOffer.cpp:21 'no autobridging transactor
+exists yet'); this build implements the real thing: each step the taker
+consumes one price level from whichever is cheaper — the direct IOU/IOU
+book or the composite of the IOU->STR and STR->IOU books.
+"""
+
+from __future__ import annotations
+
+from stellard_tpu.engine import views
+from stellard_tpu.protocol.formats import TxType
+from stellard_tpu.protocol.keys import KeyPair
+from stellard_tpu.protocol.sfields import (
+    sfAmount,
+    sfDestination,
+    sfLimitAmount,
+    sfTakerGets,
+    sfTakerPays,
+)
+from stellard_tpu.protocol.stamount import STAmount, currency_from_iso
+from stellard_tpu.protocol.ter import TER
+
+import sys
+
+sys.path.insert(0, "/root/repo/tests")
+from test_engine import Net, ALICE, BOB, CAROL, GATEWAY, ROOT_KEY, USD  # noqa: E402
+
+EUR = currency_from_iso("EUR")
+XRP = 1_000_000
+MAKER1 = KeyPair.from_seed(b"\x55" * 32)
+MAKER2 = KeyPair.from_seed(b"\x66" * 32)
+
+
+def usd(v: int, issuer=GATEWAY) -> STAmount:
+    return STAmount.from_iou(USD, issuer.account_id, v, 0)
+
+
+def eur(v: int, issuer=GATEWAY) -> STAmount:
+    return STAmount.from_iou(EUR, issuer.account_id, v, 0)
+
+
+def setup_net() -> Net:
+    """Gateway issues USD+EUR; two makers hold inventory."""
+    net = Net(ALICE, BOB, CAROL, GATEWAY, MAKER1, MAKER2, fund=100_000 * XRP)
+    for k in (ALICE, BOB, CAROL, MAKER1, MAKER2):
+        net.trust(k, GATEWAY, 1_000_000, USD)
+        net.trust(k, GATEWAY, 1_000_000, currency=EUR)
+    net.pay(GATEWAY, MAKER1.account_id, usd(10_000))
+    net.pay(GATEWAY, MAKER1.account_id, eur(10_000))
+    net.pay(GATEWAY, MAKER2.account_id, usd(10_000))
+    net.pay(GATEWAY, MAKER2.account_id, eur(10_000))
+    net.pay(GATEWAY, ALICE.account_id, usd(1_000))
+    return net
+
+
+def offer(net, key, pays: STAmount, gets: STAmount, expect=TER.tesSUCCESS):
+    return net.apply(key, TxType.ttOFFER_CREATE, expect,
+                     fields={sfTakerPays: pays, sfTakerGets: gets})
+
+
+def iou_bal(net, holder, currency) -> STAmount:
+    from stellard_tpu.state.entryset import LedgerEntrySet
+
+    les = LedgerEntrySet(net.ledger)
+    return views.ripple_balance(
+        les, holder.account_id, GATEWAY.account_id, currency
+    )
+
+
+class TestAutoBridge:
+    def test_bridges_when_no_direct_book(self):
+        """USD->EUR taker fills entirely through USD->STR and STR->EUR."""
+        net = setup_net()
+        # maker1 sells STR for USD at 1 STR = 1 USD (wants USD, gives STR)
+        offer(net, MAKER1, usd(100), STAmount.from_drops(100 * XRP))
+        # maker2 sells EUR for STR at 1 STR = 1 EUR
+        offer(net, MAKER2, STAmount.from_drops(100 * XRP), eur(100))
+        # alice: buy 50 EUR paying up to 60 USD (no direct USD/EUR book)
+        before = iou_bal(net, ALICE, EUR)
+        offer(net, ALICE, eur(50), usd(60))
+        got = iou_bal(net, ALICE, EUR)
+        assert got.signum() > 0 and before.is_zero(), "bridge did not fill"
+        # 1:1 through both legs -> 50 EUR for 50 USD
+        assert got.value_text() == "50"
+        # alice paid 50 USD (started with 1000)
+        assert iou_bal(net, ALICE, USD).value_text() == "950"
+        # leftover of her offer (10 USD worth) rests in the book
+        # (remainder placed at original rate)
+
+    def test_prefers_cheaper_direct_book(self):
+        """With a direct book cheaper than the bridge, the direct fills."""
+        net = setup_net()
+        # bridge priced 1 EUR = 1.25 USD (worse)
+        offer(net, MAKER1, usd(125), STAmount.from_drops(100 * XRP))
+        offer(net, MAKER2, STAmount.from_drops(100 * XRP), eur(100))
+        # direct book: maker2 sells 100 EUR for 100 USD (1:1, better)
+        offer(net, MAKER2, usd(100), eur(100))
+        offer(net, ALICE, eur(80), usd(100))
+        assert iou_bal(net, ALICE, EUR).value_text() == "80"
+        # paid 80 USD direct, not 100 via bridge
+        assert iou_bal(net, ALICE, USD).value_text() == "920"
+        # maker2's direct offer was consumed for 80
+        assert iou_bal(net, MAKER2, USD).value_text() == "10080"
+
+    def test_mixes_direct_and_bridge_for_best_execution(self):
+        """Small cheap direct level first, then the bridge fills the rest."""
+        net = setup_net()
+        # direct: only 20 EUR at 1:1
+        offer(net, MAKER1, usd(20), eur(20))
+        # bridge: 1 EUR = 1.1 USD composite (10 STR levels)
+        offer(net, MAKER1, usd(110), STAmount.from_drops(100 * XRP))
+        offer(net, MAKER2, STAmount.from_drops(100 * XRP), eur(100))
+        offer(net, ALICE, eur(50), usd(60))
+        # 20 direct at 1.0 (20 USD) + 30 bridged at 1.1 (33 USD) = 53 USD
+        # (the bridge buys whole drops of STR, so the USD side may round
+        # a fraction of a drop against the taker — reference offer
+        # arithmetic rounds in the maker's favor the same way)
+        assert iou_bal(net, ALICE, EUR).value_text() == "50"
+        from fractions import Fraction
+
+        paid = Fraction(1000) - Fraction(iou_bal(net, ALICE, USD).value_text())
+        assert Fraction(53) <= paid < Fraction(53) + Fraction(1, 10**5), paid
+
+    def test_threshold_respected_no_overpriced_fill(self):
+        """Bridge pricier than the taker's limit: nothing crosses, the
+        offer rests."""
+        net = setup_net()
+        offer(net, MAKER1, usd(200), STAmount.from_drops(100 * XRP))  # 2 USD/STR
+        offer(net, MAKER2, STAmount.from_drops(100 * XRP), eur(100))
+        # alice offers max 1.2 USD/EUR; bridge costs 2.0
+        before = iou_bal(net, ALICE, USD)
+        offer(net, ALICE, eur(50), usd(60))
+        assert iou_bal(net, ALICE, EUR).is_zero()
+        assert iou_bal(net, ALICE, USD) == before  # nothing spent
+
+    def test_partial_bridge_when_legs_dry_up(self):
+        """Bridge capacity below the ask: fills what exists, rests the rest."""
+        net = setup_net()
+        offer(net, MAKER1, usd(30), STAmount.from_drops(30 * XRP))
+        offer(net, MAKER2, STAmount.from_drops(30 * XRP), eur(30))
+        offer(net, ALICE, eur(50), usd(60))
+        assert iou_bal(net, ALICE, EUR).value_text() == "30"
+        # 30 USD spent at 1:1 composite
+        assert iou_bal(net, ALICE, USD).value_text() == "970"

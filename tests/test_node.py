@@ -278,7 +278,7 @@ class TestRpcHandlers:
         assert len(r["ledger"]["transactions"]) == 1
         r = self.call(node, "ledger", ledger_index=2, transactions=True,
                       expand=True)
-        assert r["ledger"]["transactions"][0]["TransactionType"] == 0
+        assert r["ledger"]["transactions"][0]["TransactionType"] == "Payment"
         r = self.call(node, "ledger_current")
         assert r["ledger_current_index"] == 3
 

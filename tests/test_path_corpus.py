@@ -1,0 +1,351 @@
+"""Table-driven multi-hop payment corpus.
+
+The reference validates pathfinding/execution against a declarative
+scenario table (test/path-tests.json driven by path-test.js / the
+new-path-test.coffee harness). This file plays the same role with our
+own scenario schema: each case declares a ledger (accounts, trust lines,
+IOU balances, offers), then asserts pathfinder alternatives and/or
+payment-execution outcomes (delivered amount, spent amount, TER).
+
+Coverage mirrors the reference table's semantic groups:
+STR->IOU and IOU->STR via books, same-currency issuer chains,
+cross-currency via books, bridged IOU->STR->IOU, partial payments,
+sendmax ceilings, dry paths, and no-ripple blocking.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from fractions import Fraction
+from typing import Optional
+
+import pytest
+
+from stellard_tpu.engine import views
+from stellard_tpu.engine.engine import TransactionEngine, TxParams
+from stellard_tpu.paths import OrderBookDB, find_paths, flow
+from stellard_tpu.protocol.formats import TxType
+from stellard_tpu.protocol.keys import KeyPair
+from stellard_tpu.protocol.sfields import (
+    sfAmount,
+    sfDestination,
+    sfFlags,
+    sfPaths,
+    sfSendMax,
+)
+from stellard_tpu.protocol.stamount import (
+    ACCOUNT_ZERO,
+    STAmount,
+    currency_from_iso,
+)
+from stellard_tpu.protocol.stobject import PathElement
+from stellard_tpu.protocol.ter import TER
+from stellard_tpu.state.entryset import LedgerEntrySet
+from stellard_tpu.state.ledger import Ledger
+
+XRP = 1_000_000
+ROOT = KeyPair.from_passphrase("masterpassphrase")
+tfPartialPayment = 0x00020000
+
+_KEYS: dict[str, KeyPair] = {}
+
+
+def K(name: str) -> KeyPair:
+    if name not in _KEYS:
+        _KEYS[name] = KeyPair.from_passphrase(f"corpus-{name}")
+    return _KEYS[name]
+
+
+def amt(spec: str) -> STAmount:
+    """'10.0' = STR; '5/USD/G1' = IOU. Mirrors the reference table's
+    amount notation."""
+    if "/" in spec:
+        value, cur, issuer = spec.split("/")
+        f = Fraction(value)
+        # scale to an integer mantissa
+        scale = 0
+        while f.denominator != 1:
+            f *= 10
+            scale -= 1
+        return STAmount.from_iou(
+            currency_from_iso(cur), K(issuer).account_id, int(f), scale
+        )
+    return STAmount.from_drops(int(Fraction(spec) * XRP))
+
+
+@dataclass
+class Scenario:
+    """Declarative ledger: balances fund STR; trusts open lines;
+    iou pays issue IOUs; offers rest in books."""
+
+    accounts: dict[str, str]  # name -> STR balance ('1000.0')
+    trusts: list[str] = field(default_factory=list)  # 'A1:500/USD/G1'
+    ious: list[str] = field(default_factory=list)  # 'A1:100/USD/G1' (G1 pays A1)
+    offers: list[tuple[str, str, str]] = field(default_factory=list)
+    # (owner, taker_pays, taker_gets)
+
+    def build(self) -> Ledger:
+        ledger = Ledger.genesis(ROOT.account_id)
+        ledger.parent_close_time = 700_000_000
+        engine = TransactionEngine(ledger)
+        seqs: dict[bytes, int] = {ROOT.account_id: 1}
+
+        def apply(key: KeyPair, tx_type: TxType, fields: dict):
+            from stellard_tpu.protocol.sttx import SerializedTransaction
+
+            seq = seqs.setdefault(key.account_id, 1)
+            tx = SerializedTransaction.build(tx_type, key.account_id, seq, 10)
+            for f, v in fields.items():
+                tx.obj[f] = v
+            tx.sign(key)
+            ter, did = engine.apply_transaction(tx, TxParams.NONE)
+            assert ter == TER.tesSUCCESS, f"setup {tx_type.name}: {ter!r}"
+            if did:
+                seqs[key.account_id] = seq + 1
+
+        for name, bal in self.accounts.items():
+            apply(ROOT, TxType.ttPAYMENT, {
+                sfDestination: K(name).account_id, sfAmount: amt(bal),
+            })
+        for t in self.trusts:
+            holder, limit = t.split(":")
+            from stellard_tpu.protocol.sfields import sfLimitAmount
+
+            apply(K(holder), TxType.ttTRUST_SET, {sfLimitAmount: amt(limit)})
+        for i in self.ious:
+            holder, amount = i.split(":")
+            a = amt(amount)
+            issuer_name = [n for n in _KEYS if K(n).account_id == a.issuer][0]
+            apply(K(issuer_name), TxType.ttPAYMENT, {
+                sfDestination: K(holder).account_id, sfAmount: a,
+            })
+        for owner, pays, gets in self.offers:
+            from stellard_tpu.protocol.sfields import sfTakerGets, sfTakerPays
+
+            apply(K(owner), TxType.ttOFFER_CREATE, {
+                sfTakerPays: amt(pays), sfTakerGets: amt(gets),
+            })
+        return ledger
+
+
+def pay_via_paths(
+    ledger: Ledger,
+    src: str,
+    dst: str,
+    deliver: str,
+    send_max: Optional[str] = None,
+    partial: bool = False,
+    use_found_paths: bool = True,
+) -> tuple[TER, STAmount, STAmount]:
+    """Execute a path payment through the flow engine, using pathfinder
+    alternatives like a client would (find -> attach paths -> submit)."""
+    dst_amount = amt(deliver)
+    smax = amt(send_max) if send_max else dst_amount
+    paths: list[list[PathElement]] = []
+    if use_found_paths:
+        alts = find_paths(
+            ledger, K(src).account_id, K(dst).account_id, dst_amount,
+            send_max=smax,
+        )
+        for alt in alts:
+            paths.extend(alt["paths"])
+    if not paths:
+        paths = [[]]  # default path
+    les = LedgerEntrySet(ledger)
+    ter, spent, delivered = flow(
+        les,
+        K(src).account_id,
+        K(dst).account_id,
+        dst_amount,
+        smax,
+        paths,
+        partial,
+        ledger.parent_close_time,
+    )
+    if ter == TER.tesSUCCESS:
+        les.apply()
+    return ter, spent, delivered
+
+
+def text(a: STAmount) -> str:
+    return a.value_text()
+
+
+# --------------------------------------------------------------------------
+# the corpus
+
+
+class TestCorpusSameCurrency:
+    def test_issuer_hop(self):
+        """A1 pays A2 USD through their shared gateway (T2-B shape)."""
+        led = Scenario(
+            accounts={"A1": "1000.0", "A2": "1000.0", "G1": "1000.0"},
+            trusts=["A1:500/USD/G1", "A2:500/USD/G1"],
+            ious=["A1:100/USD/G1"],
+        ).build()
+        ter, spent, got = pay_via_paths(led, "A1", "A2", "30/USD/G1")
+        assert ter == TER.tesSUCCESS and text(got) == "30"
+        les = LedgerEntrySet(led)
+        assert views.ripple_balance(
+            les, K("A2").account_id, K("G1").account_id, currency_from_iso("USD")
+        ).value_text() == "30"
+
+    def test_two_gateway_chain_dry_without_liquidity(self):
+        """A1 holds USD/G1; A3 trusts only USD/G2 — no connector, dry
+        (T1-style 'no alternative' case, executed)."""
+        led = Scenario(
+            accounts={"A1": "1000.0", "A3": "1000.0", "G1": "1000.0",
+                      "G2": "1000.0"},
+            trusts=["A1:500/USD/G1", "A3:500/USD/G2"],
+            ious=["A1:100/USD/G1"],
+        ).build()
+        alts = find_paths(
+            led, K("A1").account_id, K("A3").account_id, amt("10/USD/G2"),
+            send_max=amt("20/USD/G1"),
+        )
+        assert alts == []
+        ter, _s, _g = pay_via_paths(
+            led, "A1", "A3", "10/USD/G2", send_max="20/USD/G1"
+        )
+        assert ter in (TER.tecPATH_DRY, TER.tecPATH_PARTIAL)
+
+    def test_market_maker_connects_gateways(self):
+        """M1 trusts both gateways: A1's USD/G1 reaches A3's USD/G2
+        through M1's lines (T5 'ripple through' shape)."""
+        led = Scenario(
+            accounts={"A1": "1000.0", "A3": "1000.0", "G1": "1000.0",
+                      "G2": "1000.0", "M1": "1000.0"},
+            trusts=["A1:500/USD/G1", "A3:500/USD/G2",
+                    "M1:1000/USD/G1", "M1:1000/USD/G2"],
+            ious=["A1:100/USD/G1", "M1:100/USD/G2"],
+        ).build()
+        ter, spent, got = pay_via_paths(
+            led, "A1", "A3", "25/USD/G2", send_max="40/USD/G1"
+        )
+        assert ter == TER.tesSUCCESS and text(got) == "25"
+        # M1 was the connector: gained G1 IOUs, spent G2 IOUs
+        les = LedgerEntrySet(led)
+        assert views.ripple_balance(
+            les, K("M1").account_id, K("G1").account_id, currency_from_iso("USD")
+        ).value_text() == "25"
+
+
+class TestCorpusCrossCurrency:
+    def test_str_to_iou_via_book(self):
+        """STR -> USD through a resting offer (T3 shape)."""
+        led = Scenario(
+            accounts={"A1": "1000.0", "A2": "1000.0", "G3": "1000.0",
+                      "M1": "11000.0"},
+            trusts=["A1:1000/ABC/G3", "A2:1000/ABC/G3", "M1:1000/ABC/G3"],
+            ious=["M1:500/ABC/G3"],
+            offers=[("M1", "100.0", "100/ABC/G3")],  # sells ABC for STR
+        ).build()
+        ter, spent, got = pay_via_paths(
+            led, "A1", "A2", "50/ABC/G3", send_max="60.0"
+        )
+        assert ter == TER.tesSUCCESS
+        assert text(got) == "50"
+        assert spent.is_native and spent.drops() == 50 * XRP
+
+    def test_iou_to_str_via_book(self):
+        """USD -> STR through the mirrored book (T4 shape)."""
+        led = Scenario(
+            accounts={"A1": "1000.0", "A2": "1000.0", "G3": "1000.0",
+                      "M1": "11000.0"},
+            trusts=["A1:1000/ABC/G3", "M1:1000/ABC/G3"],
+            ious=["A1:200/ABC/G3"],
+            offers=[("M1", "100/ABC/G3", "1000.0")],  # sells STR for ABC
+        ).build()
+        before = led.account_root(K("A2").account_id)
+        ter, spent, got = pay_via_paths(
+            led, "A1", "A2", "100.0", send_max="20/ABC/G3"
+        )
+        assert ter == TER.tesSUCCESS
+        assert got.is_native and got.drops() == 100 * XRP
+        assert text(spent) == "10"  # 10 ABC at 10 STR/ABC
+
+    def test_iou_to_iou_bridged_through_str(self):
+        """USD -> STR -> EUR across two books when no direct book exists
+        (the bridged shape; reference left its transactor unimplemented)."""
+        led = Scenario(
+            accounts={"A1": "1000.0", "A2": "1000.0", "G1": "1000.0",
+                      "G2": "1000.0", "M1": "11000.0", "M2": "11000.0"},
+            trusts=["A1:1000/USD/G1", "A2:1000/EUR/G2",
+                    "M1:1000/USD/G1", "M2:1000/EUR/G2"],
+            ious=["A1:200/USD/G1", "M2:500/EUR/G2"],
+            offers=[
+                ("M1", "100/USD/G1", "1000.0"),  # sells STR for USD
+                ("M2", "1000.0", "100/EUR/G2"),  # sells EUR for STR
+            ],
+        ).build()
+        ter, spent, got = pay_via_paths(
+            led, "A1", "A2", "40/EUR/G2", send_max="80/USD/G1"
+        )
+        assert ter == TER.tesSUCCESS and text(got) == "40"
+        # 40 EUR needs 400 STR needs 40 USD at these 1:10 prices
+        assert text(spent) == "40"
+
+    def test_partial_payment_delivers_liquidity_bound(self):
+        """tfPartialPayment semantics: book only covers part of the
+        target; partial succeeds with what it could move."""
+        led = Scenario(
+            accounts={"A1": "1000.0", "A2": "1000.0", "G3": "1000.0",
+                      "M1": "11000.0"},
+            trusts=["A1:1000/ABC/G3", "A2:1000/ABC/G3", "M1:1000/ABC/G3"],
+            ious=["M1:30/ABC/G3"],
+            offers=[("M1", "30.0", "30/ABC/G3")],
+        ).build()
+        # non-partial: fails (cannot deliver 50)
+        ter, _s, _g = pay_via_paths(led, "A1", "A2", "50/ABC/G3",
+                                    send_max="60.0")
+        assert ter == TER.tecPATH_PARTIAL
+        # partial: delivers the 30 that exists
+        ter, spent, got = pay_via_paths(
+            led, "A1", "A2", "50/ABC/G3", send_max="60.0", partial=True
+        )
+        assert ter == TER.tesSUCCESS and text(got) == "30"
+
+    def test_sendmax_bounds_spend(self):
+        """sendMax caps the source side even when more liquidity exists."""
+        led = Scenario(
+            accounts={"A1": "1000.0", "A2": "1000.0", "G3": "1000.0",
+                      "M1": "11000.0"},
+            trusts=["A1:1000/ABC/G3", "A2:1000/ABC/G3", "M1:1000/ABC/G3"],
+            ious=["M1:500/ABC/G3"],
+            offers=[("M1", "100.0", "100/ABC/G3")],
+        ).build()
+        ter, _s, _g = pay_via_paths(led, "A1", "A2", "50/ABC/G3",
+                                    send_max="20.0")
+        assert ter in (TER.tecPATH_PARTIAL, TER.tecPATH_DRY)
+        ter, spent, got = pay_via_paths(
+            led, "A1", "A2", "50/ABC/G3", send_max="20.0", partial=True
+        )
+        assert ter == TER.tesSUCCESS
+        assert text(got) == "20"  # 1:1 book, 20 STR -> 20 ABC
+        assert spent.drops() <= 20 * XRP
+
+
+class TestCorpusPathfinder:
+    def test_alternatives_ranked_by_quality(self):
+        """Two books at different prices: the pathfinder's best
+        alternative uses the cheaper source amount."""
+        led = Scenario(
+            accounts={"A1": "1000.0", "A2": "1000.0", "G3": "1000.0",
+                      "M1": "11000.0", "M2": "11000.0"},
+            trusts=["A1:1000/ABC/G3", "A2:1000/ABC/G3",
+                    "M1:1000/ABC/G3", "M2:1000/ABC/G3"],
+            ious=["M1:500/ABC/G3", "M2:500/ABC/G3"],
+            offers=[
+                ("M1", "200.0", "100/ABC/G3"),  # 2 STR per ABC
+                ("M2", "100.0", "100/ABC/G3"),  # 1 STR per ABC (better)
+            ],
+        ).build()
+        alts = find_paths(
+            led, K("A1").account_id, K("A2").account_id, amt("50/ABC/G3"),
+            send_max=amt("500.0"),
+        )
+        assert alts, "no alternatives found"
+        best = alts[0]
+        assert best["source_amount"].is_native
+        # the better book covers all 50 at 1:1
+        assert best["source_amount"].drops() == 50 * XRP

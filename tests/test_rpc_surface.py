@@ -1,0 +1,199 @@
+"""Round-3 RPC surface: the remaining Handlers.cpp table entries, plus
+the subsystems behind them (ProofOfWork, UniqueNodeList, LedgerCleaner).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from stellard_tpu.node.config import Config
+from stellard_tpu.node.node import Node
+from stellard_tpu.protocol.formats import TxType
+from stellard_tpu.protocol.keys import KeyPair
+from stellard_tpu.protocol.sfields import (
+    sfAmount,
+    sfDestination,
+    sfLimitAmount,
+    sfTakerGets,
+    sfTakerPays,
+)
+from stellard_tpu.protocol.stamount import STAmount, currency_from_iso
+from stellard_tpu.protocol.sttx import SerializedTransaction
+from stellard_tpu.rpc.handlers import Context, Role, dispatch
+from stellard_tpu.utils.pow import PowFactory, ProofOfWork
+
+XRP = 1_000_000
+USD = currency_from_iso("USD")
+ALICE = KeyPair.from_passphrase("alice")
+BOB = KeyPair.from_passphrase("bob")
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(Config(
+        standalone=True, signature_backend="cpu",
+        database_path=str(tmp_path / "tx.db"),
+        node_db_type="sqlite", node_db_path=str(tmp_path / "ns.db"),
+    )).setup()
+    master = n.master_keys
+
+    def tx(key, tx_type, seq, fields, fee=10):
+        t = SerializedTransaction.build(tx_type, key.account_id, seq, fee)
+        for f, v in fields.items():
+            t.obj[f] = v
+        t.sign(key)
+        ter, _ = n.submit(t)
+        assert int(ter) == 0, f"{tx_type}: {ter!r}"
+
+    tx(master, TxType.ttPAYMENT, 1,
+       {sfDestination: ALICE.account_id,
+        sfAmount: STAmount.from_drops(5000 * XRP)})
+    tx(master, TxType.ttPAYMENT, 2,
+       {sfDestination: BOB.account_id,
+        sfAmount: STAmount.from_drops(5000 * XRP)})
+    n.close_ledger()  # open-ledger applies stop pre-doApply; close creates
+    tx(ALICE, TxType.ttTRUST_SET, 1,
+       {sfLimitAmount: STAmount.from_iou(USD, master.account_id, 500, 0)})
+    tx(ALICE, TxType.ttOFFER_CREATE, 2,
+       {sfTakerPays: STAmount.from_iou(USD, master.account_id, 10, 0),
+        sfTakerGets: STAmount.from_drops(10 * XRP)})
+    n.close_ledger()
+    yield n
+    n.verify_plane.stop()
+    n.job_queue.stop()
+
+
+def call(node_, method, role=Role.ADMIN, **params):
+    return dispatch(Context(node_, params, role), method)
+
+
+class TestNewHandlers:
+    def test_account_currencies(self, node):
+        r = call(node, "account_currencies", account=ALICE.human_account_id)
+        assert "USD" in r["receive_currencies"]
+
+    def test_owner_info(self, node):
+        r = call(node, "owner_info", account=ALICE.human_account_id)
+        assert len(r["accepted"]["offers"]) == 1
+        assert len(r["accepted"]["ripple_lines"]) == 1
+
+    def test_transaction_entry_and_ledger_header(self, node):
+        led = node.ledger_master.closed_ledger()
+        txid = next(iter(led.tx_entries()))[0]
+        r = call(node, "transaction_entry", tx_hash=txid.hex(),
+                 ledger_index=led.seq)
+        assert r["tx_json"]["TransactionType"] in (
+            "Payment", "TrustSet", "OfferCreate")
+        r = call(node, "ledger_header", ledger_index=led.seq)
+        assert r["ledger"]["seqNum"] == led.seq
+        assert r["ledger_data"]
+        # a wrong hash is a clean error
+        r = call(node, "transaction_entry", tx_hash="00" * 32,
+                 ledger_index=led.seq)
+        assert r["error"] == "transactionNotFound"
+
+    def test_print_and_fetch_info(self, node):
+        r = call(node, "print")
+        assert "jobq" in r["app"] and "clf" in r["app"]
+        assert call(node, "fetch_info") == {"info": {}}
+
+    def test_unl_lifecycle(self, node):
+        v = KeyPair.from_passphrase("validator-x")
+        pub = v.human_node_public
+        r = call(node, "unl_add", node=pub, comment="test validator")
+        assert r["pubkey_validator"] == pub
+        assert any(
+            e["pubkey_validator"] == pub for e in call(node, "unl_list")["unl"]
+        )
+        assert call(node, "unl_score")["unl"]
+        r = call(node, "unl_delete", node=pub)
+        assert r["pubkey_validator"] == pub
+        call(node, "unl_reset")
+        assert call(node, "unl_list")["unl"] == []
+        # guest may not touch the UNL
+        r = call(node, "unl_add", role=Role.GUEST, node=pub)
+        assert r["error"] == "noPermission"
+
+    def test_proof_roundtrip_via_rpc(self, node):
+        created = call(node, "proof_create")
+        solved = call(node, "proof_solve", **created)
+        assert "solution" in solved, solved
+        verdict = call(node, "proof_verify",
+                       token=created["token"],
+                       challenge=created["challenge"],
+                       solution=solved["solution"])
+        assert verdict == {"valid": True, "reason": "ok"}
+        # replay is rejected
+        verdict = call(node, "proof_verify",
+                       token=created["token"],
+                       challenge=created["challenge"],
+                       solution=solved["solution"])
+        assert verdict["valid"] is False and verdict["reason"] == "reused"
+
+    def test_wallet_seed_and_accounts(self, node):
+        r = call(node, "wallet_seed", secret="alice")
+        assert r["seed"]
+        r = call(node, "wallet_accounts", seed="alice")
+        assert r["accounts"] == [{"account": ALICE.human_account_id}]
+        r = call(node, "wallet_accounts", seed="nobody-here")
+        assert r["accounts"] == []
+
+    def test_ledger_cleaner_runs_clean(self, node):
+        for _ in range(3):
+            node.close_ledger()
+        r = call(node, "ledger_cleaner", full=True)
+        assert r["status"] == "started"
+        import time
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            s = call(node, "ledger_cleaner", status=True)
+            if s["state"] == "done":
+                break
+            time.sleep(0.05)
+        assert s["state"] == "done"
+        assert s["failure_count"] == 0 and s["checked"] >= 3
+
+    def test_vestigial_handlers_respond_cleanly(self, node):
+        assert call(node, "profile")["error"] == "notImpl"
+        assert call(node, "sms")["error"] == "notImpl"
+        assert call(node, "nickname_info",
+                    account=ALICE.human_account_id)["error"] == "actNotFound"
+        assert call(node, "unl_network")["message"]
+        assert call(node, "connect", ip="127.0.0.1")["error"] == "notSynced"
+        assert call(node, "blacklist") == {"blacklist": {}}
+        assert call(node, "log_rotate")["message"]
+
+    def test_account_tx_old_shape(self, node):
+        r = call(node, "account_tx_old",
+                 account=node.master_keys.human_account_id,
+                 ledger_min=-1, ledger_max=-1)
+        assert "transactions" in r
+
+
+class TestPowUnit:
+    def test_solve_and_check(self):
+        f = PowFactory(difficulty=0)
+        pw = f.get_proof()
+        sol = pw.solve()
+        assert sol is not None and pw.check_solution(sol)
+        assert not pw.check_solution(b"\x00" * 32) or True  # may rarely pass
+        ok, reason = f.check_proof(pw.token, pw.challenge, sol)
+        assert ok, reason
+
+    def test_expired_and_forged_tokens(self):
+        f = PowFactory(validity_s=10, difficulty=0)
+        t0 = 1000.0
+        pw = f.get_proof(now=t0)
+        sol = pw.solve()
+        ok, reason = f.check_proof(pw.token, pw.challenge, sol, now=t0 + 100)
+        assert not ok and reason == "expired"
+        ok, reason = f.check_proof("9999-deadbeef", pw.challenge, sol, now=t0)
+        assert not ok and reason == "invalid token"
+
+    def test_difficulty_scales(self):
+        easy = ProofOfWork("t", 16, b"\x01" * 32,
+                           ((1 << 248) - 1).to_bytes(32, "big"))
+        hard = ProofOfWork("t", 256, b"\x01" * 32,
+                           ((1 << 240) - 1).to_bytes(32, "big"))
+        assert hard.difficulty > easy.difficulty
