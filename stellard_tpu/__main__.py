@@ -175,7 +175,7 @@ def _offline_tools(args, cfg) -> int:
 
         hasher = make_hasher(cfg.hash_backend)
         stats = replay_ledger(db, hdr["hash"],
-                              hash_batch=hasher.prefix_hash_batch)
+                              hash_batch=hasher)
         print(json.dumps(stats, indent=2))
         return 0 if stats["ok"] else 1
     return 0

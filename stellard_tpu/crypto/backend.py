@@ -16,7 +16,7 @@ from __future__ import annotations
 
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
-from typing import Callable, Sequence
+from typing import Callable, Optional, Sequence
 
 import numpy as np
 
@@ -38,12 +38,19 @@ class BatchVerifier:
 
 
 class BatchHasher:
-    """Interface: batched SHA-512-half with 4-byte domain prefixes."""
+    """Interface: batched SHA-512-half with 4-byte domain prefixes.
+
+    Hashers are callable (the SHAMap hash_batch seam); implementations
+    may additionally expose ``hash_tree(root)`` for whole-tree device
+    pipelines (state.shamap.compute_hashes detects it)."""
 
     name = "abstract"
 
     def prefix_hash_batch(self, prefixes: Sequence[int], payloads: Sequence[bytes]) -> list[bytes]:
         raise NotImplementedError
+
+    def __call__(self, prefixes, payloads):
+        return self.prefix_hash_batch(prefixes, payloads)
 
 
 _VERIFIERS: dict[str, Callable[..., BatchVerifier]] = {}
@@ -120,13 +127,47 @@ class TpuVerifier(BatchVerifier):
     """Batched JAX Ed25519 kernel (ops.ed25519_jax.verify_kernel).
 
     Batches are padded to power-of-two sizes to bound XLA recompiles.
+    With more than one accelerator visible, the batch dimension shards
+    data-parallel over a 1-D device mesh (parallel/mesh.py) and XLA
+    splits the whole point-arithmetic pipeline across chips over ICI —
+    the production integration of SURVEY §2.9 mapping #3 (VERDICT r2 #3).
     """
 
     name = "tpu"
 
-    def __init__(self, min_batch: int = 256, max_batch: int = 16384):
+    def __init__(self, min_batch: int = 256, max_batch: int = 16384,
+                 use_mesh: Optional[bool] = None):
         self.min_batch = min_batch
         self.max_batch = max_batch
+        self._kernel = None  # resolved lazily (device discovery)
+        self._use_mesh = use_mesh
+        self.n_devices = 1
+
+    def _resolve_kernel(self):
+        if self._kernel is not None:
+            return self._kernel
+        import jax
+
+        from ..ops.ed25519_jax import verify_kernel
+
+        devices = jax.devices()
+        want_mesh = (
+            self._use_mesh
+            if self._use_mesh is not None
+            else len(devices) > 1
+        )
+        if want_mesh and len(devices) > 1:
+            from ..parallel.mesh import make_mesh, sharded_verify_kernel
+
+            self.n_devices = len(devices)
+            self._kernel = sharded_verify_kernel(make_mesh(devices))
+            # pad floor must divide evenly across the mesh (round UP to a
+            # multiple — doubling can never fix an odd device count)
+            nd = self.n_devices
+            self.min_batch = ((self.min_batch + nd - 1) // nd) * nd
+        else:
+            self._kernel = verify_kernel
+        return self._kernel
 
     @staticmethod
     def _pad_size(n: int, lo: int, hi: int) -> int:
@@ -136,49 +177,222 @@ class TpuVerifier(BatchVerifier):
         return size
 
     def verify_batch(self, batch: Sequence[VerifyRequest]) -> np.ndarray:
-        from ..ops.ed25519_jax import verify_stream
+        from ..ops.ed25519_jax import prepare_batch
 
+        kernel = self._resolve_kernel()
         starts = list(range(0, len(batch), self.max_batch))
 
-        def chunks():
-            for start in starts:
-                chunk = batch[start : start + self.max_batch]
-                size = self._pad_size(len(chunk), self.min_batch, self.max_batch)
-                pad = size - len(chunk)
-                yield (
-                    [r.public for r in chunk] + [b"\x00" * 32] * pad,
-                    [r.signing_hash for r in chunk] + [b""] * pad,
-                    [r.signature for r in chunk] + [b"\x00" * 64] * pad,
-                )
-
+        # double-buffered pipeline: host prep of chunk i+1 overlaps the
+        # device execution of chunk i (JAX dispatch is asynchronous)
         out = np.zeros(len(batch), bool)
-        # verify_stream double-buffers: host prep of chunk i+1 overlaps the
-        # device execution of chunk i — the same pipeline bench.py measures
-        for start, res in zip(starts, verify_stream(chunks())):
-            n = min(self.max_batch, len(batch) - start)
-            out[start : start + n] = res[:n]
+        pending: list = []  # (start, n, device_future)
+        for start in starts:
+            chunk = batch[start : start + self.max_batch]
+            size = self._pad_size(len(chunk), self.min_batch, self.max_batch)
+            nd = self.n_devices
+            size = ((size + nd - 1) // nd) * nd  # shardable across the mesh
+            pad = size - len(chunk)
+            inputs = prepare_batch(
+                [r.public for r in chunk] + [b"\x00" * 32] * pad,
+                [r.signing_hash for r in chunk] + [b""] * pad,
+                [r.signature for r in chunk] + [b"\x00" * 64] * pad,
+            )
+            res = kernel(
+                inputs["a_words"], inputs["r_words"], inputs["s_windows"],
+                inputs["h_digits"], inputs["s_canonical"],
+            )
+            pending.append((start, len(chunk), res))
+            if len(pending) > 1:
+                s0, n0, r0 = pending.pop(0)
+                out[s0 : s0 + n0] = np.asarray(r0)[:n0]
+        for s0, n0, r0 in pending:
+            out[s0 : s0 + n0] = np.asarray(r0)[:n0]
         return out
 
 
 class TpuHasher(BatchHasher):
-    """Batched JAX SHA-512 (ops.sha512_jax), bucketed by block count."""
+    """Batched JAX SHA-512 (ops.sha512_jax).
+
+    Two paths (VERDICT r2 weak #3):
+    - ``prefix_hash_batch``: flat batches, bucketed to a fixed
+      block-count ladder and power-of-two batch sizes via the MASKED
+      kernel, so the jit cache stays bounded;
+    - ``hash_tree``: whole dirty SHAMaps hash level-synchronously with
+      device-resident digests — inner payloads are assembled on-device
+      by scattering child digests into pre-built templates, every level
+      dispatches asynchronously, and the host blocks once at the end.
+    """
 
     name = "tpu"
 
     def prefix_hash_batch(self, prefixes, payloads):
-        from ..ops.sha512_jax import padded_block_count, sha512_half_batch
+        import jax.numpy as jnp
+
+        from ..ops.sha512_jax import padded_block_count
+        from ..ops.treehash_jax import (
+            LEAF_BLOCK_LADDER,
+            pad_leaf_batch,
+            sha512_blocks_masked,
+        )
+        from ..utils.hashes import prefix_hash
 
         msgs = [p.to_bytes(4, "big") + d for p, d in zip(prefixes, payloads)]
-        # bucket by padded block count to keep shapes static
+        out: list[bytes | None] = [None] * len(msgs)
         buckets: dict[int, list[int]] = {}
         for i, m in enumerate(msgs):
-            buckets.setdefault(padded_block_count(len(m)), []).append(i)
-        out: list[bytes | None] = [None] * len(msgs)
-        for nb, idxs in buckets.items():
-            digests = sha512_half_batch([msgs[i] for i in idxs])
-            for i, d in zip(idxs, digests):
-                out[i] = d
+            nb = padded_block_count(len(m))
+            ladder = next((l for l in LEAF_BLOCK_LADDER if nb <= l), None)
+            if ladder is None:  # oversized: host path (rare)
+                out[i] = prefix_hash(prefixes[i], payloads[i])
+            else:
+                buckets.setdefault(ladder, []).append(i)
+        results = []  # (idxs, device_state) — dispatched async, read after
+        for ladder, idxs in buckets.items():
+            blocks, nblocks = pad_leaf_batch([msgs[i] for i in idxs], ladder)
+            st = self._masked_kernel()(jnp.asarray(blocks), jnp.asarray(nblocks))
+            results.append((idxs, st))
+        for idxs, st in results:
+            arr = np.asarray(st)  # [Mpad, 16] u32
+            raw = arr[:, :8].astype(">u4").tobytes()
+            for row, i in enumerate(idxs):
+                out[i] = raw[row * 32 : row * 32 + 32]
         return out  # type: ignore[return-value]
+
+    _MASKED = None
+
+    @classmethod
+    def _masked_kernel(cls):
+        if cls._MASKED is None:
+            import jax
+
+            from ..ops.treehash_jax import sha512_blocks_masked
+
+            cls._MASKED = jax.jit(sha512_blocks_masked)
+        return cls._MASKED
+
+    # -- whole-tree pipeline ----------------------------------------------
+
+    def hash_tree(self, root) -> int:
+        """Fill every missing node hash in a SHAMap with device-resident
+        level-synchronous hashing. Returns the number of nodes hashed."""
+        import jax.numpy as jnp
+
+        from ..ops.sha512_jax import padded_block_count
+        from ..ops.treehash_jax import (
+            INNER_WORDS,
+            LEAF_BLOCK_LADDER,
+            build_inner_template,
+            inner_level_kernel,
+            leaf_level_kernel,
+            pad_leaf_batch,
+            _pow2,
+        )
+        from ..state.shamap import Inner, Leaf, ZERO256, _collect_unhashed
+        from ..utils.hashes import HP_INNER_NODE, prefix_hash
+
+        levels = _collect_unhashed(root)
+        if not levels:
+            return 0
+
+        index_of: dict[int, int] = {}  # id(node) -> digest-buffer row
+        plan: list[tuple] = []
+        offset = 0
+        hashed_host = 0
+
+        for level in reversed(levels):
+            leaves_by_bucket: dict[int, list] = {}
+            inners: list = []
+            for node in level:
+                if isinstance(node, Leaf):
+                    p, d = node.hash_payload()
+                    msg = p.to_bytes(4, "big") + d
+                    nb = padded_block_count(len(msg))
+                    ladder = next(
+                        (l for l in LEAF_BLOCK_LADDER if nb <= l), None
+                    )
+                    if ladder is None:  # oversized leaf: host hash, known
+                        node._hash = prefix_hash(p, d)
+                        hashed_host += 1
+                    else:
+                        leaves_by_bucket.setdefault(ladder, []).append(
+                            (node, msg)
+                        )
+                else:
+                    if node.is_empty():
+                        node._hash = ZERO256
+                        hashed_host += 1
+                    else:
+                        inners.append(node)
+            for ladder, entries in sorted(leaves_by_bucket.items()):
+                for i, (node, _msg) in enumerate(entries):
+                    index_of[id(node)] = offset + i
+                plan.append(("leaf", ladder, entries, offset))
+                offset += _pow2(len(entries))
+            if inners:
+                for i, node in enumerate(inners):
+                    index_of[id(node)] = offset + i
+                plan.append(("inner", inners, offset))
+                offset += _pow2(len(inners))
+
+        if not plan:
+            return hashed_host
+
+        cap = _pow2(offset)
+        buf = jnp.zeros((cap, 8), jnp.uint32)
+        prefix_words = int(HP_INNER_NODE)
+
+        for step in plan:
+            if step[0] == "leaf":
+                _k, ladder, entries, off = step
+                blocks, nblocks = pad_leaf_batch(
+                    [msg for _n, msg in entries], ladder
+                )
+                buf = leaf_level_kernel(
+                    buf, jnp.asarray(blocks), jnp.asarray(nblocks), off
+                )
+            else:
+                _k, inners, off = step
+                n = len(inners)
+                template = build_inner_template(n)
+                template[:, 0] = prefix_words
+                rows, col_base, src_rows = [], [], []
+                for i, node in enumerate(inners):
+                    for c, child in enumerate(node.children):
+                        if child is None:
+                            h = ZERO256
+                        elif child._hash is not None:
+                            h = child._hash
+                        else:
+                            rows.append(i)
+                            col_base.append(1 + 8 * c)
+                            src_rows.append(index_of[id(child)])
+                            continue
+                        template[i, 1 + 8 * c : 9 + 8 * c] = np.frombuffer(
+                            h, dtype=">u4"
+                        )
+                k_pad = _pow2(max(len(rows), 1))
+                dummy_row = template.shape[0] - 1  # scratch row
+                rows += [dummy_row] * (k_pad - len(rows))
+                col_base += [1] * (k_pad - len(col_base))
+                src_rows += [0] * (k_pad - len(src_rows))
+                buf = inner_level_kernel(
+                    buf,
+                    jnp.asarray(template),
+                    jnp.asarray(np.array(rows, np.int32)),
+                    jnp.asarray(np.array(col_base, np.int32)),
+                    jnp.asarray(np.array(src_rows, np.int32)),
+                    off,
+                    n,
+                )
+
+        host = np.asarray(buf)  # ONE transfer; blocks on the whole chain
+        raw = host.astype(">u4").tobytes()
+        for level in levels:
+            for node in level:
+                if node._hash is None:
+                    row = index_of[id(node)]
+                    node._hash = raw[row * 32 : row * 32 + 32]
+        return hashed_host + len(index_of)
 
 
 register_verifier("cpu", CpuVerifier)

@@ -61,6 +61,7 @@ class LedgerCleaner:
             hdr = self.node.txdb.get_ledger_header(seq=seq)
             if hdr is None:
                 self.failed.append({"seq": seq, "problem": "missing header"})
+                prev_hash = None  # linkage unknown across the gap
                 continue
             try:
                 led = Ledger.load(

@@ -94,7 +94,7 @@ class Node:
 
         # ledger chain + brain
         self.ledger_master = LedgerMaster(
-            hash_batch=self.hasher.prefix_hash_batch
+            hash_batch=self.hasher
         )
         self.ops = NetworkOPs(
             self.ledger_master,
@@ -140,14 +140,14 @@ class Node:
             # state pointer is the atomically-committed source of truth;
             # the txdb header index is the fallback
             led = self.clf.load_last_known(
-                self.nodestore, hash_batch=self.hasher.prefix_hash_batch
+                self.nodestore, hash_batch=self.hasher
             )
             if led is None:
                 hdr = self.txdb.get_ledger_header()
                 if hdr is not None:
                     led = Ledger.load(
                         self.nodestore, hdr["hash"],
-                        hash_batch=self.hasher.prefix_hash_batch,
+                        hash_batch=self.hasher,
                     )
             if led is None:
                 self.ledger_master.start_new_ledger(self.master_keys.account_id)

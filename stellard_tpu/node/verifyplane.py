@@ -5,9 +5,17 @@ verifies each signature synchronously inside its own job
 (PeerImp::checkTransaction → STTx::checkSign → libsodium); here,
 verification requests from concurrent jobs are coalesced across an
 adaptive window and dispatched as ONE device program over the whole
-batch (crypto.backend.BatchVerifier), with a CPU fast path for small
-batches so standalone latency stays flat (SURVEY §7 "Batching vs
-latency").
+batch (crypto.backend.BatchVerifier).
+
+Dispatch is LATENCY-AWARE (VERDICT r2 #1b): the plane continuously
+measures both backends on the batches it actually runs — a per-signature
+EWMA for the threaded CPU path, a per-pad-bucket EWMA for the device
+kernel (whose cost is dominated by a fixed per-invocation latency) — and
+routes each batch to whichever model predicts faster. Small/trickled
+batches therefore stay on the CPU even when a device is configured; the
+device wins exactly where it is faster. Per-batch latencies are kept as
+histograms per backend (the SURVEY §5 tracing ask) and exported through
+get_json.
 
 Callers either:
 - `submit(req) -> Future[bool]` — async, coalesced (the JobQueue path),
@@ -18,6 +26,7 @@ Callers either:
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import Future
 from typing import Optional, Sequence
 
@@ -26,6 +35,104 @@ import numpy as np
 from ..crypto.backend import BatchVerifier, VerifyRequest, make_verifier
 
 __all__ = ["VerifyPlane"]
+
+# histogram bucket upper bounds (ms)
+_HIST_EDGES = [1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, float("inf")]
+
+
+class _LatencyModel:
+    """Measured-cost models for the routing decision."""
+
+    # after this many CPU-routed eligible batches, retry the device once
+    # (load characteristics drift; a one-shot loss must not be forever)
+    REEXPLORE_EVERY = 512
+
+    def __init__(self, min_device_batch: int):
+        self.min_device_batch = min_device_batch
+        # CPU: cost ~ linear in batch size
+        self.cpu_persig_ms: Optional[float] = None
+        # device: cost ~ flat per pad-bucket (kernel latency dominates)
+        self.device_bucket_ms: dict[int, float] = {}
+        # buckets that have absorbed their first (compile-laden) sample
+        self._device_warm: set[int] = set()
+        self._since_device = 0
+        self.lock = threading.Lock()
+
+    @staticmethod
+    def _bucket(n: int, lo: int) -> int:
+        size = lo
+        while size < n:
+            size *= 2
+        return size
+
+    def observe_cpu(self, n: int, ms: float) -> None:
+        if n <= 0:
+            return
+        with self.lock:
+            per = ms / n
+            if self.cpu_persig_ms is None:
+                self.cpu_persig_ms = per
+            else:
+                self.cpu_persig_ms += 0.25 * (per - self.cpu_persig_ms)
+
+    def observe_device(self, n: int, ms: float) -> None:
+        b = self._bucket(max(n, 1), self.min_device_batch)
+        with self.lock:
+            self._since_device = 0
+            if b not in self._device_warm:
+                # first sample per bucket includes XLA compilation —
+                # recording it would poison the model and route every
+                # later batch to the CPU; discard it and measure the
+                # steady state from the second sample on
+                self._device_warm.add(b)
+                return
+            cur = self.device_bucket_ms.get(b)
+            self.device_bucket_ms[b] = (
+                ms if cur is None else cur + 0.25 * (ms - cur)
+            )
+
+    def expected_cpu_ms(self, n: int) -> Optional[float]:
+        with self.lock:
+            if self.cpu_persig_ms is None:
+                return None
+            return self.cpu_persig_ms * n
+
+    def expected_device_ms(self, n: int) -> Optional[float]:
+        b = self._bucket(max(n, 1), self.min_device_batch)
+        with self.lock:
+            if b in self.device_bucket_ms:
+                return self.device_bucket_ms[b]
+            # nearest measured bucket as an estimate; device cost is
+            # near-flat, so any measurement beats none
+            if self.device_bucket_ms:
+                near = min(
+                    self.device_bucket_ms, key=lambda k: abs(k - b)
+                )
+                return self.device_bucket_ms[near]
+            return None
+
+    def use_device(self, n: int) -> bool:
+        """True when the device model predicts a win for this batch.
+        Unmeasured sides are explored optimistically: the device gets
+        tried once a batch reaches min_device_batch, after which real
+        measurements drive every later decision."""
+        if n < self.min_device_batch:
+            return False
+        dev = self.expected_device_ms(n)
+        cpu = self.expected_cpu_ms(n)
+        if dev is None:
+            return True  # explore: one measurement teaches the model
+        if cpu is None:
+            return False  # CPU unmeasured: measure it too
+        if dev < cpu:
+            return True
+        # periodic re-exploration so a stale loss can be unlearned
+        with self.lock:
+            self._since_device += 1
+            if self._since_device >= self.REEXPLORE_EVERY:
+                self._since_device = 0
+                return True
+        return False
 
 
 class VerifyPlane:
@@ -45,6 +152,8 @@ class VerifyPlane:
         self.window = window_ms / 1000.0
         self.max_batch = max_batch
         self.min_device_batch = min_device_batch
+        self.model = _LatencyModel(min_device_batch)
+        self._device_capable = backend != "cpu"
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -52,6 +161,12 @@ class VerifyPlane:
         self._stopping = False
         self.batches = 0
         self.verified = 0
+        self.device_batches = 0
+        self.cpu_batches = 0
+        self._hist: dict[str, list[int]] = {
+            "cpu": [0] * len(_HIST_EDGES),
+            "device": [0] * len(_HIST_EDGES),
+        }
         self._flusher = threading.Thread(
             target=self._flush_loop, name="verify-plane", daemon=True
         )
@@ -74,8 +189,16 @@ class VerifyPlane:
                     self._cv.wait(timeout=0.05)
                 if self._stopping and not self._pending:
                     return
-                # open the coalescing window: wait for more arrivals
-                if len(self._pending) < self.max_batch:
+                # coalescing window: wait for more arrivals while the
+                # backlog is still below a device-worthwhile batch AND the
+                # device would win at the larger size (holding a batch the
+                # CPU can clear immediately only adds latency)
+                if len(self._pending) < self.max_batch and (
+                    self._device_capable
+                    and self.model.use_device(
+                        max(len(self._pending), self.min_device_batch)
+                    )
+                ):
                     self._cv.wait(timeout=self.window)
                 batch = self._pending[: self.max_batch]
                 self._pending = self._pending[self.max_batch :]
@@ -92,14 +215,32 @@ class VerifyPlane:
 
     # -- blocking whole-batch path ---------------------------------------
 
+    def _record(self, kind: str, ms: float) -> None:
+        hist = self._hist[kind]
+        for i, edge in enumerate(_HIST_EDGES):
+            if ms <= edge:
+                hist[i] += 1
+                break
+
     def verify_many(self, reqs: Sequence[VerifyRequest]) -> np.ndarray:
         if not reqs:
             return np.zeros(0, bool)
-        use_cpu = len(reqs) < self.min_device_batch
-        verifier = self.cpu if use_cpu else self.verifier
+        n = len(reqs)
+        use_device = self._device_capable and self.model.use_device(n)
+        verifier = self.verifier if use_device else self.cpu
+        t0 = time.perf_counter()
         out = verifier.verify_batch(reqs)
+        ms = (time.perf_counter() - t0) * 1000.0
+        if use_device:
+            self.model.observe_device(n, ms)
+            self.device_batches += 1
+            self._record("device", ms)
+        else:
+            self.model.observe_cpu(n, ms)
+            self.cpu_batches += 1
+            self._record("cpu", ms)
         self.batches += 1
-        self.verified += len(reqs)
+        self.verified += n
         return out
 
     def stop(self) -> None:
@@ -109,9 +250,22 @@ class VerifyPlane:
         self._flusher.join(timeout=10)
 
     def get_json(self) -> dict:
+        with self.model.lock:
+            model = {
+                "cpu_persig_ms": self.model.cpu_persig_ms,
+                "device_bucket_ms": dict(self.model.device_bucket_ms),
+            }
         return {
             "backend": self.backend_name,
             "batches": self.batches,
             "verified": self.verified,
+            "device_batches": self.device_batches,
+            "cpu_batches": self.cpu_batches,
             "pending": len(self._pending),
+            "model": model,
+            "latency_histogram_ms": {
+                "edges": [e for e in _HIST_EDGES if e != float("inf")],
+                "cpu": list(self._hist["cpu"]),
+                "device": list(self._hist["device"]),
+            },
         }

@@ -123,6 +123,7 @@ class TcpOverlay(ConsensusAdapter):
         bootcache_path: Optional[str] = None,
         resource_key_fn: Optional[Callable] = None,
         gossip_interval: float = GOSSIP_INTERVAL,
+        unl_store=None,
     ):
         self.key = key
         self.port = port
@@ -151,6 +152,7 @@ class TcpOverlay(ConsensusAdapter):
             bootcache_path=bootcache_path,
         )
         self.resources = ResourceManager(key_fn=resource_key_fn)
+        self.unl_store = unl_store  # node.unl.UniqueNodeList or None
         self.gossip_interval = gossip_interval
         self._last_gossip = 0.0
         self._peers_lock = threading.Lock()
@@ -442,6 +444,10 @@ class TcpOverlay(ConsensusAdapter):
             vid = val.validation_id()
             if self._first_seen(vid, peer):
                 if node.handle_validation(val):
+                    if self.unl_store is not None and val.signer in self.unl_store:
+                        # observed-validation bookkeeping (the modern
+                        # unl_score: UniqueNodeList.on_validation)
+                        self.unl_store.on_validation(val.signer, val.ledger_seq)
                     self._relay(msg, except_peer=peer)
                 else:
                     self._charge_if_bad(peer, vid)

@@ -850,7 +850,9 @@ def do_account_currencies(ctx: Context) -> dict:
         our_limit = low if is_low else high
         peer_limit = high if is_low else low
         iso = iso_from_currency(low.currency)
-        if balance.signum() > 0 or peer_limit.signum() > 0:
+        # sendable = positive balance OR remaining peer credit (a line
+        # drawn to its full limit has no capacity left)
+        if balance.signum() > 0 or (peer_limit + balance).signum() > 0:
             send.add(iso)
         if our_limit.signum() > 0:
             receive.add(iso)
@@ -945,9 +947,9 @@ def do_fetch_info(ctx: Context) -> dict:
     if inbound is not None:
         for h, il in list(inbound.live.items()):
             info[h.hex().upper()] = {
-                "have_base": il.have_base,
-                "timeouts": il.timeouts,
-                "complete": il.complete,
+                "have_base": il.header is not None,
+                "failed": il.failed,
+                "complete": il.is_complete(),
             }
     return {"info": info}
 
@@ -1002,19 +1004,33 @@ def do_log_rotate(ctx: Context) -> dict:
 
 @handler("inflate", Role.ADMIN)
 def do_inflate(ctx: Context) -> dict:
-    """reference: handlers/Inflate.cpp (Stellar-specific) — submit an
-    Inflation transaction for the given sequence."""
+    """reference: handlers/Inflate.cpp (Stellar-specific) — build, sign
+    and submit an Inflation transaction for the given sequence."""
     p = ctx.params
     if "seq" not in p:
         raise RPCError("invalidParams", "missing seq")
     from ..protocol.formats import TxType as _Tx
-    from ..protocol.sfields import sfInflateSeq, sfSigningPubKey
+    from ..protocol.keys import decode_seed, passphrase_to_seed
+    from ..protocol.sfields import sfInflateSeq
 
     node = ctx.node
+    secret = p.get("secret")
+    if not secret:
+        raise RPCError("invalidParams", "missing secret")
+    try:
+        seed = decode_seed(secret)
+    except (ValueError, KeyError):
+        seed = passphrase_to_seed(secret)
+    kp = KeyPair.from_seed(seed)
+    led = node.ledger_master.current_ledger()
+    root = led.account_root(kp.account_id)
+    if root is None:
+        raise RPCError("actNotFound")
     tx = SerializedTransaction.build(
-        _Tx.ttINFLATION, node.master_keys.account_id, int(p["seq"]), 0,
-        {sfInflateSeq: int(p["seq"]), sfSigningPubKey: b""},
+        _Tx.ttINFLATION, kp.account_id, root[sfSequence], 10,
+        {sfInflateSeq: int(p["seq"])},
     )
+    tx.sign(kp)
     ter, applied = node.ops.process_transaction(tx, admin=True)
     return {"engine_result": ter.token, "applied": applied}
 

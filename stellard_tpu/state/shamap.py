@@ -246,6 +246,10 @@ def compute_hashes(root, hash_batch: Callable = _default_hasher) -> int:
     `hash_batch` is the device SHA-512 kernel and each level is one
     device program over all dirty nodes of that level.
     """
+    if hasattr(hash_batch, "hash_tree"):
+        # whole-tree device pipeline (TpuHasher.hash_tree): digests stay
+        # device-resident across levels, one host transfer at the end
+        return hash_batch.hash_tree(root)
     levels = _collect_unhashed(root)
     n = 0
     for level in reversed(levels):

@@ -94,7 +94,9 @@ class PowFactory:
         self.secret = os.urandom(32)
         self.validity_s = validity_s
         self.difficulty = max(0, min(difficulty, len(_DIFFICULTY) - 1))
-        self._used: set[bytes] = set()
+        # bucket -> accepted solutions; buckets past expiry are dropped,
+        # so replay memory stays bounded by two validity windows
+        self._used: dict[int, set[bytes]] = {}
 
     def _token(self, challenge: bytes, bucket: int) -> str:
         mac = hmac.new(
@@ -129,11 +131,13 @@ class PowFactory:
             return False, "invalid token"
         if bucket_now - bucket > 1:
             return False, "expired"
-        if solution in self._used:
+        for stale in [b for b in self._used if bucket_now - b > 1]:
+            del self._used[stale]
+        if any(solution in s for s in self._used.values()):
             return False, "reused"
         iterations, bits = _DIFFICULTY[self.difficulty]
         pow_ = ProofOfWork(token, iterations, challenge, _target_bytes(bits))
         if not pow_.check_solution(solution):
             return False, "incorrect"
-        self._used.add(solution)
+        self._used.setdefault(bucket, set()).add(solution)
         return True, "ok"

@@ -1,0 +1,98 @@
+"""Multi-chip mesh integrated into the production verify plane
+(VERDICT r2 #3): TpuVerifier shards over every visible device, exercised
+here on the 8-device virtual CPU mesh the conftest pins.
+
+Covers: uneven (padded) batches, invalid signatures landing in specific
+shards, the psum count path, and the VerifyPlane wiring end-to-end.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from stellard_tpu.crypto.backend import TpuVerifier, VerifyRequest
+from stellard_tpu.ops import ed25519_ref as ref
+from stellard_tpu.ops.ed25519_jax import prepare_batch
+from stellard_tpu.parallel.mesh import make_mesh, verify_and_count
+from stellard_tpu.protocol.keys import KeyPair
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual CPU mesh"
+)
+
+
+def make_reqs(n: int, corrupt: set[int] = frozenset()):
+    rng = np.random.default_rng(3)
+    keys = [KeyPair.from_seed(rng.bytes(32)) for _ in range(8)]
+    reqs, want = [], []
+    for i in range(n):
+        k = keys[i % 8]
+        m = rng.bytes(32)
+        s = bytearray(k.sign(m))
+        if i in corrupt:
+            s[rng.integers(0, 64)] ^= 1 << int(rng.integers(0, 8))
+        reqs.append(VerifyRequest(k.public, m, bytes(s)))
+        want.append(ref.verify(k.public, m, bytes(s)))
+    return reqs, np.array(want)
+
+
+class TestMeshVerifier:
+    def test_verifier_auto_meshes_over_all_devices(self):
+        v = TpuVerifier(min_batch=64)
+        v._resolve_kernel()
+        assert v.n_devices == len(jax.devices())
+
+    def test_uneven_batch_with_bad_sigs_in_specific_shards(self):
+        # 300 requests pad to 512 over 8 shards of 64; corrupt indexes
+        # chosen to land in shards 0, 3 and 7
+        corrupt = {1, 2, 200, 290, 299}
+        reqs, want = make_reqs(300, corrupt)
+        v = TpuVerifier(min_batch=64)
+        got = v.verify_batch(reqs)
+        assert np.array_equal(got, want)
+        assert not got[list(corrupt)].any()
+
+    def test_multi_chunk_pipeline(self):
+        reqs, want = make_reqs(96, corrupt={5, 50})
+        v = TpuVerifier(min_batch=8, max_batch=32)  # forces 3 chunks
+        got = v.verify_batch(reqs)
+        assert np.array_equal(got, want)
+
+    def test_psum_count_with_shard_local_failures(self):
+        n = 128
+        corrupt = {0, 1, 64, 127}
+        reqs, want = make_reqs(n, corrupt)
+        inp = prepare_batch(
+            [r.public for r in reqs],
+            [r.signing_hash for r in reqs],
+            [r.signature for r in reqs],
+        )
+        mesh = make_mesh()
+        flags, total = verify_and_count(mesh)(
+            inp["a_words"], inp["r_words"], inp["s_windows"],
+            inp["h_digits"], inp["s_canonical"],
+        )
+        assert int(total) == int(want.sum())
+        assert np.array_equal(np.asarray(flags), want)
+
+    def test_verifyplane_uses_meshed_verifier(self):
+        from stellard_tpu.node.verifyplane import VerifyPlane
+
+        plane = VerifyPlane(backend="tpu", min_device_batch=8)
+        try:
+            reqs, want = make_reqs(64, corrupt={7})
+            # force-teach the model that the device wins so routing is
+            # deterministic in this test
+            plane.model.observe_cpu(10, 1000.0)
+            got = plane.verify_many(reqs)
+            assert np.array_equal(got, want)
+            assert plane.device_batches == 1
+            assert isinstance(plane.verifier, TpuVerifier)
+            assert plane.verifier.n_devices == len(jax.devices())
+        finally:
+            plane.stop()

@@ -1,0 +1,149 @@
+"""Latency-aware VerifyPlane dispatch (VERDICT r2 #1b).
+
+The plane must learn, from real measurements, when the device batch
+kernel beats the threaded CPU path, and route each batch accordingly —
+trickled submissions must not pay the device kernel latency.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from stellard_tpu.crypto.backend import (
+    BatchVerifier,
+    VerifyRequest,
+    register_verifier,
+)
+from stellard_tpu.node.verifyplane import VerifyPlane, _LatencyModel
+from stellard_tpu.protocol.keys import KeyPair
+
+
+class FakeDeviceVerifier(BatchVerifier):
+    """Deterministic 'device': fixed 50ms kernel latency per call."""
+
+    name = "fake-device"
+    kernel_ms = 50.0
+
+    def __init__(self, **_):
+        self.calls = []
+
+    def verify_batch(self, batch):
+        self.calls.append(len(batch))
+        time.sleep(self.kernel_ms / 1000.0)
+        return np.ones(len(batch), bool)
+
+
+register_verifier("fake-device", FakeDeviceVerifier)
+
+
+def reqs(n: int) -> list[VerifyRequest]:
+    k = KeyPair.from_passphrase("vp-policy")
+    m = b"\x42" * 32
+    s = k.sign(m)
+    return [VerifyRequest(k.public, m, s) for _ in range(n)]
+
+
+class TestModel:
+    def test_routing_learns_crossover(self):
+        m = _LatencyModel(min_device_batch=64)
+        # measured: CPU 0.1 ms/sig; device flat 50ms per call
+        m.observe_cpu(100, 10.0)
+        for _ in range(2):  # first device sample per bucket is warmup
+            m.observe_device(256, 50.0)
+            m.observe_device(4096, 55.0)
+        assert not m.use_device(32)  # below floor
+        assert not m.use_device(200)  # 20ms CPU < ~50ms device
+        assert m.use_device(1000)  # 100ms CPU > ~50ms device
+        assert m.use_device(4096)  # 410ms CPU > 55ms device
+
+    def test_unmeasured_device_explored_then_driven_by_data(self):
+        m = _LatencyModel(min_device_batch=64)
+        m.observe_cpu(100, 1.0)  # very fast CPU: 0.01 ms/sig
+        assert m.use_device(128)  # no device data yet: explore
+        m.observe_device(128, 5000.0)  # first sample = compile: discarded
+        assert m.use_device(128)  # still exploring (warm, unmeasured)
+        m.observe_device(128, 50.0)  # steady-state sample
+        assert not m.use_device(128)  # 1.3ms CPU beats 50ms kernel
+
+    def test_bucket_estimates_generalize(self):
+        m = _LatencyModel(min_device_batch=64)
+        for _ in range(2):  # past the warmup discard
+            m.observe_device(4096, 50.0)
+        # unmeasured bucket borrows the nearest measurement
+        assert m.expected_device_ms(256) == 50.0
+        assert m.expected_device_ms(16384) == 50.0
+
+
+class TestPlaneRouting:
+    def test_small_batches_stay_on_cpu(self):
+        plane = VerifyPlane(backend="fake-device", min_device_batch=64,
+                            window_ms=1.0)
+        fake: FakeDeviceVerifier = plane.verifier  # type: ignore[assignment]
+        try:
+            # trickle: 10 batches of 4 — all must go CPU (below floor)
+            for _ in range(10):
+                assert plane.verify_many(reqs(4)).all()
+            assert fake.calls == []
+            assert plane.cpu_batches == 10
+        finally:
+            plane.stop()
+
+    def test_large_batches_move_to_device_when_it_wins(self):
+        plane = VerifyPlane(backend="fake-device", min_device_batch=64,
+                            window_ms=1.0)
+        fake: FakeDeviceVerifier = plane.verifier  # type: ignore[assignment]
+        # teach the model a slow CPU (0.5 ms/sig) without sleeping
+        plane.model.observe_cpu(100, 50.0)
+        # pre-warm the buckets (the first device sample per bucket is
+        # treated as compile time and discarded)
+        for b in (256, 64, 512):
+            plane.model.observe_device(b, 0.0)
+        try:
+            assert plane.verify_many(reqs(256)).all()
+            assert fake.calls == [256]  # 128ms CPU estimate > explore
+            # model now knows device ≈ 50ms; a 64-batch (32ms CPU) goes CPU
+            assert plane.verify_many(reqs(64)).all()
+            assert fake.calls == [256]
+            # but a 512-batch (256ms CPU) goes device
+            assert plane.verify_many(reqs(512)).all()
+            assert fake.calls == [256, 512]
+        finally:
+            plane.stop()
+
+    def test_device_losing_everywhere_goes_all_cpu(self):
+        """The r2 regression shape: device slower at every size -> after
+        the exploration batch, everything routes CPU."""
+        plane = VerifyPlane(backend="fake-device", min_device_batch=64,
+                            window_ms=1.0)
+        fake: FakeDeviceVerifier = plane.verifier  # type: ignore[assignment]
+        plane.model.observe_cpu(1000, 10.0)  # fast CPU: 0.01 ms/sig
+        try:
+            for _ in range(6):
+                plane.verify_many(reqs(256))
+            # exploration hits the device at most twice (the first sample
+            # is discarded as compile warmup); never again after
+            assert len(fake.calls) <= 2
+            assert plane.cpu_batches >= 4
+        finally:
+            plane.stop()
+
+    def test_histograms_and_model_exported(self):
+        plane = VerifyPlane(backend="cpu")
+        try:
+            plane.verify_many(reqs(8))
+            j = plane.get_json()
+            assert sum(j["latency_histogram_ms"]["cpu"]) == 1
+            assert j["model"]["cpu_persig_ms"] is not None
+        finally:
+            plane.stop()
+
+    def test_async_submit_path_unchanged(self):
+        plane = VerifyPlane(backend="cpu", window_ms=1.0)
+        try:
+            futs = [plane.submit(r) for r in reqs(32)]
+            assert all(f.result(timeout=10) for f in futs)
+        finally:
+            plane.stop()
