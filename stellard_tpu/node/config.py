@@ -70,6 +70,10 @@ class Config:
     validation_quorum: int = 1  # reference Config.h:406 default sizing
     consensus_threshold: int = 0  # Stellar addition (Config.h:407)
 
+    # -- ops ([sntp_servers], [insight]) -----------------------------------
+    sntp_servers: list[str] = field(default_factory=list)  # host[:port]
+    insight: str = ""  # '' | 'statsd:host:port[:prefix]'
+
     # -- API doors ([rpc_*], [websocket_*]) --------------------------------
     rpc_ip: str = "127.0.0.1"
     rpc_port: Optional[int] = None  # None = disabled, 0 = ephemeral
@@ -121,6 +125,8 @@ class Config:
         cfg.hash_backend = one("hash_backend", cfg.hash_backend).lower()
 
         cfg.validation_seed = one("validation_seed", cfg.validation_seed)
+        cfg.sntp_servers = [line.split()[0] for line in s.get("sntp_servers", [])]
+        cfg.insight = one("insight", cfg.insight)
         cfg.validators = [
             line.split()[0] for line in s.get("validators", [])
         ]  # reference allows trailing comments per line

@@ -66,7 +66,7 @@ class LedgerCleaner:
             try:
                 led = Ledger.load(
                     self.node.nodestore, hdr["hash"],
-                    hash_batch=self.node.hasher.prefix_hash_batch,
+                    hash_batch=self.node.hasher,
                 )
             except (KeyError, ValueError) as e:
                 self.failed.append({"seq": seq, "problem": f"load: {e}"})

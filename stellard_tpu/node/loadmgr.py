@@ -36,10 +36,13 @@ class LoadFeeTrack:
     sustained overload escalates geometrically and recovery is smooth.
     """
 
+    REMOTE_TTL = 30.0  # a cluster report is stale after this many seconds
+
     def __init__(self):
         self._lock = threading.Lock()
         self._local = NORMAL_FEE
         self._remote = NORMAL_FEE
+        self._remote_expiry = 0.0
         self.raise_count = 0
 
     def raise_local_fee(self) -> None:
@@ -53,14 +56,30 @@ class LoadFeeTrack:
                 self._local = max(NORMAL_FEE, self._local - max(1, self._local // 4))
 
     def set_remote_fee(self, fee: int) -> None:
-        """From cluster/peer load reports (sfLoadFee in validations)."""
+        """From cluster/peer load reports (sfLoadFee in validations).
+        Reports expire: a peer that stops reporting (or whose load
+        subsides) must not ratchet our fee up forever."""
         with self._lock:
             self._remote = max(NORMAL_FEE, min(MAX_FEE, int(fee)))
+            self._remote_expiry = time.monotonic() + self.REMOTE_TTL
+
+    @property
+    def local_fee(self) -> int:
+        """Our OWN load fee — what cluster reports must carry (sending
+        the max(local, remote) would echo a peer's fee back and ratchet
+        the whole cluster permanently)."""
+        with self._lock:
+            return self._local
 
     @property
     def load_factor(self) -> int:
         with self._lock:
-            return max(self._local, self._remote)
+            remote = (
+                self._remote
+                if time.monotonic() < self._remote_expiry
+                else NORMAL_FEE
+            )
+            return max(self._local, remote)
 
     @property
     def is_loaded(self) -> bool:

@@ -1,0 +1,212 @@
+"""Metrics plane: insight-style instruments + statsd export.
+
+Role parity with the reference's beast::insight + CollectorManager
+(/root/reference/src/ripple_app/main/CollectorManager.cpp:22-60,
+beast insight {Counter,Gauge,Event,Meter,Hook}): subsystems register
+named instruments against a collector; the `[insight]` config selects a
+NullCollector (default) or a StatsDCollector that ships deltas over UDP.
+
+Hooks are pull-gauges: a callable sampled at flush time, which is how
+the JobQueue per-type gauges and the verify plane's rates export without
+the hot paths touching the collector.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Meter",
+    "CollectorManager",
+    "NullCollector",
+    "StatsDCollector",
+]
+
+
+class Counter:
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+
+class Meter:
+    """Events per flush interval."""
+
+    __slots__ = ("name", "count", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def mark(self, n: int = 1) -> None:
+        with self._lock:
+            self.count += n
+
+    def drain(self) -> int:
+        with self._lock:
+            n = self.count
+            self.count = 0
+            return n
+
+
+class NullCollector:
+    """Discards everything (the default when [insight] is unset)."""
+
+    def flush(self, lines: list[str]) -> None:  # pragma: no cover - trivial
+        pass
+
+    def close(self) -> None:  # pragma: no cover - trivial
+        pass
+
+
+class StatsDCollector:
+    """Ships statsd datagrams over UDP (reference StatsDCollector)."""
+
+    def __init__(self, host: str, port: int, prefix: str = "stellard"):
+        self.addr = (host, port)
+        self.prefix = prefix
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sent = 0
+
+    def flush(self, lines: list[str]) -> None:
+        # batch into ~1400-byte datagrams (statsd multi-metric packets)
+        buf = b""
+        for line in lines:
+            data = f"{self.prefix}.{line}\n".encode()
+            if buf and len(buf) + len(data) > 1400:
+                self._send(buf)
+                buf = b""
+            buf += data
+        if buf:
+            self._send(buf)
+
+    def _send(self, buf: bytes) -> None:
+        try:
+            self.sock.sendto(buf, self.addr)
+            self.sent += 1
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        self.sock.close()
+
+
+class CollectorManager:
+    """Instrument registry + periodic flusher (CollectorManager role)."""
+
+    def __init__(self, collector=None, flush_interval: float = 1.0):
+        self.collector = collector or NullCollector()
+        self.flush_interval = flush_interval
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._meters: dict[str, Meter] = {}
+        self._hooks: dict[str, Callable[[], dict]] = {}
+        self._last_counter_vals: dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def from_config(cls, insight: str) -> "CollectorManager":
+        """[insight] value: '' / 'null' -> null; 'statsd:host:port[:prefix]'
+        -> statsd (reference CollectorManager.cpp config parse)."""
+        if insight.startswith("statsd:"):
+            parts = insight.split(":")
+            try:
+                host, port = parts[1], int(parts[2])
+            except (IndexError, ValueError):
+                return cls(NullCollector())  # malformed: metrics off
+            prefix = parts[3] if len(parts) > 3 else "stellard"
+            return cls(StatsDCollector(host, port, prefix))
+        return cls(NullCollector())
+
+    # -- registry ----------------------------------------------------------
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter(name))
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            return self._gauges.setdefault(name, Gauge(name))
+
+    def meter(self, name: str) -> Meter:
+        with self._lock:
+            return self._meters.setdefault(name, Meter(name))
+
+    def hook(self, name: str, fn: Callable[[], dict]) -> None:
+        """fn() -> {metric_suffix: value} sampled at flush time (the
+        insight::Hook shape; how JobQueue gauges export pull-style)."""
+        with self._lock:
+            self._hooks[name] = fn
+
+    # -- flushing ----------------------------------------------------------
+
+    def flush_once(self) -> list[str]:
+        lines: list[str] = []
+        with self._lock:
+            counters = list(self._counters.values())
+            gauges = list(self._gauges.values())
+            meters = list(self._meters.values())
+            hooks = list(self._hooks.items())
+        for c in counters:
+            prev = self._last_counter_vals.get(c.name, 0)
+            delta = c.value - prev
+            self._last_counter_vals[c.name] = c.value
+            if delta:
+                lines.append(f"{c.name}:{delta}|c")
+        for g in gauges:
+            lines.append(f"{g.name}:{g.value:g}|g")
+        for m in meters:
+            n = m.drain()
+            if n:
+                lines.append(f"{m.name}:{n}|m")
+        for name, fn in hooks:
+            try:
+                for suffix, value in fn().items():
+                    lines.append(f"{name}.{suffix}:{value:g}|g")
+            except Exception:  # noqa: BLE001 — a hook must not kill the flusher
+                pass
+        self.collector.flush(lines)
+        return lines
+
+    def start(self) -> "CollectorManager":
+        self._thread = threading.Thread(
+            target=self._run, name="insight", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.flush_interval):
+            self.flush_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.collector.close()

@@ -92,6 +92,26 @@ class Node:
         self.pow_factory = PowFactory()
         self.ledger_cleaner = LedgerCleaner(self)
 
+        # ops plane: SNTP network clock + insight metrics (reference:
+        # SNTPClient init Application.cpp:698-699, CollectorManager :287)
+        from .metrics import CollectorManager
+        from .netclock import SntpClient
+
+        self.collector = CollectorManager.from_config(cfg.insight)
+        self.sntp: Optional[SntpClient] = None
+        if cfg.sntp_servers:
+            servers = []
+            for spec in cfg.sntp_servers:
+                host, _, port = spec.rpartition(":")
+                if not host:  # bare hostname, no port
+                    host, port = spec, ""
+                try:
+                    servers.append((host, int(port) if port else 123))
+                except ValueError:
+                    continue  # malformed entry: skip, don't kill the node
+            if servers:
+                self.sntp = SntpClient(servers)
+
         # ledger chain + brain
         self.ledger_master = LedgerMaster(
             hash_batch=self.hasher
@@ -176,6 +196,27 @@ class Node:
             ).start()
         self._running.set()
         self.load_manager.start()
+        if self.sntp is not None:
+            self.sntp.start()
+        # pull-gauges for the metrics plane (insight Hook shape)
+        self.collector.hook(
+            "jobq",
+            lambda: {
+                t: s["queued"] + s["running"]
+                for t, s in self.job_queue.get_json().items()
+            },
+        )
+        self.collector.hook(
+            "verify",
+            lambda: {
+                "batches": self.verify_plane.batches,
+                "verified": self.verify_plane.verified,
+            },
+        )
+        self.collector.hook(
+            "load", lambda: {"factor": self.fee_track.load_factor}
+        )
+        self.collector.start()
         return self
 
     def run(self) -> None:
@@ -202,11 +243,18 @@ class Node:
                     "heartbeat",
                     self.load_manager.reset_deadlock_detector,
                 )
+                if self.sntp is not None and self.sntp.synced:
+                    # discipline the network clock used for close times
+                    # (reference getNetworkTimeNC via the SNTP offset)
+                    self.ops.net_time_offset = int(round(self.sntp.offset))
             _time.sleep(0.2)
 
     def stop(self) -> None:
         self._running.clear()
         self.load_manager.stop()
+        self.collector.stop()
+        if self.sntp is not None:
+            self.sntp.stop()
         if self.http_server:
             self.http_server.stop()
         if self.ws_server:

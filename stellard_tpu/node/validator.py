@@ -67,6 +67,9 @@ class ValidatorNode:
             is_trusted=lambda pk: pk in self.unl, now=network_time
         )
         self.router = HashRouter()
+        from .localtxs import LocalTxs
+
+        self.local_txs = LocalTxs()
         self.round: Optional[LedgerConsensus] = None
         self.prev_proposers = 0
         self.prev_round_ms = LEDGER_MIN_CONSENSUS_MS
@@ -181,11 +184,20 @@ class ValidatorNode:
         )
         self.prev_round_ms = max(round_ms, LEDGER_MIN_CONSENSUS_MS)
         self.rounds_completed += 1
+        # local submissions that missed this ledger re-apply to the new
+        # open ledger; landed/expired ones sweep (reference LocalTxs)
+        self.local_txs.sweep(ledger)
+        if len(self.local_txs):
+            self.local_txs.apply_to_open(
+                self.lm, TxParams.OPEN_LEDGER | TxParams.RETRY
+            )
         self.begin_round()
 
     # -- transaction submission ------------------------------------------
 
-    def submit(self, tx: SerializedTransaction) -> tuple[TER, bool]:
+    def submit(
+        self, tx: SerializedTransaction, local: bool = True
+    ) -> tuple[TER, bool]:
         txid = tx.txid()
         flags = self.router.get_flags(txid)
         if flags & SF_BAD:
@@ -202,6 +214,12 @@ class ValidatorNode:
         )
         if ter == TER.terPRE_SEQ:
             self.lm.add_held_transaction(tx)
+        if local and not ter.is_tem:
+            # client submissions (NOT relayed gossip) re-apply across
+            # rounds (reference: LocalTxs.cpp push_back fed only from the
+            # client submit path — tracking relays would grow with total
+            # network traffic)
+            self.local_txs.push_back(self.lm.closed_ledger().seq, tx)
         return ter, applied
 
     # -- peer message handlers -------------------------------------------
@@ -209,7 +227,7 @@ class ValidatorNode:
     def handle_tx(self, tx: SerializedTransaction) -> bool:
         """Relayed network tx (reference: PeerImp::checkTransaction).
         Returns True when it should be re-relayed."""
-        ter, _ = self.submit(tx)
+        ter, _ = self.submit(tx, local=False)
         return int(ter) == 0 or -99 <= int(ter) < 0
 
     def handle_proposal(self, prop: LedgerProposal) -> bool:

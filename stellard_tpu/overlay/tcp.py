@@ -43,6 +43,7 @@ from .resource import (
     ResourceManager,
 )
 from .wire import (
+    ClusterStatus,
     Endpoints,
     FrameReader,
     GetLedger,
@@ -124,6 +125,8 @@ class TcpOverlay(ConsensusAdapter):
         resource_key_fn: Optional[Callable] = None,
         gossip_interval: float = GOSSIP_INTERVAL,
         unl_store=None,
+        cluster: Optional[set[bytes]] = None,
+        fee_track=None,
     ):
         self.key = key
         self.port = port
@@ -153,6 +156,10 @@ class TcpOverlay(ConsensusAdapter):
         )
         self.resources = ResourceManager(key_fn=resource_key_fn)
         self.unl_store = unl_store  # node.unl.UniqueNodeList or None
+        # same-operator cluster (reference mtCLUSTER): members share their
+        # load fee so the whole cluster escalates together
+        self.cluster = cluster or set()
+        self.fee_track = fee_track  # node.loadmgr.LoadFeeTrack or None
         self.gossip_interval = gossip_interval
         self._last_gossip = 0.0
         self._peers_lock = threading.Lock()
@@ -294,6 +301,11 @@ class TcpOverlay(ConsensusAdapter):
                 their_hello.node_public, session_hash, their_hello.session_sig
             ):
                 self._charge(peer, FEE_INVALID_SIGNATURE)
+                peer.close()
+                return
+            if their_hello.proto_version != PROTO_VERSION:
+                # protocol version skew: refuse cleanly (reference: TMHello
+                # version gate in PeerImp::recvHello)
                 peer.close()
                 return
             if their_hello.node_public == self.key.public:
@@ -451,6 +463,13 @@ class TcpOverlay(ConsensusAdapter):
                     self._relay(msg, except_peer=peer)
                 else:
                     self._charge_if_bad(peer, vid)
+        elif isinstance(msg, ClusterStatus):
+            if (
+                self.fee_track is not None
+                and msg.node_public in self.cluster
+                and msg.node_public == peer.node_public
+            ):
+                self.fee_track.set_remote_fee(msg.load_fee)
         elif isinstance(msg, Endpoints):
             accepted = self.peerfinder.on_endpoints(
                 msg.endpoints, sender=peer.remote
@@ -514,6 +533,19 @@ class TcpOverlay(ConsensusAdapter):
                 sample = self.peerfinder.gossip_sample(("0.0.0.0", self.port))
                 if sample:
                     self._broadcast(Endpoints(sample))
+                if self.fee_track is not None and self.cluster:
+                    status = frame(ClusterStatus(
+                        self.key.public,
+                        self.fee_track.local_fee,
+                        self._ntime(),
+                    ))
+                    with self._peers_lock:
+                        members = [
+                            p for p in self.peers.values()
+                            if p.node_public in self.cluster
+                        ]
+                    for p in members:
+                        p.send(status)
                 self.resources.sweep()
             # Half-open detection: a crashed peer (no FIN/RST) leaves our
             # reader blocked in recv with alive=True forever, which would

@@ -36,6 +36,7 @@ __all__ = [
     "LedgerData",
     "StatusChange",
     "Endpoints",
+    "ClusterStatus",
     "GetObjects",
     "ObjectsData",
     "encode_message",
@@ -63,6 +64,7 @@ class MessageType(IntEnum):
     LEDGER_DATA = 21
     STATUS_CHANGE = 22
     ENDPOINTS = 30
+    CLUSTER = 31
     GET_OBJECTS = 40
     OBJECTS_DATA = 41
 
@@ -174,6 +176,17 @@ class StatusChange:
 @dataclass
 class Endpoints:
     endpoints: list = field(default_factory=list)  # (host, port, hops)
+
+
+@dataclass
+class ClusterStatus:
+    """Same-operator load report (reference: mtCLUSTER /
+    ClusterNodeStatus.h): cluster members share their load fee so every
+    member escalates together."""
+
+    node_public: bytes
+    load_fee: int
+    report_time: int
 
 
 @dataclass
@@ -326,6 +339,16 @@ def _dec_status(p: BinaryParser) -> StatusChange:
     return StatusChange(p.read8(), p.read32(), p.read(32), p.read32())
 
 
+def _enc_cluster(s: Serializer, m: ClusterStatus):
+    s.add_vl(m.node_public)
+    s.add32(m.load_fee)
+    s.add32(m.report_time)
+
+
+def _dec_cluster(p: BinaryParser) -> ClusterStatus:
+    return ClusterStatus(p.read_vl(), p.read32(), p.read32())
+
+
 def _enc_endpoints(s: Serializer, m: Endpoints):
     s.add32(len(m.endpoints))
     for host, port, hops in m.endpoints:
@@ -375,6 +398,7 @@ _CODECS = {
     MessageType.LEDGER_DATA: (LedgerData, _enc_ledger_data, _dec_ledger_data),
     MessageType.STATUS_CHANGE: (StatusChange, _enc_status, _dec_status),
     MessageType.ENDPOINTS: (Endpoints, _enc_endpoints, _dec_endpoints),
+    MessageType.CLUSTER: (ClusterStatus, _enc_cluster, _dec_cluster),
     MessageType.GET_OBJECTS: (GetObjects, _enc_get_objects, _dec_get_objects),
     MessageType.OBJECTS_DATA: (ObjectsData, _enc_objects_data, _dec_objects_data),
 }

@@ -1,0 +1,342 @@
+"""Auxiliary subsystems (SURVEY §5 / VERDICT r2 missing rows):
+SNTP network clock, insight/statsd metrics, LocalTxs re-application,
+cluster load sharing, protocol-version gate, slow-reader backpressure.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from stellard_tpu.node.localtxs import LocalTxs, HOLD_LEDGERS
+from stellard_tpu.node.metrics import (
+    CollectorManager,
+    NullCollector,
+    StatsDCollector,
+)
+from stellard_tpu.node.netclock import NTP_EPOCH_DELTA, SntpClient
+
+
+class TestSntp:
+    def _fake_server(self, skew: float):
+        """A one-shot SNTP responder applying a clock skew."""
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+
+        def serve():
+            data, addr = sock.recvfrom(512)
+            reply = bytearray(48)
+            reply[0] = (4 << 3) | 4  # VN=4 Mode=4 (server)
+            tx = time.time() + skew + NTP_EPOCH_DELTA
+            sec = int(tx)
+            frac = int((tx - sec) * (1 << 32))
+            struct.pack_into(">II", reply, 40, sec, frac)
+            sock.sendto(bytes(reply), addr)
+            sock.close()
+
+        threading.Thread(target=serve, daemon=True).start()
+        return port
+
+    def test_learns_offset_from_skewed_server(self):
+        port = self._fake_server(skew=42.0)
+        c = SntpClient([("127.0.0.1", port)], timeout=3.0)
+        assert c.query_once()
+        assert c.synced
+        assert abs(c.offset - 42.0) < 1.0
+        assert abs(c.network_unix_time() - (time.time() + 42.0)) < 1.0
+
+    def test_insane_offset_rejected(self):
+        port = self._fake_server(skew=10_000.0)
+        c = SntpClient([("127.0.0.1", port)], timeout=3.0)
+        assert not c.query_once()
+        assert not c.synced
+
+    def test_unreachable_server_is_clean(self):
+        c = SntpClient([("127.0.0.1", 1)], timeout=0.2)
+        assert not c.query_once()
+        assert c.offset == 0.0
+
+
+class TestMetrics:
+    def test_instruments_and_statsd_lines(self):
+        mgr = CollectorManager(NullCollector())
+        mgr.counter("tx.processed").inc(5)
+        mgr.gauge("jobq.depth").set(17)
+        mgr.meter("peer.msgs").mark(3)
+        mgr.hook("verify", lambda: {"batches": 2, "rate": 1.5})
+        lines = mgr.flush_once()
+        assert "tx.processed:5|c" in lines
+        assert "jobq.depth:17|g" in lines
+        assert "peer.msgs:3|m" in lines
+        assert "verify.batches:2|g" in lines
+        # counters flush deltas, not totals
+        mgr.counter("tx.processed").inc(2)
+        lines = mgr.flush_once()
+        assert "tx.processed:2|c" in lines
+
+    def test_statsd_udp_export(self):
+        rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        rx.bind(("127.0.0.1", 0))
+        rx.settimeout(3.0)
+        port = rx.getsockname()[1]
+        mgr = CollectorManager(StatsDCollector("127.0.0.1", port, "testnode"))
+        mgr.counter("closes").inc()
+        mgr.flush_once()
+        data, _ = rx.recvfrom(2048)
+        assert b"testnode.closes:1|c" in data
+        rx.close()
+        mgr.stop()
+
+    def test_from_config(self):
+        assert isinstance(CollectorManager.from_config("").collector, NullCollector)
+        m = CollectorManager.from_config("statsd:127.0.0.1:8125:pfx")
+        assert isinstance(m.collector, StatsDCollector)
+        assert m.collector.prefix == "pfx"
+        m.collector.close()
+
+    def test_broken_hook_does_not_kill_flush(self):
+        mgr = CollectorManager(NullCollector())
+        mgr.hook("bad", lambda: 1 / 0)
+        mgr.gauge("ok").set(1)
+        assert "ok:1|g" in mgr.flush_once()
+
+
+class TestLocalTxs:
+    def test_reapply_until_landed_then_swept(self):
+        """A local tx left out of one consensus set re-applies to the next
+        open ledger and sweeps once it lands in a validated ledger."""
+        from stellard_tpu.engine.engine import TxParams
+        from stellard_tpu.node.ledgermaster import LedgerMaster
+        from stellard_tpu.protocol.formats import TxType
+        from stellard_tpu.protocol.keys import KeyPair
+        from stellard_tpu.protocol.sfields import sfAmount, sfDestination
+        from stellard_tpu.protocol.stamount import STAmount
+        from stellard_tpu.protocol.sttx import SerializedTransaction
+
+        master = KeyPair.from_passphrase("masterpassphrase")
+        alice = KeyPair.from_passphrase("alice")
+        lm = LedgerMaster()
+        lm.min_validations = 0
+        lm.start_new_ledger(master.account_id, 1000)
+        lt = LocalTxs()
+
+        tx = SerializedTransaction.build(
+            TxType.ttPAYMENT, master.account_id, 1, 10,
+            {sfAmount: STAmount.from_drops(500_000_000),
+             sfDestination: alice.account_id},
+        )
+        tx.sign(master)
+        lm.do_transaction(tx, TxParams.OPEN_LEDGER | TxParams.RETRY)
+        lt.push_back(lm.closed_ledger().seq, tx)
+
+        # consensus closes WITHOUT our tx (another node's empty set won)
+        lcl, _ = lm.close_with_txset([], 2000, 10)
+        assert lt.sweep(lcl) == 0  # not landed, not expired
+        assert len(lt) == 1
+        lt.apply_to_open(lm, TxParams.OPEN_LEDGER | TxParams.RETRY)
+        # next close includes the open ledger (normal close path)
+        lcl2, _ = lm.close_and_advance(3000, 10)
+        assert lcl2.account_root(alice.account_id) is not None
+        assert lt.sweep(lcl2) == 1  # landed -> swept
+        assert len(lt) == 0
+
+    def test_expiry_and_permanent_failure(self):
+        from stellard_tpu.engine.engine import TxParams
+        from stellard_tpu.node.ledgermaster import LedgerMaster
+        from stellard_tpu.protocol.formats import TxType
+        from stellard_tpu.protocol.keys import KeyPair
+        from stellard_tpu.protocol.sfields import sfAmount, sfDestination
+        from stellard_tpu.protocol.stamount import STAmount
+        from stellard_tpu.protocol.sttx import SerializedTransaction
+
+        master = KeyPair.from_passphrase("masterpassphrase")
+        alice = KeyPair.from_passphrase("alice")
+        lm = LedgerMaster()
+        lm.min_validations = 0
+        lm.start_new_ledger(master.account_id, 1000)
+        lt = LocalTxs()
+        # a tx with a far-future sequence can never apply
+        tx = SerializedTransaction.build(
+            TxType.ttPAYMENT, master.account_id, 99, 10,
+            {sfAmount: STAmount.from_drops(1_000_000),
+             sfDestination: alice.account_id},
+        )
+        tx.sign(master)
+        lt.push_back(lm.closed_ledger().seq, tx)
+        for i in range(HOLD_LEDGERS + 2):
+            lcl, _ = lm.close_and_advance(2000 + i * 10, 10)
+        assert lt.sweep(lcl) == 1  # expired
+        assert len(lt) == 0
+
+
+class TestOverlayHardening:
+    def _mini_net(self, n=2, **kw):
+        import sys
+
+        sys.path.insert(0, "/root/repo/tests")
+        from test_peerfinder import free_ports, make_overlay, MASTER
+
+        from stellard_tpu.protocol.keys import KeyPair
+
+        ports = free_ports(n)
+        keys = [KeyPair.from_passphrase(f"aux-val-{i}") for i in range(n)]
+        unl = {k.public for k in keys}
+        t0 = time.monotonic()
+        clock = lambda: (time.monotonic() - t0) * 5.0
+        ntime = lambda: 40_000_000 + int(clock())
+        overlays = [
+            make_overlay(
+                keys[i], unl, ports[i],
+                [("127.0.0.1", ports[j]) for j in range(n) if j != i],
+                ntime, clock, **(kw if isinstance(kw, dict) else {}),
+            )
+            for i in range(n)
+        ]
+        for ov in overlays:
+            ov.start(MASTER.account_id, close_time=ntime())
+        return overlays, ports
+
+    def test_version_skew_rejected(self):
+        """A peer announcing a different protocol version is refused after
+        the hello (clean close, no session registered)."""
+        import os
+
+        from stellard_tpu.overlay.tcp import HP_SESSION, PROTO_VERSION
+        from stellard_tpu.overlay.wire import FrameReader, Hello, frame
+        from stellard_tpu.protocol.keys import KeyPair
+        from stellard_tpu.utils.hashes import prefix_hash
+
+        overlays, ports = self._mini_net(2)
+        try:
+            me = KeyPair.from_passphrase("skewed-node")
+            s = socket.create_connection(("127.0.0.1", ports[0]), timeout=3)
+            s.settimeout(3.0)
+            their_nonce = s.recv(32)
+            nonce = os.urandom(32)
+            s.sendall(nonce)
+            session_hash = prefix_hash(
+                HP_SESSION,
+                min(nonce, their_nonce) + max(nonce, their_nonce),
+            )
+            hello = Hello(
+                PROTO_VERSION + 7,  # skewed version
+                0, me.public, me.sign(session_hash), 1, b"\x00" * 32, 0,
+            )
+            s.sendall(frame(hello))
+            # server closes on us; no session appears under our key
+            deadline = time.monotonic() + 5
+            closed = False
+            while time.monotonic() < deadline:
+                try:
+                    if s.recv(65536) == b"":
+                        closed = True
+                        break
+                except socket.timeout:
+                    break
+                except OSError:
+                    closed = True
+                    break
+            assert closed
+            assert me.public not in overlays[0].peers
+        finally:
+            for ov in overlays:
+                ov.stop()
+
+    def test_slow_reader_does_not_wedge_the_net(self):
+        """A connected peer that stops reading (full kernel buffer) must
+        not block broadcasts: bounded sends mark it dead and the rest of
+        the net keeps closing ledgers."""
+        overlays, ports = self._mini_net(2)
+        try:
+            assert any(
+                _wait(lambda: ov.peer_count() == 1, 15) for ov in overlays
+            )
+            victim = overlays[0]
+            # grab the live session and wedge its socket: stop the reader
+            # thread cooperatively by pausing recv via shrinking the
+            # peer's socket buffer and never reading from our side
+            with victim._peers_lock:
+                peer = next(iter(victim.peers.values()))
+            # flood a burst of large frames; bounded SO_SNDTIMEO on the
+            # sender side guarantees send() returns (dead or sent)
+            big = b"\x00" * 512 * 1024
+            t0 = time.monotonic()
+            from stellard_tpu.overlay.wire import TxSetData, frame as fr
+
+            for _ in range(64):
+                peer.send(fr(TxSetData(b"\x11" * 32, [big])))
+                if not peer.alive:
+                    break
+            elapsed = time.monotonic() - t0
+            assert elapsed < 60, "send path wedged"
+            # the node itself still ticks (timer thread not blocked)
+            seq0 = victim.node.lm.closed_ledger().seq
+            assert _wait(
+                lambda: victim.node.lm.closed_ledger().seq >= seq0, 5
+            )
+        finally:
+            for ov in overlays:
+                ov.stop()
+
+    def test_cluster_load_fee_propagates(self):
+        from stellard_tpu.node.loadmgr import LoadFeeTrack
+        from stellard_tpu.protocol.keys import KeyPair
+
+        keys = [KeyPair.from_passphrase(f"aux-clu-{i}") for i in range(2)]
+        cluster = {k.public for k in keys}
+        tracks = [LoadFeeTrack(), LoadFeeTrack()]
+        import sys
+
+        sys.path.insert(0, "/root/repo/tests")
+        from test_peerfinder import free_ports, MASTER
+
+        from stellard_tpu.overlay.tcp import TcpOverlay
+
+        ports = free_ports(2)
+        t0 = time.monotonic()
+        clock = lambda: (time.monotonic() - t0) * 5.0
+        ntime = lambda: 41_000_000 + int(clock())
+        overlays = []
+        for i in range(2):
+            overlays.append(TcpOverlay(
+                key=keys[i],
+                unl=cluster,
+                quorum=2,
+                port=ports[i],
+                peer_addrs=[("127.0.0.1", ports[1 - i])],
+                network_time=ntime,
+                clock=clock,
+                timer_interval=0.15,
+                idle_interval=4,
+                gossip_interval=0.3,
+                cluster=cluster,
+                fee_track=tracks[i],
+            ))
+        for ov in overlays:
+            ov.start(MASTER.account_id, close_time=ntime())
+        try:
+            assert _wait(lambda: all(o.peer_count() == 1 for o in overlays), 15)
+            # node 0 is overloaded; node 1 must learn the remote fee
+            for _ in range(6):
+                tracks[0].raise_local_fee()
+            lf = tracks[0].load_factor
+            assert _wait(lambda: tracks[1].load_factor >= lf, 15), (
+                tracks[1].get_json()
+            )
+        finally:
+            for ov in overlays:
+                ov.stop()
+
+
+def _wait(pred, timeout):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return pred()

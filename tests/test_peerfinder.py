@@ -235,3 +235,20 @@ class TestAbuse:
             ),
             10,
         )
+
+
+class TestDialChurn:
+    def test_established_sessions_not_churned_by_redial_timer(self, seeded_net):
+        """r2 regression guard: the connect loop must never dial over (and
+        thereby displace) an established live session."""
+        overlays, ports = seeded_net
+        assert wait_until(lambda: all(ov.peer_count() == 3 for ov in overlays), 30)
+        # snapshot session object identities
+        def sessions(ov):
+            with ov._peers_lock:
+                return {pk: id(p) for pk, p in ov.peers.items()}
+
+        before = [sessions(ov) for ov in overlays]
+        time.sleep(5)  # several redial sweeps (sweep period 2s)
+        after = [sessions(ov) for ov in overlays]
+        assert before == after, "established sessions were churned"
