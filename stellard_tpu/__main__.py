@@ -30,6 +30,13 @@ def main(argv: list[str] | None = None) -> int:
                     help="re-drive a transaction dump through a fresh chain")
     ap.add_argument("--ledger", metavar="SEQ", type=int, default=None,
                     help="with --replay: the ledger to re-close")
+    ap.add_argument("--import_db", metavar="TYPE[:PATH]", default=None,
+                    help="migrate every node object from another NodeStore "
+                         "backend into the configured one (reference: "
+                         "--import, Application.cpp:320-323,1403)")
+    ap.add_argument("--sustain", action="store_true",
+                    help="supervisor mode: restart the server if it "
+                         "crashes (reference: DoSustain, Main.cpp:261-275)")
     ap.add_argument("--replay", action="store_true",
                     help="replay stored ledger --ledger and verify its hash")
     ap.add_argument("command", nargs="*", help="RPC client command")
@@ -72,6 +79,9 @@ def main(argv: list[str] | None = None) -> int:
             print(json.dumps(json.load(resp), indent=2))
         return 0
 
+    if args.import_db:
+        return _import_nodestore(args.import_db, cfg)
+
     if (
         args.dump_ledger is not None
         or args.dump_transactions
@@ -79,6 +89,9 @@ def main(argv: list[str] | None = None) -> int:
         or args.replay
     ):
         return _offline_tools(args, cfg)
+
+    if args.sustain:
+        return _sustain(argv)
 
     from .node.node import Node
 
@@ -101,6 +114,59 @@ def main(argv: list[str] | None = None) -> int:
     finally:
         node.stop()
     return 0
+
+
+def _import_nodestore(spec: str, cfg) -> int:
+    """Copy every object from another backend into the configured main
+    store (reference: --import NodeStore migration)."""
+    from .nodestore.core import make_database
+
+    src_type, _, src_path = spec.partition(":")
+    source = make_database(
+        type=src_type, **({"path": src_path} if src_path else {}),
+        async_writes=False,
+    )
+    dest = make_database(
+        type=cfg.node_db_type,
+        **({"path": cfg.node_db_path} if cfg.node_db_path else {}),
+        async_writes=False,
+    )
+    n = 0
+    chunk = []
+    for obj in source.backend.iterate():
+        chunk.append(obj)
+        n += 1
+        if len(chunk) >= 4096:
+            dest.backend.store_batch(chunk)  # one commit per chunk
+            chunk = []
+    if chunk:
+        dest.backend.store_batch(chunk)
+    dest.close()
+    source.close()
+    print(f"imported {n} node objects from {spec} "
+          f"into {cfg.node_db_type}", file=sys.stderr)
+    return 0
+
+
+def _sustain(argv: list[str] | None) -> int:
+    """Supervisor loop: re-exec the server child until it exits cleanly
+    (reference: DoSustain — the parent process restarts a crashed child).
+    """
+    import subprocess
+    import time as _time
+
+    child_args = [a for a in (argv if argv is not None else sys.argv[1:])
+                  if a != "--sustain"]
+    cmd = [sys.executable, "-m", "stellard_tpu"] + child_args
+    restarts = 0
+    while True:
+        rc = subprocess.call(cmd)
+        if rc == 0:
+            return 0
+        restarts += 1
+        print(f"sustain: child exited rc={rc}; restart #{restarts}",
+              file=sys.stderr)
+        _time.sleep(min(30, restarts))
 
 
 def _offline_tools(args, cfg) -> int:

@@ -67,6 +67,8 @@ class Config:
     # -- network identity / trust ([validation_seed], [validators]) --------
     validation_seed: str = ""  # base58 seed; empty = not a validator
     validators: list[str] = field(default_factory=list)  # node public keys
+    validators_file: str = ""  # local validators.txt ([validators_file])
+    validators_site: str = ""  # hosted stellar.txt URL ([validators_site])
     validation_quorum: int = 1  # reference Config.h:406 default sizing
     consensus_threshold: int = 0  # Stellar addition (Config.h:407)
 
@@ -126,6 +128,8 @@ class Config:
 
         cfg.validation_seed = one("validation_seed", cfg.validation_seed)
         cfg.sntp_servers = [line.split()[0] for line in s.get("sntp_servers", [])]
+        cfg.validators_file = one("validators_file", cfg.validators_file)
+        cfg.validators_site = one("validators_site", cfg.validators_site)
         cfg.insight = one("insight", cfg.insight)
         cfg.validators = [
             line.split()[0] for line in s.get("validators", [])

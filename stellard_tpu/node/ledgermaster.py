@@ -61,7 +61,14 @@ class LedgerMaster:
         self.closed: Optional[Ledger] = None  # last closed (LCL)
         self.validated: Optional[Ledger] = None
         self.ledger_history: dict[int, bytes] = {}  # seq -> hash
-        self.ledgers_by_hash: dict[bytes, Ledger] = {}  # closed-ledger cache
+        # closed-ledger cache: bounded + aged so a long-running node's
+        # memory does not grow with chain length (reference: LedgerHistory
+        # TaggedCache, tuned at Application.cpp:723-727)
+        from ..utils.taggedcache import TaggedCache
+
+        self.ledgers_by_hash: TaggedCache = TaggedCache(
+            "ledger_history", target_size=512, expiration_s=600.0
+        )
         # txns held for a future ledger (reference: mHeldTransactions)
         self.held: dict[tuple[bytes, int], SerializedTransaction] = {}
         self.min_validations = 0  # quorum for checkAccept
@@ -93,7 +100,7 @@ class LedgerMaster:
         self.closed = ledger
         h = ledger.hash()
         self.ledger_history[ledger.seq] = h
-        self.ledgers_by_hash[h] = ledger
+        self.ledgers_by_hash.put(h, ledger)
 
     # -- accessors --------------------------------------------------------
 

@@ -41,8 +41,10 @@ class LoadFeeTrack:
     def __init__(self):
         self._lock = threading.Lock()
         self._local = NORMAL_FEE
-        self._remote = NORMAL_FEE
-        self._remote_expiry = 0.0
+        # source -> (fee, expiry): per-reporter so one healthy cluster
+        # member cannot overwrite another's elevated report (reference
+        # keeps per-node ClusterNodeStatus entries)
+        self._remote: dict[bytes, tuple[int, float]] = {}
         self.raise_count = 0
 
     def raise_local_fee(self) -> None:
@@ -55,13 +57,15 @@ class LoadFeeTrack:
             if self._local > NORMAL_FEE:
                 self._local = max(NORMAL_FEE, self._local - max(1, self._local // 4))
 
-    def set_remote_fee(self, fee: int) -> None:
-        """From cluster/peer load reports (sfLoadFee in validations).
-        Reports expire: a peer that stops reporting (or whose load
-        subsides) must not ratchet our fee up forever."""
+    def set_remote_fee(self, fee: int, source: bytes = b"") -> None:
+        """From cluster/peer load reports (sfLoadFee in validations),
+        keyed by reporter. Reports expire: a peer that stops reporting
+        (or whose load subsides) must not ratchet our fee up forever."""
         with self._lock:
-            self._remote = max(NORMAL_FEE, min(MAX_FEE, int(fee)))
-            self._remote_expiry = time.monotonic() + self.REMOTE_TTL
+            self._remote[source] = (
+                max(NORMAL_FEE, min(MAX_FEE, int(fee))),
+                time.monotonic() + self.REMOTE_TTL,
+            )
 
     @property
     def local_fee(self) -> int:
@@ -71,15 +75,21 @@ class LoadFeeTrack:
         with self._lock:
             return self._local
 
+    def _live_remote(self) -> int:
+        now = time.monotonic()
+        best = NORMAL_FEE
+        for source in list(self._remote):
+            fee, expiry = self._remote[source]
+            if now >= expiry:
+                del self._remote[source]
+            else:
+                best = max(best, fee)
+        return best
+
     @property
     def load_factor(self) -> int:
         with self._lock:
-            remote = (
-                self._remote
-                if time.monotonic() < self._remote_expiry
-                else NORMAL_FEE
-            )
-            return max(self._local, remote)
+            return max(self._local, self._live_remote())
 
     @property
     def is_loaded(self) -> bool:
@@ -87,11 +97,12 @@ class LoadFeeTrack:
 
     def get_json(self) -> dict:
         with self._lock:
+            remote = self._live_remote()
             return {
-                "load_factor": max(self._local, self._remote),
+                "load_factor": max(self._local, remote),
                 "load_base": NORMAL_FEE,
                 "local_fee": self._local,
-                "remote_fee": self._remote,
+                "remote_fee": remote,
             }
 
 

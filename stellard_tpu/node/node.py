@@ -83,12 +83,40 @@ class Node:
 
         unl_path = cfg.database_path + ".unl" if cfg.database_path else None
         self.unl = UniqueNodeList(unl_path)
-        if cfg.validators:
+        if cfg.validators or cfg.validators_file or cfg.validators_site:
             from ..protocol.keys import decode_node_public
+            from .sitefiles import fetch_site_validators, load_validators_file
 
-            self.unl.load_from(
-                (decode_node_public(v) for v in cfg.validators), "config"
-            )
+            def add_keys(pairs, default_comment):
+                for key, comment in pairs:
+                    try:
+                        self.unl.add(
+                            decode_node_public(key), comment or default_comment
+                        )
+                    except (ValueError, KeyError):
+                        continue  # malformed key in an external source
+
+            add_keys(((v, "") for v in cfg.validators), "config")
+            if cfg.validators_file:
+                try:
+                    add_keys(load_validators_file(cfg.validators_file), "file")
+                except OSError:
+                    pass  # a missing file must not kill the node
+            if cfg.validators_site:
+                # fetched on a background thread: startup must not block
+                # on a remote site, and NO exception class from urllib
+                # may kill the node (reference fetches sites async too)
+                def fetch_site():
+                    try:
+                        add_keys(
+                            fetch_site_validators(cfg.validators_site), "site"
+                        )
+                    except Exception:  # noqa: BLE001 — log-and-skip source
+                        pass
+
+                threading.Thread(
+                    target=fetch_site, name="validators-site", daemon=True
+                ).start()
         self.pow_factory = PowFactory()
         self.ledger_cleaner = LedgerCleaner(self)
 

@@ -469,7 +469,7 @@ class TcpOverlay(ConsensusAdapter):
                 and msg.node_public in self.cluster
                 and msg.node_public == peer.node_public
             ):
-                self.fee_track.set_remote_fee(msg.load_fee)
+                self.fee_track.set_remote_fee(msg.load_fee, source=msg.node_public)
         elif isinstance(msg, Endpoints):
             accepted = self.peerfinder.on_endpoints(
                 msg.endpoints, sender=peer.remote

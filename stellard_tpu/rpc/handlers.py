@@ -107,19 +107,35 @@ def _parse_account(params: dict, key: str = "account") -> bytes:
         raise RPCError("actMalformed") from exc
 
 
+def _load_historical(ctx: Context, ledger_hash: bytes) -> Optional[Ledger]:
+    """In-memory miss -> rebuild from the NodeStore (the history cache is
+    bounded/aged, but persisted ledgers stay queryable forever)."""
+    try:
+        return Ledger.load(
+            ctx.node.nodestore, ledger_hash, hash_batch=ctx.node.hasher
+        )
+    except (KeyError, ValueError, AttributeError):
+        return None
+
+
 def _select_ledger(ctx: Context) -> Ledger:
     """reference: RPC::lookupLedger (impl/LookupLedger.cpp) — by
     ledger_hash, numeric ledger_index, or current|closed|validated."""
     lm = ctx.node.ledger_master
     p = ctx.params
     if p.get("ledger_hash"):
-        led = lm.get_ledger_by_hash(bytes.fromhex(p["ledger_hash"]))
+        h = bytes.fromhex(p["ledger_hash"])
+        led = lm.get_ledger_by_hash(h) or _load_historical(ctx, h)
         if led is None:
             raise RPCError("lgrNotFound")
         return led
     idx = p.get("ledger_index", "current")
     if isinstance(idx, int) or (isinstance(idx, str) and idx.isdigit()):
         led = lm.get_ledger_by_seq(int(idx))
+        if led is None:
+            hdr = ctx.node.txdb.get_ledger_header(seq=int(idx))
+            if hdr is not None:
+                led = _load_historical(ctx, hdr["hash"])
         if led is None:
             raise RPCError("lgrNotFound")
         return led

@@ -277,7 +277,7 @@ class TestOverlayHardening:
             # the node itself still ticks (timer thread not blocked)
             seq0 = victim.node.lm.closed_ledger().seq
             assert _wait(
-                lambda: victim.node.lm.closed_ledger().seq >= seq0, 5
+                lambda: victim.node.lm.closed_ledger().seq > seq0, 10
             )
         finally:
             for ov in overlays:
