@@ -122,6 +122,15 @@ def _import_nodestore(spec: str, cfg) -> int:
     from .nodestore.core import make_database
 
     src_type, _, src_path = spec.partition(":")
+    if cfg.node_db_type in ("memory", "null"):
+        print("import: destination [node_db] is non-persistent "
+              f"({cfg.node_db_type!r}) — configure a real backend",
+              file=sys.stderr)
+        return 1
+    if src_type in ("sqlite", "cpplog") and not src_path:
+        print(f"import: source {src_type!r} needs a path "
+              "(TYPE:PATH)", file=sys.stderr)
+        return 1
     source = make_database(
         type=src_type, **({"path": src_path} if src_path else {}),
         async_writes=False,

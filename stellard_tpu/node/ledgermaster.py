@@ -69,6 +69,9 @@ class LedgerMaster:
         self.ledgers_by_hash: TaggedCache = TaggedCache(
             "ledger_history", target_size=512, expiration_s=600.0
         )
+        # optional loader for cache misses (Node wires the NodeStore in;
+        # overlay validators are memory-resident and leave it unset)
+        self.fetch_fallback: Optional[Callable[[bytes], Optional[Ledger]]] = None
         # txns held for a future ledger (reference: mHeldTransactions)
         self.held: dict[tuple[bytes, int], SerializedTransaction] = {}
         self.min_validations = 0  # quorum for checkAccept
@@ -100,6 +103,9 @@ class LedgerMaster:
         self.closed = ledger
         h = ledger.hash()
         self.ledger_history[ledger.seq] = h
+        if len(self.ledger_history) > 8192:
+            # bound the seq index too; full history stays in txdb/nodestore
+            del self.ledger_history[min(self.ledger_history)]
         self.ledgers_by_hash.put(h, ledger)
 
     # -- accessors --------------------------------------------------------
@@ -121,7 +127,12 @@ class LedgerMaster:
 
     def get_ledger_by_hash(self, h: bytes) -> Optional[Ledger]:
         with self._lock:
-            return self.ledgers_by_hash.get(h)
+            led = self.ledgers_by_hash.get(h)
+            if led is None and self.fetch_fallback is not None:
+                led = self.fetch_fallback(h)
+                if led is not None:
+                    self.ledgers_by_hash.put(h, led)
+            return led
 
     # -- held transactions (reference: addHeldTransaction) ----------------
 

@@ -94,9 +94,18 @@ class Node:
                             decode_node_public(key), comment or default_comment
                         )
                     except (ValueError, KeyError):
-                        continue  # malformed key in an external source
+                        import logging
 
-            add_keys(((v, "") for v in cfg.validators), "config")
+                        logging.getLogger("stellard.unl").warning(
+                            "skipping malformed validator key from %s: %r",
+                            default_comment, key,
+                        )
+
+            # the INLINE config is operator-written: a malformed key there
+            # is a misconfiguration that must fail loudly, not shrink the
+            # trusted set silently
+            for v in cfg.validators:
+                self.unl.add(decode_node_public(v), "config")
             if cfg.validators_file:
                 try:
                     add_keys(load_validators_file(cfg.validators_file), "file")
@@ -144,6 +153,16 @@ class Node:
         self.ledger_master = LedgerMaster(
             hash_batch=self.hasher
         )
+
+        def _fetch_fallback(h: bytes):
+            # history-cache miss -> rebuild from the NodeStore (consensus
+            # promotion and peers must see everything persisted)
+            try:
+                return Ledger.load(self.nodestore, h, hash_batch=self.hasher)
+            except (KeyError, ValueError):
+                return None
+
+        self.ledger_master.fetch_fallback = _fetch_fallback
         self.ops = NetworkOPs(
             self.ledger_master,
             self.job_queue,

@@ -53,8 +53,22 @@ def fetch_site_validators(
     url: str, timeout: float = 5.0
 ) -> list[tuple[str, str]]:
     """Fetch and parse a hosted stellar.txt (reference: SiteFiles::Manager
-    + HTTPClient). Raises OSError on network failure; callers decide
-    whether a source being down is fatal (the reference logs and moves on).
+    + HTTPClient over HTTPS). Raises OSError on network failure; callers
+    decide whether a source being down is fatal (the reference logs and
+    moves on).
+
+    The validator list is a TRUST ROOT: plain http is refused except to
+    loopback (test harnesses), or an on-path attacker could inject
+    validator keys.
     """
+    from urllib.parse import urlparse
+
+    parsed = urlparse(url)
+    if parsed.scheme != "https" and parsed.hostname not in (
+        "localhost", "127.0.0.1", "::1",
+    ):
+        raise ValueError(
+            f"validators_site must be https (got {parsed.scheme!r})"
+        )
     with urllib.request.urlopen(url, timeout=timeout) as resp:
         return parse_validators_text(resp.read().decode("utf-8", "replace"))

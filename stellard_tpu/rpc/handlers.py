@@ -109,13 +109,17 @@ def _parse_account(params: dict, key: str = "account") -> bytes:
 
 def _load_historical(ctx: Context, ledger_hash: bytes) -> Optional[Ledger]:
     """In-memory miss -> rebuild from the NodeStore (the history cache is
-    bounded/aged, but persisted ledgers stay queryable forever)."""
+    bounded/aged, but persisted ledgers stay queryable forever). The
+    rebuilt ledger re-enters the cache so a polling client only pays the
+    reconstruction once."""
     try:
-        return Ledger.load(
+        led = Ledger.load(
             ctx.node.nodestore, ledger_hash, hash_batch=ctx.node.hasher
         )
     except (KeyError, ValueError, AttributeError):
         return None
+    ctx.node.ledger_master.ledgers_by_hash.put(ledger_hash, led)
+    return led
 
 
 def _select_ledger(ctx: Context) -> Ledger:

@@ -47,10 +47,14 @@ class TaggedCache(Generic[K, V]):
                 self.misses += 1
                 return None
             at, value = entry
-            if self._clock() - at > self.expiration_s:
+            now = self._clock()
+            if now - at > self.expiration_s:
                 del self._data[key]
                 self.misses += 1
                 return None
+            # age by LAST ACCESS (reference TaggedCache): continuously
+            # used entries never expire
+            self._data[key] = (now, value)
             self._data.move_to_end(key)
             self.hits += 1
             return value
