@@ -173,8 +173,11 @@ class Node:
         )
         self.ops.on_ledger_closed.append(self._persist_closed_ledger)
 
-        # node identity (reference: LocalCredentials; validators sign with
-        # [validation_seed])
+        # node identity (reference: LocalCredentials + wallet.db): the
+        # node key is generated ONCE and persisted beside the databases,
+        # so the overlay identity survives restarts; validators sign with
+        # [validation_seed] when configured
+        self.node_keys = self._load_or_create_identity()
         self.validation_keys: Optional[KeyPair] = None
         if cfg.validation_seed:
             self.validation_keys = KeyPair.from_seed(decode_seed(cfg.validation_seed))
@@ -187,6 +190,36 @@ class Node:
         self.http_server = None
         self.ws_server = None
         self.subs = None
+
+    def _load_or_create_identity(self) -> KeyPair:
+        """reference: LocalCredentials::start (wallet.db node seed) — a
+        stable per-node keypair, created on first start and persisted."""
+        import json
+        import os
+
+        path = (
+            self.config.database_path + ".wallet"
+            if self.config.database_path
+            else None
+        )
+        if path and os.path.exists(path):
+            try:
+                with open(path) as fh:
+                    rec = json.loads(fh.read())
+                return KeyPair.from_seed(bytes.fromhex(rec["node_seed"]))
+            except (OSError, ValueError, KeyError):
+                pass  # unreadable wallet: regenerate below
+        kp = KeyPair.random()
+        if path:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                fh.write(json.dumps({
+                    "node_seed": kp.seed.hex(),
+                    "node_public": kp.human_node_public,
+                }))
+            os.replace(tmp, path)
+            os.chmod(path, 0o600)
+        return kp
 
     # -- lifecycle --------------------------------------------------------
 

@@ -175,3 +175,32 @@ class TestValidatorSources:
         node = Node(cfg).setup()
         node.verify_plane.stop()
         node.job_queue.stop()
+
+
+class TestLocalCredentials:
+    def test_node_identity_persists_across_restarts(self, tmp_path):
+        from stellard_tpu.node.node import Node
+
+        cfg = Config(standalone=True, signature_backend="cpu",
+                     database_path=str(tmp_path / "tx.db"))
+        n1 = Node(cfg).setup()
+        pub1 = n1.node_keys.public
+        n1.verify_plane.stop()
+        n1.job_queue.stop()
+        n2 = Node(cfg).setup()
+        try:
+            assert n2.node_keys.public == pub1  # wallet.db role
+        finally:
+            n2.verify_plane.stop()
+            n2.job_queue.stop()
+
+    def test_ephemeral_without_database_path(self):
+        from stellard_tpu.node.node import Node
+
+        cfg = Config(standalone=True, signature_backend="cpu")
+        n = Node(cfg).setup()
+        try:
+            assert n.node_keys is not None
+        finally:
+            n.verify_plane.stop()
+            n.job_queue.stop()
