@@ -68,6 +68,9 @@ def _init_device_backend() -> str:
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    from stellard_tpu.utils.xlacache import enable_compilation_cache
+
+    enable_compilation_cache()
     return jax.devices()[0].platform
 
 

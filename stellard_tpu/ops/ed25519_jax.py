@@ -9,16 +9,20 @@ with one data-parallel kernel over the whole batch:
     R' = [S]B + [h](-A),  accept iff encode(R') == R  and  S < l
 
 Design notes (TPU-first):
-- Points are [..., 4, 20] int32 (X, Y, Z, T extended coords over the
-  13-bit-limb field of fe25519). The batch dim feeds the vector lanes.
+- Field elements are LIMB-MAJOR [20, B] int32 (13-bit limbs, fe25519):
+  the batch axis is minor, so it maps onto the 128-wide TPU lane axis
+  and every elementwise limb op runs at full vector width. The public
+  kernel signature stays batch-major ([B, ...]); inputs are transposed
+  once on entry, the verdict once on exit.
+- Points are [4, 20, B] int32 (X, Y, Z, T extended coords).
 - The twisted-Edwards addition law is COMPLETE for ed25519 (a = -1 is a
   square mod p, d is a non-square), so one branch-free formula covers
   identity/doubling/adversarial small-order inputs — exactly what a
   lock-step SIMD batch needs.
 - [S]B uses a 64-window fixed-base comb (no doublings; table host-built
-  once in precomputed "niels" form (y+x, y-x, 2dxy), so each comb step
-  is a 7M mixed addition). Table entries are selected with a
-  [B,16] x [16,60] one-hot f32 matmul — a dense MXU op; per-lane gathers
+  once in precomputed "niels" form (y+x, y-x, 2dxy)), so each comb step
+  is a 7M mixed addition. Table entries are selected with a
+  [60,16] x [16,B] one-hot f32 matmul — a dense MXU op; per-lane gathers
   serialize on TPU.
 - [h](-A) uses SIGNED 4-bit windows (digits in [-8, 7], recoded
   host-side): the per-element table holds only 9 cached multiples
@@ -73,16 +77,16 @@ NWINDOWS = 64  # ceil(256/4); scalars are < l < 2^253
 # --------------------------------------------------------------------------
 # point helpers
 #
-# extended point: [..., 4, 20] stack of (X, Y, Z, T), x = X/Z, y = Y/Z,
+# extended point: [4, 20, *batch] stack of (X, Y, Z, T), x = X/Z, y = Y/Z,
 #                 T = XY/Z
-# cached point:   [..., 4, 20] stack of (Y+X, Y-X, 2d*T, 2Z) — the
+# cached point:   [4, 20, *batch] stack of (Y+X, Y-X, 2d*T, 2Z) — the
 #                 precomputed operand form of add-2008-hwcd
-# niels point:    [..., 3, 20] stack of (y+x, y-x, 2d*x*y) — cached with
-#                 Z = 1, so the 2Z slot is the constant 2
+# niels point:    [3, 20, *batch] stack of (y+x, y-x, 2d*x*y) — cached
+#                 with Z = 1, so the 2Z slot is the constant 2
 
 
 def pt_stack(x, y, z, t):
-    return jnp.stack([x, y, z, t], axis=-2)
+    return jnp.stack([x, y, z, t], axis=0)
 
 
 def pt_identity(batch_shape=()):
@@ -96,22 +100,17 @@ def pt_identity(batch_shape=()):
 
 def pt_to_cached(p):
     """extended -> cached: 1M + 3 add."""
-    x, y, z, t = p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
+    x, y, z, t = p[0], p[1], p[2], p[3]
     return jnp.stack(
         [fe_add(y, x), fe_sub(y, x), fe_mul(t, fe_const(D2)), fe_add(z, z)],
-        axis=-2,
+        axis=0,
     )
 
 
 def pt_add_cached(p, q_cached):
     """Complete unified addition, q in cached form: 8M."""
-    x1, y1, z1, t1 = p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
-    ypx2, ymx2, t2d2, z22 = (
-        q_cached[..., 0, :],
-        q_cached[..., 1, :],
-        q_cached[..., 2, :],
-        q_cached[..., 3, :],
-    )
+    x1, y1, z1, t1 = p[0], p[1], p[2], p[3]
+    ypx2, ymx2, t2d2, z22 = q_cached[0], q_cached[1], q_cached[2], q_cached[3]
     a = fe_mul(fe_sub(y1, x1), ymx2)
     b = fe_mul(fe_add(y1, x1), ypx2)
     c = fe_mul(t1, t2d2)
@@ -125,8 +124,8 @@ def pt_add_cached(p, q_cached):
 
 def pt_add_mixed(p, q_niels):
     """Complete unified addition, q in niels form (Z2 = 1): 7M."""
-    x1, y1, z1, t1 = p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
-    ypx2, ymx2, t2d2 = q_niels[..., 0, :], q_niels[..., 1, :], q_niels[..., 2, :]
+    x1, y1, z1, t1 = p[0], p[1], p[2], p[3]
+    ypx2, ymx2, t2d2 = q_niels[0], q_niels[1], q_niels[2]
     a = fe_mul(fe_sub(y1, x1), ymx2)
     b = fe_mul(fe_add(y1, x1), ypx2)
     c = fe_mul(t1, t2d2)
@@ -145,7 +144,7 @@ def pt_add(p, q):
 
 def pt_double(p):
     """Dedicated doubling (dbl-2008-hwcd, a=-1): 4S + 4M."""
-    x1, y1, z1 = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    x1, y1, z1 = p[0], p[1], p[2]
     a = fe_square(x1)
     b = fe_square(y1)
     zz = fe_square(z1)
@@ -158,19 +157,17 @@ def pt_double(p):
 
 
 def pt_neg(p):
-    return pt_stack(
-        fe_neg(p[..., 0, :]), p[..., 1, :], p[..., 2, :], fe_neg(p[..., 3, :])
-    )
+    return pt_stack(fe_neg(p[0]), p[1], p[2], fe_neg(p[3]))
 
 
 def pt_encode_words(p):
-    """-> [..., 8] uint32 LE words of the canonical compressed encoding."""
-    zi = fe_invert(p[..., 2, :])
-    x = fe_reduce_full(fe_mul(p[..., 0, :], zi))
-    y = fe_reduce_full(fe_mul(p[..., 1, :], zi))
+    """-> [8, *batch] uint32 LE words of the canonical compressed encoding."""
+    zi = fe_invert(p[2])
+    x = fe_reduce_full(fe_mul(p[0], zi))
+    y = fe_reduce_full(fe_mul(p[1], zi))
     words = limbs_to_words_le(y)
-    sign = (x[..., 0] & 1).astype(jnp.uint32)
-    return words.at[..., 7].set(words[..., 7] | (sign << 31))
+    sign = (x[0] & 1).astype(jnp.uint32)
+    return words.at[7].set(words[7] | (sign << 31))
 
 
 # --------------------------------------------------------------------------
@@ -178,9 +175,9 @@ def pt_encode_words(p):
 
 
 def pt_decompress(words_u32):
-    """[..., 8] u32 LE encoding -> (point [..., 4, 20], valid [...])."""
+    """[8, *batch] u32 LE encoding -> (point [4, 20, *batch], valid [*batch])."""
     y = limbs_from_words_le(words_u32, mask_high=True)
-    sign = (words_u32[..., 7] >> 31).astype(jnp.int32)
+    sign = (words_u32[7] >> 31).astype(jnp.int32)
     y2 = fe_square(y)
     u = fe_sub(y2, fe_const(1))
     v = fe_add(fe_mul(y2, fe_const(D)), fe_const(1))
@@ -196,7 +193,7 @@ def pt_decompress(words_u32):
     valid = valid & ~(x_zero & (sign == 1))
     flip = fe_is_odd(x) != (sign == 1)
     x = fe_select(flip, fe_neg(x), x)
-    point = pt_stack(x, y, fe_const(1, x.shape[:-1]), fe_mul(x, y))
+    point = pt_stack(x, y, fe_const(1, x.shape[1:]), fe_mul(x, y))
     return point, valid
 
 
@@ -205,11 +202,12 @@ def pt_decompress(words_u32):
 
 
 def _build_cached_table(p):
-    """p extended [..., 4, 20] -> [..., 9, 4, 20] cached multiples 0..8P.
+    """p extended [4, 20, *batch] -> [9, 4, 20, *batch] cached multiples
+    0..8P.
 
     4 doublings + 3 cached adds + 8 cached conversions; the doubling-
     based ladder keeps the dependency chain at 4 instead of 14."""
-    batch = p.shape[:-2]
+    batch = p.shape[2:]
     ident = jnp.stack(
         [
             fe_const(1, batch),
@@ -217,7 +215,7 @@ def _build_cached_table(p):
             fe_const(0, batch),
             fe_const(2, batch),
         ],
-        axis=-2,
+        axis=0,
     )
     m1 = p
     c1 = pt_to_cached(m1)
@@ -232,24 +230,21 @@ def _build_cached_table(p):
     m7 = pt_add_cached(m6, c1)
     m8 = pt_double(m4)
     cached = [ident, c1, c2, c3, c4] + [pt_to_cached(m) for m in (m5, m6, m7, m8)]
-    return jnp.stack(cached, axis=-3)
+    return jnp.stack(cached, axis=0)
 
 
 def _select_cached(tbl, digit):
-    """tbl [..., 9, 4, 20], digit [...] int32 in [-8, 7] -> cached entry.
+    """tbl [9, 4, 20, *batch], digit [*batch] int32 in [-8, 7] -> cached
+    entry [4, 20, *batch].
 
     |digit| selects by one-hot contraction (no gathers); a negative digit
     swaps (Y+X)/(Y-X) and negates 2dT — point negation in cached form."""
     mag = jnp.abs(digit)
     neg = digit < 0
-    onehot = (mag[..., None] == jnp.arange(9, dtype=mag.dtype)).astype(jnp.int32)
-    entry = jnp.sum(onehot[..., :, None, None] * tbl, axis=-3)  # [..., 4, 20]
-    ypx, ymx, t2d, z2 = (
-        entry[..., 0, :],
-        entry[..., 1, :],
-        entry[..., 2, :],
-        entry[..., 3, :],
-    )
+    sel = jnp.arange(9, dtype=mag.dtype).reshape((9,) + (1,) * mag.ndim)
+    onehot = (mag == sel).astype(jnp.int32)  # [9, *batch]
+    entry = jnp.sum(onehot[:, None, None] * tbl, axis=0)  # [4, 20, *batch]
+    ypx, ymx, t2d, z2 = entry[0], entry[1], entry[2], entry[3]
     return jnp.stack(
         [
             fe_select(neg, ymx, ypx),
@@ -257,7 +252,7 @@ def _select_cached(tbl, digit):
             fe_select(neg, fe_neg(t2d), t2d),
             z2,
         ],
-        axis=-2,
+        axis=0,
     )
 
 
@@ -268,9 +263,10 @@ _COMB_NP: np.ndarray | None = None
 
 
 def _comb_table_np() -> np.ndarray:
-    """[NWINDOWS, 16, 60] f32: row (j, w) = niels form (y+x, y-x, 2dxy)
-    of (w * 16^j) * B. f32 is exact for 13-bit limbs and routes the
-    one-hot selection through the MXU."""
+    """[NWINDOWS, 60, 16] f32: column (j, :, w) = niels form
+    (y+x, y-x, 2dxy) of (w * 16^j) * B, laid out limb-major so
+    table[j] @ onehot[16, B] lands directly in [60, B]. f32 is exact for
+    13-bit limbs and routes the one-hot selection through the MXU."""
     global _COMB_NP
     if _COMB_NP is None:
         out = np.zeros((NWINDOWS, 16, 3, NLIMB), np.int32)
@@ -287,14 +283,21 @@ def _comb_table_np() -> np.ndarray:
                 acc = ref.pt_add(acc, step)
             for _ in range(4):
                 step = ref.pt_double(step)
-        _COMB_NP = out.reshape(NWINDOWS, 16, 3 * NLIMB).astype(np.float32)
+        # [j, w, 3*20] -> [j, 3*20, w] so the in-loop matmul is [60,16]@[16,B]
+        _COMB_NP = (
+            out.reshape(NWINDOWS, 16, 3 * NLIMB)
+            .transpose(0, 2, 1)
+            .astype(np.float32)
+            .copy()
+        )
     return _COMB_NP
 
 
 def _batch_zero(ref_arr):
-    """[..., 1, 1] int32 zero carrying the batch 'varying' tag of ref_arr,
-    so fori_loop carries seeded from constants stay shard_map-compatible."""
-    return (ref_arr[..., :1] * 0)[..., None]
+    """[1, 1, B] int32 zero carrying the batch 'varying' tag of ref_arr
+    ([64, B]), so fori_loop carries seeded from constants stay
+    shard_map-compatible."""
+    return (ref_arr[:1] * 0)[None]
 
 
 # --------------------------------------------------------------------------
@@ -303,7 +306,8 @@ def _batch_zero(ref_arr):
 
 @jax.jit
 def verify_kernel(a_words, r_words, s_windows, h_digits, s_canonical):
-    """Batched core: all inputs leading dim B.
+    """Batched core: all inputs leading dim B (public layout; transposed
+    to the limb-major internal layout on entry).
 
     a_words: [B, 8] u32 public keys (LE words)
     r_words: [B, 8] u32 signature R
@@ -312,40 +316,47 @@ def verify_kernel(a_words, r_words, s_windows, h_digits, s_canonical):
     s_canonical: [B] bool (S < l, checked host-side)
     -> [B] bool
     """
-    a_point, a_valid = pt_decompress(a_words)
-    htbl = _build_cached_table(pt_neg(a_point))  # [B, 9, 4, 20]
-    comb = jnp.asarray(_comb_table_np())  # [64, 16, 60] f32
+    aw = jnp.transpose(a_words)  # [8, B]
+    rw = jnp.transpose(r_words)
+    sw = jnp.transpose(s_windows)  # [64, B]
+    hd = jnp.transpose(h_digits)
 
-    zero = _batch_zero(s_windows)
-    acc0_h = pt_identity(s_windows.shape[:-1]) + zero
-    acc0_s = pt_identity(s_windows.shape[:-1]) + zero
+    a_point, a_valid = pt_decompress(aw)
+    htbl = _build_cached_table(pt_neg(a_point))  # [9, 4, 20, B]
+    comb = jnp.asarray(_comb_table_np())  # [64, 60, 16] f32
+
+    zero = _batch_zero(sw)
+    acc0_h = pt_identity(sw.shape[1:]) + zero
+    acc0_s = pt_identity(sw.shape[1:]) + zero
 
     def body(j, accs):
         acc_h, acc_s = accs
         # [h](-A): MSB-first windows, 4 doublings + 1 cached add
         for _ in range(WINDOW):
             acc_h = pt_double(acc_h)
-        d = lax.dynamic_index_in_dim(h_digits, NWINDOWS - 1 - j, axis=-1, keepdims=False)
+        d = lax.dynamic_index_in_dim(hd, NWINDOWS - 1 - j, axis=0, keepdims=False)
         acc_h = pt_add_cached(acc_h, _select_cached(htbl, d))
         # [S]B: comb window j, one MXU one-hot matmul + mixed add
-        tj = lax.dynamic_index_in_dim(comb, j, axis=0, keepdims=False)  # [16, 60]
-        w = lax.dynamic_index_in_dim(s_windows, j, axis=-1, keepdims=False)
-        onehot = (w[..., None] == jnp.arange(16, dtype=w.dtype)).astype(jnp.float32)
+        tj = lax.dynamic_index_in_dim(comb, j, axis=0, keepdims=False)  # [60, 16]
+        w = lax.dynamic_index_in_dim(sw, j, axis=0, keepdims=False)  # [B]
+        onehot = (w[None, :] == jnp.arange(16, dtype=w.dtype)[:, None]).astype(
+            jnp.float32
+        )  # [16, B]
         # HIGHEST precision: default-precision TPU matmuls truncate f32
         # operands to bf16 (8-bit mantissa) in the MXU, which corrupts
         # 13-bit limbs; full-precision f32 is exact for these magnitudes
         entry = (
-            jnp.matmul(onehot, tj, precision=lax.Precision.HIGHEST)
+            jnp.matmul(tj, onehot, precision=lax.Precision.HIGHEST)
             .astype(jnp.int32)
-            .reshape(onehot.shape[:-1] + (3, NLIMB))
-        )
+            .reshape((3, NLIMB) + w.shape)
+        )  # [3, 20, B]
         acc_s = pt_add_mixed(acc_s, entry)
         return acc_h, acc_s
 
     acc_h, acc_s = lax.fori_loop(0, NWINDOWS, body, (acc0_h, acc0_s))
     rp = pt_add_cached(acc_s, pt_to_cached(acc_h))
     enc = pt_encode_words(rp)
-    eq = jnp.all(enc == r_words, axis=-1)
+    eq = jnp.all(enc == rw, axis=0)
     return eq & a_valid & s_canonical
 
 

@@ -1,8 +1,12 @@
 """Field arithmetic mod p = 2^255 - 19 for batched Ed25519 on TPU.
 
-Representation: 20 limbs x 13 bits, int32, little-endian limb order, shape
-[..., 20]. All ops are batched over leading axes — the batch dimension is
-the vector-lane parallelism; limb loops are tiny and static.
+Representation: 20 limbs x 13 bits, int32, little-endian limb order,
+LIMB-MAJOR layout: shape [20, *batch]. The batch axes TRAIL, so the batch
+dimension lands in the TPU minor (lane) axis — a [20, B] tensor tiles as
+(sublane=20, lane=B) and fills all 128 vector lanes for B >= 128, where
+the previous batch-major [B, 20] layout left 108 of 128 lanes idle (the
+limb axis, size 20, was minor). Measured on-chip this layout bound was
+the kernel's dominant cost, not FLOPs.
 
 Why 13-bit limbs in int32: schoolbook products are < 2^26.3 and a 20-term
 column sum stays < 2^31, so the whole multiply runs in native int32 lanes
@@ -75,9 +79,28 @@ _P_LIMBS = int_to_limbs_np(P)
 _BIAS_LIMBS = (38 * _P_LIMBS).astype(np.int32)
 
 
+def _col(limbs_1d, ndim: int) -> jnp.ndarray:
+    """[20] constant -> [20, 1, 1, ...] so it broadcasts against a
+    limb-major [20, *batch] tensor of rank `ndim`."""
+    arr = jnp.asarray(limbs_1d)
+    return arr.reshape((NLIMB,) + (1,) * (ndim - 1)) if ndim > 1 else arr
+
+
+def _align2(a: jnp.ndarray, b: jnp.ndarray):
+    """Limb-major rank alignment: numpy broadcasting prepends axes, but a
+    [20] constant must align with [20, *batch] by APPENDING singleton
+    batch axes. Every binary fe op routes through this."""
+    if a.ndim < b.ndim:
+        a = a.reshape(a.shape + (1,) * (b.ndim - a.ndim))
+    elif b.ndim < a.ndim:
+        b = b.reshape(b.shape + (1,) * (a.ndim - b.ndim))
+    return a, b
+
+
 def fe_const(x: int, batch_shape=()) -> jnp.ndarray:
     limbs = jnp.asarray(int_to_limbs_np(x % P))
-    return jnp.broadcast_to(limbs, tuple(batch_shape) + (NLIMB,))
+    out = limbs.reshape((NLIMB,) + (1,) * len(batch_shape))
+    return jnp.broadcast_to(out, (NLIMB,) + tuple(batch_shape))
 
 
 def _carry(c: jnp.ndarray, steps: int) -> jnp.ndarray:
@@ -86,9 +109,7 @@ def _carry(c: jnp.ndarray, steps: int) -> jnp.ndarray:
     limb's carry-out is never dropped."""
     for _ in range(steps):
         hi = c >> BITS
-        c = (c & MASK) + jnp.concatenate(
-            [jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1
-        )
+        c = (c & MASK) + jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
     return c
 
 
@@ -98,11 +119,9 @@ def _carry20_fold(c: jnp.ndarray) -> jnp.ndarray:
     Output limbs <= 8191 + 40 + FOLD*3 < BOUND."""
     hi = c >> BITS
     lo = c & MASK
-    shifted = jnp.concatenate([jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1)
+    shifted = jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
     out = lo + shifted
-    return jnp.concatenate(
-        [(out[..., 0] + FOLD * hi[..., 19])[..., None], out[..., 1:]], axis=-1
-    )
+    return jnp.concatenate([(out[0] + FOLD * hi[19])[None], out[1:]], axis=0)
 
 
 def _finish_mul(lo_cols: list, hi_cols: list) -> jnp.ndarray:
@@ -111,33 +130,30 @@ def _finish_mul(lo_cols: list, hi_cols: list) -> jnp.ndarray:
 
     lo_cols: 20 column sums, each < 2^31. hi_cols: 19 column sums."""
     z = jnp.zeros_like(lo_cols[0])
-    lo = jnp.stack(lo_cols, axis=-1)
+    lo = jnp.stack(lo_cols, axis=0)
     # carry hi first so FOLD*hi stays in int32; 2 spare limbs so no
     # carry-out is ever dropped
-    hi = jnp.stack(hi_cols + [z, z], axis=-1)
+    hi = jnp.stack(hi_cols + [z, z], axis=0)
     hi = _carry(hi, 2)  # limbs <= MASK + 33
-    c = lo + FOLD * hi[..., :20]  # < 2^31
+    c = lo + FOLD * hi[:20]  # < 2^31
     # hi[20] (weight 2^260 * 2^260) folds with FOLD^2; hi's own carrying
     # makes it tiny (<= 33)
-    c0 = c[..., 0] + (FOLD * FOLD) * hi[..., 20]
+    c0 = c[0] + (FOLD * FOLD) * hi[20]
     c = jnp.concatenate(
-        [c0[..., None], c[..., 1:], jnp.zeros(c.shape[:-1] + (2,), c.dtype)],
-        axis=-1,
+        [c0[None], c[1:], jnp.zeros((2,) + c.shape[1:], c.dtype)], axis=0
     )
     c = _carry(c, 2)  # limbs <= MASK + 33; c[20] <= MASK + 33, c[21] <= 33
-    h = c[..., 19] >> 8  # bits >= 2^255 in limb 19
-    c0 = c[..., 0] + 19 * h + FOLD * (c[..., 20] + (c[..., 21] << BITS))
-    c = jnp.concatenate(
-        [c0[..., None], c[..., 1:19], (c[..., 19] & 0xFF)[..., None]], axis=-1
-    )
+    h = c[19] >> 8  # bits >= 2^255 in limb 19
+    c0 = c[0] + 19 * h + FOLD * (c[20] + (c[21] << BITS))
+    c = jnp.concatenate([c0[None], c[1:19], (c[19] & 0xFF)[None]], axis=0)
     return _carry(c, 2)  # limbs <= MASK + 33 < BOUND
 
 
 def fe_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Schoolbook 20x20 product as 39 pure-SSA column sums + fold."""
-    a, b = jnp.broadcast_arrays(a, b)
-    ai = [a[..., i] for i in range(NLIMB)]
-    bi = [b[..., i] for i in range(NLIMB)]
+    a, b = jnp.broadcast_arrays(*_align2(a, b))
+    ai = [a[i] for i in range(NLIMB)]
+    bi = [b[i] for i in range(NLIMB)]
     lo_cols, hi_cols = [], []
     for k in range(2 * NLIMB - 1):
         terms = [ai[i] * bi[k - i] for i in range(max(0, k - 19), min(NLIMB, k + 1))]
@@ -150,7 +166,7 @@ def fe_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 def fe_square(a: jnp.ndarray) -> jnp.ndarray:
     """Symmetric schoolbook square: 210 lane products (vs 400)."""
-    ai = [a[..., i] for i in range(NLIMB)]
+    ai = [a[i] for i in range(NLIMB)]
     lo_cols, hi_cols = [], []
     for k in range(2 * NLIMB - 1):
         i = max(0, k - 19)
@@ -174,12 +190,15 @@ def fe_square(a: jnp.ndarray) -> jnp.ndarray:
 
 
 def fe_add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    a, b = _align2(a, b)
     c = a + b  # limbs <= 2*BOUND < 2^14.3
     return _carry20_fold(c)
 
 
 def fe_sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    c = a + jnp.asarray(_BIAS_LIMBS) - b  # limb-wise >= 0; value = a-b+38p
+    a, b = _align2(a, b)
+    ndim = max(a.ndim, b.ndim)
+    c = a + _col(_BIAS_LIMBS, ndim) - b  # limb-wise >= 0; value = a-b+38p
     return _carry20_fold(c)
 
 
@@ -193,23 +212,22 @@ def fe_reduce_full(a: jnp.ndarray) -> jnp.ndarray:
     Folding limb 19's bits >= 2^255 FIRST (2^255 ≡ 19) brings the value
     under 2p before any carry sweep, so no 2^260 carry-out ever exists
     to drop; the conditional subtract then handles the last excess."""
-    h = a[..., 19] >> 8
+    h = a[19] >> 8
     c = jnp.concatenate(
-        [(a[..., 0] + 19 * h)[..., None], a[..., 1:19], (a[..., 19] & 0xFF)[..., None]],
-        axis=-1,
+        [(a[0] + 19 * h)[None], a[1:19], (a[19] & 0xFF)[None]], axis=0
     )
     c = _carry(c, NLIMB + 1)
     # limbs < 2^13 exactly, value < 2^255 + eps; subtract p once if >= p
     ge = (
-        (c[..., 19] >= 0x100)
+        (c[19] >= 0x100)
         | (
-            (c[..., 19] == 0xFF)
-            & jnp.all(c[..., 1:19] == MASK, axis=-1)
-            & (c[..., 0] >= MASK - 18)
+            (c[19] == 0xFF)
+            & jnp.all(c[1:19] == MASK, axis=0)
+            & (c[0] >= MASK - 18)
         )
     )
-    p_limbs = jnp.asarray(_P_LIMBS)
-    c = c - jnp.where(ge[..., None], p_limbs, jnp.zeros_like(p_limbs))
+    p_col = _col(_P_LIMBS, c.ndim)
+    c = c - jnp.where(ge, p_col, jnp.zeros_like(p_col))
     return _carry(c, NLIMB + 1)
 
 
@@ -264,30 +282,32 @@ def fe_pow(a: jnp.ndarray, e: int) -> jnp.ndarray:
 
     def body(i, r):
         r = fe_square(r)
-        return jnp.where(bits_arr[i][..., None] == 1, fe_mul(r, a), r)
+        return jnp.where(bits_arr[i] == 1, fe_mul(r, a), r)
 
     return lax.fori_loop(1, len(bits), body, a)
 
 
 def fe_is_zero(a: jnp.ndarray) -> jnp.ndarray:
-    return jnp.all(fe_reduce_full(a) == 0, axis=-1)
+    return jnp.all(fe_reduce_full(a) == 0, axis=0)
 
 
 def fe_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return jnp.all(fe_reduce_full(a) == fe_reduce_full(b), axis=-1)
+    ra, rb = _align2(fe_reduce_full(a), fe_reduce_full(b))
+    return jnp.all(ra == rb, axis=0)
 
 
 def fe_is_odd(a: jnp.ndarray) -> jnp.ndarray:
-    return (fe_reduce_full(a)[..., 0] & 1) == 1
+    return (fe_reduce_full(a)[0] & 1) == 1
 
 
 def fe_select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """a where cond else b; cond is [...] bool."""
-    return jnp.where(cond[..., None], a, b)
+    """a where cond else b; cond is [*batch], a/b are [20, *batch] —
+    trailing-axis broadcasting aligns cond with the batch axes."""
+    return jnp.where(cond, *_align2(a, b))
 
 
 def limbs_from_words_le(words_u32: jnp.ndarray, mask_high: bool = True) -> jnp.ndarray:
-    """[..., 8] uint32 little-endian words -> [..., 20] int32 limbs.
+    """[8, *batch] uint32 little-endian words -> [20, *batch] int32 limbs.
 
     With mask_high, bit 255 (the point-compression sign bit) is dropped.
     """
@@ -296,31 +316,31 @@ def limbs_from_words_le(words_u32: jnp.ndarray, mask_high: bool = True) -> jnp.n
     for k in range(NLIMB):
         bit = BITS * k
         a, r = divmod(bit, 32)
-        lo = w[..., a] >> r
+        lo = w[a] >> r
         if r + BITS > 32 and a + 1 < 8:
-            lo = lo | (w[..., a + 1] << (32 - r))
-        out.append((lo & MASK).astype(jnp.int32))
-    limbs = jnp.stack(out, axis=-1)
-    if mask_high:
-        limbs = limbs.at[..., 19].set(limbs[..., 19] & 0xFF)
-    return limbs
+            lo = lo | (w[a + 1] << (32 - r))
+        lo = lo & MASK
+        if mask_high and k == NLIMB - 1:
+            lo = lo & 0xFF
+        out.append(lo.astype(jnp.int32))
+    return jnp.stack(out, axis=0)
 
 
 def limbs_to_words_le(limbs: jnp.ndarray) -> jnp.ndarray:
-    """Canonical [..., 20] limbs -> [..., 8] uint32 little-endian words."""
+    """Canonical [20, *batch] limbs -> [8, *batch] uint32 LE words."""
     l = limbs.astype(jnp.uint32)
     words = []
     for wi in range(8):
         bit0 = 32 * wi
-        w = jnp.zeros(limbs.shape[:-1], jnp.uint32)
+        w = jnp.zeros(limbs.shape[1:], jnp.uint32)
         for k in range(NLIMB):
             lb = BITS * k
             if lb + BITS <= bit0 or lb >= bit0 + 32:
                 continue
             sh = lb - bit0
             if sh >= 0:
-                w = w | (l[..., k] << sh)
+                w = w | (l[k] << sh)
             else:
-                w = w | (l[..., k] >> (-sh))
+                w = w | (l[k] >> (-sh))
         words.append(w)
-    return jnp.stack(words, axis=-1)
+    return jnp.stack(words, axis=0)

@@ -1,0 +1,25 @@
+"""Shared persistent XLA compilation cache setup.
+
+The big kernels (batched Ed25519 verify, tree hashing) take minutes to
+compile for the CPU backend and tens of seconds for TPU; one on-disk cache
+under the repo root makes every process after the first fast. Used by
+tests/conftest.py and bench.py so the knobs can never drift apart.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> str:
+    """Point JAX's persistent compilation cache at `<repo>/.jax_cache`
+    (or `cache_dir`). Safe to call more than once. Returns the dir."""
+    import jax
+
+    if cache_dir is None:
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        cache_dir = os.path.join(pkg_root, ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    return cache_dir

@@ -44,15 +44,16 @@ class TestFieldArithmetic:
         rng = random.Random(3)
         xs = [rng.randrange(F.P) for _ in range(32)]
         ys = [rng.randrange(F.P) for _ in range(32)]
-        X = jnp.asarray(np.stack([F.int_to_limbs_np(v) for v in xs]))
-        Y = jnp.asarray(np.stack([F.int_to_limbs_np(v) for v in ys]))
+        # limb-major layout: [20, B]
+        X = jnp.asarray(np.stack([F.int_to_limbs_np(v) for v in xs], axis=1))
+        Y = jnp.asarray(np.stack([F.int_to_limbs_np(v) for v in ys], axis=1))
         mul = np.asarray(F.fe_reduce_full(F.fe_mul(X, Y)))
         sub = np.asarray(F.fe_reduce_full(F.fe_sub(X, Y)))
         add = np.asarray(F.fe_reduce_full(F.fe_add(X, Y)))
         for i in range(32):
-            assert F.limbs_to_int(mul[i]) == xs[i] * ys[i] % F.P
-            assert F.limbs_to_int(sub[i]) == (xs[i] - ys[i]) % F.P
-            assert F.limbs_to_int(add[i]) == (xs[i] + ys[i]) % F.P
+            assert F.limbs_to_int(mul[:, i]) == xs[i] * ys[i] % F.P
+            assert F.limbs_to_int(sub[:, i]) == (xs[i] - ys[i]) % F.P
+            assert F.limbs_to_int(add[:, i]) == (xs[i] + ys[i]) % F.P
 
 
 def _make_cases(n=32):
