@@ -414,6 +414,9 @@ class LedgerConsensus:
         new_lcl, _results = self.lm.close_with_txset(
             txs, close_time, self.resolution, correct_close_time=ct_agree
         )
+        # per-tx apply results ride on the ledger for the persistence
+        # plane (txdb records real TER tokens, not a blanket tesSUCCESS)
+        new_lcl.apply_results = _results
         self.round_ms = self._ms_since(self.consensus_start)
 
         # disputed txns that lost get another shot in the new open ledger

@@ -89,6 +89,9 @@ class Config:
     peer_ip: str = "127.0.0.1"
     peer_port: int = 0  # 0 = disabled
     ips: list[str] = field(default_factory=list)  # bootstrap peers host:port
+    # test-net accelerator: virtual seconds per real second for the
+    # overlay clock (consensus windows shrink accordingly; 1.0 = live)
+    clock_speed: float = 1.0
 
     # -- ops ([node_size], fees) ------------------------------------------
     node_size: str = "tiny"  # tiny|small|medium|large|huge (thread sizing)
@@ -154,6 +157,8 @@ class Config:
         if one("peer_port"):
             cfg.peer_port = int(one("peer_port"))
         cfg.ips = list(s.get("ips", []))
+        if one("clock_speed"):
+            cfg.clock_speed = float(one("clock_speed"))
 
         cfg.node_size = one("node_size", cfg.node_size).lower()
         if one("fee_default"):

@@ -77,6 +77,12 @@ class NetworkOPs:
         self.mode = OperatingMode.FULL if standalone else OperatingMode.DISCONNECTED
         self.master_lock = threading.RLock()  # reference: getApp().getMasterLock()
         self.net_time_offset = 0
+        # networked-mode seams (wired by Node when an overlay exists):
+        # relay an applied client tx to peers / track it for re-apply
+        # across rounds (reference: processTransaction relay step +
+        # LocalTxs client-submit tracking)
+        self.relay_tx: Optional[Callable[[SerializedTransaction], None]] = None
+        self.local_push: Optional[Callable[[int, SerializedTransaction], None]] = None
         # pub/sub sinks (wired by InfoSub manager; reference NetworkOPsImp
         # mSubLedger / mSubTransactions / ...)
         self.on_ledger_closed: list[Callable[[Ledger, dict], None]] = []
@@ -203,8 +209,19 @@ class NetworkOPs:
         for sink in self.on_proposed_tx:
             sink(tx, ter)
 
-        # relay seam (overlay broadcast; no-op in standalone)
-        self.router.swap_set(txid, set(), SF_RELAYED)
+        # relay seam (overlay broadcast; no-op in standalone). The
+        # SF_RELAYED flag is only CONSUMED when the tx actually applied:
+        # a transiently-failing submission (e.g. telINSUF_FEE_P under
+        # load) must still relay on its later successful resubmit, while
+        # a successful one must not become a per-resubmit broadcast
+        # amplifier (swap_set returns newly-set exactly for this gate)
+        if not ter.is_tem and (did_apply or ter == TER.terPRE_SEQ):
+            _prev, newly = self.router.swap_set(txid, set(), SF_RELAYED)
+            if newly:
+                if self.relay_tx is not None:
+                    self.relay_tx(tx)
+                if self.local_push is not None:
+                    self.local_push(self.lm.closed_ledger().seq, tx)
         return ter, did_apply
 
     # -- standalone close (reference: NetworkOPs::acceptLedger) ------------
@@ -223,12 +240,20 @@ class NetworkOPs:
                 close_time=self.network_time(),
                 close_resolution=self.lm.closed_ledger().close_resolution,
             )
-        for txid, ter in results.items():
+        self.publish_closed_ledger(closed, results)
+        return closed, results
+
+    def publish_closed_ledger(
+        self, closed: Ledger, results: dict[bytes, TER]
+    ) -> None:
+        """Status promotion + ledger-closed sinks, shared by the
+        standalone close above and the networked consensus path (the
+        WS ledger/transactions streams hang off on_ledger_closed)."""
+        for txid, _ter in results.items():
             if self.on_tx_result.get(txid) == TxStatus.INCLUDED:
                 self._record_status(txid, TxStatus.COMMITTED)
         for sink in self.on_ledger_closed:
             sink(closed, results)
-        return closed, results
 
     def _record_status(self, txid: bytes, status: TxStatus) -> None:
         m = self.on_tx_result

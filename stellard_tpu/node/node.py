@@ -33,6 +33,56 @@ __all__ = ["Node"]
 MASTER_PASSPHRASE = "masterpassphrase"
 
 
+def _parse_host_port(entry: str, default_port: int) -> Optional[tuple[str, int]]:
+    """One "host port" / "host:port" / bare-host entry -> (host, port).
+    A colon is only a separator when it appears exactly once — an IPv6
+    literal like ::1 stays a bare host (reference Config.cpp IPS rules).
+    Returns None for malformed entries (callers skip, never crash)."""
+    entry = entry.strip()
+    if not entry:
+        return None
+    if " " in entry:
+        host, _, port = entry.partition(" ")
+    elif entry.count(":") == 1:
+        host, _, port = entry.partition(":")
+    else:
+        host, port = entry, ""
+    try:
+        return (host.strip(), int(port) if port else default_port)
+    except ValueError:
+        return None
+
+
+def _parse_peer_addrs(ips: list[str]) -> list[tuple[str, int]]:
+    """[ips] entries -> (host, port) dial pairs."""
+    out = []
+    for entry in ips:
+        pair = _parse_host_port(entry, 51235)
+        if pair is not None:
+            out.append(pair)
+    return out
+
+
+def _result_token(txid: bytes, results: dict, meta: Optional[bytes]) -> str:
+    """TER token for a committed tx: the local apply result when we
+    closed the round ourselves, else the sfTransactionResult byte from
+    the tx metadata (catch-up-adopted ledgers were not applied locally,
+    and recording a blanket tesSUCCESS would misreport tec-class txs)."""
+    if txid in results:
+        return TER(results[txid]).token
+    if meta:
+        try:
+            from ..protocol.sfields import sfTransactionResult
+            from ..protocol.stobject import STObject
+
+            code = STObject.from_bytes(meta).get(sfTransactionResult)
+            if code is not None:
+                return TER(code).token
+        except Exception:  # noqa: BLE001 — unparseable meta: fall through
+            pass
+    return TER.tesSUCCESS.token
+
+
 class Node:
     """One stellard-tpu node. Construct → setup() → (serve / drive)."""
 
@@ -137,22 +187,120 @@ class Node:
         self.collector = CollectorManager.from_config(cfg.insight)
         self.sntp: Optional[SntpClient] = None
         if cfg.sntp_servers:
-            servers = []
-            for spec in cfg.sntp_servers:
-                host, _, port = spec.rpartition(":")
-                if not host:  # bare hostname, no port
-                    host, port = spec, ""
-                try:
-                    servers.append((host, int(port) if port else 123))
-                except ValueError:
-                    continue  # malformed entry: skip, don't kill the node
+            servers = [
+                pair
+                for pair in (
+                    _parse_host_port(spec, 123) for spec in cfg.sntp_servers
+                )
+                if pair is not None
+            ]
             if servers:
                 self.sntp = SntpClient(servers)
 
-        # ledger chain + brain
-        self.ledger_master = LedgerMaster(
-            hash_batch=self.hasher
-        )
+        # node identity + validator identity must exist before the overlay
+        # (the overlay handshakes and proposes with them)
+        self.node_keys = self._load_or_create_identity()
+        self.validation_keys: Optional[KeyPair] = None
+        if cfg.validation_seed:
+            self.validation_keys = KeyPair.from_seed(decode_seed(cfg.validation_seed))
+
+        # overlay plane (reference: ApplicationImp Overlay :300 + Peers
+        # start :811): when [peer_port] is configured the node joins a
+        # TCP net and the overlay's ValidatorNode OWNS the ledger chain —
+        # consensus and the RPC plane then share one LedgerMaster and
+        # serialize on one master lock
+        self.overlay = None
+        if cfg.peer_port and not cfg.standalone:
+            from ..overlay.tcp import TcpOverlay
+
+            speed = max(cfg.clock_speed, 1e-9)
+            clock = None
+            ntime = None
+            timer_interval = 1.0
+            if speed != 1.0:
+                import time as _time
+
+                t0 = _time.monotonic()
+                clock = lambda: (_time.monotonic() - t0) * speed  # noqa: E731
+                # virtual network time is a pure function of WALL time so
+                # independently-started peers agree (anchoring to process
+                # start would skew peers by (speed-1) x launch offset).
+                # Only the delta from a FIXED recent anchor is scaled, so
+                # the value stays well inside the u32 close-time wire
+                # fields (scaling the whole 2000-epoch offset overflows
+                # past speed ~5)
+                _ANCHOR = 1_750_000_000  # fixed wall anchor (2025-06-15)
+                _BASE = _ANCHOR - 946_684_800
+                ntime = lambda: _BASE + int(  # noqa: E731
+                    (_time.time() - _ANCHOR) * speed
+                )
+                timer_interval = max(0.1, 1.0 / speed)
+            unl_keys = self.unl.publics()
+            signer = self.validation_keys or self.node_keys
+            self.overlay = TcpOverlay(
+                key=signer,
+                unl=unl_keys,
+                quorum=cfg.validation_quorum,
+                port=cfg.peer_port,
+                peer_addrs=_parse_peer_addrs(cfg.ips),
+                network_time=ntime,
+                clock=clock,
+                timer_interval=timer_interval,
+                hash_batch=self.hasher,
+                verify_many=self.verify_plane.verify_many,
+                fee_track=self.fee_track,
+                unl_store=self.unl,
+                bootcache_path=(
+                    cfg.database_path + ".bootcache" if cfg.database_path else None
+                ),
+                proposing=self.validation_keys is not None,
+                router=self.hash_router,
+            )
+            # persistence rides a dedicated ORDERED worker, NOT the
+            # consensus tick (the hook fires under the master lock and a
+            # slow disk must not stall round timing — reference:
+            # pendSaveValidated) and NOT the general job pool (concurrent
+            # workers could commit ledger N+1's CLF pointer before N's,
+            # regressing the resume point)
+            import queue as _queue
+
+            self._persist_q: _queue.Queue = _queue.Queue()
+
+            def _persist_worker():
+                while True:
+                    item = self._persist_q.get()
+                    if item is None:
+                        return
+                    led, results = item
+                    try:
+                        self._persist_closed_ledger(led, results)
+                        # WS streams + INCLUDED→COMMITTED promotion fire
+                        # for networked closes exactly as for standalone
+                        self.ops.publish_closed_ledger(led, results)
+                    except Exception:  # noqa: BLE001 — keep persisting later ledgers
+                        import logging
+
+                        logging.getLogger("stellard.node").exception(
+                            "ledger persist failed"
+                        )
+
+            self._persist_thread = threading.Thread(
+                target=_persist_worker, name="ledger-persist", daemon=True
+            )
+            self._persist_thread.start()
+
+            def _persist_async(led):
+                self._persist_q.put((led, getattr(led, "apply_results", {})))
+
+            self.overlay.accepted_hooks.append(_persist_async)
+
+        # ledger chain + brain (networked: the overlay's chain IS ours)
+        if self.overlay is not None:
+            self.ledger_master = self.overlay.node.lm
+        else:
+            self.ledger_master = LedgerMaster(
+                hash_batch=self.hasher
+            )
 
         def _fetch_fallback(h: bytes):
             # history-cache miss -> rebuild from the NodeStore (consensus
@@ -171,16 +319,18 @@ class Node:
             standalone=cfg.standalone,
             fee_track=self.fee_track,
         )
-        self.ops.on_ledger_closed.append(self._persist_closed_ledger)
-
-        # node identity (reference: LocalCredentials + wallet.db): the
-        # node key is generated ONCE and persisted beside the databases,
-        # so the overlay identity survives restarts; validators sign with
-        # [validation_seed] when configured
-        self.node_keys = self._load_or_create_identity()
-        self.validation_keys: Optional[KeyPair] = None
-        if cfg.validation_seed:
-            self.validation_keys = KeyPair.from_seed(decode_seed(cfg.validation_seed))
+        if self.overlay is not None:
+            # one master lock for consensus + RPC over the shared chain,
+            # and the relay/local-retry seams (reference: the relay step
+            # of NetworkOPs::processTransaction :544-556 + LocalTxs).
+            # Persistence rides the overlay's on_ledger hook (which also
+            # fires publish_closed_ledger), NOT the sinks below.
+            self.ops.master_lock = self.overlay.node.lock
+            self.ops.relay_tx = self.overlay.broadcast_tx
+            self.ops.local_push = self.overlay.node.local_txs.push_back
+        else:
+            # standalone: persistence rides the ledger-closed sinks
+            self.ops.on_ledger_closed.append(self._persist_closed_ledger)
 
         self.master_keys = KeyPair.from_passphrase(MASTER_PASSPHRASE)
         self._running = threading.Event()
@@ -276,6 +426,11 @@ class Node:
             ).start()
         self._running.set()
         self.load_manager.start()
+        if self.overlay is not None:
+            # chain already set up (fresh/load) by setup(); open the
+            # first consensus round over it and join the net
+            self.overlay.node.begin_round()
+            self.overlay.start_network()
         if self.sntp is not None:
             self.sntp.start()
         # pull-gauges for the metrics plane (insight Hook shape)
@@ -327,11 +482,34 @@ class Node:
                     # discipline the network clock used for close times
                     # (reference getNetworkTimeNC via the SNTP offset)
                     self.ops.net_time_offset = int(round(self.sntp.offset))
+                if self.overlay is not None:
+                    # operating mode from overlay health (reference:
+                    # NetworkOPs::setMode heuristics): FULL only while
+                    # rounds are actually completing — a node that closed
+                    # rounds once and then lost its peers must degrade
+                    from .networkops import OperatingMode
+
+                    vn = self.overlay.node
+                    rounds = vn.rounds_completed
+                    if rounds > getattr(self, "_last_rounds", 0):
+                        self._last_rounds = rounds
+                        self._last_round_at = now
+                    recently = now - getattr(self, "_last_round_at", 0.0) < 60.0
+                    if rounds > 0 and recently:
+                        self.ops.mode = OperatingMode.FULL
+                    elif self.overlay.peer_count() > 0:
+                        self.ops.mode = OperatingMode.CONNECTED
+                    else:
+                        self.ops.mode = OperatingMode.DISCONNECTED
             _time.sleep(0.2)
 
     def stop(self) -> None:
         self._running.clear()
         self.load_manager.stop()
+        if self.overlay is not None:
+            self.overlay.stop()
+            self._persist_q.put(None)  # drain, then stop the persist worker
+            self._persist_thread.join(timeout=10)
         self.collector.stop()
         if self.sntp is not None:
             self.sntp.stop()
@@ -365,7 +543,7 @@ class Node:
                     tx.account,
                     tx.sequence,
                     ledger.seq,
-                    TER(results.get(txid, TER.tesSUCCESS)).token,
+                    _result_token(txid, results, meta),
                     blob,
                     meta,
                     affected,

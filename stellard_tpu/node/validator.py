@@ -35,6 +35,19 @@ from .ledgermaster import LedgerMaster
 __all__ = ["ValidatorNode"]
 
 
+def _locked(method):
+    """Serialize a ValidatorNode entry point on the master lock (RLock:
+    accept callbacks re-enter from within a locked timer tick)."""
+    import functools
+
+    @functools.wraps(method)
+    def wrapper(self, *args, **kwargs):
+        with self.lock:
+            return method(self, *args, **kwargs)
+
+    return wrapper
+
+
 class ValidatorNode:
     def __init__(
         self,
@@ -49,7 +62,16 @@ class ValidatorNode:
         proposing: bool = True,
         idle_interval: int = LEDGER_IDLE_INTERVAL,
         voting=None,
+        lock=None,
+        router: Optional[HashRouter] = None,
     ):
+        import threading
+
+        # master lock: consensus timer / peer-message threads and the RPC
+        # plane mutate the SAME LedgerMaster when this validator backs an
+        # application container (reference: getApp().getMasterLock());
+        # every public entry point below serializes on it
+        self.lock = lock if lock is not None else threading.RLock()
         self.key = key
         self.unl = set(unl) | {key.public}  # we trust ourselves
         self.adapter = adapter
@@ -66,10 +88,17 @@ class ValidatorNode:
         self.validations = ValidationsStore(
             is_trusted=lambda pk: pk in self.unl, now=network_time
         )
-        self.router = HashRouter()
+        # shared with the application container when one embeds this
+        # validator: RPC-plane and peer-plane sig verdicts / suppression
+        # must be ONE state (reference: a single getApp().getHashRouter())
+        self.router = router if router is not None else HashRouter()
         from .localtxs import LocalTxs
 
         self.local_txs = LocalTxs()
+        # fired for EVERY ledger that becomes our LCL — locally-closed
+        # rounds AND catch-up adoptions — so the persistence plane never
+        # gaps (reference: pendSaveValidated covers both paths)
+        self.on_ledger: list[Callable[[Ledger], None]] = []
         self.round: Optional[LedgerConsensus] = None
         self.prev_proposers = 0
         self.prev_round_ms = LEDGER_MIN_CONSENSUS_MS
@@ -110,6 +139,7 @@ class ValidatorNode:
             voting=self.voting,
         )
 
+    @_locked
     def on_timer(self) -> None:
         """Heartbeat → consensus timer + catch-up check (reference:
         processHeartbeatTimer → timerEntry / checkLastClosedLedger)."""
@@ -174,8 +204,21 @@ class ValidatorNode:
         self.lm.check_accept(
             ledger.hash(), self.validations.trusted_count_for(ledger.hash())
         )
+        self._fire_on_ledger(ledger)
         self.begin_round()
 
+    def _fire_on_ledger(self, ledger: Ledger) -> None:
+        for cb in self.on_ledger:
+            try:
+                cb(ledger)
+            except Exception:  # noqa: BLE001 — hooks must not kill consensus
+                import logging
+
+                logging.getLogger("stellard.validator").exception(
+                    "on_ledger hook failed"
+                )
+
+    @_locked
     def round_accepted(self, ledger: Ledger, round_ms: int) -> None:
         """Adapter callback after accept(): record stats and start the
         next round (reference: endConsensus → NetworkOPs::endConsensus)."""
@@ -184,6 +227,7 @@ class ValidatorNode:
         )
         self.prev_round_ms = max(round_ms, LEDGER_MIN_CONSENSUS_MS)
         self.rounds_completed += 1
+        self._fire_on_ledger(ledger)
         # local submissions that missed this ledger re-apply to the new
         # open ledger; landed/expired ones sweep (reference LocalTxs)
         self.local_txs.sweep(ledger)
@@ -195,6 +239,7 @@ class ValidatorNode:
 
     # -- transaction submission ------------------------------------------
 
+    @_locked
     def submit(
         self, tx: SerializedTransaction, local: bool = True
     ) -> tuple[TER, bool]:
@@ -224,6 +269,7 @@ class ValidatorNode:
 
     # -- peer message handlers -------------------------------------------
 
+    @_locked
     def handle_tx(self, tx: SerializedTransaction) -> bool:
         """Relayed network tx (reference: PeerImp::checkTransaction).
         Returns True when it should be re-relayed."""
@@ -232,7 +278,10 @@ class ValidatorNode:
 
     def handle_proposal(self, prop: LedgerProposal) -> bool:
         """reference: PeerImp::checkPropose → peerPosition. Signature is
-        verified once per suppression id, then routed to the round."""
+        verified once per suppression id OUTSIDE the master lock (the
+        reference checks on jtVALIDATION jobs off the lock too — a device
+        verify batch must not serialize RPC tx application), then the
+        round mutation runs locked."""
         pid = prop.suppression_id()
         flags = self.router.get_flags(pid)
         if flags & SF_BAD:
@@ -243,13 +292,15 @@ class ValidatorNode:
                 return False
             self.router.set_flag(pid, SF_SIGGOOD)
         prop.set_sig_verdict(True)
-        if self.round is None:
-            return False
-        return self.round.peer_proposal(prop)
+        with self.lock:
+            if self.round is None:
+                return False
+            return self.round.peer_proposal(prop)
 
     def handle_validation(self, val: STValidation) -> bool:
         """reference: PeerImp::checkValidation → recvValidation →
-        Validations::addValidation → LedgerMaster::checkAccept."""
+        Validations::addValidation → LedgerMaster::checkAccept.
+        Signature check runs outside the master lock (see handle_proposal)."""
         vid = val.validation_id()
         flags = self.router.get_flags(vid)
         if flags & SF_BAD:
@@ -260,23 +311,27 @@ class ValidatorNode:
                 return False
             self.router.set_flag(vid, SF_SIGGOOD)
         val.set_sig_verdict(True)
-        current = self.validations.add(val)
-        self.lm.check_accept(
-            val.ledger_hash,
-            self.validations.trusted_count_for(val.ledger_hash),
-        )
-        return current
+        with self.lock:
+            current = self.validations.add(val)
+            self.lm.check_accept(
+                val.ledger_hash,
+                self.validations.trusted_count_for(val.ledger_hash),
+            )
+            return current
 
+    @_locked
     def handle_ledger_data(self, msg) -> None:
         """Route a LedgerData reply into the acquisition machinery."""
         self.inbound.take_ledger_data(msg)
 
+    @_locked
     def serve_get_ledger(self, msg):
         """Answer a peer's GetLedger from our closed-ledger cache."""
         from .inbound import serve_get_ledger
 
         return serve_get_ledger(self.lm.get_ledger_by_hash(msg.ledger_hash), msg)
 
+    @_locked
     def handle_txset(self, txset: TxSet) -> None:
         """A shared/acquired candidate set arrived
         (reference: TMHaveTransactionSet/TransactionAcquire completion)."""

@@ -66,14 +66,25 @@ HP_SESSION = (ord("S") << 24) | (ord("S") << 16) | (ord("N") << 8)
 
 
 class _Peer:
+    # bounded outbound queue: a peer that stops reading sheds here
+    # instead of blocking the caller (consensus timer / relay threads
+    # must NEVER wait on a socket — reference: PeerImp's async writes)
+    SENDQ_DEPTH = 256
+
     def __init__(self, sock: socket.socket, inbound: bool,
                  addr: Optional[tuple[str, int]] = None):
+        import queue
+
         self.sock = sock
         self.inbound = inbound
         self.addr = addr  # configured dial address (outbound only)
         self.reader = FrameReader()
         self.node_public: bytes = b""
         self.send_lock = threading.Lock()
+        self.sendq: "queue.Queue[Optional[bytes]]" = queue.Queue(
+            maxsize=self.SENDQ_DEPTH
+        )
+        self._writer: Optional[threading.Thread] = None
         self.alive = True
         self.established_at = 0.0
         # real wall-clock (not the node's virtual clock): socket liveness
@@ -87,14 +98,43 @@ class _Peer:
         self.advertised: Optional[tuple[str, int]] = None
 
     def send(self, data: bytes) -> None:
-        try:
+        """Non-blocking enqueue; the per-peer writer thread drains. A
+        full queue means a slow/stalled reader — drop the peer rather
+        than wedge the sender (the master lock may be held here)."""
+        import queue
+
+        if not self.alive:
+            return
+        if self._writer is None:
             with self.send_lock:
-                self.sock.sendall(data)
-        except OSError:
-            self.alive = False
+                if self._writer is None:
+                    t = threading.Thread(
+                        target=self._write_loop, name="peer-writer", daemon=True
+                    )
+                    self._writer = t
+                    t.start()
+        try:
+            self.sendq.put_nowait(data)
+        except queue.Full:
+            self.close()
+
+    def _write_loop(self) -> None:
+        while True:
+            data = self.sendq.get()
+            if data is None or not self.alive:
+                return
+            try:
+                self.sock.sendall(data)  # SO_SNDTIMEO bounds each write
+            except OSError:
+                self.alive = False
+                return
 
     def close(self) -> None:
         self.alive = False
+        try:
+            self.sendq.put_nowait(None)  # wake the writer
+        except Exception:  # noqa: BLE001 — full queue: shutdown below aborts it
+            pass
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -127,6 +167,9 @@ class TcpOverlay(ConsensusAdapter):
         unl_store=None,
         cluster: Optional[set[bytes]] = None,
         fee_track=None,
+        verify_many: Optional[Callable] = None,
+        proposing: bool = True,
+        router=None,
     ):
         self.key = key
         self.port = port
@@ -145,6 +188,9 @@ class TcpOverlay(ConsensusAdapter):
             clock=self._clock,
             idle_interval=idle_interval,
             hash_batch=hash_batch,
+            verify_many=verify_many,
+            proposing=proposing,
+            router=router,
         )
         self.peers: dict[bytes, _Peer] = {}  # node pubkey -> session
         self._dialing: set[tuple[str, int]] = set()  # dials in flight
@@ -172,6 +218,12 @@ class TcpOverlay(ConsensusAdapter):
 
     def start(self, genesis_account: bytes, close_time: int = 0) -> None:
         self.node.start(genesis_account, close_time or self._ntime())
+        self.start_network()
+
+    def start_network(self) -> None:
+        """Open the listener + dial/timer loops WITHOUT (re)creating the
+        genesis ledger — the path for an application container whose
+        LedgerMaster was already set up (fresh or loaded) by Node.setup."""
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind(("127.0.0.1", self.port))
@@ -597,12 +649,40 @@ class TcpOverlay(ConsensusAdapter):
     def on_accepted(self, ledger: Ledger, round_ms: int) -> None:
         self.node.round_accepted(ledger, round_ms)
 
+    @property
+    def accepted_hooks(self) -> list:
+        """Ledger hooks live on the ValidatorNode (fired for consensus
+        closes AND catch-up adoptions); exposed here for the container."""
+        return self.node.on_ledger
+
     # -- client entry -----------------------------------------------------
 
     def submit_client_tx(self, tx: SerializedTransaction) -> None:
         self.node.submit(tx)
         self._broadcast(TxMessage(tx.serialize()))
 
+    def broadcast_tx(self, tx: SerializedTransaction) -> None:
+        """Relay an already-applied client tx (the NetworkOPs relay seam)."""
+        self._broadcast(TxMessage(tx.serialize()))
+
     def peer_count(self) -> int:
         with self._peers_lock:
             return len(self.peers)
+
+    def peers_json(self) -> list[dict]:
+        """reference: OverlayImpl::json / handlers/Peers.cpp row shape."""
+        from ..protocol.keys import encode_node_public
+
+        with self._peers_lock:
+            peers = list(self.peers.items())
+        out = []
+        for pub, p in peers:
+            out.append(
+                {
+                    "public_key": encode_node_public(pub),
+                    "address": f"{p.addr[0]}:{p.addr[1]}" if p.addr else "",
+                    "inbound": bool(p.inbound),
+                    "alive": bool(p.alive),
+                }
+            )
+        return out

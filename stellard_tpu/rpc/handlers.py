@@ -235,7 +235,11 @@ def do_server_info(ctx: Context) -> dict:
         "build_version": "stellard-tpu 0.1.0",
         "server_state": node.ops.server_state(),
         "complete_ledgers": _complete_ledgers(node),
-        "peers": 0,
+        "peers": (
+            node.overlay.peer_count()
+            if getattr(node, "overlay", None) is not None
+            else 0
+        ),
         "load_factor": node.fee_track.load_factor / 256.0,
         "load_base": 256,
         "signature_backend": node.config.signature_backend,

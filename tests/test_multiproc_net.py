@@ -1,0 +1,208 @@
+"""4-validator private net as SEPARATE PROCESSES over real sockets —
+the deployment BASELINE config #4 describes (one host per validator),
+driven end-to-end through the CLI + RPC planes (reference: the Vagrant
+one-box testnet, doc/stellard-example.cfg private-net template).
+
+Each validator is `python -m stellard_tpu --conf <ini> --start`: the full
+application container (NodeStore, CLF mirror, JobQueue, VerifyPlane,
+TcpOverlay + ValidatorNode consensus, HTTP RPC). The test asserts the
+net closes ledgers in agreement and that a payment submitted over RPC to
+one validator commits network-wide.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from stellard_tpu.protocol.keys import KeyPair
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SPEED = 5.0  # virtual seconds per real second (clock_speed knob)
+
+
+def free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def rpc(port: int, method: str, params: dict | None = None, timeout=5.0):
+    body = json.dumps({"method": method, "params": [params or {}]}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.load(resp)["result"]
+
+
+def wait_until(pred, timeout: float, interval: float = 0.5):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            last = pred()
+            if last:
+                return last
+        except Exception:
+            pass
+        time.sleep(interval)
+    return last
+
+
+@pytest.fixture(scope="module")
+def net(tmp_path_factory):
+    n = 4
+    tmp = tmp_path_factory.mktemp("mpnet")
+    ports = free_ports(3 * n)
+    peer_ports, rpc_ports, ws_ports = ports[:n], ports[n : 2 * n], ports[2 * n :]
+    keys = [KeyPair.from_passphrase(f"mp-val-{i}") for i in range(n)]
+
+    procs = []
+    for i in range(n):
+        others_keys = "\n".join(
+            keys[j].human_node_public for j in range(n) if j != i
+        )
+        others_addrs = "\n".join(
+            f"127.0.0.1 {peer_ports[j]}" for j in range(n) if j != i
+        )
+        cfg = f"""
+[standalone]
+0
+
+[node_db]
+type=memory
+
+[signature_backend]
+type=cpu
+
+[validation_seed]
+{keys[i].human_seed}
+
+[validators]
+{others_keys}
+
+[validation_quorum]
+3
+
+[peer_port]
+{peer_ports[i]}
+
+[ips]
+{others_addrs}
+
+[clock_speed]
+{SPEED}
+
+[rpc_port]
+{rpc_ports[i]}
+
+[websocket_port]
+{ws_ports[i]}
+"""
+        path = tmp / f"validator-{i}.cfg"
+        path.write_text(cfg)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"  # never grab the TPU tunnel from tests
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "stellard_tpu", "--conf", str(path),
+                 "--start"],
+                cwd=REPO,
+                env=env,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.STDOUT,
+            )
+        )
+    try:
+        yield {"rpc_ports": rpc_ports, "procs": procs}
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+@pytest.mark.slow
+class TestMultiProcessNet:
+    def test_ledgers_close_and_agree(self, net):
+        rpc_ports = net["rpc_ports"]
+
+        # all four servers come up and connect to each other
+        assert wait_until(
+            lambda: all(
+                rpc(p, "server_info")["info"]["peers"] == 3 for p in rpc_ports
+            ),
+            timeout=30,
+        ), "validators never fully meshed"
+
+        # the net closes ledgers: every validator advances past seq 3
+        def advanced():
+            seqs = [
+                rpc(p, "server_info")["info"]["validated_ledger"]["seq"]
+                for p in rpc_ports
+            ]
+            return all(s >= 3 for s in seqs)
+
+        assert wait_until(advanced, timeout=60), "net never closed 3 ledgers"
+
+        # agreement: at a common validated sequence the hashes match
+        infos = [rpc(p, "server_info")["info"] for p in rpc_ports]
+        common = min(i["validated_ledger"]["seq"] for i in infos)
+        hashes = {
+            rpc(p, "ledger", {"ledger_index": common})["ledger"]["hash"]
+            for p in rpc_ports
+        }
+        assert len(hashes) == 1, f"fork at seq {common}: {hashes}"
+
+    def test_rpc_payment_commits_network_wide(self, net):
+        rpc_ports = net["rpc_ports"]
+        alice = KeyPair.from_passphrase("mp-alice")
+        amount = 5_000 * 1_000_000
+
+        res = rpc(
+            rpc_ports[0],
+            "submit",
+            {
+                "secret": "masterpassphrase",
+                "tx_json": {
+                    "TransactionType": "Payment",
+                    "Account": KeyPair.from_passphrase(
+                        "masterpassphrase"
+                    ).human_account_id,
+                    "Destination": alice.human_account_id,
+                    "Amount": str(amount),
+                },
+            },
+            timeout=15.0,
+        )
+        assert res["engine_result"] in ("tesSUCCESS", "terQUEUED"), res
+
+        # the payment lands in a validated ledger on EVERY validator
+        def landed():
+            for p in rpc_ports:
+                info = rpc(p, "account_info", {"account": alice.human_account_id,
+                                               "ledger_index": "validated"})
+                if int(info["account_data"]["Balance"]) != amount:
+                    return False
+            return True
+
+        assert wait_until(landed, timeout=60), "payment never committed net-wide"
