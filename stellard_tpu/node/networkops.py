@@ -216,10 +216,12 @@ class NetworkOPs:
         # a successful one must not become a per-resubmit broadcast
         # amplifier (swap_set returns newly-set exactly for this gate)
         if not ter.is_tem and (did_apply or ter == TER.terPRE_SEQ):
-            _prev, newly = self.router.swap_set(txid, set(), SF_RELAYED)
+            prev_peers, newly = self.router.swap_set(txid, set(), SF_RELAYED)
             if newly:
                 if self.relay_tx is not None:
-                    self.relay_tx(tx)
+                    # prev_peers = peers this tx already arrived from;
+                    # they are excluded from the fan-out
+                    self.relay_tx(tx, prev_peers)
                 if self.local_push is not None:
                     self.local_push(self.lm.closed_ledger().seq, tx)
         return ter, did_apply

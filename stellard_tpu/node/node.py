@@ -63,6 +63,26 @@ def _parse_peer_addrs(ips: list[str]) -> list[tuple[str, int]]:
     return out
 
 
+def _results_from_meta(ledger: Ledger) -> dict:
+    """{txid: TER} recovered from each committed tx's sfTransactionResult
+    metadata byte — for ledgers adopted from the net (never applied
+    locally, so no local results exist)."""
+    from ..protocol.sfields import sfTransactionResult
+    from ..protocol.stobject import STObject
+
+    out = {}
+    for txid, _blob, meta in ledger.tx_entries():
+        if not meta:
+            continue
+        try:
+            code = STObject.from_bytes(meta).get(sfTransactionResult)
+            if code is not None:
+                out[txid] = TER(code)
+        except Exception:  # noqa: BLE001 — unparseable meta: skip this tx
+            continue
+    return out
+
+
 def _result_token(txid: bytes, results: dict, meta: Optional[bytes]) -> str:
     """TER token for a committed tx: the local apply result when we
     closed the round ourselves, else the sfTransactionResult byte from
@@ -264,7 +284,10 @@ class Node:
             # regressing the resume point)
             import queue as _queue
 
-            self._persist_q: _queue.Queue = _queue.Queue()
+            # bounded: a disk that cannot keep up with the close rate
+            # back-pressures the consensus tick (briefly) instead of
+            # pinning an unbounded backlog of whole Ledgers in memory
+            self._persist_q: _queue.Queue = _queue.Queue(maxsize=256)
 
             def _persist_worker():
                 while True:
@@ -273,6 +296,12 @@ class Node:
                         return
                     led, results = item
                     try:
+                        if not results:
+                            # catch-up-adopted ledger: we never applied it
+                            # locally — recover per-tx results from the
+                            # sfTransactionResult metadata byte so status
+                            # promotion + WS streams report real codes
+                            results = _results_from_meta(led)
                         self._persist_closed_ledger(led, results)
                         # WS streams + INCLUDED→COMMITTED promotion fire
                         # for networked closes exactly as for standalone
@@ -509,7 +538,14 @@ class Node:
         if self.overlay is not None:
             self.overlay.stop()
             self._persist_q.put(None)  # drain, then stop the persist worker
-            self._persist_thread.join(timeout=10)
+            self._persist_thread.join(timeout=60)
+            if self._persist_thread.is_alive():
+                import logging
+
+                logging.getLogger("stellard.node").error(
+                    "shutdown with ~%d ledgers still unpersisted",
+                    self._persist_q.qsize(),
+                )
         self.collector.stop()
         if self.sntp is not None:
             self.sntp.stop()

@@ -204,7 +204,20 @@ class ValidatorNode:
         self.lm.check_accept(
             ledger.hash(), self.validations.trusted_count_for(ledger.hash())
         )
-        self._fire_on_ledger(ledger)
+        # a multi-ledger jump must hand EVERY resolvable intermediate
+        # ledger to the persistence plane oldest-first, or the txdb gets
+        # a permanent hole for the skipped range (unresolvable ancestors
+        # are the LedgerCleaner's repair territory)
+        chain = [ledger]
+        cursor = ledger
+        while cursor.seq > ours.seq + 1:
+            parent = self.lm.get_ledger_by_hash(cursor.parent_hash)
+            if parent is None:
+                break
+            chain.append(parent)
+            cursor = parent
+        for led in reversed(chain):
+            self._fire_on_ledger(led)
         self.begin_round()
 
     def _fire_on_ledger(self, ledger: Ledger) -> None:

@@ -661,9 +661,20 @@ class TcpOverlay(ConsensusAdapter):
         self.node.submit(tx)
         self._broadcast(TxMessage(tx.serialize()))
 
-    def broadcast_tx(self, tx: SerializedTransaction) -> None:
-        """Relay an already-applied client tx (the NetworkOPs relay seam)."""
-        self._broadcast(TxMessage(tx.serialize()))
+    def broadcast_tx(self, tx: SerializedTransaction, except_ids=None) -> None:
+        """Relay an already-applied client tx (the NetworkOPs relay seam).
+        `except_ids` is the HashRouter suppression peer-id set — peers the
+        tx already arrived FROM are excluded from the fan-out (reference:
+        the swapSet peer set drives exactly this exclusion)."""
+        data = frame(TxMessage(tx.serialize()))
+        with self._peers_lock:
+            targets = [
+                p
+                for p in self.peers.values()
+                if not except_ids or id(p) not in except_ids
+            ]
+        for p in targets:
+            p.send(data)
 
     def peer_count(self) -> int:
         with self._peers_lock:
