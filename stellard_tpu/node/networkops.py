@@ -78,10 +78,13 @@ class NetworkOPs:
         self.master_lock = threading.RLock()  # reference: getApp().getMasterLock()
         self.net_time_offset = 0
         # networked-mode seams (wired by Node when an overlay exists):
-        # relay an applied client tx to peers / track it for re-apply
-        # across rounds (reference: processTransaction relay step +
-        # LocalTxs client-submit tracking)
-        self.relay_tx: Optional[Callable[[SerializedTransaction], None]] = None
+        # relay an applied client tx to peers (excluding the suppression
+        # peer-id set it arrived from) / track it for re-apply across
+        # rounds (reference: processTransaction relay step + LocalTxs
+        # client-submit tracking)
+        self.relay_tx: Optional[
+            Callable[[SerializedTransaction, set[int]], None]
+        ] = None
         self.local_push: Optional[Callable[[int, SerializedTransaction], None]] = None
         # pub/sub sinks (wired by InfoSub manager; reference NetworkOPsImp
         # mSubLedger / mSubTransactions / ...)

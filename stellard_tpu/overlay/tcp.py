@@ -17,6 +17,7 @@ onto the ValidatorNode's internal locking.
 
 from __future__ import annotations
 
+import itertools
 import os
 import socket
 import struct
@@ -44,6 +45,7 @@ from .resource import (
 )
 from .wire import (
     ClusterStatus,
+    ClusterUpdate,
     Endpoints,
     FrameReader,
     GetLedger,
@@ -71,10 +73,16 @@ class _Peer:
     # must NEVER wait on a socket — reference: PeerImp's async writes)
     SENDQ_DEPTH = 256
 
+    # never-recycled session ids for HashRouter suppression sets (id()
+    # can be reused by a later peer object within the router's 300s hold,
+    # which would wrongly exclude a fresh peer from relays)
+    _NEXT_UID = itertools.count(1)
+
     def __init__(self, sock: socket.socket, inbound: bool,
                  addr: Optional[tuple[str, int]] = None):
         import queue
 
+        self.uid = next(_Peer._NEXT_UID)
         self.sock = sock
         self.inbound = inbound
         self.addr = addr  # configured dial address (outbound only)
@@ -515,13 +523,16 @@ class TcpOverlay(ConsensusAdapter):
                     self._relay(msg, except_peer=peer)
                 else:
                     self._charge_if_bad(peer, vid)
-        elif isinstance(msg, ClusterStatus):
-            if (
-                self.fee_track is not None
-                and msg.node_public in self.cluster
-                and msg.node_public == peer.node_public
-            ):
-                self.fee_track.set_remote_fee(msg.load_fee, source=msg.node_public)
+        elif isinstance(msg, ClusterUpdate):
+            # TMCluster carries one entry per cluster node the sender
+            # knows; we accept only reports about cluster members, and
+            # the sender's own entry must come from the sender itself
+            if self.fee_track is not None and peer.node_public in self.cluster:
+                for st in msg.nodes:
+                    if st.node_public in self.cluster:
+                        self.fee_track.set_remote_fee(
+                            st.load_fee, source=st.node_public
+                        )
         elif isinstance(msg, Endpoints):
             accepted = self.peerfinder.on_endpoints(
                 msg.endpoints, sender=peer.remote
@@ -555,7 +566,7 @@ class TcpOverlay(ConsensusAdapter):
 
     def _first_seen(self, h: bytes, peer: _Peer) -> bool:
         """HashRouter relay suppression (reference: addSuppressionPeer)."""
-        return self.node.router.add_suppression_peer(h, id(peer))
+        return self.node.router.add_suppression_peer(h, peer.uid)
 
     def _relay(self, msg, except_peer: Optional[_Peer] = None) -> None:
         data = frame(msg)
@@ -671,7 +682,7 @@ class TcpOverlay(ConsensusAdapter):
             targets = [
                 p
                 for p in self.peers.values()
-                if not except_ids or id(p) not in except_ids
+                if not except_ids or p.uid not in except_ids
             ]
         for p in targets:
             p.send(data)

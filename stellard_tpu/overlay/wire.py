@@ -55,6 +55,7 @@ __all__ = [
     "StatusChange",
     "Endpoints",
     "ClusterStatus",
+    "ClusterUpdate",
     "GetObjects",
     "ObjectsData",
     "encode_message",
@@ -212,6 +213,14 @@ class ClusterStatus:
     node_public: bytes
     load_fee: int
     report_time: int
+
+
+@dataclass
+class ClusterUpdate:
+    """Decoded TMCluster: every clusterNodes entry (the field is
+    `repeated` — a member reports all cluster nodes it knows)."""
+
+    nodes: list = field(default_factory=list)  # [ClusterStatus, ...]
 
 
 @dataclass
@@ -401,29 +410,49 @@ def _dec_status(buf: bytes) -> StatusChange:
     )
 
 
-def _enc_cluster(m: ClusterStatus) -> bytes:
+def _cluster_node(m: ClusterStatus) -> Encoder:
     from ..protocol.keys import encode_node_public
 
     node = Encoder()
     node.string(1, encode_node_public(m.node_public))  # publicKey (base58)
     node.varint(2, m.report_time)  # reportTime
     node.varint(3, m.load_fee)  # nodeLoad
-    return Encoder().message(1, node).data()
+    return node
 
 
-def _dec_cluster(buf: bytes) -> ClusterStatus:
+def _enc_cluster(m: ClusterStatus) -> bytes:
+    return Encoder().message(1, _cluster_node(m)).data()
+
+
+def _enc_cluster_update(m: "ClusterUpdate") -> bytes:
+    e = Encoder()
+    for node in m.nodes:
+        e.message(1, _cluster_node(node))
+    return e.data()
+
+
+def _dec_cluster(buf: bytes) -> "ClusterUpdate":
+    """TMCluster.clusterNodes is `repeated`: a member may report every
+    cluster node it knows (or none — loadSources only). All entries
+    decode; malformed public keys skip their entry, never the message."""
     from ..protocol.keys import decode_node_public
 
     f = parse(buf)
-    nodes = f.get(1, [])
-    if not nodes:
-        raise ValueError("TMCluster without clusterNodes")
-    nf = parse(nodes[0])
-    return ClusterStatus(
-        node_public=decode_node_public(first_bytes(nf, 1).decode("utf-8")),
-        load_fee=first_int(nf, 3),
-        report_time=first_int(nf, 2),
-    )
+    nodes = []
+    for sub in f.get(1, []):
+        nf = parse(sub)
+        try:
+            pub = decode_node_public(first_bytes(nf, 1).decode("utf-8"))
+        except Exception:  # noqa: BLE001 — skip one bad entry, keep the rest
+            continue
+        nodes.append(
+            ClusterStatus(
+                node_public=pub,
+                load_fee=first_int(nf, 3),
+                report_time=first_int(nf, 2),
+            )
+        )
+    return ClusterUpdate(nodes)
 
 
 def _enc_endpoints(m: Endpoints) -> bytes:
@@ -484,6 +513,7 @@ _ENCODERS = {
     Hello: (MessageType.HELLO, _enc_hello),
     Ping: (MessageType.PING, _enc_ping),
     ClusterStatus: (MessageType.CLUSTER, _enc_cluster),
+    ClusterUpdate: (MessageType.CLUSTER, _enc_cluster_update),
     Endpoints: (MessageType.ENDPOINTS, _enc_endpoints),
     TxMessage: (MessageType.TRANSACTION, _enc_tx),
     GetLedger: (MessageType.GET_LEDGER, _enc_get_ledger),
@@ -520,8 +550,27 @@ def encode_message(msg) -> bytes:
     return enc(msg)
 
 
+# ripple.proto MessageType values we know of but do not implement:
+# mtERROR_MSG, mtPROOFOFWORK(wire), presence/discovery legacy
+# (mtGET_CONTACTS..mtUNUSED_FIELD), small-node ops
+# (mtSEARCH_TRANSACTION..mtACCOUNT), mtGET_VALIDATIONS
+_KNOWN_UNIMPLEMENTED = frozenset({2, 4, 10, 11, 12, 13, 14, 20, 21, 22, 40})
+
+
 def decode_message(mt: int, payload: bytes):
-    return _DECODERS[MessageType(mt)](payload)
+    """Decode one payload. Schema-known message types outside our subset
+    return None (skipped — a full-ripple.proto peer routinely sends
+    them, and protobuf compatibility means never erroring on them); a
+    type outside the schema entirely is a protocol violation and raises,
+    so the resource plane can charge the sender (reference: PeerImp's
+    invalid-message fee)."""
+    if mt in _KNOWN_UNIMPLEMENTED:
+        return None
+    try:
+        typ = MessageType(mt)
+    except ValueError:
+        raise ValueError(f"message type {mt} outside the wire schema") from None
+    return _DECODERS[typ](payload)
 
 
 def frame(msg) -> bytes:
@@ -551,5 +600,7 @@ class FrameReader:
             mt = int.from_bytes(self._buf[4:6], "big")
             payload = bytes(self._buf[HEADER_LEN : HEADER_LEN + length])
             del self._buf[: HEADER_LEN + length]
-            out.append(decode_message(mt, payload))
+            msg = decode_message(mt, payload)
+            if msg is not None:  # unknown type: skipped, stream continues
+                out.append(msg)
         return out

@@ -281,13 +281,36 @@ def execute_strand(
     requests folded in)."""
     if not hops:
         raise PathError(TER.tecPATH_DRY, "empty strand")
-    # per-hop output targets, computed backwards over account-hop fees
+    # REVERSE pass (reference: calcNodeAccountRev / calcNodeDeliverRev):
+    # per-hop output targets computed backwards, clamped by what each hop
+    # can actually move — a capacity-limited line downstream shrinks the
+    # request upstream, so a budget-limited book hop never buys input the
+    # rest of the strand cannot deliver (over-buying both wastes sendmax
+    # and degrades the strand's measured quality)
     targets: list[STAmount] = [None] * len(hops)  # type: ignore[list-item]
     need = out_target
     for i in range(len(hops) - 1, -1, -1):
         hop = hops[i]
-        targets[i] = need
         if isinstance(hop, AccountHop):
+            # the clamp is valid only where upstream execution cannot
+            # raise this hop's capacity: an account hop directly after a
+            # book hop moves value over the very line the book crossing
+            # just credited, so its pre-execution capacity understates
+            # (reference: calcNodeAccountRev computes caps against the
+            # previous node's deliverable, not the static line state)
+            after_book = i > 0 and isinstance(hops[i - 1], BookHop)
+            if hop.currency != CURRENCY_XRP and not after_book:
+                cap = line_capacity(les, hop.src, hop.dst, hop.currency)
+                if cap is None or cap.signum() <= 0:
+                    raise PathError(
+                        TER.tecPATH_DRY, "no line capacity (rev pass)"
+                    )
+                if cap < need:
+                    need = STAmount.from_iou(
+                        need.currency, need.issuer,
+                        cap.mantissa, cap.offset, cap.negative,
+                    )
+            targets[i] = need
             # the hop's source must first RECEIVE need*rate when it is an
             # intermediary gateway (reference: rippleTransferFee)
             if hop.src != src and hop.currency != CURRENCY_XRP:
@@ -300,6 +323,7 @@ def execute_strand(
                         need.issuer,
                     )
         else:
+            targets[i] = need
             # book input requirement discovered by quote
             in_needed, out_avail = book_quote(
                 les, hop.in_currency, hop.in_issuer, need

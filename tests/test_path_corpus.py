@@ -349,3 +349,58 @@ class TestCorpusPathfinder:
         assert best["source_amount"].is_native
         # the better book covers all 50 at 1:1
         assert best["source_amount"].drops() == 50 * XRP
+
+
+class TestCorpusReversePass:
+    """The reverse pass must shrink upstream requests to downstream
+    capacity (reference: calcNodeAccountRev clamping), so a strand never
+    over-spends through a book for value a later line cannot carry."""
+
+    def test_downstream_line_cap_limits_issuer_chain_spend(self):
+        """A1 -> G3 -> A2 same-currency ripple where A2's trust for G3
+        only admits 30: a partial payment of 50 delivers exactly 30 and
+        SPENDS exactly 30 — the clamp shows up in the spent amount."""
+        led = Scenario(
+            accounts={"A1": "1000.0", "A2": "1000.0", "G3": "1000.0"},
+            trusts=["A1:1000/ABC/G3", "A2:30/ABC/G3"],
+            ious=["A1:500/ABC/G3"],
+        ).build()
+        ter, spent, got = pay_via_paths(
+            led, "A1", "A2", "50/ABC/G3", partial=True
+        )
+        assert ter == TER.tesSUCCESS
+        assert text(got) == "30"
+        assert text(spent) == "30"
+
+    def test_rev_clamp_stops_book_overbuy(self):
+        """Cross-currency strand STR -> book -> ABC -> dst, where dst's
+        trust line admits only 10 ABC: the book must only be asked for
+        10, so the partial payment spends ~10 STR (1:1 book), not the
+        full 100-ABC budget."""
+        led = Scenario(
+            accounts={"A1": "1000.0", "A2": "1000.0", "G3": "1000.0",
+                      "M1": "11000.0"},
+            trusts=["A2:10/ABC/G3", "M1:1000/ABC/G3"],
+            ious=["M1:500/ABC/G3"],
+            offers=[("M1", "100.0", "100/ABC/G3")],  # 1 STR per ABC
+        ).build()
+        ter, spent, got = pay_via_paths(
+            led, "A1", "A2", "100/ABC/G3", send_max="500.0", partial=True
+        )
+        assert ter == TER.tesSUCCESS
+        assert text(got) == "10"
+        assert spent.is_native
+        # 10 ABC at 1 STR each (+ issuer transfer at par): ~10 STR, and
+        # certainly nowhere near the 100 the unclamped strand would buy
+        assert spent.drops() <= 11 * XRP, spent.drops()
+
+    def test_rev_pass_rejects_chain_with_no_line(self):
+        """A pure ripple chain through a gateway the recipient never
+        trusted is dry at the reverse pass already."""
+        led = Scenario(
+            accounts={"A1": "1000.0", "A2": "1000.0", "G3": "1000.0"},
+            trusts=["A1:1000/ABC/G3"],
+            ious=["A1:200/ABC/G3"],
+        ).build()
+        ter, _s, _g = pay_via_paths(led, "A1", "A2", "50/ABC/G3")
+        assert ter in (TER.tecPATH_DRY, TER.tecPATH_PARTIAL)

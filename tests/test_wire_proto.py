@@ -164,6 +164,26 @@ class TestRoundTrips:
         from stellard_tpu.protocol.keys import KeyPair
 
         pk = KeyPair.from_passphrase("cluster-node").public
+        pk2 = KeyPair.from_passphrase("cluster-node-2").public
         m = W.ClusterStatus(pk, 512, 777)
         out = W.decode_message(5, W.encode_message(m))
-        assert out == m
+        assert out == W.ClusterUpdate([m])
+        # clusterNodes is `repeated`: multi-node and node-less TMClusters
+        # are schema-legal and must decode, not disconnect the peer
+        multi = W.ClusterUpdate([m, W.ClusterStatus(pk2, 256, 778)])
+        assert W.decode_message(5, W.encode_message(multi)) == multi
+        assert W.decode_message(5, b"") == W.ClusterUpdate([])
+
+    def test_unknown_message_types_are_skipped(self):
+        # a full-ripple.proto peer sends types outside our subset
+        # (e.g. mtERROR_MSG=2, mtPROOFOFWORK=4): the frame is consumed
+        # and the stream continues — never an error/disconnect
+        assert W.decode_message(2, b"\x0a\x03abc") is None
+        reader = W.FrameReader()
+        unknown = (5).to_bytes(4, "big") + (4).to_bytes(2, "big") + b"\x08\x01abc"
+        got = reader.feed(unknown + W.frame(W.Ping(False, 9)))
+        assert got == [W.Ping(False, 9)]
+        # ...but a type outside the schema entirely is a violation (the
+        # resource plane charges the sender), not forward compatibility
+        with pytest.raises(ValueError):
+            W.decode_message(999, b"junk")
