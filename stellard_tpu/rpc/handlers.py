@@ -1239,9 +1239,46 @@ def do_blacklist(ctx: Context) -> dict:
 
 @handler("profile", Role.ADMIN)
 def do_profile(ctx: Context) -> dict:
-    """reference: handlers/Profile.cpp — the old load-generation tool;
-    deliberately unsupported (bench.py is this build's load harness)."""
-    raise RPCError("notImpl", "use bench.py for load generation")
+    """Device-plane profiler control (SURVEY §5 tracing). The reference's
+    Profile.cpp was a load generator (bench.py is that harness here);
+    this build's `profile` instead captures a JAX/XLA profiler trace of
+    what the device actually executes — TensorBoard XPlane format.
+
+    params: {"action": "start"|"stop"|"status", "dir": optional path}
+    """
+    import jax
+
+    p = ctx.params
+    node = ctx.node
+    action = p.get("action", "status")
+    if action == "start":
+        if getattr(node, "_trace_dir", None):
+            raise RPCError("internal", "trace already running")
+        trace_dir = p.get("dir")
+        if not trace_dir:
+            import tempfile
+
+            trace_dir = tempfile.mkdtemp(prefix="stellard-trace-")
+        try:
+            jax.profiler.start_trace(trace_dir)
+        except Exception as exc:  # noqa: BLE001 — surface, don't crash the door
+            raise RPCError("internal", f"profiler start failed: {exc}") from exc
+        node._trace_dir = trace_dir
+        return {"status": "tracing", "dir": trace_dir}
+    if action == "stop":
+        trace_dir = getattr(node, "_trace_dir", None)
+        if not trace_dir:
+            raise RPCError("internal", "no trace running")
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            node._trace_dir = None
+        return {"status": "stopped", "dir": trace_dir}
+    return {
+        "status": "tracing" if getattr(node, "_trace_dir", None) else "idle",
+        "dir": getattr(node, "_trace_dir", None),
+        "verify_latency": node.verify_plane.get_json()["latency_histogram_ms"],
+    }
 
 
 @handler("sms", Role.ADMIN)
