@@ -38,6 +38,7 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 import hashlib
+import os
 
 import numpy as np
 import jax
@@ -72,6 +73,12 @@ from .fe25519 import (
 
 WINDOW = 4
 NWINDOWS = 64  # ceil(256/4); scalars are < l < 2^253
+
+# static unroll factor for the 64-iteration scalar-walk loop: >1 gives
+# XLA a bigger window to software-pipeline at the cost of compile time.
+# Read once at import (a jit-time constant); default 1 keeps the graph
+# byte-identical to the rolled form (and the compilation cache warm).
+_UNROLL = int(os.environ.get("STELLARD_VERIFY_UNROLL", "1"))
 
 
 # --------------------------------------------------------------------------
@@ -353,7 +360,12 @@ def verify_kernel(a_words, r_words, s_windows, h_digits, s_canonical):
         acc_s = pt_add_mixed(acc_s, entry)
         return acc_h, acc_s
 
-    acc_h, acc_s = lax.fori_loop(0, NWINDOWS, body, (acc0_h, acc0_s))
+    if _UNROLL > 1:
+        acc_h, acc_s = lax.fori_loop(
+            0, NWINDOWS, body, (acc0_h, acc0_s), unroll=_UNROLL
+        )
+    else:
+        acc_h, acc_s = lax.fori_loop(0, NWINDOWS, body, (acc0_h, acc0_s))
     rp = pt_add_cached(acc_s, pt_to_cached(acc_h))
     enc = pt_encode_words(rp)
     eq = jnp.all(enc == rw, axis=0)
