@@ -494,12 +494,22 @@ class Node:
         # :1028); embedders that drive the node directly never arm it
         self.load_manager.arm()
         last_beat = 0.0
+        last_sweep = 0.0
         while self._running.is_set():
             # the heartbeat must flow THROUGH the job queue: a wedged
             # worker pool or master lock then starves the canary reset and
             # the detector fires (reference: the heartbeat is itself a
             # jtNETOP_TIMER job)
             now = _time.monotonic()
+            if now - last_sweep >= 30.0:
+                # cache sweep (reference: ApplicationImp::doSweep on the
+                # sweep timer — jtSWEEP job over the aged caches)
+                last_sweep = now
+                self.job_queue.add_job(
+                    JobType.jtSWEEP,
+                    "sweep",
+                    self.ledger_master.ledgers_by_hash.sweep,
+                )
             if now - last_beat >= 1.0:
                 last_beat = now
                 self.job_queue.add_job(

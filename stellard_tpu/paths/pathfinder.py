@@ -257,10 +257,15 @@ def find_paths(
             break
 
     def cost_key(r):
+        """Exact-rational cost ordering (float rounding must never flip
+        two near-equal alternatives — the reference compares exact
+        STAmount rates)."""
+        from fractions import Fraction
+
         a = r["source_amount"]
-        return a.mantissa * (10.0 ** a.offset) if not a.is_native else float(
-            a.mantissa
-        )
+        if a.is_native:
+            return Fraction(a.mantissa)
+        return Fraction(a.mantissa) * Fraction(10) ** a.offset
 
     results.sort(key=cost_key)
     return results[:max_paths]
