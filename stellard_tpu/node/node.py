@@ -510,6 +510,29 @@ class Node:
                     "sweep",
                     self.ledger_master.ledgers_by_hash.sweep,
                 )
+                # disk-space guard (reference: doSweep fatals under 512MB
+                # free, Application.cpp:1098-1106): stopping cleanly now
+                # beats corrupting the stores on a full disk later
+                if self.config.database_path:
+                    import os as _os
+                    import shutil
+
+                    try:
+                        free = shutil.disk_usage(
+                            _os.path.dirname(
+                                _os.path.abspath(self.config.database_path)
+                            )
+                        ).free
+                    except OSError:
+                        free = None
+                    if free is not None and free < 512 * 1024 * 1024:
+                        import logging
+
+                        logging.getLogger("stellard.node").critical(
+                            "remaining free disk space is less than "
+                            "512MB (%d bytes) — shutting down", free,
+                        )
+                        self._running.clear()
             if now - last_beat >= 1.0:
                 last_beat = now
                 self.job_queue.add_job(
