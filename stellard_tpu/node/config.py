@@ -93,9 +93,10 @@ class Config:
     # overlay clock (consensus windows shrink accordingly; 1.0 = live)
     clock_speed: float = 1.0
 
-    # -- ops ([node_size], fees) ------------------------------------------
+    # -- ops ([node_size], fees, [debug_logfile]) --------------------------
     node_size: str = "tiny"  # tiny|small|medium|large|huge (thread sizing)
     fee_default: int = 10
+    debug_logfile: str = ""  # full-severity log mirror on disk
     network_time_offset: int = 0
 
     @classmethod
@@ -163,6 +164,7 @@ class Config:
         cfg.node_size = one("node_size", cfg.node_size).lower()
         if one("fee_default"):
             cfg.fee_default = int(one("fee_default"))
+        cfg.debug_logfile = one("debug_logfile", cfg.debug_logfile)
         return cfg
 
     def thread_count(self) -> int:

@@ -405,6 +405,21 @@ class Node:
     def setup(self) -> "Node":
         """reference: ApplicationImp::setup — START_UP switch
         (Application.cpp:733-762)."""
+        if self.config.debug_logfile:
+            # [debug_logfile]: full-severity mirror on disk regardless of
+            # the console/partition levels (reference: setDebugLogFile,
+            # Application.cpp:687-689)
+            import logging
+
+            handler = logging.FileHandler(self.config.debug_logfile)
+            handler.setLevel(logging.DEBUG)
+            handler.setFormatter(logging.Formatter(
+                "%(asctime)s %(name)s %(levelname)s %(message)s"
+            ))
+            root = logging.getLogger("stellard")
+            root.addHandler(handler)
+            if root.level > logging.DEBUG or root.level == logging.NOTSET:
+                root.setLevel(logging.DEBUG)
         if self.config.start_up == "fresh":
             self.ledger_master.start_new_ledger(self.master_keys.account_id)
             # persist the genesis close so later offline replay can load

@@ -130,7 +130,7 @@ type=cpu
             )
         )
     try:
-        yield {"rpc_ports": rpc_ports, "procs": procs}
+        yield {"rpc_ports": rpc_ports, "ws_ports": ws_ports, "procs": procs}
     finally:
         for p in procs:
             p.terminate()
@@ -206,3 +206,21 @@ class TestMultiProcessNet:
             return True
 
         assert wait_until(landed, timeout=60), "payment never committed net-wide"
+
+    def test_ws_ledger_stream_on_networked_validator(self, net):
+        """The WS ledger stream must publish CONSENSUS closes, not just
+        standalone ledger_accept ones (the publish path rides the
+        overlay's accepted-ledger hook)."""
+        from test_rpc_server import WsClient
+
+        ws = WsClient(net["ws_ports"][1])
+        try:
+            resp = ws.call("subscribe", streams=["ledger"])
+            assert resp.get("status") == "success", resp
+            # consensus closes arrive as ledgerClosed events
+            ws.sock.settimeout(30)
+            evt = ws.recv()
+            assert evt["type"] == "ledgerClosed", evt
+            assert evt["ledger_index"] >= 1
+        finally:
+            ws.close()
