@@ -267,7 +267,17 @@ class TpuHasher(BatchHasher):
 
             from ..ops.treehash_jax import sha512_blocks_masked
 
-            cls._MASKED = jax.jit(sha512_blocks_masked)
+            devices = jax.devices()
+            n = len(devices)
+            if n > 1 and (n & (n - 1)) == 0 and n <= 8:
+                # flat-batch hashing shards data-parallel over the mesh
+                # (pad_leaf_batch rows are powers of two >= 8, so any
+                # power-of-two device count up to 8 divides them evenly)
+                from ..parallel.mesh import make_mesh, sharded_masked_sha512
+
+                cls._MASKED = sharded_masked_sha512(make_mesh(devices))
+            else:
+                cls._MASKED = jax.jit(sha512_blocks_masked)
         return cls._MASKED
 
     # -- whole-tree pipeline ----------------------------------------------

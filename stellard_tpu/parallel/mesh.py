@@ -53,6 +53,18 @@ def sharded_sha512_blocks(mesh: Mesh):
     return jax.jit(sha512_blocks, in_shardings=(shard,), out_shardings=shard)
 
 
+def sharded_masked_sha512(mesh: Mesh):
+    """jit of the masked mixed-block-count SHA-512 kernel (the tree
+    hasher's leaf/flat-batch workhorse) with the row dim sharded over the
+    mesh — the hashing twin of sharded_verify_kernel."""
+    from ..ops.treehash_jax import sha512_blocks_masked
+
+    shard = _batch_sharding(mesh)
+    return jax.jit(
+        sha512_blocks_masked, in_shardings=(shard, shard), out_shardings=shard
+    )
+
+
 def verify_and_count(mesh: Mesh):
     """shard_map pipeline: verify local shard, psum the per-chip valid
     counts over ICI -> (flags [B], total_valid scalar replicated).
