@@ -197,3 +197,109 @@ class TestWsDoor:
             assert resp["result"]["account_id"] == kp.human_account_id
         finally:
             ws.close()
+
+
+class TestHackBattery:
+    """Adversarial client behavior (reference: test/hack-test.js intent):
+    malformed bodies, wrong methods, junk blobs, abusive frames — the
+    doors must answer with clean errors and KEEP SERVING."""
+
+    def _raw_http(self, node, payload: bytes, method=b"POST",
+                  content_type=b"application/json") -> bytes:
+        s = socket.create_connection(("127.0.0.1", node.http_server.port),
+                                     timeout=10)
+        try:
+            head = (
+                method + b" / HTTP/1.1\r\nHost: x\r\nContent-Type: "
+                + content_type
+                + b"\r\nContent-Length: " + str(len(payload)).encode()
+                + b"\r\nConnection: close\r\n\r\n"
+            )
+            s.sendall(head + payload)
+            buf = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    return buf
+                buf += chunk
+        finally:
+            s.close()
+
+    def test_invalid_json_body(self, node):
+        resp = self._raw_http(node, b"{this is not json")
+        assert resp.split(b"\r\n")[0].endswith((b"400 Bad Request", b"200 OK"))
+        assert b"error" in resp
+
+    def test_wrong_http_method(self, node):
+        # GET is the health probe; anything else must not crash the door
+        resp = self._raw_http(node, b"", method=b"GET")
+        assert b"200 OK" in resp.split(b"\r\n")[0]
+        resp = self._raw_http(node, b"x", method=b"BREW")
+        assert b"HTTP/1.1" in resp  # clean HTTP error, not a hang/crash
+
+    def test_params_of_wrong_type(self, node):
+        # params must be a list-of-objects; hand it scalars and junk
+        for params in (42, "x", [1, 2, 3], {"not": "a list"}):
+            body = json.dumps({"method": "server_info", "params": params})
+            resp = self._raw_http(node, body.encode())
+            assert b"HTTP/1.1" in resp  # server answered, didn't die
+
+    def test_garbage_tx_blob(self, node):
+        r = rpc(node, "submit", tx_blob="zznothex")
+        assert r["error"] == "invalidTransaction"
+        r = rpc(node, "submit", tx_blob="00" * 40)  # hex but not a tx
+        assert r["error"] == "invalidTransaction"
+
+    def test_tampered_signed_blob_rejected(self, node):
+        alice = KeyPair.from_passphrase("hack-alice")
+        r = rpc(
+            node, "sign",
+            secret="masterpassphrase",
+            tx_json={
+                "TransactionType": "Payment",
+                "Account": node.master_keys.human_account_id,
+                "Destination": alice.human_account_id,
+                "Amount": "1000000",
+            },
+        )
+        blob = bytearray(bytes.fromhex(r["tx_blob"]))
+        blob[-3] ^= 0x40  # flip a bit near the tail (inside sig/amount)
+        r2 = rpc(node, "submit", tx_blob=bytes(blob).hex().upper())
+        assert r2.get("engine_result") != "tesSUCCESS"
+
+    def test_overflow_amount_rejected(self, node):
+        r = rpc(
+            node, "submit",
+            secret="masterpassphrase",
+            tx_json={
+                "TransactionType": "Payment",
+                "Account": node.master_keys.human_account_id,
+                "Destination": KeyPair.from_passphrase("hack-bob").human_account_id,
+                "Amount": str(10**30),  # > total coin supply
+            },
+        )
+        assert r["status"] == "error" or r.get("engine_result") != "tesSUCCESS"
+
+    def test_ws_junk_frames_then_clean_close(self, node):
+        # raw bytes that are not a valid websocket handshake
+        s = socket.create_connection(("127.0.0.1", node.ws_server.port),
+                                     timeout=10)
+        try:
+            s.sendall(b"\x00\xff" * 64)
+            s.settimeout(2)
+            try:
+                while s.recv(4096):
+                    pass
+            except (TimeoutError, OSError):
+                pass
+        finally:
+            s.close()
+        # the door still serves real clients
+        ws = WsClient(node.ws_server.port)
+        try:
+            assert ws.call("ping")["status"] == "success"
+        finally:
+            ws.close()
+
+    def test_doors_survive_the_battery(self, node):
+        assert rpc(node, "server_info")["status"] == "success"
