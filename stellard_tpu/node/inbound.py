@@ -154,9 +154,19 @@ class InboundLedgers:
         self.hash_batch = hash_batch
         self.live: dict[bytes, InboundLedger] = {}
         self.on_complete: Optional[Callable[[Ledger], None]] = None
+        # per-acquisition completion callbacks (repair path)
+        self._callbacks: dict[bytes, list[Callable]] = {}
 
-    def acquire(self, ledger_hash: bytes) -> InboundLedger:
+    def acquire(
+        self, ledger_hash: bytes, callback: Optional[Callable] = None
+    ) -> InboundLedger:
+        """Start (or join) an acquisition. `callback(ledger)` fires for
+        THIS request on completion, in addition to the global
+        on_complete — repair acquisitions (LedgerCleaner) persist old
+        ledgers without routing through the LCL-adoption path."""
         il = self.live.get(ledger_hash)
+        if callback is not None:
+            self._callbacks.setdefault(ledger_hash, []).append(callback)
         if il is None:
             il = InboundLedger(ledger_hash, self.hash_batch)
             self.live[ledger_hash] = il
@@ -189,8 +199,11 @@ class InboundLedgers:
             except (ValueError, KeyError):
                 il.failed = True
                 del self.live[msg.ledger_hash]
+                self._callbacks.pop(msg.ledger_hash, None)
                 return None
             del self.live[msg.ledger_hash]
+            for cb in self._callbacks.pop(msg.ledger_hash, []):
+                cb(ledger)
             if self.on_complete is not None:
                 self.on_complete(ledger)
             return ledger

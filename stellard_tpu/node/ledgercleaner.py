@@ -26,6 +26,8 @@ class LedgerCleaner:
         self.checked = 0
         self.failed: list[dict] = []
         self.range: tuple[int, int] = (0, 0)
+        self.repairs_requested = 0
+        self.repaired = 0
 
     def start(self, min_seq: Optional[int] = None,
               max_seq: Optional[int] = None) -> dict:
@@ -61,6 +63,10 @@ class LedgerCleaner:
             hdr = self.node.txdb.get_ledger_header(seq=seq)
             if hdr is None:
                 self.failed.append({"seq": seq, "problem": "missing header"})
+                # walking newest-first, the ledger above already told us
+                # this ledger's hash (its parent_hash) — acquirable
+                if prev_hash is not None:
+                    self._request_repair(seq, prev_hash)
                 prev_hash = None  # linkage unknown across the gap
                 continue
             try:
@@ -70,6 +76,7 @@ class LedgerCleaner:
                 )
             except (KeyError, ValueError) as e:
                 self.failed.append({"seq": seq, "problem": f"load: {e}"})
+                self._request_repair(seq, hdr["hash"])
                 prev_hash = None
                 self.checked += 1
                 continue
@@ -79,6 +86,34 @@ class LedgerCleaner:
             self.checked += 1
         with self._lock:
             self.state = "done"
+
+    def _request_repair(self, seq: int, ledger_hash: bytes) -> None:
+        """Ask the acquisition plane to re-fetch a broken/missing stored
+        ledger from peers and re-persist it (reference: LedgerCleaner's
+        acquire path). No-op without an overlay."""
+        overlay = getattr(self.node, "overlay", None)
+        if overlay is None:
+            return
+        vn = overlay.node
+
+        def persist(led):
+            from .node import _results_from_meta
+
+            try:
+                self.node.persist_ledger_data(led, _results_from_meta(led))
+                with self._lock:
+                    self.repaired += 1
+            except Exception:  # noqa: BLE001 — log, keep the cleaner alive
+                import logging
+
+                logging.getLogger("stellard.cleaner").exception(
+                    "repair persist failed for seq %d", seq
+                )
+
+        with vn.lock:
+            vn.inbound.acquire(ledger_hash, callback=persist)
+        with self._lock:
+            self.repairs_requested += 1
 
     def stop(self) -> dict:
         """Abort a running scan (reference: the handler's stop verb)."""
@@ -97,4 +132,6 @@ class LedgerCleaner:
                 "checked": self.checked,
                 "failures": list(self.failed[:16]),
                 "failure_count": len(self.failed),
+                "repairs_requested": self.repairs_requested,
+                "repaired": self.repaired,
             }

@@ -584,7 +584,10 @@ class Node:
         self._running.clear()
         self.load_manager.stop()
         if self.overlay is not None:
-            self.overlay.stop()
+            stop = getattr(self.overlay, "stop", None)
+            if stop is not None:  # embedders may attach bare adapters
+                stop()
+        if hasattr(self, "_persist_q"):
             self._persist_q.put(None)  # drain, then stop the persist worker
             self._persist_thread.join(timeout=60)
             if self._persist_thread.is_alive():
@@ -609,12 +612,19 @@ class Node:
     # -- persistence on close (reference: pendSaveValidated + CLF commit) --
 
     def _persist_closed_ledger(self, ledger: Ledger, results: dict) -> None:
-        ledger.save(self.nodestore)
-        self.txdb.save_ledger_header(ledger)
+        self.persist_ledger_data(ledger, results)
         # CLF commit: one scoped SQL transaction — entry-row delta + LCL
-        # pointer (reference: stellar::LedgerMaster::commitLedgerClose)
+        # pointer (reference: stellar::LedgerMaster::commitLedgerClose).
+        # NOT part of persist_ledger_data: a repaired HISTORICAL ledger
+        # must never move the CLF resume pointer backwards.
         prev = self.ledger_master.get_ledger_by_hash(ledger.parent_hash)
         self.clf.commit_ledger_close(ledger, prev)
+
+    def persist_ledger_data(self, ledger: Ledger, results: dict) -> None:
+        """NodeStore + header + tx rows for one ledger (no CLF pointer) —
+        the shared half of close-persistence and history repair."""
+        ledger.save(self.nodestore)
+        self.txdb.save_ledger_header(ledger)
         from ..protocol.meta import affected_accounts
 
         with self.txdb.batch():
