@@ -30,6 +30,8 @@ class InboundLedger:
     """One acquisition session (reference: InboundLedger.cpp:93-265)."""
 
     def __init__(self, ledger_hash: bytes, hash_batch: Optional[Callable] = None):
+        import time as _time
+
         self.hash = ledger_hash
         self.hash_batch = hash_batch
         self.header: Optional[bytes] = None
@@ -37,6 +39,8 @@ class InboundLedger:
         self.tx_map: Optional[IncompleteMap] = None
         self.state_map: Optional[IncompleteMap] = None
         self.failed = False
+        self.created_at = _time.monotonic()
+        self.last_progress = self.created_at
 
     # -- progress ---------------------------------------------------------
 
@@ -177,6 +181,24 @@ class InboundLedgers:
         for req in il.next_requests():
             self.send(req)
 
+    def expire_stale(self, max_age_s: float = 120.0) -> int:
+        """Drop acquisitions that made no progress for `max_age_s` —
+        unserveable requests (e.g. history no peer holds) must not pin
+        sessions and re-broadcast forever (reference: PeerSet failure
+        timeouts). Returns the number expired."""
+        import time as _time
+
+        now = _time.monotonic()
+        stale = [
+            h
+            for h, il in self.live.items()
+            if now - il.last_progress > max_age_s
+        ]
+        for h in stale:
+            del self.live[h]
+            self._callbacks.pop(h, None)
+        return len(stale)
+
     def take_ledger_data(self, msg: LedgerData) -> Optional[Ledger]:
         """Route a LedgerData reply; returns the finished ledger when an
         acquisition completes. Only replies that made progress re-trigger
@@ -193,6 +215,10 @@ class InboundLedgers:
                     progressed += 1
         else:
             progressed = il.take_nodes(msg.what, msg.nodes)
+        if progressed:
+            import time as _time
+
+            il.last_progress = _time.monotonic()
         if il.is_complete():
             try:
                 ledger = il.build_ledger()

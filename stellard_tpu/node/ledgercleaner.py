@@ -43,6 +43,8 @@ class LedgerCleaner:
             self.state = "running"
             self.checked = 0
             self.failed = []
+            self.repairs_requested = 0
+            self.repaired = 0
             self._stop.clear()
             self._thread = threading.Thread(
                 target=self._run, name="ledger-cleaner", daemon=True
@@ -87,22 +89,45 @@ class LedgerCleaner:
         with self._lock:
             self.state = "done"
 
+    # outstanding-repair cap per scan: a large corrupted range must not
+    # open thousands of live acquisition sessions at once
+    MAX_INFLIGHT_REPAIRS = 32
+
     def _request_repair(self, seq: int, ledger_hash: bytes) -> None:
         """Ask the acquisition plane to re-fetch a broken/missing stored
         ledger from peers and re-persist it (reference: LedgerCleaner's
-        acquire path). No-op without an overlay."""
+        acquire path). No-op without an overlay; capped in flight (the
+        stale-acquisition expiry reclaims unserveable requests)."""
         overlay = getattr(self.node, "overlay", None)
         if overlay is None:
             return
+        with self._lock:
+            if (
+                self.repairs_requested - self.repaired
+                >= self.MAX_INFLIGHT_REPAIRS
+            ):
+                return
+            self.repairs_requested += 1
         vn = overlay.node
 
+        def on_persisted():
+            with self._lock:
+                self.repaired += 1
+
         def persist(led):
+            # fires on the overlay message thread UNDER the master lock —
+            # hand the disk work to the node's ordered persist worker
+            # (concurrent TxDatabase batches are not safe, and disk time
+            # must not stall consensus); inline only when no worker exists
+            q = getattr(self.node, "_persist_q", None)
+            if q is not None:
+                q.put(("repair", led, {}, on_persisted))
+                return
             from .node import _results_from_meta
 
             try:
                 self.node.persist_ledger_data(led, _results_from_meta(led))
-                with self._lock:
-                    self.repaired += 1
+                on_persisted()
             except Exception:  # noqa: BLE001 — log, keep the cleaner alive
                 import logging
 
@@ -112,8 +137,6 @@ class LedgerCleaner:
 
         with vn.lock:
             vn.inbound.acquire(ledger_hash, callback=persist)
-        with self._lock:
-            self.repairs_requested += 1
 
     def stop(self) -> dict:
         """Abort a running scan (reference: the handler's stop verb)."""

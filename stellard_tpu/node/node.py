@@ -294,18 +294,26 @@ class Node:
                     item = self._persist_q.get()
                     if item is None:
                         return
-                    led, results = item
+                    kind, led, results, done = item
                     try:
                         if not results:
-                            # catch-up-adopted ledger: we never applied it
-                            # locally — recover per-tx results from the
-                            # sfTransactionResult metadata byte so status
-                            # promotion + WS streams report real codes
+                            # ledger we never applied locally (catch-up
+                            # adoption / history repair): recover per-tx
+                            # results from the sfTransactionResult
+                            # metadata byte so stored history and streams
+                            # report real codes
                             results = _results_from_meta(led)
-                        self._persist_closed_ledger(led, results)
-                        # WS streams + INCLUDED→COMMITTED promotion fire
-                        # for networked closes exactly as for standalone
-                        self.ops.publish_closed_ledger(led, results)
+                        if kind == "close":
+                            self._persist_closed_ledger(led, results)
+                            # WS streams + INCLUDED→COMMITTED promotion
+                            # fire for networked closes exactly as for
+                            # standalone ones
+                            self.ops.publish_closed_ledger(led, results)
+                        else:  # "repair": historical — no CLF pointer,
+                            # no publish (it is not a new close)
+                            self.persist_ledger_data(led, results)
+                        if done is not None:
+                            done()
                     except Exception:  # noqa: BLE001 — keep persisting later ledgers
                         import logging
 
@@ -319,7 +327,9 @@ class Node:
             self._persist_thread.start()
 
             def _persist_async(led):
-                self._persist_q.put((led, getattr(led, "apply_results", {})))
+                self._persist_q.put(
+                    ("close", led, getattr(led, "apply_results", {}), None)
+                )
 
             self.overlay.accepted_hooks.append(_persist_async)
 
@@ -363,6 +373,7 @@ class Node:
 
         self.master_keys = KeyPair.from_passphrase(MASTER_PASSPHRASE)
         self._running = threading.Event()
+        self._debug_log_handler = None
 
         # API doors (started by serve(); reference: WSDoors/RPCDoor
         # Application.cpp:817-891)
@@ -405,10 +416,12 @@ class Node:
     def setup(self) -> "Node":
         """reference: ApplicationImp::setup — START_UP switch
         (Application.cpp:733-762)."""
-        if self.config.debug_logfile:
+        if self.config.debug_logfile and self._debug_log_handler is None:
             # [debug_logfile]: full-severity mirror on disk regardless of
             # the console/partition levels (reference: setDebugLogFile,
-            # Application.cpp:687-689)
+            # Application.cpp:687-689). The handler is owned by this Node
+            # and detached on stop() so setup/stop cycles in one process
+            # neither duplicate lines nor leak descriptors.
             import logging
 
             handler = logging.FileHandler(self.config.debug_logfile)
@@ -420,6 +433,7 @@ class Node:
             root.addHandler(handler)
             if root.level > logging.DEBUG or root.level == logging.NOTSET:
                 root.setLevel(logging.DEBUG)
+            self._debug_log_handler = handler
         if self.config.start_up == "fresh":
             self.ledger_master.start_new_ledger(self.master_keys.account_id)
             # persist the genesis close so later offline replay can load
@@ -608,6 +622,12 @@ class Node:
         self.verify_plane.stop()
         self.nodestore.close()
         self.txdb.close()
+        if self._debug_log_handler is not None:
+            import logging
+
+            logging.getLogger("stellard").removeHandler(self._debug_log_handler)
+            self._debug_log_handler.close()
+            self._debug_log_handler = None
 
     # -- persistence on close (reference: pendSaveValidated + CLF commit) --
 

@@ -150,6 +150,7 @@ class ValidatorNode:
         # PeerSet timeouts); progress-driven triggers do the steady-state
         self._tick = getattr(self, "_tick", 0) + 1
         if self._tick % 2 == 0:
+            self.inbound.expire_stale()
             for il in list(self.inbound.live.values()):
                 self.inbound.trigger(il)
 
