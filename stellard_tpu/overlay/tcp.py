@@ -83,6 +83,11 @@ class _Peer:
         import queue
 
         self.uid = next(_Peer._NEXT_UID)
+        # acquisition scoring (reference: PeerSet peer selection): how
+        # many ledger-data requests we routed here and how many replies
+        # came back — the reply rate drives future routing
+        self.acq_requests = 0
+        self.acq_replies = 0
         self.sock = sock
         self.inbound = inbound
         self.addr = addr  # configured dial address (outbound only)
@@ -148,6 +153,14 @@ class _Peer:
         except OSError:
             pass
         self.sock.close()
+
+
+def _acq_score(p) -> tuple:
+    """Ordering key for acquisition routing: better reply rate first,
+    then fewer outstanding requests (min() picks the best)."""
+    rate = (p.acq_replies + 1) / (p.acq_requests + 1)
+    outstanding = p.acq_requests - p.acq_replies
+    return (-rate, outstanding)
 
 
 class TcpOverlay(ConsensusAdapter):
@@ -560,6 +573,7 @@ class TcpOverlay(ConsensusAdapter):
             if reply is not None:
                 peer.send(frame(reply))
         elif isinstance(msg, LedgerData):
+            peer.acq_replies += 1
             node.handle_ledger_data(msg)
         elif isinstance(msg, Ping) and not msg.is_pong:
             peer.send(frame(Ping(True, msg.seq)))
@@ -649,13 +663,21 @@ class TcpOverlay(ConsensusAdapter):
         self._broadcast(TxMessage(blob))
 
     def request_ledger_data(self, msg: GetLedger) -> None:
-        # anycast to one connected peer, rotating (reference: PeerSet)
+        """Anycast to the best-scoring connected peer (reference:
+        PeerSet's peer selection): highest observed reply rate, fewest
+        outstanding requests; every 8th request explores round-robin so
+        fresh peers earn a score and a decayed one can recover."""
         with self._peers_lock:
-            peers = sorted(self.peers.items())
+            peers = [p for _k, p in sorted(self.peers.items()) if p.alive]
         if not peers:
             return
         self._acq_rr = getattr(self, "_acq_rr", 0) + 1
-        peers[self._acq_rr % len(peers)][1].send(frame(msg))
+        if self._acq_rr % 8 == 0:
+            target = peers[(self._acq_rr // 8) % len(peers)]
+        else:
+            target = min(peers, key=_acq_score)
+        target.acq_requests += 1
+        target.send(frame(msg))
 
     def on_accepted(self, ledger: Ledger, round_ms: int) -> None:
         self.node.round_accepted(ledger, round_ms)

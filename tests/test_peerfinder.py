@@ -252,3 +252,33 @@ class TestDialChurn:
         time.sleep(5)  # several redial sweeps (sweep period 2s)
         after = [sessions(ov) for ov in overlays]
         assert before == after, "established sessions were churned"
+
+
+class TestAcquisitionScoring:
+    """PeerSet-style selection: ledger-data requests route to the peer
+    with the best observed reply rate, with periodic exploration."""
+
+    def test_best_reply_rate_wins(self):
+        from types import SimpleNamespace
+
+        from stellard_tpu.overlay.tcp import _acq_score
+
+        good = SimpleNamespace(acq_requests=10, acq_replies=9)
+        bad = SimpleNamespace(acq_requests=10, acq_replies=1)
+        fresh = SimpleNamespace(acq_requests=0, acq_replies=0)
+        ranked = sorted([bad, good, fresh], key=_acq_score)
+        # a fresh peer scores optimistically (1/1) so it gets tried
+        # before anything with history; a proven-good peer beats a
+        # proven-bad one
+        assert ranked == [fresh, good, bad]
+
+    def test_outstanding_breaks_ties(self):
+        from types import SimpleNamespace
+
+        from stellard_tpu.overlay.tcp import _acq_score
+
+        caught_up = SimpleNamespace(acq_requests=9, acq_replies=9)
+        backlogged = SimpleNamespace(acq_requests=19, acq_replies=9)
+        # backlogged peer has 10 unanswered requests in flight — the
+        # caught-up peer must rank first
+        assert _acq_score(caught_up) < _acq_score(backlogged)
