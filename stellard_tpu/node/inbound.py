@@ -22,6 +22,8 @@ __all__ = ["InboundLedger", "InboundLedgers", "serve_get_ledger"]
 
 # GetLedger.what codes
 W_HEADER = 0
+# reply-size budget for fat GetLedger answers (nodes per LedgerData)
+MAX_REPLY_NODES = 512
 W_TX_TREE = 1
 W_STATE_TREE = 2
 
@@ -260,12 +262,26 @@ def serve_get_ledger(ledger: Optional[Ledger], msg: GetLedger) -> Optional[Ledge
             except ValueError:
                 continue
     tree.get_hash()
+    from ..state.shamap import serialize_node_prefix
+
     for nid in ids:
         node = _descend(tree, nid)
-        if node is not None:
-            from ..state.shamap import serialize_node_prefix
-
-            nodes.append((nid.encode(), serialize_node_prefix(node)))
+        if node is None:
+            continue
+        nodes.append((nid.encode(), serialize_node_prefix(node)))
+        # FAT reply (reference: fetch-pack / 'fat' related-node serving):
+        # include one extra level under each served inner node, budget-
+        # bounded — the acquirer's frontier matching consumes multi-level
+        # replies, so each round trip moves the sync two levels
+        if hasattr(node, "children") and len(nodes) < MAX_REPLY_NODES:
+            for branch, child in enumerate(node.children):
+                if child is None:
+                    continue
+                if len(nodes) >= MAX_REPLY_NODES:
+                    break
+                nodes.append(
+                    (nid.child(branch).encode(), serialize_node_prefix(child))
+                )
     if not nodes:
         return None
     return LedgerData(msg.ledger_hash, ledger.seq, msg.what, nodes)

@@ -204,3 +204,52 @@ class TestCatchUp:
         led = net.validators[3].node.lm.validated
         root = led.account_root(alice.account_id)
         assert root is not None and root[sfBalance].drops() == 777 * XRP
+
+
+class TestFatReplies:
+    def test_serve_get_ledger_includes_children(self):
+        """One reply carries the requested inner PLUS its children, so a
+        sync descends two levels per round trip."""
+        from stellard_tpu.node.inbound import (
+            W_STATE_TREE,
+            InboundLedger,
+            serve_get_ledger,
+        )
+        from stellard_tpu.overlay.wire import GetLedger
+        from stellard_tpu.state.ledger import Ledger
+        from stellard_tpu.protocol.keys import KeyPair
+        from stellard_tpu.protocol.formats import TxType
+        from stellard_tpu.protocol.sfields import sfAmount, sfDestination
+        from stellard_tpu.protocol.stamount import STAmount
+        from stellard_tpu.protocol.sttx import SerializedTransaction
+        from stellard_tpu.engine.engine import TransactionEngine, TxParams
+
+        master = KeyPair.from_passphrase("masterpassphrase")
+        led = Ledger.genesis(master.account_id)
+        eng = TransactionEngine(led)
+        for i in range(40):  # enough accounts to force inner depth
+            dest = KeyPair.from_passphrase(f"fat-{i}")
+            tx = SerializedTransaction.build(
+                TxType.ttPAYMENT, master.account_id, i + 1, 10,
+                {
+                    sfAmount: STAmount.from_drops(200_000_000),
+                    sfDestination: dest.account_id,
+                },
+            )
+            tx.sign(master)
+            ter, _ = eng.apply_transaction(tx, TxParams.NONE)
+            assert int(ter) == 0
+        led.close(close_time=1000, close_resolution=10)
+
+        # ask for the state-tree root only
+        reply = serve_get_ledger(
+            led, GetLedger(led.hash(), 0, W_STATE_TREE, [])
+        )
+        assert reply is not None
+        assert len(reply.nodes) > 1, "fat reply must include children"
+
+        # a fresh acquirer consumes the whole multi-level reply
+        il = InboundLedger(led.hash())
+        assert il.take_header(led.header_bytes())
+        got = il.take_nodes(W_STATE_TREE, reply.nodes)
+        assert got == len(reply.nodes)
