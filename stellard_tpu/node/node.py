@@ -275,6 +275,7 @@ class Node:
                 ),
                 proposing=self.validation_keys is not None,
                 router=self.hash_router,
+                job_dispatch=self._peer_job_dispatch,
             )
             # persistence rides a dedicated ORDERED worker, NOT the
             # consensus tick (the hook fires under the master lock and a
@@ -380,6 +381,19 @@ class Node:
         self.http_server = None
         self.ws_server = None
         self.subs = None
+
+    def _peer_job_dispatch(self, kind: str, thunk) -> None:
+        """Overlay peer-message scheduler: proposals/validations ride
+        their reference job types (latency targets feed LoadMonitor;
+        the queue's per-type accounting makes them sheddable)."""
+        from .jobqueue import JobType
+
+        jt = (
+            JobType.jtPROPOSAL_t
+            if kind == "proposal"
+            else JobType.jtVALIDATION_t
+        )
+        self.job_queue.add_job(jt, kind, thunk)
 
     def _load_or_create_identity(self) -> KeyPair:
         """reference: LocalCredentials::start (wallet.db node seed) — a

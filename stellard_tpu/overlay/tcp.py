@@ -191,6 +191,7 @@ class TcpOverlay(ConsensusAdapter):
         verify_many: Optional[Callable] = None,
         proposing: bool = True,
         router=None,
+        job_dispatch: Optional[Callable[[str, Callable], None]] = None,
     ):
         self.key = key
         self.port = port
@@ -227,6 +228,11 @@ class TcpOverlay(ConsensusAdapter):
         # load fee so the whole cluster escalates together
         self.cluster = cluster or set()
         self.fee_track = fee_track  # node.loadmgr.LoadFeeTrack or None
+        # peer-message scheduler seam: when the application container
+        # wires its JobQueue here, proposal/validation handling becomes
+        # jtPROPOSAL_t/jtVALIDATION_t jobs (latency-tracked, sheddable);
+        # bare overlays handle inline
+        self.job_dispatch = job_dispatch
         self.gossip_interval = gossip_interval
         self._last_gossip = 0.0
         self._peers_lock = threading.Lock()
@@ -520,22 +526,39 @@ class TcpOverlay(ConsensusAdapter):
             prop = msg.to_proposal()
             pid = prop.suppression_id()
             if self._first_seen(pid, peer):
-                if node.handle_proposal(prop):
-                    self._relay(msg, except_peer=peer)
-                else:
-                    self._charge_if_bad(peer, pid)
+                # handling (sig check + round routing) rides a
+                # jtPROPOSAL_t job when a scheduler is wired (reference:
+                # PeerImp::recvPropose queues checkPropose); inline
+                # otherwise (bare-overlay tests)
+                def do_proposal(prop=prop, pid=pid, peer=peer, msg=msg):
+                    if node.handle_proposal(prop):
+                        self._relay(msg, except_peer=peer)
+                    else:
+                        self._charge_if_bad(peer, pid)
+
+                self._schedule("proposal", do_proposal)
         elif isinstance(msg, ValidationMessage):
             val = STValidation.from_bytes(msg.blob)
             vid = val.validation_id()
             if self._first_seen(vid, peer):
-                if node.handle_validation(val):
-                    if self.unl_store is not None and val.signer in self.unl_store:
-                        # observed-validation bookkeeping (the modern
-                        # unl_score: UniqueNodeList.on_validation)
-                        self.unl_store.on_validation(val.signer, val.ledger_seq)
-                    self._relay(msg, except_peer=peer)
-                else:
-                    self._charge_if_bad(peer, vid)
+                # jtVALIDATION_t job when scheduled (reference:
+                # PeerImp::recvValidation → checkValidation job)
+                def do_validation(val=val, vid=vid, peer=peer, msg=msg):
+                    if node.handle_validation(val):
+                        if (
+                            self.unl_store is not None
+                            and val.signer in self.unl_store
+                        ):
+                            # observed-validation bookkeeping (the modern
+                            # unl_score: UniqueNodeList.on_validation)
+                            self.unl_store.on_validation(
+                                val.signer, val.ledger_seq
+                            )
+                        self._relay(msg, except_peer=peer)
+                    else:
+                        self._charge_if_bad(peer, vid)
+
+                self._schedule("validation", do_validation)
         elif isinstance(msg, ClusterUpdate):
             # TMCluster carries one entry per cluster node the sender
             # knows; we accept only reports about cluster members, and
@@ -581,6 +604,12 @@ class TcpOverlay(ConsensusAdapter):
     def _first_seen(self, h: bytes, peer: _Peer) -> bool:
         """HashRouter relay suppression (reference: addSuppressionPeer)."""
         return self.node.router.add_suppression_peer(h, peer.uid)
+
+    def _schedule(self, kind: str, thunk: Callable) -> None:
+        if self.job_dispatch is not None:
+            self.job_dispatch(kind, thunk)
+        else:
+            thunk()
 
     def _relay(self, msg, except_peer: Optional[_Peer] = None) -> None:
         data = frame(msg)
