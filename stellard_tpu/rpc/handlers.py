@@ -839,11 +839,50 @@ def do_ripple_path_find(ctx: Context) -> dict:
 
 @handler("path_find")
 def do_path_find(ctx: Context) -> dict:
-    """reference: handlers/PathFind.cpp — the WebSocket subscription form;
-    the one-shot 'create' sub-command maps to a single search here."""
-    if ctx.params.get("subcommand", "create") != "create":
+    """reference: handlers/PathFind.cpp — the WebSocket subscription
+    form: `create` registers a LIVE path request (re-searched and pushed
+    to the subscriber on every ledger close, PathRequests role), `close`
+    tears it down, `status` reports it. Over HTTP (no subscriber), a
+    create degrades to the one-shot search."""
+    sub_cmd = ctx.params.get("subcommand", "create")
+    if sub_cmd == "close":
+        if ctx.infosub is not None and ctx.subs is not None:
+            rid = ctx.params.get("id")
+            closed = ctx.subs.close_path_request(
+                ctx.infosub, int(rid) if rid is not None else None
+            )
+            return {"closed": closed}
         return {"closed": True}
-    return do_ripple_path_find(ctx)
+    if sub_cmd == "status":
+        if ctx.infosub is None:
+            raise RPCError("notSupported", "status requires a websocket")
+        return {
+            "requests": [
+                {"id": rid, **req.get("echo", {})}
+                for rid, req in ctx.infosub.path_requests.items()
+            ]
+        }
+    if sub_cmd != "create":
+        raise RPCError("invalidParams", f"unknown subcommand {sub_cmd!r}")
+    out = do_ripple_path_find(ctx)
+    if ctx.infosub is not None and ctx.subs is not None:
+        from ..protocol.stamount import STAmount as _STA
+
+        p = ctx.params
+        request = {
+            "src": decode_account_id(p["source_account"]),
+            "dst": decode_account_id(p["destination_account"]),
+            "dst_amount": _STA.from_json(p["destination_amount"]),
+            "echo": {
+                "source_account": p["source_account"],
+                "destination_account": p["destination_account"],
+                "destination_amount": p["destination_amount"],
+            },
+        }
+        if "send_max" in p:
+            request["send_max"] = _STA.from_json(p["send_max"])
+        out["id"] = ctx.subs.create_path_request(ctx.infosub, request)
+    return out
 
 
 # --------------------------------------------------------------------------

@@ -32,6 +32,10 @@ class InfoSub:
         self.streams: set[str] = set()
         self.accounts: set[bytes] = set()
         self.accounts_proposed: set[bytes] = set()
+        # live path-find subscriptions (reference: PathRequest) —
+        # request id -> decoded {src, dst, dst_amount, send_max, echo}
+        self.path_requests: dict[int, dict] = {}
+        self._next_path_id = 0
 
 
 class SubscriptionManager:
@@ -78,6 +82,53 @@ class SubscriptionManager:
         target.update(accounts)
         self.add(sub)
 
+    # -- path-find subscriptions (reference: PathRequests) ----------------
+
+    def create_path_request(self, sub: InfoSub, request: dict) -> int:
+        """Register a live path search; updates push on every close."""
+        sub._next_path_id += 1
+        rid = sub._next_path_id
+        sub.path_requests[rid] = request
+        self.add(sub)
+        return rid
+
+    def close_path_request(self, sub: InfoSub,
+                           rid: Optional[int] = None) -> bool:
+        if rid is None:
+            had = bool(sub.path_requests)
+            sub.path_requests.clear()
+            return had
+        return sub.path_requests.pop(rid, None) is not None
+
+    def _pub_path_updates(self, ledger: Ledger) -> None:
+        from ..paths import find_paths
+        from ..protocol.stobject import STPathSet
+
+        for sub in self._each():
+            for rid, req in list(sub.path_requests.items()):
+                try:
+                    alts = find_paths(
+                        ledger, req["src"], req["dst"], req["dst_amount"],
+                        send_max=req.get("send_max"),
+                    )
+                except Exception:  # noqa: BLE001 — a bad request must not kill publishing
+                    continue
+                msg = {
+                    "type": "path_find",
+                    "id": rid,
+                    "full_reply": True,
+                    "ledger_index": ledger.seq,
+                    "alternatives": [
+                        {
+                            "paths_computed": STPathSet(a["paths"]).to_json(),
+                            "source_amount": a["source_amount"].to_json(),
+                        }
+                        for a in alts
+                    ],
+                    **req.get("echo", {}),
+                }
+                self._safe_send(sub, msg)
+
     def unsubscribe_accounts(self, sub: InfoSub, accounts: list[bytes],
                              proposed: bool = False) -> None:
         target = sub.accounts_proposed if proposed else sub.accounts
@@ -123,6 +174,9 @@ class SubscriptionManager:
             tx = SerializedTransaction.from_bytes(blob)
             ter = results.get(txid, TER.tesSUCCESS)
             self._pub_tx(tx, ter, ledger=ledger, validated=True, meta=meta)
+        # live path-find subscriptions re-search against the new state
+        # (reference: PathRequests::updateAll on jtUPDATE_PF)
+        self._pub_path_updates(ledger)
 
     def _pub_proposed(self, tx: SerializedTransaction, ter: TER) -> None:
         self._pub_tx(tx, ter, ledger=None, validated=False)

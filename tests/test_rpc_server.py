@@ -303,3 +303,39 @@ class TestHackBattery:
 
     def test_doors_survive_the_battery(self, node):
         assert rpc(node, "server_info")["status"] == "success"
+
+
+class TestPathFindSubscription:
+    def test_live_path_updates_on_close(self, node):
+        """path_find create over WS registers a live request; every
+        ledger close pushes a fresh full_reply (PathRequests role)."""
+        ws = WsClient(node.ws_server.port)
+        try:
+            resp = ws.call(
+                "path_find",
+                subcommand="create",
+                source_account=node.master_keys.human_account_id,
+                destination_account=KeyPair.from_passphrase("pf-alice").human_account_id,
+                destination_amount={
+                    "currency": "USD",
+                    "issuer": node.master_keys.human_account_id,
+                    "value": "5",
+                },
+            )
+            assert resp["status"] == "success", resp
+            rid = resp["result"]["id"]
+
+            rpc(node, "ledger_accept")
+            ws.sock.settimeout(10)
+            while True:
+                msg = ws.recv()
+                if msg.get("type") == "path_find":
+                    break
+            assert msg["id"] == rid
+            assert msg["full_reply"] is True
+            assert "alternatives" in msg
+
+            closed = ws.call("path_find", subcommand="close", id=rid)
+            assert closed["result"]["closed"] is True
+        finally:
+            ws.close()
