@@ -187,3 +187,39 @@ class TestRoundTrips:
         # resource plane charges the sender), not forward compatibility
         with pytest.raises(ValueError):
             W.decode_message(999, b"junk")
+
+
+class TestCodecFuzz:
+    def test_random_bytes_never_crash_the_parser(self):
+        """parse() on arbitrary bytes either returns a field dict or
+        raises ValueError — no other exception class may escape (the
+        overlay charges-and-drops on ValueError; anything else would
+        kill the session thread)."""
+        import random
+
+        rng = random.Random(1234)
+        for _ in range(2000):
+            buf = bytes(rng.getrandbits(8) for _ in range(rng.randrange(0, 64)))
+            try:
+                parse(buf)
+            except ValueError:
+                pass
+
+    def test_truncated_real_messages_never_crash_decoders(self):
+        import pytest as _pytest
+
+        msgs = [
+            W.Hello(1, 99, b"\x02" * 32, b"\x03" * 64, 7, H32, 1234),
+            W.ProposeSet(1, 2, b"\x01" * 32, b"\x02" * 32, b"\x03" * 32,
+                         b"\x04" * 64),
+            W.LedgerData(H32, 9, 1, [(b"\x00", b"blob")]),
+            W.Endpoints([("127.0.0.1", 1024, 0)]),
+        ]
+        for m in msgs:
+            mt, enc = W._ENCODERS[type(m)]
+            payload = enc(m)
+            for cut in range(len(payload)):
+                try:
+                    W.decode_message(int(mt), payload[:cut])
+                except ValueError:
+                    continue
