@@ -198,18 +198,20 @@ class InboundLedgers:
         ]
         for h in stale:
             del self.live[h]
-            self._callbacks.pop(h, None)
+            for cb in self._callbacks.pop(h, []):
+                cb(None)  # expiry: callers release their slots
         return len(stale)
 
-    def take_ledger_data(self, msg: LedgerData) -> Optional[Ledger]:
-        """Route a LedgerData reply; returns the finished ledger when an
-        acquisition completes. Only replies that made progress re-trigger
+    def take_ledger_data(self, msg: LedgerData) -> int:
+        """Route a LedgerData reply; returns how much PROGRESS it made
+        (0 = ignored/duplicate/unknown — callers use this to score the
+        sending peer). Only replies that made progress re-trigger
         requests — a duplicate reply from a second peer must not fan out
         another request wave (the reference throttles the same way via
         PeerSet progress timeouts)."""
         il = self.live.get(msg.ledger_hash)
         if il is None:
-            return None
+            return 0
         progressed = 0
         if msg.what == W_HEADER:
             for _nid, blob in msg.nodes:
@@ -227,17 +229,18 @@ class InboundLedgers:
             except (ValueError, KeyError):
                 il.failed = True
                 del self.live[msg.ledger_hash]
-                self._callbacks.pop(msg.ledger_hash, None)
-                return None
+                for cb in self._callbacks.pop(msg.ledger_hash, []):
+                    cb(None)  # failure: callers release their slots
+                return progressed
             del self.live[msg.ledger_hash]
             for cb in self._callbacks.pop(msg.ledger_hash, []):
                 cb(ledger)
             if self.on_complete is not None:
                 self.on_complete(ledger)
-            return ledger
+            return max(progressed, 1)
         if progressed:
             self.trigger(il)
-        return None
+        return progressed
 
 
 def serve_get_ledger(ledger: Optional[Ledger], msg: GetLedger) -> Optional[LedgerData]:

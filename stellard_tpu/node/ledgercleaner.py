@@ -28,6 +28,7 @@ class LedgerCleaner:
         self.range: tuple[int, int] = (0, 0)
         self.repairs_requested = 0
         self.repaired = 0
+        self.repairs_failed = 0
 
     def start(self, min_seq: Optional[int] = None,
               max_seq: Optional[int] = None) -> dict:
@@ -45,6 +46,7 @@ class LedgerCleaner:
             self.failed = []
             self.repairs_requested = 0
             self.repaired = 0
+            self.repairs_failed = 0
             self._stop.clear()
             self._thread = threading.Thread(
                 target=self._run, name="ledger-cleaner", daemon=True
@@ -102,10 +104,10 @@ class LedgerCleaner:
         if overlay is None:
             return
         with self._lock:
-            if (
-                self.repairs_requested - self.repaired
-                >= self.MAX_INFLIGHT_REPAIRS
-            ):
+            in_flight = (
+                self.repairs_requested - self.repaired - self.repairs_failed
+            )
+            if in_flight >= self.MAX_INFLIGHT_REPAIRS:
                 return
             self.repairs_requested += 1
         vn = overlay.node
@@ -115,6 +117,13 @@ class LedgerCleaner:
                 self.repaired += 1
 
         def persist(led):
+            # led is None when the acquisition expired or failed to
+            # build — release the in-flight slot so later repairs in the
+            # scan are not starved by unserveable requests
+            if led is None:
+                with self._lock:
+                    self.repairs_failed += 1
+                return
             # fires on the overlay message thread UNDER the master lock —
             # hand the disk work to the node's ordered persist worker
             # (concurrent TxDatabase batches are not safe, and disk time
@@ -157,4 +166,5 @@ class LedgerCleaner:
                 "failure_count": len(self.failed),
                 "repairs_requested": self.repairs_requested,
                 "repaired": self.repaired,
+                "repairs_failed": self.repairs_failed,
             }

@@ -334,9 +334,15 @@ class ValidatorNode:
             return current
 
     @_locked
-    def handle_ledger_data(self, msg) -> None:
-        """Route a LedgerData reply into the acquisition machinery."""
-        self.inbound.take_ledger_data(msg)
+    def handle_ledger_data(self, msg) -> bool:
+        """Route a LedgerData reply into the acquisition machinery.
+        Returns True when the reply made progress (callers score the
+        sending peer on this — unsolicited data must earn nothing)."""
+        return bool(self.inbound.take_ledger_data(msg))
+
+    @_locked
+    def has_acquisition(self, ledger_hash: bytes) -> bool:
+        return ledger_hash in self.inbound.live
 
     @_locked
     def serve_get_ledger(self, msg):

@@ -596,8 +596,14 @@ class TcpOverlay(ConsensusAdapter):
             if reply is not None:
                 peer.send(frame(reply))
         elif isinstance(msg, LedgerData):
-            peer.acq_replies += 1
-            node.handle_ledger_data(msg)
+            # only replies that actually advanced an acquisition score —
+            # unsolicited LedgerData must not buy routing preference.
+            # Duplicates for LIVE acquisitions are legitimate (we fan
+            # out); data for unknown hashes earns a small charge
+            if node.handle_ledger_data(msg):
+                peer.acq_replies += 1
+            elif not node.has_acquisition(msg.ledger_hash):
+                self._charge(peer, FEE_UNWANTED_DATA)
         elif isinstance(msg, Ping) and not msg.is_pong:
             peer.send(frame(Ping(True, msg.seq)))
 

@@ -848,9 +848,12 @@ def do_path_find(ctx: Context) -> dict:
     if sub_cmd == "close":
         if ctx.infosub is not None and ctx.subs is not None:
             rid = ctx.params.get("id")
-            closed = ctx.subs.close_path_request(
-                ctx.infosub, int(rid) if rid is not None else None
-            )
+            if rid is not None:
+                try:
+                    rid = int(rid)
+                except (TypeError, ValueError):
+                    raise RPCError("invalidParams", "id must be an integer")
+            closed = ctx.subs.close_path_request(ctx.infosub, rid)
             return {"closed": closed}
         return {"closed": True}
     if sub_cmd == "status":

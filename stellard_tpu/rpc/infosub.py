@@ -174,9 +174,18 @@ class SubscriptionManager:
             tx = SerializedTransaction.from_bytes(blob)
             ter = results.get(txid, TER.tesSUCCESS)
             self._pub_tx(tx, ter, ledger=ledger, validated=True, meta=meta)
-        # live path-find subscriptions re-search against the new state
-        # (reference: PathRequests::updateAll on jtUPDATE_PF)
-        self._pub_path_updates(ledger)
+        # live path-find subscriptions re-search against the new state on
+        # a jtUPDATE_PF job (reference: PathRequests::updateAll) — NOT on
+        # this thread, which in networked mode is the ordered persist
+        # worker and must not serialize pathfinding into ledger persists
+        if any(s.path_requests for s in self._each()):
+            from ..node.jobqueue import JobType
+
+            self.ops.jq.add_job(
+                JobType.jtUPDATE_PF,
+                "pathUpdates",
+                lambda: self._pub_path_updates(ledger),
+            )
 
     def _pub_proposed(self, tx: SerializedTransaction, ter: TER) -> None:
         self._pub_tx(tx, ter, ledger=None, validated=False)
