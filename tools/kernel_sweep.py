@@ -1,0 +1,107 @@
+"""On-chip measurement sweep: verify kernel (batch x unroll) + tree hashing.
+
+Run on a host with the TPU tunnel up (`python tools/kernel_sweep.py`).
+Each configuration runs in a SUBPROCESS so a wedged tunnel session can
+never kill the whole sweep (see PERF.md for why that matters here), and
+the signed test set is cached on disk so retries are cheap.
+"""
+import os, sys, time, subprocess
+import numpy as np
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+CACHE = "/tmp/sigset.npz"
+
+def ensure_sigset():
+    if os.path.exists(CACHE):
+        return
+    from stellard_tpu.protocol.keys import KeyPair
+    rng = np.random.default_rng(0)
+    keys = [KeyPair.from_seed(bytes(rng.integers(0,256,32,dtype=np.uint8))) for _ in range(64)]
+    N = 8192
+    msgs = [bytes(rng.integers(0,256,32,dtype=np.uint8)) for _ in range(N)]
+    sigs = [keys[i%64].sign(msgs[i]) for i in range(N)]
+    pubs = [keys[i%64].public for i in range(N)]
+    np.savez(CACHE,
+             pubs=np.frombuffer(b"".join(pubs), np.uint8).reshape(N,32),
+             msgs=np.frombuffer(b"".join(msgs), np.uint8).reshape(N,32),
+             sigs=np.frombuffer(b"".join(sigs), np.uint8).reshape(N,64))
+
+def one_config(unroll, batches):
+    """Run one (unroll, batches) measurement in a SUBPROCESS so each
+    tunnel session is fresh and a wedge can't kill the sweep."""
+    code = f'''
+import os, sys, time
+import numpy as np
+os.environ.pop("JAX_PLATFORMS", None)
+os.environ["STELLARD_VERIFY_UNROLL"] = "{unroll}"
+sys.path.insert(0, "/root/repo")
+import jax
+assert jax.devices()[0].platform != "cpu", "no tpu"
+from stellard_tpu.utils.xlacache import enable_compilation_cache
+enable_compilation_cache()
+from stellard_tpu.ops.ed25519_jax import prepare_batch, verify_kernel
+z = np.load("{CACHE}")
+for batch in {batches}:
+    pubs = [z["pubs"][i].tobytes() for i in range(batch)]
+    msgs = [z["msgs"][i].tobytes() for i in range(batch)]
+    sigs = [z["sigs"][i].tobytes() for i in range(batch)]
+    inp = prepare_batch(pubs, msgs, sigs)
+    t0=time.time(); out = verify_kernel(**inp); out.block_until_ready()
+    print(f"unroll={unroll} batch={{batch}} compile {{time.time()-t0:.0f}}s", flush=True)
+    assert np.asarray(out).all()
+    t0=time.time(); n=0
+    while time.time()-t0 < 5:
+        verify_kernel(**inp).block_until_ready(); n+=1
+    dt=(time.time()-t0)/n
+    print(f"RESULT unroll={unroll} batch={{batch}} lat={{dt*1000:.1f}}ms rate={{batch/dt:,.0f}} sigs/s", flush=True)
+'''
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=1500)
+    out = "\n".join(l for l in (r.stdout + r.stderr).splitlines()
+                    if "WARNING" not in l and l.strip())
+    print(out, flush=True)
+    return r.returncode == 0
+
+def tree_hash_bench():
+    code = '''
+import os, sys, time
+import numpy as np
+os.environ.pop("JAX_PLATFORMS", None)
+sys.path.insert(0, "/root/repo")
+import jax
+assert jax.devices()[0].platform != "cpu", "no tpu"
+from stellard_tpu.utils.xlacache import enable_compilation_cache
+enable_compilation_cache()
+from stellard_tpu.crypto.backend import make_hasher
+from stellard_tpu.state.shamap import SHAMap, SHAMapItem, TNType
+
+def build(n, seed):
+    rng = np.random.default_rng(seed)
+    m = SHAMap(TNType.ACCOUNT_STATE)
+    for i in range(n):
+        m.set_item(SHAMapItem(rng.bytes(32), rng.bytes(int(rng.integers(40, 600)))))
+    return m
+
+for n_leaves in (1000, 5000):
+    for name in ("cpu", "tpu"):
+        h = make_hasher(name)
+        m = build(n_leaves, n_leaves)
+        m.hash_batch = h
+        t0=time.time(); m.get_hash(); c=time.time()-t0
+        m2 = build(n_leaves, n_leaves + 1)
+        m2.hash_batch = h
+        t0=time.time(); m2.get_hash(); dt=time.time()-t0
+        print(f"RESULT treehash backend={name} leaves={n_leaves} first={c:.2f}s warm={dt*1000:.0f}ms", flush=True)
+'''
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=1500)
+    print("\n".join(l for l in (r.stdout+r.stderr).splitlines()
+                    if "WARNING" not in l and l.strip()), flush=True)
+
+if __name__ == "__main__":
+    ensure_sigset()
+    one_config(1, [2048, 4096, 8192])
+    one_config(4, [4096])
+    one_config(8, [4096])
+    tree_hash_bench()
+    print("SWEEP DONE", flush=True)
