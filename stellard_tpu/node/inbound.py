@@ -14,8 +14,8 @@ from typing import Callable, Optional
 
 from ..overlay.wire import GetLedger, LedgerData
 from ..state.ledger import Ledger, parse_header
-from ..state.shamap import SHAMap, TNType, ZERO256
-from ..state.shamapsync import IncompleteMap, SHAMapNodeID, make_fetch_pack
+from ..state.shamap import SHAMap, TNType
+from ..state.shamapsync import IncompleteMap, SHAMapNodeID
 from ..utils.hashes import HP_LEDGER_MASTER, prefix_hash
 
 __all__ = ["InboundLedger", "InboundLedgers", "serve_get_ledger"]

@@ -24,7 +24,6 @@ from ..protocol.sfields import (
 )
 from ..protocol.stamount import ACCOUNT_ZERO, STAmount
 from ..protocol.stobject import PathElement
-from ..protocol.ter import TER
 from ..state import indexes
 from ..state.entryset import LedgerEntrySet
 from .flow import CURRENCY_XRP, PathError, execute_strand, plan_strand

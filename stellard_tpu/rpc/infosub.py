@@ -12,7 +12,6 @@ from __future__ import annotations
 import threading
 from typing import Callable, Optional
 
-from ..protocol.keys import encode_account_id
 from ..protocol.sttx import SerializedTransaction
 from ..protocol.ter import TER
 from ..state.ledger import Ledger
