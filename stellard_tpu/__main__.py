@@ -14,6 +14,9 @@ import urllib.request
 
 
 def main(argv: list[str] | None = None) -> int:
+    from .utils.fatal import install as install_fatal_reporter
+
+    install_fatal_reporter()
     ap = argparse.ArgumentParser(prog="stellard-tpu")
     ap.add_argument("--conf", default="", help="config file (INI sections)")
     ap.add_argument("-a", "--standalone", action="store_true",
@@ -39,8 +42,25 @@ def main(argv: list[str] | None = None) -> int:
                          "crashes (reference: DoSustain, Main.cpp:261-275)")
     ap.add_argument("--replay", action="store_true",
                     help="replay stored ledger --ledger and verify its hash")
+    ap.add_argument("--unittest", metavar="PATTERN", nargs="?", const="",
+                    default=None,
+                    help="run the test suite (optionally filtered by "
+                         "PATTERN) and exit (reference: Main.cpp:293-301)")
     ap.add_argument("command", nargs="*", help="RPC client command")
     args = ap.parse_args(argv)
+
+    if args.unittest is not None:
+        # reference: `stellard --unittest [pattern]` runs the in-source
+        # suites with a memory NodeStore; here the suite is pytest-driven
+        # and pins the 8-device virtual CPU mesh itself (tests/conftest)
+        import os
+        import subprocess
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        cmd = [sys.executable, "-m", "pytest", "tests/", "-q"]
+        if args.unittest:
+            cmd += ["-k", args.unittest]
+        return subprocess.call(cmd, cwd=repo)
 
     from .node.config import Config
 

@@ -1,0 +1,34 @@
+"""Fatal-error reporting (reference: FatalErrorReporter + the
+terminateHandler wiring at Application.cpp:645-653): uncaught exceptions
+and hard faults are logged with full context before the process dies,
+instead of vanishing into a bare traceback on a detached stderr."""
+
+from __future__ import annotations
+
+import faulthandler
+import logging
+import sys
+
+_log = logging.getLogger("stellard.fatal")
+_installed = False
+
+
+def install() -> None:
+    """Idempotently install the fault/exception reporters."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    # native-level faults (SIGSEGV/SIGABRT/...) dump all thread stacks
+    try:
+        faulthandler.enable()
+    except (RuntimeError, AttributeError):  # no usable stderr (daemonized)
+        pass
+    previous = sys.excepthook
+
+    def report(exc_type, exc, tb):
+        _log.critical("FATAL: uncaught %s: %s", exc_type.__name__, exc,
+                      exc_info=(exc_type, exc, tb))
+        previous(exc_type, exc, tb)
+
+    sys.excepthook = report
