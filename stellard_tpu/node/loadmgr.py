@@ -46,16 +46,35 @@ class LoadFeeTrack:
         # keeps per-node ClusterNodeStatus entries)
         self._remote: dict[bytes, tuple[int, float]] = {}
         self.raise_count = 0
+        # change hooks (the `server` stream publishes serverStatus on
+        # load-factor movement — reference: NetworkOPs::pubServer)
+        self.on_change: list = []
+
+    def _fire_change(self) -> None:
+        for cb in list(self.on_change):
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — observers must not break fee tracking
+                pass
 
     def raise_local_fee(self) -> None:
         with self._lock:
+            before = self._local
             self._local = min(MAX_FEE, self._local + max(1, self._local // 4))
             self.raise_count += 1
+            changed = self._local != before
+        if changed:
+            self._fire_change()
 
     def lower_local_fee(self) -> None:
+        changed = False
         with self._lock:
             if self._local > NORMAL_FEE:
+                before = self._local
                 self._local = max(NORMAL_FEE, self._local - max(1, self._local // 4))
+                changed = self._local != before
+        if changed:
+            self._fire_change()
 
     def set_remote_fee(self, fee: int, source: bytes = b"") -> None:
         """From cluster/peer load reports (sfLoadFee in validations),

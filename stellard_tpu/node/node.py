@@ -483,6 +483,8 @@ class Node:
         from ..rpc.infosub import SubscriptionManager
 
         self.subs = SubscriptionManager(self.ops)
+        # `server` stream: publish on load-factor movement (pubServer)
+        self.fee_track.on_change.append(self.subs.pub_server_status)
         if self.config.rpc_port is not None:
             from ..rpc.http_server import HttpRpcServer
 

@@ -186,6 +186,20 @@ class SubscriptionManager:
                 lambda: self._pub_path_updates(ledger),
             )
 
+    def pub_server_status(self) -> None:
+        """serverStatus event to `server`-stream subscribers (reference:
+        NetworkOPs::pubServer on load-factor movement)."""
+        ft = getattr(self.ops, "fee_track", None)
+        msg = {
+            "type": "serverStatus",
+            "server_status": self.ops.server_state(),
+            "load_base": 256,
+            "load_factor": ft.load_factor if ft is not None else 256,
+        }
+        for sub in self._each():
+            if "server" in sub.streams:
+                self._safe_send(sub, msg)
+
     def _pub_proposed(self, tx: SerializedTransaction, ter: TER) -> None:
         self._pub_tx(tx, ter, ledger=None, validated=False)
 

@@ -372,3 +372,27 @@ class TestSubscriptions:
             m["transaction"].get("Destination") == bob.human_account_id
             for m in got if m["type"] == "transaction"
         )
+
+
+class TestServerStream:
+    def test_load_change_publishes_server_status(self, tmp_path):
+        """monitor-test.js role: `server` stream subscribers get a
+        serverStatus event when the load factor moves (pubServer)."""
+        from stellard_tpu.node import Config, Node
+        from stellard_tpu.rpc.infosub import InfoSub
+
+        n = Node(Config(standalone=True, signature_backend="cpu")).setup()
+        try:
+            n.serve()
+            got = []
+            sub = InfoSub(got.append)
+            n.subs.subscribe_streams(sub, ["server"])
+            n.fee_track.raise_local_fee()
+            statuses = [m for m in got if m.get("type") == "serverStatus"]
+            assert statuses, got
+            assert statuses[-1]["load_factor"] > 256
+            n.fee_track.lower_local_fee()
+            statuses = [m for m in got if m.get("type") == "serverStatus"]
+            assert statuses[-1]["load_factor"] >= 256
+        finally:
+            n.stop()
