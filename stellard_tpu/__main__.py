@@ -57,6 +57,11 @@ def main(argv: list[str] | None = None) -> int:
         import subprocess
 
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if not os.path.isdir(os.path.join(repo, "tests")):
+            print("--unittest: no tests/ beside the package (installed "
+                  "copy?) — run pytest from a source checkout",
+                  file=sys.stderr)
+            return 1
         cmd = [sys.executable, "-m", "pytest", "tests/", "-q"]
         if args.unittest:
             cmd += ["-k", args.unittest]

@@ -189,12 +189,14 @@ class SubscriptionManager:
     def pub_server_status(self) -> None:
         """serverStatus event to `server`-stream subscribers (reference:
         NetworkOPs::pubServer on load-factor movement)."""
+        from ..node.loadmgr import NORMAL_FEE
+
         ft = getattr(self.ops, "fee_track", None)
         msg = {
             "type": "serverStatus",
             "server_status": self.ops.server_state(),
-            "load_base": 256,
-            "load_factor": ft.load_factor if ft is not None else 256,
+            "load_base": NORMAL_FEE,
+            "load_factor": ft.load_factor if ft is not None else NORMAL_FEE,
         }
         for sub in self._each():
             if "server" in sub.streams:

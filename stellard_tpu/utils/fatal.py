@@ -32,3 +32,22 @@ def install() -> None:
         previous(exc_type, exc, tb)
 
     sys.excepthook = report
+
+    # background threads bypass sys.excepthook — and that's where nearly
+    # all of the node's runtime work happens (job workers, overlay
+    # readers, watchdog)
+    import threading
+
+    prev_thread = threading.excepthook
+
+    def thread_report(args):
+        _log.critical(
+            "FATAL in thread %s: uncaught %s: %s",
+            args.thread.name if args.thread else "?",
+            args.exc_type.__name__,
+            args.exc_value,
+            exc_info=(args.exc_type, args.exc_value, args.exc_traceback),
+        )
+        prev_thread(args)
+
+    threading.excepthook = thread_report

@@ -6,8 +6,10 @@ never kill the whole sweep (see PERF.md for why that matters here), and
 the signed test set is cached on disk so retries are cheap.
 """
 import os, sys, time, subprocess
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 import numpy as np
-sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+sys.path.insert(0, REPO)
 
 CACHE = "/tmp/sigset.npz"
 
@@ -34,7 +36,7 @@ import os, sys, time
 import numpy as np
 os.environ.pop("JAX_PLATFORMS", None)
 os.environ["STELLARD_VERIFY_UNROLL"] = "{unroll}"
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, {REPO!r})
 import jax
 assert jax.devices()[0].platform != "cpu", "no tpu"
 from stellard_tpu.utils.xlacache import enable_compilation_cache
@@ -55,19 +57,23 @@ for batch in {batches}:
     dt=(time.time()-t0)/n
     print(f"RESULT unroll={unroll} batch={{batch}} lat={{dt*1000:.1f}}ms rate={{batch/dt:,.0f}} sigs/s", flush=True)
 '''
-    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                       text=True, timeout=1500)
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=1500)
+    except subprocess.TimeoutExpired:
+        print(f"unroll={unroll}: TIMED OUT (wedged tunnel?) — skipping", flush=True)
+        return False
     out = "\n".join(l for l in (r.stdout + r.stderr).splitlines()
                     if "WARNING" not in l and l.strip())
     print(out, flush=True)
     return r.returncode == 0
 
 def tree_hash_bench():
-    code = '''
+    code = f'''
 import os, sys, time
 import numpy as np
 os.environ.pop("JAX_PLATFORMS", None)
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, {REPO!r})
 import jax
 assert jax.devices()[0].platform != "cpu", "no tpu"
 from stellard_tpu.utils.xlacache import enable_compilation_cache
@@ -91,10 +97,14 @@ for n_leaves in (1000, 5000):
         m2 = build(n_leaves, n_leaves + 1)
         m2.hash_batch = h
         t0=time.time(); m2.get_hash(); dt=time.time()-t0
-        print(f"RESULT treehash backend={name} leaves={n_leaves} first={c:.2f}s warm={dt*1000:.0f}ms", flush=True)
+        print(f"RESULT treehash backend={{name}} leaves={{n_leaves}} first={{c:.2f}}s warm={{dt*1000:.0f}}ms", flush=True)
 '''
-    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                       text=True, timeout=1500)
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=1500)
+    except subprocess.TimeoutExpired:
+        print("treehash bench TIMED OUT — skipping", flush=True)
+        return
     print("\n".join(l for l in (r.stdout+r.stderr).splitlines()
                     if "WARNING" not in l and l.strip()), flush=True)
 
