@@ -286,24 +286,41 @@ def do_server_state(ctx: Context) -> dict:
 def do_get_counts(ctx: Context) -> dict:
     """reference: handlers/GetCounts.cpp — object/op counters."""
     node = ctx.node
+    hist = node.ledger_master.ledgers_by_hash
     out = {
         "jobq": node.job_queue.get_json(),
         "verify_plane": node.verify_plane.get_json(),
         "hash_router": node.hash_router.size(),
-        "ledgers_cached": len(node.ledger_master.ledgers_by_hash),
+        "ledgers_cached": len(hist),
+        "ledger_cache": {
+            "hits": hist.hits,
+            "misses": hist.misses,
+            "target_size": hist.target_size,
+        },
     }
+    overlay = getattr(node, "overlay", None)
+    if overlay is not None:
+        out["peers"] = overlay.peer_count()
+        q = getattr(node, "_persist_q", None)
+        if q is not None:
+            out["persist_backlog"] = q.qsize()
     return out
 
 
 @handler("consensus_info", Role.ADMIN)
 def do_consensus_info(ctx: Context) -> dict:
     node = ctx.node
-    return {
-        "info": {
-            "standalone": node.config.standalone,
-            "validation_quorum": node.config.validation_quorum,
-        }
+    info = {
+        "standalone": node.config.standalone,
+        "validation_quorum": node.config.validation_quorum,
     }
+    overlay = getattr(node, "overlay", None)
+    if overlay is not None:
+        # live round state (reference: LedgerConsensus::getJson via
+        # NetworkOPs::getConsensusInfo), read under the master lock
+        with overlay.node.lock:
+            info.update(overlay.node.consensus_info())
+    return {"info": info}
 
 
 @handler("peers", Role.ADMIN)
