@@ -94,6 +94,19 @@ class LoadFeeTrack:
         with self._lock:
             return self._local
 
+    def remote_reports(self) -> list[tuple[bytes, int]]:
+        """Unexpired (source, fee) cluster reports — relayed onward in
+        TMCluster so every member learns every member's load (reference:
+        each ClusterNodeStatus entry carries its ORIGINAL reporter, so
+        relaying cannot ratchet: receivers key by reporter)."""
+        now = time.monotonic()
+        with self._lock:
+            return [
+                (src, fee)
+                for src, (fee, expiry) in self._remote.items()
+                if expiry > now and src
+            ]
+
     def _live_remote(self) -> int:
         now = time.monotonic()
         best = NORMAL_FEE

@@ -646,11 +646,17 @@ class TcpOverlay(ConsensusAdapter):
                 if sample:
                     self._broadcast(Endpoints(sample))
                 if self.fee_track is not None and self.cluster:
-                    status = frame(ClusterStatus(
-                        self.key.public,
-                        self.fee_track.local_fee,
-                        self._ntime(),
-                    ))
+                    # our own entry plus every unexpired report we hold —
+                    # cluster members relay the full picture (reference:
+                    # TMCluster carries all known ClusterNodeStatus rows)
+                    now_nt = self._ntime()
+                    nodes = [ClusterStatus(
+                        self.key.public, self.fee_track.local_fee, now_nt,
+                    )]
+                    for src, fee in self.fee_track.remote_reports():
+                        if src in self.cluster and src != self.key.public:
+                            nodes.append(ClusterStatus(src, fee, now_nt))
+                    status = frame(ClusterUpdate(nodes))
                     with self._peers_lock:
                         members = [
                             p for p in self.peers.values()
