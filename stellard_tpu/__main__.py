@@ -132,6 +132,13 @@ def main(argv: list[str] | None = None) -> int:
         f"signature_backend={cfg.signature_backend})",
         file=sys.stderr,
     )
+    # graceful SIGTERM (reference: signalStop wiring): the run loop exits
+    # and the finally-teardown drains the ordered persist queue — a
+    # supervisor's TERM must not drop ledgers the RPC already reported
+    # committed
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda _s, _f: node._running.clear())
     try:
         node.run()
     except KeyboardInterrupt:
