@@ -162,6 +162,37 @@ class InboundLedgers:
         self.on_complete: Optional[Callable[[Ledger], None]] = None
         # per-acquisition completion callbacks (repair path)
         self._callbacks: dict[bytes, list[Callable]] = {}
+        # hashes of acquisitions that recently left `live` (completed,
+        # failed, or expired) -> monotonic time of departure. Late
+        # replies from peers we legitimately asked (timer re-anycasts
+        # rotate targets) must be neither charged nor scored.
+        self._recent: dict[bytes, float] = {}
+
+    RECENT_TTL = 60.0
+
+    RECENT_CAP = 256
+
+    def _mark_recent(self, ledger_hash: bytes) -> None:
+        import time as _time
+
+        now = _time.monotonic()
+        self._recent.pop(ledger_hash, None)  # re-insert at newest position
+        self._recent[ledger_hash] = now
+        if len(self._recent) > self.RECENT_CAP:
+            # TTL prune first; if everything is still fresh (fast
+            # catch-up), evict oldest-first so the dict stays bounded
+            self._recent = {
+                h: t for h, t in self._recent.items()
+                if now - t < self.RECENT_TTL
+            }
+            while len(self._recent) > self.RECENT_CAP:
+                del self._recent[next(iter(self._recent))]
+
+    def recently_done(self, ledger_hash: bytes) -> bool:
+        import time as _time
+
+        t = self._recent.get(ledger_hash)
+        return t is not None and _time.monotonic() - t < self.RECENT_TTL
 
     def acquire(
         self, ledger_hash: bytes, callback: Optional[Callable] = None
@@ -198,6 +229,7 @@ class InboundLedgers:
         ]
         for h in stale:
             del self.live[h]
+            self._mark_recent(h)
             for cb in self._callbacks.pop(h, []):
                 cb(None)  # expiry: callers release their slots
         return len(stale)
@@ -229,10 +261,12 @@ class InboundLedgers:
             except (ValueError, KeyError):
                 il.failed = True
                 del self.live[msg.ledger_hash]
+                self._mark_recent(msg.ledger_hash)
                 for cb in self._callbacks.pop(msg.ledger_hash, []):
                     cb(None)  # failure: callers release their slots
                 return progressed
             del self.live[msg.ledger_hash]
+            self._mark_recent(msg.ledger_hash)
             for cb in self._callbacks.pop(msg.ledger_hash, []):
                 cb(ledger)
             if self.on_complete is not None:

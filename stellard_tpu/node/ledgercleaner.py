@@ -116,13 +116,18 @@ class LedgerCleaner:
             with self._lock:
                 self.repaired += 1
 
+        def on_persist_failed():
+            # release the in-flight slot on a failed disk write, or the
+            # cleaner's 32-slot repair budget leaks one slot per failure
+            with self._lock:
+                self.repairs_failed += 1
+
         def persist(led):
             # led is None when the acquisition expired or failed to
             # build — release the in-flight slot so later repairs in the
             # scan are not starved by unserveable requests
             if led is None:
-                with self._lock:
-                    self.repairs_failed += 1
+                on_persist_failed()
                 return
             # fires on the overlay message thread UNDER the master lock —
             # hand the disk work to the node's ordered persist worker
@@ -130,7 +135,7 @@ class LedgerCleaner:
             # must not stall consensus); inline only when no worker exists
             q = getattr(self.node, "_persist_q", None)
             if q is not None:
-                q.put(("repair", led, {}, on_persisted))
+                q.put(("repair", led, {}, on_persisted, on_persist_failed))
                 return
             from .node import _results_from_meta
 
@@ -143,6 +148,7 @@ class LedgerCleaner:
                 logging.getLogger("stellard.cleaner").exception(
                     "repair persist failed for seq %d", seq
                 )
+                on_persist_failed()
 
         with vn.lock:
             vn.inbound.acquire(ledger_hash, callback=persist)

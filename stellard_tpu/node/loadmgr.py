@@ -41,10 +41,12 @@ class LoadFeeTrack:
     def __init__(self):
         self._lock = threading.Lock()
         self._local = NORMAL_FEE
-        # source -> (fee, expiry): per-reporter so one healthy cluster
-        # member cannot overwrite another's elevated report (reference
-        # keeps per-node ClusterNodeStatus entries)
-        self._remote: dict[bytes, tuple[int, float]] = {}
+        # source -> (fee, report_time, expiry): per-reporter so one
+        # healthy cluster member cannot overwrite another's elevated
+        # report (reference keeps per-node ClusterNodeStatus entries,
+        # each carrying the ORIGINAL reportTime so receivers keep only
+        # the newest report and stale relays age out)
+        self._remote: dict[bytes, tuple[int, int, float]] = {}
         self.raise_count = 0
         # change hooks (the `server` stream publishes serverStatus on
         # load-factor movement — reference: NetworkOPs::pubServer)
@@ -76,13 +78,34 @@ class LoadFeeTrack:
         if changed:
             self._fire_change()
 
-    def set_remote_fee(self, fee: int, source: bytes = b"") -> None:
+    def set_remote_fee(
+        self, fee: int, source: bytes = b"", report_time: int = 0
+    ) -> None:
         """From cluster/peer load reports (sfLoadFee in validations),
         keyed by reporter. Reports expire: a peer that stops reporting
-        (or whose load subsides) must not ratchet our fee up forever."""
+        (or whose load subsides) must not ratchet our fee up forever.
+
+        A report that is not NEWER (by the reporter's own report_time)
+        than the stored one is dropped, so relayed copies of an entry we
+        already hold can neither refresh its TTL nor overwrite a fresher
+        direct report — a crashed member's last report ages out
+        cluster-wide after REMOTE_TTL even while members keep relaying
+        it."""
         with self._lock:
+            prev = self._remote.get(source)
+            # drop unless strictly newer; a report with NO timing info
+            # (report_time 0, e.g. a malformed/legacy wire entry) may
+            # never displace or refresh a timestamped one, but two
+            # untimestamped direct reports keep the old replace behavior
+            if (
+                prev is not None
+                and max(prev[1], report_time) > 0
+                and prev[1] >= report_time
+            ):
+                return
             self._remote[source] = (
                 max(NORMAL_FEE, min(MAX_FEE, int(fee))),
+                int(report_time),
                 time.monotonic() + self.REMOTE_TTL,
             )
 
@@ -94,16 +117,17 @@ class LoadFeeTrack:
         with self._lock:
             return self._local
 
-    def remote_reports(self) -> list[tuple[bytes, int]]:
-        """Unexpired (source, fee) cluster reports — relayed onward in
-        TMCluster so every member learns every member's load (reference:
-        each ClusterNodeStatus entry carries its ORIGINAL reporter, so
-        relaying cannot ratchet: receivers key by reporter)."""
+    def remote_reports(self) -> list[tuple[bytes, int, int]]:
+        """Unexpired (source, fee, report_time) cluster reports — relayed
+        onward in TMCluster so every member learns every member's load
+        (reference: each ClusterNodeStatus entry carries its ORIGINAL
+        reporter AND reportTime, so relaying cannot ratchet: receivers
+        key by reporter and keep only the newest report)."""
         now = time.monotonic()
         with self._lock:
             return [
-                (src, fee)
-                for src, (fee, expiry) in self._remote.items()
+                (src, fee, rtime)
+                for src, (fee, rtime, expiry) in self._remote.items()
                 if expiry > now and src
             ]
 
@@ -111,7 +135,7 @@ class LoadFeeTrack:
         now = time.monotonic()
         best = NORMAL_FEE
         for source in list(self._remote):
-            fee, expiry = self._remote[source]
+            fee, _rtime, expiry = self._remote[source]
             if now >= expiry:
                 del self._remote[source]
             else:

@@ -295,7 +295,7 @@ class Node:
                     item = self._persist_q.get()
                     if item is None:
                         return
-                    kind, led, results, done = item
+                    kind, led, results, done, on_failed = item
                     try:
                         if not results:
                             # ledger we never applied locally (catch-up
@@ -321,6 +321,15 @@ class Node:
                         logging.getLogger("stellard.node").exception(
                             "ledger persist failed"
                         )
+                        # a failed persist must still release the
+                        # submitter's accounting (e.g. the cleaner's
+                        # bounded in-flight repair slots) or repairs
+                        # silently stop after enough failures
+                        if on_failed is not None:
+                            try:
+                                on_failed()
+                            except Exception:  # noqa: BLE001
+                                pass
 
             self._persist_thread = threading.Thread(
                 target=_persist_worker, name="ledger-persist", daemon=True
@@ -329,7 +338,7 @@ class Node:
 
             def _persist_async(led):
                 self._persist_q.put(
-                    ("close", led, getattr(led, "apply_results", {}), None)
+                    ("close", led, getattr(led, "apply_results", {}), None, None)
                 )
 
             self.overlay.accepted_hooks.append(_persist_async)

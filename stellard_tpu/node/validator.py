@@ -342,7 +342,12 @@ class ValidatorNode:
 
     @_locked
     def has_acquisition(self, ledger_hash: bytes) -> bool:
-        return ledger_hash in self.inbound.live
+        """Live OR recently-completed: late LedgerData from peers we
+        legitimately queried must not be charged as unwanted."""
+        return (
+            ledger_hash in self.inbound.live
+            or self.inbound.recently_done(ledger_hash)
+        )
 
     @_locked
     def serve_get_ledger(self, msg):

@@ -565,9 +565,17 @@ class TcpOverlay(ConsensusAdapter):
             # the sender's own entry must come from the sender itself
             if self.fee_track is not None and peer.node_public in self.cluster:
                 for st in msg.nodes:
-                    if st.node_public in self.cluster:
+                    # never ingest a relayed report about OURSELVES as a
+                    # "remote" fee — that self-echo would ratchet
+                    # local_fee's own report back onto us forever
+                    if (
+                        st.node_public in self.cluster
+                        and st.node_public != self.key.public
+                    ):
                         self.fee_track.set_remote_fee(
-                            st.load_fee, source=st.node_public
+                            st.load_fee,
+                            source=st.node_public,
+                            report_time=st.report_time,
                         )
         elif isinstance(msg, Endpoints):
             accepted = self.peerfinder.on_endpoints(
@@ -653,9 +661,13 @@ class TcpOverlay(ConsensusAdapter):
                     nodes = [ClusterStatus(
                         self.key.public, self.fee_track.local_fee, now_nt,
                     )]
-                    for src, fee in self.fee_track.remote_reports():
+                    # relay stored reports with their ORIGINAL report_time
+                    # (re-stamping would let two members refresh each
+                    # other's stale entries forever — reference TMCluster
+                    # carries the reporter's own reportTime)
+                    for src, fee, rtime in self.fee_track.remote_reports():
                         if src in self.cluster and src != self.key.public:
-                            nodes.append(ClusterStatus(src, fee, now_nt))
+                            nodes.append(ClusterStatus(src, fee, rtime))
                     status = frame(ClusterUpdate(nodes))
                     with self._peers_lock:
                         members = [

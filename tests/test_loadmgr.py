@@ -64,6 +64,39 @@ class TestLoadFeeTrack:
         ft.set_remote_fee(512)
         assert ft.load_factor == 512  # max(local, remote)
 
+    def test_remote_report_freshness_ordering(self):
+        """A relayed copy of a report we already hold (same or older
+        report_time) must neither refresh its TTL nor overwrite a fresher
+        direct report — only strictly newer reports land (reference:
+        TMCluster carries the ORIGINAL reportTime so receivers keep only
+        the newest)."""
+        ft = LoadFeeTrack()
+        src = b"\x02" * 33
+        ft.set_remote_fee(512, source=src, report_time=100)
+        # stale relay: older report_time, different fee -> dropped
+        ft.set_remote_fee(999, source=src, report_time=99)
+        ft.set_remote_fee(999, source=src, report_time=100)  # same: dropped
+        assert ft.load_factor == 512
+        reports = ft.remote_reports()
+        assert reports == [(src, 512, 100)]
+        # strictly newer report wins (even lowering the fee)
+        ft.set_remote_fee(300, source=src, report_time=101)
+        assert ft.remote_reports() == [(src, 300, 101)]
+
+    def test_remote_report_ttl_not_refreshed_by_relay(self):
+        """Replaying the same report right before expiry must not extend
+        its life — a crashed member's high-load report ages out even while
+        other members keep relaying it."""
+        ft = LoadFeeTrack()
+        ft.REMOTE_TTL = 0.1
+        src = b"\x03" * 33
+        ft.set_remote_fee(800, source=src, report_time=50)
+        time.sleep(0.06)
+        ft.set_remote_fee(800, source=src, report_time=50)  # relay echo
+        time.sleep(0.06)  # past the ORIGINAL expiry
+        assert ft.load_factor == NORMAL_FEE
+        assert ft.remote_reports() == []
+
 
 class TestLoadManager:
     def test_overload_raises_then_recovers(self):

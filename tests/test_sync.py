@@ -253,3 +253,55 @@ class TestFatReplies:
         assert il.take_header(led.header_bytes())
         got = il.take_nodes(W_STATE_TREE, reply.nodes)
         assert got == len(reply.nodes)
+
+
+class TestRecentAcquisitions:
+    """Late LedgerData for a just-finished acquisition must be treated as
+    solicited (ADVICE r3: honest slower peers were charged
+    FEE_UNWANTED_DATA and down-ranked after the fast peer completed the
+    acquisition)."""
+
+    def test_expired_acquisition_is_recently_done(self):
+        from stellard_tpu.node.inbound import InboundLedgers
+
+        sent = []
+        inb = InboundLedgers(send=sent.append)
+        h = b"\x07" * 32
+        inb.acquire(h)
+        assert h in inb.live and not inb.recently_done(h)
+        assert inb.expire_stale(max_age_s=-1) == 1
+        assert h not in inb.live
+        assert inb.recently_done(h)
+        # and it ages out
+        inb._recent[h] -= inb.RECENT_TTL + 1
+        assert not inb.recently_done(h)
+
+    def test_completed_acquisition_is_recently_done(self):
+        from stellard_tpu.node.inbound import InboundLedgers
+        from stellard_tpu.node.inbound import serve_get_ledger, W_HEADER
+        from stellard_tpu.overlay.wire import GetLedger
+        from stellard_tpu.state.ledger import Ledger
+        from stellard_tpu.protocol.keys import KeyPair
+
+        master = KeyPair.from_passphrase("masterpassphrase")
+        led = Ledger.genesis(master.account_id)
+        led.close(close_time=1000, close_resolution=10)
+
+        done = []
+        inb = InboundLedgers(send=lambda req: None)
+        inb.on_complete = done.append
+        inb.acquire(led.hash())
+        reply = serve_get_ledger(led, GetLedger(led.hash(), 0, W_HEADER, []))
+        assert inb.take_ledger_data(reply) >= 1
+        # drive remaining requests until the acquisition completes
+        for _ in range(16):
+            if led.hash() not in inb.live:
+                break
+            reqs = list(inb.live[led.hash()].next_requests())
+            assert reqs, "live acquisition must want something"
+            for req in reqs:
+                data = serve_get_ledger(led, req)
+                assert data is not None
+                inb.take_ledger_data(data)
+        assert done, "acquisition must complete against its own source"
+        assert inb.recently_done(led.hash())

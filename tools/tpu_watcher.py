@@ -1,0 +1,62 @@
+"""Background TPU-tunnel watcher: probe until the chip answers, then sweep.
+
+The axon tunnel wedges for hours at a time (jax.devices() HANGS rather
+than erroring), so every probe runs in a throwaway subprocess with a hard
+wall-clock timeout, and only ONE TPU-touching process ever runs at a time
+(concurrent sessions are what wedge it). When a probe succeeds this runs
+`tools/kernel_sweep.py` and then `bench.py`, logging to LOG, and exits.
+
+Usage: nohup python tools/tpu_watcher.py > /tmp/tpu_watcher.log 2>&1 &
+"""
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = "/root/repo/SWEEP_r04.log"
+PROBE_TIMEOUT = 120
+PROBE_INTERVAL = 300
+
+
+def probe() -> bool:
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return r.returncode == 0 and "tpu" in r.stdout.lower()
+
+
+def main() -> None:
+    n = 0
+    while True:
+        n += 1
+        up = probe()
+        print(f"[watcher] probe {n}: {'UP' if up else 'down'} "
+              f"({time.strftime('%H:%M:%S')})", flush=True)
+        if up:
+            break
+        time.sleep(PROBE_INTERVAL)
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    with open(LOG, "a") as f:
+        f.write(f"=== tunnel up at {time.strftime('%F %T')}; sweeping ===\n")
+        f.flush()
+        subprocess.run([sys.executable, os.path.join(REPO, "tools/kernel_sweep.py")],
+                       stdout=f, stderr=subprocess.STDOUT, cwd=REPO, env=env)
+        f.write("=== sweep done; running bench.py ===\n")
+        f.flush()
+        subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       stdout=f, stderr=subprocess.STDOUT, cwd=REPO, env=env)
+        f.write("=== bench done ===\n")
+    print("[watcher] sweep+bench complete; see", LOG, flush=True)
+
+
+if __name__ == "__main__":
+    main()
