@@ -14,9 +14,10 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-LOG = "/root/repo/SWEEP_r04.log"
+LOG = os.path.join(REPO, "SWEEP_r04.log")
 PROBE_TIMEOUT = 120
 PROBE_INTERVAL = 300
+RUN_TIMEOUT = 5400  # sweep/bench can compile for ~3min/shape; a wedge hangs forever
 
 
 def probe() -> bool:
@@ -33,6 +34,25 @@ def probe() -> bool:
     return r.returncode == 0 and "tpu" in r.stdout.lower()
 
 
+def _run_logged(f, label: str, argv: list[str], env) -> bool:
+    """One sweep/bench subprocess with a hard wall-clock timeout — the
+    tunnel's failure mode is an indefinite HANG, so an untimed run would
+    wedge the watcher (and, as the single allowed TPU process, block all
+    probing) forever."""
+    f.write(f"=== {label} at {time.strftime('%F %T')} ===\n")
+    f.flush()
+    try:
+        subprocess.run(argv, stdout=f, stderr=subprocess.STDOUT, cwd=REPO,
+                       env=env, timeout=RUN_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        f.write(f"=== {label} TIMED OUT after {RUN_TIMEOUT}s (wedged tunnel) ===\n")
+        f.flush()
+        return False
+    f.write(f"=== {label} done ===\n")
+    f.flush()
+    return True
+
+
 def main() -> None:
     n = 0
     while True:
@@ -40,21 +60,22 @@ def main() -> None:
         up = probe()
         print(f"[watcher] probe {n}: {'UP' if up else 'down'} "
               f"({time.strftime('%H:%M:%S')})", flush=True)
-        if up:
+        if not up:
+            time.sleep(PROBE_INTERVAL)
+            continue
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        with open(LOG, "a") as f:
+            ok = _run_logged(
+                f, "kernel_sweep",
+                [sys.executable, os.path.join(REPO, "tools/kernel_sweep.py")], env,
+            ) and _run_logged(
+                f, "bench", [sys.executable, os.path.join(REPO, "bench.py")], env,
+            )
+        if ok:
             break
+        # wedged mid-run: back to probing until the tunnel answers again
         time.sleep(PROBE_INTERVAL)
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    with open(LOG, "a") as f:
-        f.write(f"=== tunnel up at {time.strftime('%F %T')}; sweeping ===\n")
-        f.flush()
-        subprocess.run([sys.executable, os.path.join(REPO, "tools/kernel_sweep.py")],
-                       stdout=f, stderr=subprocess.STDOUT, cwd=REPO, env=env)
-        f.write("=== sweep done; running bench.py ===\n")
-        f.flush()
-        subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
-                       stdout=f, stderr=subprocess.STDOUT, cwd=REPO, env=env)
-        f.write("=== bench done ===\n")
     print("[watcher] sweep+bench complete; see", LOG, flush=True)
 
 
