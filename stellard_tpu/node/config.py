@@ -89,6 +89,10 @@ class Config:
     peer_ip: str = "127.0.0.1"
     peer_port: int = 0  # 0 = disabled
     ips: list[str] = field(default_factory=list)  # bootstrap peers host:port
+    # [peer_ssl]: "" = plaintext, "allow" = TLS out + autodetect in,
+    # "require" = TLS only (plaintext peers refused). Reference peers are
+    # always SSL (PeerImp.h:88-90); "allow" exists for mixed-net upgrades.
+    peer_ssl: str = ""
     # test-net accelerator: virtual seconds per real second for the
     # overlay clock (consensus windows shrink accordingly; 1.0 = live)
     clock_speed: float = 1.0
@@ -158,6 +162,16 @@ class Config:
         if one("peer_port"):
             cfg.peer_port = int(one("peer_port"))
         cfg.ips = list(s.get("ips", []))
+        if one("peer_ssl"):
+            cfg.peer_ssl = one("peer_ssl").lower()
+            if cfg.peer_ssl not in ("", "allow", "require"):
+                # a security toggle must not fail open: an unrecognized
+                # value running plaintext while the operator believes TLS
+                # is on would be silent downgrade
+                raise ValueError(
+                    f"[peer_ssl] must be 'allow' or 'require', "
+                    f"got {cfg.peer_ssl!r}"
+                )
         if one("clock_speed"):
             cfg.clock_speed = float(one("clock_speed"))
 

@@ -257,6 +257,22 @@ class Node:
                 timer_interval = max(0.1, 1.0 / speed)
             unl_keys = self.unl.publics()
             signer = self.validation_keys or self.node_keys
+            peer_tls = None
+            if cfg.peer_ssl in ("allow", "require"):
+                import tempfile
+
+                from ..overlay.peertls import PeerTLS
+
+                # database_path is a sqlite FILE path; state files hang
+                # suffixes off it (.clf/.unl/.wallet) — same here
+                tls_dir = (
+                    cfg.database_path + ".tls"
+                    if cfg.database_path
+                    else tempfile.mkdtemp(prefix="stellard-tls-")
+                )
+                peer_tls = PeerTLS.from_state_dir(
+                    tls_dir, required=(cfg.peer_ssl == "require")
+                )
             self.overlay = TcpOverlay(
                 key=signer,
                 unl=unl_keys,
@@ -276,6 +292,7 @@ class Node:
                 proposing=self.validation_keys is not None,
                 router=self.hash_router,
                 job_dispatch=self._peer_job_dispatch,
+                peer_tls=peer_tls,
             )
             # persistence rides a dedicated ORDERED worker, NOT the
             # consensus tick (the hook fires under the master lock and a

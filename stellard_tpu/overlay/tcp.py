@@ -83,6 +83,12 @@ class _Peer:
         import queue
 
         self.uid = next(_Peer._NEXT_UID)
+        # serializes SSL_read/SSL_write on a TLS socket: one OpenSSL SSL*
+        # must not run concurrent operations from two threads (the writer
+        # thread sends while the session thread recvs). Plain sockets
+        # don't take it — the kernel allows full-duplex concurrency.
+        self.io_lock = threading.Lock()
+        self.is_tls = False
         # acquisition scoring (reference: PeerSet peer selection): how
         # many ledger-data requests we routed here and how many replies
         # came back — the reply rate drives future routing
@@ -137,10 +143,28 @@ class _Peer:
             if data is None or not self.alive:
                 return
             try:
-                self.sock.sendall(data)  # SO_SNDTIMEO bounds each write
+                if self.is_tls:
+                    with self.io_lock:
+                        self.sock.sendall(data)
+                else:
+                    self.sock.sendall(data)  # SO_SNDTIMEO bounds each write
             except OSError:
                 self.alive = False
                 return
+
+    def recv_locked(self, bufsize: int = 65536) -> Optional[bytes]:
+        """One recv honoring the TLS serialization rule. Returns None on
+        a poll timeout (TLS path polls so the writer can interleave),
+        b\"\" on EOF, data otherwise. Raises OSError on a dead socket."""
+        if not self.is_tls:
+            return self.sock.recv(bufsize)
+        import ssl as _ssl
+
+        try:
+            with self.io_lock:
+                return self.sock.recv(bufsize)
+        except (TimeoutError, socket.timeout, _ssl.SSLWantReadError):
+            return None
 
     def close(self) -> None:
         self.alive = False
@@ -192,6 +216,7 @@ class TcpOverlay(ConsensusAdapter):
         proposing: bool = True,
         router=None,
         job_dispatch: Optional[Callable[[str, Callable], None]] = None,
+        peer_tls=None,
     ):
         self.key = key
         self.port = port
@@ -233,6 +258,12 @@ class TcpOverlay(ConsensusAdapter):
         # jtPROPOSAL_t/jtVALIDATION_t jobs (latency-tracked, sheddable);
         # bare overlays handle inline
         self.job_dispatch = job_dispatch
+        # transport encryption (overlay/peertls.py). None = plaintext
+        # (reference parity requires TLS: every reference peer link is
+        # anonymous SSL, PeerImp.h:88-90); when set, outbound dials speak
+        # TLS, inbound autodetects, and `peer_tls.required` refuses
+        # plaintext peers
+        self.peer_tls = peer_tls
         self.gossip_interval = gossip_interval
         self._last_gossip = 0.0
         self._peers_lock = threading.Lock()
@@ -336,6 +367,36 @@ class TcpOverlay(ConsensusAdapter):
             with self._peers_lock:
                 self._dialing.discard(addr)
             return
+        if self.peer_tls is not None:
+            import ssl as _ssl
+
+            sock.settimeout(5.0)
+            try:
+                sock = self.peer_tls.wrap_client(sock)
+            except (OSError, _ssl.SSLError):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                if self.peer_tls.required:
+                    self.peerfinder.on_failure(addr)
+                    with self._peers_lock:
+                        self._dialing.discard(addr)
+                    return
+                # allow mode: the remote may be a plaintext node that ate
+                # our ClientHello as garbage — redial in the clear
+                # (opportunistic encryption, mixed-net upgrades)
+                try:
+                    sock = socket.create_connection(addr, timeout=2.0)
+                except OSError:
+                    self.peerfinder.on_failure(addr)
+                    with self._peers_lock:
+                        self._dialing.discard(addr)
+                    return
+                self._session(sock, False, addr)
+                return
+            self._session(sock, False, addr, tls=True)
+            return
         self._session(sock, False, addr)
 
     def _session(
@@ -343,10 +404,14 @@ class TcpOverlay(ConsensusAdapter):
         sock: socket.socket,
         inbound: bool,
         addr: Optional[tuple[str, int]] = None,
+        tls: bool = False,
     ) -> None:
         """Nonce exchange → signed hello → message pump
-        (reference: PeerImp::onHandshake/recvHello)."""
+        (reference: PeerImp::onHandshake/recvHello). Outbound TLS wrapping
+        happens in _dial (where a failed handshake can fall back to a
+        plaintext redial); inbound autodetects here."""
         peer = _Peer(sock, inbound, addr)
+        peer.is_tls = tls
         try:
             if inbound and not self.resources.should_admit(peer.remote):
                 # endpoint balance still above the drop line: refuse
@@ -355,11 +420,41 @@ class TcpOverlay(ConsensusAdapter):
                 return
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
             sock.settimeout(5.0)
+            if self.peer_tls is not None and inbound:
+                # SSL-or-plain autodetect (reference: MultiSocket)
+                if self.peer_tls.is_tls_client_hello(sock):
+                    sock = self.peer_tls.wrap_server(sock)
+                    peer.sock = sock  # writer/pump/close use the TLS socket
+                    peer.is_tls = True
+                elif self.peer_tls.required:
+                    peer.close()  # plaintext peer refused
+                    return
+            if peer.is_tls:
+                # from here on the writer thread (hello send onward) and
+                # this session thread share one SSL object: reads poll on
+                # a short timeout so the io_lock is released regularly
+                sock.settimeout(0.05)
+            # first nonce byte must not collide with the TLS handshake
+            # record type (0x16) or the remote's autodetect would
+            # misclassify this plaintext session
             nonce = os.urandom(32)
+            while nonce[0] == 0x16:
+                nonce = os.urandom(32)
             sock.sendall(nonce)
             their_nonce = self._read_exact(sock, 32)
+            # session binding the hello signature proves: both nonces
+            # plus (when encrypted) the RFC 5929 tls-unique value of THIS
+            # TLS session — a terminating MITM's two legs have different
+            # bindings, so its spliced hellos fail verification
+            # (reference: node-key proof of the SSL session fingerprint)
+            binding = (
+                self.peer_tls.channel_binding(sock)
+                if (self.peer_tls is not None and peer.is_tls)
+                else b""
+            )
             session_hash = prefix_hash(
-                HP_SESSION, min(nonce, their_nonce) + max(nonce, their_nonce)
+                HP_SESSION,
+                min(nonce, their_nonce) + max(nonce, their_nonce) + binding,
             )
             lcl = self.node.lm.closed_ledger()
             hello = Hello(
@@ -437,7 +532,8 @@ class TcpOverlay(ConsensusAdapter):
                     existing.close()
                 peer.established_at = now
                 self.peers[peer.node_public] = peer
-            sock.settimeout(None)
+            if not peer.is_tls:
+                sock.settimeout(None)  # TLS keeps its 0.05s poll timeout
             # bounded sends only (SO_SNDTIMEO applies to send, not recv):
             # a stalled peer with a full kernel buffer must never block the
             # heartbeat/relay threads forever — sendall times out, send()
@@ -464,31 +560,50 @@ class TcpOverlay(ConsensusAdapter):
 
     @staticmethod
     def _read_exact(sock: socket.socket, n: int) -> bytes:
+        """Handshake-phase read (single-threaded: the writer thread is
+        not live yet, so no io_lock needed). Poll timeouts retry up to a
+        10s deadline; a dead peer raises OSError."""
+        import ssl as _ssl
+
+        deadline = time.monotonic() + 10.0
         buf = b""
         while len(buf) < n:
-            chunk = sock.recv(n - len(buf))
+            try:
+                chunk = sock.recv(n - len(buf))
+            except (TimeoutError, socket.timeout, _ssl.SSLWantReadError):
+                if time.monotonic() > deadline:
+                    raise OSError("handshake read timed out")
+                continue
             if not chunk:
                 raise OSError("peer closed")
             buf += chunk
         return buf
 
     def _read_hello(self, sock: socket.socket, peer: _Peer) -> Optional[Hello]:
-        while True:
-            data = sock.recv(65536)
+        # the writer thread is live from our own hello send onward, so
+        # reads go through the TLS-serializing recv; bounded overall
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            data = peer.recv_locked()
+            if data is None:
+                continue  # TLS poll timeout
             if not data:
                 return None
             msgs = peer.reader.feed(data)
             if msgs:
                 return msgs[0] if isinstance(msgs[0], Hello) else None
+        return None
 
     # -- message pump -----------------------------------------------------
 
     def _pump(self, peer: _Peer) -> None:
         while not self._stop.is_set() and peer.alive:
             try:
-                data = peer.sock.recv(65536)
+                data = peer.recv_locked()
             except OSError:
                 return
+            if data is None:
+                continue  # TLS poll timeout — let the writer in
             if not data:
                 return
             peer.last_recv = time.monotonic()

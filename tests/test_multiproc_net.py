@@ -103,6 +103,9 @@ type=cpu
 [peer_port]
 {peer_ports[i]}
 
+[peer_ssl]
+require
+
 [ips]
 {others_addrs}
 
