@@ -269,9 +269,55 @@ class PeerFinder:
                     want -= 1
         return targets
 
+    # -- slot accounting (reference: peerfinder/impl/Counts.h, Fixed.h) ----
+
+    @property
+    def max_in(self) -> int:
+        """Inbound slot cap: whatever the total cap leaves after the
+        outbound allotment (reference Counts::onConfig — maxPeers split
+        into outDesired outbound + the rest inbound)."""
+        return max(0, self.max_peers - self.out_desired)
+
+    def can_accept_inbound(
+        self, in_count: int, is_fixed_or_cluster: bool = False
+    ) -> bool:
+        """Admission check for a completed inbound handshake. Fixed and
+        cluster peers have RESERVED slots and are always admitted
+        (reference: Fixed.h fixed slots / cluster slots bypass the
+        inbound cap); everyone else competes for max_in."""
+        if is_fixed_or_cluster:
+            return True
+        return in_count < self.max_in
+
+    def handout(
+        self,
+        exclude: set[tuple[str, int]],
+        limit: int = GOSSIP_MAX,
+    ) -> list[tuple[str, int]]:
+        """Utility-ranked addresses to hand a peer we are refusing for
+        lack of slots (reference ConnectHandouts.cpp: a full node
+        REDIRECTS the connector to better targets instead of silently
+        dropping it). Ranking: fresh low-hop livecache entries first,
+        then bootcache by valence."""
+        out: list[tuple[str, int]] = []
+        for a in self.livecache.addrs():
+            if len(out) >= limit:
+                return out
+            if a not in exclude and a not in out:
+                out.append(a)
+        for a in self.bootcache.ranked():
+            if len(out) >= limit:
+                break
+            if a not in exclude and a not in out:
+                out.append(a)
+        return out
+
     def get_json(self) -> dict:
         return {
             "fixed": len(self.fixed),
             "bootcache": len(self.bootcache),
             "livecache": len(self.livecache),
+            "max_in": self.max_in,
+            "out_desired": self.out_desired,
+            "max_peers": self.max_peers,
         }

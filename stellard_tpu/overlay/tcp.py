@@ -89,6 +89,12 @@ class _Peer:
         # don't take it — the kernel allows full-duplex concurrency.
         self.io_lock = threading.Lock()
         self.is_tls = False
+        # slot accounting (reference Counts.h): reserved = fixed/cluster
+        self.slot_reserved = False
+        # real-clock establishment stamp (0.0 = never registered) and a
+        # flag marking closes that must NOT trigger dial backoff
+        self.established_mono = 0.0
+        self.benign_close = False
         # acquisition scoring (reference: PeerSet peer selection): how
         # many ledger-data requests we routed here and how many replies
         # came back — the reply rate drives future routing
@@ -267,6 +273,9 @@ class TcpOverlay(ConsensusAdapter):
         self.gossip_interval = gossip_interval
         self._last_gossip = 0.0
         self._peers_lock = threading.Lock()
+        # our own addresses as learned from self-connects via gossiped
+        # endpoints: never handed out, never redialed
+        self._self_addrs: set[tuple[str, int]] = set()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._threads_lock = threading.Lock()
@@ -346,8 +355,11 @@ class TcpOverlay(ConsensusAdapter):
                     1 for p in self.peers.values() if not p.inbound and p.alive
                 )
                 total = len(self.peers)
-            # never dial ourselves (our own gossiped hop-0 endpoint)
+            # never dial ourselves (our own gossiped hop-0 endpoint,
+            # plus any address a past self-connect proved is us)
             connected.add(("127.0.0.1", self.port))
+            with self._peers_lock:
+                connected |= self._self_addrs
             targets = self.peerfinder.dial_targets(
                 connected, dialing, out_count, total
             )
@@ -483,10 +495,13 @@ class TcpOverlay(ConsensusAdapter):
                 peer.close()
                 return
             if their_hello.node_public == self.key.public:
-                # connected to ourselves via a gossiped address: drop and
-                # blacklist the address in the bootcache
+                # connected to ourselves via a gossiped address: drop,
+                # blacklist in the bootcache, and remember it as a SELF
+                # address so it is never handed out or redialed
                 if addr is not None:
                     self.peerfinder.on_failure(addr)
+                    with self._peers_lock:
+                        self._self_addrs.add(addr)
                 peer.close()
                 return
             peer.node_public = their_hello.node_public
@@ -496,42 +511,103 @@ class TcpOverlay(ConsensusAdapter):
             if not inbound and addr is not None:
                 self.peerfinder.on_success(addr)
             now = self._clock()
+            refused = False
             with self._peers_lock:
-                existing = self.peers.get(peer.node_public)
-                if existing is not None:
-                    young = (
-                        existing.alive
-                        and now - existing.established_at <= 5.0
+                if inbound:
+                    # slot admission in the SAME critical section as the
+                    # registration below, so concurrent handshakes cannot
+                    # all see a free slot (reference: peerfinder Counts.h
+                    # accounting). Reserved (fixed/cluster) peers bypass
+                    # the cap and are excluded from in_count, so they
+                    # never starve the ordinary inbound budget.
+                    fixed = set(map(tuple, self.peerfinder.fixed))
+                    reserved = (
+                        peer.node_public in self.cluster
+                        or (
+                            peer.advertised is not None
+                            and peer.advertised in fixed
+                        )
                     )
-                    fresh = (
-                        existing.alive
-                        and time.monotonic() - existing.last_recv
-                        <= self.peer_idle_ping
+                    in_count = sum(
+                        1
+                        for pub, p in self.peers.items()
+                        if p.inbound
+                        and p.alive
+                        and not p.slot_reserved
+                        and pub != peer.node_public
                     )
-                    if young:
-                        # simultaneous-connect race: the smaller key's dial
-                        # wins, deterministically on both sides
-                        if (self.key.public < peer.node_public) == inbound:
+                    if not self.peerfinder.can_accept_inbound(
+                        in_count, reserved
+                    ):
+                        refused = True
+                    else:
+                        peer.slot_reserved = reserved
+                if not refused:
+                    existing = self.peers.get(peer.node_public)
+                    if existing is not None:
+                        young = (
+                            existing.alive
+                            and now - existing.established_at <= 5.0
+                        )
+                        fresh = (
+                            existing.alive
+                            and time.monotonic() - existing.last_recv
+                            <= self.peer_idle_ping
+                        )
+                        if young:
+                            # simultaneous-connect race: the smaller key's
+                            # dial wins, deterministically on both sides
+                            if (self.key.public < peer.node_public) == inbound:
+                                if existing.addr is None:
+                                    existing.addr = peer.addr
+                                peer.benign_close = True
+                                peer.close()
+                                return
+                        elif fresh:
+                            # existing session demonstrably alive (recent
+                            # recv): keep it; learn the dial addr so
+                            # _connect_loop stops redialing an
+                            # inbound-only pair
                             if existing.addr is None:
                                 existing.addr = peer.addr
+                            peer.benign_close = True
                             peer.close()
                             return
-                    elif fresh:
-                        # existing session demonstrably alive (recent recv):
-                        # keep it; learn the dial addr so _connect_loop stops
-                        # redialing an inbound-only pair
-                        if existing.addr is None:
-                            existing.addr = peer.addr
-                        peer.close()
-                        return
-                    # else: existing is likely half-open (crashed peer) —
-                    # the fresh authenticated session displaces it; worst
-                    # case a restarted peer waits one idle-ping window
-                    if peer.addr is None:
-                        peer.addr = existing.addr
-                    existing.close()
-                peer.established_at = now
-                self.peers[peer.node_public] = peer
+                        # else: existing is likely half-open (crashed
+                        # peer) — the fresh authenticated session
+                        # displaces it; worst case a restarted peer waits
+                        # one idle-ping window
+                        if peer.addr is None:
+                            peer.addr = existing.addr
+                        existing.close()
+                    peer.established_at = now
+                    peer.established_mono = time.monotonic()
+                    self.peers[peer.node_public] = peer
+                exclude = set(self._self_addrs)
+            if refused:
+                # inbound slots exhausted: REDIRECT the connector to
+                # better targets instead of silently dropping it
+                # (reference ConnectHandouts.cpp / doRedirect), then
+                # close. Never hand out our own addresses or the
+                # connector's own.
+                exclude.add(("127.0.0.1", self.port))
+                if peer.advertised is not None:
+                    exclude.add(peer.advertised)
+                sample = self.peerfinder.handout(exclude=exclude)
+                if sample:
+                    data = frame(
+                        Endpoints([(h, pt, 1) for h, pt in sample])
+                    )
+                    try:
+                        if peer.is_tls:
+                            with peer.io_lock:
+                                sock.sendall(data)
+                        else:
+                            sock.sendall(data)
+                    except OSError:
+                        pass
+                peer.close()
+                return
             if not peer.is_tls:
                 sock.settimeout(None)  # TLS keeps its 0.05s poll timeout
             # bounded sends only (SO_SNDTIMEO applies to send, not recv):
@@ -557,6 +633,38 @@ class TcpOverlay(ConsensusAdapter):
                 if peer.addr is not None:
                     self._dialing.discard(peer.addr)
             peer.close()
+            # a dial whose session never established (refused handshake,
+            # slot redirect) or died within seconds must BACK OFF instead
+            # of re-handshaking every connect-loop tick; benign closes
+            # (duplicate-session handling) are exempt
+            if (
+                not inbound
+                and addr is not None
+                and not peer.benign_close
+                and not self._stop.is_set()
+                and (
+                    peer.established_mono == 0.0
+                    or time.monotonic() - peer.established_mono < 3.0
+                )
+            ):
+                self.peerfinder.on_failure(addr)
+
+    def slots_json(self) -> dict:
+        """Slot accounting for the peers RPC (reference: Counts in the
+        peerfinder section of the peers response)."""
+        with self._peers_lock:
+            in_use = sum(1 for p in self.peers.values() if p.inbound and p.alive)
+            out_use = sum(
+                1 for p in self.peers.values() if not p.inbound and p.alive
+            )
+            cluster = sum(
+                1
+                for pub, p in self.peers.items()
+                if p.alive and pub in self.cluster
+            )
+        d = self.peerfinder.get_json()
+        d.update({"in_use": in_use, "out_use": out_use, "cluster_use": cluster})
+        return d
 
     @staticmethod
     def _read_exact(sock: socket.socket, n: int) -> bytes:

@@ -326,7 +326,9 @@ def do_consensus_info(ctx: Context) -> dict:
 @handler("peers", Role.ADMIN)
 def do_peers(ctx: Context) -> dict:
     overlay = getattr(ctx.node, "overlay", None)
-    return {"peers": overlay.peers_json() if overlay else []}
+    if overlay is None:
+        return {"peers": []}
+    return {"peers": overlay.peers_json(), "slots": overlay.slots_json()}
 
 
 @handler("stop", Role.ADMIN)

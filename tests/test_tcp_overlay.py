@@ -426,3 +426,72 @@ class TestSlowReaderBackpressure:
             time.sleep(0.05)
         assert not peer.alive, "overflowing peer must be dropped"
         b.close()
+
+
+class TestInboundSlots:
+    def test_second_inbound_refused_with_redirect(self):
+        """max_in=1: the first inbound peer is admitted, the second gets
+        an ENDPOINTS redirect handout and is closed (reference:
+        ConnectHandouts / doRedirect)."""
+        from stellard_tpu.overlay.wire import Endpoints
+
+        port = free_ports(1)[0]
+        key = KeyPair.from_passphrase("slots-victim")
+        t0 = time.monotonic()
+        clock = lambda: (time.monotonic() - t0) * SPEED
+        ntime = lambda: 36_000_000 + int(clock())
+        ov = TcpOverlay(
+            key=key, unl={key.public}, quorum=1, port=port,
+            peer_addrs=[], network_time=ntime, clock=clock,
+            timer_interval=0.2, idle_interval=4,
+            out_desired=2, max_peers=3,  # max_in = 1
+        )
+        ov.start(MASTER.account_id, close_time=ntime())
+        try:
+            s1 = _connect(ov)
+            _handshake(ov, s1, KeyPair.from_passphrase("slots-a"))
+            # seed the victim's livecache so the handout is non-empty
+            ov.peerfinder.livecache.insert(("10.9.9.9", 7777), 1)
+            # second inbound: complete the hello (the slot check runs
+            # post-handshake, once the peer is identified)
+            key_b = KeyPair.from_passphrase("slots-b")
+            s2 = _connect(ov)
+            server_nonce = _recv_exact(s2, 32)
+            nonce = _plain_nonce()
+            s2.sendall(nonce)
+            from stellard_tpu.overlay.tcp import HP_SESSION, PROTO_VERSION
+            from stellard_tpu.utils.hashes import prefix_hash
+
+            sh = prefix_hash(
+                HP_SESSION,
+                min(nonce, server_nonce) + max(nonce, server_nonce),
+            )
+            s2.sendall(frame(Hello(
+                PROTO_VERSION, 36_000_000, key_b.public, key_b.sign(sh),
+                1, b"\x00" * 32, 0,
+            )))
+            reader = FrameReader()
+            s2.settimeout(10.0)
+            got_redirect = False
+            closed = False
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not closed:
+                try:
+                    data = s2.recv(65536)
+                except (socket.timeout, ConnectionResetError):
+                    break
+                if not data:
+                    closed = True
+                    break
+                for m in reader.feed(data):
+                    if isinstance(m, Endpoints):
+                        got_redirect = True
+            s2.close()
+            assert closed, "over-cap inbound peer must be disconnected"
+            assert got_redirect, "refused peer must receive a handout"
+            # slot accounting visible via the peers RPC shape
+            slots = ov.slots_json()
+            assert slots["in_use"] == 1 and slots["max_in"] == 1
+            s1.close()
+        finally:
+            ov.stop()
