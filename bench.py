@@ -152,6 +152,11 @@ def _drive_node(backend, txs, chunk=500, setup_phases=()):
         node.ops.accept_ledger()
 
     txs = _fresh(txs)
+    # device_share must measure the TIMED window only: zero the routing
+    # counters so warm-up and setup-phase signatures don't mask a
+    # routed-out device
+    vp = node.verify_plane
+    vp.device_sigs = vp.cpu_sigs = vp.verified = 0
     t0 = time.perf_counter()
     for start in range(0, len(txs), chunk):
         part = txs[start : start + chunk]
@@ -162,8 +167,9 @@ def _drive_node(backend, txs, chunk=500, setup_phases=()):
         node.ops.accept_ledger()
     dt = time.perf_counter() - t0
     committed = node.ledger_master.closed_ledger().seq
+    share = node.verify_plane.get_json().get("device_share", 0.0)
     node.stop()
-    return dt, committed
+    return dt, committed, share
 
 
 def bench_payment_flood(backends):
@@ -175,10 +181,11 @@ def bench_payment_flood(backends):
     master = KeyPair.from_passphrase("masterpassphrase")
     txs = _payments(master, n)
     rates = {}
+    shares = {}
     for b in backends:
-        dt, _ = _drive_node(b, txs)  # _drive_node re-deserializes per leg
+        dt, _, shares[b] = _drive_node(b, txs)  # re-deserializes per leg
         rates[b] = n / dt
-    _emit_config("payment_flood_tx_per_sec", rates)
+    _emit_config("payment_flood_tx_per_sec", rates, shares=shares)
     return rates
 
 
@@ -276,10 +283,11 @@ def bench_offer_mix(backends):
     setup, work = _offer_workload(n)
 
     rates = {}
+    shares = {}
     for b in backends:
-        dt, _ = _drive_node(b, work, chunk=300, setup_phases=setup)
+        dt, _, shares[b] = _drive_node(b, work, chunk=300, setup_phases=setup)
         rates[b] = len(work) / dt
-    _emit_config("offer_mix_tx_per_sec", rates)
+    _emit_config("offer_mix_tx_per_sec", rates, shares=shares)
     return rates
 
 
@@ -297,6 +305,7 @@ def bench_consensus_close(backends):
     txs = _payments(master, rounds * per_round)
 
     p50s = {}
+    shares = {}
     for b in backends:
         plane = VerifyPlane(backend=b, window_ms=1.0)
         if b != "cpu":
@@ -317,6 +326,8 @@ def bench_consensus_close(backends):
             v.node.verify_many = plane.verify_many
         net.start()
         net.run_until(lambda: net.all_validated_at_least(2), 30)
+        # device_share covers the measured rounds only (not warm-up)
+        plane.device_sigs = plane.cpu_sigs = plane.verified = 0
         times = []
         submitted = 0
         leg_txs = _fresh(txs)  # no memoized-signature leak across legs
@@ -333,12 +344,14 @@ def bench_consensus_close(backends):
             if not ok:
                 break
             times.append((time.perf_counter() - t0) * 1000.0)
+        shares[b] = plane.get_json().get("device_share", 0.0)
         plane.stop()
         times.sort()
         if times:  # a leg that never closed is omitted, not Infinity
             p50s[b] = times[len(times) // 2]
     _emit_config(
-        "consensus_close_p50_ms", p50s, lower_is_better=True, unit="ms"
+        "consensus_close_p50_ms", p50s, lower_is_better=True, unit="ms",
+        shares=shares,
     )
     return p50s
 
@@ -368,24 +381,30 @@ def bench_replay(backends):
     db = node.nodestore
 
     rates = {}
+    shares = {}
     for b in backends:
         hasher = make_hasher(b)
         # unmeasured warm-up: the first replay through a device hasher
         # compiles the masked/scatter kernels — keep that out of the
         # timed window (steady-state is what the config measures)
         replay_ledger(db, hashes[0], hash_batch=hasher)
+        hasher.device_nodes = 0
+        hasher.host_nodes = 0
         total_tx = 0
         t0 = time.perf_counter()
         for h in hashes:
             stats = replay_ledger(db, h, hash_batch=hasher)
             total_tx += stats.get("tx_count", per)
         rates[b] = total_tx / (time.perf_counter() - t0)
+        hashed = hasher.device_nodes + hasher.host_nodes
+        shares[b] = (hasher.device_nodes / hashed) if hashed else 0.0
     node.stop()
-    _emit_config("replay_tx_per_sec", rates)
+    _emit_config("replay_tx_per_sec", rates, shares=shares)
     return rates
 
 
-def _emit_config(metric, rates, lower_is_better=False, unit="tx/s"):
+def _emit_config(metric, rates, lower_is_better=False, unit="tx/s",
+                 shares=None):
     cpu = rates.get("cpu")
     dev = rates.get("tpu")
     value = dev if dev is not None else cpu
@@ -397,16 +416,20 @@ def _emit_config(metric, rates, lower_is_better=False, unit="tx/s"):
         vs = (cpu / dev) if lower_is_better else (dev / cpu)
     else:
         vs = 0.0
-    _emit(
-        {
-            "metric": metric,
-            "value": round(value, 2),
-            "unit": unit,
-            "vs_baseline": round(vs, 3),
-            "cpu_baseline": round(cpu, 2) if cpu else None,
-            "fallback": dev is None,
-        }
-    )
+    out = {
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": unit,
+        "vs_baseline": round(vs, 3),
+        "cpu_baseline": round(cpu, 2) if cpu else None,
+        "fallback": dev is None,
+    }
+    if shares is not None and "tpu" in shares:
+        # device share of the work actually routed to the chip on the
+        # tpu leg: a ~1.0 ratio with device_share 0 means the routing
+        # model benched the device OUT, not that the device kept up
+        out["device_share"] = round(shares["tpu"], 4)
+    _emit(out)
 
 
 def main() -> None:

@@ -46,6 +46,11 @@ class BatchHasher:
 
     name = "abstract"
 
+    # routing counters (bench legs report a "device share" so a hasher
+    # that silently falls back to host cannot look device-accelerated)
+    device_nodes = 0
+    host_nodes = 0
+
     def prefix_hash_batch(self, prefixes: Sequence[int], payloads: Sequence[bytes]) -> list[bytes]:
         raise NotImplementedError
 
@@ -116,6 +121,7 @@ class CpuHasher(BatchHasher):
     def prefix_hash_batch(self, prefixes, payloads):
         from ..utils.hashes import prefix_hash
 
+        self.host_nodes += len(prefixes)
         return [prefix_hash(p, d) for p, d in zip(prefixes, payloads)]
 
 
@@ -244,8 +250,10 @@ class TpuHasher(BatchHasher):
             ladder = next((l for l in LEAF_BLOCK_LADDER if nb <= l), None)
             if ladder is None:  # oversized: host path (rare)
                 out[i] = prefix_hash(prefixes[i], payloads[i])
+                self.host_nodes += 1
             else:
                 buckets.setdefault(ladder, []).append(i)
+                self.device_nodes += 1
         results = []  # (idxs, device_state) — dispatched async, read after
         for ladder, idxs in buckets.items():
             blocks, nblocks = pad_leaf_batch([msgs[i] for i in idxs], ladder)
@@ -345,6 +353,7 @@ class TpuHasher(BatchHasher):
                 offset += _pow2(len(inners))
 
         if not plan:
+            self.host_nodes += hashed_host
             return hashed_host
 
         cap = _pow2(offset)
@@ -402,6 +411,8 @@ class TpuHasher(BatchHasher):
                 if node._hash is None:
                     row = index_of[id(node)]
                     node._hash = raw[row * 32 : row * 32 + 32]
+        self.host_nodes += hashed_host
+        self.device_nodes += len(index_of)
         return hashed_host + len(index_of)
 
 

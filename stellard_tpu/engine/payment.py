@@ -215,7 +215,12 @@ class PaymentTransactor(Transactor):
         )
         paths = list(tx_paths)
         if not (flags & tfNoRippleDirect):
-            paths.append([])  # the default path
+            # the default path goes FIRST: on equal quality the flow
+            # loop keeps the earliest strand, and the reference builds
+            # the direct PathState before the explicit ones
+            # (RippleCalc.cpp pre-loop addPathState(STPath(), ...)), so
+            # ties drain the direct line before any attached path
+            paths.insert(0, [])
         partial = bool(flags & tfPartialPayment)
         limit_quality = None
         if flags & tfLimitQuality:

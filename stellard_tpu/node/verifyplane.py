@@ -163,6 +163,12 @@ class VerifyPlane:
         self.verified = 0
         self.device_batches = 0
         self.cpu_batches = 0
+        # per-SIGNATURE routing counters: a bench leg's "device share"
+        # (device_sigs / verified) proves the device actually did work —
+        # latency-aware routing can otherwise zero the device out while
+        # the leg still reports a healthy ~1.0 ratio (VERDICT r3 weak #6)
+        self.device_sigs = 0
+        self.cpu_sigs = 0
         self._hist: dict[str, list[int]] = {
             "cpu": [0] * len(_HIST_EDGES),
             "device": [0] * len(_HIST_EDGES),
@@ -234,10 +240,12 @@ class VerifyPlane:
         if use_device:
             self.model.observe_device(n, ms)
             self.device_batches += 1
+            self.device_sigs += n
             self._record("device", ms)
         else:
             self.model.observe_cpu(n, ms)
             self.cpu_batches += 1
+            self.cpu_sigs += n
             self._record("cpu", ms)
         self.batches += 1
         self.verified += n
@@ -261,6 +269,13 @@ class VerifyPlane:
             "verified": self.verified,
             "device_batches": self.device_batches,
             "cpu_batches": self.cpu_batches,
+            "device_sigs": self.device_sigs,
+            "cpu_sigs": self.cpu_sigs,
+            "device_share": (
+                round(self.device_sigs / self.verified, 4)
+                if self.verified
+                else 0.0
+            ),
             "pending": len(self._pending),
             "model": model,
             "latency_histogram_ms": {

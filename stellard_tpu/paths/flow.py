@@ -133,9 +133,17 @@ def plan_strand(
     # `cur_issuer == cur_acct` is the no-SendMax placeholder (the sender
     # stands in as issuer of its own spend) — same-currency delivery from
     # there needs no book, just the issuer ripple below.
+    # An IOU dst_amount whose issuer IS the destination account means
+    # "any issuer the destination accepts" (reference: STAmount
+    # issuer-of-self convention) — whatever issuer the strand carries is
+    # deliverable, so no issuer-correcting book is implied.
+    flexible = (
+        dst_amount.currency != CURRENCY_XRP and dst_amount.issuer == dst
+    )
     if cur_currency != dst_amount.currency or (
         cur_currency != CURRENCY_XRP
         and dst_amount.currency != CURRENCY_XRP
+        and not flexible
         and cur_issuer != dst_amount.issuer
         and cur_issuer != cur_acct
         and cur_acct != dst
@@ -155,8 +163,14 @@ def plan_strand(
             hops.append(AccountHop(cur_acct, dst, CURRENCY_XRP))
         else:
             # deliver through the issuer when src/dst share no line
-            # (reference: implied issuer node for the default path)
-            issuer = dst_amount.issuer
+            # (reference: implied issuer node for the default path).
+            # Flexible delivery routes through the issuer the strand
+            # actually carries.
+            issuer = (
+                cur_issuer
+                if (flexible and cur_issuer != cur_acct)
+                else dst_amount.issuer
+            )
             if cur_acct != issuer and dst != issuer:
                 hops.append(AccountHop(cur_acct, issuer, cur_currency))
                 cur_acct = issuer
@@ -323,6 +337,15 @@ def execute_strand(
                         need.issuer,
                     )
         else:
+            # the requirement carried backward may still be denominated
+            # in the FINAL delivery issuer (e.g. flexible issuer-of-dst
+            # amounts); this book produces hop.out_issuer's IOUs — quote
+            # and target in that denomination
+            if not need.is_native and need.issuer != hop.out_issuer:
+                need = STAmount.from_iou(
+                    hop.out_currency, hop.out_issuer,
+                    need.mantissa, need.offset, need.negative,
+                )
             targets[i] = need
             # book input requirement discovered by quote
             in_needed, out_avail = book_quote(

@@ -29,7 +29,7 @@ from ..state.entryset import LedgerEntrySet
 from .flow import CURRENCY_XRP, PathError, execute_strand, plan_strand
 from .orderbook import OrderBookDB
 
-__all__ = ["find_paths", "account_lines_of"]
+__all__ = ["find_paths", "build_path_set", "account_lines_of"]
 
 MAX_GATEWAY_FANOUT = 16
 
@@ -80,7 +80,17 @@ def _source_assets(
     if send_max is not None:
         if send_max.is_native:
             return [(CURRENCY_XRP, ACCOUNT_ZERO)]
-        return [(send_max.currency, send_max.issuer)]
+        if send_max.issuer != src:
+            return [(send_max.currency, send_max.issuer)]
+        # SendMax issuer == source account: "any of my <currency>" —
+        # every line the source holds in that currency is spendable
+        # (reference: STAmount issuer-of-self convention in RippleCalc)
+        out = [
+            (line["currency"], line["peer"])
+            for line in account_lines_of(les, src, send_max.currency)
+            if line["balance"].signum() > 0 or line["peer_limit"].signum() > 0
+        ]
+        return out or [(send_max.currency, src)]
     assets: list[tuple[bytes, bytes]] = [(CURRENCY_XRP, ACCOUNT_ZERO)]
     for line in account_lines_of(les, src):
         if line["balance"].signum() > 0 or line["peer_limit"].signum() > 0:
@@ -101,6 +111,17 @@ def _candidate_paths(
     [XRP-bridge], each with implied issuer delivery."""
     c_d = dst_amount.currency
     i_d = ACCOUNT_ZERO if dst_amount.is_native else dst_amount.issuer
+    # delivery issuers dst accepts: an IOU amount whose issuer is the
+    # destination itself means "any issuer dst trusts" (reference:
+    # STAmount issuer-of-self convention in Pathfinder/RippleCalc)
+    if dst_amount.is_native:
+        dst_issuers = {ACCOUNT_ZERO}
+    elif i_d == dst:
+        dst_issuers = {
+            l["peer"] for l in account_lines_of(les, dst, c_d)
+        } | {dst}
+    else:
+        dst_issuers = {i_d}
     candidates: list[list[PathElement]] = []
 
     src_assets = _source_assets(les, src, send_max)
@@ -147,35 +168,60 @@ def _candidate_paths(
                             ]
                         )
 
-    # cross-currency: convert some source asset through a book
+    # cross-currency: convert some source asset through a book, then
+    # (when the book's out-issuer is not directly acceptable) ripple the
+    # proceeds through an account chain to one the destination trusts
+    if c_d == CURRENCY_XRP:
+        dst_line_peers: set[bytes] = set()
+    elif i_d == dst:
+        dst_line_peers = dst_issuers - {dst}  # computed above, same walk
+    else:
+        dst_line_peers = {l["peer"] for l in account_lines_of(les, dst, c_d)}
     for c_s, i_s in src_assets:
         if c_s == c_d and (c_s == CURRENCY_XRP or i_s == i_d):
             continue
-        direct_book = any(
-            b.out_currency == c_d and b.out_issuer == i_d
-            for b in books.books_taking(c_s, i_s)
-        )
-        if direct_book:
-            candidates.append(
-                [PathElement(currency=c_d, issuer=None if dst_amount.is_native else i_d)]
-            )
+        for b in books.books_taking(c_s, i_s):
+            if b.out_currency != c_d:
+                continue
+            g = b.out_issuer
+            if dst_amount.is_native:
+                candidates.append([PathElement(currency=c_d, issuer=None)])
+                continue
+            if g in dst_issuers:
+                candidates.append([PathElement(currency=c_d, issuer=g)])
+                continue
+            # book lands on issuer g the destination does not trust:
+            # extend through a connector m holding lines at both ends
+            # (reference: Pathfinder's book + account continuations)
+            for l2 in account_lines_of(les, g, c_d)[:MAX_GATEWAY_FANOUT]:
+                m = l2["peer"]
+                if m in (src, dst, g):
+                    continue
+                if m in dst_issuers or m in dst_line_peers:
+                    candidates.append([
+                        PathElement(currency=c_d, issuer=g),
+                        PathElement(account=g),
+                        PathElement(account=m),
+                    ])
         # XRP bridge: (c_s → XRP) then (XRP → c_d)
         if c_s != CURRENCY_XRP and c_d != CURRENCY_XRP:
             leg1 = any(
                 b.out_currency == CURRENCY_XRP
                 for b in books.books_taking(c_s, i_s)
             )
-            leg2 = any(
-                b.out_currency == c_d and b.out_issuer == i_d
+            leg2_issuers = {
+                b.out_issuer
                 for b in books.books_taking(CURRENCY_XRP, ACCOUNT_ZERO)
-            )
-            if leg1 and leg2:
-                candidates.append(
-                    [
-                        PathElement(currency=CURRENCY_XRP),
-                        PathElement(currency=c_d, issuer=i_d),
-                    ]
-                )
+                if b.out_currency == c_d and b.out_issuer in dst_issuers
+            }
+            if leg1:
+                for g in sorted(leg2_issuers):
+                    candidates.append(
+                        [
+                            PathElement(currency=CURRENCY_XRP),
+                            PathElement(currency=c_d, issuer=g),
+                        ]
+                    )
 
     # dedup, preserving order
     seen: set[tuple] = set()
@@ -198,40 +244,39 @@ def find_paths(
     send_max: Optional[STAmount] = None,
     max_paths: int = 4,
     books: Optional[OrderBookDB] = None,
+    include_partial: bool = False,
 ) -> list[dict]:
     """Liquidity-checked alternatives, best quality first:
-    [{"paths": [path], "source_amount": STAmount}] (the shape
-    `ripple_path_find` renders; reference: Pathfinder::findPaths +
-    getJson)."""
+    [{"paths": [path], "source_amount": STAmount, "delivered": STAmount}]
+    (the shape `ripple_path_find` renders; reference:
+    Pathfinder::findPaths + getJson). With include_partial, strands that
+    deliver only part of the target are appended after the full
+    alternatives (for build_path payment construction)."""
     les = LedgerEntrySet(ledger)
     if books is None:
         books = OrderBookDB.for_ledger(ledger)
     candidates = _candidate_paths(les, src, dst, dst_amount, send_max, books)
 
     if send_max is not None:
-        probe_assets = [
-            (send_max.currency,
-             ACCOUNT_ZERO if send_max.is_native else send_max.issuer)
-        ]
+        # _source_assets resolves the issuer-of-self convention (SendMax
+        # issuer == src means "any of my <currency>")
+        probe_assets = _source_assets(les, src, send_max)
     else:
         probe_assets = None
 
     results = []
+    partials = []
     for path in candidates:
         if probe_assets is not None:
-            c_s, i_s = probe_assets[0]
+            assets = probe_assets
         elif path and path[0].currency is not None:
             # book-first path: source asset inferred per-asset; probe all
-            c_s, i_s = None, None
+            assets = _source_assets(les, src, None)
         else:
-            c_s, i_s = dst_amount.currency, (
-                ACCOUNT_ZERO if dst_amount.is_native else dst_amount.issuer
-            )
-        assets = (
-            [(c_s, i_s)]
-            if c_s is not None
-            else _source_assets(les, src, None)
-        )
+            assets = [(
+                dst_amount.currency,
+                ACCOUNT_ZERO if dst_amount.is_native else dst_amount.issuer,
+            )]
         for a_c, a_i in assets:
             try:
                 hops = plan_strand(src, dst, dst_amount, a_c, a_i, path)
@@ -251,8 +296,22 @@ def find_paths(
             except PathError:
                 continue
             if delivered < dst_amount:
+                if delivered.signum() > 0:
+                    # single strand covers only part of the target: not
+                    # an RPC "alternative", but a payment combining
+                    # several such strands may still succeed — kept for
+                    # build_path_set (reference: Pathfinder keeps
+                    # partial-liquidity paths for build_path payments)
+                    partials.append({
+                        "paths": [path],
+                        "source_amount": spent,
+                        "delivered": delivered,
+                    })
                 continue
-            results.append({"paths": [path], "source_amount": spent})
+            results.append(
+                {"paths": [path], "source_amount": spent,
+                 "delivered": delivered}
+            )
             break
 
     def cost_key(r):
@@ -267,4 +326,67 @@ def find_paths(
         return Fraction(a.mantissa) * Fraction(10) ** a.offset
 
     results.sort(key=cost_key)
+    if include_partial:
+        def quality_key(r):
+            """Partials rank primarily by how much of the TARGET they
+            cover (delivered is always in the dst denomination, so it is
+            comparable across strands); delivered-per-spent breaks ties,
+            with native spends scaled from drops to whole-STR units so
+            an XRP-spending strand is not penalized 10^6x against an
+            IOU-spending one (spend-asset values remain a heuristic —
+            there is no universal exchange rate to rank with)."""
+            from fractions import Fraction
+
+            d, s = r["delivered"], r["source_amount"]
+            dv = Fraction(d.mantissa) * Fraction(10) ** (0 if d.is_native else d.offset)
+            sv = Fraction(s.mantissa) * Fraction(10) ** (-6 if s.is_native else s.offset)
+            return (-dv, -(dv / sv) if sv else Fraction(0))
+
+        partials.sort(key=quality_key)
+        # one entry per path SHAPE (the same path probed with several
+        # source assets yields duplicates; keep its best-quality probe)
+        seen_shapes: set[tuple] = set()
+        uniq = []
+        for r in partials:
+            key = tuple(
+                (e.account, e.currency, e.issuer)
+                for p in r["paths"]
+                for e in p
+            )
+            if key not in seen_shapes:
+                seen_shapes.add(key)
+                uniq.append(r)
+        head = results[:max_paths]
+        return head + uniq[: max_paths - len(head)]
     return results[:max_paths]
+
+
+def build_path_set(
+    ledger,
+    src: bytes,
+    dst: bytes,
+    dst_amount: STAmount,
+    send_max: Optional[STAmount] = None,
+    max_paths: int = 4,
+) -> list[list[PathElement]]:
+    """Paths to ATTACH to a payment (the JS client's build_path /
+    reference Pathfinder usage from TransactionSign): full-liquidity
+    alternatives first, then partial-liquidity strands the flow engine
+    can combine with the default path to split a delivery no single
+    strand covers. The empty default path is excluded — the Payment
+    transactor always adds it (unless tfNoDirectRipple)."""
+    alts = find_paths(
+        ledger, src, dst, dst_amount, send_max=send_max,
+        max_paths=max_paths, include_partial=True,
+    )
+    out: list[list[PathElement]] = []
+    seen: set[tuple] = set()
+    for alt in alts:
+        for path in alt["paths"]:
+            if not path:
+                continue  # default path: transactor's job
+            key = tuple((e.account, e.currency, e.issuer) for e in path)
+            if key not in seen:
+                seen.add(key)
+                out.append(path)
+    return out[:max_paths]

@@ -757,7 +757,10 @@ def do_submit(ctx: Context) -> dict:
     elif "tx_json" in p:
         if "secret" not in p:
             raise RPCError("invalidParams", "missing secret")
-        tx = transaction_sign(ctx.node, p["tx_json"], p["secret"])
+        tx = transaction_sign(
+            ctx.node, p["tx_json"], p["secret"],
+            build_path=bool(p.get("build_path")),
+        )
     else:
         raise RPCError("invalidParams", "need tx_blob or tx_json")
     ter, _applied = ctx.node.ops.process_transaction(
@@ -772,7 +775,10 @@ def do_sign(ctx: Context) -> dict:
     p = ctx.params
     if "tx_json" not in p or "secret" not in p:
         raise RPCError("invalidParams", "need tx_json and secret")
-    tx = transaction_sign(ctx.node, p["tx_json"], p["secret"])
+    tx = transaction_sign(
+        ctx.node, p["tx_json"], p["secret"],
+        build_path=bool(p.get("build_path")),
+    )
     return {
         "tx_blob": tx.serialize().hex().upper(),
         "tx_json": {**tx.obj.to_json(), "hash": tx.txid().hex().upper()},

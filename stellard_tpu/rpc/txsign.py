@@ -32,8 +32,12 @@ def keypair_from_secret(secret: str) -> KeyPair:
     return KeyPair.from_seed(passphrase_to_seed(secret))
 
 
-def transaction_sign(node, tx_json: dict, secret: str) -> SerializedTransaction:
-    """Build + autofill + sign. Raises RPCError on malformed input."""
+def transaction_sign(
+    node, tx_json: dict, secret: str, build_path: bool = False
+) -> SerializedTransaction:
+    """Build + autofill + sign. Raises RPCError on malformed input.
+    With build_path, a pathless cross-currency Payment gets a pathfinder
+    path set attached (reference: TransactionSign.cpp bPath branch)."""
     if not isinstance(tx_json, dict):
         raise RPCError("invalidParams", "tx_json is not an object")
     if "Account" not in tx_json:
@@ -47,6 +51,39 @@ def transaction_sign(node, tx_json: dict, secret: str) -> SerializedTransaction:
     tx = SerializedTransaction(obj)
 
     ledger = node.ledger_master.current_ledger()
+
+    # autofill Paths (reference: TransactionSign.cpp:195-224 — only for
+    # a Payment that is not a plain native transfer and carries none)
+    from ..protocol.formats import TxType as _TT
+    from ..protocol.sfields import (
+        sfAmount as _amt,
+        sfDestination as _dst,
+        sfPaths as _paths,
+        sfSendMax as _smax,
+        sfTransactionType as _tt,
+    )
+
+    if (
+        build_path
+        and obj.get(_tt) == int(_TT.ttPAYMENT)
+        and _paths not in obj
+    ):
+        if _amt not in obj or _dst not in obj:
+            raise RPCError(
+                "invalidTransaction",
+                "Payment needs Amount and Destination",
+            )
+        amount = obj[_amt]
+        if not (amount.is_native and _smax not in obj):
+            from ..paths.pathfinder import build_path_set
+            from ..protocol.stobject import STPathSet
+
+            found = build_path_set(
+                ledger, tx.account, obj[_dst], amount,
+                send_max=obj.get(_smax),
+            )
+            if found:
+                obj[_paths] = STPathSet(found)
 
     # autofill Fee (reference: TransactionSign.cpp:225-240, load-scaled)
     if sfFee not in obj:

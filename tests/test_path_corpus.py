@@ -76,13 +76,17 @@ def amt(spec: str) -> STAmount:
 @dataclass
 class Scenario:
     """Declarative ledger: balances fund STR; trusts open lines;
-    iou pays issue IOUs; offers rest in books."""
+    iou pays issue IOUs; offers rest in books; transfer_rates set
+    gateway fees (reference: testutils.create_accounts/credit_limits/
+    payments + account_set().transfer_rate())."""
 
     accounts: dict[str, str]  # name -> STR balance ('1000.0')
     trusts: list[str] = field(default_factory=list)  # 'A1:500/USD/G1'
     ious: list[str] = field(default_factory=list)  # 'A1:100/USD/G1' (G1 pays A1)
     offers: list[tuple[str, str, str]] = field(default_factory=list)
     # (owner, taker_pays, taker_gets)
+    transfer_rates: dict[str, float] = field(default_factory=dict)
+    # issuer name -> rate (1.1 = 10% gateway fee)
 
     def build(self) -> Ledger:
         ledger = Ledger.genesis(ROOT.account_id)
@@ -106,6 +110,12 @@ class Scenario:
         for name, bal in self.accounts.items():
             apply(ROOT, TxType.ttPAYMENT, {
                 sfDestination: K(name).account_id, sfAmount: amt(bal),
+            })
+        for name, rate in self.transfer_rates.items():
+            from stellard_tpu.protocol.sfields import sfTransferRate
+
+            apply(K(name), TxType.ttACCOUNT_SET, {
+                sfTransferRate: int(rate * 1_000_000_000),
             })
         for t in self.trusts:
             holder, limit = t.split(":")
@@ -404,3 +414,249 @@ class TestCorpusReversePass:
         ).build()
         ter, _s, _g = pay_via_paths(led, "A1", "A2", "50/ABC/G3")
         assert ter in (TER.tecPATH_DRY, TER.tecPATH_PARTIAL)
+
+
+# --------------------------------------------------------------------------
+# cases mined from the reference's own JS corpus (test/path-test.js,
+# path1-test.js, path-tests.json — VERDICT r3 missing #5 / next #7).
+# These run payments through the ENGINE (payment transactor + attached
+# build_path set), exactly as the JS harness submits them.
+
+
+def pay_tx(
+    led: Ledger,
+    src: str,
+    dst: str,
+    deliver: str,
+    send_max: Optional[str] = None,
+    build_path: bool = False,
+    partial: bool = False,
+    seq: Optional[int] = None,
+):
+    """Submit a Payment like the JS tests do ($.remote.transaction()
+    .payment(...).build_path(true)); returns the engine TER."""
+    from stellard_tpu.paths.pathfinder import build_path_set
+    from stellard_tpu.protocol.stobject import STPathSet
+    from stellard_tpu.protocol.sttx import SerializedTransaction
+
+    engine = TransactionEngine(led)
+    root = led.account_root(K(src).account_id)
+    from stellard_tpu.protocol.sfields import sfSequence
+
+    tx = SerializedTransaction.build(
+        TxType.ttPAYMENT, K(src).account_id,
+        seq if seq is not None else root[sfSequence], 10,
+    )
+    tx.obj[sfAmount] = amt(deliver)
+    tx.obj[sfDestination] = K(dst).account_id
+    if send_max is not None:
+        tx.obj[sfSendMax] = amt(send_max)
+    if partial:
+        tx.obj[sfFlags] = tfPartialPayment
+    if build_path:
+        paths = build_path_set(
+            led, K(src).account_id, K(dst).account_id, amt(deliver),
+            send_max=amt(send_max) if send_max else None,
+        )
+        if paths:
+            tx.obj[sfPaths] = STPathSet(paths)
+    tx.sign(K(src))
+    ter, _did = engine.apply_transaction(tx, TxParams.NONE)
+    return ter
+
+
+def iou_balance(led: Ledger, holder: str, issuer: str, cur: str = "USD") -> str:
+    les = LedgerEntrySet(led)
+    return views.ripple_balance(
+        les, K(holder).account_id, K(issuer).account_id, currency_from_iso(cur)
+    ).value_text()
+
+
+class TestReferenceIssueCases:
+    """path-test.js suite('Issues') — the historical regression cases."""
+
+    def test_issue5_no_path_is_dry(self):
+        """'path negative: Issue #5': dan trusts everyone but is a dead
+        end (bob trusts nobody), so alice cannot reach bob at all."""
+        led = Scenario(
+            accounts={"alice": "10000.0", "bob": "10000.0",
+                      "carol": "10000.0", "dan": "10000.0"},
+            trusts=["dan:100/USD/alice", "dan:100/USD/bob",
+                    "dan:100/USD/carol", "alice:100/USD/bob",
+                    "carol:100/USD/bob"],
+        ).build()
+        # bob sends carol 75 of his own issue first (as the JS test does)
+        assert pay_tx(led, "bob", "carol", "75/USD/bob") == TER.tesSUCCESS
+        assert iou_balance(led, "carol", "bob") == "75"
+        # no alternatives alice -> bob
+        alts = find_paths(
+            led, K("alice").account_id, K("bob").account_id,
+            amt("25/USD/bob"),
+        )
+        assert alts == []
+        # and the payment is dry
+        ter = pay_tx(led, "alice", "bob", "25/USD/alice", build_path=True)
+        assert ter == TER.tecPATH_DRY, ter
+
+    def test_issue23_smaller_split_delivery(self):
+        """'ripple-client issue #23: smaller': 55 USD via the direct
+        line (40 cap) plus the carol->dan chain (15 of its 20 cap) —
+        balances match the reference's verify_balances table exactly."""
+        led = Scenario(
+            accounts={"alice": "10000.0", "bob": "10000.0",
+                      "carol": "10000.0", "dan": "10000.0"},
+            trusts=["bob:40/USD/alice", "bob:20/USD/dan",
+                    "carol:20/USD/alice", "dan:20/USD/carol"],
+        ).build()
+        ter = pay_tx(led, "alice", "bob", "55/USD/bob", build_path=True)
+        assert ter == TER.tesSUCCESS, ter
+        assert iou_balance(led, "bob", "alice") == "40"
+        assert iou_balance(led, "bob", "dan") == "15"
+
+    def test_issue23_larger_split_delivery(self):
+        """'ripple-client issue #23: larger': 50 USD split 25 via amazon
+        + 25 via the carol->dan chain."""
+        led = Scenario(
+            accounts={"alice": "10000.0", "bob": "10000.0",
+                      "carol": "10000.0", "dan": "10000.0",
+                      "amazon": "10000.0"},
+            trusts=["amazon:120/USD/alice", "bob:25/USD/amazon",
+                    "bob:100/USD/dan", "carol:25/USD/alice",
+                    "dan:75/USD/carol"],
+        ).build()
+        ter = pay_tx(led, "alice", "bob", "50/USD/bob", build_path=True)
+        assert ter == TER.tesSUCCESS, ter
+        assert iou_balance(led, "bob", "amazon") == "25"
+        assert iou_balance(led, "bob", "dan") == "25"
+        assert iou_balance(led, "carol", "alice") == "25"
+        assert iou_balance(led, "carol", "dan") == "-25"
+        assert iou_balance(led, "dan", "carol") == "25"
+        assert iou_balance(led, "dan", "bob") == "-25"
+
+
+class TestReferenceTransferRate:
+    """path-test.js 'alternative paths - consume best transfer (first)':
+    gateway transfer fees steer strand selection."""
+
+    _SCENARIO = dict(
+        accounts={"alice": "10000.0", "bob": "10000.0",
+                  "mtgox": "10000.0", "bitstamp": "10000.0"},
+        trusts=["alice:600/USD/mtgox", "alice:800/USD/bitstamp",
+                "bob:700/USD/mtgox", "bob:900/USD/bitstamp"],
+        ious=["alice:70/USD/bitstamp", "alice:70/USD/mtgox"],
+        transfer_rates={"bitstamp": 1.1},
+    )
+
+    def test_consume_best_transfer(self):
+        """70 USD fits entirely through the par gateway (mtgox); the
+        1.1-rate gateway is untouched."""
+        led = Scenario(**self._SCENARIO).build()
+        ter = pay_tx(led, "alice", "bob", "70/USD/bob", build_path=True)
+        assert ter == TER.tesSUCCESS, ter
+        assert iou_balance(led, "alice", "mtgox") == "0"
+        assert iou_balance(led, "alice", "bitstamp") == "70"
+        assert iou_balance(led, "bob", "mtgox") == "70"
+        assert iou_balance(led, "bob", "bitstamp") == "0"
+
+    def test_consume_best_transfer_first(self):
+        """77 USD: 70 through par mtgox first, the remaining 7 through
+        bitstamp costing 7.7 (10% gateway fee) — alice ends with
+        62.3/USD/bitstamp, the reference's exact expectation."""
+        led = Scenario(**self._SCENARIO).build()
+        ter = pay_tx(
+            led, "alice", "bob", "77/USD/bob",
+            send_max="100/USD/alice", build_path=True,
+        )
+        assert ter == TER.tesSUCCESS, ter
+        assert iou_balance(led, "alice", "mtgox") == "0"
+        assert iou_balance(led, "alice", "bitstamp") == "62.3"
+        assert iou_balance(led, "bob", "mtgox") == "70"
+        assert iou_balance(led, "bob", "bitstamp") == "7"
+
+
+class TestReferencePathTable:
+    """The declarative scenarios of test/path-tests.json, built
+    literally (accounts A1-A3, gateways G1-G3, market maker M1)."""
+
+    def _t12_ledger(self) -> Ledger:
+        """Path Tests #1/#2 ledger."""
+        return Scenario(
+            accounts={"A1": "100000.0", "A2": "10000.0", "A3": "10000.0",
+                      "G1": "10000.0", "G2": "10000.0", "G3": "10000.0",
+                      "M1": "10000.0"},
+            trusts=["A1:5000/XYZ/G1", "A1:5000/ABC/G3",
+                    "A2:5000/XYZ/G2", "A2:5000/ABC/G3",
+                    "A3:1000/ABC/A2",
+                    "M1:100000/XYZ/G1", "M1:100000/ABC/G3",
+                    "M1:100000/XYZ/G2"],
+            ious=["A1:3500/XYZ/G1", "A1:1200/ABC/G3",
+                  "M1:25000/XYZ/G2", "M1:25000/ABC/G3"],
+            offers=[("M1", "1000/XYZ/G1", "1000/XYZ/G2"),
+                    ("M1", "10000.0", "1000/ABC/G3")],
+        ).build()
+
+    def test_t1_str_to_str_no_alternatives(self):
+        """T1-A: STR->STR has no alternatives (native transfers don't
+        path-find)."""
+        led = self._t12_ledger()
+        alts = find_paths(
+            led, K("A1").account_id, K("A2").account_id, amt("10.0"),
+            send_max=amt("10.0"),
+        )
+        assert alts == []
+
+    def test_t2a_iou_to_issuer_via_str(self):
+        """T2-A: A2 sends 10 ABC/G3 to G3 spending STR: one alternative
+        costing 100 STR (M1's 10-STR-per-ABC book)."""
+        led = self._t12_ledger()
+        alts = find_paths(
+            led, K("A2").account_id, K("G3").account_id, amt("10/ABC/G3"),
+            send_max=amt("100000.0"),
+        )
+        assert len(alts) == 1, [a["source_amount"].value_text() for a in alts]
+        assert alts[0]["source_amount"].is_native
+        assert alts[0]["source_amount"].drops() == 100 * XRP
+
+    def test_t2b_iou_to_holder_via_str(self):
+        """T2-B: A1 sends 1 ABC (as accepted by A2) spending STR:
+        10 STR through the book then G3."""
+        led = self._t12_ledger()
+        alts = find_paths(
+            led, K("A1").account_id, K("A2").account_id, amt("1/ABC/A2"),
+            send_max=amt("100000.0"),
+        )
+        assert len(alts) == 1
+        assert alts[0]["source_amount"].is_native
+        assert alts[0]["source_amount"].drops() == 10 * XRP
+
+    def test_t2c_two_hop_issuer_chain_via_str(self):
+        """T2-C: A1 -> A3 delivering 1 ABC/A3 (A3 only trusts A2's ABC):
+        book -> G3 -> A2 -> A3, still 10 STR."""
+        led = self._t12_ledger()
+        alts = find_paths(
+            led, K("A1").account_id, K("A3").account_id, amt("1/ABC/A3"),
+            send_max=amt("100000.0"),
+        )
+        assert len(alts) == 1, [a["source_amount"].value_text() for a in alts]
+        assert alts[0]["source_amount"].is_native
+        assert alts[0]["source_amount"].drops() == 10 * XRP
+
+    def test_t3_iou_to_str(self):
+        """Path Tests #3: A1 pays A2 10 STR spending ABC: 1 ABC through
+        G3 then the ABC->STR book."""
+        led = Scenario(
+            accounts={"A1": "10000.0", "A2": "10000.0", "G3": "10000.0",
+                      "M1": "11000.0"},
+            trusts=["A1:1000/ABC/G3", "A2:1000/ABC/G3",
+                    "M1:100000/ABC/G3"],
+            ious=["A1:1000/ABC/G3", "M1:1200/ABC/G3"],
+            offers=[("M1", "1000/ABC/G3", "10000.0")],
+        ).build()
+        alts = find_paths(
+            led, K("A1").account_id, K("A2").account_id, amt("10.0"),
+            send_max=amt("1000/ABC/A1"),
+        )
+        assert len(alts) == 1, [a["source_amount"].value_text() for a in alts]
+        a = alts[0]["source_amount"]
+        assert not a.is_native
+        assert a.value_text() == "1"

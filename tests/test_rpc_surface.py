@@ -221,3 +221,56 @@ class TestPowUnit:
         hard = ProofOfWork("t", 256, b"\x01" * 32,
                            ((1 << 240) - 1).to_bytes(32, "big"))
         assert hard.difficulty > easy.difficulty
+
+
+class TestBuildPath:
+    def test_sign_build_path_attaches_chain_path(self, node):
+        """reference: TransactionSign.cpp bPath branch — 'build_path'
+        on sign/submit path-fills a Payment that needs a non-default
+        path. Chain: carol trusts bob, dave trusts carol; bob delivers
+        USD acceptable to dave — only the [carol] path works."""
+        master = node.master_keys
+        carol = KeyPair.from_passphrase("bp-carol")
+        dave = KeyPair.from_passphrase("bp-dave")
+
+        def tx(key, tx_type, seq, fields):
+            t = SerializedTransaction.build(
+                tx_type, key.account_id, seq, 10
+            )
+            for f, v in fields.items():
+                t.obj[f] = v
+            t.sign(key)
+            ter, _ = node.submit(t)
+            assert int(ter) == 0, f"{tx_type}: {ter!r}"
+
+        from stellard_tpu.protocol.sfields import sfLimitAmount
+
+        tx(master, TxType.ttPAYMENT, 3,
+           {sfDestination: carol.account_id,
+            sfAmount: STAmount.from_drops(1000 * XRP)})
+        tx(master, TxType.ttPAYMENT, 4,
+           {sfDestination: dave.account_id,
+            sfAmount: STAmount.from_drops(1000 * XRP)})
+        node.close_ledger()
+        tx(carol, TxType.ttTRUST_SET, 1,
+           {sfLimitAmount: STAmount.from_iou(USD, BOB.account_id, 100, 0)})
+        tx(dave, TxType.ttTRUST_SET, 1,
+           {sfLimitAmount: STAmount.from_iou(USD, carol.account_id, 100, 0)})
+        node.close_ledger()
+
+        res = call(node, "sign",
+                   tx_json={
+                       "TransactionType": "Payment",
+                       "Account": BOB.human_account_id,
+                       "Destination": dave.human_account_id,
+                       "Amount": {"currency": "USD",
+                                  "issuer": dave.human_account_id,
+                                  "value": "5"},
+                   },
+                   secret="bob",
+                   build_path=True)
+        assert "error" not in res, res
+        assert "Paths" in res["tx_json"], res["tx_json"].keys()
+        # and the signed tx actually lands through that path
+        res2 = call(node, "submit", tx_blob=res["tx_blob"])
+        assert res2.get("engine_result") == "tesSUCCESS", res2
