@@ -276,13 +276,23 @@ def _offline_tools(args, cfg) -> int:
         hdr = txdb.get_ledger_header(seq=args.ledger)
         if hdr is None:
             raise SystemExit(f"no stored ledger {args.ledger}")
-        # replay through the CONFIGURED hash backend — this is the
-        # BASELINE #5 harness, so it must measure the device pipeline
+        # replay through the CONFIGURED hash/signature backends — this is
+        # the BASELINE #5 harness, so it must measure the device pipeline
+        # (batched re-verification is the catch-up trust model)
         from .crypto.backend import make_hasher
+        from .node.verifyplane import VerifyPlane
 
         hasher = make_hasher(cfg.hash_backend)
-        stats = replay_ledger(db, hdr["hash"],
-                              hash_batch=hasher)
+        plane = VerifyPlane(backend=cfg.signature_backend, window_ms=1.0)
+        stats = replay_ledger(db, hdr["hash"], hash_batch=hasher,
+                              verify_many=plane.verify_many)
+        # routing evidence: without this, latency-aware routing could
+        # verify everything on the CPU while the harness claims a
+        # device-pipeline measurement
+        pj = plane.get_json()
+        stats["device_share"] = pj.get("device_share", 0.0)
+        stats["device_sigs"] = pj.get("device_sigs", 0)
+        plane.stop()
         print(json.dumps(stats, indent=2))
         return 0 if stats["ok"] else 1
     return 0

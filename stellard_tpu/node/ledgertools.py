@@ -133,6 +133,7 @@ def replay_ledger(
     db: Database,
     ledger_hash: bytes,
     hash_batch: Optional[Callable] = None,
+    verify_many: Optional[Callable] = None,
 ) -> dict:
     """Re-close a stored ledger from its parent and verify the result
     hashes identically (reference: --ledger N --replay, Main.cpp:325-332).
@@ -140,7 +141,13 @@ def replay_ledger(
     Loads ledger L and parent P from the NodeStore, re-applies L's tx
     set to P in canonical order through the full engine, re-hashes both
     trees through the (device) BatchHasher, and compares against L's
-    recorded hashes. Returns timing/throughput stats."""
+    recorded hashes. Returns timing/throughput stats.
+
+    With `verify_many` (a VerifyPlane-style batched verifier), every tx
+    signature in the ledger is re-verified in ONE batch up front and the
+    verdicts memoized into the txs — the HashRouter SF_SIGGOOD seam — so
+    the per-tx engine path skips its inline host verify. This is the
+    catch-up trust model: replayed history is re-verified, batched."""
     kw = {"hash_batch": hash_batch} if hash_batch else {}
     target = Ledger.load(db, ledger_hash, **kw)
     parent = Ledger.load(db, target.parent_hash, **kw)
@@ -150,6 +157,15 @@ def replay_ledger(
         for _txid, blob, _meta in target.tx_entries()
     ]
     t0 = time.perf_counter()
+    if verify_many is not None and txs:
+        from ..crypto.backend import VerifyRequest
+
+        flags = verify_many([
+            VerifyRequest(tx.signing_pub_key, tx.signing_hash(), tx.signature)
+            for tx in txs
+        ])
+        for tx, good in zip(txs, flags):
+            tx.set_sig_verdict(bool(good))
     replay = parent.open_successor()
     txset = CanonicalTXSet(parent.hash())
     for tx in txs:

@@ -102,3 +102,31 @@ class TestReplay:
         # sanity: replaying with the wrong target hash raises (missing key)
         with pytest.raises((KeyError, ValueError)):
             replay_ledger(db, b"\x13" * 32)
+
+    def test_replay_batched_reverify_seam(self, chain):
+        """Replay re-verifies every tx signature in ONE batched
+        verify_many call and memoizes the verdicts (catch-up trust
+        model, HashRouter SF_SIGGOOD role). A refused verdict makes the
+        replay diverge instead of silently trusting stored history."""
+        _lm, db, ledgers, _accounts = chain
+        target = ledgers[-1]
+
+        calls = []
+
+        def spy_ok(reqs):
+            calls.append(len(reqs))
+            import numpy as np
+
+            return np.ones(len(reqs), bool)
+
+        stats = replay_ledger(db, target.hash(), verify_many=spy_ok)
+        assert stats["ok"]
+        assert calls == [stats["tx_count"]], "one batch for the whole set"
+
+        def spy_reject(reqs):
+            import numpy as np
+
+            return np.zeros(len(reqs), bool)
+
+        stats = replay_ledger(db, target.hash(), verify_many=spy_reject)
+        assert not stats["ok"], "rejected signatures must fail the replay"
