@@ -291,6 +291,71 @@ def bench_offer_mix(backends):
     return rates
 
 
+def _regular_key_workload(n, holders=24):
+    """BASELINE config #3 workload: `holders` accounts each set a
+    RegularKey, then flood AccountSet txs SIGNED WITH THE REGULAR KEY —
+    every tx exercises the regular-key authority branch of checkSig
+    (reference: Transactor::checkSig master-vs-regular, :151-180)."""
+    from stellard_tpu.protocol.formats import TxType
+    from stellard_tpu.protocol.keys import KeyPair
+    from stellard_tpu.protocol.sfields import (
+        sfAmount,
+        sfDestination,
+        sfRegularKey,
+        sfTransferRate,
+    )
+    from stellard_tpu.protocol.stamount import STAmount
+    from stellard_tpu.protocol.sttx import SerializedTransaction
+
+    master = KeyPair.from_passphrase("masterpassphrase")
+    accounts = [KeyPair.from_passphrase(f"bench-rk-{i}") for i in range(holders)]
+    regulars = [KeyPair.from_passphrase(f"bench-rk-reg-{i}") for i in range(holders)]
+
+    fund = []
+    for i, who in enumerate(accounts):
+        tx = SerializedTransaction.build(
+            TxType.ttPAYMENT, master.account_id, i + 1, 10,
+            {sfAmount: STAmount.from_drops(1_000_000_000),
+             sfDestination: who.account_id},
+        )
+        tx.sign(master)
+        fund.append(tx)
+    setkeys = []
+    for who, reg in zip(accounts, regulars):
+        tx = SerializedTransaction.build(
+            TxType.ttREGULAR_KEY_SET, who.account_id, 1, 10,
+            {sfRegularKey: reg.account_id},
+        )
+        tx.sign(who)
+        setkeys.append(tx)
+
+    work = []
+    seqs = [2] * holders
+    for i in range(n):
+        k = i % holders
+        tx = SerializedTransaction.build(
+            TxType.ttACCOUNT_SET, accounts[k].account_id, seqs[k], 10,
+            {sfTransferRate: 1_000_000_000 + (i % 7) * 1_000_000},
+        )
+        tx.sign(regulars[k])  # regular-key signature
+        seqs[k] += 1
+        work.append(tx)
+    return [fund, setkeys], work
+
+
+def bench_regular_key_fanout(backends):
+    """BASELINE config #3: SetRegularKey + AccountSet verify fan-out."""
+    n = int(os.environ.get("BENCH_RK_N", "1500"))
+    setup, work = _regular_key_workload(n)
+    rates = {}
+    shares = {}
+    for b in backends:
+        dt, _, shares[b] = _drive_node(b, work, chunk=300, setup_phases=setup)
+        rates[b] = len(work) / dt
+    _emit_config("regular_key_fanout_tx_per_sec", rates, shares=shares)
+    return rates
+
+
 def bench_consensus_close(backends):
     """BASELINE config #4: 4-validator private net, wall-clock p50 compute
     time per consensus round (virtual protocol waits cost nothing in the
@@ -465,6 +530,7 @@ def main() -> None:
         for fn in (
             bench_payment_flood,
             bench_offer_mix,
+            bench_regular_key_fanout,
             bench_consensus_close,
             bench_replay,
         ):

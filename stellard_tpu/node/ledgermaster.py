@@ -16,6 +16,7 @@ import threading
 from typing import Callable, Optional
 
 from ..engine.engine import TransactionEngine, TxParams
+from ..node.hashrouter import SF_SIGGOOD
 from ..protocol.sttx import SerializedTransaction
 from ..protocol.ter import TER
 from ..state.ledger import Ledger
@@ -44,6 +45,9 @@ class CanonicalTXSet:
     def erase(self, key: tuple) -> None:
         self._map.pop(key, None)
 
+    def values(self):
+        return self._map.values()
+
     def __len__(self):
         return len(self._map)
 
@@ -54,9 +58,16 @@ class CanonicalTXSet:
 class LedgerMaster:
     """Holds the chain: validated ←closed ←current(open)."""
 
-    def __init__(self, hash_batch: Optional[Callable] = None):
+    def __init__(
+        self, hash_batch: Optional[Callable] = None, router=None
+    ):
         self._lock = threading.RLock()
         self.hash_batch = hash_batch
+        # HashRouter: close-time re-application consults SF_SIGGOOD so
+        # txs verified at submit are not host-re-verified per close
+        # (reference: LedgerConsensus::applyTransaction skips checkSign
+        # via SF_SIGGOOD, LedgerConsensus.cpp:2101-2106)
+        self.router = router
         self.current: Optional[Ledger] = None  # open
         self.closed: Optional[Ledger] = None  # last closed (LCL)
         self.validated: Optional[Ledger] = None
@@ -155,6 +166,18 @@ class LedgerMaster:
 
     # -- close (standalone / consensus-accept share this tail) ------------
 
+    def _parse_with_verdict(self, txid: bytes, blob: bytes):
+        """Parse an open-ledger blob, carrying over the submit-time
+        SF_SIGGOOD verdict so close/re-apply never host-re-verifies
+        (reference: LedgerConsensus::applyTransaction skips checkSign
+        via SF_SIGGOOD, LedgerConsensus.cpp:2101-2106)."""
+        tx = SerializedTransaction.from_bytes(blob)
+        if self.router is not None and (
+            self.router.get_flags(txid) & SF_SIGGOOD
+        ):
+            tx.set_sig_verdict(True)
+        return tx
+
     def close_and_advance(
         self,
         close_time: int,
@@ -179,10 +202,13 @@ class LedgerMaster:
             prev = self.closed_ledger()
             open_ledger = self.current_ledger()
 
-            # 1. canonical set from the open ledger's recorded blobs
+            # 1. canonical set from the open ledger's recorded blobs;
+            # SF_SIGGOOD verdicts memoized at submit time carry over to
+            # the freshly-parsed copies (the reference's close path
+            # skips checkSign the same way)
             txset = CanonicalTXSet(prev.hash())
-            for _txid, blob, _meta in open_ledger.tx_entries():
-                txset.insert(SerializedTransaction.from_bytes(blob))
+            for txid, blob, _meta in open_ledger.tx_entries():
+                txset.insert(self._parse_with_verdict(txid, blob))
             for tx in extra_txs or []:
                 txset.insert(tx)
 
@@ -193,6 +219,10 @@ class LedgerMaster:
             # 3. seal + advance
             new_lcl.close(close_time, close_resolution, correct_close_time)
             new_lcl.accepted = True
+            # seed the parsed-tx memo so persist/publish reuse these
+            # exact objects instead of re-parsing every blob
+            for tx in txset.values():
+                new_lcl.parsed_txs[tx.txid()] = tx
             self._push_closed(new_lcl)
             self.current = new_lcl.open_successor()
 
@@ -238,14 +268,18 @@ class LedgerMaster:
 
             new_lcl.close(close_time, close_resolution, correct_close_time)
             new_lcl.accepted = True
+            for tx in txset.values():
+                new_lcl.parsed_txs[tx.txid()] = tx
             self._push_closed(new_lcl)
             self.current = new_lcl.open_successor()
 
-            # re-apply: our open-ledger txns that missed consensus, then held
+            # re-apply: our open-ledger txns that missed consensus, then
+            # held; SF_SIGGOOD verdicts from submit time carry over so
+            # the re-apply never host-re-verifies
             engine = TransactionEngine(self.current)
             consensus_ids = {tx.txid() for tx in txs}
             leftovers = [
-                SerializedTransaction.from_bytes(blob)
+                self._parse_with_verdict(txid, blob)
                 for txid, blob, _meta in open_ledger.tx_entries()
                 if txid not in consensus_ids
             ] + self.take_held_transactions()

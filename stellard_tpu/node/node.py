@@ -365,7 +365,7 @@ class Node:
             self.ledger_master = self.overlay.node.lm
         else:
             self.ledger_master = LedgerMaster(
-                hash_batch=self.hasher
+                hash_batch=self.hasher, router=self.hash_router
             )
 
         def _fetch_fallback(h: bytes):
@@ -691,7 +691,7 @@ class Node:
 
         with self.txdb.batch():
             for txn_seq, (txid, blob, meta) in enumerate(ledger.tx_entries()):
-                tx = SerializedTransaction.from_bytes(blob)
+                tx = ledger.parse_tx(txid, blob)
                 affected = affected_accounts(meta) if meta else [tx.account]
                 self.txdb.save_transaction(
                     txid,

@@ -92,6 +92,8 @@ class ValidatorNode:
         # validator: RPC-plane and peer-plane sig verdicts / suppression
         # must be ONE state (reference: a single getApp().getHashRouter())
         self.router = router if router is not None else HashRouter()
+        # close-time re-application skips re-verifying SF_SIGGOOD txs
+        self.lm.router = self.router
         from .localtxs import LocalTxs
 
         self.local_txs = LocalTxs()

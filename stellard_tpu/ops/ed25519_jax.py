@@ -80,6 +80,16 @@ NWINDOWS = 64  # ceil(256/4); scalars are < l < 2^253
 # byte-identical to the rolled form (and the compilation cache warm).
 _UNROLL = int(os.environ.get("STELLARD_VERIFY_UNROLL", "1"))
 
+# comb-table selection strategy (A/B'd by tools/kernel_sweep.py):
+#   mxu       — one [60,16]@[16,B] f32 matmul at HIGHEST precision
+#               (3 MXU passes; exact for 13-bit limbs)
+#   mxu_split — TWO one-pass matmuls on 7-bit/6-bit limb halves
+#               (halves are bf16-exact, so default precision suffices;
+#               2 passes of MXU work + a shift-add recombine)
+#   vpu       — int32 one-hot contraction on the VPU (no int<->float
+#               converts, ~960 lane mult-adds per window)
+_COMB_SELECT = os.environ.get("STELLARD_COMB_SELECT", "mxu")
+
 
 # --------------------------------------------------------------------------
 # point helpers
@@ -336,6 +346,38 @@ def verify_kernel(a_words, r_words, s_windows, h_digits, s_canonical):
     acc0_h = pt_identity(sw.shape[1:]) + zero
     acc0_s = pt_identity(sw.shape[1:]) + zero
 
+    def comb_entry(tj, w):
+        """Select comb window entries for digits w: [60,16] x [B] ->
+        [3, 20, B] int32 (strategy per _COMB_SELECT, see header)."""
+        if _COMB_SELECT == "vpu":
+            onehot_i = (
+                w[None, :] == jnp.arange(16, dtype=w.dtype)[:, None]
+            ).astype(jnp.int32)  # [16, B]
+            return jnp.sum(
+                tj.astype(jnp.int32)[:, :, None] * onehot_i[None, :, :],
+                axis=1,
+            ).reshape((3, NLIMB) + w.shape)
+        onehot = (
+            w[None, :] == jnp.arange(16, dtype=w.dtype)[:, None]
+        ).astype(jnp.float32)  # [16, B]
+        if _COMB_SELECT == "mxu_split":
+            # limb halves are bf16-exact (<= 127 / <= 63), so two
+            # DEFAULT-precision (single-pass) matmuls are exact
+            tji = tj.astype(jnp.int32)
+            lo = (tji & 0x7F).astype(jnp.float32)
+            hi = (tji >> 7).astype(jnp.float32)
+            sel_lo = jnp.matmul(lo, onehot).astype(jnp.int32)
+            sel_hi = jnp.matmul(hi, onehot).astype(jnp.int32)
+            return ((sel_hi << 7) + sel_lo).reshape((3, NLIMB) + w.shape)
+        # default "mxu": HIGHEST precision — default-precision TPU
+        # matmuls truncate f32 operands to bf16 (8-bit mantissa), which
+        # corrupts 13-bit limbs; the 3-pass f32 form is exact
+        return (
+            jnp.matmul(tj, onehot, precision=lax.Precision.HIGHEST)
+            .astype(jnp.int32)
+            .reshape((3, NLIMB) + w.shape)
+        )
+
     def body(j, accs):
         acc_h, acc_s = accs
         # [h](-A): MSB-first windows, 4 doublings + 1 cached add
@@ -343,21 +385,10 @@ def verify_kernel(a_words, r_words, s_windows, h_digits, s_canonical):
             acc_h = pt_double(acc_h)
         d = lax.dynamic_index_in_dim(hd, NWINDOWS - 1 - j, axis=0, keepdims=False)
         acc_h = pt_add_cached(acc_h, _select_cached(htbl, d))
-        # [S]B: comb window j, one MXU one-hot matmul + mixed add
+        # [S]B: comb window j, one-hot table select + mixed add
         tj = lax.dynamic_index_in_dim(comb, j, axis=0, keepdims=False)  # [60, 16]
         w = lax.dynamic_index_in_dim(sw, j, axis=0, keepdims=False)  # [B]
-        onehot = (w[None, :] == jnp.arange(16, dtype=w.dtype)[:, None]).astype(
-            jnp.float32
-        )  # [16, B]
-        # HIGHEST precision: default-precision TPU matmuls truncate f32
-        # operands to bf16 (8-bit mantissa) in the MXU, which corrupts
-        # 13-bit limbs; full-precision f32 is exact for these magnitudes
-        entry = (
-            jnp.matmul(tj, onehot, precision=lax.Precision.HIGHEST)
-            .astype(jnp.int32)
-            .reshape((3, NLIMB) + w.shape)
-        )  # [3, 20, B]
-        acc_s = pt_add_mixed(acc_s, entry)
+        acc_s = pt_add_mixed(acc_s, comb_entry(tj, w))
         return acc_h, acc_s
 
     if _UNROLL > 1:

@@ -36,8 +36,13 @@ class STI(IntEnum):
     VALIDATION = 10003
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, eq=False)
 class SField:
+    """eq=False: fields are registry singletons, so identity equality /
+    hashing is correct and keeps the per-field dict operations on the
+    hot (de)serialization paths at object-id speed (the generated
+    frozen-dataclass __hash__ tuples all four members per lookup)."""
+
     name: str
     type_id: STI
     value: int

@@ -192,10 +192,15 @@ def _copy_value(v: Any) -> Any:
 class STObject:
     """Ordered-by-canon field map."""
 
-    __slots__ = ("_fields",)
+    __slots__ = ("_fields", "_version")
 
     def __init__(self, fields: dict[SField, Any] | None = None):
         self._fields: dict[SField, Any] = dict(fields or {})
+        # bumped on every mutation so holders (SerializedTransaction)
+        # can memoize serializations/hashes safely — the reference
+        # recomputes getTransactionID per call and its own comment says
+        # "perhaps we should cache this" (SerializedTransaction.cpp:169)
+        self._version = 0
 
     # -- mapping interface -------------------------------------------------
 
@@ -207,14 +212,17 @@ class STObject:
 
     def __setitem__(self, f: SField, v: Any) -> None:
         self._fields[f] = v
+        self._version += 1
 
     def __delitem__(self, f: SField) -> None:
         del self._fields[f]
+        self._version += 1
 
     def get(self, f: SField, default: Any = None) -> Any:
         return self._fields.get(f, default)
 
     def pop(self, f: SField, default: Any = None) -> Any:
+        self._version += 1
         return self._fields.pop(f, default)
 
     def fields(self) -> Iterator[tuple[SField, Any]]:

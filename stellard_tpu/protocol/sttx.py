@@ -39,6 +39,11 @@ class SerializedTransaction:
         # memoized signature verdict (reference: mSigGood/mSigBad flags,
         # SerializedTransaction.h — the HashRouter SF_SIGGOOD seam)
         self._sig_good: Optional[bool] = None
+        # (version, value) memos — txid/blob are recomputed several
+        # times per tx along the submit->open-apply->close-apply path;
+        # STObject._version keeps the cache safe across mutations
+        self._blob_memo: Optional[tuple[int, bytes]] = None
+        self._txid_memo: Optional[tuple[int, bytes]] = None
 
     # -- construction -----------------------------------------------------
 
@@ -95,7 +100,12 @@ class SerializedTransaction:
     # -- hashing / signing ------------------------------------------------
 
     def serialize(self) -> bytes:
-        return self.obj.serialize()
+        memo = self._blob_memo
+        if memo is not None and memo[0] == self.obj._version:
+            return memo[1]
+        blob = self.obj.serialize()
+        self._blob_memo = (self.obj._version, blob)
+        return blob
 
     def signing_hash(self) -> bytes:
         """HP_TX_SIGN prefix hash over the signature-less serialization
@@ -105,8 +115,14 @@ class SerializedTransaction:
 
     def txid(self) -> bytes:
         """HP_TXN_ID over the full (signed) blob
-        (reference: getTransactionID)."""
-        return prefix_hash(HP_TXN_ID, self.serialize())
+        (reference: getTransactionID — memoized here, versioned against
+        object mutation)."""
+        memo = self._txid_memo
+        if memo is not None and memo[0] == self.obj._version:
+            return memo[1]
+        h = prefix_hash(HP_TXN_ID, self.serialize())
+        self._txid_memo = (self.obj._version, h)
+        return h
 
     def sign(self, key: KeyPair) -> None:
         """reference: SerializedTransaction::sign (:185-190)"""

@@ -170,7 +170,7 @@ class SubscriptionManager:
                 self._safe_send(sub, msg)
         # accepted transactions (reference: pubAcceptedTransaction)
         for txid, blob, meta in ledger.tx_entries():
-            tx = SerializedTransaction.from_bytes(blob)
+            tx = ledger.parse_tx(txid, blob)
             ter = results.get(txid, TER.tesSUCCESS)
             self._pub_tx(tx, ter, ledger=ledger, validated=True, meta=meta)
         # live path-find subscriptions re-search against the new state on

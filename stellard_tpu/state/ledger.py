@@ -111,6 +111,12 @@ class Ledger:
         self.reserve_base = DEFAULT_RESERVE_BASE
         self.reserve_increment = DEFAULT_RESERVE_INCREMENT
         self.load_factor = 256  # 256 = no load escalation (LoadFeeTrack)
+        # txid -> parsed SerializedTransaction memo: the close path
+        # parses each tx once and persist/publish reuse the object
+        # instead of re-parsing the blob per consumer (the reference
+        # passes SerializedTransaction::pointer around for the same
+        # reason). Seeded by close_and_advance; consulted via parse_tx.
+        self.parsed_txs: dict[bytes, object] = {}
 
     # -- genesis ----------------------------------------------------------
 
@@ -249,6 +255,16 @@ class Ledger:
                 p = BinaryParser(blob)
                 blob, meta = p.read_vl(), p.read_vl()
             yield leaf.item.tag, blob, meta
+
+    def parse_tx(self, txid: bytes, blob: bytes):
+        """Parsed-transaction memo over tx_entries blobs."""
+        tx = self.parsed_txs.get(txid)
+        if tx is None:
+            from ..protocol.sttx import SerializedTransaction
+
+            tx = SerializedTransaction.from_bytes(blob)
+            self.parsed_txs[txid] = tx
+        return tx
 
     def get_transaction(self, txid: bytes) -> Optional[tuple[bytes, bytes]]:
         """-> (tx_blob, metadata) or None. Open-ledger items (raw blob, no

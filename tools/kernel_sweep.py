@@ -13,13 +13,18 @@ sys.path.insert(0, REPO)
 
 CACHE = "/tmp/sigset.npz"
 
+SIGSET_N = 16384  # must cover 2x the largest swept batch for input cycling
+
+
 def ensure_sigset():
     if os.path.exists(CACHE):
-        return
+        if len(np.load(CACHE)["pubs"]) >= SIGSET_N:
+            return
+        os.remove(CACHE)  # stale smaller cache: would re-enable memoization
     from stellard_tpu.protocol.keys import KeyPair
     rng = np.random.default_rng(0)
     keys = [KeyPair.from_seed(bytes(rng.integers(0,256,32,dtype=np.uint8))) for _ in range(64)]
-    N = 8192
+    N = SIGSET_N
     msgs = [bytes(rng.integers(0,256,32,dtype=np.uint8)) for _ in range(N)]
     sigs = [keys[i%64].sign(msgs[i]) for i in range(N)]
     pubs = [keys[i%64].public for i in range(N)]
@@ -28,14 +33,17 @@ def ensure_sigset():
              msgs=np.frombuffer(b"".join(msgs), np.uint8).reshape(N,32),
              sigs=np.frombuffer(b"".join(sigs), np.uint8).reshape(N,64))
 
-def one_config(unroll, batches):
-    """Run one (unroll, batches) measurement in a SUBPROCESS so each
-    tunnel session is fresh and a wedge can't kill the sweep."""
+def one_config(unroll, batches, comb="mxu"):
+    """Run one (unroll, comb-select, batches) measurement in a
+    SUBPROCESS so each tunnel session is fresh and a wedge can't kill
+    the sweep. Inputs are cycled across distinct sets so no layer can
+    memoize identical submissions."""
     code = f'''
 import os, sys, time
 import numpy as np
 os.environ.pop("JAX_PLATFORMS", None)
 os.environ["STELLARD_VERIFY_UNROLL"] = "{unroll}"
+os.environ["STELLARD_COMB_SELECT"] = "{comb}"
 sys.path.insert(0, {REPO!r})
 import jax
 assert jax.devices()[0].platform != "cpu", "no tpu"
@@ -43,19 +51,24 @@ from stellard_tpu.utils.xlacache import enable_compilation_cache
 enable_compilation_cache()
 from stellard_tpu.ops.ed25519_jax import prepare_batch, verify_kernel
 z = np.load("{CACHE}")
+N = len(z["pubs"])
 for batch in {batches}:
-    pubs = [z["pubs"][i].tobytes() for i in range(batch)]
-    msgs = [z["msgs"][i].tobytes() for i in range(batch)]
-    sigs = [z["sigs"][i].tobytes() for i in range(batch)]
-    inp = prepare_batch(pubs, msgs, sigs)
-    t0=time.time(); out = verify_kernel(**inp); out.block_until_ready()
-    print(f"unroll={unroll} batch={{batch}} compile {{time.time()-t0:.0f}}s", flush=True)
+    sets = []
+    for s0 in range(0, min(4 * batch, N), batch):
+        if s0 + batch > N: break
+        sets.append(prepare_batch(
+            [z["pubs"][i].tobytes() for i in range(s0, s0 + batch)],
+            [z["msgs"][i].tobytes() for i in range(s0, s0 + batch)],
+            [z["sigs"][i].tobytes() for i in range(s0, s0 + batch)],
+        ))
+    t0=time.time(); out = verify_kernel(**sets[0]); out.block_until_ready()
+    print(f"unroll={unroll} comb={comb} batch={{batch}} compile {{time.time()-t0:.0f}}s", flush=True)
     assert np.asarray(out).all()
     t0=time.time(); n=0
     while time.time()-t0 < 5:
-        verify_kernel(**inp).block_until_ready(); n+=1
+        verify_kernel(**sets[n % len(sets)]).block_until_ready(); n+=1
     dt=(time.time()-t0)/n
-    print(f"RESULT unroll={unroll} batch={{batch}} lat={{dt*1000:.1f}}ms rate={{batch/dt:,.0f}} sigs/s", flush=True)
+    print(f"RESULT unroll={unroll} comb={comb} batch={{batch}} lat={{dt*1000:.1f}}ms rate={{batch/dt:,.0f}} sigs/s", flush=True)
 '''
     try:
         r = subprocess.run([sys.executable, "-c", code], capture_output=True,
@@ -111,7 +124,12 @@ for n_leaves in (1000, 5000):
 if __name__ == "__main__":
     ensure_sigset()
     one_config(1, [2048, 4096, 8192])
-    one_config(4, [4096])
+    one_config(2, [4096])
+    one_config(4, [4096, 8192])
     one_config(8, [4096])
+    # comb-select A/B at the best-liking shape
+    one_config(1, [4096], comb="mxu_split")
+    one_config(1, [4096], comb="vpu")
+    one_config(4, [4096], comb="vpu")
     tree_hash_bench()
     print("SWEEP DONE", flush=True)
