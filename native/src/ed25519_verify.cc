@@ -1,0 +1,481 @@
+// Batched Ed25519 signature verification on the host (threaded C++).
+//
+// Role: the reference's per-signature host verify path is libsodium's C
+// (StellarPublicKey::verifySignature,
+// /root/reference/src/ripple_data/crypto/StellarPublicKey.cpp:67-77); our
+// Python host path goes through OpenSSL one call at a time and pays
+// per-call interpreter + GIL costs that cap it near 8.5k sigs/s however
+// many threads run. This kernel verifies a whole batch in one ctypes
+// call: R' = [S]B + [h](-A), accept iff encode(R') == R_bytes, with
+// h = SHA512(R || A || M) mod l — the same cofactorless equation as the
+// Python oracle (stellard_tpu/ops/ed25519_ref.py) and the JAX kernel
+// (stellard_tpu/ops/ed25519_jax.py), written from the curve equations.
+//
+// Field arithmetic: radix-2^51 limbs with __int128 products (the natural
+// 64-bit-host layout; the JAX kernel's 13-bit×20 limbs are a TPU-lane
+// format, not a host format). Curve constants (d, sqrt(-1), the base
+// point) are DERIVED at init from first principles — d = -121665/121666,
+// By = 4/5 — so there are no hand-packed tables to get wrong.
+//
+// Scalar strategy: 4-bit unsigned Straus/Shamir interleaving. A static
+// 15-entry cached table of B (shared, built once) and a per-signature
+// 15-entry table of -A; 64 window steps of 4 doublings + up to 2 cached
+// additions. All point formulas are the complete unified a=-1 twisted
+// Edwards forms (add-2008-hwcd-3 / dbl-2008-hwcd), so identity and
+// doubling cases need no special-casing.
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+// exported by sha512.cc / ed25519_host.cc
+extern "C" void sha512_parts(const uint8_t* p1, size_t n1, const uint8_t* p2,
+                             size_t n2, const uint8_t* p3, size_t n3,
+                             uint8_t* out, size_t out_len);
+extern "C" void sc_reduce_batch(const char* h, uint8_t* out, uint64_t n);
+
+namespace {
+
+typedef unsigned __int128 u128;
+typedef uint64_t u64;
+
+constexpr u64 MASK51 = (1ULL << 51) - 1;
+
+struct Fe {
+  u64 v[5];  // radix-2^51, little-endian limbs, loosely reduced
+};
+
+const Fe FE_ZERO = {{0, 0, 0, 0, 0}};
+const Fe FE_ONE = {{1, 0, 0, 0, 0}};
+
+inline Fe fe_add(const Fe& a, const Fe& b) {
+  Fe r;
+  for (int i = 0; i < 5; i++) r.v[i] = a.v[i] + b.v[i];
+  return r;
+}
+
+// a - b + 2p (keeps limbs non-negative; inputs must be carry-reduced)
+inline Fe fe_sub(const Fe& a, const Fe& b) {
+  static const u64 TWO_P[5] = {
+      2 * ((1ULL << 51) - 19), 2 * MASK51, 2 * MASK51, 2 * MASK51,
+      2 * MASK51};
+  Fe r;
+  for (int i = 0; i < 5; i++) r.v[i] = a.v[i] + TWO_P[i] - b.v[i];
+  return r;
+}
+
+// one carry pass: brings limbs to ~51 bits (top folds ×19 into limb 0)
+inline Fe fe_carry(const Fe& a) {
+  Fe r = a;
+  u64 c;
+  c = r.v[0] >> 51; r.v[0] &= MASK51; r.v[1] += c;
+  c = r.v[1] >> 51; r.v[1] &= MASK51; r.v[2] += c;
+  c = r.v[2] >> 51; r.v[2] &= MASK51; r.v[3] += c;
+  c = r.v[3] >> 51; r.v[3] &= MASK51; r.v[4] += c;
+  c = r.v[4] >> 51; r.v[4] &= MASK51; r.v[0] += c * 19;
+  c = r.v[0] >> 51; r.v[0] &= MASK51; r.v[1] += c;
+  return r;
+}
+
+inline Fe fe_mul(const Fe& a, const Fe& b) {
+  const u64 a0 = a.v[0], a1 = a.v[1], a2 = a.v[2], a3 = a.v[3], a4 = a.v[4];
+  const u64 b0 = b.v[0], b1 = b.v[1], b2 = b.v[2], b3 = b.v[3], b4 = b.v[4];
+  const u64 b1_19 = b1 * 19, b2_19 = b2 * 19, b3_19 = b3 * 19,
+            b4_19 = b4 * 19;
+  u128 r0 = (u128)a0 * b0 + (u128)a1 * b4_19 + (u128)a2 * b3_19 +
+            (u128)a3 * b2_19 + (u128)a4 * b1_19;
+  u128 r1 = (u128)a0 * b1 + (u128)a1 * b0 + (u128)a2 * b4_19 +
+            (u128)a3 * b3_19 + (u128)a4 * b2_19;
+  u128 r2 = (u128)a0 * b2 + (u128)a1 * b1 + (u128)a2 * b0 +
+            (u128)a3 * b4_19 + (u128)a4 * b3_19;
+  u128 r3 = (u128)a0 * b3 + (u128)a1 * b2 + (u128)a2 * b1 +
+            (u128)a3 * b0 + (u128)a4 * b4_19;
+  u128 r4 = (u128)a0 * b4 + (u128)a1 * b3 + (u128)a2 * b2 +
+            (u128)a3 * b1 + (u128)a4 * b0;
+  Fe out;
+  u64 c;
+  out.v[0] = (u64)r0 & MASK51; c = (u64)(r0 >> 51); r1 += c;
+  out.v[1] = (u64)r1 & MASK51; c = (u64)(r1 >> 51); r2 += c;
+  out.v[2] = (u64)r2 & MASK51; c = (u64)(r2 >> 51); r3 += c;
+  out.v[3] = (u64)r3 & MASK51; c = (u64)(r3 >> 51); r4 += c;
+  out.v[4] = (u64)r4 & MASK51; c = (u64)(r4 >> 51);
+  out.v[0] += c * 19;
+  c = out.v[0] >> 51; out.v[0] &= MASK51; out.v[1] += c;
+  return out;
+}
+
+inline Fe fe_sq(const Fe& a) { return fe_mul(a, a); }
+
+// full reduction to the canonical representative in [0, p)
+Fe fe_freeze(const Fe& a) {
+  Fe r = fe_carry(fe_carry(a));
+  // subtract p if r >= p: add 19 and check overflow past 2^255
+  u64 t[5];
+  t[0] = r.v[0] + 19;
+  u64 c = t[0] >> 51; t[0] &= MASK51;
+  for (int i = 1; i < 5; i++) {
+    t[i] = r.v[i] + c;
+    c = t[i] >> 51;
+    t[i] &= MASK51;
+  }
+  // c is 1 iff r + 19 >= 2^255, i.e. r >= p
+  u64 use_t = (u64)0 - c;  // all-ones if r >= p
+  for (int i = 0; i < 5; i++) r.v[i] = (t[i] & use_t) | (r.v[i] & ~use_t);
+  return r;
+}
+
+void fe_tobytes(const Fe& a, uint8_t out[32]) {
+  Fe f = fe_freeze(a);
+  memset(out, 0, 32);
+  for (int i = 0; i < 5; i++) {
+    u64 v = f.v[i];
+    for (int bit = 0; bit < 51; bit++) {
+      int pos = i * 51 + bit;
+      if (pos >= 256) break;
+      out[pos / 8] |= (uint8_t)(((v >> bit) & 1) << (pos % 8));
+    }
+  }
+}
+
+// bytes (LE, high bit masked off by caller) -> limbs
+Fe fe_frombytes(const uint8_t in[32]) {
+  Fe r = FE_ZERO;
+  for (int i = 0; i < 255; i++) {
+    if ((in[i / 8] >> (i % 8)) & 1) r.v[i / 51] |= 1ULL << (i % 51);
+  }
+  return r;
+}
+
+// generic square-and-multiply, MSB-first over 255 bits of a LE exponent
+Fe fe_pow(const Fe& base, const uint8_t exp_le[32]) {
+  Fe r = FE_ONE;
+  bool started = false;
+  for (int i = 254; i >= 0; i--) {
+    if (started) r = fe_sq(r);
+    if ((exp_le[i / 8] >> (i % 8)) & 1) {
+      r = started ? fe_mul(r, base) : base;
+      started = true;
+    }
+  }
+  return r;
+}
+
+bool fe_is_zero(const Fe& a) {
+  uint8_t b[32];
+  fe_tobytes(a, b);
+  uint8_t acc = 0;
+  for (int i = 0; i < 32; i++) acc |= b[i];
+  return acc == 0;
+}
+
+bool fe_eq(const Fe& a, const Fe& b) { return fe_is_zero(fe_sub(a, b)); }
+
+inline Fe fe_neg(const Fe& a) { return fe_sub(FE_ZERO, a); }
+
+inline int fe_parity(const Fe& a) {
+  uint8_t b[32];
+  fe_tobytes(a, b);
+  return b[0] & 1;
+}
+
+// --------------------------------------------------------------------------
+// curve constants, derived at init
+
+struct Consts {
+  Fe d;        // -121665/121666
+  Fe d2;       // 2d
+  Fe sqrt_m1;  // 2^((p-1)/4)
+  uint8_t p_le[32];         // p, little-endian bytes
+  uint8_t pm2_le[32];       // p - 2   (invert exponent)
+  uint8_t pm5_8_le[32];     // (p-5)/8 (sqrt-candidate exponent)
+  uint8_t l_le[32];         // group order l (canonical-S bound)
+};
+
+// subtract a small value from a LE byte string in place
+void bytes_sub_small(uint8_t* b, int len, unsigned v) {
+  unsigned borrow = v;
+  for (int i = 0; i < len && borrow; i++) {
+    unsigned cur = b[i];
+    b[i] = (uint8_t)(cur - (borrow & 0xFF));
+    borrow = (cur < (borrow & 0xFF)) ? 1 + (borrow >> 8) : (borrow >> 8);
+  }
+}
+
+Fe fe_invert(const Fe& a, const Consts& c) { return fe_pow(a, c.pm2_le); }
+
+Fe fe_from_u64(u64 x) {
+  Fe r = FE_ZERO;
+  r.v[0] = x & MASK51;
+  r.v[1] = x >> 51;
+  return r;
+}
+
+Consts make_consts() {
+  Consts c;
+  // p = 2^255 - 19, LE
+  memset(c.p_le, 0xFF, 32);
+  c.p_le[31] = 0x7F;
+  c.p_le[0] = 0xED;
+  memcpy(c.pm2_le, c.p_le, 32);
+  bytes_sub_small(c.pm2_le, 32, 2);
+  // (p-5)/8 = 2^252 - 3
+  memset(c.pm5_8_le, 0xFF, 32);
+  c.pm5_8_le[31] = 0x0F;
+  c.pm5_8_le[0] = 0xFD;
+  // l = 2^252 + 27742317777372353535851937790883648493 (RFC 8032), from
+  // the same LE byte form ed25519_host.cc derives its fold constants from
+  static const uint8_t L_LE[32] = {
+      0xED, 0xD3, 0xF5, 0x5C, 0x1A, 0x63, 0x12, 0x58,
+      0xD6, 0x9C, 0xF7, 0xA2, 0xDE, 0xF9, 0xDE, 0x14,
+      0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00,
+      0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x10};
+  memcpy(c.l_le, L_LE, 32);
+  // d = -121665 / 121666
+  c.d = fe_mul(fe_neg(fe_from_u64(121665)),
+               fe_pow(fe_from_u64(121666), c.pm2_le));
+  c.d2 = fe_carry(fe_add(c.d, c.d));
+  // sqrt(-1) = 2^((p-1)/4); (p-1)/4 = 2^253 - 5
+  uint8_t e[32];
+  memset(e, 0xFF, 32);
+  e[31] = 0x1F;
+  e[0] = 0xFB;
+  c.sqrt_m1 = fe_pow(fe_from_u64(2), e);
+  return c;
+}
+
+const Consts& consts() {
+  static const Consts c = make_consts();
+  return c;
+}
+
+// --------------------------------------------------------------------------
+// points
+
+struct Ge {
+  Fe X, Y, Z, T;  // extended: x = X/Z, y = Y/Z, T = XY/Z
+};
+
+struct GeCached {
+  Fe ypx, ymx, t2d, z2;  // Y+X, Y-X, 2dT, 2Z
+};
+
+const Ge GE_IDENTITY = {FE_ZERO, FE_ONE, FE_ONE, FE_ZERO};
+
+GeCached ge_to_cached(const Ge& p) {
+  GeCached r;
+  r.ypx = fe_carry(fe_add(p.Y, p.X));
+  r.ymx = fe_carry(fe_sub(p.Y, p.X));
+  r.t2d = fe_mul(p.T, consts().d2);
+  r.z2 = fe_carry(fe_add(p.Z, p.Z));
+  return r;
+}
+
+// complete unified addition, q cached (add-2008-hwcd-3, a=-1): 8M
+Ge ge_add_cached(const Ge& p, const GeCached& q) {
+  Fe a = fe_mul(fe_carry(fe_sub(p.Y, p.X)), q.ymx);
+  Fe b = fe_mul(fe_carry(fe_add(p.Y, p.X)), q.ypx);
+  Fe cc = fe_mul(p.T, q.t2d);
+  Fe dd = fe_mul(p.Z, q.z2);
+  Fe e = fe_carry(fe_sub(b, a));
+  Fe f = fe_carry(fe_sub(dd, cc));
+  Fe g = fe_carry(fe_add(dd, cc));
+  Fe h = fe_carry(fe_add(b, a));
+  Ge r;
+  r.X = fe_mul(e, f);
+  r.Y = fe_mul(g, h);
+  r.Z = fe_mul(f, g);
+  r.T = fe_mul(e, h);
+  return r;
+}
+
+// dedicated doubling (dbl-2008-hwcd, a=-1): 4S + 4M
+Ge ge_double(const Ge& p) {
+  Fe a = fe_sq(p.X);
+  Fe b = fe_sq(p.Y);
+  Fe zz = fe_sq(p.Z);
+  Fe cc = fe_carry(fe_add(zz, zz));
+  Fe xy = fe_carry(fe_add(p.X, p.Y));
+  Fe e = fe_carry(fe_sub(fe_carry(fe_sub(fe_sq(xy), a)), b));
+  Fe g = fe_carry(fe_sub(b, a));         // G = aA + B = B - A
+  Fe f = fe_carry(fe_sub(g, cc));        // F = G - C
+  Fe h = fe_carry(fe_sub(fe_neg(a), b)); // H = aA - B = -A - B
+  Ge r;
+  r.X = fe_mul(e, f);
+  r.Y = fe_mul(g, h);
+  r.Z = fe_mul(f, g);
+  r.T = fe_mul(e, h);
+  return r;
+}
+
+// y-encoding (+ sign bit of x) of p, canonical
+void ge_encode(const Ge& p, uint8_t out[32]) {
+  Fe zi = fe_invert(p.Z, consts());
+  Fe x = fe_mul(p.X, zi);
+  Fe y = fe_mul(p.Y, zi);
+  fe_tobytes(y, out);
+  out[31] |= (uint8_t)(fe_parity(x) << 7);
+}
+
+// decode 32 bytes -> point; rejects non-canonical y (>= p) the way the
+// production host library (RFC 8032 decode) does, recovers x from the
+// curve equation, rejects non-residues and x=0-with-sign
+bool ge_decode(const uint8_t in[32], Ge* out) {
+  const Consts& c = consts();
+  // canonical check: y bytes (high bit masked) must be < p
+  uint8_t yb[32];
+  memcpy(yb, in, 32);
+  int sign = yb[31] >> 7;
+  yb[31] &= 0x7F;
+  bool lt = false;  // yb < p ?
+  for (int i = 31; i >= 0; i--) {
+    if (yb[i] < c.p_le[i]) { lt = true; break; }
+    if (yb[i] > c.p_le[i]) return false;
+  }
+  if (!lt) return false;  // y == p is non-canonical too
+  Fe y = fe_frombytes(yb);
+  Fe y2 = fe_sq(y);
+  Fe u = fe_carry(fe_sub(y2, FE_ONE));
+  Fe v = fe_carry(fe_add(fe_mul(y2, c.d), FE_ONE));
+  // candidate x = u v^3 (u v^7)^((p-5)/8)
+  Fe v3 = fe_mul(fe_sq(v), v);
+  Fe v7 = fe_mul(fe_sq(v3), v);
+  Fe x = fe_mul(fe_mul(u, v3), fe_pow(fe_mul(u, v7), c.pm5_8_le));
+  Fe vxx = fe_mul(v, fe_sq(x));
+  if (!fe_eq(vxx, u)) {
+    if (!fe_eq(vxx, fe_neg(u))) return false;  // non-residue: not a point
+    x = fe_mul(x, c.sqrt_m1);
+  }
+  if (fe_is_zero(x)) {
+    if (sign) return false;  // -0 is not encodable
+  } else if (fe_parity(x) != sign) {
+    x = fe_neg(x);
+  }
+  out->X = fe_carry(x);
+  out->Y = y;
+  out->Z = FE_ONE;
+  out->T = fe_mul(out->X, y);
+  return true;
+}
+
+// --------------------------------------------------------------------------
+// Straus 4-bit double-scalar multiplication
+
+// table[k] = (k+1) * p in cached form, k = 0..14
+void build_table(const Ge& p, GeCached table[15]) {
+  Ge multiples[15];
+  multiples[0] = p;
+  for (int k = 1; k < 15; k++)
+    multiples[k] = (k & 1) ? ge_double(multiples[k / 2])
+                           : ge_add_cached(multiples[k - 1],
+                                           ge_to_cached(p));
+  for (int k = 0; k < 15; k++) table[k] = ge_to_cached(multiples[k]);
+}
+
+const GeCached* base_table() {
+  static GeCached table[15];
+  static std::once_flag flag;
+  std::call_once(flag, [] {
+    const Consts& c = consts();
+    // By = 4/5, Bx = sqrt from the curve equation with even parity
+    Fe by = fe_mul(fe_from_u64(4), fe_invert(fe_from_u64(5), c));
+    uint8_t enc[32];
+    fe_tobytes(by, enc);  // sign bit 0 = even x
+    Ge b;
+    bool ok = ge_decode(enc, &b);
+    (void)ok;  // by construction 4/5 decodes
+    build_table(b, table);
+  });
+  return table;
+}
+
+inline int nibble(const uint8_t* le32, int i) {
+  return (le32[i >> 1] >> ((i & 1) << 2)) & 0xF;
+}
+
+// R' = [s]B + [h]negA  (s, h little-endian 32-byte scalars < l)
+Ge straus(const uint8_t s_le[32], const uint8_t h_le[32],
+          const GeCached nega_table[15]) {
+  const GeCached* btab = base_table();
+  Ge q = GE_IDENTITY;
+  for (int i = 63; i >= 0; i--) {
+    q = ge_double(ge_double(ge_double(ge_double(q))));
+    int ns = nibble(s_le, i);
+    if (ns) q = ge_add_cached(q, btab[ns - 1]);
+    int nh = nibble(h_le, i);
+    if (nh) q = ge_add_cached(q, nega_table[nh - 1]);
+  }
+  return q;
+}
+
+// s (LE 32 bytes) < l ?
+bool scalar_canonical(const uint8_t s_le[32]) {
+  const Consts& c = consts();
+  for (int i = 31; i >= 0; i--) {
+    if (s_le[i] < c.l_le[i]) return true;
+    if (s_le[i] > c.l_le[i]) return false;
+  }
+  return false;  // equal
+}
+
+// one full verification; msg is the (usually 32-byte) signing hash
+bool verify_one(const uint8_t pub[32], const uint8_t* msg, size_t msg_len,
+                const uint8_t sig[64]) {
+  if (!scalar_canonical(sig + 32)) return false;  // canonical-S rule
+  Ge a;
+  if (!ge_decode(pub, &a)) return false;
+  // h = SHA512(R || A || M) mod l
+  uint8_t digest[64], h[32];
+  sha512_parts(sig, 32, pub, 32, msg, msg_len, digest, 64);
+  sc_reduce_batch((const char*)digest, h, 1);
+  // negate A, build its window table
+  Ge nega;
+  nega.X = fe_neg(a.X);
+  nega.Y = a.Y;
+  nega.Z = a.Z;
+  nega.T = fe_neg(a.T);
+  GeCached nega_table[15];
+  build_table(nega, nega_table);
+  Ge rp = straus(sig + 32, h, nega_table);
+  uint8_t enc[32];
+  ge_encode(rp, enc);
+  return memcmp(enc, sig, 32) == 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// out[i] = 1 if signature i verifies. pubs: packed 32B; sigs: packed
+// 64B; msgs: packed with offsets[n+1] (same shape as ed25519_h_batch).
+void ed25519_verify_batch(const uint8_t* pubs, const uint8_t* msgs,
+                          const uint64_t* offsets, const uint8_t* sigs,
+                          uint8_t* out, uint64_t n) {
+  (void)base_table();  // build the shared table before threads fan out
+  auto work = [&](uint64_t lo, uint64_t hi) {
+    for (uint64_t i = lo; i < hi; i++) {
+      out[i] = verify_one(pubs + 32 * i, msgs + offsets[i],
+                          (size_t)(offsets[i + 1] - offsets[i]),
+                          sigs + 64 * i)
+                   ? 1
+                   : 0;
+    }
+  };
+  unsigned nt = std::thread::hardware_concurrency();
+  if (nt > 8) nt = 8;
+  if (nt < 2 || n < 16) {
+    work(0, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  uint64_t chunk = (n + nt - 1) / nt;
+  for (unsigned t = 0; t < nt; t++) {
+    uint64_t lo = t * chunk, hi = lo + chunk > n ? n : lo + chunk;
+    if (lo >= hi) break;
+    ts.emplace_back(work, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+}  // extern "C"

@@ -95,12 +95,23 @@ def main(argv: list[str] | None = None) -> int:
                 params[k] = v
             else:
                 params.setdefault("args", []).append(arg)
-        url = f"http://{cfg.rpc_ip}:{cfg.rpc_port or 5005}/"
+        scheme = "https" if cfg.rpc_secure else "http"
+        url = f"{scheme}://{cfg.rpc_ip}:{cfg.rpc_port or 5005}/"
         body = json.dumps({"method": method, "params": [params]}).encode()
         req = urllib.request.Request(
             url, data=body, headers={"Content-Type": "application/json"}
         )
-        with urllib.request.urlopen(req) as resp:
+        ssl_ctx = None
+        if cfg.rpc_secure:
+            # the server cert is a self-signed transport artifact
+            # (reference RPCCall over [rpc_secure] likewise skips
+            # verification for the loopback admin connection)
+            import ssl as _ssl
+
+            ssl_ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_CLIENT)
+            ssl_ctx.check_hostname = False
+            ssl_ctx.verify_mode = _ssl.CERT_NONE
+        with urllib.request.urlopen(req, context=ssl_ctx) as resp:
             print(json.dumps(json.load(resp), indent=2))
         return 0
 
@@ -125,9 +136,11 @@ def main(argv: list[str] | None = None) -> int:
     if cfg.websocket_port is None:
         cfg.websocket_port = 6006
     node = Node(cfg).setup().serve()
+    rpc_scheme = "https" if cfg.rpc_secure else "http"
+    ws_scheme = "wss" if cfg.websocket_secure else "ws"
     print(
-        f"stellard-tpu: rpc http://{cfg.rpc_ip}:{node.http_server.port} "
-        f"ws ws://{cfg.websocket_ip}:{node.ws_server.port} "
+        f"stellard-tpu: rpc {rpc_scheme}://{cfg.rpc_ip}:{node.http_server.port} "
+        f"ws {ws_scheme}://{cfg.websocket_ip}:{node.ws_server.port} "
         f"(standalone={cfg.standalone}, "
         f"signature_backend={cfg.signature_backend})",
         file=sys.stderr,

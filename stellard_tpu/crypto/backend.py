@@ -91,6 +91,7 @@ class CpuVerifier(BatchVerifier):
     reference: StellarPublicKey::verifySignature), threaded over the batch."""
 
     name = "cpu"
+    impl = "openssl"
 
     _shared_pool: ThreadPoolExecutor | None = None
 
@@ -113,6 +114,56 @@ class CpuVerifier(BatchVerifier):
         if self._pool is None or len(batch) < 64:
             return np.array([one(r) for r in batch], bool)
         return np.array(list(self._pool.map(one, batch)), bool)
+
+
+class NativeVerifier(BatchVerifier):
+    """Batched C++ verification (native/src/ed25519_verify.cc): the whole
+    batch crosses into native code in ONE call, so per-signature cost is
+    pure curve arithmetic — no per-call interpreter work and no GIL.
+    This is the closest analog of the reference's libsodium hot path
+    (StellarPublicKey::verifySignature) and the default host side of the
+    verify plane when the toolchain is present."""
+
+    name = "cpu"  # fills the host role; .impl says which implementation
+    impl = "native"
+
+    def __init__(self, **_):
+        from ..native import Ed25519NativeVerify
+
+        self._impl = Ed25519NativeVerify()
+
+    def verify_batch(self, batch: Sequence[VerifyRequest]) -> np.ndarray:
+        return self._impl.verify_batch(
+            [r.public for r in batch],
+            [r.signing_hash for r in batch],
+            [r.signature for r in batch],
+        )
+
+
+def _host_verifier_factory(**kwargs) -> BatchVerifier:
+    """The ``cpu`` backend resolves to the fastest available host
+    implementation: native C++ batch verify, else the per-signature
+    host-library path. ``STELLARD_HOST_VERIFY`` overrides: ``python`` /
+    ``openssl`` force the host-library path, ``native`` requires the
+    C++ kernel (raises if unbuildable), ``auto`` (default) prefers
+    native with graceful degradation. Unknown values are rejected — a
+    perf/debug toggle must not silently no-op."""
+    import os
+
+    choice = os.environ.get("STELLARD_HOST_VERIFY", "auto").lower()
+    if choice in ("python", "openssl"):
+        return CpuVerifier(**kwargs)
+    if choice == "native":
+        return NativeVerifier()
+    if choice not in ("auto", ""):
+        raise ValueError(
+            f"STELLARD_HOST_VERIFY={choice!r}: expected auto|native|"
+            "python|openssl"
+        )
+    try:
+        return NativeVerifier()
+    except Exception:  # noqa: BLE001 — toolchain-less box: degrade
+        return CpuVerifier(**kwargs)
 
 
 class CpuHasher(BatchHasher):
@@ -416,7 +467,9 @@ class TpuHasher(BatchHasher):
         return hashed_host + len(index_of)
 
 
-register_verifier("cpu", CpuVerifier)
+register_verifier("cpu", _host_verifier_factory)
+register_verifier("native", NativeVerifier)  # strict: raises if unbuildable
+register_verifier("openssl", CpuVerifier)  # always-available host library
 register_verifier("tpu", TpuVerifier)
 register_hasher("cpu", CpuHasher)
 register_hasher("tpu", TpuHasher)

@@ -16,7 +16,14 @@ import subprocess
 import threading
 from typing import Optional
 
-__all__ = ["load_native", "native_available", "Sha512Native", "CppLogLib"]
+__all__ = [
+    "load_native",
+    "native_available",
+    "Sha512Native",
+    "Ed25519HostPrep",
+    "Ed25519NativeVerify",
+    "CppLogLib",
+]
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libstellard_native.so")
@@ -97,6 +104,20 @@ def _bind(lib: ctypes.CDLL) -> None:
         lib.has_ed25519_prep = True
     except AttributeError:
         lib.has_ed25519_prep = False
+
+    try:
+        lib.ed25519_verify_batch.argtypes = [
+            ctypes.c_char_p,  # packed 32B public keys
+            ctypes.c_char_p,  # packed messages
+            ctypes.POINTER(ctypes.c_uint64),  # offsets[n+1]
+            ctypes.c_char_p,  # packed 64B signatures
+            u8p,  # out: n bytes, 1 = valid
+            ctypes.c_uint64,  # n
+        ]
+        lib.ed25519_verify_batch.restype = None
+        lib.has_ed25519_verify = True
+    except AttributeError:
+        lib.has_ed25519_verify = False
 
     lib.cpplog_open.argtypes = [ctypes.c_char_p]
     lib.cpplog_open.restype = ctypes.c_void_p
@@ -181,6 +202,56 @@ class Ed25519HostPrep:
             rs, pubs, packed, offsets,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n,
         )
+        return out
+
+
+class Ed25519NativeVerify:
+    """Batched full Ed25519 verification over the C++ kernel
+    (native/src/ed25519_verify.cc) — the libsodium role of the reference
+    (StellarPublicKey::verifySignature) without the per-call interpreter
+    and GIL costs of the one-at-a-time host library path."""
+
+    def __init__(self):
+        self.lib = load_native()
+        if self.lib is None:
+            raise RuntimeError("native library unavailable")
+        if not getattr(self.lib, "has_ed25519_verify", False):
+            raise RuntimeError("native library predates ed25519_verify_batch")
+
+    def verify_batch(self, publics, messages, signatures) -> "np.ndarray":
+        """publics/signatures: sequences of 32/64-byte strings; messages:
+        sequence of bytes. Returns a bool ndarray of per-item validity.
+        Malformed-length items are rejected (False) without touching the
+        C layer, mirroring keys.verify_signature's length gates."""
+        import numpy as np
+
+        n = len(publics)
+        if not (len(messages) == len(signatures) == n):
+            raise ValueError("verify_batch: ragged batch")
+        ok_shape = [
+            len(publics[i]) == 32 and len(signatures[i]) == 64
+            for i in range(n)
+        ]
+        idx = [i for i in range(n) if ok_shape[i]]
+        out = np.zeros(n, bool)
+        if not idx:
+            return out
+        offsets = (ctypes.c_uint64 * (len(idx) + 1))()
+        pos = 0
+        for j, i in enumerate(idx):
+            offsets[j] = pos
+            pos += len(messages[i])
+        offsets[len(idx)] = pos
+        raw = (ctypes.c_uint8 * len(idx))()
+        self.lib.ed25519_verify_batch(
+            b"".join(publics[i] for i in idx),
+            b"".join(messages[i] for i in idx),
+            offsets,
+            b"".join(signatures[i] for i in idx),
+            raw,
+            len(idx),
+        )
+        out[idx] = np.frombuffer(bytes(raw), np.uint8).astype(bool)
         return out
 
 

@@ -84,6 +84,16 @@ class Config:
     admin_ips: list[str] = field(default_factory=lambda: ["127.0.0.1", "::1"])
     websocket_ip: str = "127.0.0.1"
     websocket_port: Optional[int] = None  # None = disabled, 0 = ephemeral
+    # TLS on the API doors (reference [rpc_secure]/[websocket_secure],
+    # ConfigSections.h:85-86 + Config.cpp:475-492). Cert/key paths are
+    # optional: empty means auto-generate a self-signed transport cert in
+    # the state dir (same machinery as the peer links, overlay/peertls.py)
+    rpc_secure: int = 0
+    rpc_ssl_cert: str = ""  # [rpc_ssl_cert]
+    rpc_ssl_key: str = ""  # [rpc_ssl_key]
+    websocket_secure: int = 0
+    websocket_ssl_cert: str = ""  # [websocket_ssl_cert]
+    websocket_ssl_key: str = ""  # [websocket_ssl_key]
 
     # -- overlay ([peer_ip]/[peer_port]/[ips]) -----------------------------
     peer_ip: str = "127.0.0.1"
@@ -157,6 +167,16 @@ class Config:
             cfg.websocket_ip = one("websocket_ip")
         if one("websocket_port"):
             cfg.websocket_port = int(one("websocket_port"))
+        if one("rpc_secure"):
+            cfg.rpc_secure = int(one("rpc_secure"))
+        cfg.rpc_ssl_cert = one("rpc_ssl_cert", cfg.rpc_ssl_cert)
+        cfg.rpc_ssl_key = one("rpc_ssl_key", cfg.rpc_ssl_key)
+        if one("websocket_secure"):
+            cfg.websocket_secure = int(one("websocket_secure"))
+        cfg.websocket_ssl_cert = one(
+            "websocket_ssl_cert", cfg.websocket_ssl_cert
+        )
+        cfg.websocket_ssl_key = one("websocket_ssl_key", cfg.websocket_ssl_key)
         if one("peer_ip"):
             cfg.peer_ip = one("peer_ip")
         if one("peer_port"):

@@ -511,11 +511,35 @@ class Node:
         self.subs = SubscriptionManager(self.ops)
         # `server` stream: publish on load-factor movement (pubServer)
         self.fee_track.on_change.append(self.subs.pub_server_status)
+        door_state_dir: list[str] = []  # one shared auto-cert dir per serve
+
+        def _door_ssl(secure: int, cert: str, key: str):
+            # reference [rpc_secure]/[websocket_secure] (Config.cpp:475-492)
+            if not secure:
+                return None
+            from ..overlay.peertls import make_door_ssl_context
+
+            if not door_state_dir:
+                if self.config.database_path:
+                    door_state_dir.append(self.config.database_path + ".tls")
+                else:
+                    import tempfile
+
+                    d = tempfile.mkdtemp(prefix="stellard-tls-")
+                    door_state_dir.append(d)
+                    self._tmp_tls_dir = d  # removed on stop()
+            return make_door_ssl_context(cert, key, door_state_dir[0])
+
         if self.config.rpc_port is not None:
             from ..rpc.http_server import HttpRpcServer
 
             self.http_server = HttpRpcServer(
-                self, self.config.rpc_ip, self.config.rpc_port
+                self, self.config.rpc_ip, self.config.rpc_port,
+                ssl_context=_door_ssl(
+                    self.config.rpc_secure,
+                    self.config.rpc_ssl_cert,
+                    self.config.rpc_ssl_key,
+                ),
             ).start()
         if self.config.websocket_port is not None:
             from ..rpc.ws_server import WsRpcServer
@@ -523,6 +547,11 @@ class Node:
             self.ws_server = WsRpcServer(
                 self, self.config.websocket_ip, self.config.websocket_port,
                 subs=self.subs,
+                ssl_context=_door_ssl(
+                    self.config.websocket_secure,
+                    self.config.websocket_ssl_cert,
+                    self.config.websocket_ssl_key,
+                ),
             ).start()
         self._running.set()
         self.load_manager.start()
@@ -670,6 +699,11 @@ class Node:
             logging.getLogger("stellard").removeHandler(self._debug_log_handler)
             self._debug_log_handler.close()
             self._debug_log_handler = None
+        if getattr(self, "_tmp_tls_dir", None):
+            import shutil
+
+            shutil.rmtree(self._tmp_tls_dir, ignore_errors=True)
+            self._tmp_tls_dir = None
 
     # -- persistence on close (reference: pendSaveValidated + CLF commit) --
 

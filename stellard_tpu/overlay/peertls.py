@@ -32,7 +32,22 @@ import socket
 import ssl
 from typing import Optional
 
-__all__ = ["PeerTLS", "ensure_node_cert"]
+__all__ = ["PeerTLS", "ensure_node_cert", "make_door_ssl_context"]
+
+
+def make_door_ssl_context(
+    cert_path: str, key_path: str, state_dir: str
+) -> ssl.SSLContext:
+    """Server-side TLS context for the API doors (reference
+    [rpc_secure]/[websocket_secure], Config.cpp:475-492). Empty paths
+    auto-generate the node's self-signed transport cert — operators
+    terminating with a real cert point [rpc_ssl_cert]/[rpc_ssl_key] at
+    it, exactly the reference's config surface."""
+    if not (cert_path and key_path):
+        cert_path, key_path = ensure_node_cert(state_dir)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_path, key_path)
+    return ctx
 
 
 def ensure_node_cert(state_dir: str) -> tuple[str, str]:

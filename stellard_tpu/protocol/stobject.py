@@ -192,7 +192,7 @@ def _copy_value(v: Any) -> Any:
 class STObject:
     """Ordered-by-canon field map."""
 
-    __slots__ = ("_fields", "_version")
+    __slots__ = ("_fields", "_version", "_sorted_keys")
 
     def __init__(self, fields: dict[SField, Any] | None = None):
         self._fields: dict[SField, Any] = dict(fields or {})
@@ -201,6 +201,10 @@ class STObject:
         # recomputes getTransactionID per call and its own comment says
         # "perhaps we should cache this" (SerializedTransaction.cpp:169)
         self._version = 0
+        # (version, [keys in canonical order]) — every serialization
+        # sorts the field set; ledger entries are serialized many times
+        # between mutations
+        self._sorted_keys: tuple[int, list[SField]] | None = None
 
     # -- mapping interface -------------------------------------------------
 
@@ -226,7 +230,13 @@ class STObject:
         return self._fields.pop(f, default)
 
     def fields(self) -> Iterator[tuple[SField, Any]]:
-        return iter(sorted(self._fields.items(), key=lambda kv: sort_key(kv[0])))
+        memo = self._sorted_keys
+        if memo is None or memo[0] != self._version:
+            keys = sorted(self._fields, key=sort_key)
+            self._sorted_keys = memo = (self._version, keys)
+        fields = self._fields
+        # materialized so callers keep snapshot semantics under mutation
+        return iter([(k, fields[k]) for k in memo[1]])
 
     def copy(self) -> "STObject":
         """Copy that detaches container values (lists, nested objects,

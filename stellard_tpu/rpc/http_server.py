@@ -57,7 +57,9 @@ def _role_for_peer(node, writer) -> Role:
 class HttpRpcServer:
     """Minimal threaded asyncio HTTP/1.1 server for the RPC door."""
 
-    def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0,
+                 ssl_context=None):
+        self._ssl = ssl_context  # reference [rpc_secure] (RPCDoor SSL)
         self.node = node
         self.host = host
         self.port = port
@@ -125,7 +127,8 @@ class HttpRpcServer:
 
         async def boot():
             self._server = await asyncio.start_server(
-                self._handle, self.host, self.port, limit=_MAX_BODY
+                self._handle, self.host, self.port, limit=_MAX_BODY,
+                ssl=self._ssl,
             )
             self.port = self._server.sockets[0].getsockname()[1]
             self._started.set()

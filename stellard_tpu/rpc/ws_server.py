@@ -69,7 +69,9 @@ async def _read_frame(reader: asyncio.StreamReader) -> tuple[int, bytes, bool]:
 
 class WsRpcServer:
     def __init__(self, node, host: str = "127.0.0.1", port: int = 0,
-                 subs: Optional[SubscriptionManager] = None):
+                 subs: Optional[SubscriptionManager] = None,
+                 ssl_context=None):
+        self._ssl = ssl_context  # reference [websocket_secure] (WSDoor SSL)
         self.node = node
         self.host = host
         self.port = port
@@ -193,7 +195,8 @@ class WsRpcServer:
 
         async def boot():
             self._server = await asyncio.start_server(
-                self._handle, self.host, self.port, limit=_MAX_MSG
+                self._handle, self.host, self.port, limit=_MAX_MSG,
+                ssl=self._ssl,
             )
             self.port = self._server.sockets[0].getsockname()[1]
             self._started.set()

@@ -137,3 +137,108 @@ class TestCppLogBackend:
         got = db.get(key)
         assert got is not None and got[1] == blob
         db.close()
+
+
+class TestNativeEd25519Verify:
+    """Differential tests of the batched C++ verifier
+    (native/src/ed25519_verify.cc) against the host-library path
+    (keys.verify_signature -> OpenSSL), incl. the adversarial cases the
+    reference's canonical-S rule exists for
+    (RippleAddress.cpp:226-252)."""
+
+    def _keys_msgs_sigs(self, n=48, seed=11):
+        import numpy as np
+
+        from stellard_tpu.protocol.keys import KeyPair
+
+        rng = np.random.default_rng(seed)
+        keys = [
+            KeyPair.from_seed(bytes(rng.integers(0, 256, 32, dtype=np.uint8)))
+            for _ in range(8)
+        ]
+        msgs = [
+            bytes(rng.integers(0, 256, 32, dtype=np.uint8)) for _ in range(n)
+        ]
+        pubs = [keys[i % 8].public for i in range(n)]
+        sigs = [keys[i % 8].sign(msgs[i]) for i in range(n)]
+        return pubs, msgs, sigs
+
+    def test_differential_with_planted_failures(self):
+        import numpy as np
+
+        from stellard_tpu.native import Ed25519NativeVerify
+        from stellard_tpu.protocol.keys import ED25519_L, verify_signature
+
+        pubs, msgs, sigs = self._keys_msgs_sigs()
+        # corrupt R, corrupt S, corrupt A, wrong message
+        sigs[3] = sigs[3][:2] + bytes([sigs[3][2] ^ 1]) + sigs[3][3:]
+        sigs[5] = sigs[5][:40] + bytes([sigs[5][40] ^ 1]) + sigs[5][41:]
+        pubs[7] = pubs[7][:1] + bytes([pubs[7][1] ^ 0x10]) + pubs[7][2:]
+        msgs[9] = msgs[9][:-1] + bytes([msgs[9][-1] ^ 1])
+        # non-canonical S: s + l still satisfies the curve equation but
+        # must be rejected (signatureIsCanonical)
+        s_int = int.from_bytes(sigs[11][32:], "little")
+        if s_int + ED25519_L < (1 << 256):
+            sigs[11] = sigs[11][:32] + (s_int + ED25519_L).to_bytes(32, "little")
+        got = Ed25519NativeVerify().verify_batch(pubs, msgs, sigs)
+        want = np.array(
+            [verify_signature(p, m, s) for p, m, s in zip(pubs, msgs, sigs)]
+        )
+        assert np.array_equal(got, want)
+        assert not got[[3, 5, 7, 9, 11]].any()
+        assert got.sum() == len(pubs) - 5
+
+    def test_non_canonical_pubkey_encoding_rejected(self):
+        """A pubkey whose y-coordinate encoding is >= p must be rejected
+        (RFC 8032 decode), matching the host library."""
+        from stellard_tpu.native import Ed25519NativeVerify
+        from stellard_tpu.protocol.keys import verify_signature
+
+        pubs, msgs, sigs = self._keys_msgs_sigs(n=2)
+        # y = p (non-canonical encoding of 0) and y = 2^255 - 1
+        p_bytes = ((1 << 255) - 19).to_bytes(32, "little")
+        big = ((1 << 255) - 1).to_bytes(32, "little")
+        pubs = [p_bytes, big]
+        got = Ed25519NativeVerify().verify_batch(pubs, msgs, sigs)
+        assert not got.any()
+        assert not any(
+            verify_signature(p, m, s) for p, m, s in zip(pubs, msgs, sigs)
+        )
+
+    def test_variable_length_messages(self):
+        import numpy as np
+
+        from stellard_tpu.native import Ed25519NativeVerify
+        from stellard_tpu.protocol.keys import KeyPair
+
+        k = KeyPair.from_passphrase("varlen")
+        msgs = [b"", b"x", b"y" * 100, b"z" * 1000]
+        sigs = [k.sign_raw(m) if hasattr(k, "sign_raw") else None for m in msgs]
+        if sigs[0] is None:
+            # KeyPair.sign requires 32-byte hashes; sign via the raw
+            # primitive to cover non-32-byte message lengths
+            from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+                Ed25519PrivateKey,
+            )
+
+            priv = Ed25519PrivateKey.from_private_bytes(k.seed)
+            sigs = [priv.sign(m) for m in msgs]
+        got = Ed25519NativeVerify().verify_batch(
+            [k.public] * 4, msgs, sigs
+        )
+        assert np.array_equal(got, np.ones(4, bool))
+
+    def test_empty_batch(self):
+        from stellard_tpu.native import Ed25519NativeVerify
+
+        assert len(Ed25519NativeVerify().verify_batch([], [], [])) == 0
+
+    def test_backend_seam_prefers_native(self, monkeypatch):
+        monkeypatch.delenv("STELLARD_HOST_VERIFY", raising=False)
+        from stellard_tpu.crypto.backend import make_verifier
+
+        v = make_verifier("cpu")
+        assert v.name == "cpu"
+        assert v.impl == "native"
+        monkeypatch.setenv("STELLARD_HOST_VERIFY", "python")
+        assert make_verifier("cpu").impl == "openssl"

@@ -400,3 +400,52 @@ class TestServerStream:
             assert statuses[-1]["load_factor"] == 256
         finally:
             n.stop()
+
+
+class TestSigVerifyMemoization:
+    def test_each_tx_verified_exactly_once(self, monkeypatch):
+        """A tx verified at submit must NOT be host-re-verified at close
+        (reference: LedgerConsensus::applyTransaction skips checkSign
+        via SF_SIGGOOD, LedgerConsensus.cpp:2101-2106). Counts actual
+        ed25519 verifications across submit + close + persist/publish.
+        The host path is pinned to the python implementation so every
+        verification — plane batches (CpuVerifier) and synchronous
+        checkSign (sttx) — flows through the counted function."""
+        import stellard_tpu.protocol.keys as keys_mod
+        import stellard_tpu.protocol.sttx as sttx_mod
+
+        monkeypatch.setenv("STELLARD_HOST_VERIFY", "python")
+
+        calls = {"n": 0}
+        orig = keys_mod.verify_signature
+
+        def counting(*a, **kw):
+            calls["n"] += 1
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(keys_mod, "verify_signature", counting)
+        # sttx binds the name at import time (checkSign's memoized path)
+        monkeypatch.setattr(sttx_mod, "verify_signature", counting)
+
+        n = Node(Config()).setup()
+        try:
+            alice = KeyPair.from_passphrase("memo-alice")
+            n_tx = 8
+            master = n.master_keys
+            for i in range(n_tx):
+                ter, _ = n.submit(
+                    payment(master, i + 1, alice.account_id, 200 * XRP)
+                )
+                assert ter == TER.tesSUCCESS, ter
+            n.close_ledger()
+            n.close_ledger()  # second close: held/reapply paths
+        finally:
+            n.stop()
+        assert calls["n"] > 0, (
+            "counting hook never fired — the test is not observing the "
+            "host verify path"
+        )
+        assert calls["n"] <= n_tx, (
+            f"{calls['n']} host verifications for {n_tx} txs — "
+            "close-time re-verification leak"
+        )

@@ -339,3 +339,104 @@ class TestPathFindSubscription:
             assert closed["result"]["closed"] is True
         finally:
             ws.close()
+
+
+class TestSecureDoors:
+    """[rpc_secure]/[websocket_secure] — TLS-terminated API doors
+    (reference Config.cpp:475-492; WSDoor/RPCDoor SSL). The cert is the
+    node's auto-generated self-signed transport cert, so clients connect
+    with verification off, as the reference's own tooling does for
+    loopback admin."""
+
+    @pytest.fixture(scope="class")
+    def secure_node(self):
+        cfg = Config()
+        cfg.rpc_port = 0
+        cfg.websocket_port = 0
+        cfg.rpc_secure = 1
+        cfg.websocket_secure = 1
+        n = Node(cfg).setup().serve()
+        yield n
+        n.stop()
+
+    @staticmethod
+    def _client_ctx():
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+
+    def test_https_rpc(self, secure_node):
+        url = f"https://127.0.0.1:{secure_node.http_server.port}/"
+        body = json.dumps(
+            {"method": "server_info", "params": [{}]}
+        ).encode()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"}
+        )
+        with urllib.request.urlopen(
+            req, timeout=10, context=self._client_ctx()
+        ) as resp:
+            result = json.load(resp)["result"]
+        assert result["status"] == "success"
+        assert "info" in result
+
+    def test_plain_http_refused_on_secure_door(self, secure_node):
+        import urllib.error
+
+        url = f"http://127.0.0.1:{secure_node.http_server.port}/"
+        body = json.dumps(
+            {"method": "server_info", "params": [{}]}
+        ).encode()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"}
+        )
+        with pytest.raises(Exception):
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                json.load(resp)
+
+    def test_wss_command(self, secure_node):
+        import base64
+        import os
+        import socket
+        import ssl
+
+        raw = socket.create_connection(
+            ("127.0.0.1", secure_node.ws_server.port), timeout=10
+        )
+        s = self._client_ctx().wrap_socket(raw)
+        try:
+            key = base64.b64encode(os.urandom(16)).decode()
+            s.sendall(
+                (
+                    f"GET / HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+                    f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+                    f"Sec-WebSocket-Version: 13\r\n\r\n"
+                ).encode()
+            )
+            resp = b""
+            while b"\r\n\r\n" not in resp:
+                resp += s.recv(4096)
+            assert b"101" in resp.split(b"\r\n", 1)[0]
+            # one masked text frame: {"command": "ping", "id": 1}
+            payload = json.dumps({"command": "ping", "id": 1}).encode()
+            mask = os.urandom(4)
+            frame = bytes([0x81, 0x80 | len(payload)]) + mask + bytes(
+                b ^ mask[i % 4] for i, b in enumerate(payload)
+            )
+            s.sendall(frame)
+            hdr = s.recv(2)
+            assert hdr and (hdr[0] & 0x0F) == 1  # text frame back
+            ln = hdr[1] & 0x7F
+            if ln == 126:
+                ln = struct.unpack(">H", s.recv(2))[0]
+            data = b""
+            while len(data) < ln:
+                data += s.recv(ln - len(data))
+            msg = json.loads(data)
+            assert msg.get("id") == 1
+            assert msg.get("status") == "success"
+        finally:
+            s.close()
