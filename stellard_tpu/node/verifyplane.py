@@ -265,6 +265,11 @@ class VerifyPlane:
             }
         return {
             "backend": self.backend_name,
+            # which host implementation fills the cpu side (native C++
+            # batch kernel vs per-signature host library) — a silent
+            # toolchain degrade must be visible to operators (this dict
+            # is embedded in the get_counts / print RPC replies)
+            "host_impl": getattr(self.cpu, "impl", "?"),
             "batches": self.batches,
             "verified": self.verified,
             "device_batches": self.device_batches,

@@ -85,7 +85,10 @@ class LedgerEntrySet:
         e = self._entries.get(index)
         if e is not None:
             return None if e.action == Action.DELETED else e.sle
-        orig = self.ledger.read_entry(index)
+        # orig is the SHARED pristine parse (never mutated here: it is
+        # only compared/iterated for metadata deltas); the working copy
+        # detaches from it
+        orig = self.ledger.read_entry_pristine(index)
         if orig is None:
             return None
         work = orig.copy()
@@ -104,7 +107,7 @@ class LedgerEntrySet:
             e.sle = sle
             e.action = Action.MODIFIED
             return sle
-        if self.ledger.read_entry(index) is not None:
+        if self.ledger.read_entry_pristine(index) is not None:
             raise ValueError(f"entry {index.hex()[:16]} already in ledger")
         self._entries[index] = _Entry(sle, Action.CREATED, None)
         return sle

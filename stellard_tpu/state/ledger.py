@@ -178,13 +178,25 @@ class Ledger:
 
     # -- state entries (SLEs) --------------------------------------------
 
-    def read_entry(self, index: bytes) -> Optional[STObject]:
+    def read_entry_pristine(self, index: bytes) -> Optional[STObject]:
+        """Shared parsed entry (the reference's SLE cache role): one
+        parse per immutable SHAMapItem, shared across ledger versions
+        that alias the item. Callers MUST NOT mutate the result."""
         item = self.state_map.get(index)
         if item is None:
             return None
-        return STObject.from_bytes(item.data)
+        if item.parsed is None:
+            item.parsed = STObject.from_bytes(item.data)
+        return item.parsed
+
+    def read_entry(self, index: bytes) -> Optional[STObject]:
+        sle = self.read_entry_pristine(index)
+        return None if sle is None else sle.copy()
 
     def write_entry(self, index: bytes, sle: STObject) -> None:
+        # parsed stays None here: cold entries (written, never re-read)
+        # must not pay a deep copy or pin a parsed mirror; the first
+        # re-read lazily fills it (read_entry_pristine)
         self.state_map.set_item(SHAMapItem(index, sle.serialize()))
 
     def delete_entry(self, index: bytes) -> None:

@@ -70,14 +70,21 @@ _LEAF_PREFIX = {
 
 class SHAMapItem:
     """A keyed blob: 32-byte tag (index) + serialized payload
-    (reference: src/ripple_app/shamap/SHAMapItem.h)."""
+    (reference: src/ripple_app/shamap/SHAMapItem.h).
 
-    __slots__ = ("tag", "data")
+    ``parsed`` memoizes the deserialized STObject for this (immutable)
+    blob — writes always construct fresh items, so the pristine parse
+    can be shared across the persistent-map versions that alias the
+    item (the reference's SLE cache role); consumers must COPY before
+    mutating (Ledger.read_entry does)."""
+
+    __slots__ = ("tag", "data", "parsed")
 
     def __init__(self, tag: bytes, data: bytes):
         assert len(tag) == 32
         self.tag = tag
         self.data = data
+        self.parsed = None
 
     def __eq__(self, other):
         return (
