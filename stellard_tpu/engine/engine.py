@@ -48,6 +48,10 @@ class TransactionEngine:
         (TransactionEngine.cpp:94-253)."""
         from .transactor import make_transactor
 
+        # plain int from here down: IntFlag.__and__ builds a new enum
+        # member per test, which is measurable at flood rates; int &
+        # IntFlag stays on the C fast path
+        params = int(params)
         self.les = LedgerEntrySet(self.ledger)
 
         # pseudo-transactions (zero account, no fee/signature) only enter

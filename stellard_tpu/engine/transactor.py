@@ -60,7 +60,7 @@ class Transactor:
         from .engine import TxParams  # circular-safe
 
         self.tx = tx
-        self.params = params
+        self.params = int(params)  # keep flag tests on the int fast path
         self.engine = engine
         self.les = engine.les
         self.account_id: bytes = b""

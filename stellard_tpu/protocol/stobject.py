@@ -243,6 +243,11 @@ class STObject:
         arrays) so mutating the copy never aliases the original."""
         out = STObject()
         out._fields = {f: _copy_value(v) for f, v in self._fields.items()}
+        memo = self._sorted_keys
+        if memo is not None and memo[0] == self._version:
+            # the key list is never mutated in place (fields() replaces
+            # the tuple wholesale), so sharing it across copies is safe
+            out._sorted_keys = (0, memo[1])
         return out
 
     def __len__(self) -> int:
@@ -282,16 +287,31 @@ class STObject:
     @classmethod
     def deserialize(cls, p: BinaryParser, *, inner: bool = False) -> "STObject":
         obj = cls()
+        # canonical input (the overwhelmingly common case: our own
+        # serializer always writes sorted) seeds the sort memo so the
+        # next serialization skips the sort; non-canonical wire input
+        # falls back to sorting in fields()
+        in_order = True
+        prev_key = None
         while not p.empty():
             type_id, name = p.read_field_id()
             if inner and (type_id, name) == _OBJECT_END:
+                if in_order:
+                    obj._sorted_keys = (obj._version, list(obj._fields))
                 return obj
             f = field_by_code(type_id, name)
             if f is None:
                 raise ValueError(f"unknown field ({type_id}, {name})")
+            if in_order:
+                k = sort_key(f)
+                if prev_key is not None and k < prev_key:
+                    in_order = False
+                prev_key = k
             obj._fields[f] = _deserialize_value(p, f)
         if inner:
             raise ValueError("unterminated inner object")
+        if in_order:
+            obj._sorted_keys = (obj._version, list(obj._fields))
         return obj
 
     @classmethod

@@ -44,6 +44,7 @@ class SerializedTransaction:
         # STObject._version keeps the cache safe across mutations
         self._blob_memo: Optional[tuple[int, bytes]] = None
         self._txid_memo: Optional[tuple[int, bytes]] = None
+        self._tx_type_memo: Optional[tuple[int, TxType]] = None
 
     # -- construction -----------------------------------------------------
 
@@ -71,7 +72,15 @@ class SerializedTransaction:
 
     @property
     def tx_type(self) -> TxType:
-        return TxType(self.obj[sfTransactionType])
+        # enum construction is measurable at flood rates; version-guarded
+        # like _blob_memo/_txid_memo (the public obj is mutable)
+        memo = self._tx_type_memo
+        ver = self.obj._version
+        if memo is not None and memo[0] == ver:
+            return memo[1]
+        t = TxType(self.obj[sfTransactionType])
+        self._tx_type_memo = (ver, t)
+        return t
 
     @property
     def account(self) -> bytes:
