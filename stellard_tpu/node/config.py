@@ -67,6 +67,12 @@ class Config:
     # -- network identity / trust ([validation_seed], [validators]) --------
     validation_seed: str = ""  # base58 seed; empty = not a validator
     validators: list[str] = field(default_factory=list)  # node public keys
+    # same-operator cluster members ([cluster_nodes], ConfigSections.h:40):
+    # members relay each other's load-fee reports (mtCLUSTER) so the
+    # whole cluster escalates fees together. List the key each member
+    # proves in its peer hello — its VALIDATION public when it
+    # validates, its node identity public otherwise
+    cluster_nodes: list[str] = field(default_factory=list)
     validators_file: str = ""  # local validators.txt ([validators_file])
     validators_site: str = ""  # hosted stellar.txt URL ([validators_site])
     validation_quorum: int = 1  # reference Config.h:406 default sizing
@@ -142,7 +148,10 @@ class Config:
             cfg.verify_max_batch = int(sig["max_batch"])
         if "min_device_batch" in sig:
             cfg.verify_min_device_batch = int(sig["min_device_batch"])
-        cfg.hash_backend = one("hash_backend", cfg.hash_backend).lower()
+        hsh = _kv(s.get("hash_backend", []))
+        cfg.hash_backend = hsh.get(
+            "type", one("hash_backend", cfg.hash_backend)
+        ).lower()
 
         cfg.validation_seed = one("validation_seed", cfg.validation_seed)
         cfg.sntp_servers = [line.split()[0] for line in s.get("sntp_servers", [])]
@@ -152,6 +161,9 @@ class Config:
         cfg.validators = [
             line.split()[0] for line in s.get("validators", [])
         ]  # reference allows trailing comments per line
+        cfg.cluster_nodes = [
+            line.split()[0] for line in s.get("cluster_nodes", [])
+        ]
         if one("validation_quorum"):
             cfg.validation_quorum = int(one("validation_quorum"))
         if one("consensus_threshold"):

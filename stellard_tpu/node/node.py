@@ -255,6 +255,8 @@ class Node:
                     (_time.time() - _ANCHOR) * speed
                 )
                 timer_interval = max(0.1, 1.0 / speed)
+            from ..protocol.keys import decode_node_public
+
             unl_keys = self.unl.publics()
             signer = self.validation_keys or self.node_keys
             peer_tls = None
@@ -293,6 +295,12 @@ class Node:
                 router=self.hash_router,
                 job_dispatch=self._peer_job_dispatch,
                 peer_tls=peer_tls,
+                # matched against peer.node_public from the hello, i.e.
+                # the key the member HANDSHAKES with: its validation
+                # public when it validates, else its node identity
+                cluster={
+                    decode_node_public(v) for v in cfg.cluster_nodes
+                } or None,
             )
             # persistence rides a dedicated ORDERED worker, NOT the
             # consensus tick (the hook fires under the master lock and a

@@ -449,3 +449,47 @@ class TestSigVerifyMemoization:
             f"{calls['n']} host verifications for {n_tx} txs — "
             "close-time re-verification leak"
         )
+
+
+class TestClusterConfig:
+    def test_cluster_nodes_wire_into_overlay(self):
+        """[cluster_nodes] (reference ConfigSections.h:40) decodes into
+        the overlay's cluster set so mtCLUSTER load gossip flows."""
+        from stellard_tpu.node.config import Config as Cfg
+
+        member = KeyPair.from_passphrase("cluster-mate")
+        cfg = Cfg.from_ini(
+            f"""
+[standalone]
+0
+
+[node_db]
+type=memory
+
+[peer_port]
+0
+"""
+        )
+        assert cfg.cluster_nodes == []
+        cfg2 = Cfg.from_ini(
+            f"""
+[cluster_nodes]
+{member.human_node_public} mate-comment
+"""
+        )
+        assert cfg2.cluster_nodes == [member.human_node_public]
+
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        cfg2.standalone = False
+        cfg2.peer_port = port
+        cfg2.validation_seed = KeyPair.from_passphrase("cl-self").human_seed
+        n = Node(cfg2).setup()
+        try:
+            assert member.public in n.overlay.cluster
+        finally:
+            n.stop()
