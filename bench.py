@@ -17,6 +17,26 @@ import time
 
 import numpy as np
 
+# Apply the on-chip sweep's winning kernel configuration
+# (tools/kernel_sweep.py writes KERNEL_TUNING.json) BEFORE any kernel
+# module import reads the env. Explicit env settings win — the sweep
+# itself sets them per subprocess.
+_TUNING = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "KERNEL_TUNING.json")
+_TUNED_BATCH: str | None = None
+if os.path.exists(_TUNING):
+    try:
+        with open(_TUNING) as _f:
+            _t = json.load(_f)
+        # read every value BEFORE setting any env var: a partial tuning
+        # file must not apply a half-tuned (never-measured) combination
+        _unroll, _comb = str(int(_t["unroll"])), str(_t["comb"])
+        _TUNED_BATCH = str(int(_t["batch"]))
+        os.environ.setdefault("STELLARD_VERIFY_UNROLL", _unroll)
+        os.environ.setdefault("STELLARD_COMB_SELECT", _comb)
+    except (ValueError, KeyError, TypeError, OSError):
+        _TUNED_BATCH = None  # malformed tuning file: run with defaults
+
 
 def _emit(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
@@ -518,7 +538,7 @@ def main() -> None:
     )
     from stellard_tpu.protocol.keys import KeyPair
 
-    batch = int(os.environ.get("BENCH_BATCH", "4096"))
+    batch = int(os.environ.get("BENCH_BATCH", _TUNED_BATCH or "4096"))
     seconds = float(os.environ.get("BENCH_SECONDS", "10"))
 
     # BASELINE configs 1-5 (one JSON line each); the headline metric

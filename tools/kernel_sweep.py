@@ -15,6 +15,13 @@ CACHE = "/tmp/sigset.npz"
 
 SIGSET_N = 16384  # must cover 2x the largest swept batch for input cycling
 
+# measured (unroll, comb, batch, rate) rows; the winner is persisted to
+# KERNEL_TUNING.json so an unattended bench.py run (the driver's
+# end-of-round invocation) picks the tuned kernel without a human in
+# the loop
+RESULTS: list[dict] = []
+TUNING_PATH = os.path.join(REPO, "KERNEL_TUNING.json")
+
 
 def ensure_sigset():
     if os.path.exists(CACHE):
@@ -79,6 +86,20 @@ for batch in {batches}:
     out = "\n".join(l for l in (r.stdout + r.stderr).splitlines()
                     if "WARNING" not in l and l.strip())
     print(out, flush=True)
+    for line in out.splitlines():
+        # RESULT unroll=U comb=C batch=B lat=L rate=R sigs/s
+        if line.startswith("RESULT unroll="):
+            try:
+                kv = dict(p.split("=", 1) for p in line.split()[1:-1]
+                          if "=" in p)
+                RESULTS.append({
+                    "unroll": int(kv["unroll"]),
+                    "comb": kv["comb"],
+                    "batch": int(kv["batch"]),
+                    "rate": float(kv["rate"].replace(",", "")),
+                })
+            except (KeyError, ValueError):
+                pass
     return r.returncode == 0
 
 def tree_hash_bench():
@@ -121,6 +142,31 @@ for n_leaves in (1000, 5000):
     print("\n".join(l for l in (r.stdout+r.stderr).splitlines()
                     if "WARNING" not in l and l.strip()), flush=True)
 
+def write_tuning():
+    if not RESULTS:
+        return
+    import json
+
+    best = max(RESULTS, key=lambda r: r["rate"])
+    # temp + rename: an interrupted dump must never leave a truncated
+    # file for the driver's unattended bench.py to trip over. The file
+    # is committed with the round like the other bench artifacts — it
+    # documents the measured-best kernel config.
+    tmp = TUNING_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({
+            "unroll": best["unroll"],
+            "comb": best["comb"],
+            "batch": best["batch"],
+            "rate": best["rate"],
+            "all": RESULTS,
+        }, f, indent=1)
+    os.replace(tmp, TUNING_PATH)
+    print(f"TUNING -> {TUNING_PATH}: unroll={best['unroll']} "
+          f"comb={best['comb']} batch={best['batch']} "
+          f"rate={best['rate']:,.0f}", flush=True)
+
+
 if __name__ == "__main__":
     ensure_sigset()
     one_config(1, [2048, 4096, 8192])
@@ -131,5 +177,6 @@ if __name__ == "__main__":
     one_config(1, [4096], comb="mxu_split")
     one_config(1, [4096], comb="vpu")
     one_config(4, [4096], comb="vpu")
+    write_tuning()  # before the (slow) tree bench: a wedge must not lose it
     tree_hash_bench()
     print("SWEEP DONE", flush=True)
