@@ -12,6 +12,15 @@ LedgerEntrySet with an exact output target per hop; book hops consume
 real offers via the same taker loop OfferCreate uses (engine.offers.
 cross_offers), so a path payment and an offer crossing move money
 through identical code.
+
+Recorded design bound: trust-line QualityIn/QualityOut rates
+(calcNodeRipple's uQualityIn/uQualityOut scaling, RippleCalc.cpp:
+1253-1340) are stored and reported (TrustSet/account_lines) but NOT
+applied to path delivery — faithful quality math requires the
+reference's per-node redeem-vs-issue split (quality scales only the
+ISSUE portion, calcNodeAccountFwd:1996-2010), which this engine's
+single-amount-per-edge model deliberately folds together. Lines with
+default (unset) quality — the overwhelming norm — behave identically.
 """
 
 from __future__ import annotations

@@ -22,13 +22,23 @@ from ..protocol.keys import (
     encode_node_public,
     encode_seed,
 )
+from ..engine.flags import (
+    lsfHighAuth,
+    lsfHighNoRipple,
+    lsfLowAuth,
+    lsfLowNoRipple,
+)
 from ..protocol.sfields import (
     sfAccount,
     sfBalance,
     sfFlags,
     sfHighLimit,
+    sfHighQualityIn,
+    sfHighQualityOut,
     sfLedgerEntryType,
     sfLowLimit,
+    sfLowQualityIn,
+    sfLowQualityOut,
     sfOwnerCount,
     sfRegularKey,
     sfSequence,
@@ -590,17 +600,35 @@ def do_account_lines(ctx: Context) -> dict:
         bal = balance if is_low else -balance
         limit = low if is_low else high
         limit_peer = high if is_low else low
-        lines.append(
-            {
-                "account": encode_account_id(other),
-                "balance": bal.value_text(),
-                "currency": iso_from_currency(balance.currency),
-                "limit": limit.value_text(),
-                "limit_peer": limit_peer.value_text(),
-                "quality_in": 0,
-                "quality_out": 0,
-            }
-        )
+        row = {
+            "account": encode_account_id(other),
+            "balance": bal.value_text(),
+            "currency": iso_from_currency(balance.currency),
+            "limit": limit.value_text(),
+            "limit_peer": limit_peer.value_text(),
+        }
+        # optional fields match the reference's presence rules
+        # (AccountLines.cpp:102-112: only emitted when set)
+        q_in = sle.get(sfLowQualityIn if is_low else sfHighQualityIn, 0)
+        q_out = sle.get(sfLowQualityOut if is_low else sfHighQualityOut, 0)
+        if q_in:
+            row["quality_in"] = q_in
+        if q_out:
+            row["quality_out"] = q_out
+        flags = sle.get(sfFlags, 0)
+        my_auth = lsfLowAuth if is_low else lsfHighAuth
+        peer_auth = lsfHighAuth if is_low else lsfLowAuth
+        my_nr = lsfLowNoRipple if is_low else lsfHighNoRipple
+        peer_nr = lsfHighNoRipple if is_low else lsfLowNoRipple
+        if flags & my_auth:
+            row["authorized"] = True
+        if flags & peer_auth:
+            row["peer_authorized"] = True
+        if flags & my_nr:
+            row["no_ripple"] = True
+        if flags & peer_nr:
+            row["no_ripple_peer"] = True
+        lines.append(row)
     out = _ledger_ident(led)
     out["account"] = ctx.params["account"]
     out["lines"] = lines

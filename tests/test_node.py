@@ -315,6 +315,52 @@ class TestRpcHandlers:
         assert line["account"] == gw.human_account_id
         assert line["currency"] == "USD"
         assert line["limit"] == "100"
+        # optional fields follow the reference's presence rules
+        # (AccountLines.cpp:102-112): absent when unset
+        assert "quality_in" not in line and "no_ripple" not in line
+
+    def test_account_lines_quality_and_flags(self, node):
+        from stellard_tpu.engine.flags import tfSetNoRipple
+        from stellard_tpu.protocol.sfields import (
+            sfFlags as _sfFlags,
+            sfQualityIn,
+            sfQualityOut,
+        )
+
+        carol = KeyPair.from_passphrase("carol-q")
+        gw = KeyPair.from_passphrase("gateway-q")
+        fund(node, carol)
+        fund(node, gw)
+        node.close_ledger()
+        trust = SerializedTransaction.build(
+            TxType.ttTRUST_SET, carol.account_id, 1, 10,
+            {
+                sfLimitAmount: STAmount.from_iou(
+                    currency_from_iso("EUR"), gw.account_id, 500, 0
+                ),
+                sfQualityIn: 990_000_000,   # values incoming at 0.99
+                sfQualityOut: 1_010_000_000,
+                _sfFlags: tfSetNoRipple,
+            },
+        )
+        trust.sign(carol)
+        ter, _ = node.submit(trust)
+        assert ter == TER.tesSUCCESS, ter
+        node.close_ledger()
+        r = self.call(node, "account_lines", account=carol.human_account_id)
+        eur = [l for l in r["lines"] if l["currency"] == "EUR"]
+        assert len(eur) == 1
+        line = eur[0]
+        assert line["quality_in"] == 990_000_000
+        assert line["quality_out"] == 1_010_000_000
+        assert line.get("no_ripple") is True
+        assert "peer_authorized" not in line
+        # the PEER's view mirrors the same line with the roles flipped
+        r2 = self.call(node, "account_lines", account=gw.human_account_id)
+        eur2 = [l for l in r2["lines"] if l["currency"] == "EUR"]
+        assert len(eur2) == 1
+        assert eur2[0].get("no_ripple_peer") is True
+        assert "quality_in" not in eur2[0]
 
     def test_ledger_entry(self, node):
         r = self.call(
