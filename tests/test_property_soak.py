@@ -1,0 +1,165 @@
+"""Randomized property tests over the consensus-critical primitives —
+CI-sized versions of the build-time soaks (120k/20k/4k iterations ran
+clean 2026-07-30):
+
+- STAmount multiply/divide differential vs exact Fractions
+  (reference STAmount.cpp rounding: *“(m1*m2)/10^14 + 7”*,
+  *“(num*10^17)/den + 5”*), add within one canonical ulp;
+- STObject serialize→parse→serialize byte-stability over random field
+  sets (also exercises the canonical-order sort-memo seeding);
+- native C++ Ed25519 verifier agreement with the host library over
+  valid + adversarially mutated batches.
+"""
+
+from __future__ import annotations
+
+import random
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from stellard_tpu.protocol import sfields as sf
+from stellard_tpu.protocol.keys import KeyPair, verify_signature
+from stellard_tpu.protocol.stamount import STAmount, currency_from_iso
+from stellard_tpu.protocol.stobject import PathElement, STObject, STPathSet
+
+USD = currency_from_iso("USD")
+ISS = b"\x07" * 20
+
+
+def _frac(a: STAmount) -> Fraction:
+    f = Fraction(a.mantissa) * Fraction(10) ** a.offset
+    return -f if a.negative else f
+
+
+class TestSTAmountProperties:
+    def test_mul_div_add_vs_fractions(self):
+        rng = random.Random(20260730)
+
+        def rand_iou():
+            return STAmount(
+                USD, ISS,
+                rng.randint(10**15, 10**16 - 1),
+                rng.randint(-35, 15),
+                rng.random() < 0.5,
+            )
+
+        for _ in range(5000):
+            a, b = rand_iou(), rand_iou()
+            try:
+                p = STAmount.multiply(a, b, USD, ISS)
+            except ValueError:
+                continue
+            if not p.is_zero():
+                exact = _frac(a) * _frac(b)
+                assert abs(_frac(p) - exact) / abs(exact) < Fraction(1, 10**14)
+            q = STAmount.divide(a, b, USD, ISS)
+            exact = _frac(a) / _frac(b)
+            assert abs(_frac(q) - exact) / abs(exact) < Fraction(1, 10**14)
+            s = a + b
+            exact = _frac(a) + _frac(b)
+            if s.is_zero():
+                assert abs(exact) < Fraction(10) ** (max(a.offset, b.offset) + 2)
+            else:
+                assert abs(_frac(s) - exact) <= Fraction(10) ** (
+                    max(a.offset, b.offset) + 1
+                )
+
+
+class TestSTObjectRoundTrip:
+    INT_FIELDS = [sf.sfSequence, sf.sfFlags, sf.sfOfferSequence,
+                  sf.sfTransferRate, sf.sfQualityIn, sf.sfQualityOut,
+                  sf.sfSourceTag, sf.sfDestinationTag]
+    H256 = [sf.sfPreviousTxnID, sf.sfInvoiceID]
+    AMT = [sf.sfAmount, sf.sfLimitAmount, sf.sfTakerPays, sf.sfTakerGets,
+           sf.sfSendMax]
+    ACCT = [sf.sfAccount, sf.sfDestination, sf.sfRegularKey]
+    BLOB = [sf.sfSigningPubKey, sf.sfTxnSignature]
+
+    def test_serialize_parse_serialize_byte_stable(self):
+        rng = random.Random(42)
+
+        def rand_amount():
+            if rng.random() < 0.4:
+                return STAmount.from_drops(rng.randint(0, 10**15))
+            return STAmount(
+                USD, bytes([rng.randint(0, 255)]) * 20,
+                rng.randint(10**15, 10**16 - 1), rng.randint(-30, 10),
+                rng.random() < 0.5,
+            )
+
+        def rand_obj():
+            o = STObject()
+            for f in rng.sample(self.INT_FIELDS, rng.randint(0, 4)):
+                o[f] = rng.randint(0, 2**31)
+            for f in rng.sample(self.H256, rng.randint(0, 2)):
+                o[f] = bytes(rng.randint(0, 255) for _ in range(32))
+            for f in rng.sample(self.AMT, rng.randint(0, 3)):
+                o[f] = rand_amount()
+            for f in rng.sample(self.ACCT, rng.randint(0, 2)):
+                o[f] = bytes(rng.randint(0, 255) for _ in range(20))
+            for f in rng.sample(self.BLOB, rng.randint(0, 2)):
+                o[f] = bytes(
+                    rng.randint(0, 255) for _ in range(rng.randint(0, 80))
+                )
+            if rng.random() < 0.25:
+                pe = PathElement(
+                    account=bytes(rng.randint(0, 255) for _ in range(20))
+                )
+                o[sf.sfPaths] = STPathSet([[pe]])
+            return o
+
+        for i in range(1500):
+            o = rand_obj()
+            blob = o.serialize()
+            o2 = STObject.from_bytes(blob)
+            assert o2.serialize() == blob, i
+
+
+class TestEd25519Differential:
+    def test_native_matches_host_library_adversarial(self):
+        from stellard_tpu.native import native_available
+
+        if not native_available():
+            pytest.skip("native toolchain unavailable")
+        from stellard_tpu.native import Ed25519NativeVerify
+
+        rng = np.random.default_rng(99)
+        keys = [
+            KeyPair.from_seed(bytes(rng.integers(0, 256, 32, dtype=np.uint8)))
+            for _ in range(8)
+        ]
+        N = 256
+        msgs = [bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+                for _ in range(N)]
+        pubs = [keys[i % 8].public for i in range(N)]
+        sigs = [keys[i % 8].sign(msgs[i]) for i in range(N)]
+        for i in range(0, N, 2):
+            kind = i % 12
+            if kind == 0:
+                b = bytearray(sigs[i])
+                b[int(rng.integers(0, 32))] ^= 1 << int(rng.integers(0, 8))
+                sigs[i] = bytes(b)
+            elif kind == 2:
+                b = bytearray(sigs[i])
+                b[32 + int(rng.integers(0, 32))] ^= 1 << int(rng.integers(0, 8))
+                sigs[i] = bytes(b)
+            elif kind == 4:
+                b = bytearray(pubs[i])
+                b[int(rng.integers(0, 32))] ^= 1 << int(rng.integers(0, 8))
+                pubs[i] = bytes(b)
+            elif kind == 6:
+                b = bytearray(msgs[i])
+                b[int(rng.integers(0, 32))] ^= 1
+                msgs[i] = bytes(b)
+            elif kind == 8:
+                sigs[i] = bytes(rng.integers(0, 256, 64, dtype=np.uint8))
+            else:
+                pubs[i] = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+        got = Ed25519NativeVerify().verify_batch(pubs, msgs, sigs)
+        want = np.array(
+            [verify_signature(p, m, s) for p, m, s in zip(pubs, msgs, sigs)]
+        )
+        assert np.array_equal(got, want)
+        assert 0 < int(want.sum()) < N  # both classes exercised
