@@ -134,18 +134,32 @@ class TxDatabase:
         max_ledger: int = 1 << 62,
         limit: int = 200,
         forward: bool = True,
+        after: "tuple[int, int] | None" = None,
     ) -> list[dict]:
-        """reference: handlers/AccountTx.cpp SQL walk"""
+        """reference: handlers/AccountTx.cpp SQL walk. ``after`` is an
+        EXCLUSIVE (ledger_seq, txn_seq) resume point in walk order (the
+        marker/resumeToken role, AccountTx.cpp:91-93)."""
         order = "ASC" if forward else "DESC"
+        resume = ""
+        args: list = [account.hex(), min_ledger, max_ledger]
+        if after is not None:
+            al, at = int(after[0]), int(after[1])
+            cmp = ">" if forward else "<"
+            resume = (
+                f" AND (A.LedgerSeq {cmp} ? OR "
+                f"(A.LedgerSeq = ? AND A.TxnSeq {cmp} ?))"
+            )
+            args += [al, al, at]
+        args.append(limit)
         with self._lock:
             rows = self._conn.execute(
                 f"""SELECT T.TransID, T.TransType, T.FromAcct, T.FromSeq,
-                     T.LedgerSeq, T.Status, T.RawTxn, T.TxnMeta
+                     T.LedgerSeq, T.Status, T.RawTxn, T.TxnMeta, A.TxnSeq
                     FROM AccountTransactions A JOIN Transactions T
                       ON A.TransID = T.TransID
-                    WHERE A.Account = ? AND A.LedgerSeq BETWEEN ? AND ?
+                    WHERE A.Account = ? AND A.LedgerSeq BETWEEN ? AND ?{resume}
                     ORDER BY A.LedgerSeq {order}, A.TxnSeq {order} LIMIT ?""",
-                (account.hex(), min_ledger, max_ledger, limit),
+                args,
             ).fetchall()
         return [
             {
@@ -157,6 +171,7 @@ class TxDatabase:
                 "status": r[5],
                 "raw": r[6],
                 "meta": r[7],
+                "txn_seq": r[8],
             }
             for r in rows
         ]

@@ -676,26 +676,56 @@ def do_account_tx(ctx: Context) -> dict:
     if max_l < 0:
         max_l = 1 << 62
     forward = bool(p.get("forward", False))
-    limit = min(int(p.get("limit", 200)), 500)
+    binary = bool(p.get("binary", False))
+    limit = max(1, min(int(p.get("limit", 200)), 500))
+    after = None
+    marker = p.get("marker")
+    if marker is not None:
+        # a malformed marker must fail loudly, not restart from page one
+        # (a well-behaved pager would then loop forever over duplicates)
+        try:
+            after = (int(marker["ledger"]), int(marker["seq"]))
+        except (TypeError, KeyError, ValueError):
+            raise RPCError("invalidParams", "malformed marker")
+    # fetch one extra row: its presence means the walk was truncated and
+    # a resume marker must be returned (AccountTx.cpp resumeToken)
     rows = ctx.node.txdb.account_transactions(
-        account_id, min_l, max_l, limit, forward
+        account_id, min_l, max_l, limit + 1, forward, after=after
     )
+    more = len(rows) > limit
+    rows = rows[:limit]
     txs = []
     for r in rows:
-        tx = SerializedTransaction.from_bytes(r["raw"])
-        j = tx.obj.to_json()
-        j["hash"] = r["txid"].hex().upper()
-        j["ledger_index"] = r["ledger_seq"]
-        entry = {"tx": j, "validated": True}
-        if r["meta"]:
-            entry["meta"] = STObject.from_bytes(r["meta"]).to_json()
+        if binary:
+            entry = {
+                "tx_blob": r["raw"].hex().upper(),
+                "ledger_index": r["ledger_seq"],
+                "validated": True,
+            }
+            if r["meta"]:
+                entry["meta"] = r["meta"].hex().upper()
+        else:
+            tx = SerializedTransaction.from_bytes(r["raw"])
+            j = tx.obj.to_json()
+            j["hash"] = r["txid"].hex().upper()
+            j["ledger_index"] = r["ledger_seq"]
+            entry = {"tx": j, "validated": True}
+            if r["meta"]:
+                entry["meta"] = STObject.from_bytes(r["meta"]).to_json()
         txs.append(entry)
-    return {
+    out = {
         "account": p["account"],
         "ledger_index_min": min_l,
         "ledger_index_max": max_l if max_l < (1 << 62) else -1,
+        "limit": limit,
         "transactions": txs,
     }
+    if more and rows:
+        out["marker"] = {
+            "ledger": rows[-1]["ledger_seq"],
+            "seq": rows[-1]["txn_seq"],
+        }
+    return out
 
 
 # -- order books -----------------------------------------------------------
