@@ -193,3 +193,56 @@ class TestSimNetDeterminism:
             ]
 
         assert run() == run()
+
+
+class TestByzantine:
+    def test_equivocating_proposer_cannot_fork_honest_nodes(self):
+        """One validator SIGNS two conflicting proposals per round and
+        sends a different one to each half of the net (classic
+        equivocation). Honest quorum (3 incl. own validation) must keep
+        converging on ONE chain — validations, not proposals, decide
+        (reference: LedgerConsensus disputes + Validations quorum)."""
+        from stellard_tpu.consensus.proposal import LedgerProposal
+        from stellard_tpu.overlay.simnet import ProposeSet, frame
+
+        net = SimNet(4, quorum=3)
+        byz = net.validators[3]
+        real_propose = byz.propose
+
+        calls = {"n": 0}
+
+        def equivocate(proposal):
+            calls["n"] += 1
+            # half the peers get the real position...
+            net.send(3, 0, frame(ProposeSet.from_proposal(proposal)))
+            net.send(3, 1, frame(ProposeSet.from_proposal(proposal)))
+            # ...the other peer gets a SIGNED conflicting position
+            fake = LedgerProposal(
+                prev_ledger=proposal.prev_ledger,
+                propose_seq=proposal.propose_seq,
+                tx_set_hash=b"\xEE" * 32,  # set nobody can acquire
+                close_time=proposal.close_time,
+            )
+            fake.sign(byz.node.key)
+            net.send(3, 2, frame(ProposeSet.from_proposal(fake)))
+
+        byz.propose = equivocate
+        net.start()
+
+        alice = KeyPair.from_passphrase("byz-alice")
+        net.validators[0].submit_client_tx(
+            payment(MASTER, 1, alice.account_id, 1000 * XRP)
+        )
+        assert net.run_until(lambda: net.all_validated_at_least(4), 120), (
+            "net stalled under an equivocating proposer"
+        )
+        # one chain: at every commonly-validated seq there is one hash
+        top = min(net.validated_seqs())
+        assert len(net.validated_hashes_at(top)) == 1, (
+            f"fork under equivocation: {net.validated_hashes_at(top)}"
+        )
+        assert calls["n"] > 0, "equivocating proposer never proposed"
+        # and the client tx still committed
+        for v in net.validators:
+            led = v.node.lm.validated
+            assert led.account_root(alice.account_id) is not None
