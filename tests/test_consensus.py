@@ -209,6 +209,30 @@ class TestValidationsStore:
     def _store(self, trusted: set, now: list):
         return ValidationsStore(lambda pk: pk in trusted, lambda: now[0])
 
+    def test_equivocating_signer_single_vote_in_election(self):
+        """A signer that validates TWO different hashes for the same
+        round contributes one count to EACH hash bucket (per-hash store,
+        reference Validations.cpp addValidation) but only its LATEST
+        validation to the current-ledger election — equivocation cannot
+        double a node's electoral weight."""
+        k = kp(1)
+        now = [10_000]
+        store = self._store({k.public}, now)
+        v1 = STValidation.build(H(1), signing_time=now[0], ledger_seq=5)
+        v1.sign(k)
+        v2 = STValidation.build(H(2), signing_time=now[0] + 1, ledger_seq=5)
+        v2.sign(k)
+        assert store.add(v1)
+        assert store.add(v2)
+        assert store.trusted_count_for(H(1)) == 1
+        assert store.trusted_count_for(H(2)) == 1
+        weights = store.current_ledger_weights()
+        assert weights.get(H(2)) == 1
+        assert H(1) not in weights, "equivocator kept two current votes"
+        # re-sending the SAME validation never double-counts
+        store.add(v2)
+        assert store.trusted_count_for(H(2)) == 1
+
     def test_quorum_counts_trusted_only(self):
         keys = [kp(i) for i in range(4)]
         trusted = {k.public for k in keys[:3]}
