@@ -11,6 +11,7 @@ mirrors the reference.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 from ..crypto.backend import make_hasher
@@ -408,6 +409,7 @@ class Node:
 
         self.master_keys = KeyPair.from_passphrase(MASTER_PASSPHRASE)
         self._running = threading.Event()
+        self.started_at = time.monotonic()  # server_info uptime
         self._debug_log_handler = None
 
         # API doors (started by serve(); reference: WSDoors/RPCDoor

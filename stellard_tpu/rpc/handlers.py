@@ -262,11 +262,17 @@ def do_server_info(ctx: Context) -> dict:
             "reserve_base_str": str(lcl.reserve_base),
             "reserve_inc_str": str(lcl.reserve_increment),
         },
-        "pubkey_node": (
+        # node identity vs validator key, as the reference splits them
+        # (NetworkOPs.cpp:1721-1726): pubkey_node is the persisted
+        # LocalCredentials identity; pubkey_validator is "none" for
+        # non-validators
+        "pubkey_node": node.node_keys.human_node_public,
+        "pubkey_validator": (
             node.validation_keys.human_node_public
             if node.validation_keys
-            else ""
+            else "none"
         ),
+        "uptime": int(time.monotonic() - node.started_at),
     }
     return {"info": info}
 

@@ -210,6 +210,11 @@ class TestRpcHandlers:
         r = self.call(node, "server_info")
         assert r["info"]["server_state"] == "full"
         assert r["info"]["complete_ledgers"] == "1"
+        # identity split (reference NetworkOPs.cpp:1721-1726): node
+        # identity always present; validator key "none" when not set
+        assert r["info"]["pubkey_node"].startswith("n")
+        assert r["info"]["pubkey_validator"] == "none"
+        assert r["info"]["uptime"] >= 0
 
     def test_wallet_propose_roundtrip(self, node):
         r = self.call(node, "wallet_propose", passphrase="alice")
