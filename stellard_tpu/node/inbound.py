@@ -43,6 +43,10 @@ class InboundLedger:
         self.failed = False
         self.created_at = _time.monotonic()
         self.last_progress = self.created_at
+        # True when the LCL catch-up path requested this ledger; repair
+        # acquisitions (LedgerCleaner) must NEVER route through LCL
+        # adoption (on_complete), only through their own callbacks
+        self.for_lcl = False
 
     # -- progress ---------------------------------------------------------
 
@@ -72,6 +76,27 @@ class InboundLedger:
                     )
                 )
         return out
+
+    def resolve_local(self, fetch: Callable[[bytes], Optional[bytes]]) -> int:
+        """Fill missing nodes from a LOCAL (hash -> prefix-blob) source
+        before asking the network: near-tip ledgers share almost their
+        whole trees with ledgers we already hold, so catch-up only
+        fetches the delta over the wire (the reference gets this from
+        SHAMap's node cache + fetch packs). Returns nodes resolved."""
+        total = 0
+        for imap in (self.tx_map, self.state_map):
+            if imap is None:
+                continue
+            while not imap.is_complete():
+                found = []
+                for _nid, h in imap.missing_nodes(4096):
+                    blob = fetch(h)
+                    if blob is not None:
+                        found.append((h, blob))
+                if not found or imap.add_nodes(found) == 0:
+                    break
+                total += len(found)
+        return total
 
     # -- data intake ------------------------------------------------------
 
@@ -155,9 +180,13 @@ class InboundLedgers:
     (reference: InboundLedgers.cpp)."""
 
     def __init__(self, send: Callable[[GetLedger], None],
-                 hash_batch: Optional[Callable] = None):
+                 hash_batch: Optional[Callable] = None,
+                 local_fetch: Optional[Callable[[bytes], Optional[bytes]]] = None):
         self.send = send  # broadcast/anycast a GetLedger to peers
         self.hash_batch = hash_batch
+        # optional hash -> prefix-blob lookup into local storage so
+        # acquisitions only fetch the DELTA over the wire
+        self.local_fetch = local_fetch
         self.live: dict[bytes, InboundLedger] = {}
         self.on_complete: Optional[Callable[[Ledger], None]] = None
         # per-acquisition completion callbacks (repair path)
@@ -195,24 +224,81 @@ class InboundLedgers:
         return t is not None and _time.monotonic() - t < self.RECENT_TTL
 
     def acquire(
-        self, ledger_hash: bytes, callback: Optional[Callable] = None
+        self, ledger_hash: bytes, callback: Optional[Callable] = None,
+        for_lcl: bool = False,
     ) -> InboundLedger:
         """Start (or join) an acquisition. `callback(ledger)` fires for
-        THIS request on completion, in addition to the global
-        on_complete — repair acquisitions (LedgerCleaner) persist old
-        ledgers without routing through the LCL-adoption path."""
+        THIS request on completion; the global on_complete (the LCL
+        adoption hook) fires only for sessions marked ``for_lcl`` —
+        repair acquisitions (LedgerCleaner) persist old ledgers without
+        ever switching the live chain onto them."""
         il = self.live.get(ledger_hash)
         if callback is not None:
             self._callbacks.setdefault(ledger_hash, []).append(callback)
         if il is None:
             il = InboundLedger(ledger_hash, self.hash_batch)
+            il.for_lcl = for_lcl
             self.live[ledger_hash] = il
             self.trigger(il)
+        elif for_lcl:
+            il.for_lcl = True
         return il
 
+    def abandon(self, ledger_hash: bytes) -> None:
+        """Drop a live acquisition (retargeting): callers' slots are
+        released with a None result, late replies are absorbed by the
+        recently-done set."""
+        il = self.live.pop(ledger_hash, None)
+        if il is None:
+            return
+        self._mark_recent(ledger_hash)
+        for cb in self._callbacks.pop(ledger_hash, []):
+            cb(None)
+
     def trigger(self, il: InboundLedger) -> None:
+        if self.local_fetch is not None:
+            if il.header is None:
+                # the header lives in the same store under the ledger
+                # hash (HP_LEDGER_MASTER-prefixed); a ledger we already
+                # hold on disk must not need a peer at all
+                blob = self.local_fetch(il.hash)
+                if blob is not None:
+                    if (
+                        len(blob) >= 4
+                        and int.from_bytes(blob[:4], "big") == HP_LEDGER_MASTER
+                    ):
+                        blob = blob[4:]
+                    il.take_header(blob)
+            if il.header is not None and il.resolve_local(self.local_fetch):
+                import time as _time
+
+                il.last_progress = _time.monotonic()
+            if self._finish(il):
+                return
         for req in il.next_requests():
             self.send(req)
+
+    def _finish(self, il: InboundLedger) -> bool:
+        """Completion/failure bookkeeping; True when the session ended."""
+        if not il.is_complete():
+            return False
+        h = il.hash
+        try:
+            ledger = il.build_ledger()
+        except (ValueError, KeyError):
+            il.failed = True
+            del self.live[h]
+            self._mark_recent(h)
+            for cb in self._callbacks.pop(h, []):
+                cb(None)  # failure: callers release their slots
+            return True
+        del self.live[h]
+        self._mark_recent(h)
+        for cb in self._callbacks.pop(h, []):
+            cb(ledger)
+        if self.on_complete is not None and il.for_lcl:
+            self.on_complete(ledger)
+        return True
 
     def expire_stale(self, max_age_s: float = 120.0) -> int:
         """Drop acquisitions that made no progress for `max_age_s` —
@@ -255,23 +341,8 @@ class InboundLedgers:
             import time as _time
 
             il.last_progress = _time.monotonic()
-        if il.is_complete():
-            try:
-                ledger = il.build_ledger()
-            except (ValueError, KeyError):
-                il.failed = True
-                del self.live[msg.ledger_hash]
-                self._mark_recent(msg.ledger_hash)
-                for cb in self._callbacks.pop(msg.ledger_hash, []):
-                    cb(None)  # failure: callers release their slots
-                return progressed
-            del self.live[msg.ledger_hash]
-            self._mark_recent(msg.ledger_hash)
-            for cb in self._callbacks.pop(msg.ledger_hash, []):
-                cb(ledger)
-            if self.on_complete is not None:
-                self.on_complete(ledger)
-            return max(progressed, 1)
+        if self._finish(il):
+            return max(progressed, 1) if not il.failed else progressed
         if progressed:
             self.trigger(il)
         return progressed

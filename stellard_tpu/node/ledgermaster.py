@@ -83,6 +83,12 @@ class LedgerMaster:
         # optional loader for cache misses (Node wires the NodeStore in;
         # overlay validators are memory-resident and leave it unset)
         self.fetch_fallback: Optional[Callable[[bytes], Optional[Ledger]]] = None
+        # optional LIGHT resolver: ledger hash -> (seq, parent_hash)
+        # from the stored header alone (no tree loads) — used by the
+        # LCL-switch reindex walk
+        self.header_fetch: Optional[
+            Callable[[bytes], Optional[tuple[int, bytes]]]
+        ] = None
         # txns held for a future ledger (reference: mHeldTransactions)
         self.held: dict[tuple[bytes, int], SerializedTransaction] = {}
         self.min_validations = 0  # quorum for checkAccept
@@ -300,6 +306,61 @@ class LedgerMaster:
             ledger.accepted = True
             self._push_closed(ledger)
             self.current = ledger.open_successor()
+            self._reindex_chain(ledger)
+
+    def _reindex_chain(self, ledger: Ledger) -> None:
+        """Repoint the seq->hash index at the adopted chain's ancestry.
+        Closes we made ourselves before the switch are ORPHANS: leaving
+        them indexed would make get_ledger_by_seq (and the `ledger` RPC)
+        serve a ledger the network never validated at that index — the
+        mismatch the reference's LedgerHistory::handleMismatch repairs.
+        Repoints every resolvable ancestor; index entries between the
+        last VALIDATED seq and the deepest confirmed ancestor that
+        cannot be confirmed are DROPPED — after a switch they are
+        orphan-branch closes, and serving nothing (the caller falls
+        back to stored history, whose own divergence is LedgerCleaner
+        repair territory) beats serving a ledger the network never
+        validated. The tip itself was just indexed by _push_closed; the
+        walk starts at its parent. Ancestry resolves from the in-memory
+        cache or the LIGHT header fetch (seq + parent only) — never a
+        full two-tree Ledger.load under the master lock — and stops at
+        the validated floor, which no switch may rewrite."""
+        floor = self.validated.seq if self.validated is not None else 0
+
+        def resolve(h: bytes) -> Optional[tuple[int, bytes]]:
+            led = self.ledgers_by_hash.get(h)
+            if led is not None:
+                return led.seq, led.parent_hash
+            if self.header_fetch is not None:
+                return self.header_fetch(h)
+            return None
+
+        cur_hash = ledger.parent_hash
+        confirmed_down_to = ledger.seq
+        while True:
+            info = resolve(cur_hash)
+            if info is None:
+                break
+            seq, parent_hash = info
+            if seq <= floor:
+                break  # never rewrite the validated chain's entries
+            if self.ledger_history.get(seq) == cur_hash:
+                confirmed_down_to = seq
+                break
+            self.ledger_history[seq] = cur_hash
+            confirmed_down_to = seq
+            cur_hash = parent_hash
+        for seq in [
+            s for s in self.ledger_history if floor < s < confirmed_down_to
+        ]:
+            del self.ledger_history[seq]
+        # entries ABOVE the adopted tip are our own solo closes on an
+        # abandoned fork (backward adoption repairs a runaway node):
+        # the network never validated them
+        for seq in [s for s in self.ledger_history if s > ledger.seq]:
+            del self.ledger_history[seq]
+        while len(self.ledger_history) > 8192:
+            del self.ledger_history[min(self.ledger_history)]
 
     def set_validated(self, ledger: Ledger) -> None:
         """A quorum of trusted validations arrived for this ledger
@@ -308,6 +369,11 @@ class LedgerMaster:
             if self.validated is not None and ledger.seq <= self.validated.seq:
                 return
             self.validated = ledger
+            # a quorum-validated ledger is the strongest possible signal
+            # for its index slot: repair any orphan entry left by a fork
+            # healed without an LCL switch (LedgerHistory mismatch role)
+            self.ledger_history[ledger.seq] = ledger.hash()
+            self.ledgers_by_hash.put(ledger.hash(), ledger)
         if self.on_validated:
             self.on_validated(ledger)
 

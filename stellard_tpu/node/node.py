@@ -303,6 +303,16 @@ class Node:
                     decode_node_public(v) for v in cfg.cluster_nodes
                 } or None,
             )
+
+            # catch-up acquisitions resolve nodes from OUR NodeStore
+            # before asking peers: near-tip trees are mostly shared, so
+            # only the delta crosses the wire (reference: SHAMap node
+            # cache + fetch packs)
+            def _local_node_blob(h: bytes):
+                obj = self.nodestore.fetch(h)
+                return obj.data if obj is not None else None
+
+            self.overlay.node.inbound.local_fetch = _local_node_blob
             # persistence rides a dedicated ORDERED worker, NOT the
             # consensus tick (the hook fires under the master lock and a
             # slow disk must not stall round timing — reference:
@@ -386,6 +396,25 @@ class Node:
                 return None
 
         self.ledger_master.fetch_fallback = _fetch_fallback
+
+        def _header_fetch(h: bytes):
+            # LIGHT resolver for the reindex walk: header bytes only
+            from ..state.ledger import parse_header
+            from ..utils.hashes import HP_LEDGER_MASTER
+
+            obj = self.nodestore.fetch(h)
+            if obj is None:
+                return None
+            body = obj.data
+            if int.from_bytes(body[:4], "big") == HP_LEDGER_MASTER:
+                body = body[4:]
+            try:
+                f = parse_header(body)
+            except (ValueError, IndexError):
+                return None
+            return f["seq"], f["parent_hash"]
+
+        self.ledger_master.header_fetch = _header_fetch
         self.ops = NetworkOPs(
             self.ledger_master,
             self.job_queue,

@@ -97,6 +97,9 @@ class ValidatorNode:
         from .localtxs import LocalTxs
 
         self.local_txs = LocalTxs()
+        # trusted proposer -> (its proposal's prev-ledger hash, seen-at):
+        # the peer-LCL votes of the reference's checkLastClosedLedger
+        self._peer_prevs: dict[bytes, tuple[bytes, int]] = {}
         # fired for EVERY ledger that becomes our LCL — locally-closed
         # rounds AND catch-up adoptions — so the persistence plane never
         # gaps (reference: pendSaveValidated covers both paths)
@@ -168,20 +171,40 @@ class ValidatorNode:
         on the transient where peer validations beat its own close."""
         ours = self.lm.closed_ledger()
         ours_hash = ours.hash()
-        votes: dict[bytes, int] = {}
+        # floor: the last QUORUM-VALIDATED seq. Validations below it are
+        # history; validations between it and our closed seq stay
+        # eligible — a node that solo-closed AHEAD of a starved net must
+        # be pullable BACK onto the authoritative chain (filtering by
+        # our own closed seq let a runaway fork ratchet forever; the
+        # reference's checkLastClosedLedger weighs all current
+        # validations, NetworkOPs.cpp:776-925)
+        floor = self.lm.validated.seq if self.lm.validated is not None else 0
+        val_votes: dict[bytes, int] = {}
         for v in self.validations.current_trusted():
-            if v.ledger_seq is None or v.ledger_seq < ours.seq:
-                continue  # never move backwards
-            votes[v.ledger_hash] = votes.get(v.ledger_hash, 0) + 1
-        # our implicit vote for our own LCL (our stored validation may
-        # already be counted; the +1 is the reference's home-field bias)
-        our_weight = votes.get(ours_hash, 0) + 1
-        votes.pop(ours_hash, None)
-        if not votes:
-            self._lcl_candidate = None
-            return
-        best, weight = max(votes.items(), key=lambda kv: (kv[1], kv[0]))
-        if weight <= our_weight:
+            if v.ledger_seq is None or v.ledger_seq <= floor:
+                continue
+            val_votes[v.ledger_hash] = val_votes.get(v.ledger_hash, 0) + 1
+        # peer-LCL votes from current proposals (the reference's
+        # nodesUsing, NetworkOPs.cpp:821-843) — these break a symmetric
+        # validation split (every closed chain diverged 1-1-...-1) that
+        # validations alone can never heal
+        now = self.network_time()
+        using: dict[bytes, int] = {ours_hash: 1}  # ourselves
+        for pub, (prev, seen) in list(self._peer_prevs.items()):
+            if now - seen > 60:
+                del self._peer_prevs[pub]
+                continue
+            using[prev] = using.get(prev, 0) + 1
+        # election key mirrors ValidationCount::operator> with the
+        # LEDGER HASH as the final deterministic tie-break, so a split
+        # net elects ONE winner everywhere
+        def key(h: bytes) -> tuple[int, int, bytes]:
+            return (val_votes.get(h, 0), using.get(h, 0), h)
+
+        candidates = set(val_votes) | set(using)
+        candidates.discard(ours.parent_hash)  # never our own previous
+        best = max(candidates, key=key)
+        if best == ours_hash or key(best) <= key(ours_hash):
             self._lcl_candidate = None
             return
         if getattr(self, "_lcl_candidate", None) != best:
@@ -191,7 +214,22 @@ class ValidatorNode:
         if led is not None:
             self._adopt_network_lcl(led)
         else:
-            self.inbound.acquire(best)
+            # single-flight: while one catch-up acquisition is live AND
+            # viable, finishing it beats chasing every newer validation —
+            # an adopted slightly-stale LCL still moves us forward, and
+            # the next election closes the remaining gap. Without this, a
+            # moving target (net closes faster than one acquisition
+            # completes) re-targets forever and catch-up never lands. A
+            # session that never even got a header (an unserveable —
+            # possibly fabricated — hash) must not pin catch-up: retarget.
+            cur = getattr(self, "_lcl_acquiring", None)
+            if cur is not None and cur in self.inbound.live:
+                il = self.inbound.live[cur]
+                if cur == best or il.header is not None:
+                    return
+                self.inbound.abandon(cur)
+            self._lcl_acquiring = best
+            self.inbound.acquire(best, for_lcl=True)
 
     def _ledger_acquired(self, ledger: Ledger) -> None:
         """Acquisition finished (reference: InboundLedger LADispatch →
@@ -200,7 +238,14 @@ class ValidatorNode:
 
     def _adopt_network_lcl(self, ledger: Ledger) -> None:
         ours = self.lm.closed_ledger()
-        if ledger.seq < ours.seq or ledger.hash() == ours.hash():
+        if ledger.hash() == ours.hash():
+            return
+        # adopting a LOWER-seq ledger is legal fork repair (we solo-ran
+        # ahead); the floor is the validated chain, which never regresses
+        floor = (
+            self.lm.validated.seq if self.lm.validated is not None else 0
+        )
+        if ledger.seq <= floor:
             return
         self.lm.switch_lcl(ledger)
         self._lcl_candidate = None
@@ -309,6 +354,14 @@ class ValidatorNode:
             self.router.set_flag(pid, SF_SIGGOOD)
         prop.set_sig_verdict(True)
         with self.lock:
+            # remember each trusted proposer's view of the LCL even when
+            # its proposal is for ANOTHER chain — these are the
+            # "nodesUsing" votes of the reference's LCL election
+            # (NetworkOPs.cpp:821-843 counts peer closed-ledger hashes)
+            if prop.node_public in self.unl and not prop.is_bowout():
+                self._peer_prevs[prop.node_public] = (
+                    prop.prev_ledger, self.network_time()
+                )
             if self.round is None:
                 return False
             return self.round.peer_proposal(prop)

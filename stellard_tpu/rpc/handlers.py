@@ -240,7 +240,12 @@ def do_validation_seed(ctx: Context) -> dict:
 def do_server_info(ctx: Context) -> dict:
     """reference: handlers/ServerInfo.cpp via NetworkOPs::getServerInfo"""
     node = ctx.node
-    lcl = node.ledger_master.closed_ledger()
+    lm = node.ledger_master
+    lcl = lm.closed_ledger()
+    # the validated ledger is the QUORUM-confirmed one — reporting the
+    # LCL here would claim agreement the net has not reached (closed
+    # chains legitimately diverge until validations land)
+    val = lm.validated if lm.validated is not None else lcl
     info = {
         "build_version": "stellard-tpu 0.1.0",
         "server_state": node.ops.server_state(),
@@ -255,12 +260,16 @@ def do_server_info(ctx: Context) -> dict:
         "signature_backend": node.config.signature_backend,
         "validation_quorum": node.config.validation_quorum,
         "validated_ledger": {
+            "seq": val.seq,
+            "hash": val.hash().hex().upper(),
+            "close_time": val.close_time,
+            "base_fee_str": str(val.base_fee),
+            "reserve_base_str": str(val.reserve_base),
+            "reserve_inc_str": str(val.reserve_increment),
+        },
+        "closed_ledger": {
             "seq": lcl.seq,
             "hash": lcl.hash().hex().upper(),
-            "close_time": lcl.close_time,
-            "base_fee_str": str(lcl.base_fee),
-            "reserve_base_str": str(lcl.reserve_base),
-            "reserve_inc_str": str(lcl.reserve_increment),
         },
         # node identity vs validator key, as the reference splits them
         # (NetworkOPs.cpp:1721-1726): pubkey_node is the persisted

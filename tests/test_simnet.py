@@ -246,3 +246,38 @@ class TestByzantine:
         for v in net.validators:
             led = v.node.lm.validated
             assert led.account_root(alice.account_id) is not None
+
+
+class TestRunawayRejoin:
+    def test_solo_runaway_node_pulled_back_onto_net_chain(self):
+        """An isolated validator keeps CLOSING rounds alone (closing
+        needs no quorum) and runs ahead of the net on its own fork.
+        After healing it must be pulled BACK onto the authoritative
+        chain even though the net's validations carry lower seqs than
+        its solo closes (the repair the closed-seq filter used to
+        block)."""
+        net = SimNet(4, quorum=3)
+        net.start()
+        net.run_until(lambda: net.all_validated_at_least(2), 40)
+        for other in range(1, 4):
+            net.cut_link(0, other)
+        # let the isolated node solo-close well ahead while the majority
+        # keeps validating its own chain
+        majority_target = max(net.validated_seqs()[1:]) + 3
+        assert net.run_until(
+            lambda: all(s >= majority_target for s in net.validated_seqs()[1:]),
+            80,
+        )
+        solo_closed = net.validators[0].node.lm.closed_ledger().seq
+        assert solo_closed > 2, "isolated node never solo-closed"
+        for other in range(1, 4):
+            net.heal_link(0, other)
+        # the runaway must converge onto the majority chain
+        target = max(net.validated_seqs()) + 2
+        assert net.run_until(
+            lambda: net.all_validated_at_least(target), 120
+        ), f"runaway node never rejoined: {net.validated_seqs()}"
+        top = min(net.validated_seqs())
+        assert len(net.validated_hashes_at(top)) == 1, (
+            f"fork after rejoin: {net.validated_hashes_at(top)}"
+        )

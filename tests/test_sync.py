@@ -267,7 +267,7 @@ class TestRecentAcquisitions:
         sent = []
         inb = InboundLedgers(send=sent.append)
         h = b"\x07" * 32
-        inb.acquire(h)
+        inb.acquire(h, for_lcl=True)
         assert h in inb.live and not inb.recently_done(h)
         assert inb.expire_stale(max_age_s=-1) == 1
         assert h not in inb.live
@@ -290,7 +290,7 @@ class TestRecentAcquisitions:
         done = []
         inb = InboundLedgers(send=lambda req: None)
         inb.on_complete = done.append
-        inb.acquire(led.hash())
+        inb.acquire(led.hash(), for_lcl=True)
         reply = serve_get_ledger(led, GetLedger(led.hash(), 0, W_HEADER, []))
         assert inb.take_ledger_data(reply) >= 1
         # drive remaining requests until the acquisition completes
@@ -305,3 +305,139 @@ class TestRecentAcquisitions:
                 inb.take_ledger_data(data)
         assert done, "acquisition must complete against its own source"
         assert inb.recently_done(led.hash())
+
+
+class TestLclSwitchReindex:
+    def test_orphaned_seqs_repointed_to_adopted_chain(self):
+        """After an LCL switch, get_ledger_by_seq must serve the ADOPTED
+        chain's ledgers at every index, not our pre-switch orphans —
+        the mismatch the reference's LedgerHistory::handleMismatch
+        repairs. Two masters fork from a common parent; ours closes two
+        orphans, then adopts the network chain two ahead."""
+        from stellard_tpu.node.ledgermaster import LedgerMaster
+
+        ours = LedgerMaster()
+        ours.start_new_ledger(MASTER.account_id, close_time=1000)
+        ours.min_validations = 3  # networked: own closes are NOT validated
+        net = LedgerMaster()
+        net.start_new_ledger(MASTER.account_id, close_time=1000)
+        assert ours.closed_ledger().hash() == net.closed_ledger().hash()
+
+        # diverge: our chain closes seqs 2,3 with one tx; the network's
+        # closes empty ledgers for 2,3 and advances to 4
+        alice = KeyPair.from_passphrase("reindex-alice")
+        tx = SerializedTransaction.build(
+            TxType.ttPAYMENT, MASTER.account_id, 1, 10,
+            {
+                sfAmount: STAmount.from_drops(500 * XRP),
+                sfDestination: alice.account_id,
+            },
+        )
+        tx.sign(MASTER)
+        from stellard_tpu.engine.engine import TxParams
+
+        ours.do_transaction(tx, TxParams.OPEN_LEDGER)
+        ours.close_and_advance(2000, 30)  # our seq 2 (with tx)
+        ours.close_and_advance(2030, 30)  # our seq 3
+        for t in (2000, 2030, 2060):
+            net.close_and_advance(t, 30)  # network seqs 2,3,4 (empty)
+        assert (
+            ours.get_ledger_by_seq(2).hash() != net.get_ledger_by_seq(2).hash()
+        )
+
+        # adopt the network LCL; make its ancestry resolvable to us
+        for seq in (2, 3, 4):
+            led = net.get_ledger_by_seq(seq)
+            ours.ledgers_by_hash.put(led.hash(), led)
+        ours.switch_lcl(net.closed_ledger())
+
+        for seq in (2, 3, 4):
+            got = ours.get_ledger_by_seq(seq)
+            assert got is not None
+            assert got.hash() == net.get_ledger_by_seq(seq).hash(), seq
+
+    def test_unresolvable_orphan_entries_dropped(self):
+        """When the adopted chain's ancestry CANNOT be resolved (the
+        real catch-up shape: only the tip was acquired), the orphan
+        index entries above the validated floor are dropped — serving
+        nothing beats serving a ledger the network never validated."""
+        from stellard_tpu.node.ledgermaster import LedgerMaster
+
+        ours = LedgerMaster()
+        ours.start_new_ledger(MASTER.account_id, close_time=1000)
+        ours.min_validations = 3  # networked: own closes are NOT validated
+        net = LedgerMaster()
+        net.start_new_ledger(MASTER.account_id, close_time=1000)
+
+        alice = KeyPair.from_passphrase("reindex-bob")
+        tx = SerializedTransaction.build(
+            TxType.ttPAYMENT, MASTER.account_id, 1, 10,
+            {
+                sfAmount: STAmount.from_drops(500 * XRP),
+                sfDestination: alice.account_id,
+            },
+        )
+        tx.sign(MASTER)
+        from stellard_tpu.engine.engine import TxParams
+
+        ours.do_transaction(tx, TxParams.OPEN_LEDGER)
+        ours.close_and_advance(2000, 30)  # orphan seq 2
+        ours.close_and_advance(2030, 30)  # orphan seq 3
+        for t in (2000, 2030, 2060):
+            net.close_and_advance(t, 30)  # network 2,3,4
+
+        # adopt ONLY the tip — ancestry unresolvable
+        ours.switch_lcl(net.closed_ledger())
+        assert ours.get_ledger_by_seq(4).hash() == net.closed_ledger().hash()
+        for seq in (2, 3):
+            got = ours.get_ledger_by_seq(seq)
+            assert got is None or got.hash() == net.get_ledger_by_seq(seq).hash(), (
+                f"seq {seq} still serves an orphan"
+            )
+        # the validated floor (genesis) survives
+        assert ours.get_ledger_by_seq(1) is not None
+
+
+class TestLocalDeltaResolution:
+    def test_acquisition_completes_from_local_store_after_header(self):
+        """With local_fetch wired to the NodeStore, an acquisition asks
+        the wire for the HEADER only — every tree node resolves locally
+        (the delta-sync shape of real catch-up: near-tip trees are
+        shared)."""
+        from stellard_tpu.node.inbound import InboundLedgers, serve_get_ledger
+        from stellard_tpu.node.ledgermaster import LedgerMaster
+        from stellard_tpu.nodestore.core import make_database
+        from stellard_tpu.overlay.wire import GetLedger
+
+        lm = LedgerMaster()
+        lm.start_new_ledger(MASTER.account_id, close_time=1000)
+        alice = KeyPair.from_passphrase("delta-alice")
+        tx = SerializedTransaction.build(
+            TxType.ttPAYMENT, MASTER.account_id, 1, 10,
+            {
+                sfAmount: STAmount.from_drops(700 * XRP),
+                sfDestination: alice.account_id,
+            },
+        )
+        tx.sign(MASTER)
+        from stellard_tpu.engine.engine import TxParams
+
+        lm.do_transaction(tx, TxParams.OPEN_LEDGER)
+        closed, _ = lm.close_and_advance(2000, 30)
+        db = make_database(type="memory")
+        closed.save(db)
+
+        sent: list[GetLedger] = []
+        done: list = []
+
+        def local_blob(h: bytes):
+            obj = db.fetch(h)
+            return obj.data if obj is not None else None
+
+        ibs = InboundLedgers(send=sent.append, local_fetch=local_blob)
+        ibs.on_complete = done.append
+        ibs.acquire(closed.hash(), for_lcl=True)
+        # the whole ledger (header + both trees) resolves locally:
+        # NOTHING touches the wire
+        assert sent == []
+        assert done and done[0].hash() == closed.hash()
