@@ -315,3 +315,82 @@ class TestMultiProcessNet:
         assert wait_until(converged, timeout=60), (
             "validators never converged on one post-rejoin ledger hash"
         )
+
+    def test_load_restart_convergence(self, net):
+        """CI-sized version of the build-time net soak that exposed the
+        round-4 fork-repair fixes: continuous submissions while one
+        validator restarts from fresh genesis; afterwards every
+        validator's QUORUM-VALIDATED chain must advance and agree."""
+        import threading
+
+        rpc_ports = net["rpc_ports"]
+        procs = net["procs"]
+
+        assert wait_until(
+            lambda: all(
+                rpc(p, "server_info")["info"]["peers"] == 3 for p in rpc_ports
+            ),
+            timeout=60,
+        ), "net not meshed before load"
+
+        master = KeyPair.from_passphrase("masterpassphrase")
+        stop = threading.Event()
+        submitted = [0]
+
+        def load():
+            i = 0
+            while not stop.is_set():
+                try:
+                    rpc(
+                        rpc_ports[i % 4],
+                        "submit",
+                        {
+                            "secret": "masterpassphrase",
+                            "tx_json": {
+                                "TransactionType": "Payment",
+                                "Account": master.human_account_id,
+                                "Destination": KeyPair.from_passphrase(
+                                    f"lr-{i % 3}"
+                                ).human_account_id,
+                                "Amount": str(1_500_000_000),
+                            },
+                        },
+                        timeout=15,
+                    )
+                    submitted[0] += 1
+                except Exception:
+                    pass
+                i += 1
+                stop.wait(1.5)
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+        try:
+            time.sleep(12)
+            victim = 1
+            procs[victim].terminate()
+            procs[victim].wait(timeout=10)
+            time.sleep(4)
+            net["respawn"](victim)
+            time.sleep(20)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert submitted[0] > 0
+
+        def validated_seqs():
+            return [
+                rpc(p, "server_info")["info"]["validated_ledger"]["seq"]
+                for p in rpc_ports
+            ]
+
+        target = max(validated_seqs()) + 2
+        assert wait_until(
+            lambda: min(validated_seqs()) >= target, timeout=120
+        ), f"validated chains never converged: {validated_seqs()}"
+        common = min(validated_seqs())
+        hashes = {
+            rpc(p, "ledger", {"ledger_index": common})["ledger"]["hash"]
+            for p in rpc_ports
+        }
+        assert len(hashes) == 1, f"fork at {common}: {hashes}"
