@@ -206,6 +206,8 @@ class Config:
                 )
         if one("clock_speed"):
             cfg.clock_speed = float(one("clock_speed"))
+        if one("network_time_offset"):
+            cfg.network_time_offset = int(one("network_time_offset"))
 
         cfg.node_size = one("node_size", cfg.node_size).lower()
         if one("fee_default"):

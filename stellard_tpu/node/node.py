@@ -256,6 +256,21 @@ class Node:
                     (_time.time() - _ANCHOR) * speed
                 )
                 timer_interval = max(0.1, 1.0 / speed)
+            if cfg.network_time_offset:
+                # deliberate clock skew ([network_time_offset], test-net
+                # knob) on the overlay's consensus clock; the ops-plane
+                # clock gets the same offset below so both agree
+                from .networkops import EPOCH_OFFSET
+
+                base_nt = ntime
+                if base_nt is None:
+                    import time as _time2
+
+                    base_nt = (  # noqa: E731
+                        lambda: int(_time2.time()) - EPOCH_OFFSET
+                    )
+                off = int(cfg.network_time_offset)
+                ntime = lambda: base_nt() + off  # noqa: E731
             from ..protocol.keys import decode_node_public
 
             unl_keys = self.unl.publics()
@@ -423,6 +438,10 @@ class Node:
             standalone=cfg.standalone,
             fee_track=self.fee_track,
         )
+        # configured skew applies to the ops-plane clock too (standalone
+        # closes, status, staleness checks); the SNTP heartbeat COMPOSES
+        # its measured correction with this base (see _heartbeat)
+        self.ops.net_time_offset = int(cfg.network_time_offset)
         if self.overlay is not None:
             # one master lock for consensus + RPC over the shared chain,
             # and the relay/local-retry seams (reference: the relay step
@@ -681,8 +700,11 @@ class Node:
                 )
                 if self.sntp is not None and self.sntp.synced:
                     # discipline the network clock used for close times
-                    # (reference getNetworkTimeNC via the SNTP offset)
-                    self.ops.net_time_offset = int(round(self.sntp.offset))
+                    # (reference getNetworkTimeNC via the SNTP offset),
+                    # composed with any configured deliberate skew
+                    self.ops.net_time_offset = int(
+                        round(self.sntp.offset)
+                    ) + int(self.config.network_time_offset)
                 if self.overlay is not None:
                     # operating mode from overlay health (reference:
                     # NetworkOPs::setMode heuristics): FULL only while
