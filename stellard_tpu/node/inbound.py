@@ -13,7 +13,7 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 from ..overlay.wire import GetLedger, LedgerData
-from ..state.ledger import Ledger, parse_header
+from ..state.ledger import Ledger, parse_header, strip_ledger_prefix
 from ..state.shamap import SHAMap, TNType
 from ..state.shamapsync import IncompleteMap, SHAMapNodeID
 from ..utils.hashes import HP_LEDGER_MASTER, prefix_hash
@@ -263,12 +263,7 @@ class InboundLedgers:
                 # hold on disk must not need a peer at all
                 blob = self.local_fetch(il.hash)
                 if blob is not None:
-                    if (
-                        len(blob) >= 4
-                        and int.from_bytes(blob[:4], "big") == HP_LEDGER_MASTER
-                    ):
-                        blob = blob[4:]
-                    il.take_header(blob)
+                    il.take_header(strip_ledger_prefix(blob))
             if il.header is not None and il.resolve_local(self.local_fetch):
                 import time as _time
 

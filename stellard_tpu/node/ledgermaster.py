@@ -350,14 +350,15 @@ class LedgerMaster:
             self.ledger_history[seq] = cur_hash
             confirmed_down_to = seq
             cur_hash = parent_hash
-        for seq in [
-            s for s in self.ledger_history if floor < s < confirmed_down_to
-        ]:
-            del self.ledger_history[seq]
+        # one pass: (a) unconfirmable entries between the floor and the
+        # deepest confirmed ancestor are orphan-branch closes; (b)
         # entries ABOVE the adopted tip are our own solo closes on an
-        # abandoned fork (backward adoption repairs a runaway node):
-        # the network never validated them
-        for seq in [s for s in self.ledger_history if s > ledger.seq]:
+        # abandoned fork (backward adoption repairs a runaway node) —
+        # the network validated neither
+        for seq in [
+            s for s in self.ledger_history
+            if floor < s < confirmed_down_to or s > ledger.seq
+        ]:
             del self.ledger_history[seq]
         while len(self.ledger_history) > 8192:
             del self.ledger_history[min(self.ledger_history)]

@@ -412,19 +412,15 @@ class Node:
 
         self.ledger_master.fetch_fallback = _fetch_fallback
 
+        from ..state.ledger import parse_header, strip_ledger_prefix
+
         def _header_fetch(h: bytes):
             # LIGHT resolver for the reindex walk: header bytes only
-            from ..state.ledger import parse_header
-            from ..utils.hashes import HP_LEDGER_MASTER
-
             obj = self.nodestore.fetch(h)
             if obj is None:
                 return None
-            body = obj.data
-            if int.from_bytes(body[:4], "big") == HP_LEDGER_MASTER:
-                body = body[4:]
             try:
-                f = parse_header(body)
+                f = parse_header(strip_ledger_prefix(obj.data))
             except (ValueError, IndexError):
                 return None
             return f["seq"], f["parent_hash"]

@@ -100,6 +100,9 @@ class ValidatorNode:
         # trusted proposer -> (its proposal's prev-ledger hash, seen-at):
         # the peer-LCL votes of the reference's checkLastClosedLedger
         self._peer_prevs: dict[bytes, tuple[bytes, int]] = {}
+        self._lcl_candidate: Optional[bytes] = None  # election hysteresis
+        self._lcl_acquiring: Optional[bytes] = None  # single-flight catch-up
+        self._tick = 0
         # fired for EVERY ledger that becomes our LCL — locally-closed
         # rounds AND catch-up adoptions — so the persistence plane never
         # gaps (reference: pendSaveValidated covers both paths)
@@ -153,7 +156,7 @@ class ValidatorNode:
         self._check_lcl()
         # re-trigger stalled acquisitions every other tick (reference:
         # PeerSet timeouts); progress-driven triggers do the steady-state
-        self._tick = getattr(self, "_tick", 0) + 1
+        self._tick += 1
         if self._tick % 2 == 0:
             self.inbound.expire_stale()
             for il in list(self.inbound.live.values()):
@@ -204,10 +207,10 @@ class ValidatorNode:
         candidates = set(val_votes) | set(using)
         candidates.discard(ours.parent_hash)  # never our own previous
         best = max(candidates, key=key)
-        if best == ours_hash or key(best) <= key(ours_hash):
+        if key(best) <= key(ours_hash):  # covers best == ours_hash
             self._lcl_candidate = None
             return
-        if getattr(self, "_lcl_candidate", None) != best:
+        if self._lcl_candidate != best:
             self._lcl_candidate = best  # hysteresis: confirm next tick
             return
         led = self.lm.get_ledger_by_hash(best)
@@ -222,7 +225,7 @@ class ValidatorNode:
             # completes) re-targets forever and catch-up never lands. A
             # session that never even got a header (an unserveable —
             # possibly fabricated — hash) must not pin catch-up: retarget.
-            cur = getattr(self, "_lcl_acquiring", None)
+            cur = self._lcl_acquiring
             if cur is not None and cur in self.inbound.live:
                 il = self.inbound.live[cur]
                 if cur == best or il.header is not None:

@@ -38,6 +38,14 @@ __all__ = [
 ]
 
 
+def strip_ledger_prefix(body: bytes) -> bytes:
+    """Drop the HP_LEDGER_MASTER domain prefix when present — stored
+    ledger-header blobs carry it (save() above), wire headers do not."""
+    if len(body) >= 4 and int.from_bytes(body[:4], "big") == HP_LEDGER_MASTER:
+        return body[4:]
+    return body
+
+
 def parse_header(blob: bytes) -> dict:
     """Decode Ledger::addRaw header bytes — the single reader for the
     layout header_bytes() writes (reference: Ledger.cpp:1182-1196)."""
