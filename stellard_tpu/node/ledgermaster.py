@@ -167,17 +167,28 @@ class LedgerMaster:
 
     def do_transaction(self, tx: SerializedTransaction, params: TxParams) -> tuple[TER, bool]:
         with self._lock:
-            engine = TransactionEngine(self.current_ledger())
-            return engine.apply_transaction(tx, params)
+            open_ledger = self.current_ledger()
+            engine = TransactionEngine(open_ledger)
+            ter, applied = engine.apply_transaction(tx, params)
+            if applied:
+                # seed the OPEN ledger's parsed-tx memo so the close path
+                # reuses this exact object instead of re-parsing the blob
+                # (txid is the blob's content hash; the memo's lifetime
+                # is the open ledger's). Ownership contract: a submitted
+                # tx belongs to the node — callers must not mutate it.
+                open_ledger.parsed_txs[tx.txid()] = tx
+            return ter, applied
 
     # -- close (standalone / consensus-accept share this tail) ------------
 
-    def _parse_with_verdict(self, txid: bytes, blob: bytes):
-        """Parse an open-ledger blob, carrying over the submit-time
+    def _parse_with_verdict(self, open_ledger: Ledger, txid: bytes, blob: bytes):
+        """Parse an open-ledger blob — or reuse the submit-time parsed
+        object from the ledger's own memo (txid is content-addressed,
+        so a hit is byte-equal) — carrying over the submit-time
         SF_SIGGOOD verdict so close/re-apply never host-re-verifies
         (reference: LedgerConsensus::applyTransaction skips checkSign
         via SF_SIGGOOD, LedgerConsensus.cpp:2101-2106)."""
-        tx = SerializedTransaction.from_bytes(blob)
+        tx = open_ledger.parse_tx(txid, blob)
         if self.router is not None and (
             self.router.get_flags(txid) & SF_SIGGOOD
         ):
@@ -214,7 +225,7 @@ class LedgerMaster:
             # skips checkSign the same way)
             txset = CanonicalTXSet(prev.hash())
             for txid, blob, _meta in open_ledger.tx_entries():
-                txset.insert(self._parse_with_verdict(txid, blob))
+                txset.insert(self._parse_with_verdict(open_ledger, txid, blob))
             for tx in extra_txs or []:
                 txset.insert(tx)
 
@@ -285,7 +296,7 @@ class LedgerMaster:
             engine = TransactionEngine(self.current)
             consensus_ids = {tx.txid() for tx in txs}
             leftovers = [
-                self._parse_with_verdict(txid, blob)
+                self._parse_with_verdict(open_ledger, txid, blob)
                 for txid, blob, _meta in open_ledger.tx_entries()
                 if txid not in consensus_ids
             ] + self.take_held_transactions()
