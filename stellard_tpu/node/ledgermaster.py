@@ -173,9 +173,10 @@ class LedgerMaster:
             if applied:
                 # seed the OPEN ledger's parsed-tx memo so the close path
                 # reuses this exact object instead of re-parsing the blob
-                # (txid is the blob's content hash; the memo's lifetime
-                # is the open ledger's). Ownership contract: a submitted
-                # tx belongs to the node — callers must not mutate it.
+                # (txid is the blob's content hash). Ownership contract: a
+                # submitted tx belongs to the node FOREVER — the object
+                # escapes into the closed ledger's parsed_txs and is served
+                # from history caches — so callers must never mutate it.
                 open_ledger.parsed_txs[tx.txid()] = tx
             return ter, applied
 
@@ -253,11 +254,13 @@ class LedgerMaster:
             # re-apply held txns to the new open ledger
             for tx in self.take_held_transactions():
                 engine = TransactionEngine(self.current)
-                ter, _ = engine.apply_transaction(
+                ter, applied = engine.apply_transaction(
                     tx, TxParams.OPEN_LEDGER | TxParams.RETRY
                 )
                 if ter == TER.terPRE_SEQ:
                     self.add_held_transaction(tx)
+                elif applied:
+                    self.current.parsed_txs[tx.txid()] = tx
             return new_lcl, results
 
     def close_with_txset(
@@ -301,11 +304,13 @@ class LedgerMaster:
                 if txid not in consensus_ids
             ] + self.take_held_transactions()
             for tx in leftovers:
-                ter, _ = engine.apply_transaction(
+                ter, applied = engine.apply_transaction(
                     tx, TxParams.OPEN_LEDGER | TxParams.RETRY
                 )
                 if ter == TER.terPRE_SEQ:
                     self.add_held_transaction(tx)
+                elif applied:
+                    self.current.parsed_txs[tx.txid()] = tx
             return new_lcl, results
 
     def switch_lcl(self, ledger: Ledger) -> None:

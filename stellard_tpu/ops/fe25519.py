@@ -42,6 +42,8 @@ import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
+import os
+
 NLIMB = 20
 BITS = 13
 MASK = (1 << BITS) - 1
@@ -124,16 +126,16 @@ def _carry20_fold(c: jnp.ndarray) -> jnp.ndarray:
     return jnp.concatenate([(out[0] + FOLD * hi[19])[None], out[1:]], axis=0)
 
 
-def _finish_mul(lo_cols: list, hi_cols: list) -> jnp.ndarray:
+def _finish_mul_t(lo: jnp.ndarray, hi: jnp.ndarray) -> jnp.ndarray:
     """Shared tail of multiply/square: fold the 19 high columns
     (weights 2^260..) onto the 20 low ones via 2^260 ≡ FOLD, then carry.
 
-    lo_cols: 20 column sums, each < 2^31. hi_cols: 19 column sums."""
-    z = jnp.zeros_like(lo_cols[0])
-    lo = jnp.stack(lo_cols, axis=0)
+    lo: [20, *batch] column sums < 2^31. hi: [19, *batch] column sums."""
     # carry hi first so FOLD*hi stays in int32; 2 spare limbs so no
     # carry-out is ever dropped
-    hi = jnp.stack(hi_cols + [z, z], axis=0)
+    hi = jnp.concatenate(
+        [hi, jnp.zeros((2,) + hi.shape[1:], hi.dtype)], axis=0
+    )
     hi = _carry(hi, 2)  # limbs <= MASK + 33
     c = lo + FOLD * hi[:20]  # < 2^31
     # hi[20] (weight 2^260 * 2^260) folds with FOLD^2; hi's own carrying
@@ -149,44 +151,97 @@ def _finish_mul(lo_cols: list, hi_cols: list) -> jnp.ndarray:
     return _carry(c, 2)  # limbs <= MASK + 33 < BOUND
 
 
+def _finish_mul(lo_cols: list, hi_cols: list) -> jnp.ndarray:
+    return _finish_mul_t(jnp.stack(lo_cols, axis=0), jnp.stack(hi_cols, axis=0))
+
+
+# Multiply formulation. The original "legacy" form emits every one of the
+# ~400 limb products and ~580 column adds as its own [*batch]-shaped 1-D
+# XLA op (the per-limb Python slicing drops the limb axis), and measured
+# on-chip the kernel's cost tracks that op COUNT, not its FLOPs — a TPU
+# core runs the post-fusion op sequence serially, so thousands of
+# vector-register-sized ops are pure sequencing overhead. The "rowpad"
+# form keeps the limb axis inside the tensors: 20 shifted row-products,
+# padded to the 39-column width and summed in one reduction — ~45 wide
+# ops instead of ~1000 tiny ones, identical arithmetic and bounds.
+_FE_MUL_IMPL = os.environ.get("STELLARD_FE_MUL", "rowpad")
+if _FE_MUL_IMPL not in ("rowpad", "legacy"):
+    raise ValueError(
+        f"STELLARD_FE_MUL={_FE_MUL_IMPL!r}: expected 'rowpad' or 'legacy'"
+    )
+
+
+def _rows_padsum(rows: list) -> jnp.ndarray:
+    """rows[i]: [len_i, *batch] partial products whose limb 0 sits at
+    column offset off_i; returns [39, *batch] column sums."""
+    nb = rows[0][1].ndim - 1
+    padded = [
+        jnp.pad(r, ((off, 2 * NLIMB - 1 - off - r.shape[0]),) + ((0, 0),) * nb)
+        for off, r in rows
+    ]
+    return jnp.sum(jnp.stack(padded, axis=0), axis=0)
+
+
 def fe_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Schoolbook 20x20 product as 39 pure-SSA column sums + fold."""
+    """Schoolbook 20x20 product -> 39 column sums + fold."""
     a, b = jnp.broadcast_arrays(*_align2(a, b))
-    ai = [a[i] for i in range(NLIMB)]
-    bi = [b[i] for i in range(NLIMB)]
-    lo_cols, hi_cols = [], []
-    for k in range(2 * NLIMB - 1):
-        terms = [ai[i] * bi[k - i] for i in range(max(0, k - 19), min(NLIMB, k + 1))]
-        s = terms[0]
-        for t in terms[1:]:
-            s = s + t
-        (lo_cols if k < NLIMB else hi_cols).append(s)
-    return _finish_mul(lo_cols, hi_cols)
-
-
-def fe_square(a: jnp.ndarray) -> jnp.ndarray:
-    """Symmetric schoolbook square: 210 lane products (vs 400)."""
-    ai = [a[i] for i in range(NLIMB)]
-    lo_cols, hi_cols = [], []
-    for k in range(2 * NLIMB - 1):
-        i = max(0, k - 19)
-        j = k - i
-        terms = []
-        while i < j:
-            terms.append(ai[i] * ai[j])
-            i += 1
-            j -= 1
-        s = None
-        if terms:
+    if _FE_MUL_IMPL == "legacy":
+        ai = [a[i] for i in range(NLIMB)]
+        bi = [b[i] for i in range(NLIMB)]
+        lo_cols, hi_cols = [], []
+        for k in range(2 * NLIMB - 1):
+            terms = [
+                ai[i] * bi[k - i]
+                for i in range(max(0, k - 19), min(NLIMB, k + 1))
+            ]
             s = terms[0]
             for t in terms[1:]:
                 s = s + t
-            s = s + s  # off-diagonal pairs count twice
-        if i == j:
-            d = ai[i] * ai[i]
-            s = d if s is None else s + d
-        (lo_cols if k < NLIMB else hi_cols).append(s)
-    return _finish_mul(lo_cols, hi_cols)
+            (lo_cols if k < NLIMB else hi_cols).append(s)
+        return _finish_mul(lo_cols, hi_cols)
+    # rowpad: row i = a_i * b lands at columns i..i+19
+    cols = _rows_padsum([(i, a[i] * b) for i in range(NLIMB)])
+    return _finish_mul_t(cols[:NLIMB], cols[NLIMB:])
+
+
+def fe_square(a: jnp.ndarray) -> jnp.ndarray:
+    """Symmetric schoolbook square: halved off-diagonal work."""
+    if _FE_MUL_IMPL == "legacy":
+        ai = [a[i] for i in range(NLIMB)]
+        lo_cols, hi_cols = [], []
+        for k in range(2 * NLIMB - 1):
+            i = max(0, k - 19)
+            j = k - i
+            terms = []
+            while i < j:
+                terms.append(ai[i] * ai[j])
+                i += 1
+                j -= 1
+            s = None
+            if terms:
+                s = terms[0]
+                for t in terms[1:]:
+                    s = s + t
+                s = s + s  # off-diagonal pairs count twice
+            if i == j:
+                d = ai[i] * ai[i]
+                s = d if s is None else s + d
+            (lo_cols if k < NLIMB else hi_cols).append(s)
+        return _finish_mul(lo_cols, hi_cols)
+    # rowpad: row i = a_i * (a_i, 2a_{i+1}, .., 2a_19) lands at columns
+    # 2i..i+19; every i<j pair appears once, doubled. Bounds: column k
+    # sums the pairs (i, k-i) with i <= k-i < 20 — at most 10 of them
+    # (k = 19: (0,19)..(9,10); k = 20: (1,19)..(10,10)) — each term
+    # <= 2*BOUND^2 = 1.805e8, so the worst column is 10 * 1.805e8 =
+    # 1.805e9 < 2^31, the same slack the legacy halved form relied on.
+    rows = []
+    for i in range(NLIMB):
+        seg = a[i] * a[i:]  # [NLIMB - i, *batch]
+        if seg.shape[0] > 1:
+            seg = jnp.concatenate([seg[:1], seg[1:] + seg[1:]], axis=0)
+        rows.append((2 * i, seg))
+    cols = _rows_padsum(rows)
+    return _finish_mul_t(cols[:NLIMB], cols[NLIMB:])
 
 
 def fe_add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,198 @@
+"""Decompose the on-chip cost of the batched Ed25519 verify kernel.
+
+The full-kernel sweep (tools/kernel_sweep.py) answers "how fast"; this
+answers "where does the time go" by timing isolated sub-kernels whose
+field-op counts are known exactly:
+
+  sq_chain    — N dependent fe_square on [20, B]   (the doubling/invert
+                substrate: per-square cost, pure dependency chain)
+  mul_chain   — N dependent fe_mul on [20, B]
+  dbl_chain   — N dependent pt_double               (4S + 4M + adds)
+  select_h    — 64 signed-digit one-hot table selects (the in-loop form)
+  comb_mxu    — 64 one-hot [60,16]@[16,B] matmuls at HIGHEST precision
+  encode      — pt_encode_words (fe_invert: 254 dependent squares + tail)
+
+Each sub-kernel is wrapped in jit with a donated dummy carry so XLA
+cannot elide the chain. Comparing (measured total) vs (sum of parts at
+these rates) pins which formulation change pays: wider ops (grouped
+muls), hoisted selects, shorter chains, or bigger batches.
+
+Run on the TPU host: `python tools/kernel_profile.py [B ...]`.
+Optionally set STELLARD_PROFILE_TRACE=/tmp/jaxtrace to also capture a
+jax.profiler trace of one full verify_kernel invocation.
+"""
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+
+os.environ.pop("JAX_PLATFORMS", None)
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from stellard_tpu.utils.xlacache import enable_compilation_cache
+
+enable_compilation_cache()
+
+from stellard_tpu.ops import ed25519_jax as ej
+from stellard_tpu.ops.fe25519 import NLIMB, fe_add, fe_mul, fe_square
+
+
+def bench(fn, *args, reps=20, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.time()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.time() - t0) / reps
+
+
+def rand_fe(B, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, 8191, size=(NLIMB, B), dtype=np.int32))
+
+
+def rand_pt(B, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(
+        rng.integers(0, 8191, size=(4, NLIMB, B), dtype=np.int32)
+    )
+
+
+def main(batches):
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {dev.device_kind}", flush=True)
+    N = 64  # chain length for per-op timings
+
+    @jax.jit
+    def sq_chain(x):
+        return lax.fori_loop(0, N, lambda i, a: fe_square(a), x)
+
+    @jax.jit
+    def mul_chain(x, y):
+        return lax.fori_loop(0, N, lambda i, a: fe_mul(a, y), x)
+
+    @jax.jit
+    def add_chain(x, y):
+        return lax.fori_loop(0, N, lambda i, a: fe_add(a, y), x)
+
+    @jax.jit
+    def dbl_chain(p):
+        return lax.fori_loop(0, N, lambda i, a: ej.pt_double(a), p)
+
+    @jax.jit
+    def select_h(tbl, digits):
+        def body(j, acc):
+            d = lax.dynamic_index_in_dim(digits, j, axis=0, keepdims=False)
+            return acc + ej._select_cached(tbl, d)
+
+        return lax.fori_loop(0, N, body, jnp.zeros_like(tbl[0]))
+
+    comb_np = ej._comb_table_np()
+
+    @jax.jit
+    def comb_mxu(comb, sw):
+        def body(j, acc):
+            tj = lax.dynamic_index_in_dim(comb, j, axis=0, keepdims=False)
+            w = lax.dynamic_index_in_dim(sw, j, axis=0, keepdims=False)
+            onehot = (
+                w[None, :] == jnp.arange(16, dtype=w.dtype)[:, None]
+            ).astype(jnp.float32)
+            sel = (
+                jnp.matmul(tj, onehot, precision=lax.Precision.HIGHEST)
+                .astype(jnp.int32)
+                .reshape((3, NLIMB) + w.shape)
+            )
+            return acc + sel
+
+        z = jnp.zeros((3, NLIMB) + sw.shape[1:], jnp.int32)
+        return lax.fori_loop(0, N, body, z)
+
+    @jax.jit
+    def comb_hoisted(comb, sw):
+        onehot = (
+            sw[:, None, :] == jnp.arange(16, dtype=sw.dtype)[None, :, None]
+        ).astype(jnp.float32)  # [64, 16, B]
+        sel = jnp.einsum(
+            "jlw,jwb->jlb", comb, onehot, precision=lax.Precision.HIGHEST
+        ).astype(jnp.int32)
+        return sel.reshape((N, 3, NLIMB) + sw.shape[1:])
+
+    @jax.jit
+    def encode(p):
+        return ej.pt_encode_words(p)
+
+    for B in batches:
+        rng = np.random.default_rng(1)
+        x, y = rand_fe(B, 1), rand_fe(B, 2)
+        p = rand_pt(B, 3)
+        tbl = jnp.asarray(
+            rng.integers(0, 8191, size=(9, 4, NLIMB, B), dtype=np.int32)
+        )
+        digits = jnp.asarray(
+            rng.integers(-8, 8, size=(N, B), dtype=np.int32)
+        )
+        sw = jnp.asarray(rng.integers(0, 16, size=(N, B), dtype=np.int32))
+        comb = jnp.asarray(comb_np)
+
+        rows = [
+            ("sq_chain", lambda: bench(sq_chain, x), N),
+            ("mul_chain", lambda: bench(mul_chain, x, y), N),
+            ("add_chain", lambda: bench(add_chain, x, y), N),
+            ("dbl_chain", lambda: bench(dbl_chain, p), N),
+            ("select_h", lambda: bench(select_h, tbl, digits), N),
+            ("comb_mxu", lambda: bench(comb_mxu, comb, sw), N),
+            ("comb_hoisted", lambda: bench(comb_hoisted, comb, sw), 1),
+            ("encode", lambda: bench(encode, p), 1),
+        ]
+        print(f"\n== B={B} ==", flush=True)
+        per = {}
+        for name, run, n in rows:
+            t = run()
+            per[name] = t / n
+            print(
+                f"{name:14s} total={t * 1e3:8.2f}ms  per-unit={t / n * 1e6:9.1f}us",
+                flush=True,
+            )
+        # reconstruct the full kernel from parts:
+        #   256 doublings (as 256/N dbl_chain units of N) + 64 cached adds
+        #   (~8/7 of a mul-dominated unit; approximate with mul_chain cost
+        #   x 8 muls) + 64 selects + 64 comb steps + 64 mixed adds + encode
+        est = (
+            256 * per["dbl_chain"]
+            + 64 * (8 * per["mul_chain"])
+            + 64 * per["select_h"]
+            + 64 * per["comb_mxu"]
+            + 64 * (7 * per["mul_chain"])
+            + per["encode"]
+        )
+        print(f"reconstructed-from-parts ~= {est * 1e3:.1f}ms", flush=True)
+
+    trace_dir = os.environ.get("STELLARD_PROFILE_TRACE")
+    if trace_dir:
+        z = np.load("/tmp/sigset.npz")
+        B = 4096
+        inputs = ej.prepare_batch(
+            [z["pubs"][i].tobytes() for i in range(B)],
+            [z["msgs"][i].tobytes() for i in range(B)],
+            [z["sigs"][i].tobytes() for i in range(B)],
+        )
+        out = ej.verify_kernel(**inputs)
+        out.block_until_ready()
+        with jax.profiler.trace(trace_dir):
+            out = ej.verify_kernel(**inputs)
+            out.block_until_ready()
+        print(f"trace written to {trace_dir}", flush=True)
+
+
+if __name__ == "__main__":
+    bs = [int(a) for a in sys.argv[1:]] or [4096]
+    main(bs)
