@@ -115,6 +115,34 @@ def pt_identity(batch_shape=()):
     )
 
 
+def _mul_many(pairs):
+    """K independent field multiplies as ONE wide multiply.
+
+    A TPU core executes the post-fusion op sequence serially, so K
+    narrow multiplies cost ~K times one wide one; concatenating the
+    operands along the minor (lane) axis turns them into a single
+    K-times-wider op at the same lane-op count. All operands must share
+    one shape [20, *batch]."""
+    k = len(pairs)
+    if k == 1:
+        return [fe_mul(pairs[0][0], pairs[0][1])]
+    n = pairs[0][0].shape[-1]
+    a = jnp.concatenate([p[0] for p in pairs], axis=-1)
+    b = jnp.concatenate([p[1] for p in pairs], axis=-1)
+    c = fe_mul(a, b)
+    return [c[..., i * n : (i + 1) * n] for i in range(k)]
+
+
+def _square_many(xs):
+    """K independent field squarings as ONE wide squaring (see
+    _mul_many)."""
+    if len(xs) == 1:
+        return [fe_square(xs[0])]
+    n = xs[0].shape[-1]
+    c = fe_square(jnp.concatenate(xs, axis=-1))
+    return [c[..., i * n : (i + 1) * n] for i in range(len(xs))]
+
+
 def pt_to_cached(p):
     """extended -> cached: 1M + 3 add."""
     x, y, z, t = p[0], p[1], p[2], p[3]
@@ -125,33 +153,34 @@ def pt_to_cached(p):
 
 
 def pt_add_cached(p, q_cached):
-    """Complete unified addition, q in cached form: 8M."""
+    """Complete unified addition, q in cached form: 8M (2 wide ops)."""
     x1, y1, z1, t1 = p[0], p[1], p[2], p[3]
     ypx2, ymx2, t2d2, z22 = q_cached[0], q_cached[1], q_cached[2], q_cached[3]
-    a = fe_mul(fe_sub(y1, x1), ymx2)
-    b = fe_mul(fe_add(y1, x1), ypx2)
-    c = fe_mul(t1, t2d2)
-    d = fe_mul(z1, z22)
+    a, b, c, d = _mul_many(
+        [(fe_sub(y1, x1), ymx2), (fe_add(y1, x1), ypx2), (t1, t2d2), (z1, z22)]
+    )
     e = fe_sub(b, a)
     f = fe_sub(d, c)
     g = fe_add(d, c)
     h = fe_add(b, a)
-    return pt_stack(fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+    x3, y3, z3, t3 = _mul_many([(e, f), (g, h), (f, g), (e, h)])
+    return pt_stack(x3, y3, z3, t3)
 
 
 def pt_add_mixed(p, q_niels):
     """Complete unified addition, q in niels form (Z2 = 1): 7M."""
     x1, y1, z1, t1 = p[0], p[1], p[2], p[3]
     ypx2, ymx2, t2d2 = q_niels[0], q_niels[1], q_niels[2]
-    a = fe_mul(fe_sub(y1, x1), ymx2)
-    b = fe_mul(fe_add(y1, x1), ypx2)
-    c = fe_mul(t1, t2d2)
+    a, b, c = _mul_many(
+        [(fe_sub(y1, x1), ymx2), (fe_add(y1, x1), ypx2), (t1, t2d2)]
+    )
     d = fe_add(z1, z1)
     e = fe_sub(b, a)
     f = fe_sub(d, c)
     g = fe_add(d, c)
     h = fe_add(b, a)
-    return pt_stack(fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+    x3, y3, z3, t3 = _mul_many([(e, f), (g, h), (f, g), (e, h)])
+    return pt_stack(x3, y3, z3, t3)
 
 
 def pt_add(p, q):
@@ -160,17 +189,16 @@ def pt_add(p, q):
 
 
 def pt_double(p):
-    """Dedicated doubling (dbl-2008-hwcd, a=-1): 4S + 4M."""
+    """Dedicated doubling (dbl-2008-hwcd, a=-1): 4S + 4M (2 wide ops)."""
     x1, y1, z1 = p[0], p[1], p[2]
-    a = fe_square(x1)
-    b = fe_square(y1)
-    zz = fe_square(z1)
+    a, b, zz, sq = _square_many([x1, y1, z1, fe_add(x1, y1)])
     c = fe_add(zz, zz)
-    e = fe_sub(fe_sub(fe_square(fe_add(x1, y1)), a), b)
+    e = fe_sub(fe_sub(sq, a), b)
     g = fe_sub(b, a)  # a_coeff=-1: G = aA + B = B - A
     f = fe_sub(g, c)  # F = G - C
     h = fe_sub(fe_neg(a), b)  # H = aA - B = -A - B
-    return pt_stack(fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+    x3, y3, z3, t3 = _mul_many([(e, f), (g, h), (f, g), (e, h)])
+    return pt_stack(x3, y3, z3, t3)
 
 
 def pt_neg(p):
@@ -248,6 +276,23 @@ def _build_cached_table(p):
     m8 = pt_double(m4)
     cached = [ident, c1, c2, c3, c4] + [pt_to_cached(m) for m in (m5, m6, m7, m8)]
     return jnp.stack(cached, axis=0)
+
+
+def _build_cached_table_signed(p):
+    """p extended [4, 20, *batch] -> [17, 4, 20, *batch] cached multiples
+    for signed digits -8..8 (index d + 8).
+
+    Baking the negative entries into the table (cached-form negation:
+    swap Y+X/Y-X, negate 2dT) lets the per-window selection be one plain
+    one-hot contraction with no post-selection fixups — which in turn
+    lets ALL 64 window selections hoist out of the scalar-walk loop as a
+    single contraction."""
+    pos = _build_cached_table(p)  # [9, 4, 20, *batch], digits 0..8
+    negs = [
+        jnp.stack([pos[k, 1], pos[k, 0], fe_neg(pos[k, 2]), pos[k, 3]], axis=0)
+        for k in range(8, 0, -1)
+    ]  # digits -8..-1
+    return jnp.concatenate([jnp.stack(negs, axis=0), pos], axis=0)
 
 
 def _select_cached(tbl, digit):
@@ -339,56 +384,62 @@ def verify_kernel(a_words, r_words, s_windows, h_digits, s_canonical):
     hd = jnp.transpose(h_digits)
 
     a_point, a_valid = pt_decompress(aw)
-    htbl = _build_cached_table(pt_neg(a_point))  # [9, 4, 20, B]
+    htbl = _build_cached_table_signed(pt_neg(a_point))  # [17, 4, 20, B]
     comb = jnp.asarray(_comb_table_np())  # [64, 60, 16] f32
+
+    # Hoisted window selections: ALL 64 windows of both scalar walks are
+    # selected before the loop in two wide contractions, so the loop body
+    # is pure point arithmetic. (In-loop one-hot selects were ~35% of the
+    # op count; a TPU core runs ops serially, so fewer+wider wins.)
+    # [h](-A) windows, MSB-first over the signed table:
+    onehot_h = (
+        hd[:, None, :] == (jnp.arange(17, dtype=hd.dtype) - 8)[None, :, None]
+    ).astype(jnp.int32)  # [64, 17, B]
+    hsel = jnp.einsum("wsb,scdb->wcdb", onehot_h, htbl)  # [64, 4, 20, B]
+    # [S]B comb windows (strategy per _COMB_SELECT, see header):
+    if _COMB_SELECT == "vpu":
+        onehot_i = (
+            sw[:, None, :] == jnp.arange(16, dtype=sw.dtype)[None, :, None]
+        ).astype(jnp.int32)  # [64, 16, B]
+        csel = jnp.einsum("jlw,jwb->jlb", comb.astype(jnp.int32), onehot_i)
+    else:
+        onehot_s = (
+            sw[:, None, :] == jnp.arange(16, dtype=sw.dtype)[None, :, None]
+        ).astype(jnp.float32)  # [64, 16, B]
+        if _COMB_SELECT == "mxu_split":
+            # limb halves are bf16-exact (<= 127 / <= 63), so two
+            # DEFAULT-precision (single-pass) matmuls are exact
+            comb_i = comb.astype(jnp.int32)
+            lo = (comb_i & 0x7F).astype(jnp.float32)
+            hi = (comb_i >> 7).astype(jnp.float32)
+            sel_lo = jnp.einsum("jlw,jwb->jlb", lo, onehot_s).astype(jnp.int32)
+            sel_hi = jnp.einsum("jlw,jwb->jlb", hi, onehot_s).astype(jnp.int32)
+            csel = (sel_hi << 7) + sel_lo
+        else:
+            # default "mxu": HIGHEST precision — default-precision TPU
+            # matmuls truncate f32 operands to bf16 (8-bit mantissa),
+            # which corrupts 13-bit limbs; the 3-pass f32 form is exact
+            csel = jnp.einsum(
+                "jlw,jwb->jlb", comb, onehot_s, precision=lax.Precision.HIGHEST
+            ).astype(jnp.int32)
+    csel = csel.reshape((NWINDOWS, 3, NLIMB) + sw.shape[1:])  # [64, 3, 20, B]
 
     zero = _batch_zero(sw)
     acc0_h = pt_identity(sw.shape[1:]) + zero
     acc0_s = pt_identity(sw.shape[1:]) + zero
-
-    def comb_entry(tj, w):
-        """Select comb window entries for digits w: [60,16] x [B] ->
-        [3, 20, B] int32 (strategy per _COMB_SELECT, see header)."""
-        if _COMB_SELECT == "vpu":
-            onehot_i = (
-                w[None, :] == jnp.arange(16, dtype=w.dtype)[:, None]
-            ).astype(jnp.int32)  # [16, B]
-            return jnp.sum(
-                tj.astype(jnp.int32)[:, :, None] * onehot_i[None, :, :],
-                axis=1,
-            ).reshape((3, NLIMB) + w.shape)
-        onehot = (
-            w[None, :] == jnp.arange(16, dtype=w.dtype)[:, None]
-        ).astype(jnp.float32)  # [16, B]
-        if _COMB_SELECT == "mxu_split":
-            # limb halves are bf16-exact (<= 127 / <= 63), so two
-            # DEFAULT-precision (single-pass) matmuls are exact
-            tji = tj.astype(jnp.int32)
-            lo = (tji & 0x7F).astype(jnp.float32)
-            hi = (tji >> 7).astype(jnp.float32)
-            sel_lo = jnp.matmul(lo, onehot).astype(jnp.int32)
-            sel_hi = jnp.matmul(hi, onehot).astype(jnp.int32)
-            return ((sel_hi << 7) + sel_lo).reshape((3, NLIMB) + w.shape)
-        # default "mxu": HIGHEST precision — default-precision TPU
-        # matmuls truncate f32 operands to bf16 (8-bit mantissa), which
-        # corrupts 13-bit limbs; the 3-pass f32 form is exact
-        return (
-            jnp.matmul(tj, onehot, precision=lax.Precision.HIGHEST)
-            .astype(jnp.int32)
-            .reshape((3, NLIMB) + w.shape)
-        )
 
     def body(j, accs):
         acc_h, acc_s = accs
         # [h](-A): MSB-first windows, 4 doublings + 1 cached add
         for _ in range(WINDOW):
             acc_h = pt_double(acc_h)
-        d = lax.dynamic_index_in_dim(hd, NWINDOWS - 1 - j, axis=0, keepdims=False)
-        acc_h = pt_add_cached(acc_h, _select_cached(htbl, d))
-        # [S]B: comb window j, one-hot table select + mixed add
-        tj = lax.dynamic_index_in_dim(comb, j, axis=0, keepdims=False)  # [60, 16]
-        w = lax.dynamic_index_in_dim(sw, j, axis=0, keepdims=False)  # [B]
-        acc_s = pt_add_mixed(acc_s, comb_entry(tj, w))
+        hs = lax.dynamic_index_in_dim(
+            hsel, NWINDOWS - 1 - j, axis=0, keepdims=False
+        )
+        acc_h = pt_add_cached(acc_h, hs)
+        # [S]B: comb window j, mixed add of the pre-selected entry
+        cs = lax.dynamic_index_in_dim(csel, j, axis=0, keepdims=False)
+        acc_s = pt_add_mixed(acc_s, cs)
         return acc_h, acc_s
 
     if _UNROLL > 1:
