@@ -31,9 +31,13 @@ if os.path.exists(_TUNING):
         # read every value BEFORE setting any env var: a partial tuning
         # file must not apply a half-tuned (never-measured) combination
         _unroll, _comb = str(int(_t["unroll"])), str(_t["comb"])
+        _hoist = str(int(_t.get("hoist", 0)))
+        _group = str(int(_t.get("group", 1)))
         _TUNED_BATCH = str(int(_t["batch"]))
         os.environ.setdefault("STELLARD_VERIFY_UNROLL", _unroll)
         os.environ.setdefault("STELLARD_COMB_SELECT", _comb)
+        os.environ.setdefault("STELLARD_HOIST_SELECT", _hoist)
+        os.environ.setdefault("STELLARD_GROUP_OPS", _group)
     except (ValueError, KeyError, TypeError, OSError):
         _TUNED_BATCH = None  # malformed tuning file: run with defaults
 

@@ -90,6 +90,19 @@ _UNROLL = int(os.environ.get("STELLARD_VERIFY_UNROLL", "1"))
 #               converts, ~960 lane mult-adds per window)
 _COMB_SELECT = os.environ.get("STELLARD_COMB_SELECT", "mxu")
 
+# hoist ALL 64 window selections of both scalar walks out of the loop
+# into two wide contractions (1) vs select per-iteration inside the loop
+# (0). Hoisting materialises [64, 4, 20, B] / [64, 3, 20, B] selected-
+# window tensors in HBM; measured on-chip (r4) that LOSES to in-loop
+# selection and the gap grows with batch (16384: 63.7k vs 99.9k sigs/s),
+# so the default is the measured winner. Kept as a knob because the
+# op-count model says it should win — future XLA versions may differ.
+_HOIST_SELECT = os.environ.get("STELLARD_HOIST_SELECT", "0") == "1"
+
+# merge the 3-4 independent field muls/squares inside each point formula
+# into one wider op (concat along the batch axis) — fewer, wider ops.
+_GROUP_OPS = os.environ.get("STELLARD_GROUP_OPS", "1") == "1"
+
 
 # --------------------------------------------------------------------------
 # point helpers
@@ -124,8 +137,8 @@ def _mul_many(pairs):
     K-times-wider op at the same lane-op count. All operands must share
     one shape [20, *batch]."""
     k = len(pairs)
-    if k == 1:
-        return [fe_mul(pairs[0][0], pairs[0][1])]
+    if k == 1 or not _GROUP_OPS:
+        return [fe_mul(a, b) for a, b in pairs]
     n = pairs[0][0].shape[-1]
     a = jnp.concatenate([p[0] for p in pairs], axis=-1)
     b = jnp.concatenate([p[1] for p in pairs], axis=-1)
@@ -136,8 +149,8 @@ def _mul_many(pairs):
 def _square_many(xs):
     """K independent field squarings as ONE wide squaring (see
     _mul_many)."""
-    if len(xs) == 1:
-        return [fe_square(xs[0])]
+    if len(xs) == 1 or not _GROUP_OPS:
+        return [fe_square(x) for x in xs]
     n = xs[0].shape[-1]
     c = fe_square(jnp.concatenate(xs, axis=-1))
     return [c[..., i * n : (i + 1) * n] for i in range(len(xs))]
@@ -384,45 +397,90 @@ def verify_kernel(a_words, r_words, s_windows, h_digits, s_canonical):
     hd = jnp.transpose(h_digits)
 
     a_point, a_valid = pt_decompress(aw)
-    htbl = _build_cached_table_signed(pt_neg(a_point))  # [17, 4, 20, B]
     comb = jnp.asarray(_comb_table_np())  # [64, 60, 16] f32
 
-    # Hoisted window selections: ALL 64 windows of both scalar walks are
-    # selected before the loop in two wide contractions, so the loop body
-    # is pure point arithmetic. (In-loop one-hot selects were ~35% of the
-    # op count; a TPU core runs ops serially, so fewer+wider wins.)
-    # [h](-A) windows, MSB-first over the signed table:
-    onehot_h = (
-        hd[:, None, :] == (jnp.arange(17, dtype=hd.dtype) - 8)[None, :, None]
-    ).astype(jnp.int32)  # [64, 17, B]
-    hsel = jnp.einsum("wsb,scdb->wcdb", onehot_h, htbl)  # [64, 4, 20, B]
-    # [S]B comb windows (strategy per _COMB_SELECT, see header):
-    if _COMB_SELECT == "vpu":
-        onehot_i = (
-            sw[:, None, :] == jnp.arange(16, dtype=sw.dtype)[None, :, None]
-        ).astype(jnp.int32)  # [64, 16, B]
-        csel = jnp.einsum("jlw,jwb->jlb", comb.astype(jnp.int32), onehot_i)
-    else:
-        onehot_s = (
-            sw[:, None, :] == jnp.arange(16, dtype=sw.dtype)[None, :, None]
-        ).astype(jnp.float32)  # [64, 16, B]
+    def comb_entry(tj, w):
+        """Select comb window entries for digits w: [60,16] x [B] ->
+        [3, 20, B] int32 (strategy per _COMB_SELECT, see header)."""
+        if _COMB_SELECT == "vpu":
+            onehot_i = (
+                w[None, :] == jnp.arange(16, dtype=w.dtype)[:, None]
+            ).astype(jnp.int32)  # [16, B]
+            return jnp.sum(
+                tj.astype(jnp.int32)[:, :, None] * onehot_i[None, :, :],
+                axis=1,
+            ).reshape((3, NLIMB) + w.shape)
+        onehot = (
+            w[None, :] == jnp.arange(16, dtype=w.dtype)[:, None]
+        ).astype(jnp.float32)  # [16, B]
         if _COMB_SELECT == "mxu_split":
             # limb halves are bf16-exact (<= 127 / <= 63), so two
             # DEFAULT-precision (single-pass) matmuls are exact
-            comb_i = comb.astype(jnp.int32)
-            lo = (comb_i & 0x7F).astype(jnp.float32)
-            hi = (comb_i >> 7).astype(jnp.float32)
-            sel_lo = jnp.einsum("jlw,jwb->jlb", lo, onehot_s).astype(jnp.int32)
-            sel_hi = jnp.einsum("jlw,jwb->jlb", hi, onehot_s).astype(jnp.int32)
-            csel = (sel_hi << 7) + sel_lo
-        else:
-            # default "mxu": HIGHEST precision — default-precision TPU
-            # matmuls truncate f32 operands to bf16 (8-bit mantissa),
-            # which corrupts 13-bit limbs; the 3-pass f32 form is exact
+            tji = tj.astype(jnp.int32)
+            lo = (tji & 0x7F).astype(jnp.float32)
+            hi = (tji >> 7).astype(jnp.float32)
+            sel_lo = jnp.matmul(lo, onehot).astype(jnp.int32)
+            sel_hi = jnp.matmul(hi, onehot).astype(jnp.int32)
+            return ((sel_hi << 7) + sel_lo).reshape((3, NLIMB) + w.shape)
+        # default "mxu": HIGHEST precision — default-precision TPU
+        # matmuls truncate f32 operands to bf16 (8-bit mantissa), which
+        # corrupts 13-bit limbs; the 3-pass f32 form is exact
+        return (
+            jnp.matmul(tj, onehot, precision=lax.Precision.HIGHEST)
+            .astype(jnp.int32)
+            .reshape((3, NLIMB) + w.shape)
+        )
+
+    if _HOIST_SELECT:
+        # Hoisted window selections: ALL 64 windows of both scalar walks
+        # selected before the loop in two wide contractions, so the loop
+        # body is pure point arithmetic. Measured on-chip (r4) this
+        # LOSES — the [64, ., 20, B] selected-window tensors live in HBM
+        # and the loop re-reads them — but the knob stays for A/B.
+        htbl = _build_cached_table_signed(pt_neg(a_point))  # [17,4,20,B]
+        onehot_h = (
+            hd[:, None, :]
+            == (jnp.arange(17, dtype=hd.dtype) - 8)[None, :, None]
+        ).astype(jnp.int32)  # [64, 17, B]
+        hsel = jnp.einsum("wsb,scdb->wcdb", onehot_h, htbl)  # [64,4,20,B]
+        # [S]B comb windows in one wide contraction (all 64 at once):
+        if _COMB_SELECT == "vpu":
+            onehot_i = (
+                sw[:, None, :]
+                == jnp.arange(16, dtype=sw.dtype)[None, :, None]
+            ).astype(jnp.int32)  # [64, 16, B]
             csel = jnp.einsum(
-                "jlw,jwb->jlb", comb, onehot_s, precision=lax.Precision.HIGHEST
-            ).astype(jnp.int32)
-    csel = csel.reshape((NWINDOWS, 3, NLIMB) + sw.shape[1:])  # [64, 3, 20, B]
+                "jlw,jwb->jlb", comb.astype(jnp.int32), onehot_i
+            )
+        else:
+            onehot_s = (
+                sw[:, None, :]
+                == jnp.arange(16, dtype=sw.dtype)[None, :, None]
+            ).astype(jnp.float32)  # [64, 16, B]
+            if _COMB_SELECT == "mxu_split":
+                comb_i = comb.astype(jnp.int32)
+                lo = (comb_i & 0x7F).astype(jnp.float32)
+                hi = (comb_i >> 7).astype(jnp.float32)
+                sel_lo = jnp.einsum(
+                    "jlw,jwb->jlb", lo, onehot_s
+                ).astype(jnp.int32)
+                sel_hi = jnp.einsum(
+                    "jlw,jwb->jlb", hi, onehot_s
+                ).astype(jnp.int32)
+                csel = (sel_hi << 7) + sel_lo
+            else:
+                csel = jnp.einsum(
+                    "jlw,jwb->jlb",
+                    comb,
+                    onehot_s,
+                    precision=lax.Precision.HIGHEST,
+                ).astype(jnp.int32)
+        csel = csel.reshape(
+            (NWINDOWS, 3, NLIMB) + sw.shape[1:]
+        )  # [64, 3, 20, B]
+    else:
+        htbl = _build_cached_table(pt_neg(a_point))  # [9, 4, 20, B]
+        hsel = csel = None
 
     zero = _batch_zero(sw)
     acc0_h = pt_identity(sw.shape[1:]) + zero
@@ -433,12 +491,21 @@ def verify_kernel(a_words, r_words, s_windows, h_digits, s_canonical):
         # [h](-A): MSB-first windows, 4 doublings + 1 cached add
         for _ in range(WINDOW):
             acc_h = pt_double(acc_h)
-        hs = lax.dynamic_index_in_dim(
-            hsel, NWINDOWS - 1 - j, axis=0, keepdims=False
-        )
+        if _HOIST_SELECT:
+            hs = lax.dynamic_index_in_dim(
+                hsel, NWINDOWS - 1 - j, axis=0, keepdims=False
+            )
+            cs = lax.dynamic_index_in_dim(csel, j, axis=0, keepdims=False)
+        else:
+            d = lax.dynamic_index_in_dim(
+                hd, NWINDOWS - 1 - j, axis=0, keepdims=False
+            )
+            hs = _select_cached(htbl, d)
+            tj = lax.dynamic_index_in_dim(comb, j, axis=0, keepdims=False)
+            w = lax.dynamic_index_in_dim(sw, j, axis=0, keepdims=False)
+            cs = comb_entry(tj, w)
         acc_h = pt_add_cached(acc_h, hs)
-        # [S]B: comb window j, mixed add of the pre-selected entry
-        cs = lax.dynamic_index_in_dim(csel, j, axis=0, keepdims=False)
+        # [S]B: comb window j, mixed add of the selected entry
         acc_s = pt_add_mixed(acc_s, cs)
         return acc_h, acc_s
 

@@ -40,17 +40,19 @@ def ensure_sigset():
              msgs=np.frombuffer(b"".join(msgs), np.uint8).reshape(N,32),
              sigs=np.frombuffer(b"".join(sigs), np.uint8).reshape(N,64))
 
-def one_config(unroll, batches, comb="mxu"):
-    """Run one (unroll, comb-select, batches) measurement in a
-    SUBPROCESS so each tunnel session is fresh and a wedge can't kill
-    the sweep. Inputs are cycled across distinct sets so no layer can
-    memoize identical submissions."""
+def one_config(unroll, batches, comb="mxu", hoist=0, group=1):
+    """Run one (unroll, comb-select, hoist, group, batches) measurement
+    in a SUBPROCESS so each tunnel session is fresh and a wedge can't
+    kill the sweep. Inputs are cycled across distinct sets so no layer
+    can memoize identical submissions."""
     code = f'''
 import os, sys, time
 import numpy as np
 os.environ.pop("JAX_PLATFORMS", None)
 os.environ["STELLARD_VERIFY_UNROLL"] = "{unroll}"
 os.environ["STELLARD_COMB_SELECT"] = "{comb}"
+os.environ["STELLARD_HOIST_SELECT"] = "{hoist}"
+os.environ["STELLARD_GROUP_OPS"] = "{group}"
 sys.path.insert(0, {REPO!r})
 import jax
 assert jax.devices()[0].platform != "cpu", "no tpu"
@@ -79,13 +81,13 @@ for batch in {batches}:
             [z["sigs"][i].tobytes() for i in idx],
         ))
     t0=time.time(); out = verify_kernel(**sets[0]); out.block_until_ready()
-    print(f"unroll={unroll} comb={comb} batch={{batch}} compile {{time.time()-t0:.0f}}s", flush=True)
+    print(f"unroll={unroll} comb={comb} hoist={hoist} group={group} batch={{batch}} compile {{time.time()-t0:.0f}}s", flush=True)
     assert np.asarray(out).all()
     t0=time.time(); n=0
     while time.time()-t0 < 5:
         verify_kernel(**sets[n % len(sets)]).block_until_ready(); n+=1
     dt=(time.time()-t0)/n
-    print(f"RESULT unroll={unroll} comb={comb} batch={{batch}} lat={{dt*1000:.1f}}ms rate={{batch/dt:,.0f}} sigs/s", flush=True)
+    print(f"RESULT unroll={unroll} comb={comb} hoist={hoist} group={group} batch={{batch}} lat={{dt*1000:.1f}}ms rate={{batch/dt:,.0f}} sigs/s", flush=True)
 '''
     try:
         r = subprocess.run([sys.executable, "-c", code], capture_output=True,
@@ -105,6 +107,8 @@ for batch in {batches}:
                 RESULTS.append({
                     "unroll": int(kv["unroll"]),
                     "comb": kv["comb"],
+                    "hoist": int(kv.get("hoist", 0)),
+                    "group": int(kv.get("group", 1)),
                     "batch": int(kv["batch"]),
                     "rate": float(kv["rate"].replace(",", "")),
                 })
@@ -167,11 +171,13 @@ def write_tuning():
         json.dump({
             "unroll": best["unroll"],
             "comb": best["comb"],
+            "hoist": best.get("hoist", 0),
+            "group": best.get("group", 1),
             "batch": best["batch"],
             "rate": best["rate"],
             "all": RESULTS,
             "note": "measured by tools/kernel_sweep.py on the current "
-                    "kernel source (rowpad + hoisted selects)",
+                    "kernel source (rowpad fe_mul; hoist/group gates)",
         }, f, indent=1)
     os.replace(tmp, TUNING_PATH)
     print(f"TUNING -> {TUNING_PATH}: unroll={best['unroll']} "
@@ -185,11 +191,18 @@ if __name__ == "__main__":
     # 4096/8192/16384/32768; unroll>1 measured flat, so the sweep
     # focuses on batch scaling + comb A/B for the hoisted form).
     ensure_sigset()
-    one_config(1, [4096, 8192, 16384])
-    one_config(1, [32768, 65536])
+    # A/B the two r4 graph transforms against the measured 99.9k@16384
+    # baseline (rowpad, in-loop select, ungrouped = hoist 0 / group 0):
+    one_config(1, [16384], hoist=0, group=0)   # reproduce the winner
+    one_config(1, [16384], hoist=0, group=1)   # grouping alone
+    # (hoist=1 group=1 measured 2026-07-31: 41.7k/57.7k/63.7k at
+    # 4096/8192/16384 — the hoisted form loses, see PERF.md)
+    # in-loop comb-select strategies, never yet A/B'd on-chip:
     one_config(1, [16384], comb="mxu_split")
     one_config(1, [16384], comb="vpu")
-    one_config(2, [16384])
+    # batch scaling at the best shape so far:
+    one_config(1, [32768, 65536], group=0)
+    one_config(1, [32768], group=1)
     write_tuning()  # before the (slow) tree bench: a wedge must not lose it
     tree_hash_bench()
     print("SWEEP DONE", flush=True)
