@@ -32,7 +32,7 @@ if os.path.exists(_TUNING):
         # file must not apply a half-tuned (never-measured) combination
         _unroll, _comb = str(int(_t["unroll"])), str(_t["comb"])
         _hoist = str(int(_t.get("hoist", 0)))
-        _group = str(int(_t.get("group", 1)))
+        _group = str(int(_t.get("group", 0)))
         _TUNED_BATCH = str(int(_t["batch"]))
         os.environ.setdefault("STELLARD_VERIFY_UNROLL", _unroll)
         os.environ.setdefault("STELLARD_COMB_SELECT", _comb)

@@ -14,6 +14,8 @@ implementation coalesced JobQueue-style verification batches run on.
 
 from __future__ import annotations
 
+import os
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Callable, Optional, Sequence
@@ -341,9 +343,18 @@ class TpuHasher(BatchHasher):
 
     # -- whole-tree pipeline ----------------------------------------------
 
-    def hash_tree(self, root) -> int:
+    def hash_tree(self, root, cancelled=None, cancel_lock=None) -> int:
         """Fill every missing node hash in a SHAMap with device-resident
-        level-synchronous hashing. Returns the number of nodes hashed."""
+        level-synchronous hashing. Returns the number of nodes hashed.
+
+        ``cancelled``/``cancel_lock`` (threading.Event/Lock, optional,
+        supplied together by the watchdog — utils.devicewatch): the
+        write-back runs check-then-stamp as ONE critical section under
+        ``cancel_lock``, and the watchdog sets ``cancelled`` under the
+        same lock before it starts any host fallback. Either this call
+        stamps the whole tree before the fallback begins, or it stamps
+        nothing — an abandoned (zombie) call can never interleave writes
+        with the fallback's traversal."""
         import jax.numpy as jnp
 
         from ..ops.sha512_jax import padded_block_count
@@ -456,12 +467,16 @@ class TpuHasher(BatchHasher):
                 )
 
         host = np.asarray(buf)  # ONE transfer; blocks on the whole chain
-        raw = host.astype(">u4").tobytes()
-        for level in levels:
-            for node in level:
-                if node._hash is None:
-                    row = index_of[id(node)]
-                    node._hash = raw[row * 32 : row * 32 + 32]
+        lock = cancel_lock if cancel_lock is not None else threading.Lock()
+        with lock:
+            if cancelled is not None and cancelled.is_set():
+                return 0  # abandoned by the watchdog: tree untouched
+            raw = host.astype(">u4").tobytes()
+            for level in levels:
+                for node in level:
+                    if node._hash is None:
+                        row = index_of[id(node)]
+                        node._hash = raw[row * 32 : row * 32 + 32]
         self.host_nodes += hashed_host
         self.device_nodes += len(index_of)
         return hashed_host + len(index_of)
@@ -495,3 +510,120 @@ class CppHasher(BatchHasher):
 # a toolchain-less box, and the (one-time) native build cost lands only
 # on callers that actually select the cpp backend — never at import
 register_hasher("cpp", CppHasher)
+
+
+class WatchdogHasher(BatchHasher):
+    """Run a device hasher's calls under a wedge deadline with a CPU
+    fallback (utils.devicewatch): the observed tunnel failure mode is an
+    indefinite hang, and a frozen tree-hash would freeze every ledger
+    close. One overrun routes hashing to the fallback for the life of
+    the process (sticky, shared with the verify plane's verdict).
+
+    Deadlines: ``prefix_hash_batch`` warms per pow-of-2 batch bucket
+    (the device hasher compiles one program per padded size);
+    ``hash_tree`` ALWAYS gets the generous compile deadline — its
+    program shapes follow the tree's per-level sizes, which grow with
+    the ledger, so no call is provably recompile-free and a tight
+    deadline would falsely kill a healthy device mid-compile.
+    """
+
+    def __init__(self, inner: BatchHasher, fallback: BatchHasher,
+                 first_timeout: Optional[float] = None,
+                 warm_timeout: Optional[float] = None):
+        from ..utils.devicewatch import resolve_timeouts
+
+        self.inner = inner
+        self.fallback = fallback
+        self.name = inner.name
+        self._t_first, self._t_warm = resolve_timeouts(
+            first_timeout, warm_timeout
+        )
+        self._warm_buckets: set[int] = set()
+        self.device_wedged = False
+
+    @property
+    def device_nodes(self):  # type: ignore[override]
+        return self.inner.device_nodes
+
+    @property
+    def host_nodes(self):  # type: ignore[override]
+        return self.inner.host_nodes + self.fallback.host_nodes
+
+    def _wedge(self, exc: Exception) -> None:
+        from ..utils.devicewatch import log as dlog
+
+        self.device_wedged = True
+        dlog.error("hash plane: %s — falling back to host hashing", exc)
+
+    def prefix_hash_batch(self, prefixes, payloads):
+        from ..utils.devicewatch import DeviceWedged, call_with_deadline
+
+        if not self.device_wedged:
+            bucket = 1 << max(0, (len(payloads) - 1)).bit_length()
+            deadline = (
+                self._t_warm
+                if bucket in self._warm_buckets
+                else self._t_first
+            )
+            try:
+                out = call_with_deadline(
+                    lambda: self.inner.prefix_hash_batch(prefixes, payloads),
+                    deadline, label="hash-device",
+                )
+                self._warm_buckets.add(bucket)
+                return out
+            except DeviceWedged as exc:
+                self._wedge(exc)
+        return self.fallback.prefix_hash_batch(prefixes, payloads)
+
+    def _host_tree(self, root) -> int:
+        """Level-batched host hashing. When the device is healthy this
+        still routes through the WATCHED prefix path (so e.g. a native
+        cpp inner without hash_tree is used, watchdogged, for the
+        dominant tree workload); once wedged it goes straight to the
+        fallback."""
+        from ..state.shamap import compute_hashes
+
+        if self.device_wedged:
+            return compute_hashes(root, self.fallback)
+        # plain callable (no hash_tree attr): compute_hashes level-batches
+        return compute_hashes(
+            root, lambda p, d: self.prefix_hash_batch(p, d)
+        )
+
+    def hash_tree(self, root) -> int:
+        from ..utils.devicewatch import DeviceWedged, call_with_deadline
+
+        inner_tree = getattr(self.inner, "hash_tree", None)
+        if inner_tree is None:
+            return self._host_tree(root)
+        if not self.device_wedged:
+            import inspect
+
+            params = inspect.signature(inner_tree).parameters
+            cancel = threading.Event() if "cancelled" in params else None
+            lock = threading.Lock() if "cancel_lock" in params else None
+            kwargs = {}
+            if cancel is not None:
+                kwargs["cancelled"] = cancel
+            if lock is not None:
+                kwargs["cancel_lock"] = lock
+            try:
+                return call_with_deadline(
+                    lambda: inner_tree(root, **kwargs), self._t_first,
+                    label="hash-device",
+                )
+            except DeviceWedged as exc:
+                # Close the zombie race BEFORE any host work touches the
+                # tree: setting cancelled under the shared lock means the
+                # abandoned call either already stamped the whole tree
+                # (its critical section completed first — the fallback
+                # then finds nothing to hash) or will stamp nothing.
+                if cancel is not None:
+                    if lock is not None:
+                        with lock:
+                            cancel.set()
+                    else:
+                        cancel.set()
+                self._wedge(exc)
+        return self._host_tree(root)

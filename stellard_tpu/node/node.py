@@ -127,8 +127,15 @@ class Node:
         )
         self.clf = CLFMirror(LedgerSqlDatabase(clf_path))
 
-        # crypto plane (north star: pluggable cpu|tpu batch backends)
+        # crypto plane (north star: pluggable cpu|tpu batch backends).
+        # Device hashers run under the wedge watchdog: the tunnel's
+        # failure mode is an indefinite hang, and a frozen tree-hash
+        # would freeze every ledger close (utils/devicewatch.py).
         self.hasher = make_hasher(cfg.hash_backend)
+        if cfg.hash_backend != "cpu":
+            from ..crypto.backend import WatchdogHasher
+
+            self.hasher = WatchdogHasher(self.hasher, make_hasher("cpu"))
         self.verify_plane = VerifyPlane(
             backend=cfg.signature_backend,
             window_ms=cfg.verify_batch_window_ms,

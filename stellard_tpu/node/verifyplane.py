@@ -25,6 +25,7 @@ Callers either:
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from concurrent.futures import Future
@@ -33,6 +34,13 @@ from typing import Optional, Sequence
 import numpy as np
 
 from ..crypto.backend import BatchVerifier, VerifyRequest, make_verifier
+from ..utils.devicewatch import (
+    DeviceWedged,
+    call_with_deadline,
+    resolve_timeouts,
+)
+
+log = logging.getLogger("stellard.device")
 
 __all__ = ["VerifyPlane"]
 
@@ -143,6 +151,8 @@ class VerifyPlane:
         max_batch: int = 16384,
         min_device_batch: int = 64,
         cpu_fallback: Optional[BatchVerifier] = None,
+        device_first_timeout: Optional[float] = None,
+        device_warm_timeout: Optional[float] = None,
     ):
         self.backend_name = backend
         self.verifier: BatchVerifier = make_verifier(backend)
@@ -154,6 +164,17 @@ class VerifyPlane:
         self.min_device_batch = min_device_batch
         self.model = _LatencyModel(min_device_batch)
         self._device_capable = backend != "cpu"
+        # device-wedge watchdog deadlines (utils.devicewatch): the first
+        # call to a pad-bucket shape legitimately compiles (~1-3 min on
+        # chip), so unseen shapes get the generous deadline and warmed
+        # shapes the tight one. On overrun the device is dead for the
+        # process and every batch (including the stalled one, re-run on
+        # the CPU side) still gets verified.
+        self._t_first, self._t_warm = resolve_timeouts(
+            device_first_timeout, device_warm_timeout
+        )
+        self._warm_buckets: set[int] = set()
+        self.device_wedged = False
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -228,25 +249,65 @@ class VerifyPlane:
                 hist[i] += 1
                 break
 
+    def _pad_buckets(self, n: int) -> set[int]:
+        """Pad-bucket shapes the device verifier will compile for a batch
+        of n (one chunk per max_batch, each padded per its own policy)."""
+        pad = getattr(self.verifier, "_pad_size", None)
+        lo = getattr(self.verifier, "min_batch", self.min_device_batch)
+        hi = getattr(self.verifier, "max_batch", self.max_batch)
+        buckets = set()
+        for start in range(0, n, hi):
+            chunk = min(hi, n - start)
+            buckets.add(pad(chunk, lo, hi) if pad else chunk)
+        return buckets
+
+    def _device_deadline(self, n: int) -> float:
+        """Generous while any chunk's pad-bucket shape is uncompiled,
+        tight (per chunk) once every shape is warm."""
+        if self._pad_buckets(n) - self._warm_buckets:
+            return self._t_first
+        hi = getattr(self.verifier, "max_batch", self.max_batch)
+        nchunks = max(1, -(-n // max(1, hi)))
+        return self._t_warm * nchunks
+
+    def _mark_warm(self, n: int) -> None:
+        self._warm_buckets |= self._pad_buckets(n)
+
     def verify_many(self, reqs: Sequence[VerifyRequest]) -> np.ndarray:
         if not reqs:
             return np.zeros(0, bool)
         n = len(reqs)
         use_device = self._device_capable and self.model.use_device(n)
-        verifier = self.verifier if use_device else self.cpu
-        t0 = time.perf_counter()
-        out = verifier.verify_batch(reqs)
-        ms = (time.perf_counter() - t0) * 1000.0
         if use_device:
-            self.model.observe_device(n, ms)
-            self.device_batches += 1
-            self.device_sigs += n
-            self._record("device", ms)
-        else:
-            self.model.observe_cpu(n, ms)
-            self.cpu_batches += 1
-            self.cpu_sigs += n
-            self._record("cpu", ms)
+            t0 = time.perf_counter()
+            try:
+                out = call_with_deadline(
+                    lambda: self.verifier.verify_batch(reqs),
+                    self._device_deadline(n),
+                    label="verify-device",
+                )
+                ms = (time.perf_counter() - t0) * 1000.0
+                self._mark_warm(n)
+                self.model.observe_device(n, ms)
+                self.device_batches += 1
+                self.device_sigs += n
+                self._record("device", ms)
+                self.batches += 1
+                self.verified += n
+                return out
+            except DeviceWedged as exc:
+                # wedged tunnel: device plane is dead for the process;
+                # this batch (and all future ones) verifies on the CPU
+                self._device_capable = False
+                self.device_wedged = True
+                log.error("verify plane: %s — falling back to CPU", exc)
+        t0 = time.perf_counter()
+        out = self.cpu.verify_batch(reqs)
+        ms = (time.perf_counter() - t0) * 1000.0
+        self.model.observe_cpu(n, ms)
+        self.cpu_batches += 1
+        self.cpu_sigs += n
+        self._record("cpu", ms)
         self.batches += 1
         self.verified += n
         return out
@@ -276,6 +337,7 @@ class VerifyPlane:
             "cpu_batches": self.cpu_batches,
             "device_sigs": self.device_sigs,
             "cpu_sigs": self.cpu_sigs,
+            "device_wedged": self.device_wedged,
             "device_share": (
                 round(self.device_sigs / self.verified, 4)
                 if self.verified

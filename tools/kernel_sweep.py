@@ -40,7 +40,7 @@ def ensure_sigset():
              msgs=np.frombuffer(b"".join(msgs), np.uint8).reshape(N,32),
              sigs=np.frombuffer(b"".join(sigs), np.uint8).reshape(N,64))
 
-def one_config(unroll, batches, comb="mxu", hoist=0, group=1):
+def one_config(unroll, batches, comb="mxu", hoist=0, group=0):
     """Run one (unroll, comb-select, hoist, group, batches) measurement
     in a SUBPROCESS so each tunnel session is fresh and a wedge can't
     kill the sweep. Inputs are cycled across distinct sets so no layer
@@ -93,7 +93,9 @@ for batch in {batches}:
         r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                            text=True, timeout=1500)
     except subprocess.TimeoutExpired:
-        print(f"unroll={unroll}: TIMED OUT (wedged tunnel?) — skipping", flush=True)
+        print(f"unroll={unroll} comb={comb} hoist={hoist} group={group} "
+              f"batches={batches}: TIMED OUT (wedged tunnel?) — skipping",
+              flush=True)
         return False
     out = "\n".join(l for l in (r.stdout + r.stderr).splitlines()
                     if "WARNING" not in l and l.strip())
@@ -108,7 +110,7 @@ for batch in {batches}:
                     "unroll": int(kv["unroll"]),
                     "comb": kv["comb"],
                     "hoist": int(kv.get("hoist", 0)),
-                    "group": int(kv.get("group", 1)),
+                    "group": int(kv.get("group", 0)),
                     "batch": int(kv["batch"]),
                     "rate": float(kv["rate"].replace(",", "")),
                 })
@@ -172,7 +174,7 @@ def write_tuning():
             "unroll": best["unroll"],
             "comb": best["comb"],
             "hoist": best.get("hoist", 0),
-            "group": best.get("group", 1),
+            "group": best.get("group", 0),
             "batch": best["batch"],
             "rate": best["rate"],
             "all": RESULTS,
