@@ -33,11 +33,15 @@ if os.path.exists(_TUNING):
         _unroll, _comb = str(int(_t["unroll"])), str(_t["comb"])
         _hoist = str(int(_t.get("hoist", 0)))
         _group = str(int(_t.get("group", 0)))
+        _impl = str(_t.get("impl", "xla"))
+        _block = str(int(_t.get("block", 512)))
         _TUNED_BATCH = str(int(_t["batch"]))
         os.environ.setdefault("STELLARD_VERIFY_UNROLL", _unroll)
         os.environ.setdefault("STELLARD_COMB_SELECT", _comb)
         os.environ.setdefault("STELLARD_HOIST_SELECT", _hoist)
         os.environ.setdefault("STELLARD_GROUP_OPS", _group)
+        os.environ.setdefault("STELLARD_VERIFY_IMPL", _impl)
+        os.environ.setdefault("STELLARD_PALLAS_BLOCK", _block)
     except (ValueError, KeyError, TypeError, OSError):
         _TUNED_BATCH = None  # malformed tuning file: run with defaults
 

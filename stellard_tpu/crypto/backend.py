@@ -209,6 +209,14 @@ class TpuVerifier(BatchVerifier):
 
         from ..ops.ed25519_jax import verify_kernel
 
+        if os.environ.get("STELLARD_VERIFY_IMPL", "xla") == "pallas":
+            # whole-verify-in-VMEM Pallas kernel (ops/ed25519_pallas.py);
+            # single-chip only — mesh mode shards the XLA formulation
+            from ..ops.ed25519_pallas import verify_kernel_pallas
+
+            self._kernel = verify_kernel_pallas
+            return self._kernel
+
         devices = jax.devices()
         want_mesh = (
             self._use_mesh
@@ -519,12 +527,16 @@ class WatchdogHasher(BatchHasher):
     close. One overrun routes hashing to the fallback for the life of
     the process (sticky, shared with the verify plane's verdict).
 
-    Deadlines: ``prefix_hash_batch`` warms per pow-of-2 batch bucket
-    (the device hasher compiles one program per padded size);
-    ``hash_tree`` ALWAYS gets the generous compile deadline — its
-    program shapes follow the tree's per-level sizes, which grow with
-    the ledger, so no call is provably recompile-free and a tight
-    deadline would falsely kill a healthy device mid-compile.
+    Deadlines: every hashing call gets the GENEROUS compile deadline.
+    Unlike the verify plane (whose pad-bucket set is enumerable, so
+    warmth is provable per shape), the device hasher compiles one
+    program per (padded-batch, block-ladder) combination and tree
+    hashing per level size — none of which the wrapper can enumerate
+    from outside, so no call is provably recompile-free and a tight
+    deadline would falsely kill a healthy device mid-compile. Hashing
+    sits off the latency-critical path (closes batch it), and the
+    verify plane's tight warmed deadline still provides fast wedge
+    detection for the shared process-wide verdict.
     """
 
     def __init__(self, inner: BatchHasher, fallback: BatchHasher,
@@ -535,10 +547,7 @@ class WatchdogHasher(BatchHasher):
         self.inner = inner
         self.fallback = fallback
         self.name = inner.name
-        self._t_first, self._t_warm = resolve_timeouts(
-            first_timeout, warm_timeout
-        )
-        self._warm_buckets: set[int] = set()
+        self._t_first, _ = resolve_timeouts(first_timeout, warm_timeout)
         self.device_wedged = False
 
     @property
@@ -559,19 +568,11 @@ class WatchdogHasher(BatchHasher):
         from ..utils.devicewatch import DeviceWedged, call_with_deadline
 
         if not self.device_wedged:
-            bucket = 1 << max(0, (len(payloads) - 1)).bit_length()
-            deadline = (
-                self._t_warm
-                if bucket in self._warm_buckets
-                else self._t_first
-            )
             try:
-                out = call_with_deadline(
+                return call_with_deadline(
                     lambda: self.inner.prefix_hash_batch(prefixes, payloads),
-                    deadline, label="hash-device",
+                    self._t_first, label="hash-device",
                 )
-                self._warm_buckets.add(bucket)
-                return out
             except DeviceWedged as exc:
                 self._wedge(exc)
         return self.fallback.prefix_hash_batch(prefixes, payloads)

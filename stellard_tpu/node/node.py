@@ -132,7 +132,9 @@ class Node:
         # failure mode is an indefinite hang, and a frozen tree-hash
         # would freeze every ledger close (utils/devicewatch.py).
         self.hasher = make_hasher(cfg.hash_backend)
-        if cfg.hash_backend != "cpu":
+        if cfg.hash_backend == "tpu":
+            # only the DEVICE hasher can wedge; host backends (cpp)
+            # must not share the device verdict or pay watchdog threads
             from ..crypto.backend import WatchdogHasher
 
             self.hasher = WatchdogHasher(self.hasher, make_hasher("cpu"))

@@ -1,0 +1,236 @@
+"""Pallas TPU Ed25519 batch-verify: the whole verification fused in VMEM.
+
+Why this exists (measured, PERF.md r4): the XLA formulation's cost tracks
+its op COUNT, not its FLOPs — at production batches every [20, B]
+intermediate is megabytes, so the op sequence streams HBM between fusion
+clusters, and both "fewer, wider ops" transforms that added data movement
+(grouped point ops, hoisted window selects) measured SLOWER. The logical
+endpoint of that finding is to stop paying per-op data movement at all:
+process the batch in blocks whose entire working set (accumulators,
+cached table, comb table, every field-op temporary) stays resident in
+VMEM for the whole verification, with HBM touched only for the kernel's
+true input/output (~600 B per signature).
+
+Same math as ops.ed25519_jax.verify_kernel — rowpad fe ops (fe25519),
+9-entry cached table + signed 4-bit windows for [h](-A), fixed-base comb
+for [S]B — via the same helpers, so the differential oracle suite pins
+both. Selection one-hots are built with broadcasted_iota (TPU Pallas
+rejects 1-D iota). The comb select runs as an int32 VPU contraction
+(exact; no f32 precision carve-outs needed inside the kernel).
+
+Reference role: the batched replacement for libsodium
+crypto_sign_verify_detached in SerializedTransaction::checkSign
+(/root/reference/src/ripple_app/misc/SerializedTransaction.cpp:192-230).
+
+Knobs (read at import, like the XLA kernel's):
+  STELLARD_PALLAS_BLOCK — batch lanes per grid step (default 512).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+from .ed25519_jax import (
+    NWINDOWS,
+    WINDOW,
+    _build_cached_table,
+    _comb_table_np,
+    pt_add_cached,
+    pt_add_mixed,
+    pt_decompress,
+    pt_double,
+    pt_encode_words,
+    pt_identity,
+    pt_neg,
+    pt_stack,
+    pt_to_cached,
+)
+from .fe25519 import (
+    NLIMB,
+    const_mode,
+    const_table_np,
+    fe_neg,
+    fe_select,
+)
+
+BLOCK = int(os.environ.get("STELLARD_PALLAS_BLOCK", "512"))
+
+
+def _select_cached_iota(tbl, digit):
+    """tbl [9, 4, 20, B], digit [B] int32 in [-8, 7] -> cached entry
+    [4, 20, B]. Same as ed25519_jax._select_cached with the one-hot
+    built from broadcasted_iota (Pallas-safe)."""
+    mag = jnp.abs(digit)
+    neg = digit < 0
+    sel = lax.broadcasted_iota(jnp.int32, (9,) + mag.shape, 0)
+    onehot = (mag[None] == sel).astype(jnp.int32)  # [9, B]
+    entry = jnp.sum(onehot[:, None, None] * tbl, axis=0)  # [4, 20, B]
+    ypx, ymx, t2d, z2 = entry[0], entry[1], entry[2], entry[3]
+    return jnp.stack(
+        [
+            fe_select(neg, ymx, ypx),
+            fe_select(neg, ypx, ymx),
+            fe_select(neg, fe_neg(t2d), t2d),
+            z2,
+        ],
+        axis=0,
+    )
+
+
+def _comb_entry_iota(tj, w):
+    """tj [60, 16] int32, w [B] digits -> [3, 20, B] int32 selected niels
+    entry, as one VPU one-hot contraction (exact int32 math)."""
+    sel = lax.broadcasted_iota(jnp.int32, (16,) + w.shape, 0)
+    onehot = (w[None] == sel).astype(jnp.int32)  # [16, B]
+    picked = jnp.sum(tj[:, :, None] * onehot[None], axis=1)  # [60, B]
+    return picked.reshape((3, NLIMB) + w.shape)
+
+
+def _verify_block(aw, rw, sw, hd, sc, comb):
+    """One VMEM-resident block: aw/rw [8, B] u32, sw/hd [64, B] i32,
+    sc [B] i32, comb [64, 60, 16] i32 -> [B] i32 verdicts."""
+    a_point, a_valid = pt_decompress(aw)
+    htbl = _build_cached_table(pt_neg(a_point))  # [9, 4, 20, B]
+
+    acc0_h = pt_identity(aw.shape[1:])
+    acc0_s = pt_identity(aw.shape[1:])
+    # fe_const gives [20, 1]-style broadcastable consts; make the batch
+    # axis concrete so the fori_loop carry has a stable [4, 20, B] shape
+    zero = jnp.zeros(aw.shape[1:], jnp.int32)
+    acc0_h = acc0_h + zero
+    acc0_s = acc0_s + zero
+
+    def body(j, accs):
+        acc_h, acc_s = accs
+        for _ in range(WINDOW):
+            acc_h = pt_double(acc_h)
+        d = lax.dynamic_index_in_dim(hd, NWINDOWS - 1 - j, 0, keepdims=False)
+        acc_h = pt_add_cached(acc_h, _select_cached_iota(htbl, d))
+        tj = lax.dynamic_index_in_dim(comb, j, 0, keepdims=False)  # [60,16]
+        w = lax.dynamic_index_in_dim(sw, j, 0, keepdims=False)  # [B]
+        acc_s = pt_add_mixed(acc_s, _comb_entry_iota(tj, w))
+        return acc_h, acc_s
+
+    acc_h, acc_s = lax.fori_loop(0, NWINDOWS, body, (acc0_h, acc0_s))
+    rp = pt_add_cached(acc_s, pt_to_cached(acc_h))
+    enc = pt_encode_words(rp)
+    eq = jnp.all(enc == rw, axis=0)
+    return (eq & a_valid & (sc != 0)).astype(jnp.int32)
+
+
+def _kernel(aw_ref, rw_ref, sw_ref, hd_ref, sc_ref, comb_ref, ktab_ref,
+            out_ref):
+    # consume mode: every fe25519 [20]-limb constant the math touches is
+    # served as a row of the ktab input (Pallas cannot capture array
+    # constants); the collect trace in _ensure_const_table guarantees
+    # the table is complete before this kernel ever traces.
+    with const_mode("consume", ktab_ref[:]):
+        out = _verify_block(
+            aw_ref[:],
+            rw_ref[:],
+            sw_ref[:],
+            hd_ref[:],
+            sc_ref[0, :],
+            comb_ref[:],
+        )
+    out_ref[0, :] = out
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "nconst"))
+def _call(aw, rw, sw, hd, sc, comb, ktab, *, interpret: bool, nconst: int):
+    bp = aw.shape[1]
+    grid = bp // BLOCK
+    blk = lambda rows: pl.BlockSpec((rows, BLOCK), lambda i: (0, i))
+    return pl.pallas_call(
+        _kernel,
+        grid=(grid,),
+        in_specs=[
+            blk(8),
+            blk(8),
+            blk(64),
+            blk(64),
+            blk(1),
+            pl.BlockSpec((NWINDOWS, 60, 16), lambda i: (0, 0, 0)),
+            pl.BlockSpec((nconst, NLIMB), lambda i: (0, 0)),
+        ],
+        out_specs=blk(1),
+        out_shape=jax.ShapeDtypeStruct((1, bp), jnp.int32),
+        interpret=interpret,
+    )(aw, rw, sw, hd, sc, comb, ktab)
+
+
+_COMB_I32: np.ndarray | None = None
+_KTAB: np.ndarray | None = None
+_TRACE_LOCK = __import__("threading").Lock()
+
+
+def _comb_i32() -> np.ndarray:
+    global _COMB_I32
+    if _COMB_I32 is None:
+        _COMB_I32 = _comb_table_np().astype(np.int32)
+    return _COMB_I32
+
+
+def _ensure_const_table() -> np.ndarray:
+    """Collect-trace the block math once to enumerate every fe25519
+    constant, then freeze them as the [K, 20] kernel input. Caller must
+    hold _TRACE_LOCK."""
+    global _KTAB
+    if _KTAB is None:
+        with const_mode("collect"):
+            jax.eval_shape(
+                _verify_block,
+                jax.ShapeDtypeStruct((8, BLOCK), jnp.uint32),
+                jax.ShapeDtypeStruct((8, BLOCK), jnp.uint32),
+                jax.ShapeDtypeStruct((NWINDOWS, BLOCK), jnp.int32),
+                jax.ShapeDtypeStruct((NWINDOWS, BLOCK), jnp.int32),
+                jax.ShapeDtypeStruct((BLOCK,), jnp.int32),
+                jax.ShapeDtypeStruct((NWINDOWS, 60, 16), jnp.int32),
+            )
+            _KTAB = const_table_np()
+    return _KTAB
+
+
+def verify_kernel_pallas(a_words, r_words, s_windows, h_digits, s_canonical):
+    """Drop-in for ed25519_jax.verify_kernel (same prepare_batch inputs,
+    public batch-major layout) running the Pallas block kernel."""
+    a_words = jnp.asarray(a_words)
+    b = a_words.shape[0]
+    bp = -(-b // BLOCK) * BLOCK
+    pad = bp - b
+
+    def prep(x, dtype):
+        x = jnp.asarray(x)
+        if pad:
+            x = jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1))
+        x = x.T if x.ndim == 2 else x[None, :]
+        return x.astype(dtype)
+
+    # _TRACE_LOCK spans the collect trace AND the _call invocation: the
+    # first call per padded shape traces the Pallas kernel, whose
+    # consume-mode const_mode mutates fe25519's process-global mode —
+    # concurrent unlocked traces could restore the mode mid-trace and
+    # reintroduce captured-constant lowering errors. Execution also runs
+    # under the lock, which is moot: device calls are serialized by the
+    # plane's single flusher thread anyway.
+    with _TRACE_LOCK:
+        ktab = _ensure_const_table()
+        out = _call(
+            prep(a_words, jnp.uint32),
+            prep(r_words, jnp.uint32),
+            prep(s_windows, jnp.int32),
+            prep(h_digits, jnp.int32),
+            prep(s_canonical, jnp.int32),
+            jnp.asarray(_comb_i32()),
+            jnp.asarray(ktab),
+            interpret=jax.default_backend() == "cpu",
+            nconst=ktab.shape[0],
+        )
+    return out[0, :b].astype(bool)

@@ -41,6 +41,7 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 from jax import lax
+from contextlib import contextmanager
 
 import os
 
@@ -81,11 +82,67 @@ _P_LIMBS = int_to_limbs_np(P)
 _BIAS_LIMBS = (38 * _P_LIMBS).astype(np.int32)
 
 
+# --- constant provisioning ------------------------------------------------
+# Pallas kernels cannot capture array constants ("pass them as inputs"),
+# so every [20]-limb constant routes through _const20(). Outside a kernel
+# it just materializes the numpy array (jaxpr constant, status quo). For
+# a Pallas trace, ed25519_pallas first traces the math in COLLECT mode to
+# enumerate the distinct constants, then passes the stacked [K, 20] table
+# as a kernel input and sets CONSUME mode so _const20 returns rows of it.
+_CONST_MODE: str | None = None  # None | "collect" | "consume"
+_CONST_INDEX: dict[bytes, int] = {}
+_CONST_ROWS: list[np.ndarray] = []
+_CONST_TABLE: jnp.ndarray | None = None  # [K, 20] while consuming
+
+
+def _const20(limbs_np: np.ndarray) -> jnp.ndarray:
+    row = np.asarray(limbs_np, np.int32)
+    if _CONST_MODE is None:
+        return jnp.asarray(row)
+    key = row.tobytes()
+    idx = _CONST_INDEX.get(key)
+    if idx is None:
+        if _CONST_MODE == "consume":
+            raise KeyError(
+                "fe25519 constant not seen during the collect trace — "
+                "the Pallas const table is incomplete"
+            )
+        idx = len(_CONST_ROWS)
+        _CONST_INDEX[key] = idx
+        _CONST_ROWS.append(row)
+    if _CONST_MODE == "collect":
+        return jnp.asarray(row)
+    return _CONST_TABLE[idx]
+
+
 def _col(limbs_1d, ndim: int) -> jnp.ndarray:
     """[20] constant -> [20, 1, 1, ...] so it broadcasts against a
     limb-major [20, *batch] tensor of rank `ndim`."""
-    arr = jnp.asarray(limbs_1d)
+    arr = _const20(limbs_1d)
     return arr.reshape((NLIMB,) + (1,) * (ndim - 1)) if ndim > 1 else arr
+
+
+@contextmanager
+def const_mode(mode: str, table: jnp.ndarray | None = None):
+    """Scope the constant-provisioning mode (see _const20). ``collect``
+    records every distinct [20]-limb constant a trace touches;
+    ``consume`` serves them from ``table`` ([K, 20], normally a Pallas
+    kernel input). Traces are single-threaded per kernel build; the
+    caller (ed25519_pallas) holds a lock around nested use."""
+    global _CONST_MODE, _CONST_TABLE
+    prev_mode, prev_table = _CONST_MODE, _CONST_TABLE
+    _CONST_MODE, _CONST_TABLE = mode, table
+    try:
+        yield
+    finally:
+        _CONST_MODE, _CONST_TABLE = prev_mode, prev_table
+
+
+def const_table_np() -> np.ndarray:
+    """The collected constants as one [K, 20] int32 table."""
+    if not _CONST_ROWS:
+        raise RuntimeError("no constants collected — run a collect trace")
+    return np.stack(_CONST_ROWS, axis=0)
 
 
 def _align2(a: jnp.ndarray, b: jnp.ndarray):
@@ -100,7 +157,7 @@ def _align2(a: jnp.ndarray, b: jnp.ndarray):
 
 
 def fe_const(x: int, batch_shape=()) -> jnp.ndarray:
-    limbs = jnp.asarray(int_to_limbs_np(x % P))
+    limbs = _const20(int_to_limbs_np(x % P))
     out = limbs.reshape((NLIMB,) + (1,) * len(batch_shape))
     return jnp.broadcast_to(out, (NLIMB,) + tuple(batch_shape))
 

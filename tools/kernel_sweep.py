@@ -40,11 +40,14 @@ def ensure_sigset():
              msgs=np.frombuffer(b"".join(msgs), np.uint8).reshape(N,32),
              sigs=np.frombuffer(b"".join(sigs), np.uint8).reshape(N,64))
 
-def one_config(unroll, batches, comb="mxu", hoist=0, group=0):
-    """Run one (unroll, comb-select, hoist, group, batches) measurement
-    in a SUBPROCESS so each tunnel session is fresh and a wedge can't
-    kill the sweep. Inputs are cycled across distinct sets so no layer
-    can memoize identical submissions."""
+def one_config(unroll, batches, comb="mxu", hoist=0, group=0, impl="xla",
+               block=512):
+    """Run one (unroll, comb-select, hoist, group, impl, batches)
+    measurement in a SUBPROCESS so each tunnel session is fresh and a
+    wedge can't kill the sweep. Inputs are cycled across distinct sets
+    so no layer can memoize identical submissions. impl="pallas" runs
+    the whole-verify-in-VMEM kernel (ops/ed25519_pallas.py) with grid
+    block size `block`."""
     code = f'''
 import os, sys, time
 import numpy as np
@@ -53,12 +56,18 @@ os.environ["STELLARD_VERIFY_UNROLL"] = "{unroll}"
 os.environ["STELLARD_COMB_SELECT"] = "{comb}"
 os.environ["STELLARD_HOIST_SELECT"] = "{hoist}"
 os.environ["STELLARD_GROUP_OPS"] = "{group}"
+os.environ["STELLARD_PALLAS_BLOCK"] = "{block}"
 sys.path.insert(0, {REPO!r})
 import jax
 assert jax.devices()[0].platform != "cpu", "no tpu"
 from stellard_tpu.utils.xlacache import enable_compilation_cache
 enable_compilation_cache()
-from stellard_tpu.ops.ed25519_jax import prepare_batch, verify_kernel
+from stellard_tpu.ops.ed25519_jax import prepare_batch
+if "{impl}" == "pallas":
+    from stellard_tpu.ops.ed25519_pallas import (
+        verify_kernel_pallas as verify_kernel)
+else:
+    from stellard_tpu.ops.ed25519_jax import verify_kernel
 z = np.load("{CACHE}")
 N = len(z["pubs"])
 for batch in {batches}:
@@ -81,21 +90,21 @@ for batch in {batches}:
             [z["sigs"][i].tobytes() for i in idx],
         ))
     t0=time.time(); out = verify_kernel(**sets[0]); out.block_until_ready()
-    print(f"unroll={unroll} comb={comb} hoist={hoist} group={group} batch={{batch}} compile {{time.time()-t0:.0f}}s", flush=True)
+    print(f"unroll={unroll} comb={comb} hoist={hoist} group={group} impl={impl} block={block} batch={{batch}} compile {{time.time()-t0:.0f}}s", flush=True)
     assert np.asarray(out).all()
     t0=time.time(); n=0
     while time.time()-t0 < 5:
         verify_kernel(**sets[n % len(sets)]).block_until_ready(); n+=1
     dt=(time.time()-t0)/n
-    print(f"RESULT unroll={unroll} comb={comb} hoist={hoist} group={group} batch={{batch}} lat={{dt*1000:.1f}}ms rate={{batch/dt:,.0f}} sigs/s", flush=True)
+    print(f"RESULT unroll={unroll} comb={comb} hoist={hoist} group={group} impl={impl} block={block} batch={{batch}} lat={{dt*1000:.1f}}ms rate={{batch/dt:,.0f}} sigs/s", flush=True)
 '''
     try:
         r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                            text=True, timeout=1500)
     except subprocess.TimeoutExpired:
         print(f"unroll={unroll} comb={comb} hoist={hoist} group={group} "
-              f"batches={batches}: TIMED OUT (wedged tunnel?) — skipping",
-              flush=True)
+              f"impl={impl} block={block} batches={batches}: TIMED OUT "
+              f"(wedged tunnel?) — skipping", flush=True)
         return False
     out = "\n".join(l for l in (r.stdout + r.stderr).splitlines()
                     if "WARNING" not in l and l.strip())
@@ -111,6 +120,8 @@ for batch in {batches}:
                     "comb": kv["comb"],
                     "hoist": int(kv.get("hoist", 0)),
                     "group": int(kv.get("group", 0)),
+                    "impl": kv.get("impl", "xla"),
+                    "block": int(kv.get("block", 512)),
                     "batch": int(kv["batch"]),
                     "rate": float(kv["rate"].replace(",", "")),
                 })
@@ -175,11 +186,14 @@ def write_tuning():
             "comb": best["comb"],
             "hoist": best.get("hoist", 0),
             "group": best.get("group", 0),
+            "impl": best.get("impl", "xla"),
+            "block": best.get("block", 512),
             "batch": best["batch"],
             "rate": best["rate"],
             "all": RESULTS,
             "note": "measured by tools/kernel_sweep.py on the current "
-                    "kernel source (rowpad fe_mul; hoist/group gates)",
+                    "kernel source (rowpad fe_mul; hoist/group gates; "
+                    "impl=xla|pallas)",
         }, f, indent=1)
     os.replace(tmp, TUNING_PATH)
     print(f"TUNING -> {TUNING_PATH}: unroll={best['unroll']} "
@@ -193,18 +207,19 @@ if __name__ == "__main__":
     # 4096/8192/16384/32768; unroll>1 measured flat, so the sweep
     # focuses on batch scaling + comb A/B for the hoisted form).
     ensure_sigset()
-    # A/B the two r4 graph transforms against the measured 99.9k@16384
-    # baseline (rowpad, in-loop select, ungrouped = hoist 0 / group 0):
-    one_config(1, [16384], hoist=0, group=0)   # reproduce the winner
-    one_config(1, [16384], hoist=0, group=1)   # grouping alone
-    # (hoist=1 group=1 measured 2026-07-31: 41.7k/57.7k/63.7k at
-    # 4096/8192/16384 — the hoisted form loses, see PERF.md)
-    # in-loop comb-select strategies, never yet A/B'd on-chip:
+    # Measured 2026-07-31 (SWEEP_r04.log): hoist=0/group=0 @16384 =
+    # 100.7k sigs/s (reproduces the a7910e1 winner); group=1 = 63.2k
+    # (grouping is the regression); hoisted+grouped = 63.7k. Standing
+    # record: 103.4k @32768 (prior window). Remaining questions:
+    # 1) the Pallas whole-verify-in-VMEM kernel vs the XLA formulation:
+    one_config(1, [16384], impl="pallas", block=512)
+    one_config(1, [16384], impl="pallas", block=1024)
+    one_config(1, [16384], impl="pallas", block=256)
+    # 2) batch scaling of the XLA winner beyond the 32768 record:
+    one_config(1, [32768, 65536], group=0)
+    # 3) in-loop comb-select strategies at the winning defaults:
     one_config(1, [16384], comb="mxu_split")
     one_config(1, [16384], comb="vpu")
-    # batch scaling at the best shape so far:
-    one_config(1, [32768, 65536], group=0)
-    one_config(1, [32768], group=1)
     write_tuning()  # before the (slow) tree bench: a wedge must not lose it
     tree_hash_bench()
     print("SWEEP DONE", flush=True)
