@@ -546,6 +546,14 @@ def main() -> None:
     )
     from stellard_tpu.protocol.keys import KeyPair
 
+    # honor the tuned kernel implementation: with impl=pallas in the
+    # tuning file the headline must measure the Pallas kernel, not the
+    # XLA formulation run at the pallas winner's batch size
+    if os.environ.get("STELLARD_VERIFY_IMPL", "xla") == "pallas":
+        from stellard_tpu.ops.ed25519_pallas import (
+            verify_kernel_pallas as verify_kernel,
+        )
+
     batch = int(os.environ.get("BENCH_BATCH", _TUNED_BATCH or "4096"))
     seconds = float(os.environ.get("BENCH_SECONDS", "10"))
 
@@ -632,7 +640,7 @@ def main() -> None:
             i += 1
 
     total = 0
-    for flags in verify_stream(feed()):
+    for flags in verify_stream(feed(), kernel=verify_kernel):
         assert flags.all()
         total += len(flags)
     e2e_rate = total / (time.time() - t0)
@@ -647,6 +655,7 @@ def main() -> None:
             "prep_only": round(prep_rate, 1),
             "device_only": round(device_rate, 1),
             "batch": batch,
+            "impl": os.environ.get("STELLARD_VERIFY_IMPL", "xla"),
             "platform": platform,
             # fallback=true means NO device kernel ran — the value is the
             # device program emulated on one cpu core, not a chip number

@@ -316,11 +316,13 @@ def _select_cached(tbl, digit):
     entry [4, 20, *batch].
 
     |digit| selects by one-hot contraction (no gathers); a negative digit
-    swaps (Y+X)/(Y-X) and negates 2dT — point negation in cached form."""
+    swaps (Y+X)/(Y-X) and negates 2dT — point negation in cached form.
+    The one-hot is built with broadcasted_iota so this exact function is
+    shared by the Pallas kernel (TPU Pallas rejects 1-D iota)."""
     mag = jnp.abs(digit)
     neg = digit < 0
-    sel = jnp.arange(9, dtype=mag.dtype).reshape((9,) + (1,) * mag.ndim)
-    onehot = (mag == sel).astype(jnp.int32)  # [9, *batch]
+    sel = lax.broadcasted_iota(mag.dtype, (9,) + mag.shape, 0)
+    onehot = (mag[None] == sel).astype(jnp.int32)  # [9, *batch]
     entry = jnp.sum(onehot[:, None, None] * tbl, axis=0)  # [4, 20, *batch]
     ypx, ymx, t2d, z2 = entry[0], entry[1], entry[2], entry[3]
     return jnp.stack(
@@ -332,6 +334,20 @@ def _select_cached(tbl, digit):
         ],
         axis=0,
     )
+
+
+def comb_select_vpu(tj, w):
+    """Comb window entry select: [60, 16] table x [*batch] digits ->
+    [3, 20, *batch] niels entry as ONE exact int32 one-hot contraction
+    on the VPU (no int<->float converts). Shared by the XLA kernel's
+    vpu comb strategy and the Pallas kernel (whose lowering rejects 1-D
+    iota, hence broadcasted_iota)."""
+    sel = lax.broadcasted_iota(w.dtype, (16,) + w.shape, 0)
+    onehot = (w[None] == sel).astype(jnp.int32)  # [16, *batch]
+    picked = jnp.sum(
+        tj.astype(jnp.int32)[:, :, None] * onehot[None, :, :], axis=1
+    )
+    return picked.reshape((3, NLIMB) + w.shape)
 
 
 # --------------------------------------------------------------------------
@@ -406,13 +422,7 @@ def verify_kernel(a_words, r_words, s_windows, h_digits, s_canonical):
         """Select comb window entries for digits w: [60,16] x [B] ->
         [3, 20, B] int32 (strategy per _COMB_SELECT, see header)."""
         if _COMB_SELECT == "vpu":
-            onehot_i = (
-                w[None, :] == jnp.arange(16, dtype=w.dtype)[:, None]
-            ).astype(jnp.int32)  # [16, B]
-            return jnp.sum(
-                tj.astype(jnp.int32)[:, :, None] * onehot_i[None, :, :],
-                axis=1,
-            ).reshape((3, NLIMB) + w.shape)
+            return comb_select_vpu(tj, w)
         onehot = (
             w[None, :] == jnp.arange(16, dtype=w.dtype)[:, None]
         ).astype(jnp.float32)  # [16, B]
@@ -639,19 +649,23 @@ def verify_batch(publics, messages, signatures) -> np.ndarray:
     return np.asarray(verify_kernel(**inputs))
 
 
-def verify_stream(batches):
+def verify_stream(batches, kernel=None):
     """Double-buffered end-to-end verification over an iterable of
     (publics, messages, signatures) tuples.
 
     JAX dispatch is asynchronous, so the host prep (native SHA-512 +
     mod-l + numpy packing) of batch i+1 runs while the device executes
     batch i — the steady-state pipeline the round-1 bench only asserted.
-    Yields [B] bool numpy arrays in submission order.
+    Yields [B] bool numpy arrays in submission order. ``kernel``
+    defaults to this module's XLA verify_kernel; pass e.g. the Pallas
+    implementation to pipeline that one instead.
     """
+    if kernel is None:
+        kernel = verify_kernel
     pending = None
     for batch in batches:
         inputs = prepare_batch(*batch)
-        out = verify_kernel(**inputs)  # async dispatch
+        out = kernel(**inputs)  # async dispatch
         if pending is not None:
             yield np.asarray(pending)  # blocks on batch i-1 only
         pending = out

@@ -42,6 +42,8 @@ from .ed25519_jax import (
     WINDOW,
     _build_cached_table,
     _comb_table_np,
+    _select_cached,
+    comb_select_vpu,
     pt_add_cached,
     pt_add_mixed,
     pt_decompress,
@@ -49,48 +51,11 @@ from .ed25519_jax import (
     pt_encode_words,
     pt_identity,
     pt_neg,
-    pt_stack,
     pt_to_cached,
 )
-from .fe25519 import (
-    NLIMB,
-    const_mode,
-    const_table_np,
-    fe_neg,
-    fe_select,
-)
+from .fe25519 import NLIMB, const_mode, const_table_np
 
 BLOCK = int(os.environ.get("STELLARD_PALLAS_BLOCK", "512"))
-
-
-def _select_cached_iota(tbl, digit):
-    """tbl [9, 4, 20, B], digit [B] int32 in [-8, 7] -> cached entry
-    [4, 20, B]. Same as ed25519_jax._select_cached with the one-hot
-    built from broadcasted_iota (Pallas-safe)."""
-    mag = jnp.abs(digit)
-    neg = digit < 0
-    sel = lax.broadcasted_iota(jnp.int32, (9,) + mag.shape, 0)
-    onehot = (mag[None] == sel).astype(jnp.int32)  # [9, B]
-    entry = jnp.sum(onehot[:, None, None] * tbl, axis=0)  # [4, 20, B]
-    ypx, ymx, t2d, z2 = entry[0], entry[1], entry[2], entry[3]
-    return jnp.stack(
-        [
-            fe_select(neg, ymx, ypx),
-            fe_select(neg, ypx, ymx),
-            fe_select(neg, fe_neg(t2d), t2d),
-            z2,
-        ],
-        axis=0,
-    )
-
-
-def _comb_entry_iota(tj, w):
-    """tj [60, 16] int32, w [B] digits -> [3, 20, B] int32 selected niels
-    entry, as one VPU one-hot contraction (exact int32 math)."""
-    sel = lax.broadcasted_iota(jnp.int32, (16,) + w.shape, 0)
-    onehot = (w[None] == sel).astype(jnp.int32)  # [16, B]
-    picked = jnp.sum(tj[:, :, None] * onehot[None], axis=1)  # [60, B]
-    return picked.reshape((3, NLIMB) + w.shape)
 
 
 def _verify_block(aw, rw, sw, hd, sc, comb):
@@ -99,23 +64,19 @@ def _verify_block(aw, rw, sw, hd, sc, comb):
     a_point, a_valid = pt_decompress(aw)
     htbl = _build_cached_table(pt_neg(a_point))  # [9, 4, 20, B]
 
+    # pt_identity broadcasts its constants to a concrete [4, 20, B]
     acc0_h = pt_identity(aw.shape[1:])
     acc0_s = pt_identity(aw.shape[1:])
-    # fe_const gives [20, 1]-style broadcastable consts; make the batch
-    # axis concrete so the fori_loop carry has a stable [4, 20, B] shape
-    zero = jnp.zeros(aw.shape[1:], jnp.int32)
-    acc0_h = acc0_h + zero
-    acc0_s = acc0_s + zero
 
     def body(j, accs):
         acc_h, acc_s = accs
         for _ in range(WINDOW):
             acc_h = pt_double(acc_h)
         d = lax.dynamic_index_in_dim(hd, NWINDOWS - 1 - j, 0, keepdims=False)
-        acc_h = pt_add_cached(acc_h, _select_cached_iota(htbl, d))
+        acc_h = pt_add_cached(acc_h, _select_cached(htbl, d))
         tj = lax.dynamic_index_in_dim(comb, j, 0, keepdims=False)  # [60,16]
         w = lax.dynamic_index_in_dim(sw, j, 0, keepdims=False)  # [B]
-        acc_s = pt_add_mixed(acc_s, _comb_entry_iota(tj, w))
+        acc_s = pt_add_mixed(acc_s, comb_select_vpu(tj, w))
         return acc_h, acc_s
 
     acc_h, acc_s = lax.fori_loop(0, NWINDOWS, body, (acc0_h, acc0_s))

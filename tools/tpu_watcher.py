@@ -65,6 +65,7 @@ def main() -> None:
             continue
         env = dict(os.environ)
         env.pop("JAX_PLATFORMS", None)
+        mark = os.path.getsize(LOG) if os.path.exists(LOG) else 0
         with open(LOG, "a") as f:
             ok = _run_logged(
                 f, "kernel_sweep",
@@ -73,8 +74,21 @@ def main() -> None:
                 f, "bench", [sys.executable, os.path.join(REPO, "bench.py")], env,
             )
         if ok:
-            break
-        # wedged mid-run: back to probing until the tunnel answers again
+            # both subprocesses finished — but a mid-run wedge makes the
+            # sweep skip configs (exit 0) and bench emit its CPU-fallback
+            # lines (exit 0), which is NOT the measurement this watcher
+            # exists to capture. Stop only when the cycle produced BOTH
+            # a verify-sweep measurement (a "RESULT unroll=" row, not
+            # just a treehash row) AND at least one on-chip bench line;
+            # a single leg's fallback must not discard a good cycle.
+            with open(LOG) as f:
+                f.seek(mark)
+                tail = f.read()
+            if "RESULT unroll=" in tail and '"fallback": false' in tail:
+                break
+            print("[watcher] cycle completed but without on-chip sweep+"
+                  "bench evidence (wedge mid-run) — continuing to probe",
+                  flush=True)
         time.sleep(PROBE_INTERVAL)
     print("[watcher] sweep+bench complete; see", LOG, flush=True)
 
