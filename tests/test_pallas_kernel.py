@@ -65,3 +65,18 @@ def test_pallas_verify_differential():
     got = np.asarray(verify_kernel_pallas(**prepare_batch(pubs, msgs, sigs)))
     assert got.shape == (n,)
     assert (got == expect).all(), np.nonzero(got != expect)
+
+
+def test_pallas_matches_oracle_on_edge_cases():
+    """The adversarial corpus the XLA kernel is pinned by (y=0 / identity
+    / invalid-encoding / non-canonical-y pubkeys, bad R, non-canonical S,
+    random bit flips) must give byte-identical verdicts from the Pallas
+    kernel — both implementations answer to the same Python oracle."""
+    from stellard_tpu.ops import ed25519_ref as ref
+    from test_crypto_plane import _make_cases  # pytest's module name
+
+    cases = _make_cases(48)
+    pubs, msgs, sigs = (list(t) for t in zip(*cases))
+    got = np.asarray(verify_kernel_pallas(**prepare_batch(pubs, msgs, sigs)))
+    want = np.array([ref.verify(p, m, s) for p, m, s in cases])
+    assert np.array_equal(got, want), np.nonzero(got != want)
