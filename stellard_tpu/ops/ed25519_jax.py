@@ -228,7 +228,11 @@ def pt_encode_words(p):
     y = fe_reduce_full(fe_mul(p[1], zi))
     words = limbs_to_words_le(y)
     sign = (x[0] & 1).astype(jnp.uint32)
-    return words.at[7].set(words[7] | (sign << 31))
+    # concatenate, not .at[7].set — a scatter has no Mosaic lowering,
+    # and this function is shared with the Pallas kernel
+    return jnp.concatenate(
+        [words[:7], (words[7] | (sign << 31))[None]], axis=0
+    )
 
 
 # --------------------------------------------------------------------------

@@ -58,11 +58,30 @@ from .fe25519 import NLIMB, const_mode, const_table_np
 BLOCK = int(os.environ.get("STELLARD_PALLAS_BLOCK", "512"))
 
 
-def _verify_block(aw, rw, sw, hd, sc, comb):
+def _verify_block(aw, rw, sw, hd, sc, comb, window_loader=None):
     """One VMEM-resident block: aw/rw [8, B] u32, sw/hd [64, B] i32,
-    sc [B] i32, comb [64, 60, 16] i32 -> [B] i32 verdicts."""
+    sc [B] i32, comb [64, 60, 16] i32 -> [B] i32 verdicts.
+
+    ``window_loader(j) -> (d [B], tj [60, 16], w [B])`` supplies window
+    j's inputs inside the scalar-walk loop. The default indexes the
+    VALUES (plain XLA trace; used by the collect trace and tests); the
+    Pallas kernel passes a ref-based loader (with sw/hd/comb None so no
+    dead full-block loads are traced) because Mosaic has no lowering
+    for dynamic_slice on values — dynamic indexing must go through the
+    VMEM refs."""
     a_point, a_valid = pt_decompress(aw)
     htbl = _build_cached_table(pt_neg(a_point))  # [9, 4, 20, B]
+
+    if window_loader is None:
+        assert sw is not None and hd is not None and comb is not None
+
+        def window_loader(j):
+            d = lax.dynamic_index_in_dim(
+                hd, NWINDOWS - 1 - j, 0, keepdims=False
+            )
+            tj = lax.dynamic_index_in_dim(comb, j, 0, keepdims=False)
+            w = lax.dynamic_index_in_dim(sw, j, 0, keepdims=False)
+            return d, tj, w
 
     # pt_identity broadcasts its constants to a concrete [4, 20, B]
     acc0_h = pt_identity(aw.shape[1:])
@@ -72,10 +91,8 @@ def _verify_block(aw, rw, sw, hd, sc, comb):
         acc_h, acc_s = accs
         for _ in range(WINDOW):
             acc_h = pt_double(acc_h)
-        d = lax.dynamic_index_in_dim(hd, NWINDOWS - 1 - j, 0, keepdims=False)
+        d, tj, w = window_loader(j)
         acc_h = pt_add_cached(acc_h, _select_cached(htbl, d))
-        tj = lax.dynamic_index_in_dim(comb, j, 0, keepdims=False)  # [60,16]
-        w = lax.dynamic_index_in_dim(sw, j, 0, keepdims=False)  # [B]
         acc_s = pt_add_mixed(acc_s, comb_select_vpu(tj, w))
         return acc_h, acc_s
 
@@ -92,14 +109,21 @@ def _kernel(aw_ref, rw_ref, sw_ref, hd_ref, sc_ref, comb_ref, ktab_ref,
     # served as a row of the ktab input (Pallas cannot capture array
     # constants); the collect trace in _ensure_const_table guarantees
     # the table is complete before this kernel ever traces.
+    def ref_loader(j):
+        d = hd_ref[pl.ds(NWINDOWS - 1 - j, 1), :][0]
+        tj = comb_ref[pl.ds(j, 1), :, :][0]
+        w = sw_ref[pl.ds(j, 1), :][0]
+        return d, tj, w
+
     with const_mode("consume", ktab_ref[:]):
         out = _verify_block(
             aw_ref[:],
             rw_ref[:],
-            sw_ref[:],
-            hd_ref[:],
+            None,  # sw/hd/comb only feed the default loader; passing
+            None,  # the values would trace dead full-block loads
             sc_ref[0, :],
-            comb_ref[:],
+            None,
+            window_loader=ref_loader,
         )
     out_ref[0, :] = out
 

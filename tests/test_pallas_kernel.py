@@ -67,6 +67,38 @@ def test_pallas_verify_differential():
     assert (got == expect).all(), np.nonzero(got != expect)
 
 
+def test_pallas_lowers_for_tpu():
+    """Cross-platform export must produce TPU MLIR: Mosaic supports a
+    subset of primitives (no value dynamic_slice, no scatter, no 1-D
+    iota...), and a refactor of the shared fe/pt helpers can silently
+    reintroduce one. This catches it on the CPU host — on-chip tunnel
+    time is too scarce to spend discovering lowering errors."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import export
+
+    from stellard_tpu.ops import ed25519_pallas as P
+
+    with P._TRACE_LOCK:
+        ktab = P._ensure_const_table()
+    blk = P.BLOCK
+    args = (
+        jax.ShapeDtypeStruct((8, blk), jnp.uint32),
+        jax.ShapeDtypeStruct((8, blk), jnp.uint32),
+        jax.ShapeDtypeStruct((64, blk), jnp.int32),
+        jax.ShapeDtypeStruct((64, blk), jnp.int32),
+        jax.ShapeDtypeStruct((1, blk), jnp.int32),
+        jax.ShapeDtypeStruct((64, 60, 16), jnp.int32),
+        jax.ShapeDtypeStruct(ktab.shape, jnp.int32),
+    )
+    fn = functools.partial(P._call, interpret=False, nconst=ktab.shape[0])
+    with P._TRACE_LOCK:
+        exp = export.export(jax.jit(fn), platforms=["tpu"])(*args)
+    assert len(exp.mlir_module_serialized) > 0
+
+
 def test_pallas_matches_oracle_on_edge_cases():
     """The adversarial corpus the XLA kernel is pinned by (y=0 / identity
     / invalid-encoding / non-canonical-y pubkeys, bad R, non-canonical S,
