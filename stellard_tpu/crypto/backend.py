@@ -201,6 +201,9 @@ class TpuVerifier(BatchVerifier):
         self._kernel = None  # resolved lazily (device discovery)
         self._use_mesh = use_mesh
         self.n_devices = 1
+        # mesh+pallas small-batch bypass (set by _resolve_kernel)
+        self._small_kernel = None
+        self._mesh_floor = 0
 
     def _resolve_kernel(self):
         if self._kernel is not None:
@@ -209,14 +212,14 @@ class TpuVerifier(BatchVerifier):
 
         from ..ops.ed25519_jax import verify_kernel
 
-        if os.environ.get("STELLARD_VERIFY_IMPL", "xla") == "pallas":
-            # whole-verify-in-VMEM Pallas kernel (ops/ed25519_pallas.py);
-            # single-chip only — mesh mode shards the XLA formulation
-            from ..ops.ed25519_pallas import verify_kernel_pallas
-
-            self._kernel = verify_kernel_pallas
-            return self._kernel
-
+        impl = os.environ.get("STELLARD_VERIFY_IMPL", "xla")
+        if impl not in ("xla", "pallas"):
+            # a perf/debug toggle must not silently no-op (same policy
+            # as STELLARD_HOST_VERIFY below)
+            raise ValueError(
+                f"STELLARD_VERIFY_IMPL={impl!r}: expected 'xla' or 'pallas'"
+            )
+        impl_pallas = impl == "pallas"
         devices = jax.devices()
         want_mesh = (
             self._use_mesh
@@ -224,14 +227,38 @@ class TpuVerifier(BatchVerifier):
             else len(devices) > 1
         )
         if want_mesh and len(devices) > 1:
-            from ..parallel.mesh import make_mesh, sharded_verify_kernel
+            from ..parallel.mesh import (
+                make_mesh,
+                sharded_verify_kernel,
+                sharded_verify_kernel_pallas,
+            )
 
             self.n_devices = len(devices)
-            self._kernel = sharded_verify_kernel(make_mesh(devices))
+            mesh = make_mesh(devices)
+            if impl_pallas:
+                from ..ops.ed25519_pallas import (
+                    BLOCK,
+                    verify_kernel_pallas,
+                )
+
+                self._kernel = sharded_verify_kernel_pallas(mesh)
+                # each shard pads itself to a full grid BLOCK, so a
+                # batch below n_devices*BLOCK would pay n_devices
+                # blocks of mostly-zero work for single-block latency;
+                # route those to the single-chip kernel instead
+                self._small_kernel = verify_kernel_pallas
+                self._mesh_floor = len(devices) * BLOCK
+            else:
+                self._kernel = sharded_verify_kernel(mesh)
             # pad floor must divide evenly across the mesh (round UP to a
             # multiple — doubling can never fix an odd device count)
             nd = self.n_devices
             self.min_batch = ((self.min_batch + nd - 1) // nd) * nd
+        elif impl_pallas:
+            # whole-verify-in-VMEM Pallas kernel (ops/ed25519_pallas.py)
+            from ..ops.ed25519_pallas import verify_kernel_pallas
+
+            self._kernel = verify_kernel_pallas
         else:
             self._kernel = verify_kernel
         return self._kernel
@@ -264,7 +291,10 @@ class TpuVerifier(BatchVerifier):
                 [r.signing_hash for r in chunk] + [b""] * pad,
                 [r.signature for r in chunk] + [b"\x00" * 64] * pad,
             )
-            res = kernel(
+            k = kernel
+            if self._small_kernel is not None and size < self._mesh_floor:
+                k = self._small_kernel  # single chip beats 94%-zero shards
+            res = k(
                 inputs["a_words"], inputs["r_words"], inputs["s_windows"],
                 inputs["h_digits"], inputs["s_canonical"],
             )

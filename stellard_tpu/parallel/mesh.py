@@ -48,6 +48,29 @@ def sharded_verify_kernel(mesh: Mesh):
     )
 
 
+def sharded_verify_kernel_pallas(mesh: Mesh):
+    """shard_map of the Pallas whole-verify-in-VMEM kernel: each chip
+    runs the grid over its local batch shard (a pallas_call is a custom
+    call XLA cannot auto-partition, so the data-parallel split is
+    explicit shard_map, unlike sharded_verify_kernel's jit+shardings).
+    Public layout identical to verify_kernel's; each shard pads itself
+    to its block multiple internally."""
+    from ..ops.ed25519_pallas import verify_kernel_pallas
+
+    pspec = P(BATCH_AXIS)
+    return jax.jit(
+        jax.shard_map(
+            verify_kernel_pallas,
+            mesh=mesh,
+            in_specs=(pspec,) * 5,
+            out_specs=pspec,
+            # a pallas_call's out_shape carries no varying-mesh-axes
+            # annotation, so the vma consistency check cannot apply
+            check_vma=False,
+        )
+    )
+
+
 def sharded_sha512_blocks(mesh: Mesh):
     shard = _batch_sharding(mesh)
     return jax.jit(sha512_blocks, in_shardings=(shard,), out_shardings=shard)
