@@ -67,11 +67,17 @@ def main() -> None:
         env.pop("JAX_PLATFORMS", None)
         mark = os.path.getsize(LOG) if os.path.exists(LOG) else 0
         with open(LOG, "a") as f:
+            # bench FIRST: KERNEL_TUNING already pins a measured-good
+            # config, and the end-to-end device legs are the round's
+            # headline evidence — a short tunnel window must capture
+            # them before the (longer, upside-only) sweep. The driver's
+            # own end-of-round bench picks up any tuning the sweep
+            # improves afterwards.
             ok = _run_logged(
+                f, "bench", [sys.executable, os.path.join(REPO, "bench.py")], env,
+            ) and _run_logged(
                 f, "kernel_sweep",
                 [sys.executable, os.path.join(REPO, "tools/kernel_sweep.py")], env,
-            ) and _run_logged(
-                f, "bench", [sys.executable, os.path.join(REPO, "bench.py")], env,
             )
         if ok:
             # both subprocesses finished — but a mid-run wedge makes the
