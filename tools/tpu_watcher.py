@@ -1,10 +1,14 @@
-"""Background TPU-tunnel watcher: probe until the chip answers, then sweep.
+"""Background TPU-tunnel watcher: probe until the chip answers, then measure.
 
 The axon tunnel wedges for hours at a time (jax.devices() HANGS rather
 than erroring), so every probe runs in a throwaway subprocess with a hard
 wall-clock timeout, and only ONE TPU-touching process ever runs at a time
 (concurrent sessions are what wedge it). When a probe succeeds this runs
-`tools/kernel_sweep.py` and then `bench.py`, logging to LOG, and exits.
+`bench.py` FIRST (the end-to-end device legs are the round's headline
+evidence and KERNEL_TUNING already pins a measured-good config — a short
+window must capture them) and then `tools/kernel_sweep.py` (upside-only
+A/B), logging to LOG; it exits only once a cycle shows both an on-chip
+bench line and a verify-sweep RESULT row.
 
 Usage: nohup python tools/tpu_watcher.py > /tmp/tpu_watcher.log 2>&1 &
 """
