@@ -20,30 +20,14 @@ import numpy as np
 # Apply the on-chip sweep's winning kernel configuration
 # (tools/kernel_sweep.py writes KERNEL_TUNING.json) BEFORE any kernel
 # module import reads the env. Explicit env settings win — the sweep
-# itself sets them per subprocess.
+# itself sets them per subprocess. (crypto.backend imports no kernel
+# module at import time, so this is safe to import here.)
+from stellard_tpu.crypto.backend import apply_kernel_tuning  # noqa: E402
+
 _TUNING = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "KERNEL_TUNING.json")
-_TUNED_BATCH: str | None = None
-if os.path.exists(_TUNING):
-    try:
-        with open(_TUNING) as _f:
-            _t = json.load(_f)
-        # read every value BEFORE setting any env var: a partial tuning
-        # file must not apply a half-tuned (never-measured) combination
-        _unroll, _comb = str(int(_t["unroll"])), str(_t["comb"])
-        _hoist = str(int(_t.get("hoist", 0)))
-        _group = str(int(_t.get("group", 0)))
-        _impl = str(_t.get("impl", "xla"))
-        _block = str(int(_t.get("block", 512)))
-        _TUNED_BATCH = str(int(_t["batch"]))
-        os.environ.setdefault("STELLARD_VERIFY_UNROLL", _unroll)
-        os.environ.setdefault("STELLARD_COMB_SELECT", _comb)
-        os.environ.setdefault("STELLARD_HOIST_SELECT", _hoist)
-        os.environ.setdefault("STELLARD_GROUP_OPS", _group)
-        os.environ.setdefault("STELLARD_VERIFY_IMPL", _impl)
-        os.environ.setdefault("STELLARD_PALLAS_BLOCK", _block)
-    except (ValueError, KeyError, TypeError, OSError):
-        _TUNED_BATCH = None  # malformed tuning file: run with defaults
+_t = apply_kernel_tuning(_TUNING)
+_TUNED_BATCH: str | None = str(int(_t["batch"])) if _t else None
 
 
 def _emit(obj: dict) -> None:

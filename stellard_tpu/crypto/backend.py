@@ -550,6 +550,46 @@ class CppHasher(BatchHasher):
 register_hasher("cpp", CppHasher)
 
 
+def apply_kernel_tuning(path: str) -> Optional[dict]:
+    """Apply an on-chip sweep's winning kernel configuration
+    (tools/kernel_sweep.py writes KERNEL_TUNING.json) as env defaults,
+    BEFORE any kernel module reads them. Explicit env settings win —
+    which also means the values are process-global and first-writer-
+    wins: a second tuning file applied in the same process is silently
+    inert (the kernel knobs are read once at module import, so env is
+    the only channel). Returns the parsed tuning dict when applied
+    (callers also use its 'batch'), else None — malformed or
+    unreadable files apply NOTHING (never a half-tuned combination).
+    Used by bench.py (repo root) and the node ([kernel_tuning] config
+    knob) so a daemon run honors the measured winner, not a hardcoded
+    default."""
+    import json
+
+    try:
+        with open(path) as f:
+            t = json.load(f)
+        # read every value BEFORE setting any env var: a partial file
+        # must not apply a half-tuned (never-measured) combination
+        values = {
+            "STELLARD_VERIFY_UNROLL": str(int(t["unroll"])),
+            "STELLARD_COMB_SELECT": str(t["comb"]),
+            "STELLARD_HOIST_SELECT": str(int(t.get("hoist", 0))),
+            "STELLARD_GROUP_OPS": str(int(t.get("group", 0))),
+            "STELLARD_VERIFY_IMPL": str(t.get("impl", "xla")),
+            "STELLARD_PALLAS_BLOCK": str(int(t.get("block", 512))),
+        }
+        if values["STELLARD_VERIFY_IMPL"] not in ("xla", "pallas"):
+            # a hand-edited file must not park a crash at the first
+            # device batch (_resolve_kernel validates the same set)
+            raise ValueError(values["STELLARD_VERIFY_IMPL"])
+        int(t["batch"])  # validated for callers
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+    for k, v in values.items():
+        os.environ.setdefault(k, v)
+    return t
+
+
 class WatchdogHasher(BatchHasher):
     """Run a device hasher's calls under a wedge deadline with a CPU
     fallback (utils.devicewatch): the observed tunnel failure mode is an

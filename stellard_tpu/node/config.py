@@ -63,6 +63,11 @@ class Config:
     verify_batch_window_ms: float = 2.0  # coalescing window
     verify_max_batch: int = 16384
     verify_min_device_batch: int = 64  # below this, CPU path is used
+    # [kernel_tuning]: path to an on-chip sweep's KERNEL_TUNING.json —
+    # applied as env defaults at node setup so a daemon honors the
+    # measured kernel winner (default: the file name in the CWD, if
+    # any; "none"/"off" disables)
+    kernel_tuning: str = "KERNEL_TUNING.json"
 
     # -- network identity / trust ([validation_seed], [validators]) --------
     validation_seed: str = ""  # base58 seed; empty = not a validator
@@ -152,6 +157,7 @@ class Config:
         cfg.hash_backend = hsh.get(
             "type", one("hash_backend", cfg.hash_backend)
         ).lower()
+        cfg.kernel_tuning = one("kernel_tuning", cfg.kernel_tuning)
 
         cfg.validation_seed = one("validation_seed", cfg.validation_seed)
         cfg.sntp_servers = [line.split()[0] for line in s.get("sntp_servers", [])]

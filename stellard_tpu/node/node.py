@@ -131,6 +131,31 @@ class Node:
         # Device hashers run under the wedge watchdog: the tunnel's
         # failure mode is an indefinite hang, and a frozen tree-hash
         # would freeze every ledger close (utils/devicewatch.py).
+        if cfg.kernel_tuning and cfg.kernel_tuning.lower() not in (
+            "none", "off"
+        ):
+            # measured-winner kernel config as env defaults (explicit
+            # env settings win). Outcomes are operator-visible: a
+            # missing DEFAULT path is normal; an explicitly configured
+            # path that fails to apply is a loud warning (stated
+            # stance: degraded subsystems report, never stay silent).
+            import logging
+
+            from ..crypto.backend import apply_kernel_tuning
+
+            tuned = apply_kernel_tuning(cfg.kernel_tuning)
+            lg = logging.getLogger("stellard.device")
+            if tuned is not None:
+                lg.info(
+                    "kernel tuning applied from %s (impl=%s batch=%s)",
+                    cfg.kernel_tuning, tuned.get("impl", "xla"),
+                    tuned.get("batch"),
+                )
+            elif cfg.kernel_tuning != "KERNEL_TUNING.json":
+                lg.warning(
+                    "[kernel_tuning] %s missing or malformed — running "
+                    "with hardcoded kernel defaults", cfg.kernel_tuning,
+                )
         self.hasher = make_hasher(cfg.hash_backend)
         if cfg.hash_backend == "tpu":
             # only the DEVICE hasher can wedge; host backends (cpp)

@@ -150,6 +150,56 @@ class TestVerifyPlaneWedge:
             assert out.all()
         assert wedge.calls == 1  # no re-exploration of a dead device
 
+    def test_node_closes_ledgers_through_a_wedged_device(self):
+        """Node-level wiring: a validator whose device wedges mid-run
+        must keep accepting transactions and closing ledgers on the CPU
+        side — the subsystem degrades, the chain does not stall."""
+        from stellard_tpu.node.config import Config
+        from stellard_tpu.node.node import Node
+        from stellard_tpu.protocol.formats import TxType
+        from stellard_tpu.protocol.sfields import sfAmount, sfDestination
+        from stellard_tpu.protocol.stamount import STAmount
+        from stellard_tpu.protocol.sttx import SerializedTransaction
+
+        node = Node(Config()).setup()
+        try:
+            # plant a wedge in the live plane (as if the tunnel hung);
+            # min_device_batch=1 so even single-signature batches explore
+            # the device (normal routing would shield them from it)
+            node.verify_plane.verifier = _Wedge()
+            node.verify_plane._device_capable = True
+            node.verify_plane._t_first = 0.3
+            node.verify_plane._t_warm = 0.3
+            node.verify_plane.min_device_batch = 1
+            node.verify_plane.model.min_device_batch = 1
+            master = KeyPair.from_passphrase("masterpassphrase")
+            dest = KeyPair.from_seed(b"\x33" * 32)
+            done = threading.Semaphore(0)
+            results = []
+
+            def cb(tx, ter, applied):
+                results.append((ter, applied))
+                done.release()
+
+            for seq in (1, 2):
+                tx = SerializedTransaction.build(
+                    TxType.ttPAYMENT, master.account_id, seq, 10,
+                    {sfAmount: STAmount.from_drops(300_000_000),
+                     sfDestination: dest.account_id},
+                )
+                tx.sign(master)
+                # async intake: signature rides the verify plane, which
+                # explores the (wedged) device on the first batch
+                node.ops.submit_transaction(tx, cb)
+                assert done.acquire(timeout=30)
+                node.ops.accept_ledger()
+            assert node.ledger_master.closed_ledger().seq >= 3
+            assert all(applied for _, applied in results), results
+            assert node.verify_plane.device_wedged
+            assert node.verify_plane.get_json()["cpu_sigs"] >= 2
+        finally:
+            node.stop()
+
     def test_healthy_device_unaffected(self):
         class _Ok(BatchVerifier):
             name = "tpu"

@@ -63,7 +63,13 @@ class TestMeshVerifier:
         shard_map — a pallas_call is a custom call XLA cannot
         auto-partition). Interpreter mode on the CPU mesh."""
         os.environ["STELLARD_VERIFY_IMPL"] = "pallas"
-        os.environ.setdefault("STELLARD_PALLAS_BLOCK", "128")
+        # forced, not setdefault: an earlier node test's [kernel_tuning]
+        # application may have set the 512 production default, and an
+        # 8-shard interpreter run at block 512 is minutes of dead time.
+        # (If ed25519_pallas is already imported this is a no-op — the
+        # test sizes its batch from the ACTUAL P.BLOCK below.)
+        prev_block = os.environ.get("STELLARD_PALLAS_BLOCK")
+        os.environ["STELLARD_PALLAS_BLOCK"] = "128"
         try:
             from stellard_tpu.ops import ed25519_pallas as P
 
@@ -85,6 +91,10 @@ class TestMeshVerifier:
             assert np.array_equal(got2, small_want)
         finally:
             del os.environ["STELLARD_VERIFY_IMPL"]
+            if prev_block is None:
+                os.environ.pop("STELLARD_PALLAS_BLOCK", None)
+            else:
+                os.environ["STELLARD_PALLAS_BLOCK"] = prev_block
 
     def test_multi_chunk_pipeline(self):
         reqs, want = make_reqs(96, corrupt={5, 50})

@@ -14,9 +14,11 @@ import os
 
 import numpy as np
 
-# small grid block keeps interpreter cost CI-sized; must be set before
-# the module under test is imported (read once at import, jit-static)
-os.environ.setdefault("STELLARD_PALLAS_BLOCK", "128")
+# small grid block keeps interpreter cost CI-sized; must be FORCED (not
+# setdefault) before the module under test is imported (read once at
+# import, jit-static) — an earlier node test's [kernel_tuning]
+# application may already have set the 512 production default
+os.environ["STELLARD_PALLAS_BLOCK"] = "128"
 
 from stellard_tpu.ops.ed25519_jax import prepare_batch  # noqa: E402
 from stellard_tpu.ops.ed25519_pallas import (  # noqa: E402
