@@ -45,6 +45,10 @@ def _kv(lines: list[str]) -> dict[str, str]:
     return out
 
 
+# default [kernel_tuning] path, shared with Node's outcome logging
+DEFAULT_KERNEL_TUNING = "KERNEL_TUNING.json"
+
+
 @dataclass
 class Config:
     # -- run modes (reference Config.h RUN_STANDALONE / START_UP) ---------
@@ -67,7 +71,7 @@ class Config:
     # applied as env defaults at node setup so a daemon honors the
     # measured kernel winner (default: the file name in the CWD, if
     # any; "none"/"off" disables)
-    kernel_tuning: str = "KERNEL_TUNING.json"
+    kernel_tuning: str = DEFAULT_KERNEL_TUNING
 
     # -- network identity / trust ([validation_seed], [validators]) --------
     validation_seed: str = ""  # base58 seed; empty = not a validator

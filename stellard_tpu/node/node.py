@@ -10,6 +10,7 @@ mirrors the reference.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Optional
@@ -20,7 +21,7 @@ from ..protocol.keys import KeyPair, decode_seed
 from ..protocol.sttx import SerializedTransaction
 from ..protocol.ter import TER
 from ..state.ledger import Ledger
-from .config import Config
+from .config import DEFAULT_KERNEL_TUNING, Config
 from .hashrouter import HashRouter
 from .jobqueue import JobQueue
 from .ledgermaster import LedgerMaster
@@ -151,10 +152,20 @@ class Node:
                     cfg.kernel_tuning, tuned.get("impl", "xla"),
                     tuned.get("batch"),
                 )
-            elif cfg.kernel_tuning != "KERNEL_TUNING.json":
+            elif os.path.exists(cfg.kernel_tuning):
+                # present but unusable is a fault at ANY path — the
+                # operator believes the measured winner is applied
                 lg.warning(
-                    "[kernel_tuning] %s missing or malformed — running "
-                    "with hardcoded kernel defaults", cfg.kernel_tuning,
+                    "[kernel_tuning] %s exists but is malformed — "
+                    "running with hardcoded kernel defaults",
+                    cfg.kernel_tuning,
+                )
+            elif cfg.kernel_tuning != DEFAULT_KERNEL_TUNING:
+                # a missing DEFAULT path is normal; a missing
+                # explicitly-configured path is an operator mistake
+                lg.warning(
+                    "[kernel_tuning] %s not found — running with "
+                    "hardcoded kernel defaults", cfg.kernel_tuning,
                 )
         self.hasher = make_hasher(cfg.hash_backend)
         if cfg.hash_backend == "tpu":

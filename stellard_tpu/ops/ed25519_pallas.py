@@ -89,8 +89,9 @@ def _verify_block(aw, rw, sw, hd, sc, comb, window_loader=None):
 
     def body(j, accs):
         acc_h, acc_s = accs
-        for _ in range(WINDOW):
-            acc_h = pt_double(acc_h)
+        for i in range(WINDOW):
+            # T is only read by the add after the chain (see pt_double)
+            acc_h = pt_double(acc_h, need_t=(i == WINDOW - 1))
         d, tj, w = window_loader(j)
         acc_h = pt_add_cached(acc_h, _select_cached(htbl, d))
         acc_s = pt_add_mixed(acc_s, comb_select_vpu(tj, w))

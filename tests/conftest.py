@@ -26,3 +26,22 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 from stellard_tpu.utils.xlacache import enable_compilation_cache  # noqa: E402
 
 enable_compilation_cache()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _stellard_env_guard():
+    """Snapshot/restore STELLARD_* env around every test: node setup
+    applies [kernel_tuning] as process-wide env setdefaults, and tests
+    force kernel knobs — neither may leak into later tests. (Module-
+    import-time sets in test files intentionally persist: the kernel
+    modules read them once at import.)"""
+    saved = {
+        k: v for k, v in os.environ.items() if k.startswith("STELLARD_")
+    }
+    yield
+    for k in [k for k in os.environ if k.startswith("STELLARD_")]:
+        if k not in saved:
+            del os.environ[k]
+    os.environ.update(saved)
