@@ -44,11 +44,11 @@ from .ed25519_jax import (
     _comb_table_np,
     _select_cached,
     comb_select_vpu,
+    decompress_inputs,
+    final_check,
     pt_add_cached,
     pt_add_mixed,
-    pt_decompress,
     pt_double,
-    pt_encode_words,
     pt_identity,
     pt_neg,
     pt_to_cached,
@@ -69,7 +69,7 @@ def _verify_block(aw, rw, sw, hd, sc, comb, window_loader=None):
     dead full-block loads are traced) because Mosaic has no lowering
     for dynamic_slice on values — dynamic indexing must go through the
     VMEM refs."""
-    a_point, a_valid = pt_decompress(aw)
+    a_point, r_point, valid, r_canon = decompress_inputs(aw, rw)
     htbl = _build_cached_table(pt_neg(a_point))  # [9, 4, 20, B]
 
     if window_loader is None:
@@ -99,9 +99,9 @@ def _verify_block(aw, rw, sw, hd, sc, comb, window_loader=None):
 
     acc_h, acc_s = lax.fori_loop(0, NWINDOWS, body, (acc0_h, acc0_s))
     rp = pt_add_cached(acc_s, pt_to_cached(acc_h))
-    enc = pt_encode_words(rp)
-    eq = jnp.all(enc == rw, axis=0)
-    return (eq & a_valid & (sc != 0)).astype(jnp.int32)
+    return final_check(
+        rp, rw, r_point, valid, r_canon, sc != 0
+    ).astype(jnp.int32)
 
 
 def _kernel(aw_ref, rw_ref, sw_ref, hd_ref, sc_ref, comb_ref, ktab_ref,

@@ -343,6 +343,21 @@ def fe_reduce_full(a: jnp.ndarray) -> jnp.ndarray:
     return _carry(c, NLIMB + 1)
 
 
+def limbs_lt_p(a: jnp.ndarray) -> jnp.ndarray:
+    """[20, *batch] CANONICAL-per-limb value (each limb < 2^13, e.g.
+    straight from limbs_from_words_le) -> [*batch] bool: value < p.
+
+    Unrolled most-significant-first compare (no cumprod/scan — the
+    helper must lower inside Pallas kernels)."""
+    p_col = _col(_P_LIMBS, a.ndim)
+    lt = jnp.zeros(a.shape[1:], bool)
+    all_eq = jnp.ones(a.shape[1:], bool)
+    for k in range(NLIMB - 1, -1, -1):
+        lt = lt | (all_eq & (a[k] < p_col[k]))
+        all_eq = all_eq & (a[k] == p_col[k])
+    return lt
+
+
 def _sqn(a: jnp.ndarray, n: int) -> jnp.ndarray:
     """a^(2^n): n chained squarings. Rolled for large n (small XLA graph,
     the loop body is one fused square); unrolled when tiny."""

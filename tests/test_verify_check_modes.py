@@ -1,0 +1,105 @@
+"""Equivalence of the two final-check formulations of the verify kernel
+(STELLARD_VERIFY_CHECK=bytes|point) against the Python oracle.
+
+`bytes` is the reference's exact verify shape (ref10 crypto_sign_open:
+encode([S]B + [h](-A)) and byte-compare against R). `point` replaces
+the inversion chain with a projective equality against the decompressed
+R plus an explicit canonical-y_r check. Consensus splits on ANY verdict
+divergence, so the corpus leans adversarial: non-canonical R encodings,
+x=0/sign=1 R, off-curve R, the classic small-order identity forgery
+(which ref10 semantics ACCEPT — both modes must too), corrupted
+R/S/key/message bytes, and non-canonical S.
+
+The env knob is read at kernel import, so each mode runs in a
+subprocess.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CASE_RUNNER = r'''
+import os, sys
+import numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %(repo)r)
+from stellard_tpu.ops import ed25519_ref as ref
+from stellard_tpu.ops.ed25519_jax import P, prepare_batch, verify_kernel
+from stellard_tpu.protocol.keys import KeyPair
+
+rng = np.random.default_rng(5)
+keys = [
+    KeyPair.from_seed(bytes(rng.integers(0, 256, 32, dtype=np.uint8)))
+    for _ in range(4)
+]
+pubs, msgs, sigs = [], [], []
+for i in range(24):
+    k = keys[i %% 4]
+    m = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+    s = bytearray(k.sign(m))
+    if i %% 5 == 1:
+        s[rng.integers(0, 64)] ^= 1 << rng.integers(0, 8)
+    pubs.append(k.public)
+    msgs.append(m)
+    sigs.append(bytes(s))
+
+ident = (1).to_bytes(32, "little")  # canonical identity-point encoding
+zero_s = bytes(32)
+m = b"\x42" * 32
+# non-canonical R encoding of the identity (y = 1 + p)
+pubs.append(ident); msgs.append(m)
+sigs.append((1 + P).to_bytes(32, "little") + zero_s)
+# x=0 with sign=1: invalid encoding
+x0s1 = bytearray(ident); x0s1[31] |= 0x80
+pubs.append(ident); msgs.append(m); sigs.append(bytes(x0s1) + zero_s)
+# canonical small-order forgery A=R=identity, S=0 (ref10 ACCEPTS this)
+pubs.append(ident); msgs.append(m); sigs.append(ident + zero_s)
+# off-curve R
+pubs.append(keys[0].public); msgs.append(m)
+sigs.append(b"\x17" * 32 + zero_s)
+# non-canonical S (l + small) on an otherwise-valid signature
+k = keys[1]; mm = b"\x55" * 32
+good = k.sign(mm)
+from stellard_tpu.ops.ed25519_ref import L as ED_L
+s_nc = int.from_bytes(good[32:], "little") + ED_L
+if s_nc < (1 << 256):
+    pubs.append(k.public); msgs.append(mm)
+    sigs.append(good[:32] + s_nc.to_bytes(32, "little"))
+
+want = np.array([ref.verify(p, mm, s) for p, mm, s in zip(pubs, msgs, sigs)])
+got = np.asarray(verify_kernel(**prepare_batch(pubs, msgs, sigs)))
+assert got.shape == want.shape
+assert (got == want).all(), (
+    os.environ.get("STELLARD_VERIFY_CHECK", "bytes"),
+    np.nonzero(got != want)[0].tolist(),
+)
+assert bool(want[26]) is True  # the forgery case IS accepted (ref10)
+print("OK", os.environ.get("STELLARD_VERIFY_CHECK", "bytes"), len(pubs))
+'''
+
+
+def _run(mode: str) -> str:
+    env = dict(os.environ)
+    env["STELLARD_VERIFY_CHECK"] = mode
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-u", "-c", _CASE_RUNNER % {"repo": REPO}],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    assert r.returncode == 0, (mode, r.stdout[-2000:], r.stderr[-2000:])
+    return r.stdout
+
+
+def test_bytes_mode_matches_oracle():
+    assert "OK bytes" in _run("bytes")
+
+
+def test_point_mode_matches_oracle():
+    assert "OK point" in _run("point")

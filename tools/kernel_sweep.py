@@ -41,13 +41,14 @@ def ensure_sigset():
              sigs=np.frombuffer(b"".join(sigs), np.uint8).reshape(N,64))
 
 def one_config(unroll, batches, comb="mxu", hoist=0, group=0, impl="xla",
-               block=512):
-    """Run one (unroll, comb-select, hoist, group, impl, batches)
+               block=512, check="bytes"):
+    """Run one (unroll, comb-select, hoist, group, impl, check, batches)
     measurement in a SUBPROCESS so each tunnel session is fresh and a
     wedge can't kill the sweep. Inputs are cycled across distinct sets
     so no layer can memoize identical submissions. impl="pallas" runs
     the whole-verify-in-VMEM kernel (ops/ed25519_pallas.py) with grid
-    block size `block`."""
+    block size `block`; check="point" runs the inversion-free projective
+    final check (stacked double-width decompress)."""
     code = f'''
 import os, sys, time
 import numpy as np
@@ -57,6 +58,7 @@ os.environ["STELLARD_COMB_SELECT"] = "{comb}"
 os.environ["STELLARD_HOIST_SELECT"] = "{hoist}"
 os.environ["STELLARD_GROUP_OPS"] = "{group}"
 os.environ["STELLARD_PALLAS_BLOCK"] = "{block}"
+os.environ["STELLARD_VERIFY_CHECK"] = "{check}"
 sys.path.insert(0, {REPO!r})
 import jax
 assert jax.devices()[0].platform != "cpu", "no tpu"
@@ -90,20 +92,20 @@ for batch in {batches}:
             [z["sigs"][i].tobytes() for i in idx],
         ))
     t0=time.time(); out = verify_kernel(**sets[0]); out.block_until_ready()
-    print(f"unroll={unroll} comb={comb} hoist={hoist} group={group} impl={impl} block={block} batch={{batch}} compile {{time.time()-t0:.0f}}s", flush=True)
+    print(f"unroll={unroll} comb={comb} hoist={hoist} group={group} impl={impl} block={block} check={check} batch={{batch}} compile {{time.time()-t0:.0f}}s", flush=True)
     assert np.asarray(out).all()
     t0=time.time(); n=0
     while time.time()-t0 < 5:
         verify_kernel(**sets[n % len(sets)]).block_until_ready(); n+=1
     dt=(time.time()-t0)/n
-    print(f"RESULT unroll={unroll} comb={comb} hoist={hoist} group={group} impl={impl} block={block} batch={{batch}} lat={{dt*1000:.1f}}ms rate={{batch/dt:,.0f}} sigs/s", flush=True)
+    print(f"RESULT unroll={unroll} comb={comb} hoist={hoist} group={group} impl={impl} block={block} check={check} batch={{batch}} lat={{dt*1000:.1f}}ms rate={{batch/dt:,.0f}} sigs/s", flush=True)
 '''
     try:
         r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                            text=True, timeout=1500)
     except subprocess.TimeoutExpired:
         print(f"unroll={unroll} comb={comb} hoist={hoist} group={group} "
-              f"impl={impl} block={block} batches={batches}: TIMED OUT "
+              f"impl={impl} block={block} check={check} batches={batches}: TIMED OUT "
               f"(wedged tunnel?) — skipping", flush=True)
         return False
     out = "\n".join(l for l in (r.stdout + r.stderr).splitlines()
@@ -122,6 +124,7 @@ for batch in {batches}:
                     "group": int(kv.get("group", 0)),
                     "impl": kv.get("impl", "xla"),
                     "block": int(kv.get("block", 512)),
+                    "check": kv.get("check", "bytes"),
                     "batch": int(kv["batch"]),
                     "rate": float(kv["rate"].replace(",", "")),
                 })
@@ -187,7 +190,7 @@ def write_tuning():
         return (r.get("unroll", 1), r.get("comb", "mxu"),
                 r.get("hoist", 0), r.get("group", 0),
                 r.get("impl", "xla"), r.get("block", 512),
-                r.get("batch"))
+                r.get("check", "bytes"), r.get("batch"))
     seen = {key(r) for r in rows}
     for r in prior:
         # normalize historical source-revision labels: "rowpad" IS the
@@ -216,6 +219,7 @@ def write_tuning():
             "group": best.get("group", 0),
             "impl": best.get("impl", "xla"),
             "block": best.get("block", 512),
+            "check": best.get("check", "bytes"),
             "batch": best["batch"],
             "rate": best["rate"],
             "all": RESULTS,
@@ -238,14 +242,18 @@ if __name__ == "__main__":
     # Measured 2026-07-31 (SWEEP_r04.log): hoist=0/group=0 @16384 =
     # 100.7k sigs/s (reproduces the a7910e1 winner); group=1 = 63.2k
     # (grouping is the regression); hoisted+grouped = 63.7k. Standing
-    # record: 103.4k @32768 (prior window). Remaining questions:
-    # 1) the Pallas whole-verify-in-VMEM kernel vs the XLA formulation:
+    # record: 103.4k @32768 (prior window). Remaining questions,
+    # ordered so a short window answers the biggest first:
+    # 1) the inversion-free projective final check (~15% fewer
+    #    sequential wide ops than the ref10 byte-compare shape):
+    one_config(1, [16384, 32768], check="point")
+    # 2) the Pallas whole-verify-in-VMEM kernel vs the XLA formulation:
     one_config(1, [16384], impl="pallas", block=512)
     one_config(1, [16384], impl="pallas", block=1024)
-    one_config(1, [16384], impl="pallas", block=256)
-    # 2) batch scaling of the XLA winner beyond the 32768 record:
+    one_config(1, [16384], impl="pallas", block=256, check="point")
+    # 3) batch scaling of the XLA winner beyond the 32768 record:
     one_config(1, [32768, 65536], group=0)
-    # 3) in-loop comb-select strategies at the winning defaults:
+    # 4) in-loop comb-select strategies at the winning defaults:
     one_config(1, [16384], comb="mxu_split")
     one_config(1, [16384], comb="vpu")
     write_tuning()  # before the (slow) tree bench: a wedge must not lose it
