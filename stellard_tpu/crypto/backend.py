@@ -577,14 +577,16 @@ def apply_kernel_tuning(path: str) -> Optional[dict]:
             "STELLARD_GROUP_OPS": str(int(t.get("group", 0))),
             "STELLARD_VERIFY_IMPL": str(t.get("impl", "xla")),
             "STELLARD_PALLAS_BLOCK": str(int(t.get("block", 512))),
-            "STELLARD_VERIFY_CHECK": str(t.get("check", "bytes")),
         }
         if values["STELLARD_VERIFY_IMPL"] not in ("xla", "pallas"):
             # a hand-edited file must not park a crash at the first
             # device batch (_resolve_kernel validates the same set)
             raise ValueError(values["STELLARD_VERIFY_IMPL"])
-        if values["STELLARD_VERIFY_CHECK"] not in ("bytes", "point"):
-            raise ValueError(values["STELLARD_VERIFY_CHECK"])
+        # NOTE: "check" (STELLARD_VERIFY_CHECK) is deliberately NOT
+        # auto-applied. Unlike the knobs above it changes the computed
+        # verify FUNCTION (byte-compare vs projective equality) — a
+        # consensus-semantics choice that must be an explicit operator
+        # decision (env var), never a perf-sweep side effect.
         int(t["batch"])  # validated for callers
     except (OSError, ValueError, KeyError, TypeError):
         return None

@@ -374,11 +374,14 @@ def decompress_inputs(aw, rw):
     op count as one chain). -> (a_point, r_point|None, valid,
     r_canonical|None); shared by the XLA and Pallas kernels."""
     if _VERIFY_CHECK == "point":
-        both = jnp.concatenate([aw, rw], axis=-1)  # [8, 2B]
-        pts, valids = pt_decompress(both)
-        b = aw.shape[-1]
-        a_point, r_point = pts[..., :b], pts[..., b:]
-        valid = valids[:b] & valids[b:]
+        # stack on a NEW axis (batch shape (2, B)), not along the batch:
+        # lane i of A and R stay together, so a batch-sharded meshed
+        # kernel keeps device locality (no resharding collectives
+        # around the double-width chain)
+        both = jnp.stack([aw, rw], axis=1)  # [8, 2, B]
+        pts, valids = pt_decompress(both)  # [4, 20, 2, B], [2, B]
+        a_point, r_point = pts[:, :, 0], pts[:, :, 1]
+        valid = valids[0] & valids[1]
         # byte-compare implicitly rejects non-canonical R encodings
         # (encode emits canonical y); the point check must do so
         # explicitly: y_r (sign bit already masked by the decoder's

@@ -204,7 +204,13 @@ def write_tuning():
         if key(r) not in seen:      # keep older rows not re-measured
             rows.append(r)
             seen.add(key(r))
-    best = max(rows, key=lambda r: r["rate"])
+    # the persisted WINNER must keep the reference verify semantics:
+    # check=point rows are recorded in "all" for the A/B evidence, but
+    # auto-applied tuning never flips the consensus-critical check mode
+    # (see crypto.backend.apply_kernel_tuning) — so the winner is the
+    # best bytes-mode row
+    bytes_rows = [r for r in rows if r.get("check", "bytes") == "bytes"]
+    best = max(bytes_rows or rows, key=lambda r: r["rate"])
     RESULTS[:] = rows
     # temp + rename: an interrupted dump must never leave a truncated
     # file for the driver's unattended bench.py to trip over. The file
@@ -247,10 +253,12 @@ if __name__ == "__main__":
     # 1) the inversion-free projective final check (~15% fewer
     #    sequential wide ops than the ref10 byte-compare shape):
     one_config(1, [16384, 32768], check="point")
-    # 2) the Pallas whole-verify-in-VMEM kernel vs the XLA formulation:
+    # 2) the Pallas whole-verify-in-VMEM kernel vs the XLA formulation
+    #    (same block set for both check modes — the comparison must not
+    #    confound formulation with block size):
     one_config(1, [16384], impl="pallas", block=512)
     one_config(1, [16384], impl="pallas", block=1024)
-    one_config(1, [16384], impl="pallas", block=256, check="point")
+    one_config(1, [16384], impl="pallas", block=512, check="point")
     # 3) batch scaling of the XLA winner beyond the 32768 record:
     one_config(1, [32768, 65536], group=0)
     # 4) in-loop comb-select strategies at the winning defaults:
