@@ -61,7 +61,8 @@ os.environ["STELLARD_PALLAS_BLOCK"] = "{block}"
 os.environ["STELLARD_VERIFY_CHECK"] = "{check}"
 sys.path.insert(0, {REPO!r})
 import jax
-assert jax.devices()[0].platform != "cpu", "no tpu"
+if os.environ.get("STELLARD_SWEEP_ALLOW_CPU") != "1":
+    assert jax.devices()[0].platform != "cpu", "no tpu"
 from stellard_tpu.utils.xlacache import enable_compilation_cache
 enable_compilation_cache()
 from stellard_tpu.ops.ed25519_jax import prepare_batch
