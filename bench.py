@@ -436,7 +436,6 @@ def bench_consensus_close(backends):
 def bench_replay(backends):
     """BASELINE config #5: ledger replay / catch-up throughput with
     hash_backend = cpu vs tpu (full SHAMap re-hash + tx re-apply)."""
-    from stellard_tpu.crypto import make_hasher
     from stellard_tpu.node.config import Config
     from stellard_tpu.node.ledgertools import replay_ledger
     from stellard_tpu.node.node import Node
@@ -462,7 +461,12 @@ def bench_replay(backends):
     rates = {}
     shares = {}
     for b in backends:
-        hasher = make_hasher(b)
+        # the node's exact hasher wiring (tpu rides the wedge watchdog:
+        # a tunnel that dies MID-LEG degrades this unattended run to the
+        # host path — flagged via device share — instead of hanging)
+        from stellard_tpu.crypto.backend import make_watched_hasher
+
+        hasher = make_watched_hasher(b)
         plane = VerifyPlane(backend=b, window_ms=1.0)
         # unmeasured warm-up: the first replay through a device hasher /
         # verifier compiles the masked/scatter + verify kernels — keep

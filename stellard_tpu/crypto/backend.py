@@ -550,6 +550,18 @@ class CppHasher(BatchHasher):
 register_hasher("cpp", CppHasher)
 
 
+def make_watched_hasher(backend: str) -> BatchHasher:
+    """The ONE wiring for a possibly-device hasher: the tpu backend is
+    wrapped in the wedge watchdog with a cpu fallback (a hung tunnel
+    must degrade, not freeze); host backends pass through untouched.
+    Used by the node and the bench legs so both always measure/run the
+    identical construction."""
+    hasher = make_hasher(backend)
+    if backend == "tpu":
+        hasher = WatchdogHasher(hasher, make_hasher("cpu"))
+    return hasher
+
+
 def apply_kernel_tuning(path: str) -> Optional[dict]:
     """Apply an on-chip sweep's winning kernel configuration
     (tools/kernel_sweep.py writes KERNEL_TUNING.json) as env defaults,
@@ -629,9 +641,20 @@ class WatchdogHasher(BatchHasher):
     def device_nodes(self):  # type: ignore[override]
         return self.inner.device_nodes
 
+    @device_nodes.setter
+    def device_nodes(self, value):  # counter reset (bench legs)
+        self.inner.device_nodes = value
+
     @property
     def host_nodes(self):  # type: ignore[override]
         return self.inner.host_nodes + self.fallback.host_nodes
+
+    @host_nodes.setter
+    def host_nodes(self, value):  # counter reset (bench legs)
+        # round-trips: getter sums inner + fallback, so the value goes
+        # to inner and the fallback share zeroes
+        self.inner.host_nodes = value
+        self.fallback.host_nodes = 0
 
     def _wedge(self, exc: Exception) -> None:
         from ..utils.devicewatch import log as dlog

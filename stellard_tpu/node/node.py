@@ -15,7 +15,6 @@ import threading
 import time
 from typing import Optional
 
-from ..crypto.backend import make_hasher
 from ..nodestore.core import make_database
 from ..protocol.keys import KeyPair, decode_seed
 from ..protocol.sttx import SerializedTransaction
@@ -167,13 +166,9 @@ class Node:
                     "[kernel_tuning] %s not found — running with "
                     "hardcoded kernel defaults", cfg.kernel_tuning,
                 )
-        self.hasher = make_hasher(cfg.hash_backend)
-        if cfg.hash_backend == "tpu":
-            # only the DEVICE hasher can wedge; host backends (cpp)
-            # must not share the device verdict or pay watchdog threads
-            from ..crypto.backend import WatchdogHasher
+        from ..crypto.backend import make_watched_hasher
 
-            self.hasher = WatchdogHasher(self.hasher, make_hasher("cpu"))
+        self.hasher = make_watched_hasher(cfg.hash_backend)
         self.verify_plane = VerifyPlane(
             backend=cfg.signature_backend,
             window_ms=cfg.verify_batch_window_ms,
