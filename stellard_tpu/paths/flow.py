@@ -110,6 +110,17 @@ def plan_strand(
             return
         if cur_currency == CURRENCY_XRP:
             raise PathError(TER.temBAD_PATH, "STR cannot ripple")
+        if (
+            not hops
+            and cur_acct == src
+            and cur_issuer not in (src, acct)
+        ):
+            # implied head: a spend of an externally-issued asset enters
+            # the network through its issuer (reference: expandPath
+            # inserts the SendMax issuer node after the source), so
+            # src -> [G1] -> M for a USD/G1 spend
+            hops.append(AccountHop(src, cur_issuer, cur_currency))
+            cur_acct = cur_issuer
         hops.append(AccountHop(cur_acct, acct, cur_currency))
         cur_acct = acct
         # an account node becomes the issuer context of the leg it

@@ -918,9 +918,15 @@ def do_ripple_path_find(ctx: Context) -> dict:
         dst = decode_account_id(p["destination_account"])
         dst_amount = _STA.from_json(p["destination_amount"])
         send_max = _STA.from_json(p["send_max"]) if "send_max" in p else None
-    except (KeyError, ValueError) as e:
+        # search_level bounds which cost-ranked shape-table rows run
+        # (reference: PathRequest's iLevel vs Config PATH_SEARCH knobs)
+        level = int(p.get("search_level", 0)) or None
+    except (KeyError, ValueError, TypeError) as e:
         raise RPCError("invalidParams", str(e))
-    alts = find_paths(led, src, dst, dst_amount, send_max=send_max)
+    kwargs = {"send_max": send_max}
+    if level is not None:
+        kwargs["level"] = level
+    alts = find_paths(led, src, dst, dst_amount, **kwargs)
     out = _ledger_ident(led)
     out["source_account"] = p["source_account"]
     out["destination_account"] = p["destination_account"]

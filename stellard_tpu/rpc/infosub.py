@@ -101,21 +101,36 @@ class SubscriptionManager:
 
     def _pub_path_updates(self, ledger: Ledger) -> None:
         from ..paths import find_paths
+        from ..paths.pathfinder import PATH_SEARCH_DEFAULT, PATH_SEARCH_FAST
+
         from ..protocol.stobject import STPathSet
 
         for sub in self._each():
             for rid, req in list(sub.path_requests.items()):
+                # level ramp (reference: PathRequest.cpp:370-379 —
+                # answer at PATH_SEARCH_FAST on the first update, then
+                # jump to the full PATH_SEARCH level)
+                level = (
+                    PATH_SEARCH_FAST
+                    if req.get("level", 0) < PATH_SEARCH_FAST
+                    else PATH_SEARCH_DEFAULT
+                )
+                req["level"] = level
                 try:
                     alts = find_paths(
                         ledger, req["src"], req["dst"], req["dst_amount"],
-                        send_max=req.get("send_max"),
+                        send_max=req.get("send_max"), level=level,
                     )
                 except Exception:  # noqa: BLE001 — a bad request must not kill publishing
                     continue
                 msg = {
                     "type": "path_find",
                     "id": rid,
-                    "full_reply": True,
+                    # only the full-depth search is a definitive answer;
+                    # the FAST first pass is marked partial so clients
+                    # wait for the deeper updates (reference:
+                    # PathRequest's iLastLevel / full_reply contract)
+                    "full_reply": level >= PATH_SEARCH_DEFAULT,
                     "ledger_index": ledger.seq,
                     "alternatives": [
                         {

@@ -595,6 +595,28 @@ class TestReferencePathTable:
                     ("M1", "10000.0", "1000/ABC/G3")],
         ).build()
 
+    def test_through_destination_wrong_currency(self):
+        """A path may pass THROUGH the destination in the wrong currency
+        and still complete (reference: addLink's 100000-priority
+        candidate + STPath::hasSeen matching on (account, currency,
+        issuer) triples, so the same account in another currency is not
+        'seen'): alice holds EUR issued by bob and pays bob USD through
+        bob's own EUR->USD book."""
+        led = Scenario(
+            accounts={"alice": "1000.0", "bob": "1000.0", "M1": "1000.0"},
+            trusts=["alice:100/EUR/bob", "M1:100/EUR/bob",
+                    "M1:100/USD/bob"],
+            ious=["alice:30/EUR/bob", "M1:50/USD/bob"],
+            offers=[("M1", "30/EUR/bob", "30/USD/bob")],
+        ).build()
+        alts = find_paths(
+            led, K("alice").account_id, K("bob").account_id,
+            amt("20/USD/bob"),
+        )
+        assert alts, "through-destination path not found"
+        spends = [a["source_amount"] for a in alts]
+        assert any(not s.is_native for s in spends), spends
+
     def test_t1_str_to_str_no_alternatives(self):
         """T1-A: STR->STR has no alternatives (native transfers don't
         path-find)."""

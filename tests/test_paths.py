@@ -52,8 +52,11 @@ class TestPlanStrand:
             ALICE.account_id, BOB.account_id, iou(10, CAROL),
             USD, CAROL.account_id, [PathElement(account=GATEWAY.account_id)],
         )
-        # alice -> G -> ... -> bob; final delivery may add the issuer
-        assert hops[0].dst == GATEWAY.account_id
+        # a USD/CAROL spend enters the network through CAROL (implied
+        # head; reference: expandPath inserts the SendMax issuer node),
+        # then the explicit gateway, then delivery to bob
+        assert hops[0].dst == CAROL.account_id
+        assert hops[1].dst == GATEWAY.account_id
         assert hops[-1].dst == BOB.account_id
 
     def test_cross_currency_inserts_book(self):

@@ -325,12 +325,25 @@ class TestPathFindSubscription:
             assert resp["status"] == "success", resp
             rid = resp["result"]["id"]
 
-            rpc(node, "ledger_accept")
             ws.sock.settimeout(10)
-            while True:
-                msg = ws.recv()
-                if msg.get("type") == "path_find":
-                    break
+
+            def next_path_find():
+                while True:
+                    msg = ws.recv()
+                    if msg.get("type") == "path_find":
+                        return msg
+
+            # first update answers at PATH_SEARCH_FAST and is marked
+            # partial; the next one runs the full search level
+            # (reference: PathRequest.cpp:370-379 + full_reply contract)
+            rpc(node, "ledger_accept")
+            msg = next_path_find()
+            assert msg["id"] == rid
+            assert msg["full_reply"] is False
+            assert "alternatives" in msg
+
+            rpc(node, "ledger_accept")
+            msg = next_path_find()
             assert msg["id"] == rid
             assert msg["full_reply"] is True
             assert "alternatives" in msg
