@@ -204,6 +204,22 @@ class TpuVerifier(BatchVerifier):
         # mesh+pallas small-batch bypass (set by _resolve_kernel)
         self._small_kernel = None
         self._mesh_floor = 0
+        # Pad policy: "pow2" compiles one XLA program per power-of-two
+        # bucket (proportional cost — right when compute scales with the
+        # batch, i.e. CPU test backends); "max" pads every chunk to
+        # max_batch so exactly ONE program shape ever compiles — right
+        # on TPU, where the kernel is latency-flat in batch size (PERF.md
+        # round-4 measurements) but every new shape costs a ~60s
+        # mid-traffic compile. "auto" (default) picks the platform in
+        # _resolve_kernel; until then pow2 is assumed, which only makes
+        # the wedge watchdog's first-call deadline conservative.
+        env = os.environ.get("STELLARD_PAD_POLICY", "auto")
+        if env not in ("auto", "pow2", "max"):
+            raise ValueError(
+                f"STELLARD_PAD_POLICY={env!r}: expected auto|pow2|max"
+            )
+        self._pad_policy_env = env
+        self.pad_policy = "pow2" if env != "max" else "max"
 
     def _resolve_kernel(self):
         if self._kernel is not None:
@@ -221,6 +237,10 @@ class TpuVerifier(BatchVerifier):
             )
         impl_pallas = impl == "pallas"
         devices = jax.devices()
+        if self._pad_policy_env == "auto":
+            self.pad_policy = (
+                "max" if devices[0].platform == "tpu" else "pow2"
+            )
         want_mesh = (
             self._use_mesh
             if self._use_mesh is not None
@@ -263,8 +283,9 @@ class TpuVerifier(BatchVerifier):
             self._kernel = verify_kernel
         return self._kernel
 
-    @staticmethod
-    def _pad_size(n: int, lo: int, hi: int) -> int:
+    def _pad_size(self, n: int, lo: int, hi: int) -> int:
+        if self.pad_policy == "max":
+            return hi
         size = lo
         while size < n and size < hi:
             size *= 2

@@ -28,6 +28,7 @@ __all__ = [
     "dump_transactions",
     "load_transactions",
     "replay_ledger",
+    "replay_range",
 ]
 
 
@@ -134,6 +135,8 @@ def replay_ledger(
     ledger_hash: bytes,
     hash_batch: Optional[Callable] = None,
     verify_many: Optional[Callable] = None,
+    _txs: Optional[list] = None,
+    _target: Optional[Ledger] = None,
 ) -> dict:
     """Re-close a stored ledger from its parent and verify the result
     hashes identically (reference: --ledger N --replay, Main.cpp:325-332).
@@ -149,10 +152,12 @@ def replay_ledger(
     the per-tx engine path skips its inline host verify. This is the
     catch-up trust model: replayed history is re-verified, batched."""
     kw = {"hash_batch": hash_batch} if hash_batch else {}
-    target = Ledger.load(db, ledger_hash, **kw)
+    target = _target if _target is not None else Ledger.load(
+        db, ledger_hash, **kw
+    )
     parent = Ledger.load(db, target.parent_hash, **kw)
 
-    txs = [
+    txs = _txs if _txs is not None else [
         SerializedTransaction.from_bytes(blob)
         for _txid, blob, _meta in target.tx_entries()
     ]
@@ -194,4 +199,61 @@ def replay_ledger(
         == target.state_map.get_hash(),
         "tx_hash_ok": replay.tx_map.get_hash() == target.tx_map.get_hash(),
         "results": {k.hex(): int(v) for k, v in results.items()},
+    }
+
+
+def replay_range(
+    db: Database,
+    ledger_hashes: list[bytes],
+    hash_batch: Optional[Callable] = None,
+    verify_many: Optional[Callable] = None,
+) -> dict:
+    """Bulk catch-up over a chain of stored ledgers.
+
+    The reference re-verifies acquired history per ledger because its
+    verify is a per-call host library (LedgerMaster/LedgerCleaner checks,
+    libsodium); on a latency-flat batch device the TPU-native formulation
+    verifies EVERY transaction signature across the whole range in ONE
+    kernel invocation up front, then re-applies ledger by ledger with the
+    verdicts memoized (the SF_SIGGOOD seam) — the bigger the catch-up
+    span, the further the batch rides up the device's throughput curve.
+    Verdict semantics are identical to per-ledger replay: a bad historic
+    signature still fails its own ledger's hash check, no other's."""
+    kw = {"hash_batch": hash_batch} if hash_batch else {}
+    t0 = time.perf_counter()
+    targets = [Ledger.load(db, h, **kw) for h in ledger_hashes]
+    per_ledger: list[list[SerializedTransaction]] = [
+        [
+            SerializedTransaction.from_bytes(blob)
+            for _txid, blob, _meta in target.tx_entries()
+        ]
+        for target in targets
+    ]
+    if verify_many is not None:
+        from ..crypto.backend import VerifyRequest
+
+        all_txs = [tx for txs in per_ledger for tx in txs]
+        if all_txs:
+            flags = verify_many([
+                VerifyRequest(
+                    tx.signing_pub_key, tx.signing_hash(), tx.signature
+                )
+                for tx in all_txs
+            ])
+            for tx, good in zip(all_txs, flags):
+                tx.set_sig_verdict(bool(good))
+    stats = [
+        replay_ledger(db, h, hash_batch=hash_batch, _txs=txs,
+                      _target=target)
+        for h, txs, target in zip(ledger_hashes, per_ledger, targets)
+    ]
+    elapsed = time.perf_counter() - t0
+    total = sum(s["tx_count"] for s in stats)
+    return {
+        "ok": all(s["ok"] for s in stats),
+        "ledger_count": len(stats),
+        "tx_count": total,
+        "elapsed_s": elapsed,
+        "tx_per_s": total / elapsed if elapsed > 0 else 0.0,
+        "ledgers": stats,
     }

@@ -175,6 +175,12 @@ class Node:
             max_batch=cfg.verify_max_batch,
             min_device_batch=cfg.verify_min_device_batch,
         )
+        self.verify_prewarm: Optional[threading.Thread] = None
+        if cfg.signature_backend != "cpu":
+            # compile + measure the device shapes in the background;
+            # traffic rides the CPU side until the chip is warm (a ~60s
+            # XLA compile must never stall a live batch)
+            self.verify_prewarm = self.verify_plane.start_prewarm()
 
         # executor (reference: JobQueue :287)
         self.job_queue = JobQueue(threads=cfg.thread_count())

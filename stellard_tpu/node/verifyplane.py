@@ -119,11 +119,14 @@ class _LatencyModel:
                 return self.device_bucket_ms[near]
             return None
 
-    def use_device(self, n: int) -> bool:
+    def use_device(self, n: int, count: bool = True) -> bool:
         """True when the device model predicts a win for this batch.
         Unmeasured sides are explored optimistically: the device gets
         tried once a batch reaches min_device_batch, after which real
-        measurements drive every later decision."""
+        measurements drive every later decision. `count=False` asks the
+        same question without advancing the re-exploration counter (the
+        coalescing-window decision polls this every wake-up and must not
+        inflate the re-explore cadence)."""
         if n < self.min_device_batch:
             return False
         dev = self.expected_device_ms(n)
@@ -134,7 +137,13 @@ class _LatencyModel:
             return False  # CPU unmeasured: measure it too
         if dev < cpu:
             return True
-        # periodic re-exploration so a stale loss can be unlearned
+        if not count:
+            return False
+        # periodic re-exploration so a stale loss can be unlearned — but
+        # only within striking distance: a ~300 ms kernel invocation must
+        # never be retried on a 64-sig batch it cannot possibly win
+        if cpu * 4.0 < dev:
+            return False
         with self.lock:
             self._since_device += 1
             if self._since_device >= self.REEXPLORE_EVERY:
@@ -175,6 +184,10 @@ class VerifyPlane:
         )
         self._warm_buckets: set[int] = set()
         self.device_wedged = False
+        # while a prewarm runs, traffic routes to the CPU side — the
+        # device must never pay its first (compile-laden) invocation on
+        # live batches
+        self._prewarm_pending = False
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -222,8 +235,10 @@ class VerifyPlane:
                 # CPU can clear immediately only adds latency)
                 if len(self._pending) < self.max_batch and (
                     self._device_capable
+                    and not self._prewarm_pending
                     and self.model.use_device(
-                        max(len(self._pending), self.min_device_batch)
+                        max(len(self._pending), self.min_device_batch),
+                        count=False,
                     )
                 ):
                     self._cv.wait(timeout=self.window)
@@ -273,11 +288,71 @@ class VerifyPlane:
     def _mark_warm(self, n: int) -> None:
         self._warm_buckets |= self._pad_buckets(n)
 
+    def start_prewarm(
+        self, sizes: Optional[Sequence[int]] = None, rounds: int = 2
+    ) -> threading.Thread:
+        """Compile and measure the device's pad-bucket shapes OFF the
+        traffic path. Until the thread finishes, every live batch routes
+        to the CPU side; afterwards the routing model holds real
+        steady-state device measurements (the first sample per bucket is
+        compile-laden and discarded by observe_device). The reference
+        needs no analog — libsodium is ready at link time; XLA
+        compilation is the TPU build's equivalent and belongs in node
+        startup, never inside live traffic. Join the returned thread for
+        a deterministic warm start (bench legs do)."""
+        if sizes is None:
+            # derive from this plane's own routing range: the smallest
+            # batch the model can route to the device and the largest it
+            # can coalesce — a configured min_device_batch must warm ITS
+            # pad bucket, not a hardcoded one (under the TPU "max" pad
+            # policy both collapse to the single canonical shape anyway)
+            lo = max(
+                self.min_device_batch,
+                getattr(self.verifier, "min_batch", self.min_device_batch),
+            )
+            sizes = sorted({lo, self.max_batch})
+        if self._device_capable:
+            self._prewarm_pending = True
+
+        def run() -> None:
+            try:
+                if not self._device_capable:
+                    return
+                req = VerifyRequest(b"\x66" * 32, b"\x77" * 32, b"\x88" * 64)
+                for size in sizes:
+                    reqs = [req] * size
+                    for _ in range(max(2, rounds)):
+                        t0 = time.perf_counter()
+                        call_with_deadline(
+                            lambda: self.verifier.verify_batch(reqs),
+                            self._device_deadline(size),
+                            label="verify-prewarm",
+                        )
+                        ms = (time.perf_counter() - t0) * 1000.0
+                        self._mark_warm(size)
+                        self.model.observe_device(size, ms)
+            except DeviceWedged as exc:
+                self._device_capable = False
+                self.device_wedged = True
+                log.error("verify prewarm: %s — device plane disabled", exc)
+            except Exception:  # noqa: BLE001 — a prewarm failure must not kill startup
+                log.exception("verify prewarm failed; device unwarmed")
+            finally:
+                self._prewarm_pending = False
+
+        t = threading.Thread(target=run, name="verify-prewarm", daemon=True)
+        t.start()
+        return t
+
     def verify_many(self, reqs: Sequence[VerifyRequest]) -> np.ndarray:
         if not reqs:
             return np.zeros(0, bool)
         n = len(reqs)
-        use_device = self._device_capable and self.model.use_device(n)
+        use_device = (
+            self._device_capable
+            and not self._prewarm_pending
+            and self.model.use_device(n)
+        )
         if use_device:
             t0 = time.perf_counter()
             try:

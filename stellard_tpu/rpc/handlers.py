@@ -918,9 +918,13 @@ def do_ripple_path_find(ctx: Context) -> dict:
         dst = decode_account_id(p["destination_account"])
         dst_amount = _STA.from_json(p["destination_amount"])
         send_max = _STA.from_json(p["send_max"]) if "send_max" in p else None
-        # search_level bounds which cost-ranked shape-table rows run
-        # (reference: PathRequest's iLevel vs Config PATH_SEARCH knobs)
-        level = int(p.get("search_level", 0)) or None
+        # search_level bounds which cost-ranked shape-table rows run;
+        # 0/absent means "use the default level" (reference: PathRequest
+        # treats iLevel 0 as unset, PathRequest.cpp:370-375)
+        level = int(p["search_level"]) if "search_level" in p else 0
+        if level < 0:
+            raise ValueError(f"search_level {level} out of range")
+        level = level or None
     except (KeyError, ValueError, TypeError) as e:
         raise RPCError("invalidParams", str(e))
     kwargs = {"send_max": send_max}

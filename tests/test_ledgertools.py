@@ -10,6 +10,7 @@ import pytest
 from stellard_tpu.engine.engine import TxParams
 from stellard_tpu.node.ledgermaster import LedgerMaster
 from stellard_tpu.node.ledgertools import (
+    replay_range,
     dump_ledger,
     dump_transactions,
     load_transactions,
@@ -130,3 +131,51 @@ class TestReplay:
 
         stats = replay_ledger(db, target.hash(), verify_many=spy_reject)
         assert not stats["ok"], "rejected signatures must fail the replay"
+
+    def test_replay_range_one_batch_for_the_whole_span(self, chain):
+        """Bulk catch-up (replay_range) verifies EVERY signature across
+        the ledger span in ONE verify_many call — the TPU-native
+        formulation of the reference's per-ledger history re-check —
+        and reproduces every ledger hash."""
+        _lm, db, ledgers, _accounts = chain
+        hashes = [l.hash() for l in ledgers[1:]]
+
+        calls = []
+
+        def spy_ok(reqs):
+            import numpy as np
+
+            calls.append(len(reqs))
+            return np.ones(len(reqs), bool)
+
+        stats = replay_range(db, hashes, verify_many=spy_ok)
+        assert stats["ok"], stats
+        assert stats["ledger_count"] == len(hashes)
+        assert calls == [stats["tx_count"]], "one batch for the whole SPAN"
+        assert stats["tx_count"] == sum(
+            s["tx_count"] for s in stats["ledgers"]
+        )
+
+    def test_replay_range_bad_sig_fails_only_its_ledger(self, chain):
+        """A rejected historic signature fails its own ledger's replay,
+        not the whole span — identical verdict semantics to per-ledger
+        replay."""
+        _lm, db, ledgers, _accounts = chain
+        hashes = [l.hash() for l in ledgers[1:]]
+
+        seen = {"n": 0}
+
+        def reject_first(reqs):
+            import numpy as np
+
+            out = np.ones(len(reqs), bool)
+            if seen["n"] == 0:
+                out[0] = False  # first tx of the span = first ledger's tx
+            seen["n"] += 1
+            return out
+
+        stats = replay_range(db, hashes, verify_many=reject_first)
+        assert not stats["ok"]
+        per = stats["ledgers"]
+        assert not per[0]["ok"], "the corrupted ledger fails"
+        assert all(s["ok"] for s in per[1:]), "later ledgers unaffected"

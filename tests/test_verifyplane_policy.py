@@ -147,3 +147,104 @@ class TestPlaneRouting:
             assert all(f.result(timeout=10) for f in futs)
         finally:
             plane.stop()
+
+
+class TestPrewarm:
+    def test_prewarm_gates_device_until_done_then_model_is_warm(self):
+        import threading
+
+        plane = VerifyPlane(backend="fake-device", min_device_batch=64,
+                            window_ms=1.0)
+        fake: FakeDeviceVerifier = plane.verifier  # type: ignore[assignment]
+        # hold the fake device until the live batch has been routed, so
+        # the "prewarm still pending" window is deterministic
+        gate = threading.Event()
+        orig = fake.verify_batch
+
+        def gated(batch):
+            gate.wait(10)
+            return orig(batch)
+
+        fake.verify_batch = gated  # type: ignore[method-assign]
+        try:
+            t = plane.start_prewarm(sizes=(256,), rounds=2)
+            # while the prewarm runs, a device-sized batch routes CPU
+            assert plane.verify_many(reqs(256)).all()
+            gate.set()
+            t.join(timeout=30)
+            assert not t.is_alive()
+            assert plane._prewarm_pending is False
+            # the prewarm compiled (discarded) + measured the bucket
+            assert plane.model.expected_device_ms(256) is not None
+            # prewarm traffic never pollutes the public counters
+            assert plane.device_sigs == 0
+            assert plane.verified == 256  # the one live batch above
+            # prewarm calls went to the fake device directly
+            assert fake.calls and all(c == 256 for c in fake.calls)
+        finally:
+            plane.stop()
+
+    def test_prewarm_on_cpu_backend_is_a_noop(self):
+        plane = VerifyPlane(backend="cpu")
+        try:
+            t = plane.start_prewarm(sizes=(64,))
+            t.join(timeout=10)
+            assert plane._prewarm_pending is False
+        finally:
+            plane.stop()
+
+
+class TestBoundedReexplore:
+    def test_hopeless_batches_never_reexplored(self):
+        m = _LatencyModel(min_device_batch=64)
+        m.observe_cpu(100, 1.0)  # 0.01 ms/sig
+        for _ in range(2):
+            m.observe_device(256, 500.0)  # device hopeless at this size
+        # 256 sigs = 2.56ms CPU vs 500ms device: outside the 4x band,
+        # so even REEXPLORE_EVERY calls never send it back to the device
+        for _ in range(m.REEXPLORE_EVERY * 2 + 5):
+            assert not m.use_device(256)
+
+    def test_close_losses_are_reexplored(self):
+        m = _LatencyModel(min_device_batch=64)
+        m.observe_cpu(100, 30.0)  # 0.3 ms/sig
+        for _ in range(2):
+            m.observe_device(256, 100.0)  # 77ms CPU vs 100ms device
+        hits = sum(
+            m.use_device(256) for _ in range(m.REEXPLORE_EVERY + 5)
+        )
+        assert hits == 1  # exactly one periodic re-exploration
+
+    def test_window_poll_does_not_advance_reexplore(self):
+        m = _LatencyModel(min_device_batch=64)
+        m.observe_cpu(100, 30.0)
+        for _ in range(2):
+            m.observe_device(256, 100.0)
+        for _ in range(m.REEXPLORE_EVERY * 3):
+            assert not m.use_device(256, count=False)
+        assert m._since_device == 0
+
+
+class TestPadPolicy:
+    def test_max_policy_pads_every_chunk_to_one_shape(self, monkeypatch):
+        monkeypatch.setenv("STELLARD_PAD_POLICY", "max")
+        from stellard_tpu.crypto.backend import TpuVerifier
+
+        v = TpuVerifier(min_batch=256, max_batch=16384)
+        assert v._pad_size(5, 256, 16384) == 16384
+        assert v._pad_size(5000, 256, 16384) == 16384
+
+    def test_pow2_policy_keeps_proportional_buckets(self, monkeypatch):
+        monkeypatch.setenv("STELLARD_PAD_POLICY", "pow2")
+        from stellard_tpu.crypto.backend import TpuVerifier
+
+        v = TpuVerifier(min_batch=256, max_batch=16384)
+        assert v._pad_size(5, 256, 16384) == 256
+        assert v._pad_size(5000, 256, 16384) == 8192
+
+    def test_bad_policy_rejected(self, monkeypatch):
+        monkeypatch.setenv("STELLARD_PAD_POLICY", "bogus")
+        from stellard_tpu.crypto.backend import TpuVerifier
+
+        with pytest.raises(ValueError):
+            TpuVerifier()
