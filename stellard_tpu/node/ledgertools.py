@@ -130,6 +130,23 @@ def load_transactions(
     return applied, failed
 
 
+def _reverify_memoized(txs: list, verify_many: Callable) -> None:
+    """Re-verify a tx list's signatures in ONE batched call and memoize
+    the verdicts (the HashRouter SF_SIGGOOD seam) — the single shape of
+    the catch-up trust model, shared by per-ledger replay and bulk
+    replay_range."""
+    if not txs:
+        return
+    from ..crypto.backend import VerifyRequest
+
+    flags = verify_many([
+        VerifyRequest(tx.signing_pub_key, tx.signing_hash(), tx.signature)
+        for tx in txs
+    ])
+    for tx, good in zip(txs, flags):
+        tx.set_sig_verdict(bool(good))
+
+
 def replay_ledger(
     db: Database,
     ledger_hash: bytes,
@@ -162,15 +179,8 @@ def replay_ledger(
         for _txid, blob, _meta in target.tx_entries()
     ]
     t0 = time.perf_counter()
-    if verify_many is not None and txs:
-        from ..crypto.backend import VerifyRequest
-
-        flags = verify_many([
-            VerifyRequest(tx.signing_pub_key, tx.signing_hash(), tx.signature)
-            for tx in txs
-        ])
-        for tx, good in zip(txs, flags):
-            tx.set_sig_verdict(bool(good))
+    if verify_many is not None:
+        _reverify_memoized(txs, verify_many)
     replay = parent.open_successor()
     txset = CanonicalTXSet(parent.hash())
     for tx in txs:
@@ -230,18 +240,9 @@ def replay_range(
         for target in targets
     ]
     if verify_many is not None:
-        from ..crypto.backend import VerifyRequest
-
-        all_txs = [tx for txs in per_ledger for tx in txs]
-        if all_txs:
-            flags = verify_many([
-                VerifyRequest(
-                    tx.signing_pub_key, tx.signing_hash(), tx.signature
-                )
-                for tx in all_txs
-            ])
-            for tx, good in zip(all_txs, flags):
-                tx.set_sig_verdict(bool(good))
+        _reverify_memoized(
+            [tx for txs in per_ledger for tx in txs], verify_many
+        )
     stats = [
         replay_ledger(db, h, hash_batch=hash_batch, _txs=txs,
                       _target=target)

@@ -301,16 +301,22 @@ class VerifyPlane:
         startup, never inside live traffic. Join the returned thread for
         a deterministic warm start (bench legs do)."""
         if sizes is None:
-            # derive from this plane's own routing range: the smallest
-            # batch the model can route to the device and the largest it
-            # can coalesce — a configured min_device_batch must warm ITS
-            # pad bucket, not a hardcoded one (under the TPU "max" pad
-            # policy both collapse to the single canonical shape anyway)
+            # derive from this plane's own routing range: every pad
+            # bucket between the smallest batch the model can route to
+            # the device and the largest it can coalesce — live traffic
+            # must find EVERY shape warm (under the TPU "max" pad
+            # policy the whole ladder collapses to one canonical shape)
             lo = max(
                 self.min_device_batch,
                 getattr(self.verifier, "min_batch", self.min_device_batch),
             )
-            sizes = sorted({lo, self.max_batch})
+            ladder = []
+            size = lo
+            while size < self.max_batch:
+                ladder.append(size)
+                size *= 2
+            ladder.append(self.max_batch)
+            sizes = sorted(set(ladder))
         if self._device_capable:
             self._prewarm_pending = True
 

@@ -479,15 +479,20 @@ def verify_kernel(a_words, r_words, s_windows, h_digits, s_canonical):
 
     a_words: [B, 8] u32 public keys (LE words)
     r_words: [B, 8] u32 signature R
-    s_windows: [B, 64] int32 unsigned 4-bit windows of S (LSB first)
-    h_digits: [B, 64] int32 SIGNED 4-bit digits of h in [-8, 7] (LSB first)
+    s_windows: [B, 64] int32/int8 unsigned 4-bit windows of S (LSB first)
+    h_digits: [B, 64] int32/int8 SIGNED 4-bit digits of h in [-8, 7]
+        (LSB first)
     s_canonical: [B] bool (S < l, checked host-side)
     -> [B] bool
+
+    The digit arrays may arrive narrow (int8 — prepare_batch's wire
+    format: 4-bit values in int32 tripled the host->device transfer for
+    nothing) and are widened here, ON DEVICE, before use.
     """
     aw = jnp.transpose(a_words)  # [8, B]
     rw = jnp.transpose(r_words)
-    sw = jnp.transpose(s_windows)  # [64, B]
-    hd = jnp.transpose(h_digits)
+    sw = jnp.transpose(s_windows).astype(jnp.int32)  # [64, B]
+    hd = jnp.transpose(h_digits).astype(jnp.int32)
 
     a_point, r_point, valid, r_canon = decompress_inputs(aw, rw)
     comb = jnp.asarray(_comb_table_np())  # [64, 60, 16] f32
@@ -631,17 +636,20 @@ def _native_prep():
 
 
 def _nibbles_le(b: np.ndarray) -> np.ndarray:
-    """[B, 32] uint8 LE scalar bytes -> [B, 64] int32 4-bit windows,
-    LSB window first."""
+    """[B, 32] uint8 LE scalar bytes -> [B, 64] int8 4-bit windows,
+    LSB window first. int8 is the WIRE dtype (the kernel widens on
+    device): 4-bit values shipped as int32 made the host->device
+    transfer — the tunnel's scarce resource — 3x larger for nothing."""
     lo = b & 0xF
     hi = b >> 4
-    return np.stack([lo, hi], axis=-1).reshape(b.shape[0], 64).astype(np.int32)
+    return np.stack([lo, hi], axis=-1).reshape(b.shape[0], 64).astype(np.int8)
 
 
 def _signed_digits_le(b: np.ndarray) -> np.ndarray:
-    """[B, 32] uint8 LE scalar bytes -> [B, 64] int32 signed 4-bit digits
-    in [-8, 7], LSB first. Valid for scalars < 2^253 (top digit + final
-    carry stays < 8, so no 65th digit is needed)."""
+    """[B, 32] uint8 LE scalar bytes -> [B, 64] int8 signed 4-bit digits
+    in [-8, 7], LSB first (int8 is the wire dtype, inherited from
+    _nibbles_le; the kernel widens on device). Valid for scalars < 2^253
+    (top digit + final carry stays < 8, so no 65th digit is needed)."""
     nib = _nibbles_le(b)
     out = np.empty_like(nib)
     carry = np.zeros(nib.shape[0], np.int32)

@@ -140,7 +140,8 @@ import numpy as np
 os.environ.pop("JAX_PLATFORMS", None)
 sys.path.insert(0, {REPO!r})
 import jax
-assert jax.devices()[0].platform != "cpu", "no tpu"
+if os.environ.get("STELLARD_SWEEP_ALLOW_CPU") != "1":
+    assert jax.devices()[0].platform != "cpu", "no tpu"
 from stellard_tpu.utils.xlacache import enable_compilation_cache
 enable_compilation_cache()
 from stellard_tpu.crypto.backend import make_hasher
@@ -172,6 +173,59 @@ for n_leaves in (1000, 5000):
         return
     print("\n".join(l for l in (r.stdout+r.stderr).splitlines()
                     if "WARNING" not in l and l.strip()), flush=True)
+    if r.returncode != 0 or "RESULT treehash" not in r.stdout:
+        # a silent miss here cost two windows of the one unmeasured
+        # number the replay leg hinges on — make the failure loud
+        print("treehash bench FAILED (no RESULT rows)", flush=True)
+
+def transfer_probe():
+    """Host->device transfer rate for one prepared verify batch — the
+    e2e headline's unexplained gap (14.5k e2e vs 96.6k device-only in
+    the contaminated r4 window) points at the tunnel's transfer path;
+    this measures it directly, for the narrow (int8 digit) wire format."""
+    code = f'''
+import os, sys, time
+import numpy as np
+os.environ.pop("JAX_PLATFORMS", None)
+sys.path.insert(0, {REPO!r})
+import jax
+if os.environ.get("STELLARD_SWEEP_ALLOW_CPU") != "1":
+    assert jax.devices()[0].platform != "cpu", "no tpu"
+from stellard_tpu.ops.ed25519_jax import prepare_batch
+z = np.load("{CACHE}")
+B = 16384
+idx = list(range(B))
+inputs = prepare_batch(
+    [z["pubs"][i % len(z["pubs"])].tobytes() for i in idx],
+    [z["msgs"][i % len(z["msgs"])].tobytes() for i in idx],
+    [z["sigs"][i % len(z["sigs"])].tobytes() for i in idx],
+    device_put=False,
+)
+nbytes = sum(np.asarray(v).nbytes for v in inputs.values())
+import jax.numpy as jnp
+# one warm put, then timed puts of fresh host copies
+for _ in range(2):
+    res = {{k: jnp.asarray(v) for k, v in inputs.items()}}
+    jax.block_until_ready(list(res.values()))
+t0 = time.time(); n = 0
+while time.time() - t0 < 5:
+    res = {{k: jnp.asarray(np.ascontiguousarray(v)) for k, v in inputs.items()}}
+    jax.block_until_ready(list(res.values()))
+    n += 1
+dt = (time.time() - t0) / n
+print(f"RESULT transfer batch={{B}} bytes={{nbytes}} per_put={{dt*1000:.1f}}ms rate={{nbytes/dt/1e6:.1f}} MB/s", flush=True)
+'''
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=900)
+    except subprocess.TimeoutExpired:
+        print("transfer probe TIMED OUT — skipping", flush=True)
+        return
+    print("\n".join(l for l in (r.stdout + r.stderr).splitlines()
+                    if "WARNING" not in l and l.strip()), flush=True)
+    if r.returncode != 0 or "RESULT transfer" not in r.stdout:
+        print("transfer probe FAILED (no RESULT row)", flush=True)
+
 
 def write_tuning():
     if not RESULTS:
@@ -258,13 +312,24 @@ if __name__ == "__main__":
     #    (same block set for both check modes — the comparison must not
     #    confound formulation with block size):
     one_config(1, [16384], impl="pallas", block=512)
-    one_config(1, [16384], impl="pallas", block=1024)
-    one_config(1, [16384], impl="pallas", block=512, check="point")
-    # 3) batch scaling of the XLA winner beyond the 32768 record:
-    one_config(1, [32768, 65536], group=0)
-    # 4) in-loop comb-select strategies at the winning defaults:
-    one_config(1, [16384], comb="mxu_split")
-    one_config(1, [16384], comb="vpu")
-    write_tuning()  # before the (slow) tree bench: a wedge must not lose it
+    write_tuning()  # interim: a wedge below must not lose what's measured
+    # 2b) host->device transfer rate (is the e2e headline
+    #     transfer-bound over the tunnel?)
+    transfer_probe()
+    # 3) tree-hash first/warm timings — NEVER yet measured on-chip
+    #    (dropped by wedges in both r4 windows) and the replay leg's
+    #    device share hinges on them; ahead of the remaining verify A/Bs
     tree_hash_bench()
+    one_config(1, [16384], impl="pallas", block=1024)
+    write_tuning()  # interim after every late config: the 5400s outer
+    one_config(1, [16384], impl="pallas", block=512, check="point")
+    write_tuning()  # deadline must never lose a completed measurement
+    # 4) batch scaling of the XLA winner beyond the 32768 record:
+    one_config(1, [32768, 65536], group=0)
+    write_tuning()
+    # 5) in-loop comb-select strategies at the winning defaults:
+    one_config(1, [16384], comb="mxu_split")
+    write_tuning()
+    one_config(1, [16384], comb="vpu")
+    write_tuning()
     print("SWEEP DONE", flush=True)
