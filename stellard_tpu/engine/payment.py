@@ -149,19 +149,17 @@ class PaymentTransactor(Transactor):
 
         issuer = dst_amount.issuer
         if issuer != self.account_id and issuer != dst_id:
-            # third-party IOU: sender must hold the issuer's IOUs
-            held = views.ripple_balance(
-                self.les, self.account_id, issuer, dst_amount.currency
-            )
-            fee = views.ripple_transfer_fee(
-                self.les, self.account_id, dst_id, issuer, dst_amount
-            )
-            total = dst_amount + fee if not fee.is_zero() else dst_amount
-            if held < STAmount.from_iou(held.currency, held.issuer,
-                                        total.mantissa, total.offset,
-                                        total.negative):
-                return TER.tecPATH_PARTIAL
-        elif issuer == self.account_id:
+            # third-party issuer: the default path is a real two-hop
+            # ripple (sender -> issuer -> destination) whose legality
+            # depends on line state BOTH ways — the sender may redeem
+            # held IOUs or ISSUE into a line the intermediary trusts,
+            # and the intermediary's transfer rate and line qualities
+            # apply. That is the flow engine's job (reference: Payment
+            # routes every non-direct case through RippleCalc,
+            # Payment.cpp:185-248); a held-balance precheck here
+            # wrongly rejected issue-along-line deliveries.
+            return self._flow_payment(dst_id, dst_amount, max_amount, flags)
+        if issuer == self.account_id:
             # issuing own IOUs: delivery must fit the destination's trust
             # limit (the RippleCalc credit-limit rule on the default path)
             line_idx = indexes.ripple_state_index(

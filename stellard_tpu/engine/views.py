@@ -248,6 +248,42 @@ def ripple_transfer_rate(les: LedgerEntrySet, issuer_id: bytes) -> int:
     return rate if rate else QUALITY_ONE
 
 
+def ripple_quality(
+    les: LedgerEntrySet,
+    to_id: bytes,
+    from_id: bytes,
+    currency: bytes,
+    inbound: bool,
+) -> int:
+    """`to_id`'s QualityIn (inbound=True) or QualityOut on its line with
+    `from_id`, 1e9 = parity; parity when absent / no line / self
+    (reference: LedgerEntrySet::rippleQualityIn/Out,
+    LedgerEntrySet.cpp:1225 — field picked from to_id's side of the
+    line, zero clamped to 1 against divide-by-zero)."""
+    from ..protocol.sfields import (
+        sfHighQualityIn,
+        sfHighQualityOut,
+        sfLowQualityIn,
+        sfLowQualityOut,
+    )
+    from ..state import indexes as _ix
+
+    if to_id == from_id:
+        return QUALITY_ONE
+    line = les.peek(_ix.ripple_state_index(to_id, from_id, currency))
+    if line is None:
+        return QUALITY_ONE
+    is_low = to_id < from_id
+    if inbound:
+        field = sfLowQualityIn if is_low else sfHighQualityIn
+    else:
+        field = sfLowQualityOut if is_low else sfHighQualityOut
+    q = line.get(field, 0)
+    if not q:
+        q = QUALITY_ONE if field not in line else 1
+    return q
+
+
 def ripple_transfer_fee(les: LedgerEntrySet, sender_id: bytes,
                         receiver_id: bytes, issuer_id: bytes,
                         amount: STAmount) -> STAmount:

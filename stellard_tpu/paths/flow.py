@@ -297,6 +297,29 @@ def book_quote(
     return in_total, out_total
 
 
+def _node_qualities(
+    les: LedgerEntrySet, hops: list, i: int, src: bytes
+) -> tuple[int, int]:
+    """(qualityIn, qualityOut) at the node SENDING hop i (an interior
+    AccountHop), 1e9 = parity (reference: calcNodeAccountRev's
+    rippleQualityIn/Out lookups, RippleCalc.cpp:1419-1424). qualityIn
+    covers the line the value arrived over — defined only when the
+    previous hop is an account-to-account ripple (the reference's
+    account-adjacent-to-account node shape; book boundaries carry no
+    line quality); qualityOut covers the line to this hop's receiver."""
+    hop = hops[i]
+    prev = hops[i - 1] if i > 0 else None
+    if not isinstance(prev, AccountHop) or hop.src == src:
+        return views.QUALITY_ONE, views.QUALITY_ONE
+    qin = views.ripple_quality(
+        les, hop.src, prev.src, hop.currency, inbound=True
+    )
+    qout = views.ripple_quality(
+        les, hop.src, hop.dst, hop.currency, inbound=False
+    )
+    return qin, qout
+
+
 # -- forward execution ----------------------------------------------------
 
 
@@ -353,6 +376,24 @@ def execute_strand(
                     need = STAmount.multiply(
                         need,
                         STAmount.from_iou(_CUR_ONE, ACCOUNT_ONE, rate, -9),
+                        need.currency,
+                        need.issuer,
+                    )
+                # line-quality fee at the interior node (reference:
+                # calcNodeRipple — in = out * qualityOut/qualityIn when
+                # qualityIn < qualityOut, never a bonus): the node rates
+                # inbound IOUs from the previous account by ITS OWN
+                # QualityIn on that line, and its forwarding to the next
+                # by its QualityOut
+                qin, qout = _node_qualities(les, hops, i, src)
+                if qin < qout:
+                    need = STAmount.multiply(
+                        STAmount.divide(
+                            need,
+                            STAmount.from_iou(_CUR_ONE, ACCOUNT_ONE, qin, -9),
+                            need.currency, need.issuer,
+                        ),
+                        STAmount.from_iou(_CUR_ONE, ACCOUNT_ONE, qout, -9),
                         need.currency,
                         need.issuer,
                     )
@@ -419,6 +460,21 @@ def execute_strand(
                         STAmount.from_iou(_CUR_ONE, ACCOUNT_ONE, rate, -9),
                         carried.currency,
                         carried.issuer,
+                    )
+                # line-quality fee (mirror of the reverse pass): the
+                # node forwards in * qualityIn/qualityOut of what
+                # arrived when qualityIn < qualityOut
+                qin, qout = _node_qualities(les, hops, i, src)
+                if qin < qout:
+                    usable = STAmount.divide(
+                        STAmount.multiply(
+                            usable,
+                            STAmount.from_iou(_CUR_ONE, ACCOUNT_ONE, qin, -9),
+                            usable.currency, usable.issuer,
+                        ),
+                        STAmount.from_iou(_CUR_ONE, ACCOUNT_ONE, qout, -9),
+                        usable.currency,
+                        usable.issuer,
                     )
                 deliver = min(deliver, usable)
             else:

@@ -682,3 +682,100 @@ class TestReferencePathTable:
         a = alts[0]["source_amount"]
         assert not a.is_native
         assert a.value_text() == "1"
+
+
+class TestLineQualities:
+    """Trust-line QualityIn/QualityOut applied during rippling
+    (reference: calcNodeRipple, RippleCalc.cpp:1253 — an interior node
+    forwards in * qualityIn/qualityOut when qualityIn < qualityOut,
+    never a bonus; qualities read from the node's own side of each
+    line, LedgerEntrySet::rippleQualityIn/Out)."""
+
+    def _ledger(self, qin, qout):
+        led = Scenario(
+            accounts={"alice": "1000.0", "mid": "1000.0", "bob": "1000.0"},
+            trusts=["mid:1000/USD/alice", "bob:1000/USD/mid"],
+        ).build()
+        from stellard_tpu.engine.engine import TransactionEngine, TxParams
+        from stellard_tpu.protocol.sfields import (
+            sfLimitAmount,
+            sfQualityIn,
+            sfQualityOut,
+            sfSequence,
+        )
+        from stellard_tpu.protocol.sttx import SerializedTransaction
+
+        engine = TransactionEngine(led)
+        mid = K("mid")
+        seq = led.account_root(mid.account_id)[sfSequence]
+        for limit, fields in (
+            ("1000/USD/alice", {sfQualityIn: qin}),
+            ("1/USD/bob", {sfQualityOut: qout}),
+        ):
+            tx = SerializedTransaction.build(
+                TxType.ttTRUST_SET, mid.account_id, seq, 10
+            )
+            tx.obj[sfLimitAmount] = amt(limit)
+            for f, v in fields.items():
+                tx.obj[f] = v
+            tx.sign(mid)
+            ter, did = engine.apply_transaction(tx, TxParams.NONE)
+            assert ter == TER.tesSUCCESS, ter
+            assert did
+            seq += 1
+        return led
+
+    def test_quality_out_charges_the_fee(self):
+        """mid rates its outbound line to bob at 2.0: delivering 10 to
+        bob consumes 20 arriving at mid."""
+        led = self._ledger(qin=1_000_000_000, qout=2_000_000_000)
+        ter, spent, got = pay_via_paths(
+            led, "alice", "bob", "10/USD/mid", send_max="50/USD/alice"
+        )
+        assert ter == TER.tesSUCCESS, ter
+        assert text(got) == "10", text(got)
+        assert text(spent) == "20", text(spent)
+
+    def test_quality_in_discount_is_never_a_bonus(self):
+        """qualityIn > qualityOut is the no-fee branch: 1:1, never a
+        multiplier below one (reference: calcNodeRipple 'No fees')."""
+        led = self._ledger(qin=2_000_000_000, qout=1_000_000_000)
+        ter, spent, got = pay_via_paths(
+            led, "alice", "bob", "10/USD/mid", send_max="50/USD/alice"
+        )
+        assert ter == TER.tesSUCCESS, ter
+        assert text(got) == "10"
+        assert text(spent) == "10", text(spent)
+
+    def test_parity_qualities_change_nothing(self):
+        led = self._ledger(qin=1_000_000_000, qout=1_000_000_000)
+        ter, spent, got = pay_via_paths(
+            led, "alice", "bob", "10/USD/mid", send_max="50/USD/alice"
+        )
+        assert ter == TER.tesSUCCESS, ter
+        assert text(got) == "10"
+        assert text(spent) == "10"
+
+
+class TestThirdPartyIssuerDefaultPath:
+    def test_issue_along_line_without_held_balance(self):
+        """A sender holding NONE of the issuer's IOUs can still deliver
+        a third-party-issuer amount by ISSUING into a line the
+        intermediary trusts (reference: the default path runs through
+        RippleCalc, which permits issuance up to the line limit — a
+        held-balance precheck wrongly rejected this shape)."""
+        led = Scenario(
+            accounts={"alice": "1000.0", "mid": "1000.0", "bob": "1000.0"},
+            trusts=["mid:1000/USD/alice", "bob:1000/USD/mid"],
+        ).build()
+        ter = pay_tx(led, "alice", "bob", "10/USD/mid",
+                     send_max="50/USD/alice")
+        assert ter == TER.tesSUCCESS, ter
+        les = LedgerEntrySet(led)
+        USD = currency_from_iso("USD")
+        assert views.ripple_balance(
+            les, K("bob").account_id, K("mid").account_id, USD
+        ).value_text() == "10"
+        assert views.ripple_balance(
+            les, K("mid").account_id, K("alice").account_id, USD
+        ).value_text() == "10"
