@@ -18,9 +18,12 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-LOG = os.path.join(REPO, "SWEEP_r04.log")
+LOG = os.path.join(REPO, "SWEEP_r05.log")
 PROBE_TIMEOUT = 120
-PROBE_INTERVAL = 300
+# a wedged probe HANGS its full timeout, so the down-cycle is already
+# PROBE_TIMEOUT + interval; r4's windows were as short as ~8 min, and a
+# 300s interval can eat half a window before the first UP probe lands
+PROBE_INTERVAL = 60
 RUN_TIMEOUT = 5400  # sweep/bench can compile for ~3min/shape; a wedge hangs forever
 
 
