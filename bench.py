@@ -145,9 +145,14 @@ def _payments(master, n, start_seq=1, dests=16):
             for i in range(dests)]
     txs = []
     for i in range(n):
+        # 250 STR: above the 200 STR genesis reserve, so the first payment
+        # to each destination CREATES the account and every later one is a
+        # real transfer. (1 STR payments tec'd with NO_DST_INSUF_STR on
+        # every close — a fee-claim flood that also ran the close apply
+        # twice per tx via the forced final pass.)
         tx = SerializedTransaction.build(
             TxType.ttPAYMENT, master.account_id, start_seq + i, 10,
-            {sfAmount: STAmount.from_drops(1_000_000),
+            {sfAmount: STAmount.from_drops(250_000_000),
              sfDestination: outs[i % dests]},
         )
         tx.sign(master)

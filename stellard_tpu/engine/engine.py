@@ -31,6 +31,12 @@ class TxParams(IntFlag):
     NO_CHECK_SIGN = 0x01  # tapNO_CHECK_SIGN
 
 
+# int mirrors of TxParams (enum & is slow in the apply hot path); derived
+# from the enum so they can never drift from it
+_OPEN_LEDGER_I = int(TxParams.OPEN_LEDGER)
+_RETRY_I = int(TxParams.RETRY)
+
+
 def _is_tec(ter: TER) -> bool:
     return 100 <= int(ter) < 300
 
@@ -81,7 +87,7 @@ class TransactionEngine:
 
         if ter == TER.tesSUCCESS:
             did_apply = True
-        elif _is_tec(ter) and not (params & TxParams.RETRY):
+        elif _is_tec(ter) and not (params & _RETRY_I):
             # claim only the fee (reference: TransactionEngine.cpp:146-185)
             self.les = LedgerEntrySet(self.ledger)
             idx = indexes.account_root_index(tx.account)
@@ -110,7 +116,7 @@ class TransactionEngine:
             if not self._check_invariants(tx, params, minted):
                 return TER.tefINTERNAL, False
             blob = tx.serialize()
-            if params & TxParams.OPEN_LEDGER:
+            if params & _OPEN_LEDGER_I:
                 txid, added = self.ledger.add_open_transaction(blob)
                 if not added:
                     return TER.tefALREADY, False
@@ -121,6 +127,7 @@ class TransactionEngine:
                 meta = self.les.calc_meta(ter, self.tx_seq, self.ledger.seq, tx.txid())
                 self.tx_seq += 1
                 self.ledger.add_transaction(blob, meta.serialize())
+                self.ledger.parsed_metas[tx.txid()] = meta
                 # deferred header mutations (Inflation/SetFee), applied
                 # only now that the invariant gate has passed
                 hc = getattr(transactor, "header_changes", {})
@@ -146,7 +153,7 @@ class TransactionEngine:
         change must equal minted coins minus the fee. The reference's
         checkInvariants is an empty stub (TransactionCheck.cpp:26-32); this
         enforces the conservation law it gestures at."""
-        if params & TxParams.OPEN_LEDGER:
+        if params & _OPEN_LEDGER_I:
             return True
         from ..protocol.sfields import sfBalance as _bal
         from ..state.entryset import Action

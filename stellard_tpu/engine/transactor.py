@@ -35,6 +35,16 @@ __all__ = ["Transactor", "register_transactor", "make_transactor"]
 
 _REGISTRY: dict[TxType, Type["Transactor"]] = {}
 
+# TxParams flag values as plain ints: `int & IntFlag` falls into
+# IntFlag.__rand__ (enum-member construction), measurable at flood rates
+from .engine import TxParams as _TP  # no cycle: engine imports this module lazily
+
+_OPEN_LEDGER = int(_TP.OPEN_LEDGER)
+_RETRY = int(_TP.RETRY)
+_ADMIN = int(_TP.ADMIN)
+_NO_CHECK_SIGN = int(_TP.NO_CHECK_SIGN)
+del _TP
+
 
 def register_transactor(tx_type: TxType) -> Callable:
     def deco(cls: Type["Transactor"]) -> Type["Transactor"]:
@@ -57,8 +67,6 @@ class Transactor:
     and may override check hooks."""
 
     def __init__(self, tx: SerializedTransaction, params: int, engine):
-        from .engine import TxParams  # circular-safe
-
         self.tx = tx
         self.params = int(params)  # keep flag tests on the int fast path
         self.engine = engine
@@ -74,7 +82,6 @@ class Transactor:
         # inflation_seq_delta, fee_pool, base_fee, reference_fee_units,
         # reserve_base, reserve_increment)
         self.header_changes: dict = {}
-        self._TxParams = TxParams
 
     # -- hooks ------------------------------------------------------------
 
@@ -98,7 +105,7 @@ class Transactor:
         self.account_id = self.tx.account
         if self.account_id == b"\x00" * 20 or not self.account_id:
             return TER.temBAD_SRC_ACCOUNT
-        if not (self.params & self._TxParams.NO_CHECK_SIGN):
+        if not (self.params & _NO_CHECK_SIGN):
             if not self.tx.check_sign():
                 return TER.temINVALID
         return TER.tesSUCCESS
@@ -109,7 +116,7 @@ class Transactor:
         t_seq = self.tx.sequence
         a_seq = self.account[sfSequence]
 
-        if self.params & self._TxParams.OPEN_LEDGER:
+        if self.params & _OPEN_LEDGER:
             # predicted seq from the open ledger's per-account cache —
             # O(1), maintained by add_open_transaction (the reference
             # walks the open tx map per tx, which is quadratic)
@@ -143,12 +150,12 @@ class Transactor:
         paid = self.tx.fee
         fee_due = STAmount.from_drops(
             self.engine.ledger.scale_fee_load(
-                self.calculate_base_fee(), bool(self.params & self._TxParams.ADMIN)
+                self.calculate_base_fee(), bool(self.params & _ADMIN)
             )
         )
         if not paid.is_native or paid.negative:
             return TER.temBAD_FEE
-        if (self.params & self._TxParams.OPEN_LEDGER) and paid < fee_due:
+        if (self.params & _OPEN_LEDGER) and paid < fee_due:
             return TER.telINSUF_FEE_P
         if paid.is_zero():
             return TER.tesSUCCESS
@@ -204,7 +211,7 @@ class Transactor:
         if ter != TER.tesSUCCESS:
             return ter
 
-        if self.params & self._TxParams.OPEN_LEDGER:
+        if self.params & _OPEN_LEDGER:
             # open ledger: checks only; the close re-applies for real
             # (reference: Transactor.cpp:345-347)
             return TER.tesSUCCESS

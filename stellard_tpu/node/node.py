@@ -826,22 +826,25 @@ class Node:
         self.txdb.save_ledger_header(ledger)
         from ..protocol.meta import affected_accounts
 
+        rows = []
+        for txn_seq, (txid, blob, meta) in enumerate(ledger.tx_entries()):
+            tx = ledger.parse_tx(txid, blob)
+            meta_src = ledger.parsed_metas.get(txid, meta)
+            affected = affected_accounts(meta_src) if meta else [tx.account]
+            rows.append((
+                txid,
+                tx.tx_type.name,
+                tx.account,
+                tx.sequence,
+                ledger.seq,
+                _result_token(txid, results, meta),
+                blob,
+                meta,
+                affected,
+                txn_seq,
+            ))
         with self.txdb.batch():
-            for txn_seq, (txid, blob, meta) in enumerate(ledger.tx_entries()):
-                tx = ledger.parse_tx(txid, blob)
-                affected = affected_accounts(meta) if meta else [tx.account]
-                self.txdb.save_transaction(
-                    txid,
-                    tx.tx_type.name,
-                    tx.account,
-                    tx.sequence,
-                    ledger.seq,
-                    _result_token(txid, results, meta),
-                    blob,
-                    meta,
-                    affected,
-                    txn_seq,
-                )
+            self.txdb.save_transactions(rows)
 
     # -- convenience driving (tests / CLI) --------------------------------
 

@@ -91,21 +91,40 @@ class TxDatabase:
         affected_accounts: list[bytes],
         txn_seq: int = 0,
     ) -> None:
+        self.save_transactions([
+            (txid, tx_type, account, seq, ledger_seq, status, raw, meta,
+             affected_accounts, txn_seq)
+        ])
+
+    def save_transactions(self, rows: list[tuple]) -> None:
+        """Bulk form of save_transaction for one closed ledger: three
+        executemany calls instead of 3+len(affected) executes per tx
+        (sqlite statement dispatch was ~25% of the flood apply path).
+        Each row is (txid, tx_type, account, seq, ledger_seq, status,
+        raw, meta, affected_accounts, txn_seq)."""
+        tx_rows = []
+        del_rows = []
+        acct_rows = []
+        for (txid, tx_type, account, seq, ledger_seq, status, raw, meta,
+             affected, txn_seq) in rows:
+            h = txid.hex()
+            tx_rows.append((h, tx_type, account.hex(), seq, ledger_seq,
+                            status, raw, meta))
+            del_rows.append((h,))
+            for acct in affected:
+                acct_rows.append((h, acct.hex(), ledger_seq, txn_seq))
         with self._lock:
             cur = self._conn.cursor()
-            cur.execute(
+            cur.executemany(
                 "INSERT OR REPLACE INTO Transactions VALUES (?,?,?,?,?,?,?,?)",
-                (txid.hex(), tx_type, account.hex(), seq, ledger_seq, status,
-                 raw, meta),
+                tx_rows,
             )
-            cur.execute(
-                "DELETE FROM AccountTransactions WHERE TransID = ?", (txid.hex(),)
+            cur.executemany(
+                "DELETE FROM AccountTransactions WHERE TransID = ?", del_rows
             )
-            for acct in affected_accounts:
-                cur.execute(
-                    "INSERT INTO AccountTransactions VALUES (?,?,?,?)",
-                    (txid.hex(), acct.hex(), ledger_seq, txn_seq),
-                )
+            cur.executemany(
+                "INSERT INTO AccountTransactions VALUES (?,?,?,?)", acct_rows
+            )
             self._commit()
 
     def get_transaction(self, txid: bytes) -> Optional[dict]:

@@ -31,7 +31,13 @@ from typing import Optional, Union
 
 from ..engine import views
 from ..engine.flags import lsfHighNoRipple, lsfLowNoRipple
-from ..engine.offers import Amounts, CURRENCY_ONE as _CUR_ONE, _scale_to_out, cross_offers
+from ..engine.offers import (
+    Amounts,
+    CURRENCY_ONE as _CUR_ONE,
+    PERMISSIVE_RATE,
+    _scale_to_out,
+    cross_offers,
+)
 from ..protocol.sfields import (
     sfAccount,
     sfFlags,
@@ -508,30 +514,67 @@ def execute_strand(
             )
             if in_cap.signum() <= 0:
                 raise PathError(TER.tecPATH_DRY, "no input for book")
-            # budget-limited: find what the budget actually buys so the
-            # implied limit price covers the book's marginal quality
-            # (cross_offers treats in/out as a limit order)
-            est_in, est_out = book_quote(
-                les, hop.in_currency, hop.in_issuer, want_out, in_cap
-            )
-            if est_out.signum() <= 0:
-                raise PathError(TER.tecPATH_DRY, "book too expensive or dry")
-            ter, paid, got = cross_offers(
-                les,
-                holder,
-                est_in,
-                est_out,
-                sell=False,
-                passive=False,
-                parent_close_time=parent_close_time,
-            )
-            if ter != TER.tesSUCCESS:
-                raise PathError(ter, "book crossing failed")
-            if got.signum() <= 0:
+            # quote-then-cross, iterated: the quote's midpoint roundings
+            # (reference STAmount +7/+5 rounding) can price the need a
+            # drop short, and a multi-level fill then under-delivers by
+            # a rounding quantum; a follow-up pass buys the remainder.
+            # Budget-limited throughout: the quote finds what the budget
+            # actually buys (cross_offers caps both sides exactly).
+            total_paid: Optional[STAmount] = None
+            total_got: Optional[STAmount] = None
+            for _round in range(4):
+                still = (want_out if total_got is None
+                         else want_out - total_got)
+                if still.signum() <= 0:
+                    break
+                cap_left = (in_cap if total_paid is None
+                            else in_cap - total_paid)
+                if cap_left.signum() <= 0:
+                    break
+                est_in, est_out = book_quote(
+                    les, hop.in_currency, hop.in_issuer, still, cap_left
+                )
+                if est_out.signum() <= 0:
+                    if total_got is None:
+                        raise PathError(
+                            TER.tecPATH_DRY, "book too expensive or dry"
+                        )
+                    break
+                ter, paid, got = cross_offers(
+                    les,
+                    holder,
+                    # the full remaining budget, not est_in: the quote's
+                    # midpoint roundings can price the fill a drop short
+                    # and starve the marginal offer's input; the exact
+                    # est_out cap is what terminates the fill, so input
+                    # headroom cannot overshoot the out target
+                    cap_left,
+                    est_out,
+                    sell=False,
+                    passive=False,
+                    parent_close_time=parent_close_time,
+                    # a payment's book node has NO taker quality limit
+                    # (reference: calcNodeDeliverFwd consumes offers at
+                    # their own prices until the need is met; only
+                    # tfLimitQuality imposes one). The default in/out
+                    # threshold is the AVERAGE price of the quote, which
+                    # wrongly rejects the marginal offer of a multi-
+                    # level fill; est_in/est_out still cap both sides.
+                    threshold_rate=PERMISSIVE_RATE,
+                )
+                if ter != TER.tesSUCCESS:
+                    if total_got is None:
+                        raise PathError(ter, "book crossing failed")
+                    break  # keep the earlier rounds' successful fill
+                if got.signum() <= 0:
+                    break
+                total_paid = paid if total_paid is None else total_paid + paid
+                total_got = got if total_got is None else total_got + got
+            if total_got is None or total_got.signum() <= 0:
                 raise PathError(TER.tecPATH_DRY, "book gave nothing")
             if spent is None:
-                spent = paid
-            carried = got
+                spent = total_paid
+            carried = total_got
     assert spent is not None
     return spent, carried
 

@@ -628,10 +628,15 @@ def find_paths(
     # one alternative per source currency, carrying the path SET
     # (reference: RipplePathFind runs findPaths once per source currency
     # and renders one alternative with up to max_paths paths_computed);
-    # first-in-cost-order is the alternative's headline source_amount
+    # first-in-cost-order is the alternative's headline source_amount.
+    # The DEFAULT path is never rendered (the payment engine always tries
+    # it unless tfNoRippleDirect — Payment.do_apply inserts it; reference
+    # Pathfinder drops bDefaultPath from paths_computed) but it still
+    # anchors the alternative's existence and source_amount quote.
     by_currency: dict[bytes, dict] = {}
     for r in results:
         cur = r.pop("_currency")
+        r["paths"] = [p for p in r["paths"] if p]
         g = by_currency.get(cur)
         if g is None:
             by_currency[cur] = r

@@ -114,6 +114,13 @@ class STPathSet:
 
 _HASH_WIDTH = {STI.HASH128: 16, STI.HASH160: 20, STI.HASH256: 32}
 _INT_WIDTH = {STI.UINT8: 1, STI.UINT16: 2, STI.UINT32: 4, STI.UINT64: 8}
+# types whose Python values are value-like (never mutated in place), so
+# their encoded wire chunks may be cached on the owning STObject
+_VALUE_LIKE_STI = frozenset({
+    STI.UINT8, STI.UINT16, STI.UINT32, STI.UINT64,
+    STI.HASH128, STI.HASH160, STI.HASH256,
+    STI.AMOUNT, STI.VL, STI.ACCOUNT,
+})
 
 
 def _serialize_value(s: Serializer, f: SField, v: Any) -> None:
@@ -192,7 +199,7 @@ def _copy_value(v: Any) -> Any:
 class STObject:
     """Ordered-by-canon field map."""
 
-    __slots__ = ("_fields", "_version", "_sorted_keys")
+    __slots__ = ("_fields", "_version", "_sorted_keys", "_pairs", "_enc")
 
     def __init__(self, fields: dict[SField, Any] | None = None):
         self._fields: dict[SField, Any] = dict(fields or {})
@@ -205,6 +212,16 @@ class STObject:
         # sorts the field set; ledger entries are serialized many times
         # between mutations
         self._sorted_keys: tuple[int, list[SField]] | None = None
+        # (version, [(field, value)...]) — fields() is called several
+        # times per apply (serialize, meta, invariants); rebuild only
+        # after mutation
+        self._pairs: tuple[int, list[tuple[SField, Any]]] | None = None
+        # field -> encoded wire chunk (field id + value), for VALUE-LIKE
+        # types only (ints/bytes/STAmount — never nested containers,
+        # which can be mutated in place without notifying this object).
+        # A hot SLE mutates 2-3 of its ~8 fields per tx; the unchanged
+        # fields' encodings are reused across serializations.
+        self._enc: dict[SField, bytes] = {}
 
     # -- mapping interface -------------------------------------------------
 
@@ -217,26 +234,34 @@ class STObject:
     def __setitem__(self, f: SField, v: Any) -> None:
         self._fields[f] = v
         self._version += 1
+        self._enc.pop(f, None)
 
     def __delitem__(self, f: SField) -> None:
         del self._fields[f]
         self._version += 1
+        self._enc.pop(f, None)
 
     def get(self, f: SField, default: Any = None) -> Any:
         return self._fields.get(f, default)
 
     def pop(self, f: SField, default: Any = None) -> Any:
         self._version += 1
+        self._enc.pop(f, None)
         return self._fields.pop(f, default)
 
     def fields(self) -> Iterator[tuple[SField, Any]]:
+        pairs = self._pairs
+        if pairs is not None and pairs[0] == self._version:
+            return iter(pairs[1])
         memo = self._sorted_keys
         if memo is None or memo[0] != self._version:
             keys = sorted(self._fields, key=sort_key)
             self._sorted_keys = memo = (self._version, keys)
         fields = self._fields
         # materialized so callers keep snapshot semantics under mutation
-        return iter([(k, fields[k]) for k in memo[1]])
+        lst = [(k, fields[k]) for k in memo[1]]
+        self._pairs = (self._version, lst)
+        return iter(lst)
 
     def copy(self) -> "STObject":
         """Copy that detaches container values (lists, nested objects,
@@ -248,6 +273,9 @@ class STObject:
             # the key list is never mutated in place (fields() replaces
             # the tuple wholesale), so sharing it across copies is safe
             out._sorted_keys = (0, memo[1])
+        # cached chunks cover only value-like fields, whose values the
+        # copy shares — equal value, identical encoding
+        out._enc = dict(self._enc)
         return out
 
     def __len__(self) -> int:
@@ -267,11 +295,19 @@ class STObject:
         ``signing``, non-signing fields (signatures) are omitted
         (reference STObject::getSerializer / getSigningHash,
         SerializedObject.cpp:444)."""
+        enc = self._enc
         for f, v in self.fields():
             if signing and not f.signing:
                 continue
+            chunk = enc.get(f)
+            if chunk is not None:
+                s.add_raw(chunk)
+                continue
+            mark = len(s._buf)
             s.add_field_id(int(f.type_id), f.value)
             _serialize_value(s, f, v)
+            if f.type_id in _VALUE_LIKE_STI:
+                enc[f] = bytes(s._buf[mark:])
 
     def serialize(self, *, signing: bool = False) -> bytes:
         s = Serializer()

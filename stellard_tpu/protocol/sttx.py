@@ -62,7 +62,13 @@ class SerializedTransaction:
 
     @classmethod
     def from_bytes(cls, blob: bytes) -> "SerializedTransaction":
-        return cls(STObject.from_bytes(blob))
+        tx = cls(STObject.from_bytes(blob))
+        # the received bytes ARE the serialization: the reference keeps
+        # the raw Serializer and hashes getTransactionID over it, so a
+        # parsed tx must never re-serialize (and txid must cover exactly
+        # the wire bytes, even for a non-canonical peer encoding)
+        tx._blob_memo = (tx.obj._version, blob)
+        return tx
 
     @classmethod
     def from_parser(cls, p: BinaryParser) -> "SerializedTransaction":

@@ -125,6 +125,9 @@ class Ledger:
         # passes SerializedTransaction::pointer around for the same
         # reason). Seeded by close_and_advance; consulted via parse_tx.
         self.parsed_txs: dict[bytes, object] = {}
+        # txid -> parsed meta STObject, seeded by the engine as it
+        # builds each meta so persist/publish never re-parse meta blobs
+        self.parsed_metas: dict[bytes, object] = {}
 
     # -- genesis ----------------------------------------------------------
 

@@ -779,3 +779,307 @@ class TestThirdPartyIssuerDefaultPath:
         assert views.ripple_balance(
             les, K("mid").account_id, K("alice").account_id, USD
         ).value_text() == "10"
+
+
+# --------------------------------------------------------------------------
+# The remaining reference suites from test/new-path-test.coffee: the
+# T4 non-native same-currency table (#4 and its second ledger), the
+# Bitstamp+SnapSwap liquidity-provider-without-offers suite, and the
+# production-shaped CNY scenario. Expected paths are written in the
+# coffee harness's shorthand ("HKD/G1|G1" = account hop, "...|$" =
+# order-book hop) and matched with the same hop-expansion rules as its
+# expand_alternative/hop_matcher helpers. The reference marks T4
+# F/G/H/I1/I2/I3 as `_skip` in its own table; they stay unported.
+
+
+def _expand_hops(alt):
+    """Mirror new-path-test.coffee expand_alternative: make currency and
+    issuer explicit in every hop, carrying forward from source_amount."""
+    from stellard_tpu.protocol.stamount import iso_from_currency
+
+    src_amt = alt["source_amount"]
+    prev_currency = "XRP" if src_amt.is_native else iso_from_currency(
+        src_amt.currency)
+    prev_issuer = None if src_amt.is_native else src_amt.issuer
+    out = []
+    for path in alt["paths"]:
+        hops = []
+        for el in path:
+            if el.currency is not None:
+                cur = ("XRP" if el.currency == b"\x00" * 20
+                       else iso_from_currency(el.currency))
+            else:
+                cur = prev_currency
+            if el.issuer is not None:
+                issuer = el.issuer
+            elif el.account is not None:
+                issuer = el.account
+            else:
+                issuer = prev_issuer
+            hops.append({"currency": cur,
+                         "issuer": issuer,
+                         "account": el.account})
+            if el.currency is not None:
+                prev_currency = cur
+            if el.issuer is not None:
+                prev_issuer = el.issuer
+            elif el.account is not None:
+                prev_issuer = el.account
+        out.append(hops)
+    return out
+
+
+def _match_paths(alt, expected: list[list[str]]) -> None:
+    """Assert the alternative's path set equals `expected` (shorthand,
+    order-insensitive), reference: test_alternatives/match_path."""
+    actual = _expand_hops(alt)
+    assert len(actual) == len(expected), (
+        f"expected {len(expected)} paths, got {len(actual)}: {actual}"
+    )
+    remaining = list(actual)
+    for exp in expected:
+        found = None
+        for cand in remaining:
+            if len(cand) != len(exp):
+                continue
+            ok = True
+            for hop, decl in zip(cand, exp):
+                ci, _, acct = decl.partition("|")
+                cur, _, iss = ci.partition("/")
+                if hop["currency"] != cur:
+                    ok = False
+                    break
+                if iss and hop["issuer"] != K(iss).account_id:
+                    ok = False
+                    break
+                if acct == "$":
+                    if hop["account"] is not None:
+                        ok = False
+                        break
+                elif hop["account"] != K(acct).account_id:
+                    ok = False
+                    break
+            if ok:
+                found = cand
+                break
+        assert found is not None, f"no path matches {exp} in {remaining}"
+        remaining.remove(found)
+
+
+class TestNewPathSuiteT4:
+    """Path Tests #4 (non-XRP to non-XRP, same currency) — reference:
+    test/new-path-test.coffee 'Path Tests #4' declarations."""
+
+    def _ledger(self):
+        return Scenario(
+            accounts={"G1": "1000.0", "G2": "1000.0", "G3": "1000.0",
+                      "G4": "1000.0", "A1": "1000.0", "A2": "1000.0",
+                      "A3": "1000.0", "A4": "10000.0",
+                      "M1": "11000.0", "M2": "11000.0"},
+            trusts=["A1:2000/HKD/G1", "A2:2000/HKD/G2", "A3:2000/HKD/G1",
+                    "M1:100000/HKD/G1", "M1:100000/HKD/G2",
+                    "M2:100000/HKD/G1", "M2:100000/HKD/G2"],
+            ious=["A1:1000/HKD/G1", "A2:1000/HKD/G2", "A3:1000/HKD/G1",
+                  "M1:1200/HKD/G1", "M1:5000/HKD/G2",
+                  "M2:1200/HKD/G1", "M2:5000/HKD/G2"],
+            offers=[("M1", "1000/HKD/G1", "1000/HKD/G2"),
+                    ("M2", "10000.0", "1000/HKD/G2"),
+                    ("M2", "1000/HKD/G1", "10000.0")],
+        ).build()
+
+    def _alts(self, led, src, dst, send):
+        return find_paths(
+            led, K(src).account_id, K(dst).account_id, amt(send),
+            send_max=amt(f"2000/HKD/{src}"),
+        )
+
+    def test_a_borrow_or_repay(self):
+        """T4-A: Source -> Destination (repay source issuer); one
+        alternative, default path only (no paths_computed)."""
+        alts = self._alts(self._ledger(), "A1", "G1", "10/HKD/G1")
+        assert len(alts) == 1
+        assert alts[0]["source_amount"].value_text() == "10"
+        assert alts[0]["paths"] == []
+
+    def test_a2_borrow_or_repay_dst_issuer(self):
+        """T4-A2: same, amount stated as issuer-of-destination."""
+        alts = self._alts(self._ledger(), "A1", "G1", "10/HKD/A1")
+        assert len(alts) == 1
+        assert alts[0]["source_amount"].value_text() == "10"
+        assert alts[0]["paths"] == []
+
+    def test_b_common_gateway(self):
+        """T4-B: Source -> AC -> Destination via the shared gateway."""
+        alts = self._alts(self._ledger(), "A1", "A3", "10/HKD/A3")
+        assert len(alts) == 1
+        assert alts[0]["source_amount"].value_text() == "10"
+        _match_paths(alts[0], [["HKD/G1|G1"]])
+
+    def test_c_gateway_to_gateway(self):
+        """T4-C: Source -> OB -> Destination; the four expected routes:
+        both makers, the direct cross-issuer book, the XRP bridge."""
+        alts = self._alts(self._ledger(), "G1", "G2", "10/HKD/G2")
+        assert len(alts) == 1
+        assert alts[0]["source_amount"].value_text() == "10"
+        _match_paths(alts[0], [
+            ["HKD/M2|M2"],
+            ["HKD/M1|M1"],
+            ["HKD/G2|$"],
+            ["XRP|$", "HKD/G2|$"],
+        ])
+
+    def test_d_user_to_unlinked_gateway(self):
+        """T4-D: Source -> AC -> OB -> Destination."""
+        alts = self._alts(self._ledger(), "A1", "G2", "10/HKD/G2")
+        assert len(alts) == 1
+        assert alts[0]["source_amount"].value_text() == "10"
+        _match_paths(alts[0], [
+            ["HKD/G1|G1", "HKD/G2|$"],
+            ["HKD/G1|G1", "HKD/M2|M2"],
+            ["HKD/G1|G1", "HKD/M1|M1"],
+            ["HKD/G1|G1", "XRP|$", "HKD/G2|$"],
+        ])
+
+    def test_i4_xrp_bridge(self):
+        """T4-I4: Source -> AC -> OB to XRP -> OB from XRP -> AC ->
+        Destination (plus the incidental maker routes)."""
+        alts = self._alts(self._ledger(), "A1", "A2", "10/HKD/A2")
+        assert len(alts) == 1
+        assert alts[0]["source_amount"].value_text() == "10"
+        _match_paths(alts[0], [
+            ["HKD/G1|G1", "HKD/G2|$", "HKD/G2|G2"],
+            ["HKD/G1|G1", "XRP|$", "HKD/G2|$", "HKD/G2|G2"],
+            ["HKD/G1|G1", "HKD/M1|M1", "HKD/G2|G2"],
+            ["HKD/G1|G1", "HKD/M2|M2", "HKD/G2|G2"],
+        ])
+
+    def test_e_gateway_to_user(self):
+        """T4-E (second #4 ledger): Source -> OB -> AC -> Destination."""
+        led = Scenario(
+            accounts={"G1": "1000.0", "G2": "1000.0", "A1": "1000.0",
+                      "A2": "1000.0", "A3": "1000.0", "M1": "11000.0"},
+            trusts=["A1:2000/HKD/G1", "A2:2000/HKD/G2", "A3:2000/HKD/A2",
+                    "M1:100000/HKD/G1", "M1:100000/HKD/G2"],
+            ious=["A1:1000/HKD/G1", "A2:1000/HKD/G2",
+                  "M1:5000/HKD/G1", "M1:5000/HKD/G2"],
+            offers=[("M1", "1000/HKD/G1", "1000/HKD/G2")],
+        ).build()
+        alts = find_paths(
+            led, K("G1").account_id, K("A2").account_id, amt("10/HKD/A2"),
+            send_max=amt("2000/HKD/G1"),
+        )
+        assert len(alts) == 1
+        assert alts[0]["source_amount"].value_text() == "10"
+        _match_paths(alts[0], [
+            ["HKD/G2|$", "HKD/G2|G2"],
+            ["HKD/M1|M1", "HKD/G2|G2"],
+        ])
+
+
+class TestNewPathSuiteSnapSwap:
+    """'Bitstamp + SnapSwap account holders | liquidity provider with no
+    offers' — rippling through a maker that rests NO offers (pure trust
+    liquidity). Reference: new-path-test.coffee BS P1-P5."""
+
+    def _ledger(self):
+        return Scenario(
+            accounts={"G1BS": "1000.0", "G2SW": "1000.0", "A1": "1000.0",
+                      "A2": "1000.0", "M1": "11000.0"},
+            trusts=["A1:2000/HKD/G1BS", "A2:2000/HKD/G2SW",
+                    "M1:100000/HKD/G1BS", "M1:100000/HKD/G2SW"],
+            ious=["A1:1000/HKD/G1BS", "A2:1000/HKD/G2SW",
+                  "M1:1200/HKD/G1BS", "M1:5000/HKD/G2SW"],
+        ).build()
+
+    def _alts(self, src, dst, send):
+        return find_paths(
+            self._ledger(), K(src).account_id, K(dst).account_id, amt(send),
+            send_max=amt(f"2000/HKD/{src}"),
+        )
+
+    def test_p1_user_to_user(self):
+        alts = self._alts("A1", "A2", "10/HKD/A2")
+        assert len(alts) == 1
+        _match_paths(alts[0], [["HKD/G1BS|G1BS", "HKD/M1|M1",
+                                "HKD/G2SW|G2SW"]])
+
+    def test_p2_user_to_user_reverse(self):
+        alts = self._alts("A2", "A1", "10/HKD/A1")
+        assert len(alts) == 1
+        _match_paths(alts[0], [["HKD/G2SW|G2SW", "HKD/M1|M1",
+                                "HKD/G1BS|G1BS"]])
+
+    def test_p3_issuer_to_other_gateways_user(self):
+        alts = self._alts("G1BS", "A2", "10/HKD/A2")
+        assert len(alts) == 1
+        assert alts[0]["source_amount"].value_text() == "10"
+        _match_paths(alts[0], [["HKD/M1|M1", "HKD/G2SW|G2SW"]])
+
+    def test_p4_other_issuer_to_user(self):
+        alts = self._alts("G2SW", "A1", "10/HKD/A1")
+        assert len(alts) == 1
+        assert alts[0]["source_amount"].value_text() == "10"
+        _match_paths(alts[0], [["HKD/M1|M1", "HKD/G1BS|G1BS"]])
+
+    def test_p5_maker_repays_issuer(self):
+        alts = self._alts("M1", "G1BS", "10/HKD/M1")
+        assert len(alts) == 1
+        assert alts[0]["paths"] == []  # default path (direct line)
+
+
+class TestNewPathSuiteCNY:
+    """The production-shaped 'CNY test' (new-path-test.coffee): two money
+    makers, a production-like offer mosaic with odd-lot balances; SRC
+    pays the gateway 10.1 CNY spending XRP — exactly one alternative
+    (via XRP), filled across multiple price levels of the book."""
+
+    def _ledger(self):
+        return Scenario(
+            accounts={"SRC": "4999.999898", "GATEWAY_DST": "10846.168060",
+                      "MONEY_MAKER_1": "4291.430036",
+                      "MONEY_MAKER_2": "106839.375770",
+                      "A1": "1240.997150", "A2": "14115.046893",
+                      "A3": "512087.883181"},
+            trusts=["MONEY_MAKER_2:1001/CNY/MONEY_MAKER_1",
+                    "MONEY_MAKER_2:1001/CNY/GATEWAY_DST",
+                    "A1:1000000/CNY/MONEY_MAKER_1",
+                    "A1:100000/USD/MONEY_MAKER_1",
+                    "A1:10000/BTC/MONEY_MAKER_1",
+                    "A1:1000/USD/GATEWAY_DST", "A1:1000/CNY/GATEWAY_DST",
+                    "A2:3000/CNY/MONEY_MAKER_1", "A2:3000/CNY/GATEWAY_DST",
+                    "A3:10000/CNY/MONEY_MAKER_1",
+                    "A3:10000/CNY/GATEWAY_DST"],
+            ious=["MONEY_MAKER_2:0.0000000003599/CNY/MONEY_MAKER_1",
+                  "MONEY_MAKER_2:137.6852546843001/CNY/GATEWAY_DST",
+                  "A1:0.0000000119761/CNY/MONEY_MAKER_1",
+                  "A1:33.047994/CNY/GATEWAY_DST",
+                  "A2:209.3081873019994/CNY/MONEY_MAKER_1",
+                  "A2:694.6251706504019/CNY/GATEWAY_DST",
+                  "A3:23.617050013581/CNY/MONEY_MAKER_1",
+                  "A3:70.999614649799/CNY/GATEWAY_DST"],
+            offers=[("MONEY_MAKER_2", "1.0", "1/CNY/GATEWAY_DST"),
+                    ("MONEY_MAKER_2", "1/CNY/GATEWAY_DST", "1.0"),
+                    ("MONEY_MAKER_2", "318000/CNY/GATEWAY_DST", "53000.0"),
+                    ("MONEY_MAKER_2", "209.0", "4.18/CNY/MONEY_MAKER_2"),
+                    ("MONEY_MAKER_2", "990000/CNY/MONEY_MAKER_1", "10000.0"),
+                    ("MONEY_MAKER_2", "9990000/CNY/MONEY_MAKER_1",
+                     "10000.0"),
+                    ("MONEY_MAKER_2", "8870000/CNY/GATEWAY_DST", "10000.0"),
+                    ("MONEY_MAKER_2", "232.0", "5.568/CNY/MONEY_MAKER_2"),
+                    ("A2", "2000.0", "66.8/CNY/MONEY_MAKER_1"),
+                    ("A2", "1200.0", "42/CNY/GATEWAY_DST"),
+                    ("A2", "43.2/CNY/MONEY_MAKER_1", "900.0"),
+                    ("A3", "2240/CNY/MONEY_MAKER_1", "50000.0")],
+        ).build()
+
+    def test_p101_via_xrp(self):
+        led = self._ledger()
+        alts = find_paths(
+            led, K("SRC").account_id, K("GATEWAY_DST").account_id,
+            amt("10.1/CNY/GATEWAY_DST"), send_max=amt("4999.0"),
+        )
+        assert len(alts) == 1, [a["source_amount"].value_text()
+                                for a in alts]
+        a = alts[0]
+        assert a["source_amount"].is_native
+        assert a["delivered"].value_text() == "10.1"

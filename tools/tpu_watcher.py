@@ -61,8 +61,17 @@ def _run_logged(f, label: str, argv: list[str], env) -> bool:
 
 
 def main() -> None:
+    # hard lifetime cap: an unattended watcher that never sees the tunnel
+    # must not still be burning this 1-core box (each probe is a full jax
+    # import) when the driver's own end-of-round bench runs
+    stop_after = float(os.environ.get("WATCHER_MAX_S", str(10.0 * 3600)))
+    t_start = time.monotonic()
     n = 0
     while True:
+        if time.monotonic() - t_start > stop_after:
+            print("[watcher] lifetime cap reached without a full on-chip "
+                  "cycle; exiting", flush=True)
+            return
         n += 1
         up = probe()
         print(f"[watcher] probe {n}: {'UP' if up else 'down'} "
