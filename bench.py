@@ -541,6 +541,43 @@ def bench_replay(backends):
     return rates
 
 
+def bench_mesh():
+    """SURVEY §2.9 mapping #3: the sharded verify step on an 8-virtual-
+    device CPU mesh, as a throughput number (a sharding/collective
+    regression in parallel/mesh.py shows up here as a number, not just
+    a dryrun pass/fail). Runs in a subprocess — the device-count flag
+    must be set before backend init. vs_baseline is mesh-vs-single-
+    device scaling; ~1.0 on this 1-core box is healthy (the virtual
+    devices time-slice one core)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "mesh_bench.py")],
+            capture_output=True, text=True, timeout=900, env=env,
+        )
+        line = r.stdout.strip().splitlines()[-1]
+        data = json.loads(line)
+    except Exception as e:
+        _emit({"metric": "mesh8_verify_sigs_per_sec", "value": 0.0,
+               "unit": "error", "vs_baseline": 0.0, "error": repr(e)[:300]})
+        return
+    _emit({
+        "metric": "mesh8_verify_sigs_per_sec",
+        "value": data["mesh_rate"],
+        "unit": "sigs/s",
+        "vs_baseline": data["scaling"],
+        "cpu_baseline": data["single_rate"],
+        "mesh_devices": data["mesh_devices"],
+        "batch": data["batch"],
+        "fallback": False,  # always runs (virtual cpu mesh)
+    })
+
+
 def _emit_config(metric, rates, lower_is_better=False, unit="tx/s",
                  shares=None):
     cpu = rates.get("cpu")
@@ -610,6 +647,12 @@ def main() -> None:
             except Exception as e:  # a failed config must not kill the rest
                 _emit({"metric": fn.__name__, "value": 0.0, "unit": "error",
                        "vs_baseline": 0.0, "error": repr(e)[:300]})
+        try:
+            bench_mesh()
+        except Exception as e:
+            _emit({"metric": "mesh8_verify_sigs_per_sec", "value": 0.0,
+                   "unit": "error", "vs_baseline": 0.0,
+                   "error": repr(e)[:300]})
         _write_detail()
 
     rng = np.random.default_rng(42)

@@ -610,7 +610,13 @@ def apply_kernel_tuning(path: str) -> Optional[dict]:
             "STELLARD_GROUP_OPS": str(int(t.get("group", 0))),
             "STELLARD_VERIFY_IMPL": str(t.get("impl", "xla")),
             "STELLARD_PALLAS_BLOCK": str(int(t.get("block", 512))),
+            # wire format is semantics-neutral (identical verdicts,
+            # pinned by tests) so the measured winner auto-applies;
+            # rows measured before the raw wire existed say "digits"
+            "STELLARD_WIRE": str(t.get("wire", "digits")),
         }
+        if values["STELLARD_WIRE"] not in ("raw", "digits"):
+            raise ValueError(values["STELLARD_WIRE"])
         if values["STELLARD_VERIFY_IMPL"] not in ("xla", "pallas"):
             # a hand-edited file must not park a crash at the first
             # device batch (_resolve_kernel validates the same set)

@@ -59,6 +59,7 @@ class Config:
     # -- storage ([node_db], [database_path]) ------------------------------
     node_db_type: str = "memory"
     node_db_path: str = ""
+    node_db_compression: str = ""  # "" | zlib (cpplog snappy-role knob)
     database_path: str = ""
 
     # -- crypto plane (TPU-native knobs; pattern of [node_db] type=) -------
@@ -146,6 +147,8 @@ class Config:
         node_db = _kv(s.get("node_db", []))
         cfg.node_db_type = node_db.get("type", cfg.node_db_type).lower()
         cfg.node_db_path = node_db.get("path", cfg.node_db_path)
+        cfg.node_db_compression = node_db.get(
+            "compression", cfg.node_db_compression).lower()
         cfg.database_path = one("database_path", cfg.database_path)
 
         sig = _kv(s.get("signature_backend", []))

@@ -115,6 +115,9 @@ class Node:
         self.nodestore = make_database(
             type=cfg.node_db_type,
             **({"path": cfg.node_db_path} if cfg.node_db_path else {}),
+            **({"compression": cfg.node_db_compression}
+               if cfg.node_db_compression and cfg.node_db_type == "cpplog"
+               else {}),
         )
         self.txdb = TxDatabase(cfg.database_path or ":memory:")
 

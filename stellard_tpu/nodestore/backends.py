@@ -114,25 +114,54 @@ register_backend("sqlite", SqliteBackend)
 class CppLogBackend(Backend):
     """Native log-structured backend (native/src/nodestore.cc) — the
     C++ store filling the LevelDB/RocksDB role (SURVEY §2.8): append-only
-    data log + in-memory hash index, replayed on open."""
+    data log + in-memory hash index, replayed on open.
+
+    ``compression="zlib"`` fills the snappy role (the reference vendors
+    snappy for its LevelDB blocks): blobs are deflated before the append
+    when that saves space, flagged in the record's type byte (high bit),
+    so compressed and raw records coexist and old stores read unchanged.
+    SHAMap inner nodes (child-hash vectors) are near-incompressible, but
+    serialized account/tx leaves deflate well."""
 
     name = "cpplog"
 
-    def __init__(self, path: str = "nodestore.cpplog", **_):
+    _ZLIB_FLAG = 0x80  # type-byte high bit: NodeObjectType is 0..4
+
+    def __init__(self, path: str = "nodestore.cpplog",
+                 compression: str = "", **_):
         from ..native import CppLogLib
 
         self._db = CppLogLib(path)
+        if compression not in ("", "none", "zlib"):
+            raise ValueError(f"unknown nodestore compression {compression!r}")
+        self._compress = compression == "zlib"
 
     def fetch(self, hash: bytes) -> Optional[NodeObject]:
         got = self._db.get(hash)
         if got is None:
             return None
         type_byte, blob = got
+        if type_byte & self._ZLIB_FLAG:
+            import zlib
+
+            type_byte &= ~self._ZLIB_FLAG
+            blob = zlib.decompress(blob)
         return NodeObject(NodeObjectType(type_byte), hash, blob)
 
     def store_batch(self, batch: list[NodeObject]) -> None:
-        for obj in batch:
-            self._db.put(obj.hash, int(obj.type), obj.data)
+        if self._compress:
+            import zlib
+
+            for obj in batch:
+                packed = zlib.compress(obj.data, 1)
+                if len(packed) < len(obj.data):
+                    self._db.put(obj.hash, int(obj.type) | self._ZLIB_FLAG,
+                                 packed)
+                else:  # incompressible (e.g. inner-node hash vectors)
+                    self._db.put(obj.hash, int(obj.type), obj.data)
+        else:
+            for obj in batch:
+                self._db.put(obj.hash, int(obj.type), obj.data)
         self._db.sync()
 
     def iterate(self):

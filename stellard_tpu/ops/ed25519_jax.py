@@ -485,10 +485,12 @@ def verify_kernel(a_words, r_words, s_windows, h_digits, s_canonical):
     s_canonical: [B] bool (S < l, checked host-side)
     -> [B] bool
 
-    The digit arrays may arrive narrow (int8 — prepare_batch's wire
-    format: 4-bit values in int32 tripled the host->device transfer for
-    nothing) and are widened here, ON DEVICE, before use.
+    The digit arrays may arrive narrow (int8 — prepare_batch's digit
+    wire: 4-bit values in int32 tripled the host->device transfer for
+    nothing) or as RAW [B, 32] scalar bytes (the default wire — half
+    the transfer again); both widen/expand here, ON DEVICE, before use.
     """
+    s_windows, h_digits = _maybe_expand_wire(s_windows, h_digits)
     aw = jnp.transpose(a_words)  # [8, B]
     rw = jnp.transpose(r_words)
     sw = jnp.transpose(s_windows).astype(jnp.int32)  # [64, B]
@@ -635,6 +637,56 @@ def _native_prep():
     return _NATIVE_PREP
 
 
+def expand_s_windows(s_bytes):
+    """ON-DEVICE wire expansion: [B, 32] u8 LE scalar bytes -> [B, 64]
+    int32 unsigned 4-bit windows (LSB first). The raw-bytes wire halves
+    the host->device transfer of this leg vs shipping digit arrays —
+    the tunnel's scarce resource — at the cost of two trivial vector
+    ops on device."""
+    lo = (s_bytes & 0xF).astype(jnp.int32)
+    hi = (s_bytes >> 4).astype(jnp.int32)
+    return jnp.stack([lo, hi], axis=-1).reshape(s_bytes.shape[0], 64)
+
+
+def expand_h_digits(h_bytes):
+    """ON-DEVICE signed-digit recode: [B, 32] u8 LE scalar bytes ->
+    [B, 64] int32 signed digits in [-8, 7] (LSB first), matching
+    _signed_digits_le bit-for-bit. The sequential carry ripple becomes
+    a log2(64)=6-step generate/propagate associative scan: with
+    carry<=1, carry_out(i) = g_i | (p_i & carry_in(i)) where
+    g_i = nib_i >= 8, p_i = nib_i == 7. Valid for scalars < 2^253
+    (same contract as the host recode)."""
+    nib = expand_s_windows(h_bytes)  # [B, 64] in [0, 15]
+    g = nib >= 8
+    p = nib == 7
+
+    def combine(a, b):
+        # a = (G, P) of the earlier prefix, b of the later: composing
+        # c -> gb | pb & (ga | pa & c) = (gb | pb&ga) | (pb&pa) & c
+        ga, pa = a
+        gb, pb = b
+        return (gb | (pb & ga), pb & pa)
+
+    G, _ = lax.associative_scan(combine, (g, p), axis=1)
+    carry_in = jnp.concatenate(
+        [jnp.zeros_like(G[:, :1]), G[:, :-1]], axis=1
+    ).astype(jnp.int32)
+    v = nib + carry_in
+    return v - ((v >= 8).astype(jnp.int32) << 4)
+
+
+def _maybe_expand_wire(s_windows, h_digits):
+    """Accept either wire format: legacy [B, 64] digit arrays pass
+    through; raw [B, 32] byte arrays expand on device."""
+    s_windows = jnp.asarray(s_windows)
+    h_digits = jnp.asarray(h_digits)
+    if s_windows.shape[-1] == 32:
+        s_windows = expand_s_windows(s_windows)
+    if h_digits.shape[-1] == 32:
+        h_digits = expand_h_digits(h_digits)
+    return s_windows, h_digits
+
+
 def _nibbles_le(b: np.ndarray) -> np.ndarray:
     """[B, 32] uint8 LE scalar bytes -> [B, 64] int8 4-bit windows,
     LSB window first. int8 is the WIRE dtype (the kernel widens on
@@ -699,7 +751,6 @@ def prepare_batch(publics, messages, signatures, device_put: bool = True):
     s_canonical = any_diff & (s_bytes[np.arange(B), msb] < _L_BYTES[msb])
     if bad:
         s_canonical[bad] = False
-    s_windows = _nibbles_le(s_bytes)
 
     native = _native_prep()
     if native is not None:
@@ -713,7 +764,18 @@ def prepare_batch(publics, messages, signatures, device_put: bool = True):
                 "little",
             ) % L
             h_scalars[i] = np.frombuffer(h.to_bytes(32, "little"), np.uint8)
-    h_digits = _signed_digits_le(h_scalars)
+
+    # wire format (host->device transfer is the tunnel's scarce
+    # resource): "raw" ships the 32-byte S and h scalars and the kernel
+    # expands windows/signed digits on device (129 B/sig total); "digits"
+    # ships the precomputed [B, 64] int8 arrays (193 B/sig — the r4 form,
+    # kept for A/B and for consumers that inspect digits host-side)
+    if os.environ.get("STELLARD_WIRE", "raw") == "digits":
+        s_windows = _nibbles_le(s_bytes)
+        h_digits = _signed_digits_le(h_scalars)
+    else:
+        s_windows = s_bytes
+        h_digits = h_scalars
 
     put = jnp.asarray if device_put else (lambda x: x)
     return dict(

@@ -187,6 +187,11 @@ def _ensure_const_table() -> np.ndarray:
 def verify_kernel_pallas(a_words, r_words, s_windows, h_digits, s_canonical):
     """Drop-in for ed25519_jax.verify_kernel (same prepare_batch inputs,
     public batch-major layout) running the Pallas block kernel."""
+    from .ed25519_jax import _maybe_expand_wire
+
+    # raw-bytes wire expands in an XLA prologue on device; the Pallas
+    # grid kernel always sees the [B, 64] digit arrays
+    s_windows, h_digits = _maybe_expand_wire(s_windows, h_digits)
     a_words = jnp.asarray(a_words)
     b = a_words.shape[0]
     bp = -(-b // BLOCK) * BLOCK

@@ -36,6 +36,37 @@ class STI(IntEnum):
     VALIDATION = 10003
 
 
+# encode-kind tags for the (de)serialization hot loops: integer compares
+# instead of enum identity tests, precomputed once per registry field
+K_UINT8, K_UINT16, K_UINT32, K_UINT64 = 0, 1, 2, 3
+K_HASH, K_AMOUNT, K_VL, K_ACCOUNT = 4, 5, 6, 7
+K_OBJECT, K_ARRAY, K_PATHSET, K_VECTOR256 = 8, 9, 10, 11
+
+_KIND_OF = {
+    STI.UINT8: K_UINT8, STI.UINT16: K_UINT16, STI.UINT32: K_UINT32,
+    STI.UINT64: K_UINT64,
+    STI.HASH128: K_HASH, STI.HASH160: K_HASH, STI.HASH256: K_HASH,
+    STI.AMOUNT: K_AMOUNT, STI.VL: K_VL, STI.ACCOUNT: K_ACCOUNT,
+    STI.OBJECT: K_OBJECT, STI.ARRAY: K_ARRAY, STI.PATHSET: K_PATHSET,
+    STI.VECTOR256: K_VECTOR256,
+}
+_HASH_WIDTH_OF = {STI.HASH128: 16, STI.HASH160: 20, STI.HASH256: 32}
+_INT_WIDTH_OF = {STI.UINT8: 1, STI.UINT16: 2, STI.UINT32: 4, STI.UINT64: 8}
+
+
+def _field_header(type_id: int, value: int) -> bytes:
+    """The constant field-id prefix (reference Serializer::addFieldID)."""
+    if not (0 < type_id < 256 and 0 < value < 256):
+        raise ValueError(f"bad field id ({type_id}, {value})")
+    if type_id < 16:
+        if value < 16:
+            return bytes([(type_id << 4) | value])
+        return bytes([type_id << 4, value])
+    if value < 16:
+        return bytes([value, type_id])
+    return bytes([0, type_id, value])
+
+
 @dataclass(frozen=True, eq=False)
 class SField:
     """eq=False: fields are registry singletons, so identity equality /
@@ -47,6 +78,21 @@ class SField:
     type_id: STI
     value: int
     signing: bool = True  # excluded from signing serialization when False
+    # wire constants for the hot paths, derived in __post_init__:
+    header: bytes = b""  # the encoded field id (empty for non-wire types)
+    kind: int = -1  # K_* tag, -1 for non-wire types
+    width: int = 0  # fixed byte width for K_UINT*/K_HASH kinds
+
+    def __post_init__(self):
+        k = _KIND_OF.get(self.type_id, -1)
+        object.__setattr__(self, "kind", k)
+        if k >= 0:
+            object.__setattr__(
+                self, "header", _field_header(int(self.type_id), self.value)
+            )
+        w = (_INT_WIDTH_OF.get(self.type_id, 0)
+             or _HASH_WIDTH_OF.get(self.type_id, 0))
+        object.__setattr__(self, "width", w)
 
     @property
     def code(self) -> int:

@@ -23,7 +23,22 @@ from typing import Any, Iterator
 
 from ..utils.hashes import prefix_hash
 from .serializer import BinaryParser, Serializer
-from .sfields import STI, SField, field_by_code, sort_key
+from .sfields import (
+    K_ACCOUNT,
+    K_AMOUNT,
+    K_ARRAY,
+    K_HASH,
+    K_OBJECT,
+    K_PATHSET,
+    K_UINT8,
+    K_UINT64,
+    K_VECTOR256,
+    K_VL,
+    STI,
+    SField,
+    field_by_code,
+    sort_key,
+)
 from .stamount import STAmount
 
 _OBJECT_END = (int(STI.OBJECT), 1)  # 0xE1 marker
@@ -114,76 +129,78 @@ class STPathSet:
 
 _HASH_WIDTH = {STI.HASH128: 16, STI.HASH160: 20, STI.HASH256: 32}
 _INT_WIDTH = {STI.UINT8: 1, STI.UINT16: 2, STI.UINT32: 4, STI.UINT64: 8}
-# types whose Python values are value-like (never mutated in place), so
-# their encoded wire chunks may be cached on the owning STObject
-_VALUE_LIKE_STI = frozenset({
-    STI.UINT8, STI.UINT16, STI.UINT32, STI.UINT64,
-    STI.HASH128, STI.HASH160, STI.HASH256,
-    STI.AMOUNT, STI.VL, STI.ACCOUNT,
-})
+# single-byte end markers: OBJECT(14)<<4|1, ARRAY(15)<<4|1
+_OBJECT_END_B = b"\xe1"
+_ARRAY_END_B = b"\xf1"
 
 
 def _serialize_value(s: Serializer, f: SField, v: Any) -> None:
-    t = f.type_id
-    if t == STI.UINT8:
-        s.add8(v)
-    elif t == STI.UINT16:
-        s.add16(v)
-    elif t == STI.UINT32:
-        s.add32(v)
-    elif t == STI.UINT64:
-        s.add64(v)
-    elif t in _HASH_WIDTH:
-        s.add_bits(v, _HASH_WIDTH[t])
-    elif t == STI.AMOUNT:
+    """Encode one field value (header already written). Dispatch is over
+    the precomputed SField.kind int — enum identity tests and per-call
+    field-id encoding were measurable at flood rates."""
+    k = f.kind
+    buf = s._buf
+    if k <= K_UINT64:  # the four uint kinds, widths precomputed
+        if k == K_UINT8:
+            buf.append(v & 0xFF)
+        else:
+            # masked like Serializer.add16/32/64 (silent truncation is
+            # the historical add* contract)
+            buf += (v & ((1 << (8 * f.width)) - 1)).to_bytes(f.width, "big")
+    elif k == K_HASH:
+        if len(v) != f.width:
+            raise ValueError(f"expected {f.width} bytes, got {len(v)}")
+        buf += v
+    elif k == K_AMOUNT:
         v.serialize(s)
-    elif t == STI.VL:
+    elif k == K_VL:
         s.add_vl(v)
-    elif t == STI.ACCOUNT:
+    elif k == K_ACCOUNT:
         if len(v) != 20:
             raise ValueError("account field must be 20 bytes")
-        s.add_vl(v)
-    elif t == STI.OBJECT:
+        buf.append(20)
+        buf += v
+    elif k == K_OBJECT:
         v.serialize_to(s)
-        s.add_field_id(*_OBJECT_END)
-    elif t == STI.ARRAY:
+        buf += _OBJECT_END_B
+    elif k == K_ARRAY:
         v.serialize_to(s)
-        s.add_field_id(*_ARRAY_END)
-    elif t == STI.PATHSET:
+        buf += _ARRAY_END_B
+    elif k == K_PATHSET:
         v.serialize(s)
-    elif t == STI.VECTOR256:
+    elif k == K_VECTOR256:
         s.add_vl(b"".join(v))
     else:
-        raise ValueError(f"cannot serialize field type {t}")
+        raise ValueError(f"cannot serialize field type {f.type_id}")
 
 
 def _deserialize_value(p: BinaryParser, f: SField) -> Any:
-    t = f.type_id
-    if t in _INT_WIDTH:
-        return int.from_bytes(p.read(_INT_WIDTH[t]), "big")
-    if t in _HASH_WIDTH:
-        return p.read(_HASH_WIDTH[t])
-    if t == STI.AMOUNT:
+    k = f.kind
+    if k <= K_UINT64:
+        return int.from_bytes(p.read(f.width), "big")
+    if k == K_HASH:
+        return p.read(f.width)
+    if k == K_AMOUNT:
         return STAmount.deserialize(p)
-    if t == STI.VL:
+    if k == K_VL:
         return p.read_vl()
-    if t == STI.ACCOUNT:
+    if k == K_ACCOUNT:
         v = p.read_vl()
         if len(v) != 20:
             raise ValueError("account field must be 20 bytes")
         return v
-    if t == STI.OBJECT:
+    if k == K_OBJECT:
         return STObject.deserialize(p, inner=True)
-    if t == STI.ARRAY:
+    if k == K_ARRAY:
         return STArray.deserialize(p)
-    if t == STI.PATHSET:
+    if k == K_PATHSET:
         return STPathSet.deserialize(p)
-    if t == STI.VECTOR256:
+    if k == K_VECTOR256:
         raw = p.read_vl()
         if len(raw) % 32:
             raise ValueError("bad vector256 length")
         return [raw[i : i + 32] for i in range(0, len(raw), 32)]
-    raise ValueError(f"cannot deserialize field type {t}")
+    raise ValueError(f"cannot deserialize field type {f.type_id}")
 
 
 def _copy_value(v: Any) -> Any:
@@ -199,7 +216,7 @@ def _copy_value(v: Any) -> Any:
 class STObject:
     """Ordered-by-canon field map."""
 
-    __slots__ = ("_fields", "_version", "_sorted_keys", "_pairs", "_enc")
+    __slots__ = ("_fields", "_version", "_sorted_keys", "_pairs")
 
     def __init__(self, fields: dict[SField, Any] | None = None):
         self._fields: dict[SField, Any] = dict(fields or {})
@@ -216,12 +233,6 @@ class STObject:
         # times per apply (serialize, meta, invariants); rebuild only
         # after mutation
         self._pairs: tuple[int, list[tuple[SField, Any]]] | None = None
-        # field -> encoded wire chunk (field id + value), for VALUE-LIKE
-        # types only (ints/bytes/STAmount — never nested containers,
-        # which can be mutated in place without notifying this object).
-        # A hot SLE mutates 2-3 of its ~8 fields per tx; the unchanged
-        # fields' encodings are reused across serializations.
-        self._enc: dict[SField, bytes] = {}
 
     # -- mapping interface -------------------------------------------------
 
@@ -234,19 +245,16 @@ class STObject:
     def __setitem__(self, f: SField, v: Any) -> None:
         self._fields[f] = v
         self._version += 1
-        self._enc.pop(f, None)
 
     def __delitem__(self, f: SField) -> None:
         del self._fields[f]
         self._version += 1
-        self._enc.pop(f, None)
 
     def get(self, f: SField, default: Any = None) -> Any:
         return self._fields.get(f, default)
 
     def pop(self, f: SField, default: Any = None) -> Any:
         self._version += 1
-        self._enc.pop(f, None)
         return self._fields.pop(f, default)
 
     def fields(self) -> Iterator[tuple[SField, Any]]:
@@ -273,9 +281,6 @@ class STObject:
             # the key list is never mutated in place (fields() replaces
             # the tuple wholesale), so sharing it across copies is safe
             out._sorted_keys = (0, memo[1])
-        # cached chunks cover only value-like fields, whose values the
-        # copy shares — equal value, identical encoding
-        out._enc = dict(self._enc)
         return out
 
     def __len__(self) -> int:
@@ -295,19 +300,12 @@ class STObject:
         ``signing``, non-signing fields (signatures) are omitted
         (reference STObject::getSerializer / getSigningHash,
         SerializedObject.cpp:444)."""
-        enc = self._enc
+        buf = s._buf
         for f, v in self.fields():
             if signing and not f.signing:
                 continue
-            chunk = enc.get(f)
-            if chunk is not None:
-                s.add_raw(chunk)
-                continue
-            mark = len(s._buf)
-            s.add_field_id(int(f.type_id), f.value)
+            buf += f.header
             _serialize_value(s, f, v)
-            if f.type_id in _VALUE_LIKE_STI:
-                enc[f] = bytes(s._buf[mark:])
 
     def serialize(self, *, signing: bool = False) -> bytes:
         s = Serializer()
@@ -416,9 +414,9 @@ class STArray:
 
     def serialize_to(self, s: Serializer) -> None:
         for f, obj in self.items:
-            s.add_field_id(int(f.type_id), f.value)
+            s._buf += f.header
             obj.serialize_to(s)
-            s.add_field_id(*_OBJECT_END)
+            s._buf += _OBJECT_END_B
 
     @classmethod
     def deserialize(cls, p: BinaryParser) -> "STArray":

@@ -370,3 +370,58 @@ class TestAccountTxPagination:
         for bad in ("junk", {"ledger": 7}, {"ledger": "abc", "seq": 1}):
             r = call(limit=3, marker=bad)
             assert r.get("error") == "invalidParams", r
+
+
+class TestProfileHandler:
+    """The `profile` admin door (SURVEY §5 tracing): JAX-profiler trace
+    of the device plane, start/stop/status lifecycle, XPlane artifacts
+    on disk. Replaces the reference's perf-log role
+    (handlers/Profile.cpp is a stub there; our device plane has real
+    work worth tracing)."""
+
+    def test_trace_lifecycle_captures_xplane(self, tmp_path, node):
+        import numpy as np
+
+        r = call(node, "profile")
+        assert r["status"] == "idle"
+
+        d = str(tmp_path / "trace")
+        r = call(node, "profile", action="start", dir=d)
+        assert r["status"] == "tracing" and r["dir"] == d
+
+        # double-start is an explicit error, not a silent restart
+        r2 = call(node, "profile", action="start")
+        assert r2.get("error"), r2
+
+        # run device-plane work inside the trace window so the capture
+        # contains real XLA executions (cpu backend in tests)
+        from stellard_tpu.ops.ed25519_jax import prepare_batch, verify_kernel
+        from stellard_tpu.protocol.keys import KeyPair
+
+        rng = np.random.default_rng(1)
+        keys = [KeyPair.from_seed(bytes(rng.integers(0, 256, 32,
+                                                     dtype=np.uint8)))
+                for _ in range(4)]
+        msgs = [bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+                for _ in range(16)]
+        sigs = [keys[i % 4].sign(msgs[i]) for i in range(16)]
+        pubs = [keys[i % 4].public for i in range(16)]
+        out = verify_kernel(**prepare_batch(pubs, msgs, sigs))
+        out.block_until_ready()
+        assert bool(np.asarray(out).all())
+
+        r = call(node, "profile", action="stop")
+        assert r["status"] == "stopped" and r["dir"] == d
+        # XPlane artifacts written (plugins/profile/<ts>/*.xplane.pb)
+        import glob
+
+        found = glob.glob(d + "/**/*.xplane.pb", recursive=True)
+        assert found, f"no xplane capture under {d}"
+
+        r = call(node, "profile")
+        assert r["status"] == "idle"
+        assert "verify_latency" in r
+
+    def test_stop_without_start_errors(self, node):
+        r = call(node, "profile", action="stop")
+        assert r.get("error"), r

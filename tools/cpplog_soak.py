@@ -1,0 +1,126 @@
+"""Long-soak the cpplog NodeStore: growth, reopen time, read latency.
+
+The LevelDB-role blind spot from SURVEY §2.8 / VERDICT r4 #9: cpplog
+stores raw (uncompressed) content-addressed blobs in an append-only log;
+nobody had measured store growth or reopen cost over a long run. This
+soak writes ledger-shaped batches (SHAMap-node-sized blobs, hash-keyed)
+at a paced rate, and periodically:
+
+  - records logical bytes written vs file size on disk (overhead ratio),
+  - closes + reopens the store, timing the reopen (index rebuild scan),
+  - reads a random sample of historical keys, timing fetch latency.
+
+Paced (default one batch per 2s) so it can run for hours beside the
+build without owning the box. Appends one JSON line per checkpoint to
+the output file; the final line carries `"summary": true`.
+
+Usage: python tools/cpplog_soak.py [minutes] [out.jsonl]
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from stellard_tpu.nodestore.core import NodeObject, NodeObjectType, make_backend  # noqa: E402
+
+MINUTES = float(sys.argv[1]) if len(sys.argv) > 1 else 120.0
+OUT = sys.argv[2] if len(sys.argv) > 2 else os.path.join(REPO, "SOAK_CPPLOG.jsonl")
+STORE = os.environ.get("SOAK_STORE", "/tmp/stellard_soak.cpplog")
+BATCH = int(os.environ.get("SOAK_BATCH", "400"))  # ~1 closed ledger's nodes
+PACE_S = float(os.environ.get("SOAK_PACE_S", "2.0"))
+CHECKPOINT_EVERY = int(os.environ.get("SOAK_CHECKPOINT", "120"))  # batches
+
+
+def _mk_batch(rng: random.Random, seq: int) -> list[NodeObject]:
+    """SHAMap-shaped blobs: mostly inner nodes (~512B of child hashes)
+    and account-state leaves (~200B), a few tx+meta items (~600B)."""
+    out = []
+    for i in range(BATCH):
+        kind = rng.random()
+        if kind < 0.55:
+            size, t = 512, NodeObjectType.ACCOUNT_NODE
+        elif kind < 0.9:
+            size, t = 200, NodeObjectType.ACCOUNT_NODE
+        else:
+            size, t = 600, NodeObjectType.TRANSACTION_NODE
+        data = rng.randbytes(size - 8) + seq.to_bytes(4, "big") + i.to_bytes(4, "big")
+        out.append(NodeObject(t, hashlib.sha256(data).digest(), data))
+    return out
+
+
+def main() -> None:
+    rng = random.Random(42)
+    if os.path.exists(STORE):
+        os.remove(STORE)
+    be = make_backend("cpplog", path=STORE)
+    deadline = time.monotonic() + MINUTES * 60
+    written = 0
+    logical = 0
+    keys: list[bytes] = []
+    t_start = time.monotonic()
+    batches = 0
+    write_s = 0.0
+    f = open(OUT, "a")
+
+    def checkpoint(reopen: bool) -> dict:
+        nonlocal be
+        size = os.path.getsize(STORE)
+        row = {
+            "t_min": round((time.monotonic() - t_start) / 60, 2),
+            "batches": batches,
+            "objects": written,
+            "logical_mb": round(logical / 1e6, 2),
+            "file_mb": round(size / 1e6, 2),
+            "overhead": round(size / logical, 4) if logical else 0.0,
+            "write_mb_s": round(logical / 1e6 / write_s, 2) if write_s else 0.0,
+        }
+        sample = rng.sample(keys, min(200, len(keys)))
+        t0 = time.perf_counter()
+        misses = sum(1 for k in sample if be.fetch(k) is None)
+        row["fetch_us"] = round(
+            (time.perf_counter() - t0) / max(1, len(sample)) * 1e6, 1)
+        row["fetch_misses"] = misses
+        if reopen:
+            be.close()
+            t0 = time.perf_counter()
+            be = make_backend("cpplog", path=STORE)
+            row["reopen_s"] = round(time.perf_counter() - t0, 3)
+            # reopened store must still serve a historical key
+            k = rng.choice(keys)
+            row["reopen_fetch_ok"] = be.fetch(k) is not None
+        f.write(json.dumps(row) + "\n")
+        f.flush()
+        return row
+
+    while time.monotonic() < deadline:
+        batch = _mk_batch(rng, batches)
+        t0 = time.perf_counter()
+        be.store_batch(batch)
+        write_s += time.perf_counter() - t0
+        batches += 1
+        written += len(batch)
+        logical += sum(len(o.data) for o in batch)
+        if len(keys) < 50_000:
+            keys.extend(o.hash for o in batch[:20])
+        if batches % CHECKPOINT_EVERY == 0:
+            checkpoint(reopen=(batches % (CHECKPOINT_EVERY * 4) == 0))
+        time.sleep(PACE_S)
+
+    row = checkpoint(reopen=True)
+    row["summary"] = True
+    f.write(json.dumps(row) + "\n")
+    f.close()
+    be.close()
+    print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

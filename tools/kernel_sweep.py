@@ -41,7 +41,7 @@ def ensure_sigset():
              sigs=np.frombuffer(b"".join(sigs), np.uint8).reshape(N,64))
 
 def one_config(unroll, batches, comb="mxu", hoist=0, group=0, impl="xla",
-               block=512, check="bytes"):
+               block=512, check="bytes", wire="raw"):
     """Run one (unroll, comb-select, hoist, group, impl, check, batches)
     measurement in a SUBPROCESS so each tunnel session is fresh and a
     wedge can't kill the sweep. Inputs are cycled across distinct sets
@@ -59,6 +59,7 @@ os.environ["STELLARD_HOIST_SELECT"] = "{hoist}"
 os.environ["STELLARD_GROUP_OPS"] = "{group}"
 os.environ["STELLARD_PALLAS_BLOCK"] = "{block}"
 os.environ["STELLARD_VERIFY_CHECK"] = "{check}"
+os.environ["STELLARD_WIRE"] = "{wire}"
 sys.path.insert(0, {REPO!r})
 import jax
 if os.environ.get("STELLARD_SWEEP_ALLOW_CPU") != "1":
@@ -93,20 +94,20 @@ for batch in {batches}:
             [z["sigs"][i].tobytes() for i in idx],
         ))
     t0=time.time(); out = verify_kernel(**sets[0]); out.block_until_ready()
-    print(f"unroll={unroll} comb={comb} hoist={hoist} group={group} impl={impl} block={block} check={check} batch={{batch}} compile {{time.time()-t0:.0f}}s", flush=True)
+    print(f"unroll={unroll} comb={comb} hoist={hoist} group={group} impl={impl} block={block} check={check} wire={wire} batch={{batch}} compile {{time.time()-t0:.0f}}s", flush=True)
     assert np.asarray(out).all()
     t0=time.time(); n=0
     while time.time()-t0 < 5:
         verify_kernel(**sets[n % len(sets)]).block_until_ready(); n+=1
     dt=(time.time()-t0)/n
-    print(f"RESULT unroll={unroll} comb={comb} hoist={hoist} group={group} impl={impl} block={block} check={check} batch={{batch}} lat={{dt*1000:.1f}}ms rate={{batch/dt:,.0f}} sigs/s", flush=True)
+    print(f"RESULT unroll={unroll} comb={comb} hoist={hoist} group={group} impl={impl} block={block} check={check} wire={wire} batch={{batch}} lat={{dt*1000:.1f}}ms rate={{batch/dt:,.0f}} sigs/s", flush=True)
 '''
     try:
         r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                            text=True, timeout=1500)
     except subprocess.TimeoutExpired:
         print(f"unroll={unroll} comb={comb} hoist={hoist} group={group} "
-              f"impl={impl} block={block} check={check} batches={batches}: TIMED OUT "
+              f"impl={impl} block={block} check={check} wire={wire} batches={batches}: TIMED OUT "
               f"(wedged tunnel?) — skipping", flush=True)
         return False
     out = "\n".join(l for l in (r.stdout + r.stderr).splitlines()
@@ -126,6 +127,7 @@ for batch in {batches}:
                     "impl": kv.get("impl", "xla"),
                     "block": int(kv.get("block", 512)),
                     "check": kv.get("check", "bytes"),
+                    "wire": kv.get("wire", "digits"),
                     "batch": int(kv["batch"]),
                     "rate": float(kv["rate"].replace(",", "")),
                 })
@@ -192,28 +194,30 @@ import jax
 if os.environ.get("STELLARD_SWEEP_ALLOW_CPU") != "1":
     assert jax.devices()[0].platform != "cpu", "no tpu"
 from stellard_tpu.ops.ed25519_jax import prepare_batch
+import jax.numpy as jnp
 z = np.load("{CACHE}")
 B = 16384
 idx = list(range(B))
-inputs = prepare_batch(
-    [z["pubs"][i % len(z["pubs"])].tobytes() for i in idx],
-    [z["msgs"][i % len(z["msgs"])].tobytes() for i in idx],
-    [z["sigs"][i % len(z["sigs"])].tobytes() for i in idx],
-    device_put=False,
-)
-nbytes = sum(np.asarray(v).nbytes for v in inputs.values())
-import jax.numpy as jnp
-# one warm put, then timed puts of fresh host copies
-for _ in range(2):
-    res = {{k: jnp.asarray(v) for k, v in inputs.items()}}
-    jax.block_until_ready(list(res.values()))
-t0 = time.time(); n = 0
-while time.time() - t0 < 5:
-    res = {{k: jnp.asarray(np.ascontiguousarray(v)) for k, v in inputs.items()}}
-    jax.block_until_ready(list(res.values()))
-    n += 1
-dt = (time.time() - t0) / n
-print(f"RESULT transfer batch={{B}} bytes={{nbytes}} per_put={{dt*1000:.1f}}ms rate={{nbytes/dt/1e6:.1f}} MB/s", flush=True)
+for wire in ("raw", "digits"):
+    os.environ["STELLARD_WIRE"] = wire
+    inputs = prepare_batch(
+        [z["pubs"][i % len(z["pubs"])].tobytes() for i in idx],
+        [z["msgs"][i % len(z["msgs"])].tobytes() for i in idx],
+        [z["sigs"][i % len(z["sigs"])].tobytes() for i in idx],
+        device_put=False,
+    )
+    nbytes = sum(np.asarray(v).nbytes for v in inputs.values())
+    # one warm put, then timed puts of fresh host copies
+    for _ in range(2):
+        res = {{k: jnp.asarray(v) for k, v in inputs.items()}}
+        jax.block_until_ready(list(res.values()))
+    t0 = time.time(); n = 0
+    while time.time() - t0 < 5:
+        res = {{k: jnp.asarray(np.ascontiguousarray(v)) for k, v in inputs.items()}}
+        jax.block_until_ready(list(res.values()))
+        n += 1
+    dt = (time.time() - t0) / n
+    print(f"RESULT transfer wire={{wire}} batch={{B}} bytes={{nbytes}} per_put={{dt*1000:.1f}}ms rate={{nbytes/dt/1e6:.1f}} MB/s", flush=True)
 '''
     try:
         r = subprocess.run([sys.executable, "-c", code], capture_output=True,
@@ -245,7 +249,8 @@ def write_tuning():
         return (r.get("unroll", 1), r.get("comb", "mxu"),
                 r.get("hoist", 0), r.get("group", 0),
                 r.get("impl", "xla"), r.get("block", 512),
-                r.get("check", "bytes"), r.get("batch"))
+                r.get("check", "bytes"), r.get("wire", "digits"),
+                r.get("batch"))
     seen = {key(r) for r in rows}
     for r in prior:
         # normalize historical source-revision labels: "rowpad" IS the
@@ -281,6 +286,7 @@ def write_tuning():
             "impl": best.get("impl", "xla"),
             "block": best.get("block", 512),
             "check": best.get("check", "bytes"),
+            "wire": best.get("wire", "digits"),
             "batch": best["batch"],
             "rate": best["rate"],
             "all": RESULTS,
@@ -305,7 +311,13 @@ if __name__ == "__main__":
     # (grouping is the regression); hoisted+grouped = 63.7k. Standing
     # record: 103.4k @32768 (prior window). Remaining questions,
     # ordered so a short window answers the biggest first:
-    # 1) the inversion-free projective final check (~15% fewer
+    # 1) the raw-bytes wire on the known winner config (the e2e
+    #    headline's transfer leg: 129 B/sig vs 193; kernel math
+    #    unchanged, so rate should match the 103.4k record while e2e
+    #    improves), then the digits wire as the A/B control:
+    one_config(1, [16384, 32768], wire="raw")
+    write_tuning()
+    # 2) the inversion-free projective final check (~15% fewer
     #    sequential wide ops than the ref10 byte-compare shape):
     one_config(1, [16384, 32768], check="point")
     # 2) the Pallas whole-verify-in-VMEM kernel vs the XLA formulation
@@ -327,7 +339,16 @@ if __name__ == "__main__":
     # 4) batch scaling of the XLA winner beyond the 32768 record:
     one_config(1, [32768, 65536], group=0)
     write_tuning()
-    # 5) in-loop comb-select strategies at the winning defaults:
+    # 5) consensus-close-sized batches (VERDICT r4 #8): can ANY device
+    #    config beat threaded-native at ~300-2048 sigs? Pallas small
+    #    blocks are the candidate; the XLA row is the control. If both
+    #    lose to the host at these sizes, the router's CPU floor on the
+    #    close leg is the measured-optimal answer and PERF.md says so.
+    one_config(1, [512, 2048], impl="pallas", block=256)
+    write_tuning()
+    one_config(1, [512, 2048])
+    write_tuning()
+    # 6) in-loop comb-select strategies at the winning defaults:
     one_config(1, [16384], comb="mxu_split")
     write_tuning()
     one_config(1, [16384], comb="vpu")

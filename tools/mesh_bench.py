@@ -1,0 +1,99 @@
+"""Mesh-throughput bench: the sharded verify step on N virtual CPU devices.
+
+Run as a SUBPROCESS (the host process usually has a JAX backend already
+initialised; the device-count flag must be set before init). Prints one
+JSON line:
+
+  {"mesh_devices": N, "batch": B, "mesh_rate": r, "single_rate": r1,
+   "scaling": r/r1}
+
+On this 1-core build box the N virtual devices time-slice one core, so
+`scaling` ~1.0 is healthy; the leg exists to (a) keep the
+`parallel/mesh.py` sharded path exercised with a throughput number every
+round so a sharding/collective regression shows up as a number, not just
+a dryrun pass/fail, and (b) report real scaling when run on multi-core
+hosts or a real mesh. Reference seam: SURVEY §2.9 mapping #3 (ICI
+data-parallel verify, the NCCL-role replacement).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import time
+
+N = int(os.environ.get("MESH_BENCH_DEVICES", "8"))
+BATCH = int(os.environ.get("MESH_BENCH_BATCH", "2048"))
+SECONDS = float(os.environ.get("MESH_BENCH_SECONDS", "5"))
+
+opt = f"--xla_force_host_platform_device_count={N}"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" in flags:
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", opt, flags)
+else:
+    flags = (flags + " " + opt).strip()
+os.environ["XLA_FLAGS"] = flags
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from stellard_tpu.ops.ed25519_jax import prepare_batch, verify_kernel
+    from stellard_tpu.parallel.mesh import make_mesh, verify_and_count
+    from stellard_tpu.protocol.keys import KeyPair
+
+    rng = np.random.default_rng(3)
+    keys = [KeyPair.from_seed(bytes(rng.integers(0, 256, 32, dtype=np.uint8)))
+            for _ in range(16)]
+    msgs = [bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+            for _ in range(BATCH)]
+    sigs = [keys[i % 16].sign(msgs[i]) for i in range(BATCH)]
+    pubs = [keys[i % 16].public for i in range(BATCH)]
+    inp = prepare_batch(pubs, msgs, sigs)
+    args = (inp["a_words"], inp["r_words"], inp["s_windows"],
+            inp["h_digits"], inp["s_canonical"])
+
+    devices = [d for d in jax.devices() if d.platform == "cpu"][:N]
+    assert len(devices) == N, f"need {N} cpu devices, have {jax.devices()}"
+    mesh = make_mesh(devices)
+    step = verify_and_count(mesh)
+
+    flags_out, total = step(*args)
+    flags_out.block_until_ready()  # compile
+    assert int(total) == BATCH, (int(total), BATCH)
+    t0 = time.time()
+    n = 0
+    while time.time() - t0 < SECONDS:
+        f, _ = step(*args)
+        f.block_until_ready()
+        n += 1
+    mesh_rate = BATCH * n / (time.time() - t0)
+
+    out = verify_kernel(**inp)
+    out.block_until_ready()  # compile
+    t0 = time.time()
+    n = 0
+    while time.time() - t0 < SECONDS:
+        verify_kernel(**inp).block_until_ready()
+        n += 1
+    single_rate = BATCH * n / (time.time() - t0)
+
+    print(json.dumps({
+        "mesh_devices": N,
+        "batch": BATCH,
+        "mesh_rate": round(mesh_rate, 1),
+        "single_rate": round(single_rate, 1),
+        "scaling": round(mesh_rate / single_rate, 3) if single_rate else 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

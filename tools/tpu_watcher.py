@@ -22,8 +22,10 @@ LOG = os.path.join(REPO, "SWEEP_r05.log")
 PROBE_TIMEOUT = 120
 # a wedged probe HANGS its full timeout, so the down-cycle is already
 # PROBE_TIMEOUT + interval; r4's windows were as short as ~8 min, and a
-# 300s interval can eat half a window before the first UP probe lands
-PROBE_INTERVAL = 60
+# 300s interval can eat half a window before the first UP probe lands.
+# Each probe also burns ~25s of this 1-core box on the jax import, so
+# the interval is a contention/detection-latency tradeoff (~9% duty).
+PROBE_INTERVAL = 150
 RUN_TIMEOUT = 5400  # sweep/bench can compile for ~3min/shape; a wedge hangs forever
 
 
