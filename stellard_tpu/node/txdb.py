@@ -78,30 +78,12 @@ class TxDatabase:
 
     # -- transactions -----------------------------------------------------
 
-    def save_transaction(
-        self,
-        txid: bytes,
-        tx_type: str,
-        account: bytes,
-        seq: int,
-        ledger_seq: int,
-        status: str,
-        raw: bytes,
-        meta: bytes,
-        affected_accounts: list[bytes],
-        txn_seq: int = 0,
-    ) -> None:
-        self.save_transactions([
-            (txid, tx_type, account, seq, ledger_seq, status, raw, meta,
-             affected_accounts, txn_seq)
-        ])
-
     def save_transactions(self, rows: list[tuple]) -> None:
-        """Bulk form of save_transaction for one closed ledger: three
-        executemany calls instead of 3+len(affected) executes per tx
-        (sqlite statement dispatch was ~25% of the flood apply path).
-        Each row is (txid, tx_type, account, seq, ledger_seq, status,
-        raw, meta, affected_accounts, txn_seq)."""
+        """Persist a closed ledger's tx rows: three executemany calls
+        instead of 3+len(affected) executes per tx (sqlite statement
+        dispatch was ~25% of the flood apply path). Each row is
+        (txid, tx_type, account, seq, ledger_seq, status, raw, meta,
+        affected_accounts, txn_seq)."""
         tx_rows = []
         del_rows = []
         acct_rows = []

@@ -531,7 +531,7 @@ def execute_strand(
                             else in_cap - total_paid)
                 if cap_left.signum() <= 0:
                     break
-                est_in, est_out = book_quote(
+                _, est_out = book_quote(
                     les, hop.in_currency, hop.in_issuer, still, cap_left
                 )
                 if est_out.signum() <= 0:

@@ -68,21 +68,11 @@ class Serializer:
         self._buf += data
 
     def add_field_id(self, type_id: int, name: int) -> None:
-        if not (0 < type_id < 256 and 0 < name < 256):
-            raise ValueError(f"bad field id ({type_id}, {name})")
-        if type_id < 16:
-            if name < 16:
-                self._buf.append((type_id << 4) | name)
-            else:
-                self._buf.append(type_id << 4)
-                self._buf.append(name)
-        elif name < 16:
-            self._buf.append(name)
-            self._buf.append(type_id)
-        else:
-            self._buf.append(0)
-            self._buf.append(type_id)
-            self._buf.append(name)
+        # single source of truth for the field-id encoding: the same
+        # function that precomputes SField.header (sfields._field_header)
+        from .sfields import _field_header
+
+        self._buf += _field_header(type_id, name)
 
     def sha512_half(self) -> bytes:
         return sha512_half(bytes(self._buf))

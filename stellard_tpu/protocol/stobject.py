@@ -127,8 +127,6 @@ class STPathSet:
         return out
 
 
-_HASH_WIDTH = {STI.HASH128: 16, STI.HASH160: 20, STI.HASH256: 32}
-_INT_WIDTH = {STI.UINT8: 1, STI.UINT16: 2, STI.UINT32: 4, STI.UINT64: 8}
 # single-byte end markers: OBJECT(14)<<4|1, ARRAY(15)<<4|1
 _OBJECT_END_B = b"\xe1"
 _ARRAY_END_B = b"\xf1"
@@ -140,7 +138,7 @@ def _serialize_value(s: Serializer, f: SField, v: Any) -> None:
     field-id encoding were measurable at flood rates."""
     k = f.kind
     buf = s._buf
-    if k <= K_UINT64:  # the four uint kinds, widths precomputed
+    if 0 <= k <= K_UINT64:  # the four uint kinds, widths precomputed
         if k == K_UINT8:
             buf.append(v & 0xFF)
         else:
@@ -176,7 +174,7 @@ def _serialize_value(s: Serializer, f: SField, v: Any) -> None:
 
 def _deserialize_value(p: BinaryParser, f: SField) -> Any:
     k = f.kind
-    if k <= K_UINT64:
+    if 0 <= k <= K_UINT64:
         return int.from_bytes(p.read(f.width), "big")
     if k == K_HASH:
         return p.read(f.width)
@@ -359,8 +357,8 @@ class STObject:
 
         out: dict[str, Any] = {}
         for f, v in self.fields():
-            t = f.type_id
-            if t in _INT_WIDTH:
+            k = f.kind
+            if 0 <= k <= K_UINT64:
                 # render the type discriminators symbolically, as the
                 # reference's STObject::getJson does via KnownFormats
                 if f.name == "TransactionType":
@@ -373,21 +371,21 @@ class STObject:
                         out[f.name] = v
                 else:
                     out[f.name] = v
-            elif t in _HASH_WIDTH:
+            elif k == K_HASH:
                 out[f.name] = v.hex().upper()
-            elif t == STI.AMOUNT:
+            elif k == K_AMOUNT:
                 out[f.name] = v.to_json()
-            elif t == STI.VL:
+            elif k == K_VL:
                 out[f.name] = v.hex().upper()
-            elif t == STI.ACCOUNT:
+            elif k == K_ACCOUNT:
                 out[f.name] = encode_account_id(v)
-            elif t == STI.OBJECT:
+            elif k == K_OBJECT:
                 out[f.name] = v.to_json()
-            elif t == STI.ARRAY:
+            elif k == K_ARRAY:
                 out[f.name] = v.to_json()
-            elif t == STI.PATHSET:
+            elif k == K_PATHSET:
                 out[f.name] = v.to_json()
-            elif t == STI.VECTOR256:
+            elif k == K_VECTOR256:
                 out[f.name] = [h.hex().upper() for h in v]
         return out
 

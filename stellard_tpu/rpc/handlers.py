@@ -246,8 +246,14 @@ def do_server_info(ctx: Context) -> dict:
     # LCL here would claim agreement the net has not reached (closed
     # chains legitimately diverge until validations land)
     val = lm.validated if lm.validated is not None else lcl
+    from ..utils.rfc1751 import word_from_blob
+
     info = {
         "build_version": "stellard-tpu 0.1.0",
+        # one RFC 1751 dictionary word naming this node — the reference
+        # derives it from the node address (NetworkOPs.cpp:1696,
+        # RFC1751::getWordFromBlob); here from the node identity key
+        "hostid": word_from_blob(node.node_keys.public),
         "server_state": node.ops.server_state(),
         "complete_ledgers": _complete_ledgers(node),
         "peers": (

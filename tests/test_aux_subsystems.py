@@ -357,8 +357,11 @@ class TestCppLogCompression:
         )
 
         path = str(tmp_path / "c.cpplog")
+        import random as _random
+
         compressible = b"AB" * 300  # deflates well
-        random_blob = bytes(range(256)) * 2  # stored raw (no saving)
+        random_blob = _random.Random(7).randbytes(512)  # stays raw
+        assert len(_zlib.compress(random_blob, 1)) >= len(random_blob)
 
         be = make_backend("cpplog", path=path, compression="zlib")
         import hashlib

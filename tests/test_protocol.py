@@ -360,3 +360,59 @@ class TestTER:
         assert TER.terPRE_SEQ.is_ter
         assert TER.tefPAST_SEQ.is_tef
         assert TER.telINSUF_FEE_P.is_tel
+
+
+class TestRFC1751:
+    """RFC 1751 human keys (reference: crypto/RFC1751.cpp). The live
+    consumer is server_info's hostid word; key<->English is the full
+    (vestigial in the reference) API, pinned to the RFC's own vectors."""
+
+    def test_rfc_appendix_vectors(self):
+        from stellard_tpu.utils.rfc1751 import english_to_key, key_to_english
+
+        assert english_to_key(
+            "RASH BUSH MILK LOOK BAD BRIM AVID GAFF BAIT ROT POD LOVE"
+        ).hex().upper() == "CCAC2AED591056BE4F90FD441C534766"
+        assert key_to_english(
+            bytes.fromhex("EFF81F9BFBC65350920CDD7416DE8009")
+        ) == "TROD MUTE TAIL WARM CHAR KONG HAAG CITY BORE O TEAL AWL"
+
+    def test_roundtrip_and_normalization(self):
+        import os as _os
+
+        from stellard_tpu.utils.rfc1751 import english_to_key, key_to_english
+
+        for _ in range(32):
+            k = _os.urandom(16)
+            assert english_to_key(key_to_english(k)) == k
+        # lowercase + digit-for-letter confusables normalize (the
+        # reference INTENDS this; its standard() is a no-op bug)
+        assert english_to_key(
+            "rash bush milk l00k bad brim avid gaff bait rot pod love"
+        ).hex().upper() == "CCAC2AED591056BE4F90FD441C534766"
+
+    def test_error_classes(self):
+        import pytest as _pytest
+
+        from stellard_tpu.utils.rfc1751 import english_to_key
+
+        good = "RASH BUSH MILK LOOK BAD BRIM AVID GAFF BAIT ROT POD LOVE"
+        with _pytest.raises(ValueError):  # wrong word count
+            english_to_key("RASH BUSH")
+        with _pytest.raises(ValueError):  # unknown word
+            english_to_key(good.replace("MILK", "XYZQ"))
+        with _pytest.raises(ValueError):  # parity broken by a word swap
+            english_to_key(good.replace("BAD", "BAN"))
+
+    def test_hostid_in_server_info(self):
+        from stellard_tpu.node.config import Config
+        from stellard_tpu.node.node import Node
+        from stellard_tpu.rpc.handlers import Context, Role, dispatch
+        from stellard_tpu.utils.rfc1751 import WORDS
+
+        n = Node(Config(signature_backend="cpu")).setup()
+        try:
+            info = dispatch(Context(n, {}, Role.ADMIN), "server_info")
+            assert info["info"]["hostid"] in WORDS
+        finally:
+            n.stop()
