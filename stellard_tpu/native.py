@@ -18,6 +18,7 @@ from typing import Optional
 
 __all__ = [
     "load_native",
+    "load_stser",
     "native_available",
     "Sha512Native",
     "Ed25519HostPrep",
@@ -73,6 +74,55 @@ def load_native() -> Optional[ctypes.CDLL]:
 
 def native_available() -> bool:
     return load_native() is not None
+
+
+_stser_mod = None
+_stser_tried = False
+
+
+def load_stser():
+    """Build (once) and import the _stser CPython extension (the
+    STObject serializer fast path); None when the toolchain or build is
+    unavailable — callers keep the pure-Python encode loop."""
+    global _stser_mod, _stser_tried
+    with _lock:
+        if _stser_mod is not None or _stser_tried:
+            return _stser_mod
+        _stser_tried = True
+        path = os.path.join(_NATIVE_DIR, "_stser.so")
+        if os.path.isdir(_NATIVE_DIR):
+            try:
+                # build against the RUNNING interpreter's headers — the
+                # Makefile's `python3` may be a different installation,
+                # and a version-mismatched extension dlopens anyway
+                # (inline object-layout macros would then misread)
+                import sysconfig
+
+                subprocess.run(
+                    ["make", "-s", "_stser.so",
+                     f"PY_INC={sysconfig.get_paths()['include']}"],
+                    cwd=_NATIVE_DIR,
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except (OSError, subprocess.SubprocessError):
+                if not os.path.exists(path):
+                    return None
+        if not os.path.exists(path):
+            return None
+        try:
+            import importlib.machinery
+            import importlib.util
+
+            loader = importlib.machinery.ExtensionFileLoader("_stser", path)
+            spec = importlib.util.spec_from_loader("_stser", loader)
+            mod = importlib.util.module_from_spec(spec)
+            loader.exec_module(mod)
+        except (ImportError, OSError):
+            return None
+        _stser_mod = mod
+        return _stser_mod
 
 
 def _bind(lib: ctypes.CDLL) -> None:

@@ -82,6 +82,7 @@ class SField:
     header: bytes = b""  # the encoded field id (empty for non-wire types)
     kind: int = -1  # K_* tag, -1 for non-wire types
     width: int = 0  # fixed byte width for K_UINT*/K_HASH kinds
+    cid: int = -1  # dense registry index (the native serializer's key)
 
     def __post_init__(self):
         k = _KIND_OF.get(self.type_id, -1)
@@ -107,10 +108,16 @@ _REGISTRY_BY_NAME: dict[str, SField] = {}
 
 
 def _f(name: str, type_id: STI, value: int, signing: bool = True) -> SField:
-    f = SField(name, type_id, value, signing)
+    f = SField(name, type_id, value, signing, cid=len(_REGISTRY_BY_CODE))
     _REGISTRY_BY_CODE[f.code] = f
     _REGISTRY_BY_NAME[name] = f
     return f
+
+
+def all_fields():
+    """Registry snapshot (the native serializer registers constants per
+    field at load)."""
+    return list(_REGISTRY_BY_CODE.values())
 
 
 # --- 8-bit ---------------------------------------------------------------

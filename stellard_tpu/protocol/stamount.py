@@ -213,6 +213,19 @@ class STAmount:
         s.add_bits(self.currency, 20)
         s.add_bits(self.issuer, 20)
 
+    def wire_bytes(self) -> bytes:
+        """Memoized wire encoding (8 bytes native / 48 bytes IOU) —
+        amounts are value objects, never mutated after construction, so
+        the first serialization's bytes serve every later one (the
+        native serializer consumes this)."""
+        w = getattr(self, "_wire", None)
+        if w is None:
+            s = Serializer()
+            self.serialize(s)
+            w = s.data()
+            self._wire = w
+        return w
+
     @classmethod
     def deserialize(cls, p: BinaryParser) -> "STAmount":
         value = p.read64()

@@ -201,6 +201,47 @@ def _deserialize_value(p: BinaryParser, f: SField) -> Any:
     raise ValueError(f"cannot deserialize field type {f.type_id}")
 
 
+# -- native fast path ------------------------------------------------------
+# The _stser CPython extension (native/src/stser.cc) encodes the sorted
+# pair list in C; container kinds call back into _container_chunk, which
+# recurses through the same machinery per nesting level. Disable with
+# STELLARD_NATIVE_STSER=0 (the differential tests pin byte-equality).
+
+_STSER = None
+_STSER_TRIED = False
+
+
+def _container_chunk(f: SField, v: Any) -> bytes:
+    s = Serializer()
+    _serialize_value(s, f, v)
+    return s.data()
+
+
+def _get_stser():
+    global _STSER, _STSER_TRIED
+    if not _STSER_TRIED:
+        _STSER_TRIED = True
+        import os as _os
+
+        if _os.environ.get("STELLARD_NATIVE_STSER", "1") != "0":
+            try:
+                from ..native import load_stser
+                from .sfields import all_fields
+
+                mod = load_stser()
+                if mod is not None:
+                    mod.register_fields(
+                        [(f.cid, f.header, f.kind, f.width,
+                          1 if f.signing else 0)
+                         for f in all_fields() if f.kind >= 0],
+                        _container_chunk,
+                    )
+                    globals()["_STSER"] = mod
+            except Exception:  # noqa: BLE001 — fall back to the Python loop
+                pass
+    return _STSER
+
+
 def _copy_value(v: Any) -> Any:
     if isinstance(v, list):
         return [_copy_value(x) for x in v]
@@ -256,9 +297,15 @@ class STObject:
         return self._fields.pop(f, default)
 
     def fields(self) -> Iterator[tuple[SField, Any]]:
+        return iter(self._pairs_list())
+
+    def _pairs_list(self) -> list[tuple[SField, Any]]:
+        """Canonically sorted (field, value) pairs, memoized per version.
+        Callers must NOT mutate the list (fields() hands out iterators;
+        the native serializer reads it directly)."""
         pairs = self._pairs
         if pairs is not None and pairs[0] == self._version:
-            return iter(pairs[1])
+            return pairs[1]
         memo = self._sorted_keys
         if memo is None or memo[0] != self._version:
             keys = sorted(self._fields, key=sort_key)
@@ -267,7 +314,7 @@ class STObject:
         # materialized so callers keep snapshot semantics under mutation
         lst = [(k, fields[k]) for k in memo[1]]
         self._pairs = (self._version, lst)
-        return iter(lst)
+        return lst
 
     def copy(self) -> "STObject":
         """Copy that detaches container values (lists, nested objects,
@@ -298,6 +345,10 @@ class STObject:
         ``signing``, non-signing fields (signatures) are omitted
         (reference STObject::getSerializer / getSigningHash,
         SerializedObject.cpp:444)."""
+        st = _get_stser()
+        if st is not None:
+            s._buf += st.serialize(self._pairs_list(), 1 if signing else 0)
+            return
         buf = s._buf
         for f, v in self.fields():
             if signing and not f.signing:
@@ -411,6 +462,12 @@ class STArray:
         return isinstance(other, STArray) and self.items == other.items
 
     def serialize_to(self, s: Serializer) -> None:
+        st = _get_stser()
+        if st is not None:
+            # item pairs ride the same native loop: K_OBJECT routes
+            # through the container callback (header + body + end mark)
+            s._buf += st.serialize(self.items, 0)
+            return
         for f, obj in self.items:
             s._buf += f.header
             obj.serialize_to(s)

@@ -22,6 +22,7 @@ constants). Deliberate divergences, both node-local and cosmetic:
 
 from __future__ import annotations
 
+import bisect
 import hashlib
 
 __all__ = ["key_to_english", "english_to_key", "word_from_blob"]
@@ -306,8 +307,6 @@ def _etob(words6: list[str]) -> bytes:
         w = _standard(w)
         lo, hi = (0, _SHORT_MAX) if len(w) < 4 else (_SHORT_MAX, 2048)
         # binary search within the length-partitioned dictionary range
-        import bisect
-
         i = bisect.bisect_left(WORDS, w, lo, hi)
         if i >= hi or WORDS[i] != w:
             raise ValueError(f"unknown word {w!r}")

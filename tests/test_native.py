@@ -242,3 +242,103 @@ class TestNativeEd25519Verify:
         assert v.impl == "native"
         monkeypatch.setenv("STELLARD_HOST_VERIFY", "python")
         assert make_verifier("cpu").impl == "openssl"
+
+
+class TestNativeStser:
+    """The _stser CPython extension (native/src/stser.cc) must be
+    byte-identical to the Python encode loop across every wire shape —
+    a divergence is consensus-fatal (hashes change)."""
+
+    def _py_bytes(self, obj, signing=False):
+        from stellard_tpu.protocol import stobject as so
+
+        st = so._STSER
+        so._STSER = None
+        try:
+            return obj.serialize(signing=signing)
+        finally:
+            so._STSER = st
+
+    def test_differential_all_shapes(self):
+        import random
+
+        from stellard_tpu.protocol import stobject as so
+        from stellard_tpu.protocol.formats import TxType
+        from stellard_tpu.protocol.keys import KeyPair
+        from stellard_tpu.protocol.sfields import (
+            sfAccount,
+            sfAffectedNodes,
+            sfAmount,
+            sfBalance,
+            sfDestination,
+            sfDomain,
+            sfFinalFields,
+            sfIndexes,
+            sfLedgerEntryType,
+            sfLedgerIndex,
+            sfModifiedNode,
+            sfPaths,
+            sfSequence,
+        )
+        from stellard_tpu.protocol.stamount import STAmount
+        from stellard_tpu.protocol.stobject import (
+            PathElement,
+            STArray,
+            STObject,
+            STPathSet,
+        )
+        from stellard_tpu.protocol.sttx import SerializedTransaction
+
+        if so._get_stser() is None:
+            import pytest
+
+            pytest.skip("native stser unavailable (no toolchain)")
+
+        rng = random.Random(1)
+        k = KeyPair.from_passphrase("stser-test")
+        dest = KeyPair.from_passphrase("stser-dest")
+        cases = []
+        tx = SerializedTransaction.build(
+            TxType.ttPAYMENT, k.account_id, 7, 10,
+            {sfAmount: STAmount.from_drops(123456),
+             sfDestination: dest.account_id},
+        )
+        tx.sign(k)
+        cases.append(tx.obj)
+        o = STObject()
+        o[sfSequence] = 0xDEADBEEF
+        o[sfAmount] = STAmount.from_iou(
+            b"USD" + b"\0" * 17, dest.account_id, 123456789, -3, True)
+        o[sfAccount] = k.account_id
+        o[sfLedgerIndex] = bytes(range(32))
+        o[sfPaths] = STPathSet([[
+            PathElement(account=dest.account_id),
+            PathElement(currency=b"EUR" + b"\0" * 17, issuer=k.account_id),
+        ]])
+        o[sfIndexes] = [bytes([i] * 32) for i in range(3)]
+        for n in (0, 1, 192, 193, 12480, 12481, 50000):  # VL edges
+            o[sfDomain] = bytes(rng.randbytes(n))
+            cases.append(STObject.from_bytes(self._py_bytes(o)))
+        meta = STObject()
+        arr = STArray()
+        node = STObject()
+        node[sfLedgerEntryType] = 0x61
+        node[sfLedgerIndex] = bytes(32)
+        ff = STObject()
+        ff[sfBalance] = STAmount.from_drops(999)
+        ff[sfSequence] = 3
+        node[sfFinalFields] = ff
+        arr.append(sfModifiedNode, node)
+        meta[sfAffectedNodes] = arr
+        cases.append(meta)
+
+        for obj in cases:
+            for signing in (False, True):
+                a = obj.serialize(signing=signing)
+                obj._pairs = None  # both paths must re-walk
+                assert a == self._py_bytes(obj, signing=signing)
+
+        tx2 = SerializedTransaction.from_bytes(tx.serialize())
+        assert tx2.signing_hash() == tx.signing_hash()
+        assert tx2.txid() == tx.txid()
+        assert tx2.check_sign()
