@@ -39,6 +39,13 @@ class TxDatabase:
             """CREATE INDEX IF NOT EXISTS AcctTxIndex ON
                  AccountTransactions(Account, LedgerSeq, TxnSeq)"""
         )
+        # the per-row DELETE in save_transactions keys on TransID; without
+        # this index it full-scans the table per tx — O(n^2) over a run
+        # (reference: DBInit.cpp:62-63 AcctTxIDIndex)
+        cur.execute(
+            """CREATE INDEX IF NOT EXISTS AcctTxIDIndex ON
+                 AccountTransactions(TransID)"""
+        )
         cur.execute(
             """CREATE TABLE IF NOT EXISTS Ledgers (
                  LedgerHash TEXT PRIMARY KEY, LedgerSeq INTEGER,

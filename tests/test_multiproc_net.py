@@ -165,7 +165,10 @@ class TestMultiProcessNet:
             lambda: all(
                 rpc(p, "server_info")["info"]["peers"] == 3 for p in rpc_ports
             ),
-            timeout=30,
+            # four fresh interpreters share 1-2 cores on this box; cold
+            # startup alone can eat ~35s under ambient load (measured),
+            # so the mesh wait must not be the startup race's victim
+            timeout=90,
         ), "validators never fully meshed"
 
         # the net closes ledgers: every validator advances past seq 3

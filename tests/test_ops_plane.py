@@ -204,3 +204,99 @@ class TestLocalCredentials:
         finally:
             n.verify_plane.stop()
             n.job_queue.stop()
+
+
+class TestIntakeOrdering:
+    """Ordered intake drain (networkops._enqueue_intake): same-account
+    bursts must apply in submission order (no spurious terPRE_SEQ
+    holds), and a poisoned entry must neither drop the rest of its
+    batch nor wedge the drain flag."""
+
+    def _node(self):
+        from stellard_tpu.node.config import Config
+        from stellard_tpu.node.node import Node
+
+        return Node(Config(signature_backend="cpu")).setup()
+
+    def test_burst_applies_in_order_no_holds(self):
+        import threading
+
+        from stellard_tpu.protocol.formats import TxType
+        from stellard_tpu.protocol.keys import KeyPair
+        from stellard_tpu.protocol.sfields import sfAmount, sfDestination
+        from stellard_tpu.protocol.stamount import STAmount
+        from stellard_tpu.protocol.sttx import SerializedTransaction
+
+        node = self._node()
+        try:
+            master = KeyPair.from_passphrase("masterpassphrase")
+            dest = KeyPair.from_passphrase("intake-dest")
+            txs = []
+            for i in range(200):
+                tx = SerializedTransaction.build(
+                    TxType.ttPAYMENT, master.account_id, 1 + i, 10,
+                    {sfAmount: STAmount.from_drops(250_000_000),
+                     sfDestination: dest.account_id},
+                )
+                tx.sign(master)
+                txs.append(tx)
+            done = threading.Semaphore(0)
+            results = []
+
+            def cb(tx, ter, applied):
+                results.append((ter, applied))
+                done.release()
+
+            for tx in txs:
+                node.ops.submit_transaction(tx, cb)
+            for _ in txs:
+                assert done.acquire(timeout=30)
+            assert node.ops.stats.get("held", 0) == 0, "burst was held"
+            assert all(applied for _, applied in results)
+            node.ops.accept_ledger()
+            assert node.ledger_master.closed_ledger().seq == 2
+        finally:
+            node.stop()
+
+    def test_poisoned_callback_does_not_wedge_intake(self):
+        import threading
+
+        from stellard_tpu.protocol.formats import TxType
+        from stellard_tpu.protocol.keys import KeyPair
+        from stellard_tpu.protocol.sfields import sfAmount, sfDestination
+        from stellard_tpu.protocol.stamount import STAmount
+        from stellard_tpu.protocol.sttx import SerializedTransaction
+
+        node = self._node()
+        try:
+            master = KeyPair.from_passphrase("masterpassphrase")
+            dest = KeyPair.from_passphrase("intake-dest-2")
+
+            def payment(seq):
+                tx = SerializedTransaction.build(
+                    TxType.ttPAYMENT, master.account_id, seq, 10,
+                    {sfAmount: STAmount.from_drops(250_000_000),
+                     sfDestination: dest.account_id},
+                )
+                tx.sign(master)
+                return tx
+
+            done = threading.Semaphore(0)
+
+            def bomb(tx, ter, applied):
+                done.release()
+                raise RuntimeError("poisoned callback")
+
+            def ok_cb(tx, ter, applied):
+                done.release()
+
+            node.ops.submit_transaction(payment(1), bomb)
+            node.ops.submit_transaction(payment(2), ok_cb)
+            for _ in range(2):
+                assert done.acquire(timeout=30)
+            # intake must still be alive for NEW submissions
+            node.ops.submit_transaction(payment(3), ok_cb)
+            assert done.acquire(timeout=30)
+            assert not node.ops._intake_scheduled or node.ops._intake
+        finally:
+            node.stop()
