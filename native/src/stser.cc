@@ -249,11 +249,18 @@ static PyObject *stser_register_fields(PyObject *, PyObject *args) {
   Py_RETURN_NONE;
 }
 
+static PyObject *stser_parse(PyObject *, PyObject *);
+static PyObject *stser_register_parse(PyObject *, PyObject *);
+
 static PyMethodDef Methods[] = {
     {"serialize", stser_serialize, METH_VARARGS,
      "serialize(pairs, signing) -> bytes"},
     {"register_fields", stser_register_fields, METH_VARARGS,
      "register_fields(rows, container_cb)"},
+    {"parse", stser_parse, METH_VARARGS,
+     "parse(data, pos, inner) -> (STObject, new_pos)"},
+    {"register_parse", stser_register_parse, METH_VARARGS,
+     "register_parse(rows, obj_factory, arr_factory, amount_cb, pathset_cb)"},
     {nullptr, nullptr, 0, nullptr},
 };
 
@@ -271,3 +278,361 @@ PyMODINIT_FUNC PyInit__stser(void) {
   if (g_cid_name == nullptr || g_wire_name == nullptr) return nullptr;
   return PyModule_Create(&Module);
 }
+
+// ---------------------------------------------------------------------------
+// Native binary parser: walks the canonical wire form and builds the
+// fields dict in C. Consensus-sensitive value decoding (amounts,
+// pathsets) goes through registered Python callbacks so validation
+// lives in exactly one place; objects/arrays recurse here.
+
+namespace {
+
+struct ParseField {
+  PyObject *field;  // owned ref to the SField singleton
+  int8_t kind;
+  uint8_t width;
+};
+
+static std::vector<ParseField> g_bycode;  // indexed by (type<<8)|value? no:
+// codes are (type_id<<16)|value with type_id<256, value<256 — use a
+// 65536-entry table indexed by (type_id<<8)|value.
+static PyObject *g_obj_factory = nullptr;   // (fields_dict, in_order) -> STObject
+static PyObject *g_arr_factory = nullptr;   // (items_list) -> STArray
+static PyObject *g_amount_cb = nullptr;     // (bytes) -> STAmount
+static PyObject *g_pathset_cb = nullptr;    // (bytes) -> STPathSet
+
+struct Rd {
+  const uint8_t *p;
+  Py_ssize_t n;
+  Py_ssize_t pos;
+  bool need(Py_ssize_t k) {
+    if (pos + k > n) {
+      PyErr_SetString(PyExc_ValueError, "parser underflow");
+      return false;
+    }
+    return true;
+  }
+};
+
+// -> 0 ok / -1 error; (*t, *v) out
+static int read_field_id(Rd &rd, int *t, int *v) {
+  if (!rd.need(1)) return -1;
+  int b1 = rd.p[rd.pos++];
+  int type_id = b1 >> 4;
+  int name = b1 & 0x0F;
+  if (type_id == 0) {
+    if (!rd.need(1)) return -1;
+    type_id = rd.p[rd.pos++];
+    if (type_id == 0 || type_id < 16) {
+      PyErr_SetString(PyExc_ValueError, "invalid field id encoding");
+      return -1;
+    }
+    if (name == 0) {
+      if (!rd.need(1)) return -1;
+      name = rd.p[rd.pos++];
+      if (name == 0 || name < 16) {
+        PyErr_SetString(PyExc_ValueError, "invalid field id encoding");
+        return -1;
+      }
+    }
+  } else if (name == 0) {
+    if (!rd.need(1)) return -1;
+    name = rd.p[rd.pos++];
+    if (name == 0 || name < 16) {
+      PyErr_SetString(PyExc_ValueError, "invalid field id encoding");
+      return -1;
+    }
+  }
+  *t = type_id;
+  *v = name;
+  return 0;
+}
+
+static int read_vl_len(Rd &rd, Py_ssize_t *out) {
+  if (!rd.need(1)) return -1;
+  int b1 = rd.p[rd.pos++];
+  if (b1 <= 192) {
+    *out = b1;
+  } else if (b1 <= 240) {
+    if (!rd.need(1)) return -1;
+    int b2 = rd.p[rd.pos++];
+    *out = 193 + ((b1 - 193) << 8) + b2;
+  } else if (b1 <= 254) {
+    if (!rd.need(2)) return -1;
+    int b2 = rd.p[rd.pos++];
+    int b3 = rd.p[rd.pos++];
+    *out = 12481 + ((b1 - 241) << 16) + (b2 << 8) + b3;
+  } else {
+    PyErr_SetString(PyExc_ValueError, "invalid VL length byte");
+    return -1;
+  }
+  return 0;
+}
+
+static PyObject *parse_object(Rd &rd, bool inner);  // fwd
+
+// parse one value of `kind`; returns new ref or nullptr
+static PyObject *parse_value(Rd &rd, const ParseField &fc) {
+  switch (fc.kind) {
+    case K_UINT8:
+    case K_UINT16:
+    case K_UINT32:
+    case K_UINT64: {
+      if (!rd.need(fc.width)) return nullptr;
+      uint64_t x = 0;
+      for (int i = 0; i < fc.width; ++i) x = (x << 8) | rd.p[rd.pos++];
+      return PyLong_FromUnsignedLongLong(x);
+    }
+    case K_HASH: {
+      if (!rd.need(fc.width)) return nullptr;
+      PyObject *b = PyBytes_FromStringAndSize(
+          reinterpret_cast<const char *>(rd.p + rd.pos), fc.width);
+      rd.pos += fc.width;
+      return b;
+    }
+    case K_VL: {
+      Py_ssize_t len;
+      if (read_vl_len(rd, &len) < 0 || !rd.need(len)) return nullptr;
+      PyObject *b = PyBytes_FromStringAndSize(
+          reinterpret_cast<const char *>(rd.p + rd.pos), len);
+      rd.pos += len;
+      return b;
+    }
+    case K_ACCOUNT: {
+      Py_ssize_t len;
+      if (read_vl_len(rd, &len) < 0 || !rd.need(len)) return nullptr;
+      if (len != 20) {
+        PyErr_SetString(PyExc_ValueError, "account field must be 20 bytes");
+        return nullptr;
+      }
+      PyObject *b = PyBytes_FromStringAndSize(
+          reinterpret_cast<const char *>(rd.p + rd.pos), 20);
+      rd.pos += 20;
+      return b;
+    }
+    case K_AMOUNT: {
+      // 8 bytes native; 48 when the not-native bit (MSB) is set
+      if (!rd.need(8)) return nullptr;
+      Py_ssize_t len = (rd.p[rd.pos] & 0x80) ? 48 : 8;
+      if (!rd.need(len)) return nullptr;
+      PyObject *slice = PyBytes_FromStringAndSize(
+          reinterpret_cast<const char *>(rd.p + rd.pos), len);
+      if (slice == nullptr) return nullptr;
+      PyObject *a = PyObject_CallFunctionObjArgs(g_amount_cb, slice, nullptr);
+      Py_DECREF(slice);
+      if (a != nullptr) rd.pos += len;
+      return a;
+    }
+    case K_OBJECT:
+      return parse_object(rd, true);
+    case K_ARRAY: {
+      PyObject *items = PyList_New(0);
+      if (items == nullptr) return nullptr;
+      for (;;) {
+        int t, v;
+        if (read_field_id(rd, &t, &v) < 0) {
+          Py_DECREF(items);
+          return nullptr;
+        }
+        if (t == 15 && v == 1) break;  // array end marker
+        unsigned idx = (static_cast<unsigned>(t) << 8) | v;
+        const ParseField *efc =
+            (idx < g_bycode.size() && g_bycode[idx].field != nullptr)
+                ? &g_bycode[idx]
+                : nullptr;
+        if (efc == nullptr || efc->kind != K_OBJECT) {
+          Py_DECREF(items);
+          PyErr_Format(PyExc_ValueError, "bad array element field (%d, %d)",
+                       t, v);
+          return nullptr;
+        }
+        PyObject *o = parse_object(rd, true);
+        if (o == nullptr) {
+          Py_DECREF(items);
+          return nullptr;
+        }
+        PyObject *pair = PyTuple_Pack(2, efc->field, o);
+        Py_DECREF(o);
+        if (pair == nullptr || PyList_Append(items, pair) < 0) {
+          Py_XDECREF(pair);
+          Py_DECREF(items);
+          return nullptr;
+        }
+        Py_DECREF(pair);
+      }
+      PyObject *arr =
+          PyObject_CallFunctionObjArgs(g_arr_factory, items, nullptr);
+      Py_DECREF(items);
+      return arr;
+    }
+    case K_PATHSET: {
+      // scan to the end marker (0x00) to slice the pathset region:
+      // per element byte, skip 20 bytes per set bit of {0x01,0x10,0x20};
+      // 0xFF is a path boundary
+      Py_ssize_t start = rd.pos;
+      for (;;) {
+        if (!rd.need(1)) return nullptr;
+        int k = rd.p[rd.pos++];
+        if (k == 0x00) break;
+        if (k == 0xFF) continue;
+        Py_ssize_t skip = 0;
+        if (k & 0x01) skip += 20;
+        if (k & 0x10) skip += 20;
+        if (k & 0x20) skip += 20;
+        if (!rd.need(skip)) return nullptr;
+        rd.pos += skip;
+      }
+      PyObject *slice = PyBytes_FromStringAndSize(
+          reinterpret_cast<const char *>(rd.p + start), rd.pos - start);
+      if (slice == nullptr) return nullptr;
+      PyObject *ps = PyObject_CallFunctionObjArgs(g_pathset_cb, slice, nullptr);
+      Py_DECREF(slice);
+      return ps;
+    }
+    case K_VECTOR256: {
+      Py_ssize_t len;
+      if (read_vl_len(rd, &len) < 0 || !rd.need(len)) return nullptr;
+      if (len % 32) {
+        PyErr_SetString(PyExc_ValueError, "bad vector256 length");
+        return nullptr;
+      }
+      PyObject *lst = PyList_New(len / 32);
+      if (lst == nullptr) return nullptr;
+      for (Py_ssize_t i = 0; i < len / 32; ++i) {
+        PyObject *b = PyBytes_FromStringAndSize(
+            reinterpret_cast<const char *>(rd.p + rd.pos + 32 * i), 32);
+        if (b == nullptr) {
+          Py_DECREF(lst);
+          return nullptr;
+        }
+        PyList_SET_ITEM(lst, i, b);
+      }
+      rd.pos += len;
+      return lst;
+    }
+    default:
+      PyErr_SetString(PyExc_ValueError, "cannot deserialize field type");
+      return nullptr;
+  }
+}
+
+static PyObject *parse_object(Rd &rd, bool inner) {
+  // a crafted deeply-nested blob must raise like the Python loop's
+  // RecursionError, never overflow the C stack (peer blobs reach this
+  // parser; an unguarded recursion was a remote-crash DoS)
+  if (Py_EnterRecursiveCall(" in native STObject parse")) return nullptr;
+  PyObject *result = nullptr;
+  PyObject *fields = PyDict_New();
+  if (fields == nullptr) return nullptr;
+  bool in_order = true;
+  long prev_key = -1;
+  for (;;) {
+    if (rd.pos >= rd.n) {
+      if (inner) {
+        Py_DECREF(fields);
+        PyErr_SetString(PyExc_ValueError, "unterminated inner object");
+        Py_LeaveRecursiveCall();
+        return nullptr;
+      }
+      break;
+    }
+    int t, v;
+    if (read_field_id(rd, &t, &v) < 0) {
+      Py_DECREF(fields);
+      Py_LeaveRecursiveCall();
+      return nullptr;
+    }
+    if (inner && t == 14 && v == 1) break;  // object end marker
+    unsigned idx = (static_cast<unsigned>(t) << 8) | v;
+    const ParseField *fc =
+        (idx < g_bycode.size() && g_bycode[idx].field != nullptr)
+            ? &g_bycode[idx]
+            : nullptr;
+    if (fc == nullptr) {
+      Py_DECREF(fields);
+      PyErr_Format(PyExc_ValueError, "unknown field (%d, %d)", t, v);
+      Py_LeaveRecursiveCall();
+      return nullptr;
+    }
+    long key = (static_cast<long>(t) << 8) | v;  // == sort_key order
+    if (in_order && prev_key >= 0 && key < prev_key) in_order = false;
+    prev_key = key;
+    PyObject *val = parse_value(rd, *fc);
+    if (val == nullptr) {
+      Py_DECREF(fields);
+      Py_LeaveRecursiveCall();
+      return nullptr;
+    }
+    int rc = PyDict_SetItem(fields, fc->field, val);
+    Py_DECREF(val);
+    if (rc < 0) {
+      Py_DECREF(fields);
+      Py_LeaveRecursiveCall();
+      return nullptr;
+    }
+  }
+  PyObject *flag = in_order ? Py_True : Py_False;
+  result = PyObject_CallFunctionObjArgs(g_obj_factory, fields, flag, nullptr);
+  Py_DECREF(fields);
+  Py_LeaveRecursiveCall();
+  return result;
+}
+
+static PyObject *stser_parse(PyObject *, PyObject *args) {
+  Py_buffer buf;
+  Py_ssize_t pos;
+  int inner;
+  if (!PyArg_ParseTuple(args, "y*ni", &buf, &pos, &inner)) return nullptr;
+  Rd rd{static_cast<const uint8_t *>(buf.buf), buf.len, pos};
+  if (pos < 0 || pos > buf.len) {
+    PyBuffer_Release(&buf);
+    PyErr_SetString(PyExc_ValueError, "bad parse offset");
+    return nullptr;
+  }
+  PyObject *obj = parse_object(rd, inner != 0);
+  Py_ssize_t end = rd.pos;
+  PyBuffer_Release(&buf);
+  if (obj == nullptr) return nullptr;
+  PyObject *out = Py_BuildValue("(Nn)", obj, end);
+  return out;
+}
+
+static PyObject *stser_register_parse(PyObject *, PyObject *args) {
+  // rows: list of (code, field_obj, kind, width); plus the factories
+  PyObject *rows, *obj_factory, *arr_factory, *amount_cb, *pathset_cb;
+  if (!PyArg_ParseTuple(args, "OOOOO", &rows, &obj_factory, &arr_factory,
+                        &amount_cb, &pathset_cb))
+    return nullptr;
+  PyObject *seq = PySequence_Fast(rows, "rows must be a sequence");
+  if (seq == nullptr) return nullptr;
+  g_bycode.assign(1 << 16, ParseField{nullptr, -1, 0});
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *row = PySequence_Fast_GET_ITEM(seq, i);
+    long code, kind, width;
+    PyObject *field;
+    if (!PyArg_ParseTuple(row, "lOll", &code, &field, &kind, &width)) {
+      Py_DECREF(seq);
+      return nullptr;
+    }
+    long t = code >> 16, v = code & 0xFFFF;
+    if (t <= 0 || t >= 256 || v <= 0 || v >= 256) continue;  // non-wire
+    unsigned idx = (static_cast<unsigned>(t) << 8) | static_cast<unsigned>(v);
+    Py_INCREF(field);
+    g_bycode[idx] = ParseField{field, static_cast<int8_t>(kind),
+                               static_cast<uint8_t>(width)};
+  }
+  Py_DECREF(seq);
+  auto keep = [](PyObject *&slot, PyObject *v) {
+    Py_XDECREF(slot);
+    Py_INCREF(v);
+    slot = v;
+  };
+  keep(g_obj_factory, obj_factory);
+  keep(g_arr_factory, arr_factory);
+  keep(g_amount_cb, amount_cb);
+  keep(g_pathset_cb, pathset_cb);
+  Py_RETURN_NONE;
+}
+
+}  // namespace

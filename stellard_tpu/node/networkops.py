@@ -213,11 +213,19 @@ class NetworkOPs:
                 if self._intake:
                     self._intake_scheduled = True
                     resched = True
-            if resched:
-                self.jq.add_job(
-                    JobType.jtTRANSACTION, "processTxBatch",
-                    self._drain_intake,
-                )
+            if resched and not self.jq.add_job(
+                JobType.jtTRANSACTION, "processTxBatch", self._drain_intake
+            ):
+                # queue refused (stopping): fail the stranded callers
+                # resubmittably instead of hanging them (same contract
+                # as _enqueue_intake's refusal path)
+                with self._intake_lock:
+                    stranded = list(self._intake)
+                    self._intake.clear()
+                    self._intake_scheduled = False
+                for s_tx, s_cb in stranded:
+                    if s_cb:
+                        s_cb(s_tx, TER.telINSUF_FEE_P, False)
 
     def _process_cb(self, tx, cb):
         ter, applied = self.process_transaction(tx)

@@ -716,7 +716,18 @@ class TcpOverlay(ConsensusAdapter):
                 return
             peer.last_recv = time.monotonic()
             for msg in peer.reader.feed(data):
-                self._dispatch(peer, msg)
+                try:
+                    self._dispatch(peer, msg)
+                except Exception:  # noqa: BLE001 — a malformed message
+                    # (unparseable blob, absurd nesting, handler bug)
+                    # must charge the SENDER, never kill our own pump
+                    # thread (reference: PeerImp catches per message and
+                    # charges feeBadData)
+                    log.exception(
+                        "peer %s: dispatch failed for %s",
+                        peer.remote, type(msg).__name__,
+                    )
+                    self._charge(peer, FEE_BAD_DATA)
 
     def _charge(self, peer: _Peer, fee) -> None:
         """Charge the peer's endpoint; disconnect on DROP (reference:

@@ -217,6 +217,30 @@ def _container_chunk(f: SField, v: Any) -> bytes:
     return s.data()
 
 
+def _obj_from_parse(fields: dict, in_order: bool) -> "STObject":
+    """Native-parser factory: wrap a C-built fields dict; canonical wire
+    order seeds the sort memo exactly like the Python loop."""
+    obj = STObject()
+    obj._fields = fields
+    if in_order:
+        obj._sorted_keys = (0, list(fields))
+    return obj
+
+
+def _arr_from_parse(items: list) -> "STArray":
+    return STArray(items)
+
+
+def _amount_from_wire(b: bytes) -> "STAmount":
+    # full reference validation lives in STAmount.deserialize — the C
+    # parser only slices the 8/48-byte region
+    return STAmount.deserialize(BinaryParser(b))
+
+
+def _pathset_from_wire(b: bytes) -> "STPathSet":
+    return STPathSet.deserialize(BinaryParser(b))
+
+
 def _get_stser():
     global _STSER, _STSER_TRIED
     if not _STSER_TRIED:
@@ -235,6 +259,14 @@ def _get_stser():
                           1 if f.signing else 0)
                          for f in all_fields() if f.kind >= 0],
                         _container_chunk,
+                    )
+                    mod.register_parse(
+                        [(f.code, f, f.kind, f.width)
+                         for f in all_fields() if f.kind >= 0],
+                        _obj_from_parse,
+                        _arr_from_parse,
+                        _amount_from_wire,
+                        _pathset_from_wire,
                     )
                     globals()["_STSER"] = mod
             except Exception:  # noqa: BLE001 — fall back to the Python loop
@@ -369,6 +401,13 @@ class STObject:
 
     @classmethod
     def deserialize(cls, p: BinaryParser, *, inner: bool = False) -> "STObject":
+        st = _get_stser()
+        if st is not None and cls is STObject:
+            # the native path constructs base STObjects; a future
+            # subclass must take the Python loop (obj = cls())
+            obj, pos = st.parse(p._data, p._pos, 1 if inner else 0)
+            p._pos = pos
+            return obj
         obj = cls()
         # canonical input (the overwhelmingly common case: our own
         # serializer always writes sorted) seeds the sort memo so the
