@@ -196,7 +196,7 @@ class TestEndToEndLoad:
         gate = threading.Event()
         for _ in range(len(node.job_queue._threads)):
             node.job_queue.add_job(
-                JobType.jtTRANSACTION, "blocker", lambda: gate.wait(10)
+                JobType.jtTRANSACTION, "blocker", lambda: gate.wait(30)
             )
         alice = KeyPair.from_passphrase("alice")
         master = node.master_keys
@@ -210,21 +210,21 @@ class TestEndToEndLoad:
             tx.sign(master)
             node.ops.submit_transaction(tx)
 
-        # wave 1: fill the backlog (verification is async, so wait for the
-        # verified txs to land on the wedged queue)
+        # wave 1: fill the backlog (verification is async, so wait for
+        # the verified txs to land). Intake batching keeps the QUEUED
+        # job count at ~1 — the backlog accumulates in ops._intake, and
+        # the shed gate counts job_count + len(_intake); assert on the
+        # gate's own quantity.
+        def backlog():
+            return (node.job_queue.get_job_count(JobType.jtTRANSACTION)
+                    + len(node.ops._intake))
+
         for i in range(TX_BACKLOG_SHED + 20):
             submit(i)
         deadline = time.monotonic() + 15
-        while (
-            node.job_queue.get_job_count(JobType.jtTRANSACTION)
-            <= TX_BACKLOG_SHED
-            and time.monotonic() < deadline
-        ):
+        while backlog() <= TX_BACKLOG_SHED and time.monotonic() < deadline:
             time.sleep(0.02)
-        assert (
-            node.job_queue.get_job_count(JobType.jtTRANSACTION)
-            > TX_BACKLOG_SHED
-        )
+        assert backlog() > TX_BACKLOG_SHED
         # wave 2: intake now sheds at the door
         for i in range(TX_BACKLOG_SHED + 20, TX_BACKLOG_SHED + 40):
             submit(i)
