@@ -115,15 +115,35 @@ class SimValidator(ConsensusAdapter):
     # -- delivery ---------------------------------------------------------
 
     def deliver(self, src: int, data: bytes) -> None:
-        for msg in self.reader.feed(data):
-            self._dispatch(src, msg)
+        msgs = list(self.reader.feed(data))
+        # one delivery often carries several relayed txs: parse each
+        # once and batch their signature verification through the plane
+        # before dispatching. An unparseable tx drops only ITSELF —
+        # the rest of the delivery still dispatches.
+        parsed: dict[int, SerializedTransaction] = {}
+        for i, m in enumerate(msgs):
+            if isinstance(m, TxMessage):
+                try:
+                    parsed[i] = SerializedTransaction.from_bytes(m.blob)
+                except Exception:  # noqa: BLE001 — malformed relay
+                    pass
+        if len(parsed) > 1:
+            try:
+                self.node.prefetch_tx_sigs(list(parsed.values()))
+            except Exception:  # noqa: BLE001 — prefetch is an
+                pass           # optimization; per-tx paths re-verify
+        for i, msg in enumerate(msgs):
+            if isinstance(msg, TxMessage):
+                if i in parsed:
+                    self.node.handle_tx(parsed[i])
+            else:
+                self._dispatch(src, msg)
 
     def _dispatch(self, src: int, msg) -> None:
         node = self.node
-        if isinstance(msg, TxMessage):
-            tx = SerializedTransaction.from_bytes(msg.blob)
-            node.handle_tx(tx)
-        elif isinstance(msg, ProposeSet):
+        # TxMessages are handled (parse-once + batched sig prefetch) in
+        # deliver(), the only caller
+        if isinstance(msg, ProposeSet):
             node.handle_proposal(msg.to_proposal())
         elif isinstance(msg, ValidationMessage):
             node.handle_validation(STValidation.from_bytes(msg.blob))

@@ -715,9 +715,28 @@ class TcpOverlay(ConsensusAdapter):
             if not data:
                 return
             peer.last_recv = time.monotonic()
-            for msg in peer.reader.feed(data):
+            msgs = list(peer.reader.feed(data))
+            # a single read often carries a burst of relayed txs: parse
+            # each ONCE and verify their signatures in one plane call
+            # before dispatching (an unparseable tx stays None here and
+            # raises inside _dispatch, where the sender is charged)
+            parsed_txs: dict[int, SerializedTransaction] = {}
+            if sum(1 for m in msgs if isinstance(m, TxMessage)) > 1:
+                for i, m in enumerate(msgs):
+                    if isinstance(m, TxMessage):
+                        try:
+                            parsed_txs[i] = (
+                                SerializedTransaction.from_bytes(m.blob)
+                            )
+                        except Exception:  # noqa: BLE001 — charged below
+                            pass
                 try:
-                    self._dispatch(peer, msg)
+                    self.node.prefetch_tx_sigs(list(parsed_txs.values()))
+                except Exception:  # noqa: BLE001 — prefetch is an
+                    pass           # optimization; per-tx paths re-verify
+            for i, msg in enumerate(msgs):
+                try:
+                    self._dispatch(peer, msg, parsed_tx=parsed_txs.get(i))
                 except Exception:  # noqa: BLE001 — a malformed message
                     # (unparseable blob, absurd nesting, handler bug)
                     # must charge the SENDER, never kill our own pump
@@ -744,12 +763,13 @@ class TcpOverlay(ConsensusAdapter):
         if self.node.router.get_flags(suppression_id) & SF_BAD:
             self._charge(peer, FEE_INVALID_SIGNATURE)
 
-    def _dispatch(self, peer: _Peer, msg) -> None:
+    def _dispatch(self, peer: _Peer, msg, parsed_tx=None) -> None:
         """reference: PeerImp message switch (PeerImp.cpp:1459-1738) —
         verify → apply → relay-if-new, charging abusive senders."""
         node = self.node
         if isinstance(msg, TxMessage):
-            tx = SerializedTransaction.from_bytes(msg.blob)
+            tx = (parsed_tx if parsed_tx is not None
+                  else SerializedTransaction.from_bytes(msg.blob))
             txid = tx.txid()
             if self._first_seen(txid, peer):
                 if node.handle_tx(tx):
