@@ -610,12 +610,14 @@ def apply_kernel_tuning(path: str) -> Optional[dict]:
             "STELLARD_GROUP_OPS": str(int(t.get("group", 0))),
             "STELLARD_VERIFY_IMPL": str(t.get("impl", "xla")),
             "STELLARD_PALLAS_BLOCK": str(int(t.get("block", 512))),
-            # wire format is semantics-neutral (identical verdicts,
-            # pinned by tests) so the measured winner auto-applies;
-            # rows measured before the raw wire existed say "digits"
-            "STELLARD_WIRE": str(t.get("wire", "digits")),
         }
-        if values["STELLARD_WIRE"] not in ("raw", "digits"):
+        # wire format is semantics-neutral (identical verdicts, pinned
+        # by tests) so a measured winner auto-applies — but a tuning row
+        # from before the wire field existed carries NO opinion, and
+        # must not drag the bench back to the fatter digits wire
+        if "wire" in t:
+            values["STELLARD_WIRE"] = str(t["wire"])
+        if values.get("STELLARD_WIRE", "raw") not in ("raw", "digits"):
             raise ValueError(values["STELLARD_WIRE"])
         if values["STELLARD_VERIFY_IMPL"] not in ("xla", "pallas"):
             # a hand-edited file must not park a crash at the first

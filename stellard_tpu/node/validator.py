@@ -366,8 +366,13 @@ class ValidatorNode:
         if self.verify_many is None:
             return
         pending = []
-        for tx in txs:
-            flags = self.router.get_flags(tx.txid())
+        seen: set[bytes] = set()  # dedupe: N copies of one tx in a burst
+        for tx in txs:            # must cost ONE verify, not N
+            txid = tx.txid()
+            if txid in seen:
+                continue
+            seen.add(txid)
+            flags = self.router.get_flags(txid)
             if flags & (SF_SIGGOOD | SF_BAD):
                 continue
             # structural validity gates the SIGGOOD flag exactly as the

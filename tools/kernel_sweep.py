@@ -286,7 +286,10 @@ def write_tuning():
             "impl": best.get("impl", "xla"),
             "block": best.get("block", 512),
             "check": best.get("check", "bytes"),
-            "wire": best.get("wire", "digits"),
+            # a row measured before the wire field existed carries NO
+            # wire opinion — writing "digits" here would drag the bench
+            # back to the fat wire via apply_kernel_tuning
+            **({"wire": best["wire"]} if "wire" in best else {}),
             "batch": best["batch"],
             "rate": best["rate"],
             "all": RESULTS,
