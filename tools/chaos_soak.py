@@ -1,0 +1,193 @@
+"""Randomized chaos soak over a REAL 4-validator TCP+TLS net.
+
+The standalone, longer-running sibling of
+tests/test_multiproc_net.py::test_load_restart_convergence (the r4
+build-time soak that surfaced the fork-repair fixes): continuous RPC
+payment load while a validator is killed and revived every ~45s
+(rotating victims), for `minutes` (default 12). Ends by asserting every
+validator is quorum-validated on one advancing chain with one hash, and
+prints a JSON summary line.
+
+Usage: python tools/chaos_soak.py [minutes] [> CHAOS_SOAK.log]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from stellard_tpu.protocol.keys import KeyPair  # noqa: E402
+
+MINUTES = float(sys.argv[1]) if len(sys.argv) > 1 else 12.0
+N = 4
+SPEED = 5.0
+
+
+def free_ports(k):
+    socks = [socket.socket() for _ in range(k)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def rpc(port, method, params=None, timeout=10):
+    req = json.dumps({"method": method, "params": [params or {}]}).encode()
+    r = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/", req, timeout=timeout
+    )
+    return json.loads(r.read())["result"]
+
+
+def main() -> None:
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="chaos-")
+    ports = free_ports(2 * N)
+    peer_ports, rpc_ports = ports[:N], ports[N:]
+    keys = [KeyPair.from_passphrase(f"chaos-val-{i}") for i in range(N)]
+    for i in range(N):
+        others_keys = "\n".join(
+            keys[j].human_node_public for j in range(N) if j != i
+        )
+        others_addrs = "\n".join(
+            f"127.0.0.1 {peer_ports[j]}" for j in range(N) if j != i
+        )
+        cfg = (
+            f"[standalone]\n0\n\n[node_db]\ntype=memory\n\n"
+            f"[signature_backend]\ntype=cpu\n\n"
+            f"[validation_seed]\n{keys[i].human_seed}\n\n"
+            f"[validators]\n{others_keys}\n\n[validation_quorum]\n3\n\n"
+            f"[peer_port]\n{peer_ports[i]}\n\n[peer_ssl]\nrequire\n\n"
+            f"[ips]\n{others_addrs}\n\n[clock_speed]\n{SPEED}\n\n"
+            f"[rpc_port]\n{rpc_ports[i]}\n"
+        )
+        open(os.path.join(tmp, f"v{i}.cfg"), "w").write(cfg)
+
+    procs: list = [None] * N
+
+    def respawn(i):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        procs[i] = subprocess.Popen(
+            [sys.executable, "-m", "stellard_tpu", "--conf",
+             os.path.join(tmp, f"v{i}.cfg"), "--start"],
+            cwd=REPO, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        )
+
+    for i in range(N):
+        respawn(i)
+
+    def meshed():
+        try:
+            return all(
+                rpc(p, "server_info")["info"]["peers"] == N - 1
+                for p in rpc_ports
+            )
+        except Exception:
+            return False
+
+    t0 = time.monotonic()
+    while not meshed():
+        if time.monotonic() - t0 > 120:
+            raise SystemExit("net never meshed")
+        time.sleep(2)
+    print(f"meshed in {time.monotonic()-t0:.0f}s", flush=True)
+
+    master = KeyPair.from_passphrase("masterpassphrase")
+    stop = threading.Event()
+    stats = {"submitted": 0, "errors": 0, "kills": 0}
+
+    def load():
+        i = 0
+        while not stop.is_set():
+            try:
+                rpc(rpc_ports[i % N], "submit", {
+                    "secret": "masterpassphrase",
+                    "tx_json": {
+                        "TransactionType": "Payment",
+                        "Account": master.human_account_id,
+                        "Destination": KeyPair.from_passphrase(
+                            f"chaos-dst-{i % 5}"
+                        ).human_account_id,
+                        "Amount": str(1_500_000_000),
+                    },
+                }, timeout=15)
+                stats["submitted"] += 1
+            except Exception:
+                stats["errors"] += 1
+            i += 1
+            stop.wait(1.0)
+
+    t = threading.Thread(target=load, daemon=True)
+    t.start()
+    rng = random.Random(7)
+    deadline = time.monotonic() + MINUTES * 60
+    try:
+        while time.monotonic() < deadline:
+            time.sleep(45)
+            victim = rng.randrange(N)
+            procs[victim].terminate()
+            try:
+                procs[victim].wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                procs[victim].kill()
+            stats["kills"] += 1
+            time.sleep(4)
+            respawn(victim)
+            print(f"t+{time.monotonic()-t0:.0f}s killed/revived v{victim} "
+                  f"(submitted={stats['submitted']})", flush=True)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+    def seqs():
+        out = []
+        for p in rpc_ports:
+            try:
+                out.append(
+                    rpc(p, "server_info")["info"]["validated_ledger"]["seq"]
+                )
+            except Exception:
+                out.append(-1)
+        return out
+
+    target = max(seqs()) + 2
+    t1 = time.monotonic()
+    while min(seqs()) < target:
+        if time.monotonic() - t1 > 180:
+            raise SystemExit(f"no convergence: {seqs()}")
+        time.sleep(3)
+    common = min(seqs())
+    hashes = {
+        rpc(p, "ledger", {"ledger_index": common})["ledger"]["hash"]
+        for p in rpc_ports
+    }
+    ok = len(hashes) == 1
+    for p in procs:
+        p.terminate()
+    print(json.dumps({
+        "chaos_minutes": MINUTES, "kills": stats["kills"],
+        "submitted": stats["submitted"], "errors": stats["errors"],
+        "final_validated_seqs": seqs(), "single_hash": ok,
+        "summary": True,
+    }), flush=True)
+    if not ok:
+        raise SystemExit(f"FORK at {common}: {hashes}")
+
+
+if __name__ == "__main__":
+    main()
