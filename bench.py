@@ -566,7 +566,7 @@ def bench_mesh():
         _emit({"metric": "mesh8_verify_sigs_per_sec", "value": 0.0,
                "unit": "error", "vs_baseline": 0.0, "error": repr(e)[:300]})
         return
-    _emit({
+    out = {
         "metric": "mesh8_verify_sigs_per_sec",
         "value": data["mesh_rate"],
         "unit": "sigs/s",
@@ -575,7 +575,10 @@ def bench_mesh():
         "mesh_devices": data["mesh_devices"],
         "batch": data["batch"],
         "fallback": False,  # always runs (virtual cpu mesh)
-    })
+    }
+    if "mesh_hash_nodes_per_sec" in data:
+        out["mesh_hash_nodes_per_sec"] = data["mesh_hash_nodes_per_sec"]
+    _emit(out)
 
 
 def _emit_config(metric, rates, lower_is_better=False, unit="tx/s",

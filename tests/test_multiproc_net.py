@@ -18,51 +18,17 @@ import socket
 import subprocess
 import sys
 import time
-import urllib.request
 
 import pytest
 
 from stellard_tpu.protocol.keys import KeyPair
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SPEED = 5.0  # virtual seconds per real second (clock_speed knob)
+sys.path.insert(0, os.path.join(REPO, "tools"))
 
-
-def free_ports(n: int) -> list[int]:
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
-
-
-def rpc(port: int, method: str, params: dict | None = None, timeout=5.0):
-    body = json.dumps({"method": method, "params": [params or {}]}).encode()
-    req = urllib.request.Request(
-        f"http://127.0.0.1:{port}/",
-        data=body,
-        headers={"Content-Type": "application/json"},
-    )
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        return json.load(resp)["result"]
-
-
-def wait_until(pred, timeout: float, interval: float = 0.5):
-    deadline = time.monotonic() + timeout
-    last = None
-    while time.monotonic() < deadline:
-        try:
-            last = pred()
-            if last:
-                return last
-        except Exception:
-            pass
-        time.sleep(interval)
-    return last
+# shared net-lab helpers (tools/netlab.py) — one config template /
+# launcher / RPC helper for this suite AND tools/chaos_soak.py
+from netlab import SPEED, free_ports, rpc, wait_until  # noqa: E402
 
 
 @pytest.fixture(scope="module")

@@ -6,7 +6,8 @@ build-time soak that surfaced the fork-repair fixes): continuous RPC
 payment load while a validator is killed and revived every ~45s
 (rotating victims), for `minutes` (default 12). Ends by asserting every
 validator is quorum-validated on one advancing chain with one hash, and
-prints a JSON summary line.
+prints a JSON summary line. Validators are always torn down, even on a
+failed run.
 
 Usage: python tools/chaos_soak.py [minutes] [> CHAOS_SOAK.log]
 """
@@ -16,81 +17,64 @@ from __future__ import annotations
 import json
 import os
 import random
-import socket
 import subprocess
 import sys
+import tempfile
 import threading
 import time
-import urllib.request
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from netlab import (  # noqa: E402
+    free_ports,
+    rpc,
+    spawn_validator,
+    validator_config,
+)
 from stellard_tpu.protocol.keys import KeyPair  # noqa: E402
 
 MINUTES = float(sys.argv[1]) if len(sys.argv) > 1 else 12.0
 N = 4
-SPEED = 5.0
-
-
-def free_ports(k):
-    socks = [socket.socket() for _ in range(k)]
-    for s in socks:
-        s.bind(("127.0.0.1", 0))
-    ports = [s.getsockname()[1] for s in socks]
-    for s in socks:
-        s.close()
-    return ports
-
-
-def rpc(port, method, params=None, timeout=10):
-    req = json.dumps({"method": method, "params": [params or {}]}).encode()
-    r = urllib.request.urlopen(
-        f"http://127.0.0.1:{port}/", req, timeout=timeout
-    )
-    return json.loads(r.read())["result"]
 
 
 def main() -> None:
-    import tempfile
-
     tmp = tempfile.mkdtemp(prefix="chaos-")
     ports = free_ports(2 * N)
     peer_ports, rpc_ports = ports[:N], ports[N:]
     keys = [KeyPair.from_passphrase(f"chaos-val-{i}") for i in range(N)]
+    cfg_paths = []
     for i in range(N):
-        others_keys = "\n".join(
-            keys[j].human_node_public for j in range(N) if j != i
+        p = os.path.join(tmp, f"v{i}.cfg")
+        open(p, "w").write(
+            validator_config(i, keys, peer_ports, rpc_ports[i])
         )
-        others_addrs = "\n".join(
-            f"127.0.0.1 {peer_ports[j]}" for j in range(N) if j != i
-        )
-        cfg = (
-            f"[standalone]\n0\n\n[node_db]\ntype=memory\n\n"
-            f"[signature_backend]\ntype=cpu\n\n"
-            f"[validation_seed]\n{keys[i].human_seed}\n\n"
-            f"[validators]\n{others_keys}\n\n[validation_quorum]\n3\n\n"
-            f"[peer_port]\n{peer_ports[i]}\n\n[peer_ssl]\nrequire\n\n"
-            f"[ips]\n{others_addrs}\n\n[clock_speed]\n{SPEED}\n\n"
-            f"[rpc_port]\n{rpc_ports[i]}\n"
-        )
-        open(os.path.join(tmp, f"v{i}.cfg"), "w").write(cfg)
+        cfg_paths.append(p)
 
     procs: list = [None] * N
 
     def respawn(i):
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        procs[i] = subprocess.Popen(
-            [sys.executable, "-m", "stellard_tpu", "--conf",
-             os.path.join(tmp, f"v{i}.cfg"), "--start"],
-            cwd=REPO, env=env,
-            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
-        )
+        procs[i] = spawn_validator(cfg_paths[i])
 
     for i in range(N):
         respawn(i)
 
+    try:
+        _run(procs, respawn, rpc_ports)
+    finally:
+        # ALWAYS tear the net down — a failed run must not leak four
+        # validator processes holding ports and CPU
+        for p in procs:
+            if p is None:
+                continue
+            p.terminate()
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def _run(procs, respawn, rpc_ports) -> None:
     def meshed():
         try:
             return all(
@@ -167,22 +151,24 @@ def main() -> None:
 
     target = max(seqs()) + 2
     t1 = time.monotonic()
-    while min(seqs()) < target:
+    last = seqs()
+    while min(last) < target:
         if time.monotonic() - t1 > 180:
-            raise SystemExit(f"no convergence: {seqs()}")
+            raise SystemExit(f"no convergence: {last}")
         time.sleep(3)
-    common = min(seqs())
+        last = seqs()
+    # use the LAST in-loop observation — a fresh RPC round-trip here can
+    # transiently fail and would poison `common` with a -1
+    common = min(last)
     hashes = {
         rpc(p, "ledger", {"ledger_index": common})["ledger"]["hash"]
         for p in rpc_ports
     }
     ok = len(hashes) == 1
-    for p in procs:
-        p.terminate()
     print(json.dumps({
         "chaos_minutes": MINUTES, "kills": stats["kills"],
         "submitted": stats["submitted"], "errors": stats["errors"],
-        "final_validated_seqs": seqs(), "single_hash": ok,
+        "final_validated_seqs": last, "single_hash": ok,
         "summary": True,
     }), flush=True)
     if not ok:

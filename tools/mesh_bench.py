@@ -86,12 +86,36 @@ def main() -> None:
         n += 1
     single_rate = BATCH * n / (time.time() - t0)
 
+    # the hashing twin: mesh-sharded masked SHA-512 over SHAMap-node-
+    # sized payloads (parallel/mesh.py sharded_masked_sha512)
+    import hashlib
+
+    from stellard_tpu.ops.sha512_jax import padded_block_count
+    from stellard_tpu.ops.treehash_jax import pad_leaf_batch
+    from stellard_tpu.parallel.mesh import sharded_masked_sha512
+
+    payloads = [bytes(rng.integers(0, 256, int(sz), dtype=np.uint8))
+                for sz in rng.integers(64, 600, 1024)]
+    ladder = max(padded_block_count(len(p)) for p in payloads)
+    blocks, nblocks = pad_leaf_batch(payloads, ladder)
+    hasher = sharded_masked_sha512(mesh)
+    state = np.asarray(hasher(blocks, nblocks))  # compile
+    assert state[0].astype(">u4").tobytes() == hashlib.sha512(
+        payloads[0]).digest()
+    t0 = time.time()
+    n = 0
+    while time.time() - t0 < SECONDS:
+        hasher(blocks, nblocks).block_until_ready()
+        n += 1
+    hash_rate = len(payloads) * n / (time.time() - t0)
+
     print(json.dumps({
         "mesh_devices": N,
         "batch": BATCH,
         "mesh_rate": round(mesh_rate, 1),
         "single_rate": round(single_rate, 1),
         "scaling": round(mesh_rate / single_rate, 3) if single_rate else 0.0,
+        "mesh_hash_nodes_per_sec": round(hash_rate, 1),
     }))
 
 
