@@ -636,6 +636,67 @@ def apply_kernel_tuning(path: str) -> Optional[dict]:
     return t
 
 
+class _HashCostModel:
+    """Measured-cost device-vs-host routing for the hash plane (the
+    VerifyPlane stance): per-pow2-bucket device EWMAs, first
+    (compile-laden) sample discarded, one host measurement enables the
+    comparison, and a losing device re-explores per-bucket after
+    `reexplore_every` eligible losses (a counter, not a global modulo —
+    a bucket whose calls never align with a global stride must not be
+    starved), bounded to within 4x of the winning cost. Thread-safe:
+    the hasher is shared across node threads."""
+
+    EWMA = 0.3
+    REEXPLORE_BOUND = 4.0
+
+    def __init__(self, reexplore_every: int):
+        self._lock = threading.Lock()
+        self._reexplore = reexplore_every
+        self._dev: dict[int, list] = {}   # bucket -> [n_samples, ewma]
+        self._host_unit_ms: Optional[float] = None
+        self._losses: dict[int, int] = {}  # bucket -> eligible losses
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        return 1 << max(0, n - 1).bit_length()
+
+    def _ewma(self, cur: Optional[float], ms: float) -> float:
+        return ms if cur is None else (1 - self.EWMA) * cur + self.EWMA * ms
+
+    def use_device(self, n: int) -> bool:
+        with self._lock:
+            b = self._bucket(n)
+            slot = self._dev.setdefault(b, [0, None])
+            if slot[1] is None:
+                return True  # unmeasured (or compile sample only): explore
+            if self._host_unit_ms is None:
+                return False  # measure the host side once
+            exp_dev = slot[1]
+            exp_host = self._host_unit_ms * n
+            if exp_dev <= exp_host:
+                self._losses[b] = 0
+                return True
+            if exp_dev > self.REEXPLORE_BOUND * exp_host:
+                return False  # hopeless: stay on the host
+            self._losses[b] = self._losses.get(b, 0) + 1
+            if self._losses[b] >= self._reexplore:
+                self._losses[b] = 0
+                return True
+            return False
+
+    def observe_device(self, n: int, ms: float) -> None:
+        with self._lock:
+            slot = self._dev.setdefault(self._bucket(n), [0, None])
+            slot[0] += 1
+            if slot[0] <= 1:
+                return  # discard the compile-laden first sample
+            slot[1] = self._ewma(slot[1], ms)
+
+    def observe_host(self, n: int, ms: float) -> None:
+        with self._lock:
+            self._host_unit_ms = self._ewma(self._host_unit_ms, ms / n)
+
+
 class WatchdogHasher(BatchHasher):
     """Run a device hasher's calls under a wedge deadline with a CPU
     fallback (utils.devicewatch): the observed tunnel failure mode is an
@@ -665,6 +726,22 @@ class WatchdogHasher(BatchHasher):
         self.name = inner.name
         self._t_first, _ = resolve_timeouts(first_timeout, warm_timeout)
         self.device_wedged = False
+        # measured-cost routing (same stance as VerifyPlane's model: the
+        # device must EARN traffic; a losing device floors at the host
+        # path instead of dragging a leg, and is re-explored bounded).
+        # STELLARD_HASH_ROUTING=device restores route-everything-device.
+        # (A separate small model rather than verifyplane._LatencyModel:
+        # the units differ — per-node hash rates vs per-signature verify
+        # costs — and the verify model is entangled with pad-bucket
+        # warmth bookkeeping this wrapper has no analog for.)
+        mode = os.environ.get("STELLARD_HASH_ROUTING", "cost")
+        if mode not in ("cost", "device"):
+            raise ValueError(
+                f"STELLARD_HASH_ROUTING must be cost|device, got {mode!r}"
+            )
+        self._route_by_cost = mode != "device"
+        self._flat = _HashCostModel(reexplore_every=256)
+        self._tree = _HashCostModel(reexplore_every=64)
 
     @property
     def device_nodes(self):  # type: ignore[override]
@@ -692,17 +769,31 @@ class WatchdogHasher(BatchHasher):
         dlog.error("hash plane: %s — falling back to host hashing", exc)
 
     def prefix_hash_batch(self, prefixes, payloads):
+        import time as _t
+
         from ..utils.devicewatch import DeviceWedged, call_with_deadline
 
-        if not self.device_wedged:
+        n = len(prefixes)
+        if not self.device_wedged and n and (
+            not self._route_by_cost or self._flat.use_device(n)
+        ):
             try:
-                return call_with_deadline(
+                t0 = _t.perf_counter()
+                out = call_with_deadline(
                     lambda: self.inner.prefix_hash_batch(prefixes, payloads),
                     self._t_first, label="hash-device",
                 )
+                self._flat.observe_device(
+                    n, (_t.perf_counter() - t0) * 1000.0
+                )
+                return out
             except DeviceWedged as exc:
                 self._wedge(exc)
-        return self.fallback.prefix_hash_batch(prefixes, payloads)
+        t0 = _t.perf_counter()
+        out = self.fallback.prefix_hash_batch(prefixes, payloads)
+        if n:
+            self._flat.observe_host(n, (_t.perf_counter() - t0) * 1000.0)
+        return out
 
     def _host_tree(self, root) -> int:
         """Level-batched host hashing. When the device is healthy this
@@ -720,11 +811,25 @@ class WatchdogHasher(BatchHasher):
         )
 
     def hash_tree(self, root) -> int:
+        import time as _t
+
         from ..utils.devicewatch import DeviceWedged, call_with_deadline
 
         inner_tree = getattr(self.inner, "hash_tree", None)
         if inner_tree is None:
             return self._host_tree(root)
+        if not self.device_wedged and self._route_by_cost and (
+            not self._tree.use_device(1)
+        ):
+            from ..state.shamap import compute_hashes
+
+            t0 = _t.perf_counter()
+            count = compute_hashes(root, self.fallback)
+            if count:
+                self._tree.observe_host(
+                    count, (_t.perf_counter() - t0) * 1000.0
+                )
+            return count
         if not self.device_wedged:
             import inspect
 
@@ -737,10 +842,17 @@ class WatchdogHasher(BatchHasher):
             if lock is not None:
                 kwargs["cancel_lock"] = lock
             try:
-                return call_with_deadline(
+                t0 = _t.perf_counter()
+                count = call_with_deadline(
                     lambda: inner_tree(root, **kwargs), self._t_first,
                     label="hash-device",
                 )
+                if count:
+                    # per-node rate in the size-independent bucket 1
+                    self._tree.observe_device(
+                        1, (_t.perf_counter() - t0) * 1000.0 / count
+                    )
+                return count
             except DeviceWedged as exc:
                 # Close the zombie race BEFORE any host work touches the
                 # tree: setting cancelled under the shared lock means the

@@ -285,3 +285,72 @@ class TestWatchdogHasher:
         assert m.get_hash() == expect
         assert inner.calls > 0  # the watched inner did the level batches
         assert fb.calls == 0  # the fallback was never touched
+
+
+class TestHashCostRouting:
+    """WatchdogHasher's measured-cost routing (the VerifyPlane stance
+    applied to hashing): the device must EARN traffic — a measured-slow
+    device floors at the host path with bounded re-exploration, and an
+    unmeasured device is explored (first, compile-laden sample
+    discarded)."""
+
+    class _Fake:
+        name = "fake"
+
+        def __init__(self, delay_s):
+            self.delay = delay_s
+            self.calls = 0
+            self.device_nodes = 0
+            self.host_nodes = 0
+
+        def prefix_hash_batch(self, prefixes, payloads):
+            import hashlib
+            import time as _t
+
+            self.calls += 1
+            _t.sleep(self.delay)
+            return [
+                hashlib.sha512(p.to_bytes(4, "big") + d).digest()[:32]
+                for p, d in zip(prefixes, payloads)
+            ]
+
+    def _mk(self, dev_delay, host_delay):
+        from stellard_tpu.crypto.backend import WatchdogHasher
+
+        dev = self._Fake(dev_delay)
+        host = self._Fake(host_delay)
+        w = WatchdogHasher(dev, host, first_timeout=30, warm_timeout=30)
+        return w, dev, host
+
+    def test_slow_device_floors_at_host(self):
+        w, dev, host = self._mk(dev_delay=0.02, host_delay=0.0)
+        batch = ([0x1234] * 8, [b"x" * 40] * 8)
+        for _ in range(12):
+            w.prefix_hash_batch(*batch)
+        # exploration: first (discarded) + second (recorded) device
+        # samples, one host measurement, then the host wins every call
+        assert dev.calls <= 3
+        assert host.calls >= 8
+
+    def test_fast_device_keeps_traffic(self):
+        w, dev, host = self._mk(dev_delay=0.0, host_delay=0.02)
+        batch = ([0x1234] * 8, [b"x" * 40] * 8)
+        for _ in range(12):
+            w.prefix_hash_batch(*batch)
+        # one host measurement for the comparison; device keeps the rest
+        assert host.calls == 1
+        assert dev.calls >= 10
+
+    def test_device_mode_restores_unconditional_routing(self, monkeypatch):
+        monkeypatch.setenv("STELLARD_HASH_ROUTING", "device")
+        w, dev, host = self._mk(dev_delay=0.02, host_delay=0.0)
+        batch = ([0x1234] * 4, [b"x" * 40] * 4)
+        for _ in range(6):
+            w.prefix_hash_batch(*batch)
+        assert host.calls == 0 and dev.calls == 6
+
+    def test_results_identical_across_routes(self):
+        w, dev, host = self._mk(dev_delay=0.01, host_delay=0.0)
+        batch = ([0x1234] * 4, [b"a" * 33, b"b" * 100, b"", b"c" * 7])
+        outs = {tuple(w.prefix_hash_batch(*batch)) for _ in range(8)}
+        assert len(outs) == 1  # device and host routes agree bytes-for-bytes
