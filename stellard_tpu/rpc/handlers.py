@@ -370,20 +370,53 @@ def do_stop(ctx: Context) -> dict:
 
 @handler("log_level", Role.ADMIN)
 def do_log_level(ctx: Context) -> dict:
+    """reference: handlers/LogLevel.cpp — read current levels, or set
+    the base severity / one partition's severity. Every logger in this
+    tree lives under the "stellard" hierarchy (stellard.device,
+    stellard.netops, ...), so the base set covers them all; a
+    `partition` narrows to stellard.<partition>. (The handler
+    previously set a logger name nothing logs to — no effect at all.)"""
     import logging
 
+    levels = {
+        "trace": logging.DEBUG,
+        "debug": logging.DEBUG,
+        "info": logging.INFO,
+        "warn": logging.WARNING,
+        "warning": logging.WARNING,
+        "error": logging.ERROR,
+        "fatal": logging.CRITICAL,
+    }
     severity = ctx.params.get("severity")
     if severity:
-        level = {
-            "trace": logging.DEBUG,
-            "debug": logging.DEBUG,
-            "info": logging.INFO,
-            "warning": logging.WARNING,
-            "error": logging.ERROR,
-            "fatal": logging.CRITICAL,
-        }.get(severity, logging.INFO)
-        logging.getLogger("stellard_tpu").setLevel(level)
-    return {}
+        if severity not in levels:
+            raise RPCError("invalidParams", f"unknown severity {severity!r}")
+        partition = ctx.params.get("partition")
+        if partition:
+            name = f"stellard.{partition}"
+            # only EXISTING partitions: a typo'd name would silently
+            # create a phantom logger nothing logs to (and pollute
+            # reads forever — loggerDict entries are permanent)
+            if name not in logging.root.manager.loggerDict:
+                raise RPCError(
+                    "invalidParams", f"unknown partition {partition!r}"
+                )
+        else:
+            name = "stellard"
+        logging.getLogger(name).setLevel(levels[severity])
+        return {}
+    base = logging.getLogger("stellard")
+    out = {"base": logging.getLevelName(base.getEffectiveLevel()).lower()}
+    # snapshot: lazy first-time getLogger() in another thread mutates
+    # loggerDict mid-iteration otherwise
+    for name, logger in list(logging.root.manager.loggerDict.items()):
+        if name.startswith("stellard.") and isinstance(
+            logger, logging.Logger
+        ) and logger.level != logging.NOTSET:
+            out[name.removeprefix("stellard.")] = logging.getLevelName(
+                logger.level
+            ).lower()
+    return {"levels": out}
 
 
 @handler("feature", Role.ADMIN)
