@@ -393,14 +393,13 @@ def do_log_level(ctx: Context) -> dict:
             raise RPCError("invalidParams", f"unknown severity {severity!r}")
         partition = ctx.params.get("partition")
         if partition:
-            name = f"stellard.{partition}"
-            # only EXISTING partitions: a typo'd name would silently
-            # create a phantom logger nothing logs to (and pollute
-            # reads forever — loggerDict entries are permanent)
-            if name not in logging.root.manager.loggerDict:
+            if partition not in _LOG_PARTITIONS:
+                # a typo'd name would silently create a phantom logger
+                # nothing logs to (and pollute reads forever)
                 raise RPCError(
                     "invalidParams", f"unknown partition {partition!r}"
                 )
+            name = f"stellard.{partition}"
         else:
             name = "stellard"
         logging.getLogger(name).setLevel(levels[severity])
@@ -417,6 +416,15 @@ def do_log_level(ctx: Context) -> dict:
                 logger.level
             ).lower()
     return {"levels": out}
+
+
+# the known log partitions (stellard.<name>) — a static allowlist, not
+# an existence check: several of these loggers are created lazily in
+# rare error paths, and an operator must be able to raise their
+# verbosity BEFORE the event they want to capture
+_LOG_PARTITIONS = frozenset({
+    "device", "netops", "node", "validator", "unl", "cleaner", "fatal",
+})
 
 
 @handler("feature", Role.ADMIN)

@@ -544,3 +544,90 @@ type=memory
             assert member.public in n.overlay.cluster
         finally:
             n.stop()
+
+
+class TestCliSmoke:
+    """End-to-end CLI smoke (reference: Main.cpp modes): a standalone
+    server process with file-backed stores, the RPC CLIENT mode against
+    it, then the offline --dump_ledger tooling over the persisted DB."""
+
+    def test_server_client_and_offline_dump(self, tmp_path):
+        import json
+        import os
+        import socket
+        import subprocess
+        import sys
+        import time
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        cfg = tmp_path / "cli.cfg"
+        cfg.write_text(f"""
+[standalone]
+1
+
+[node_db]
+type=sqlite
+path={tmp_path}/ns.sqlite
+
+[database_path]
+{tmp_path}/db.sqlite
+
+[signature_backend]
+type=cpu
+
+[rpc_port]
+{port}
+""")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        srv = subprocess.Popen(
+            [sys.executable, "-m", "stellard_tpu", "--conf", str(cfg),
+             "--start"],
+            cwd=repo, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+        )
+        try:
+            def client(*cmd):
+                r = subprocess.run(
+                    [sys.executable, "-m", "stellard_tpu", "--conf",
+                     str(cfg)] + list(cmd),
+                    cwd=repo, env=env, capture_output=True, text=True,
+                    timeout=30,
+                )
+                assert r.returncode == 0, r.stdout + r.stderr
+                return json.loads(r.stdout)
+
+            deadline = time.monotonic() + 90
+            info = None
+            while time.monotonic() < deadline:
+                try:
+                    info = client("server_info")
+                    break
+                except (AssertionError, json.JSONDecodeError,
+                        subprocess.TimeoutExpired):
+                    time.sleep(1.5)
+            assert info is not None, "server never answered the CLI client"
+            assert info["result"]["info"]["complete_ledgers"]
+            accept = client("ledger_accept")
+            assert accept["result"]["ledger_current_index"] >= 2
+        finally:
+            srv.terminate()
+            try:
+                srv.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                srv.kill()
+
+        # offline tooling over the PERSISTED stores (server is down)
+        r = subprocess.run(
+            [sys.executable, "-m", "stellard_tpu", "--conf", str(cfg),
+             "--dump_ledger", "1"],
+            cwd=repo, env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        dumped = json.loads(r.stdout)
+        assert dumped["ledger_index"] == 1
+        assert dumped["accountState"], "dump carries the state entries"
