@@ -537,3 +537,32 @@ class TestInflation:
         )
         net.apply(ALICE, TxType.ttINFLATION, fee=10,
                   expect=TER.temBAD_FEE, fields={sfInflateSeq: 1})
+
+
+class TestTrustAutoClear:
+    """reference: test/path2-test.js 'trust auto clear' — clearing the
+    limit while a balance is outstanding keeps the line alive; the line
+    auto-deletes the moment the balance returns to zero with both sides
+    at defaults."""
+
+    def test_line_survives_cleared_limit_then_auto_deletes(self):
+        from stellard_tpu.state import indexes
+        from stellard_tpu.state.entryset import LedgerEntrySet
+
+        net = Net(ALICE, BOB)
+        net.trust(ALICE, BOB, 1000)
+        net.pay(BOB, ALICE.account_id,
+                STAmount.from_iou(USD, BOB.account_id, 50, 0))
+        net.trust(ALICE, BOB, 0)  # clear limit; 50 USD still held
+        idx = indexes.ripple_state_index(
+            ALICE.account_id, BOB.account_id, USD
+        )
+        assert LedgerEntrySet(net.ledger).peek(idx) is not None, (
+            "line with outstanding balance must survive a cleared limit"
+        )
+        assert net.iou_balance(ALICE, BOB).value_text() == "50"
+        net.pay(ALICE, BOB.account_id,
+                STAmount.from_iou(USD, BOB.account_id, 50, 0))
+        assert LedgerEntrySet(net.ledger).peek(idx) is None, (
+            "defaulted line must auto-delete when the balance zeroes"
+        )
