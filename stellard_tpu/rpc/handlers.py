@@ -908,11 +908,39 @@ def do_sign(ctx: Context) -> dict:
 # -- pub/sub ---------------------------------------------------------------
 
 
+def _url_sub_target(ctx: Context):
+    """Resolve the subscription target for a `url` param (reference:
+    Subscribe.cpp:34-80 — HTTP callers subscribe a server-side RPCSub
+    pusher instead of a websocket InfoSub; admin only)."""
+    p = ctx.params
+    if ctx.role != Role.ADMIN:
+        raise RPCError("noPermission")
+    subs = ctx.subs or getattr(ctx.node, "subs", None)
+    if subs is None:
+        raise RPCError("notSupported", "node is not serving subscriptions")
+    try:
+        sub = subs.rpc_sub(
+            p["url"],
+            p.get("url_username", p.get("username", "")),
+            p.get("url_password", p.get("password", "")),
+        )
+    except ValueError as exc:
+        raise RPCError("invalidParams", str(exc)) from exc
+    return sub, subs
+
+
 @handler("subscribe")
 def do_subscribe(ctx: Context) -> dict:
-    """reference: handlers/Subscribe.cpp:86-112"""
-    if ctx.infosub is None or ctx.subs is None:
-        raise RPCError("notSupported", "subscribe requires a websocket")
+    """reference: handlers/Subscribe.cpp:86-112 (websocket InfoSub) and
+    :34-80 (HTTP `url` callbacks via RPCSub)."""
+    if ctx.params.get("url"):
+        infosub, subs = _url_sub_target(ctx)
+    elif ctx.infosub is None or ctx.subs is None:
+        raise RPCError("notSupported",
+                       "subscribe requires a websocket or a url")
+    else:
+        infosub, subs = ctx.infosub, ctx.subs
+    ctx = Context(ctx.node, ctx.params, ctx.role, infosub, subs)
     p = ctx.params
     result = {}
     if p.get("streams"):
@@ -931,8 +959,24 @@ def do_subscribe(ctx: Context) -> dict:
 
 @handler("unsubscribe")
 def do_unsubscribe(ctx: Context) -> dict:
-    if ctx.infosub is None or ctx.subs is None:
-        raise RPCError("notSupported", "unsubscribe requires a websocket")
+    _prune = None
+    if ctx.params.get("url"):
+        if ctx.role != Role.ADMIN:
+            raise RPCError("noPermission")
+        subs = ctx.subs or getattr(ctx.node, "subs", None)
+        if subs is None:
+            raise RPCError("notSupported", "node is not serving subscriptions")
+        # lookup ONLY: unsubscribing a never-subscribed url must error,
+        # not find-or-create a phantom subscription
+        infosub = subs.rpc_sub_lookup(ctx.params["url"])
+        if infosub is None:
+            raise RPCError("invalidParams",
+                           f"no subscription for url {ctx.params['url']!r}")
+        _prune = (subs, infosub)
+        ctx = Context(ctx.node, ctx.params, ctx.role, infosub, subs)
+    elif ctx.infosub is None or ctx.subs is None:
+        raise RPCError("notSupported",
+                       "unsubscribe requires a websocket or a url")
     p = ctx.params
     if p.get("streams"):
         ctx.subs.unsubscribe_streams(ctx.infosub, p["streams"])
@@ -946,6 +990,8 @@ def do_unsubscribe(ctx: Context) -> dict:
             [decode_account_id(a) for a in p["accounts_proposed"]],
             proposed=True,
         )
+    if _prune is not None:
+        _prune[0].prune_rpc_sub(_prune[1])
     return {}
 
 

@@ -44,8 +44,46 @@ class SubscriptionManager:
         self.ops = ops
         self._lock = threading.Lock()
         self._subs: dict[int, InfoSub] = {}
+        # url -> RpcSub (reference: NetworkOPs mRpcSubMap): HTTP-callback
+        # subscriptions outlive any one request; found/created by
+        # `subscribe` with a url (admin-only)
+        self.rpc_subs: dict[str, InfoSub] = {}
         ops.on_ledger_closed.append(self._pub_ledger)
         ops.on_proposed_tx.append(self._pub_proposed)
+
+    def rpc_sub(self, url: str, username: str = "", password: str = ""):
+        """Find-or-create the RPCSub for a url (reference: findRpcSub /
+        addRpcSub); fresh credentials update an existing sub."""
+        from .rpcsub import RpcSub
+
+        with self._lock:
+            sub = self.rpc_subs.get(url)
+            if sub is None:
+                sub = RpcSub(url, username, password)
+                self.rpc_subs[url] = sub
+            elif username or password:
+                sub.set_credentials(username, password)
+            return sub
+
+    def rpc_sub_lookup(self, url: str):
+        """Find only (unsubscribe must never create — a typo'd url would
+        register a phantom subscription and report success)."""
+        with self._lock:
+            return self.rpc_subs.get(url)
+
+    def prune_rpc_sub(self, sub) -> None:
+        """Drop an RpcSub that no longer subscribes to anything: a url
+        entry with no streams/accounts must not live (and get POSTed
+        events) forever."""
+        if (sub.streams or sub.accounts or sub.accounts_proposed
+                or sub.path_requests):
+            return
+        with self._lock:
+            self.rpc_subs.pop(getattr(sub, "url", None), None)
+            self._subs.pop(sub.id, None)
+        close = getattr(sub, "close", None)
+        if close is not None:
+            close()
 
     # -- subscribe / unsubscribe (reference: handlers/Subscribe.cpp) ------
 

@@ -1,0 +1,110 @@
+"""RPCSub: HTTP-callback subscriptions (reference:
+src/ripple_net/rpc/RPCSub.cpp + NetworkOPs' mRpcSubMap).
+
+`subscribe` with a `url` (admin-only) registers a server-side pusher:
+every pub/sub event the subscription matches is POSTed to the client's
+HTTP listener as a JSON-RPC request `{"method": "event", "params":
+[event]}`, with a per-subscription monotonically increasing `seq`
+stamped into the event (reference sendThread). Events queue up to 32
+deep; on overflow the most recently queued event is dropped (the
+reference's "drop the previous event" rule), never the oldest — a slow
+listener sees a gap, not a stale stream. One daemon sender drains the
+queue; delivery failures are logged and dropped (the reference retries
+nothing either).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import threading
+import urllib.request
+from collections import deque
+from typing import Optional
+from urllib.parse import urlparse
+
+from .infosub import InfoSub
+
+__all__ = ["RpcSub"]
+
+log = logging.getLogger("stellard.rpcsub")
+
+EVENT_QUEUE_MAX = 32  # reference RPCSub eventQueueMax
+
+
+class RpcSub(InfoSub):
+    """An InfoSub whose sink is a remote JSON-RPC listener."""
+
+    def __init__(self, url: str, username: str = "", password: str = ""):
+        parsed = urlparse(url)
+        if parsed.scheme not in ("http", "https"):
+            raise ValueError("only http and https are supported")
+        if not parsed.hostname:
+            raise ValueError("url has no host")
+        self.url = url
+        self.username = username
+        self.password = password
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._sending = False
+        self._seq = 1
+        self._closed = False
+        super().__init__(send=self._enqueue)
+
+    def set_credentials(self, username: str, password: str) -> None:
+        with self._lock:
+            self.username = username
+            self.password = password
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._q.clear()
+
+    # -- sink --------------------------------------------------------------
+
+    def _enqueue(self, obj: dict) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            if len(self._q) >= EVENT_QUEUE_MAX:
+                # reference: drop the PREVIOUS (most recently queued)
+                # event — older queued events keep their slot
+                self._q.pop()
+                log.warning("rpcsub %s: queue full, dropping an event",
+                            self.url)
+            ev = dict(obj)
+            ev["seq"] = self._seq
+            self._seq += 1
+            self._q.append(ev)
+            if self._sending:
+                return
+            self._sending = True
+        threading.Thread(
+            target=self._send_loop, name="rpcsub-send", daemon=True
+        ).start()
+
+    def _send_loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._closed or not self._q:
+                    self._sending = False
+                    return
+                ev = self._q.popleft()
+                user, pw = self.username, self.password
+            body = json.dumps(
+                {"method": "event", "params": [ev]}
+            ).encode()
+            req = urllib.request.Request(
+                self.url, data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            if user or pw:
+                tok = base64.b64encode(f"{user}:{pw}".encode()).decode()
+                req.add_header("Authorization", f"Basic {tok}")
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    resp.read()
+            except Exception as exc:  # noqa: BLE001 — drop, like the reference
+                log.info("rpcsub %s: delivery failed: %s", self.url, exc)

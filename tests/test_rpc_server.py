@@ -453,3 +453,95 @@ class TestSecureDoors:
             assert msg.get("status") == "success"
         finally:
             s.close()
+
+
+class TestRpcSubUrlCallbacks:
+    """subscribe with a `url` (reference: Subscribe.cpp:34-80 + RPCSub):
+    the server POSTs matching events to the client's HTTP listener as
+    JSON-RPC {"method": "event"} requests with increasing seq."""
+
+    def test_url_subscription_end_to_end(self):
+        import http.server
+        import json as _json
+        import threading
+        import time
+
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from stellard_tpu.node.config import Config
+        from stellard_tpu.node.node import Node
+        from stellard_tpu.rpc.handlers import Context, Role, dispatch
+
+        received: list = []
+        got_one = threading.Event()
+
+        class Sink(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                received.append(
+                    (_json.loads(self.rfile.read(n)),
+                     self.headers.get("Authorization"))
+                )
+                got_one.set()
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        listener = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Sink)
+        threading.Thread(target=listener.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{listener.server_port}/"
+
+        node = Node(Config(signature_backend="cpu")).setup().serve()
+        try:
+            # guest may not register a url sub
+            r = dispatch(Context(node, {"url": url, "streams": ["ledger"]},
+                                 Role.GUEST), "subscribe")
+            assert r.get("error") == "noPermission"
+            # bad scheme is invalidParams
+            r = dispatch(Context(node, {"url": "ftp://x/",
+                                        "streams": ["ledger"]},
+                                 Role.ADMIN), "subscribe")
+            assert r.get("error") == "invalidParams"
+
+            r = dispatch(Context(node, {
+                "url": url, "streams": ["ledger"],
+                "url_username": "u", "url_password": "p",
+            }, Role.ADMIN), "subscribe")
+            assert not r.get("error"), r
+            node.ops.accept_ledger()
+            assert got_one.wait(timeout=20), "no callback delivered"
+            body, auth = received[0]
+            assert body["method"] == "event"
+            ev = body["params"][0]
+            assert ev["type"] == "ledgerClosed" and ev["seq"] == 1
+            assert auth and auth.startswith("Basic ")
+
+            # second close: seq increases on the same subscription
+            got_one.clear()
+            node.ops.accept_ledger()
+            assert got_one.wait(timeout=20)
+            assert received[-1][0]["params"][0]["seq"] == 2
+
+            # unsubscribing an unknown url must error, never create
+            r = dispatch(Context(node, {"url": "http://127.0.0.1:1/",
+                                        "streams": ["ledger"]},
+                                 Role.ADMIN), "unsubscribe")
+            assert r.get("error") == "invalidParams"
+
+            # unsubscribe via url: no further deliveries, entry pruned
+            r = dispatch(Context(node, {"url": url, "streams": ["ledger"]},
+                                 Role.ADMIN), "unsubscribe")
+            assert not r.get("error"), r
+            assert node.subs.rpc_sub_lookup(url) is None, (
+                "emptied url subscription must be pruned"
+            )
+            got_one.clear()
+            node.ops.accept_ledger()
+            assert not got_one.wait(timeout=3)
+        finally:
+            node.stop()
+            listener.shutdown()
