@@ -46,6 +46,7 @@ from .flags import (
     lsfLowAuth,
     lsfLowNoRipple,
     lsfLowReserve,
+    lsfRequireAuth,
 )
 
 __all__ = [
@@ -198,6 +199,20 @@ def ripple_credit(les: LedgerEntrySet, sender_id: bytes, receiver_id: bytes,
         balance = -balance  # sender terms
     before = balance
     balance = balance - amount
+
+    # RequireAuth gate (reference: PathState::pushNode:309 — "can't
+    # receive IOUs from issuer without auth", terNO_AUTH): ANY movement
+    # whose SENDER set lsfRequireAuth across a line lacking the
+    # sender-side auth flag is refused, unconditionally of balances
+    # (the reference checks the edge at path-expansion time).
+    if amount.signum() > 0:
+        sender_root = les.peek(indexes.account_root_index(sender_id))
+        if sender_root is not None and (
+            sender_root.get(sfFlags, 0) & lsfRequireAuth
+        ):
+            sender_auth = lsfHighAuth if sender_high else lsfLowAuth
+            if not (line.get(sfFlags, 0) & sender_auth):
+                return TER.terNO_AUTH
 
     # line returned to default on the sender's side? clear reserve/delete
     # (reference: LedgerEntrySet.cpp:1620-1650)

@@ -933,6 +933,15 @@ def _url_sub_target(ctx: Context):
 def do_subscribe(ctx: Context) -> dict:
     """reference: handlers/Subscribe.cpp:86-112 (websocket InfoSub) and
     :34-80 (HTTP `url` callbacks via RPCSub)."""
+    p0 = ctx.params
+    # decode-validate BEFORE registering a url sub: a later param error
+    # must not leak a phantom rpc_subs entry
+    for key in ("accounts", "accounts_proposed", "rt_accounts"):
+        for a in p0.get(key) or []:
+            try:
+                decode_account_id(a)
+            except (ValueError, KeyError) as exc:
+                raise RPCError("actMalformed") from exc
     if ctx.params.get("url"):
         infosub, subs = _url_sub_target(ctx)
     elif ctx.infosub is None or ctx.subs is None:

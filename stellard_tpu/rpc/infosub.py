@@ -74,11 +74,13 @@ class SubscriptionManager:
     def prune_rpc_sub(self, sub) -> None:
         """Drop an RpcSub that no longer subscribes to anything: a url
         entry with no streams/accounts must not live (and get POSTed
-        events) forever."""
-        if (sub.streams or sub.accounts or sub.accounts_proposed
-                or sub.path_requests):
-            return
+        events) forever. Emptiness is re-checked under the registry
+        lock so a concurrent re-subscribe (which adds a stream through
+        the same lock-guarded find-or-create) is never destroyed."""
         with self._lock:
+            if (sub.streams or sub.accounts or sub.accounts_proposed
+                    or sub.path_requests):
+                return
             self.rpc_subs.pop(getattr(sub, "url", None), None)
             self._subs.pop(sub.id, None)
         close = getattr(sub, "close", None)

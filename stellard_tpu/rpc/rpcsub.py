@@ -47,7 +47,8 @@ class RpcSub(InfoSub):
         self.password = password
         self._q: deque = deque()
         self._lock = threading.Lock()
-        self._sending = False
+        self._cv = threading.Condition(self._lock)
+        self._worker: Optional[threading.Thread] = None
         self._seq = 1
         self._closed = False
         super().__init__(send=self._enqueue)
@@ -61,6 +62,7 @@ class RpcSub(InfoSub):
         with self._lock:
             self._closed = True
             self._q.clear()
+            self._cv.notify_all()
 
     # -- sink --------------------------------------------------------------
 
@@ -78,18 +80,22 @@ class RpcSub(InfoSub):
             ev["seq"] = self._seq
             self._seq += 1
             self._q.append(ev)
-            if self._sending:
+            self._cv.notify()
+            if self._worker is not None and self._worker.is_alive():
                 return
-            self._sending = True
-        threading.Thread(
-            target=self._send_loop, name="rpcsub-send", daemon=True
-        ).start()
+            # ONE persistent sender per subscription (steady stream
+            # traffic must not churn a thread per event)
+            self._worker = threading.Thread(
+                target=self._send_loop, name="rpcsub-send", daemon=True
+            )
+            self._worker.start()
 
     def _send_loop(self) -> None:
         while True:
             with self._lock:
-                if self._closed or not self._q:
-                    self._sending = False
+                while not self._q and not self._closed:
+                    self._cv.wait()
+                if self._closed:
                     return
                 ev = self._q.popleft()
                 user, pw = self.username, self.password

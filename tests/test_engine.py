@@ -566,3 +566,71 @@ class TestTrustAutoClear:
         assert LedgerEntrySet(net.ledger).peek(idx) is None, (
             "defaulted line must auto-delete when the balance zeroes"
         )
+
+
+class TestAccountSetFlags:
+    """reference: test/account_set-test.js — RequireDestTag and
+    RequireAuth end-to-end behavior (the flags were implemented; the
+    behaviors were unpinned)."""
+
+    def test_require_dest_tag(self):
+        from stellard_tpu.engine.flags import asfRequireDest
+        from stellard_tpu.protocol.sfields import (
+            sfDestinationTag,
+            sfSetFlag,
+            sfClearFlag,
+        )
+
+        net = Net(ALICE, BOB)
+        net.apply(BOB, TxType.ttACCOUNT_SET,
+                  fields={sfSetFlag: int(asfRequireDest)})
+        # untagged payment refused; tagged succeeds
+        net.pay(ALICE, BOB.account_id, STAmount.from_drops(1_000_000),
+                expect=TER.tefDST_TAG_NEEDED)
+        net.apply(ALICE, TxType.ttPAYMENT, fields={
+            sfDestination: BOB.account_id,
+            sfAmount: STAmount.from_drops(1_000_000),
+            sfDestinationTag: 7,
+        })
+        # clearing the flag restores untagged payments
+        net.apply(BOB, TxType.ttACCOUNT_SET,
+                  fields={sfClearFlag: int(asfRequireDest)})
+        net.pay(ALICE, BOB.account_id, STAmount.from_drops(1_000_000))
+
+    def test_require_auth_gates_trust_issuance(self):
+        from stellard_tpu.engine.flags import asfRequireAuth, tfSetfAuth
+        from stellard_tpu.protocol.sfields import sfFlags, sfSetFlag
+
+        gateway, holder = KeyPair.from_passphrase("asf-gw"), ALICE
+        net = Net(gateway, holder)
+        # authorizing before RequireAuth is set is an error
+        net.apply(gateway, TxType.ttTRUST_SET,
+                  expect=TER.tefNO_AUTH_REQUIRED,
+                  fields={sfLimitAmount: STAmount.from_iou(
+                      USD, holder.account_id, 0, 0), sfFlags: tfSetfAuth})
+        net.apply(gateway, TxType.ttACCOUNT_SET,
+                  fields={sfSetFlag: int(asfRequireAuth)})
+        net.trust(holder, gateway, 1000)
+        # unauthorized line: the issuer cannot be paid ACROSS it yet —
+        # pathfinding refuses the unauthorized hop
+        from stellard_tpu.paths import find_paths
+
+        alts = find_paths(
+            net.ledger, gateway.account_id, holder.account_id,
+            STAmount.from_iou(USD, gateway.account_id, 5, 0),
+        )
+        assert alts == [], "unauthorized line must not carry paths"
+        # direct issuance across the unauthorized line is refused
+        # (reference: calcNodeAccountRev terNO_AUTH)
+        net.pay(gateway, holder.account_id,
+                STAmount.from_iou(USD, gateway.account_id, 5, 0),
+                expect=TER.terNO_AUTH)
+        # the gateway authorizes the holder's line, then issuance works
+        net.apply(gateway, TxType.ttTRUST_SET, fields={
+            sfLimitAmount: STAmount.from_iou(
+                USD, holder.account_id, 0, 0),
+            sfFlags: tfSetfAuth,
+        })
+        net.pay(gateway, holder.account_id,
+                STAmount.from_iou(USD, gateway.account_id, 5, 0))
+        assert net.iou_balance(holder, gateway).value_text() == "5"
