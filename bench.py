@@ -34,6 +34,90 @@ def _emit(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
 
 
+# XLA:CPU logs a ~1.5KB "AOT result ... machine feature mismatch" warning
+# EVERY time the persistent compilation cache replays a program compiled
+# on a different machine — dozens of repeats per bench run, flooding the
+# tail and displacing the JSON result line in combined-output consumers.
+# The text is identical each time, so pass the FIRST occurrence through
+# and swallow repeats (with a final count), keeping the tail readable and
+# stdout's last line the metric JSON.
+_NOISY_MARKERS = (
+    "Machine type used for XLA:CPU compilation",
+    "XLA:CPU AOT result",
+)
+
+
+def _install_stderr_dedupe() -> None:
+    """fd-level stderr filter: the warning is written by C++ (absl/TSL)
+    directly to fd 2, so a sys.stderr wrapper can't see it. Replace fd 2
+    with a pipe drained by a daemon thread that dedupes the known-noisy
+    lines and forwards everything else untouched."""
+    import threading
+
+    try:
+        real_err = os.dup(2)
+        r, w = os.pipe()
+        os.dup2(w, 2)
+        os.close(w)
+    except OSError:
+        return  # exotic fd setup: run unfiltered rather than break
+
+    def _pump():
+        seen = 0
+        buf = b""
+        try:
+            with os.fdopen(r, "rb", buffering=0) as pipe:
+                while True:
+                    chunk = pipe.read(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+                    *lines, buf = buf.split(b"\n")
+                    for line in lines:
+                        noisy = any(
+                            m.encode() in line for m in _NOISY_MARKERS
+                        )
+                        if noisy:
+                            seen += 1
+                            if seen > 1:
+                                continue  # swallow repeats
+                        os.write(real_err, line + b"\n")
+                if buf:
+                    os.write(real_err, buf)
+                if seen > 1:
+                    os.write(
+                        real_err,
+                        f"bench: suppressed {seen - 1} repeats of the "
+                        f"XLA:CPU machine-feature warning\n".encode(),
+                    )
+        except OSError:
+            # the real stderr went away (e.g. `2>&1 | head` consumer
+            # exited) or the pipe broke: restore fd 2 so later writers
+            # get the normal EPIPE behavior, not a dead filter
+            try:
+                os.dup2(real_err, 2)
+            except OSError:
+                pass
+
+    t = threading.Thread(target=_pump, name="stderr-dedupe", daemon=True)
+    t.start()
+
+    def _restore():
+        # point fd 2 back at the terminal: this drops the last reference
+        # to the pipe's write end, the pump sees EOF, drains whatever is
+        # buffered (a final traceback must not vanish with the filter),
+        # prints its suppression summary, and exits before teardown
+        try:
+            os.dup2(real_err, 2)
+        except OSError:
+            return
+        t.join(timeout=2.0)
+
+    import atexit
+
+    atexit.register(_restore)
+
+
 # per-leg routing-model evidence (verify-plane get_json snapshots),
 # written to BENCH_DETAIL.json next to this file: when a leg's ratio
 # looks wrong, the model state (per-bucket device ms, cpu per-sig ms,
@@ -168,16 +252,34 @@ def _fresh(txs):
     return [SerializedTransaction.from_bytes(t.serialize()) for t in txs]
 
 
-def _drive_node(backend, txs, chunk=500, setup_phases=()):
+def _drive_node(backend, txs, chunk=500, setup_phases=(), cfg_kwargs=None,
+                max_inflight=None, pin_close_time=None):
     """Submit pre-signed txs through the full async pipeline (verify plane
     -> job queue -> open ledger), closing every `chunk`; -> wall seconds.
-    `setup_phases` run first, one ledger close per phase, unmeasured."""
+    `setup_phases` run first, one ledger close per phase, unmeasured.
+    `max_inflight` caps unacknowledged submissions (windowed submit):
+    below TX_BACKLOG_SHED the intake gate never drops a tx, which makes
+    the run DETERMINISTIC — required when two legs must produce
+    byte-identical ledgers (shedding is timing-dependent).
+    The returned detail dict also carries close-path evidence: per-close
+    latency p50, the final LCL hash, a digest of every per-tx close
+    result, and the close-pipeline stats (for the pipelined-flood leg's
+    serial-vs-pipelined comparison)."""
+    import hashlib
     import threading
 
     from stellard_tpu.node.config import Config
     from stellard_tpu.node.node import Node
 
-    node = Node(Config(signature_backend=backend)).setup()
+    node = Node(
+        Config(signature_backend=backend, **(cfg_kwargs or {}))
+    ).setup()
+    if pin_close_time is not None:
+        # deterministic close-time schedule (one resolution step per
+        # close): two legs run minutes apart would otherwise round to
+        # different close times and can never be byte-identical
+        closes_done = [0]
+        node.ops.network_time = lambda: pin_close_time + closes_done[0] * 30
     done = threading.Semaphore(0)
 
     if backend != "cpu" and node.verify_prewarm is not None:
@@ -205,18 +307,39 @@ def _drive_node(backend, txs, chunk=500, setup_phases=()):
     # routed-out device
     vp = node.verify_plane
     vp.device_sigs = vp.cpu_sigs = vp.verified = 0
+    close_ms = []
+    results_digest = hashlib.sha256()
     t0 = time.perf_counter()
     for start in range(0, len(txs), chunk):
         part = txs[start : start + chunk]
+        inflight = 0
         for tx in part:
+            if max_inflight is not None and inflight >= max_inflight:
+                done.acquire()
+                inflight -= 1
             node.ops.submit_transaction(tx, cb)
-        for _ in part:
+            inflight += 1
+        for _ in range(inflight):
             done.acquire()
-        node.ops.accept_ledger()
+        c0 = time.perf_counter()
+        closed, results = node.ops.accept_ledger()
+        close_ms.append((time.perf_counter() - c0) * 1000.0)
+        if pin_close_time is not None:
+            closes_done[0] += 1
+        for txid in sorted(results):
+            results_digest.update(txid + bytes([int(results[txid]) & 0xFF]))
+    # the timed window ends when all closes are DURABLE: drain the close
+    # pipeline so pipelined throughput never counts unfinished persists
+    node.close_pipeline.flush(timeout=300)
     dt = time.perf_counter() - t0
     committed = node.ledger_master.closed_ledger().seq
     detail = node.verify_plane.get_json()
     share = detail.get("device_share", 0.0)
+    close_ms.sort()
+    detail["close_p50_ms"] = round(close_ms[len(close_ms) // 2], 2) if close_ms else 0.0
+    detail["lcl_hash"] = node.ledger_master.closed_ledger().hash().hex()
+    detail["results_digest"] = results_digest.hexdigest()
+    detail["close_pipeline"] = node.close_pipeline.get_json()
     node.stop()
     return dt, committed, share, detail
 
@@ -237,6 +360,92 @@ def bench_payment_flood(backends):
         _note_detail("payment_flood_tx_per_sec", b, detail)
     _emit_config("payment_flood_tx_per_sec", rates, shares=shares)
     return rates
+
+
+def bench_pipelined_flood(backends):
+    """Close-pipeline leg: the payment flood driven twice on the host
+    backend — serial close path ([close_pipeline] enabled=0, the
+    pre-pipeline shape) vs pipelined (persistence overlapped with the
+    next ledger's verify/apply) — reporting tx/s, close p50, and queue
+    depth side by side, plus the equivalence evidence (byte-identical
+    final LCL hash and per-tx result digest across modes).
+
+    Unlike the other legs this one runs FILE-BACKED stores (cpplog
+    nodestore + sqlite on disk): the pipeline's whole point is taking
+    real storage writes (WAL commits, store appends) off the close path,
+    and an in-memory store has no such tail to overlap."""
+    import shutil
+    import tempfile
+
+    from stellard_tpu.protocol.keys import KeyPair
+
+    n = int(os.environ.get("BENCH_FLOOD_N", "3000"))
+    master = KeyPair.from_passphrase("masterpassphrase")
+    txs = _payments(master, n)
+
+    # interleaved best-of-K pairs (PERF.md's best-of convention): this
+    # box's CPU allotment fluctuates ~3x between otherwise-identical
+    # runs, so single A/B legs routinely invert; the best rep per mode
+    # is the closest observable to the structural rate
+    reps = max(1, int(os.environ.get("BENCH_PIPE_REPS", "3")))
+    legs = {"serial": [], "pipelined": []}
+    for _rep in range(reps):
+        for mode, enabled in (("serial", False), ("pipelined", True)):
+            # max_inflight under TX_BACKLOG_SHED: the intake gate never
+            # sheds, so both modes apply the identical tx set and the
+            # byte-identity check below is meaningful (shedding is
+            # timing-dependent)
+            state_dir = tempfile.mkdtemp(prefix=f"bench-pipe-{mode}-")
+            try:
+                dt, _, _, detail = _drive_node(
+                    "cpu", txs,
+                    cfg_kwargs={
+                        "close_pipeline_enabled": enabled,
+                        "database_path": os.path.join(state_dir, "bench.db"),
+                        "node_db_type": "cpplog",
+                        "node_db_path": os.path.join(state_dir, "nodestore"),
+                    },
+                    max_inflight=64,
+                    # both legs close on the identical virtual clock so
+                    # byte-identity is immune to wall-time rounding
+                    pin_close_time=900_000_000,
+                )
+            finally:
+                shutil.rmtree(state_dir, ignore_errors=True)
+            legs[mode].append({"rate": n / dt, "detail": detail})
+    _note_detail("pipelined_flood_tx_per_sec", "serial",
+                 [leg["detail"] for leg in legs["serial"]])
+    _note_detail("pipelined_flood_tx_per_sec", "pipelined",
+                 [leg["detail"] for leg in legs["pipelined"]])
+
+    ser = max(legs["serial"], key=lambda leg: leg["rate"])
+    pip = max(legs["pipelined"], key=lambda leg: leg["rate"])
+    all_details = [leg["detail"] for runs in legs.values() for leg in runs]
+    _emit({
+        "metric": "pipelined_flood_tx_per_sec",
+        "value": round(pip["rate"], 2),
+        "unit": "tx/s",
+        # vs_baseline here = pipelined over serial (the leg's whole point)
+        "vs_baseline": round(pip["rate"] / ser["rate"], 3) if ser["rate"] else 0.0,
+        "serial_tx_per_sec": round(ser["rate"], 2),
+        "reps": reps,
+        "close_p50_ms": pip["detail"]["close_p50_ms"],
+        "serial_close_p50_ms": ser["detail"]["close_p50_ms"],
+        "queue_depth_hwm": pip["detail"]["close_pipeline"]["depth_hwm"],
+        "backpressure_waits": pip["detail"]["close_pipeline"][
+            "backpressure_waits"
+        ],
+        # byte-identical ledger hashes + per-tx results across EVERY rep
+        # of BOTH modes (close times are pinned, shedding is disabled)
+        "hashes_identical": len(
+            {d["lcl_hash"] for d in all_details}
+        ) == 1,
+        "results_identical": len(
+            {d["results_digest"] for d in all_details}
+        ) == 1,
+        "fallback": False,  # host-plane leg: no device involved
+    })
+    return legs
 
 
 def _offer_workload(n):
@@ -611,6 +820,7 @@ def _emit_config(metric, rates, lower_is_better=False, unit="tx/s",
 
 
 def main() -> None:
+    _install_stderr_dedupe()
     platform = _init_device_backend()
 
     from stellard_tpu.crypto import VerifyRequest, make_verifier
@@ -640,6 +850,7 @@ def main() -> None:
         backends = ["cpu"] + (["tpu"] if platform != "cpu" else [])
         for fn in (
             bench_payment_flood,
+            bench_pipelined_flood,
             bench_offer_mix,
             bench_regular_key_fanout,
             bench_consensus_close,

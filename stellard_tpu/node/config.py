@@ -74,6 +74,15 @@ class Config:
     # any; "none"/"off" disables)
     kernel_tuning: str = DEFAULT_KERNEL_TUNING
 
+    # -- ledger-close pipeline ([close_pipeline]) --------------------------
+    # enabled=1: standalone closes hand persistence (NodeStore flush,
+    # tx rows, ordered CLF commit) to the bounded pipeline worker so
+    # ledger N persists while N+1 applies; enabled=0 is the serial
+    # fallback (persist in-line on the close path). depth bounds the
+    # queue — a full queue back-pressures the next close.
+    close_pipeline_enabled: bool = True
+    close_pipeline_depth: int = 8
+
     # -- network identity / trust ([validation_seed], [validators]) --------
     validation_seed: str = ""  # base58 seed; empty = not a validator
     validators: list[str] = field(default_factory=list)  # node public keys
@@ -165,6 +174,13 @@ class Config:
             "type", one("hash_backend", cfg.hash_backend)
         ).lower()
         cfg.kernel_tuning = one("kernel_tuning", cfg.kernel_tuning)
+        cp = _kv(s.get("close_pipeline", []))
+        if "enabled" in cp:
+            cfg.close_pipeline_enabled = cp["enabled"].lower() not in (
+                "0", "false", "no", "off"
+            )
+        if "depth" in cp:
+            cfg.close_pipeline_depth = int(cp["depth"])
 
         cfg.validation_seed = one("validation_seed", cfg.validation_seed)
         cfg.sntp_servers = [line.split()[0] for line in s.get("sntp_servers", [])]

@@ -130,12 +130,18 @@ class LedgerCleaner:
                 on_persist_failed()
                 return
             # fires on the overlay message thread UNDER the master lock —
-            # hand the disk work to the node's ordered persist worker
+            # hand the disk work to the close pipeline's ordered drain
             # (concurrent TxDatabase batches are not safe, and disk time
-            # must not stall consensus); inline only when no worker exists
-            q = getattr(self.node, "_persist_q", None)
-            if q is not None:
-                q.put(("repair", led, {}, on_persisted, on_persist_failed))
+            # must not stall consensus); a "repair" entry persists data
+            # only, never the CLF resume pointer. Inline fallback for
+            # embedders that stubbed the pipeline out.
+            pipeline = getattr(self.node, "close_pipeline", None)
+            if pipeline is not None:
+                pipeline.submit_repair(
+                    led,
+                    done=lambda _results: on_persisted(),
+                    on_failed=on_persist_failed,
+                )
                 return
             from .node import _results_from_meta
 

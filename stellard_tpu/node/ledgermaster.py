@@ -93,6 +93,10 @@ class LedgerMaster:
         self.held: dict[tuple[bytes, int], SerializedTransaction] = {}
         self.min_validations = 0  # quorum for checkAccept
         self.on_validated: Optional[Callable[[Ledger], None]] = None
+        # optional persist-row materializer (Node wires build_tx_rows):
+        # when set, the close overlaps this Python tail with the seal
+        # tree-hash, whose native/device batches release the GIL
+        self.persist_prep: Optional[Callable[[Ledger, dict], list]] = None
 
     # -- bootstrap --------------------------------------------------------
 
@@ -196,6 +200,41 @@ class LedgerMaster:
             tx.set_sig_verdict(True)
         return tx
 
+    def _seal(self, new_lcl: Ledger, results: dict[bytes, TER]) -> None:
+        """Shared seal tail of both close paths: compute the two tree
+        hashes while the persist-row materialization runs.
+
+        The tree hash is the close's crypto block — its batches run in
+        the GIL-releasing native/device hashers when configured — so it
+        computes on a helper thread while THIS thread does the pure-
+        Python persist tail (meta parse, affected-account walk, row
+        build). The SHAMap is persistent: hashing only fills node._hash
+        slots, and the row walk reads item data/children, so the two
+        traversals never write the same fields. A hashing failure on the
+        helper thread is absorbed — _push_closed recomputes serially."""
+        if self.persist_prep is None:
+            return
+        done = threading.Event()
+
+        def _hash_trees():
+            try:
+                new_lcl.tx_map.get_hash()
+                new_lcl.state_map.get_hash()
+            except Exception:  # noqa: BLE001 — recomputed serially on push
+                pass
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_hash_trees, name="seal-hash")
+        t.start()
+        try:
+            new_lcl.persist_rows = self.persist_prep(new_lcl, results)
+        except Exception:  # noqa: BLE001 — the persist stage rebuilds rows
+            pass
+        finally:
+            done.wait()
+            t.join()
+
     def close_and_advance(
         self,
         close_time: int,
@@ -241,6 +280,9 @@ class LedgerMaster:
             # exact objects instead of re-parsing every blob
             for tx in txset.values():
                 new_lcl.parsed_txs[tx.txid()] = tx
+            # overlap: tree-hash (GIL-releasing crypto batches) on a
+            # helper thread while the persist rows materialize here
+            self._seal(new_lcl, results)
             self._push_closed(new_lcl)
             self.current = new_lcl.open_successor()
 
@@ -290,6 +332,7 @@ class LedgerMaster:
             new_lcl.accepted = True
             for tx in txset.values():
                 new_lcl.parsed_txs[tx.txid()] = tx
+            self._seal(new_lcl, results)
             self._push_closed(new_lcl)
             self.current = new_lcl.open_successor()
 

@@ -84,6 +84,34 @@ def _results_from_meta(ledger: Ledger) -> dict:
     return out
 
 
+def build_tx_rows(ledger: Ledger, results: dict) -> list[tuple]:
+    """Materialize a closed ledger's txdb rows, reusing the close pass's
+    parsed_txs/parsed_metas memos instead of re-parsing blobs. Pure
+    Python tail work: close_and_advance runs it overlapped with the seal
+    tree-hash (LedgerMaster.persist_prep), and the close pipeline's txdb
+    stage falls back to it for adopted/repaired ledgers."""
+    from ..protocol.meta import affected_accounts
+
+    rows = []
+    for txn_seq, (txid, blob, meta) in enumerate(ledger.tx_entries()):
+        tx = ledger.parse_tx(txid, blob)
+        meta_src = ledger.parsed_metas.get(txid, meta)
+        affected = affected_accounts(meta_src) if meta else [tx.account]
+        rows.append((
+            txid,
+            tx.tx_type.name,
+            tx.account,
+            tx.sequence,
+            ledger.seq,
+            _result_token(txid, results, meta),
+            blob,
+            meta,
+            affected,
+            txn_seq,
+        ))
+    return rows
+
+
 def _result_token(txid: bytes, results: dict, meta: Optional[bytes]) -> str:
     """TER token for a committed tx: the local apply result when we
     closed the round ourselves, else the sfTransactionResult byte from
@@ -129,6 +157,26 @@ class Node:
             cfg.database_path + ".clf" if cfg.database_path else ":memory:"
         )
         self.clf = CLFMirror(LedgerSqlDatabase(clf_path))
+
+        # ledger-close pipeline: closed ledgers persist on a bounded,
+        # strictly-ordered drain OFF the close path (reference:
+        # pendSaveValidated; ordered because concurrent workers could
+        # commit ledger N+1's CLF pointer before N's, regressing the
+        # resume point). Bounded: a disk that cannot keep up with the
+        # close rate back-pressures closes (briefly) instead of pinning
+        # an unbounded backlog of whole Ledgers in memory. The worker
+        # always exists; [close_pipeline] enabled=0 keeps STANDALONE
+        # closes on the serial in-line path (the repair/networked drains
+        # still ride the worker, as they always did).
+        from .closepipeline import ClosePipeline
+
+        self.close_pipeline = ClosePipeline(
+            save_stage=lambda led: led.save(self.nodestore),
+            txdb_stage=self._persist_tx_rows,
+            clf_stage=self._commit_clf,
+            recover_results=_results_from_meta,
+            depth=cfg.close_pipeline_depth,
+        )
 
         # crypto plane (north star: pluggable cpu|tpu batch backends).
         # Device hashers run under the wedge watchdog: the tunnel's
@@ -377,68 +425,20 @@ class Node:
                 return obj.data if obj is not None else None
 
             self.overlay.node.inbound.local_fetch = _local_node_blob
-            # persistence rides a dedicated ORDERED worker, NOT the
-            # consensus tick (the hook fires under the master lock and a
-            # slow disk must not stall round timing — reference:
-            # pendSaveValidated) and NOT the general job pool (concurrent
-            # workers could commit ledger N+1's CLF pointer before N's,
-            # regressing the resume point)
-            import queue as _queue
 
-            # bounded: a disk that cannot keep up with the close rate
-            # back-pressures the consensus tick (briefly) instead of
-            # pinning an unbounded backlog of whole Ledgers in memory
-            self._persist_q: _queue.Queue = _queue.Queue(maxsize=256)
-
-            def _persist_worker():
-                while True:
-                    item = self._persist_q.get()
-                    if item is None:
-                        return
-                    kind, led, results, done, on_failed = item
-                    try:
-                        if not results:
-                            # ledger we never applied locally (catch-up
-                            # adoption / history repair): recover per-tx
-                            # results from the sfTransactionResult
-                            # metadata byte so stored history and streams
-                            # report real codes
-                            results = _results_from_meta(led)
-                        if kind == "close":
-                            self._persist_closed_ledger(led, results)
-                            # WS streams + INCLUDED→COMMITTED promotion
-                            # fire for networked closes exactly as for
-                            # standalone ones
-                            self.ops.publish_closed_ledger(led, results)
-                        else:  # "repair": historical — no CLF pointer,
-                            # no publish (it is not a new close)
-                            self.persist_ledger_data(led, results)
-                        if done is not None:
-                            done()
-                    except Exception:  # noqa: BLE001 — keep persisting later ledgers
-                        import logging
-
-                        logging.getLogger("stellard.node").exception(
-                            "ledger persist failed"
-                        )
-                        # a failed persist must still release the
-                        # submitter's accounting (e.g. the cleaner's
-                        # bounded in-flight repair slots) or repairs
-                        # silently stop after enough failures
-                        if on_failed is not None:
-                            try:
-                                on_failed()
-                            except Exception:  # noqa: BLE001
-                                pass
-
-            self._persist_thread = threading.Thread(
-                target=_persist_worker, name="ledger-persist", daemon=True
-            )
-            self._persist_thread.start()
-
+            # persistence rides the close pipeline's dedicated ORDERED
+            # worker, NOT the consensus tick (the hook fires under the
+            # master lock and a slow disk must not stall round timing —
+            # reference: pendSaveValidated). WS streams + the
+            # INCLUDED→COMMITTED promotion fire AFTER the persist, in
+            # drain order, exactly as the old dedicated worker did.
             def _persist_async(led):
-                self._persist_q.put(
-                    ("close", led, getattr(led, "apply_results", {}), None, None)
+                self.close_pipeline.submit_close(
+                    led,
+                    getattr(led, "apply_results", {}),
+                    done=lambda results: self.ops.publish_closed_ledger(
+                        led, results
+                    ),
                 )
 
             self.overlay.accepted_hooks.append(_persist_async)
@@ -452,8 +452,13 @@ class Node:
             )
 
         def _fetch_fallback(h: bytes):
-            # history-cache miss -> rebuild from the NodeStore (consensus
+            # history-cache miss -> the in-flight close-pipeline entry
+            # (read-your-writes: a queued-but-unpersisted ledger must
+            # never miss), then rebuild from the NodeStore (consensus
             # promotion and peers must see everything persisted)
+            led = self.close_pipeline.get(h)
+            if led is not None:
+                return led
             try:
                 return Ledger.load(self.nodestore, h, hash_batch=self.hasher)
             except (KeyError, ValueError):
@@ -465,6 +470,9 @@ class Node:
 
         def _header_fetch(h: bytes):
             # LIGHT resolver for the reindex walk: header bytes only
+            led = self.close_pipeline.get(h)  # read-your-writes
+            if led is not None:
+                return led.seq, led.parent_hash
             obj = self.nodestore.fetch(h)
             if obj is None:
                 return None
@@ -475,6 +483,10 @@ class Node:
             return f["seq"], f["parent_hash"]
 
         self.ledger_master.header_fetch = _header_fetch
+        # close-path overlap seam: close_and_advance materializes the
+        # persist rows (Python meta/row tail) WHILE the seal tree-hash
+        # runs its GIL-releasing native/device batches on a helper thread
+        self.ledger_master.persist_prep = build_tx_rows
         self.ops = NetworkOPs(
             self.ledger_master,
             self.job_queue,
@@ -496,8 +508,17 @@ class Node:
             self.ops.master_lock = self.overlay.node.lock
             self.ops.relay_tx = self.overlay.broadcast_tx
             self.ops.local_push = self.overlay.node.local_txs.push_back
+        elif cfg.close_pipeline_enabled:
+            # standalone: the ledger-closed sink ENQUEUES — ledger N's
+            # NodeStore/txdb/CLF writes overlap ledger N+1's verify/apply
+            self.ops.on_ledger_closed.append(
+                lambda led, results: self.close_pipeline.submit_close(
+                    led, results
+                )
+            )
         else:
-            # standalone: persistence rides the ledger-closed sinks
+            # serial fallback ([close_pipeline] enabled=0): persistence
+            # rides the ledger-closed sink in-line, on the close path
             self.ops.on_ledger_closed.append(self._persist_closed_ledger)
 
         self.master_keys = KeyPair.from_passphrase(MASTER_PASSPHRASE)
@@ -683,6 +704,14 @@ class Node:
         self.collector.hook(
             "load", lambda: {"factor": self.fee_track.load_factor}
         )
+        self.collector.hook(
+            "close_pipeline",
+            lambda: {
+                "depth": self.close_pipeline.pending(),
+                "persisted": self.close_pipeline.persisted,
+                "backpressure_waits": self.close_pipeline.backpressure_waits,
+            },
+        )
         self.collector.start()
         return self
 
@@ -778,16 +807,9 @@ class Node:
             stop = getattr(self.overlay, "stop", None)
             if stop is not None:  # embedders may attach bare adapters
                 stop()
-        if hasattr(self, "_persist_q"):
-            self._persist_q.put(None)  # drain, then stop the persist worker
-            self._persist_thread.join(timeout=60)
-            if self._persist_thread.is_alive():
-                import logging
-
-                logging.getLogger("stellard.node").error(
-                    "shutdown with ~%d ledgers still unpersisted",
-                    self._persist_q.qsize(),
-                )
+        # drain-on-stop guarantee: everything queued persists before the
+        # stores close (the CLF pointer lands on the last closed ledger)
+        self.close_pipeline.stop(timeout=60)
         self.collector.stop()
         if self.sntp is not None:
             self.sntp.stop()
@@ -814,7 +836,12 @@ class Node:
     # -- persistence on close (reference: pendSaveValidated + CLF commit) --
 
     def _persist_closed_ledger(self, ledger: Ledger, results: dict) -> None:
+        """Serial (in-line) persist: the close-pipeline-disabled path and
+        embedders that drive persistence directly."""
         self.persist_ledger_data(ledger, results)
+        self._commit_clf(ledger)
+
+    def _commit_clf(self, ledger: Ledger) -> None:
         # CLF commit: one scoped SQL transaction — entry-row delta + LCL
         # pointer (reference: stellar::LedgerMaster::commitLedgerClose).
         # NOT part of persist_ledger_data: a repaired HISTORICAL ledger
@@ -822,32 +849,24 @@ class Node:
         prev = self.ledger_master.get_ledger_by_hash(ledger.parent_hash)
         self.clf.commit_ledger_close(ledger, prev)
 
+    def _persist_tx_rows(self, ledger: Ledger, results: dict) -> None:
+        """Header + tx rows in ONE sqlite transaction (close-pipeline txdb
+        stage). Rows were usually materialized at close time overlapped
+        with the seal tree-hash (LedgerMaster.persist_prep)."""
+        rows = getattr(ledger, "persist_rows", None)
+        if rows is None:
+            rows = build_tx_rows(ledger, results)
+        else:
+            # one-shot: the memo must not pin row data in the ledger
+            # cache for the ledger's whole cache lifetime
+            ledger.persist_rows = None
+        self.txdb.save_ledger(ledger, rows)
+
     def persist_ledger_data(self, ledger: Ledger, results: dict) -> None:
         """NodeStore + header + tx rows for one ledger (no CLF pointer) —
         the shared half of close-persistence and history repair."""
         ledger.save(self.nodestore)
-        self.txdb.save_ledger_header(ledger)
-        from ..protocol.meta import affected_accounts
-
-        rows = []
-        for txn_seq, (txid, blob, meta) in enumerate(ledger.tx_entries()):
-            tx = ledger.parse_tx(txid, blob)
-            meta_src = ledger.parsed_metas.get(txid, meta)
-            affected = affected_accounts(meta_src) if meta else [tx.account]
-            rows.append((
-                txid,
-                tx.tx_type.name,
-                tx.account,
-                tx.sequence,
-                ledger.seq,
-                _result_token(txid, results, meta),
-                blob,
-                meta,
-                affected,
-                txn_seq,
-            ))
-        with self.txdb.batch():
-            self.txdb.save_transactions(rows)
+        self._persist_tx_rows(ledger, results)
 
     # -- convenience driving (tests / CLI) --------------------------------
 
@@ -855,7 +874,19 @@ class Node:
         return self.ops.process_transaction(tx)
 
     def close_ledger(self):
-        return self.ops.accept_ledger()
+        """Test/CLI convenience close: synchronous-DURABLE — the close
+        pipeline drains before returning, so callers may immediately read
+        txdb/CLF state. The perf paths (bench legs, `ledger_accept` RPC,
+        networked consensus closes) call ops.accept_ledger directly and
+        stay pipelined."""
+        out = self.ops.accept_ledger()
+        if not self.close_pipeline.flush(timeout=60):
+            # the docstring's durability promise must not fail silently
+            raise RuntimeError(
+                "close_ledger: persistence pipeline failed to drain within "
+                "60s — storage stalled or wedged"
+            )
+        return out
 
     def tx_status(self, txid: bytes) -> Optional[TxStatus]:
         return self.ops.on_tx_result.get(txid)

@@ -91,29 +91,8 @@ class TxDatabase:
         dispatch was ~25% of the flood apply path). Each row is
         (txid, tx_type, account, seq, ledger_seq, status, raw, meta,
         affected_accounts, txn_seq)."""
-        tx_rows = []
-        del_rows = []
-        acct_rows = []
-        for (txid, tx_type, account, seq, ledger_seq, status, raw, meta,
-             affected, txn_seq) in rows:
-            h = txid.hex()
-            tx_rows.append((h, tx_type, account.hex(), seq, ledger_seq,
-                            status, raw, meta))
-            del_rows.append((h,))
-            for acct in affected:
-                acct_rows.append((h, acct.hex(), ledger_seq, txn_seq))
         with self._lock:
-            cur = self._conn.cursor()
-            cur.executemany(
-                "INSERT OR REPLACE INTO Transactions VALUES (?,?,?,?,?,?,?,?)",
-                tx_rows,
-            )
-            cur.executemany(
-                "DELETE FROM AccountTransactions WHERE TransID = ?", del_rows
-            )
-            cur.executemany(
-                "INSERT INTO AccountTransactions VALUES (?,?,?,?)", acct_rows
-            )
+            self._insert_tx_rows(rows)
             self._commit()
 
     def get_transaction(self, txid: bytes) -> Optional[dict]:
@@ -206,24 +185,69 @@ class TxDatabase:
             for r in rows
         ]
 
+    # -- whole-ledger persist (close-pipeline txdb stage) -----------------
+
+    def save_ledger(self, ledger, rows: list[tuple]) -> None:
+        """Header + all tx rows in ONE sqlite transaction (one fsync per
+        closed ledger instead of two, and a crash can never leave the
+        header stored without its rows). `rows` is save_transactions'
+        row shape, usually pre-materialized at close time."""
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO Ledgers VALUES (?,?,?,?,?,?,?,?,?,?)",
+                self._header_row(ledger),
+            )
+            self._insert_tx_rows(rows)
+            self._conn.commit()
+
+    @staticmethod
+    def _header_row(ledger) -> tuple:
+        return (
+            ledger.hash().hex(),
+            ledger.seq,
+            ledger.parent_hash.hex(),
+            ledger.tot_coins,
+            ledger.close_time,
+            ledger.parent_close_time,
+            ledger.close_resolution,
+            ledger.close_flags,
+            ledger.account_hash.hex(),
+            ledger.tx_hash.hex(),
+        )
+
+    def _insert_tx_rows(self, rows: list[tuple]) -> None:
+        """Three executemany calls over pre-built rows; caller holds the
+        lock and owns the commit."""
+        tx_rows = []
+        del_rows = []
+        acct_rows = []
+        for (txid, tx_type, account, seq, ledger_seq, status, raw, meta,
+             affected, txn_seq) in rows:
+            h = txid.hex()
+            tx_rows.append((h, tx_type, account.hex(), seq, ledger_seq,
+                            status, raw, meta))
+            del_rows.append((h,))
+            for acct in affected:
+                acct_rows.append((h, acct.hex(), ledger_seq, txn_seq))
+        cur = self._conn.cursor()
+        cur.executemany(
+            "INSERT OR REPLACE INTO Transactions VALUES (?,?,?,?,?,?,?,?)",
+            tx_rows,
+        )
+        cur.executemany(
+            "DELETE FROM AccountTransactions WHERE TransID = ?", del_rows
+        )
+        cur.executemany(
+            "INSERT INTO AccountTransactions VALUES (?,?,?,?)", acct_rows
+        )
+
     # -- ledger headers ---------------------------------------------------
 
     def save_ledger_header(self, ledger) -> None:
         with self._lock:
             self._conn.execute(
                 "INSERT OR REPLACE INTO Ledgers VALUES (?,?,?,?,?,?,?,?,?,?)",
-                (
-                    ledger.hash().hex(),
-                    ledger.seq,
-                    ledger.parent_hash.hex(),
-                    ledger.tot_coins,
-                    ledger.close_time,
-                    ledger.parent_close_time,
-                    ledger.close_resolution,
-                    ledger.close_flags,
-                    ledger.account_hash.hex(),
-                    ledger.tx_hash.hex(),
-                ),
+                self._header_row(ledger),
             )
             self._commit()
 

@@ -89,6 +89,76 @@ def pt_decompress(data: bytes):
     return (x, y % P, 1, (x * (y % P)) % P)
 
 
+# -- fixed-base path (key derivation + signing) -----------------------------
+#
+# RFC 8032 key derivation and signing, so the pure-Python path is
+# byte-identical with the host library (cryptography / libsodium):
+# a = clamp(SHA512(seed)[:32]), A = [a]B, r = SHA512(prefix || M) mod l,
+# R = [r]B, S = (r + SHA512(R||A||M)·a) mod l. Base-point multiples are
+# comb-precomputed (64 radix-16 windows) so a sign is ~64 point adds
+# instead of a full double-and-add ladder.
+
+_BASE_COMB: list | None = None
+
+
+def _base_comb() -> list:
+    """[window][digit] -> [digit * 16^window]B (digit 0 = identity)."""
+    global _BASE_COMB
+    if _BASE_COMB is None:
+        comb = []
+        step = BASE
+        for _ in range(64):
+            row = [IDENTITY]
+            for _d in range(15):
+                row.append(pt_add(row[-1], step))
+            comb.append(row)
+            step = pt_add(row[-1], step)  # 16^(w+1) * B
+        _BASE_COMB = comb
+    return _BASE_COMB
+
+
+def scalar_mult_base(s: int):
+    """[s]B via the fixed-base comb (≈64 adds; sign/derive hot path)."""
+    comb = _base_comb()
+    q = IDENTITY
+    for w in range(64):
+        d = (s >> (4 * w)) & 0xF
+        if d:
+            q = pt_add(q, comb[w][d])
+    return q
+
+
+def _clamp(h32: bytes) -> int:
+    a = int.from_bytes(h32, "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a
+
+
+def secret_expand(seed: bytes) -> tuple[int, bytes]:
+    """seed -> (clamped secret scalar, 32-byte nonce prefix)."""
+    h = hashlib.sha512(seed).digest()
+    return _clamp(h[:32]), h[32:]
+
+
+def derive_public(seed: bytes) -> bytes:
+    """crypto_sign_seed_keypair's public half: encode([clamp(h)]B)."""
+    a, _ = secret_expand(seed)
+    return pt_encode(scalar_mult_base(a))
+
+
+def sign(seed: bytes, public: bytes, msg: bytes) -> bytes:
+    """Detached RFC 8032 signature (byte-identical with the host lib)."""
+    a, prefix = secret_expand(seed)
+    r = int.from_bytes(hashlib.sha512(prefix + msg).digest(), "little") % L
+    r_bytes = pt_encode(scalar_mult_base(r))
+    h = int.from_bytes(
+        hashlib.sha512(r_bytes + public + msg).digest(), "little"
+    ) % L
+    s = (r + h * a) % L
+    return r_bytes + s.to_bytes(32, "little")
+
+
 def verify(public: bytes, msg: bytes, sig: bytes) -> bool:
     if len(public) != 32 or len(sig) != 64:
         return False

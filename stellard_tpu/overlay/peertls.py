@@ -50,6 +50,36 @@ def make_door_ssl_context(
     return ctx
 
 
+def _openssl_cli_cert(cert_path: str, key_path: str) -> tuple[str, str]:
+    """Cert generation without the `cryptography` wheel: the ubiquitous
+    openssl(1) binary emits the same throwaway self-signed EC transport
+    cert. Only reached when the wheel is absent (see ensure_node_cert)."""
+    import shutil
+    import subprocess
+
+    if shutil.which("openssl") is None:
+        raise RuntimeError(
+            "peer/door TLS needs a certificate but neither the "
+            "`cryptography` wheel (pip install stellard-tpu[crypto]) nor "
+            "an openssl(1) binary is available"
+        )
+    # 0o600 on the key from birth: pre-create it and have openssl write
+    # into the existing file
+    fd = os.open(key_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    os.close(fd)
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "ec",
+            "-pkeyopt", "ec_paramgen_curve:prime256v1",
+            "-keyout", key_path, "-out", cert_path,
+            "-days", "3650", "-nodes",
+            "-subj", "/CN=stellard-tpu-peer",
+        ],
+        check=True, capture_output=True,
+    )
+    return cert_path, key_path
+
+
 def ensure_node_cert(state_dir: str) -> tuple[str, str]:
     """Return (cert_path, key_path), generating a self-signed EC cert on
     first use. The cert is a transport artifact only — peers never verify
@@ -60,10 +90,13 @@ def ensure_node_cert(state_dir: str) -> tuple[str, str]:
     if os.path.exists(cert_path) and os.path.exists(key_path):
         return cert_path, key_path
 
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.x509.oid import NameOID
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.x509.oid import NameOID
+    except ImportError:
+        return _openssl_cli_cert(cert_path, key_path)
 
     key = ec.generate_private_key(ec.SECP256R1())
     name = x509.Name(

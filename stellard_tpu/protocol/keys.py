@@ -18,12 +18,23 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass
 
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
-from cryptography.exceptions import InvalidSignature
+# `cryptography` is an OPTIONAL accelerator: when the wheel is absent the
+# same operations run on the pure-Python RFC 8032 reference
+# (ops/ed25519_ref — byte-identical keys and signatures) with single-sig
+# verification preferring the native C++ batch kernel when the toolchain
+# can build it. Nothing in the protocol plane may hard-require the wheel:
+# it is an extra in pyproject ("crypto"), not a dependency.
+try:  # pragma: no cover - exercised by whichever env runs the suite
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover
+    HAVE_CRYPTOGRAPHY = False
 
 from ..utils.base58 import b58check_decode, b58check_encode
 from ..utils.hashes import hash160, sha512_half
@@ -92,6 +103,33 @@ def signature_is_canonical(sig: bytes) -> bool:
     return int.from_bytes(sig[32:], "little") < ED25519_L
 
 
+# -- pure-Python fallback plumbing (no `cryptography` wheel) ----------------
+
+_FALLBACK_VERIFY = None  # resolved once: native batch kernel or ref.verify
+
+
+def _fallback_verify_fn():
+    """Single-signature verifier for the no-wheel path: the native C++
+    batch kernel when the toolchain is present (a batch of one), else
+    the pure-Python reference. Resolved once per process."""
+    global _FALLBACK_VERIFY
+    if _FALLBACK_VERIFY is None:
+        try:
+            from ..native import Ed25519NativeVerify
+
+            impl = Ed25519NativeVerify()
+
+            def _native_one(public, msg, sig):
+                return bool(impl.verify_batch([public], [msg], [sig])[0])
+
+            _FALLBACK_VERIFY = _native_one
+        except Exception:  # noqa: BLE001 — toolchain-less box: pure Python
+            from ..ops import ed25519_ref
+
+            _FALLBACK_VERIFY = ed25519_ref.verify
+    return _FALLBACK_VERIFY
+
+
 @dataclass(frozen=True)
 class KeyPair:
     """Ed25519 seed keypair."""
@@ -103,10 +141,15 @@ class KeyPair:
     def from_seed(cls, seed: bytes) -> "KeyPair":
         if len(seed) != 32:
             raise ValueError("seed must be 32 bytes")
-        priv = Ed25519PrivateKey.from_private_bytes(seed)
-        pub = priv.public_key().public_bytes(
-            serialization.Encoding.Raw, serialization.PublicFormat.Raw
-        )
+        if HAVE_CRYPTOGRAPHY:
+            priv = Ed25519PrivateKey.from_private_bytes(seed)
+            pub = priv.public_key().public_bytes(
+                serialization.Encoding.Raw, serialization.PublicFormat.Raw
+            )
+        else:
+            from ..ops.ed25519_ref import derive_public
+
+            pub = derive_public(seed)
         return cls(seed, pub)
 
     @classmethod
@@ -142,7 +185,13 @@ class KeyPair:
         (reference RippleAddress::sign -> crypto_sign_detached)."""
         if len(signing_hash) != 32:
             raise ValueError("signing hash must be 32 bytes")
-        return Ed25519PrivateKey.from_private_bytes(self.seed).sign(signing_hash)
+        if HAVE_CRYPTOGRAPHY:
+            return Ed25519PrivateKey.from_private_bytes(self.seed).sign(
+                signing_hash
+            )
+        from ..ops.ed25519_ref import sign as ref_sign
+
+        return ref_sign(self.seed, self.public, signing_hash)
 
 
 def verify_signature(public: bytes, signing_hash: bytes, sig: bytes) -> bool:
@@ -152,6 +201,8 @@ def verify_signature(public: bytes, signing_hash: bytes, sig: bytes) -> bool:
         return False
     if not signature_is_canonical(sig):
         return False
+    if not HAVE_CRYPTOGRAPHY:
+        return bool(_fallback_verify_fn()(public, signing_hash, sig))
     try:
         Ed25519PublicKey.from_public_bytes(public).verify(sig, signing_hash)
         return True

@@ -147,6 +147,12 @@ def _select_ledger(ctx: Context) -> Ledger:
     if isinstance(idx, int) or (isinstance(idx, str) and idx.isdigit()):
         led = lm.get_ledger_by_seq(int(idx))
         if led is None:
+            # read-your-writes: a closed-but-not-yet-persisted ledger
+            # resolves from its in-flight close-pipeline entry
+            pipeline = getattr(ctx.node, "close_pipeline", None)
+            if pipeline is not None:
+                led = pipeline.get_by_seq(int(idx))
+        if led is None:
             hdr = ctx.node.txdb.get_ledger_header(seq=int(idx))
             if hdr is not None:
                 led = _load_historical(ctx, hdr["hash"])
@@ -302,15 +308,19 @@ def _complete_ledgers(node) -> str:
 @handler("server_state")
 def do_server_state(ctx: Context) -> dict:
     node = ctx.node
-    return {
-        "state": {
-            "server_state": node.ops.server_state(),
-            "complete_ledgers": _complete_ledgers(node),
-            "peers": 0,
-            "load_base": 256,
-            "load_factor": node.fee_track.load_factor,
-        }
+    state = {
+        "server_state": node.ops.server_state(),
+        "complete_ledgers": _complete_ledgers(node),
+        "peers": 0,
+        "load_base": 256,
+        "load_factor": node.fee_track.load_factor,
     }
+    pipeline = getattr(node, "close_pipeline", None)
+    if pipeline is not None:
+        # per-stage latency histograms + queue-depth gauges for the
+        # ledger-close persistence pipeline
+        state["close_pipeline"] = pipeline.get_json()
+    return {"state": state}
 
 
 @handler("get_counts", Role.ADMIN)
@@ -329,12 +339,13 @@ def do_get_counts(ctx: Context) -> dict:
             "target_size": hist.target_size,
         },
     }
+    pipeline = getattr(node, "close_pipeline", None)
+    if pipeline is not None:
+        out["close_pipeline"] = pipeline.get_json()
+        out["persist_backlog"] = pipeline.pending()
     overlay = getattr(node, "overlay", None)
     if overlay is not None:
         out["peers"] = overlay.peer_count()
-        q = getattr(node, "_persist_q", None)
-        if q is not None:
-            out["persist_backlog"] = q.qsize()
     return out
 
 
@@ -587,9 +598,17 @@ def do_tx(ctx: Context) -> dict:
     h = ctx.params.get("transaction")
     if not h:
         raise RPCError("invalidParams", "missing transaction")
-    row = ctx.node.txdb.get_transaction(bytes.fromhex(h))
+    txid = bytes.fromhex(h)
+    row = ctx.node.txdb.get_transaction(txid)
     if row is None:
-        raise RPCError("txnNotFound")
+        # read-your-writes: the tx may live in a closed ledger still
+        # queued in the close pipeline (persisted momentarily)
+        pipeline = getattr(ctx.node, "close_pipeline", None)
+        found = pipeline.lookup_tx(txid) if pipeline is not None else None
+        if found is None:
+            raise RPCError("txnNotFound")
+        led, blob, meta, _results = found
+        row = {"raw": blob, "meta": meta, "ledger_seq": led.seq}
     tx = SerializedTransaction.from_bytes(row["raw"])
     out = tx.obj.to_json()
     out["hash"] = h.upper()
@@ -602,6 +621,7 @@ def do_tx(ctx: Context) -> dict:
 
 @handler("tx_history")
 def do_tx_history(ctx: Context) -> dict:
+    _await_history(ctx)
     start = int(ctx.params.get("start", 0))
     rows = ctx.node.txdb.tx_history(start=start, limit=20)
     txs = []
@@ -726,9 +746,24 @@ def do_account_offers(ctx: Context) -> dict:
     return out
 
 
+def _await_history(ctx: Context) -> None:
+    """Read-your-writes for the SQL-index RPCs: a just-closed ledger may
+    still be queued in the close pipeline; wait (bounded) for the CLOSE
+    entries pending at call time so history queries never miss a tx
+    already reported COMMITTED. Repairs and later-arriving closes are
+    excluded — a cleaner backfill must not add latency here — and the
+    queue is almost always empty or one deep, so this is microseconds in
+    the common case. Pagination/marker semantics stay untouched; on
+    timeout (storage stalled) the query proceeds over what is stored."""
+    pipeline = getattr(ctx.node, "close_pipeline", None)
+    if pipeline is not None:
+        pipeline.wait_for_closes(timeout=10)
+
+
 @handler("account_tx")
 def do_account_tx(ctx: Context) -> dict:
     """reference: handlers/AccountTx.cpp over the SQL index."""
+    _await_history(ctx)
     account_id = _parse_account(ctx.params)
     p = ctx.params
     min_l = int(p.get("ledger_index_min", -1))

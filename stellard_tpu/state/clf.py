@@ -254,10 +254,21 @@ class CLFMirror:
         delta = new_ledger.state_map.compare(prev_ledger.state_map)
         with self.db.transaction():
             for tag, (new_item, old_item) in delta.items():
+                # the engine pinned a parsed mirror on every item it
+                # wrote (Ledger.write_entry); reuse it — re-parsing every
+                # changed entry was the commit's dominant Python cost,
+                # and on the close-pipeline worker it stole GIL time
+                # from the next ledger's apply
                 if new_item is not None:
-                    self.db.store_entry(tag, STObject.from_bytes(new_item.data))
+                    sle = new_item.parsed
+                    if sle is None:
+                        sle = STObject.from_bytes(new_item.data)
+                    self.db.store_entry(tag, sle)
                 elif old_item is not None:
-                    self.db.delete_entry(tag, STObject.from_bytes(old_item.data))
+                    sle = old_item.parsed
+                    if sle is None:
+                        sle = STObject.from_bytes(old_item.data)
+                    self.db.delete_entry(tag, sle)
             self._write_lcl_state(new_ledger)
         self.commits += 1
 
@@ -267,7 +278,10 @@ class CLFMirror:
         with self.db.transaction():
             self.db.drop_all_entries()
             for item in ledger.state_map.items():
-                self.db.store_entry(item.tag, STObject.from_bytes(item.data))
+                sle = item.parsed
+                if sle is None:
+                    sle = STObject.from_bytes(item.data)
+                self.db.store_entry(item.tag, sle)
             self._write_lcl_state(ledger)
         self.full_imports += 1
 

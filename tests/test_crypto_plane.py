@@ -131,6 +131,7 @@ class TestWireFormats:
         dev = np.asarray(ej.expand_h_digits(b))
         assert np.array_equal(host, dev)
 
+    @pytest.mark.slow  # ~1+ min wall clock (both wire kernels compile)
     def test_raw_and_digit_wires_agree(self, monkeypatch):
         from stellard_tpu.ops import ed25519_jax as ej
 

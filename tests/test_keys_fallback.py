@@ -1,0 +1,88 @@
+"""The optional-`cryptography` seam: the pure-Python RFC 8032 path must
+be byte-identical with the host library, because a box without the
+wheel derives keys and signs with it (protocol/keys.py falls back to
+ops/ed25519_ref). Pinned against the RFC 8032 test vectors so the
+fallback stays covered even on boxes WITH the wheel installed."""
+
+import pytest
+
+from stellard_tpu.ops import ed25519_ref as ref
+from stellard_tpu.protocol.keys import (
+    HAVE_CRYPTOGRAPHY,
+    KeyPair,
+    verify_signature,
+)
+
+# RFC 8032 §7.1 test vectors (seed, public, message, signature)
+VECTORS = [
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+]
+
+
+class TestRfc8032Vectors:
+    @pytest.mark.parametrize("seed,public,msg,sig", VECTORS)
+    def test_derive_sign_verify(self, seed, public, msg, sig):
+        seed_b = bytes.fromhex(seed)
+        pub_b = bytes.fromhex(public)
+        msg_b = bytes.fromhex(msg)
+        sig_b = bytes.fromhex(sig)
+        assert ref.derive_public(seed_b) == pub_b
+        assert ref.sign(seed_b, pub_b, msg_b) == sig_b
+        assert ref.verify(pub_b, msg_b, sig_b)
+        assert not ref.verify(pub_b, msg_b + b"x", sig_b)
+
+    def test_fixed_base_comb_matches_ladder(self):
+        # the comb-accelerated [s]B must equal the bit-serial ladder
+        for s in (1, 2, 7, ref.L - 1, 0x1234567890ABCDEF):
+            assert ref.pt_encode(ref.scalar_mult_base(s)) == ref.pt_encode(
+                ref.scalar_mult(s, ref.BASE)
+            )
+
+
+class TestKeyPairSeam:
+    def test_keypair_round_trip_is_self_consistent(self):
+        kp = KeyPair.from_passphrase("fallback-seam")
+        h = b"\x42" * 32
+        sig = kp.sign(h)
+        assert verify_signature(kp.public, h, sig)
+        assert not verify_signature(kp.public, b"\x43" * 32, sig)
+
+    def test_keypair_matches_reference_implementation(self):
+        # whichever backend KeyPair uses, it must match the pure-Python
+        # reference byte-for-byte (both claim RFC 8032)
+        kp = KeyPair.from_passphrase("fallback-seam")
+        assert kp.public == ref.derive_public(kp.seed)
+        h = b"\x42" * 32
+        assert kp.sign(h) == ref.sign(kp.seed, kp.public, h)
+
+    @pytest.mark.skipif(
+        not HAVE_CRYPTOGRAPHY, reason="cryptography wheel not installed"
+    )
+    def test_wheel_path_in_use_when_available(self):
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey,
+        )
+
+        kp = KeyPair.from_passphrase("fallback-seam")
+        ind = Ed25519PrivateKey.from_private_bytes(kp.seed)
+        assert ind.public_key().public_bytes_raw() == kp.public

@@ -119,6 +119,7 @@ class TestMeshVerifier:
         assert int(total) == int(want.sum())
         assert np.array_equal(np.asarray(flags), want)
 
+    @pytest.mark.slow  # ~1.5 min wall clock on the CI box
     def test_verifyplane_uses_meshed_verifier(self):
         from stellard_tpu.node.verifyplane import VerifyPlane
 

@@ -215,14 +215,12 @@ class TestNativeEd25519Verify:
         msgs = [b"", b"x", b"y" * 100, b"z" * 1000]
         sigs = [k.sign_raw(m) if hasattr(k, "sign_raw") else None for m in msgs]
         if sigs[0] is None:
-            # KeyPair.sign requires 32-byte hashes; sign via the raw
-            # primitive to cover non-32-byte message lengths
-            from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-                Ed25519PrivateKey,
-            )
+            # KeyPair.sign requires 32-byte hashes; sign via the pure-
+            # Python RFC 8032 reference (independent of the native C++
+            # verifier under test) to cover non-32-byte message lengths
+            from stellard_tpu.ops.ed25519_ref import sign as ref_sign
 
-            priv = Ed25519PrivateKey.from_private_bytes(k.seed)
-            sigs = [priv.sign(m) for m in msgs]
+            sigs = [ref_sign(k.seed, k.public, m) for m in msgs]
         got = Ed25519NativeVerify().verify_batch(
             [k.public] * 4, msgs, sigs
         )

@@ -18,6 +18,13 @@ import os
 import subprocess
 import sys
 
+import pytest
+
+# wall-clock-heavy (each case compiles + runs the full kernel in a
+# subprocess, ~3 min apiece on the CI box): excluded from the tier-1
+# `-m 'not slow'` gate; plain `pytest tests/` still runs the corpus
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _CASE_RUNNER = r'''
