@@ -1,0 +1,232 @@
+"""Speculative delta-replay for the ledger close.
+
+Every accepted transaction used to run twice: a checks-only pass against
+the open ledger at submit, then the full transactor again inside the
+close window (LedgerConsensus::applyTransactions). PERF.md r5/r6 shows
+that close apply pass is the dominant serial cost of a close. The
+Block-STM answer (Gelashvili et al., 2022; Solana's Sealevel is the same
+idea): execute speculatively once, record read/write sets, and at commit
+time VALIDATE the reads instead of re-executing.
+
+Shape here:
+
+- submit time (``SpecState.speculate``, called by LedgerMaster after the
+  open-ledger accept): run the tx once in CLOSE mode against a
+  state/specview.SpecView — the parent state plus all earlier
+  speculative writes, which is exactly the state the serial close would
+  present when the canonical order matches the submission order. Record
+  reads (key -> writer id), succ walks, the final write set, the built
+  metadata, and both the raw transactor TER and the post-claim TER.
+
+- close time (``CloseReplay.try_splice``, consulted by
+  LedgerMaster._apply_transactions before each full apply): a record
+  whose parent matches, whose entry reads all resolve to the same
+  writers in the close's own writer map, and whose succ reads reproduce
+  against the closing state map is SPLICED — recorded SLEs written
+  straight into the ledger, metadata re-indexed and inserted, fee
+  burned — with no transactor run. Any mismatch falls back to the full
+  serial re-apply for that tx, which then poisons its written keys so
+  dependent records also fall back. The serial path stays byte-identical
+  and always available ([close] delta_replay=0).
+
+Pass semantics mirror applyTransactions exactly: on non-final (RETRY)
+passes a tec record defers (reports the raw tec, no state change, gets
+requeued) because the serial path only claims fees on the final pass —
+splicing the claim early would renumber TransactionIndex for every later
+tx and break byte identity.
+
+Transaction types that read or write ledger-header state the read set
+cannot see (SetFee, EnableAmendment, Inflation) are never speculated,
+and their close-time application marks the whole replay header-dirty so
+every later record falls back too.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..protocol.formats import TxType
+from ..protocol.sfields import sfTransactionIndex
+from ..protocol.sttx import SerializedTransaction
+from ..state.entryset import Action
+from ..state.ledger import Ledger
+from ..state.specview import PARENT, SpecView
+from .engine import TransactionEngine, TxParams, _is_tec
+
+__all__ = ["SpecState", "CloseReplay", "HEADER_TYPES"]
+
+log = logging.getLogger("stellard.deltareplay")
+
+# header-coupled types: excluded from speculation, and close-time
+# application of one dirties the replay (fee/reserve schedule and
+# inflation header state are invisible to the entry read set)
+HEADER_TYPES = frozenset(
+    {TxType.ttFEE, TxType.ttAMENDMENT, TxType.ttINFLATION}
+)
+
+
+class SpecRecord:
+    __slots__ = (
+        "raw_ter", "ter", "did_apply", "reads", "succs", "writes",
+        "meta", "fee",
+    )
+
+    def __init__(self, raw_ter, ter, did_apply, reads, succs, writes,
+                 meta, fee):
+        self.raw_ter = raw_ter  # transactor outcome, pre fee-claim
+        self.ter = ter  # final outcome (post claim reprocess)
+        self.did_apply = did_apply
+        self.reads = reads  # key -> writer id (txid or PARENT)
+        self.succs = succs  # [(cursor, next key or None)]
+        self.writes = writes  # [(key, SLE or None=delete)] in apply order
+        self.meta = meta  # threaded meta STObject (tes/claim), else None
+        self.fee = fee  # drops burned when did_apply
+
+
+class SpecState:
+    """Per-open-ledger speculation: the shared overlay view plus one
+    record per open-accepted txid. Consumed by at most one close."""
+
+    def __init__(self, ledger: Ledger):
+        self.parent_hash = ledger.parent_hash
+        self.view = SpecView(ledger)
+        self.records: dict[bytes, SpecRecord] = {}
+        self.disabled = False  # poisoned overlay -> all-fallback close
+
+    def speculate(self, tx: SerializedTransaction) -> None:
+        """Close-mode dry run of an open-accepted tx; records the outcome
+        and folds its writes into the overlay for successors."""
+        if self.disabled or tx.tx_type in HEADER_TYPES:
+            return
+        txid = tx.txid()
+        self.view.begin_tx(txid)
+        try:
+            engine = TransactionEngine(self.view)
+            ter, did_apply = engine.apply_transaction(tx, TxParams.NONE)
+            reads, succs, writes = self.view.end_tx()
+            meta = self.view.parsed_metas.pop(txid, None)
+            if did_apply and meta is None:
+                return  # commit tail didn't complete; keep no record
+            self.records[txid] = SpecRecord(
+                raw_ter=engine.last_raw_ter if engine.last_raw_ter
+                is not None else ter,
+                ter=ter,
+                did_apply=did_apply,
+                reads=reads,
+                succs=succs,
+                writes=writes,
+                meta=meta,
+                fee=tx.fee.mantissa if did_apply else 0,
+            )
+        except Exception:  # noqa: BLE001 — a half-applied overlay can't
+            # be trusted for ANY later record; the close falls back whole
+            log.exception(
+                "speculation failed for %s; disabling delta replay for "
+                "this ledger", txid.hex()[:16],
+            )
+            self.disabled = True
+
+
+class CloseReplay:
+    """One close's splice-or-fallback context over a SpecState."""
+
+    def __init__(self, spec: Optional[SpecState], ledger: Ledger):
+        self.spec = spec
+        self.ledger = ledger
+        self.parent_ok = (
+            spec is not None
+            and not spec.disabled
+            and spec.parent_hash == ledger.parent_hash
+        )
+        # key -> provenance: txid for spliced writers, a unique non-txid
+        # marker for fallback writers (their values may differ from the
+        # speculative run, so they must never validate a recorded read)
+        self.writers: dict[bytes, object] = {}
+        self.header_dirty = False
+        self._dirty_seq = 0
+        # per-TX final classification (a retried tx may be attempted on
+        # several passes — the last attempt's outcome wins, so
+        # spliced+fallback always sums to the distinct tx count)
+        self._class: dict[bytes, str] = {}
+        self.invalidated = 0  # validation failures, counted PER ATTEMPT
+        # (a retried record re-validates each pass; the churn is the
+        # diagnostic, so attempts are the honest unit here)
+
+    def try_splice(self, engine: TransactionEngine,
+                   tx: SerializedTransaction, final: bool):
+        """-> (ter, did_apply) when the recorded outcome stands in for
+        this pass, else None (caller runs the full serial apply)."""
+        if not self.parent_ok or self.header_dirty:
+            return None
+        txid = tx.txid()
+        rec = self.spec.records.get(txid)
+        if rec is None:
+            return None
+        writers = self.writers
+        for k, wid in rec.reads.items():
+            if writers.get(k, PARENT) != wid:
+                self.invalidated += 1
+                return None
+        st = self.ledger.state_map
+        for cursor, tag in rec.succs:
+            item = st.succ(cursor)
+            if (item.tag if item is not None else None) != tag:
+                self.invalidated += 1
+                return None
+
+        if not rec.did_apply:
+            # no state effect either way; on non-final passes the serial
+            # path reports the RAW tec (the claim only runs under NONE)
+            self._class[txid] = "spliced"
+            ter = rec.raw_ter if not final and _is_tec(rec.raw_ter) else rec.ter
+            return ter, False
+        if not final and _is_tec(rec.raw_ter):
+            # defer the recorded fee claim to final-pass semantics, like
+            # the serial path; the caller's tec branch requeues it
+            self._class[txid] = "spliced"
+            return rec.raw_ter, False
+
+        ledger = self.ledger
+        meta = rec.meta
+        meta[sfTransactionIndex] = engine.tx_seq
+        engine.tx_seq += 1
+        ledger.add_transaction(tx.serialize(), meta.serialize())
+        ledger.parsed_metas[txid] = meta
+        ledger.tot_coins -= rec.fee
+        ledger.fee_pool += rec.fee
+        for k, sle in rec.writes:
+            if sle is None:
+                ledger.delete_entry(k)
+            else:
+                ledger.write_entry(k, sle)
+            writers[k] = txid
+        self._class[txid] = "spliced"
+        return rec.ter, True
+
+    def note_fallback(self, tx: SerializedTransaction,
+                      engine: TransactionEngine, did_apply: bool) -> None:
+        """A full serial apply ran: poison its written keys so records
+        that read them can never splice against diverged values."""
+        self._class[tx.txid()] = "fallback"
+        if not did_apply:
+            return
+        if tx.tx_type in HEADER_TYPES:
+            self.header_dirty = True
+        les = engine.les
+        if les is None:
+            return
+        self._dirty_seq += 1
+        marker = ("fallback", self._dirty_seq)
+        for idx, _sle, action in les.entries():
+            if action != Action.CACHED:
+                self.writers[idx] = marker
+
+    def counts(self) -> dict:
+        cls = self._class.values()
+        return {
+            "spliced": sum(1 for c in cls if c == "spliced"),
+            "fallback": sum(1 for c in cls if c == "fallback"),
+            "invalidated": self.invalidated,
+            "parent_ok": self.parent_ok,
+        }

@@ -46,6 +46,10 @@ class TransactionEngine:
         self.ledger = ledger
         self.les: LedgerEntrySet | None = None
         self.tx_seq = 0  # metadata TransactionIndex within the closing ledger
+        # raw transactor outcome of the last apply, BEFORE the tec
+        # claim-fee reprocess may replace it — the delta-replay close
+        # needs it to mirror non-final-pass (RETRY) semantics exactly
+        self.last_raw_ter: TER | None = None
 
     def apply_transaction(
         self, tx: SerializedTransaction, params: TxParams
@@ -83,6 +87,7 @@ class TransactionEngine:
             return TER.temUNKNOWN, False
 
         ter = transactor.apply()
+        self.last_raw_ter = ter
         did_apply = False
 
         if ter == TER.tesSUCCESS:
@@ -126,8 +131,7 @@ class TransactionEngine:
             else:
                 meta = self.les.calc_meta(ter, self.tx_seq, self.ledger.seq, tx.txid())
                 self.tx_seq += 1
-                self.ledger.add_transaction(blob, meta.serialize())
-                self.ledger.parsed_metas[tx.txid()] = meta
+                self.ledger.record_transaction(blob, meta)
                 # deferred header mutations (Inflation/SetFee), applied
                 # only now that the invariant gate has passed
                 hc = getattr(transactor, "header_changes", {})

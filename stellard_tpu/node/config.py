@@ -83,6 +83,15 @@ class Config:
     close_pipeline_enabled: bool = True
     close_pipeline_depth: int = 8
 
+    # -- ledger close ([close]) --------------------------------------------
+    # delta_replay=1: the open-ledger accept also executes the tx once in
+    # close mode against a speculative overlay, recording its read/write
+    # sets; the close then splices recorded deltas whose reads still
+    # validate instead of re-running the transactor, falling back to the
+    # full serial apply per tx on any conflict (engine/deltareplay.py).
+    # delta_replay=0 is the always-available serial path.
+    close_delta_replay: bool = True
+
     # -- network identity / trust ([validation_seed], [validators]) --------
     validation_seed: str = ""  # base58 seed; empty = not a validator
     validators: list[str] = field(default_factory=list)  # node public keys
@@ -181,6 +190,11 @@ class Config:
             )
         if "depth" in cp:
             cfg.close_pipeline_depth = int(cp["depth"])
+        close = _kv(s.get("close", []))
+        if "delta_replay" in close:
+            cfg.close_delta_replay = close["delta_replay"].lower() not in (
+                "0", "false", "no", "off"
+            )
 
         cfg.validation_seed = one("validation_seed", cfg.validation_seed)
         cfg.sntp_servers = [line.split()[0] for line in s.get("sntp_servers", [])]

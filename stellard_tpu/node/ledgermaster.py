@@ -13,6 +13,8 @@ applied.
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
 from typing import Callable, Optional
 
 from ..engine.engine import TransactionEngine, TxParams
@@ -97,6 +99,17 @@ class LedgerMaster:
         # when set, the close overlaps this Python tail with the seal
         # tree-hash, whose native/device batches release the GIL
         self.persist_prep: Optional[Callable[[Ledger, dict], list]] = None
+        # speculative delta-replay close ([close] delta_replay): the
+        # open-ledger accept also runs the tx once in close mode against
+        # a SpecView, and the close splices the recorded delta when the
+        # read set still validates (engine/deltareplay.py)
+        self.delta_replay = True
+        self.delta_stats = {
+            "closes": 0, "spliced": 0, "fallback": 0, "invalidated": 0,
+        }
+        self.last_close: dict = {}
+        # per-close stage latencies (ms): apply pass, seal overlap, total
+        self.close_stage_ms: deque = deque(maxlen=256)
 
     # -- bootstrap --------------------------------------------------------
 
@@ -171,18 +184,37 @@ class LedgerMaster:
 
     def do_transaction(self, tx: SerializedTransaction, params: TxParams) -> tuple[TER, bool]:
         with self._lock:
-            open_ledger = self.current_ledger()
-            engine = TransactionEngine(open_ledger)
-            ter, applied = engine.apply_transaction(tx, params)
-            if applied:
-                # seed the OPEN ledger's parsed-tx memo so the close path
-                # reuses this exact object instead of re-parsing the blob
-                # (txid is the blob's content hash). Ownership contract: a
-                # submitted tx belongs to the node FOREVER — the object
-                # escapes into the closed ledger's parsed_txs and is served
-                # from history caches — so callers must never mutate it.
-                open_ledger.parsed_txs[tx.txid()] = tx
-            return ter, applied
+            return self._open_apply(tx, params)
+
+    def _open_apply(self, tx: SerializedTransaction,
+                    params: TxParams) -> tuple[TER, bool]:
+        """Apply to the open ledger; on accept, seed the parsed-tx memo
+        and run the speculative close-mode execution. Caller holds the
+        lock."""
+        open_ledger = self.current_ledger()
+        engine = TransactionEngine(open_ledger)
+        ter, applied = engine.apply_transaction(tx, params)
+        if applied:
+            # seed the OPEN ledger's parsed-tx memo so the close path
+            # reuses this exact object instead of re-parsing the blob
+            # (txid is the blob's content hash). Ownership contract: a
+            # submitted tx belongs to the node FOREVER — the object
+            # escapes into the closed ledger's parsed_txs and is served
+            # from history caches — so callers must never mutate it.
+            open_ledger.parsed_txs[tx.txid()] = tx
+            # speculate only for OPEN-mode accepts: the open window
+            # never mutates ledger state, which is the invariant that
+            # makes the SpecView's parent reads equal to the state the
+            # close will start from (a close-mode apply through this
+            # path would break it)
+            if self.delta_replay and (int(params) & int(TxParams.OPEN_LEDGER)):
+                spec = getattr(open_ledger, "_spec_state", None)
+                if spec is None:
+                    from ..engine.deltareplay import SpecState
+
+                    spec = open_ledger._spec_state = SpecState(open_ledger)
+                spec.speculate(tx)
+        return ter, applied
 
     # -- close (standalone / consensus-accept share this tail) ------------
 
@@ -256,6 +288,7 @@ class LedgerMaster:
         Returns (new closed ledger, per-txid results).
         """
         with self._lock:
+            t0 = time.perf_counter()
             prev = self.closed_ledger()
             open_ledger = self.current_ledger()
 
@@ -269,9 +302,15 @@ class LedgerMaster:
             for tx in extra_txs or []:
                 txset.insert(tx)
 
-            # 2. successor of the LCL; apply with retry passes
+            # 2. successor of the LCL; apply with retry passes, splicing
+            # speculative deltas where the open pass's records validate
             new_lcl = prev.open_successor()
-            results = self._apply_transactions(new_lcl, txset)
+            spec = (
+                getattr(open_ledger, "_spec_state", None)
+                if self.delta_replay else None
+            )
+            results = self._apply_transactions(new_lcl, txset, spec=spec)
+            t_apply = time.perf_counter()
 
             # 3. seal + advance
             new_lcl.close(close_time, close_resolution, correct_close_time)
@@ -283,6 +322,7 @@ class LedgerMaster:
             # overlap: tree-hash (GIL-releasing crypto batches) on a
             # helper thread while the persist rows materialize here
             self._seal(new_lcl, results)
+            t_seal = time.perf_counter()
             self._push_closed(new_lcl)
             self.current = new_lcl.open_successor()
 
@@ -295,14 +335,12 @@ class LedgerMaster:
 
             # re-apply held txns to the new open ledger
             for tx in self.take_held_transactions():
-                engine = TransactionEngine(self.current)
-                ter, applied = engine.apply_transaction(
+                ter, _applied = self._open_apply(
                     tx, TxParams.OPEN_LEDGER | TxParams.RETRY
                 )
                 if ter == TER.terPRE_SEQ:
                     self.add_held_transaction(tx)
-                elif applied:
-                    self.current.parsed_txs[tx.txid()] = tx
+            self._note_close_stages(t0, t_apply, t_seal)
             return new_lcl, results
 
     def close_with_txset(
@@ -318,6 +356,7 @@ class LedgerMaster:
         ledger anything we had locally that didn't make the consensus set
         (reference: reapply of local/disputed txns :1050-1127)."""
         with self._lock:
+            t0 = time.perf_counter()
             prev = self.closed_ledger()
             open_ledger = self.current_ledger()
 
@@ -326,20 +365,25 @@ class LedgerMaster:
                 txset.insert(tx)
 
             new_lcl = prev.open_successor()
-            results = self._apply_transactions(new_lcl, txset)
+            spec = (
+                getattr(open_ledger, "_spec_state", None)
+                if self.delta_replay else None
+            )
+            results = self._apply_transactions(new_lcl, txset, spec=spec)
+            t_apply = time.perf_counter()
 
             new_lcl.close(close_time, close_resolution, correct_close_time)
             new_lcl.accepted = True
             for tx in txset.values():
                 new_lcl.parsed_txs[tx.txid()] = tx
             self._seal(new_lcl, results)
+            t_seal = time.perf_counter()
             self._push_closed(new_lcl)
             self.current = new_lcl.open_successor()
 
             # re-apply: our open-ledger txns that missed consensus, then
             # held; SF_SIGGOOD verdicts from submit time carry over so
             # the re-apply never host-re-verifies
-            engine = TransactionEngine(self.current)
             consensus_ids = {tx.txid() for tx in txs}
             leftovers = [
                 self._parse_with_verdict(open_ledger, txid, blob)
@@ -347,13 +391,12 @@ class LedgerMaster:
                 if txid not in consensus_ids
             ] + self.take_held_transactions()
             for tx in leftovers:
-                ter, applied = engine.apply_transaction(
+                ter, _applied = self._open_apply(
                     tx, TxParams.OPEN_LEDGER | TxParams.RETRY
                 )
                 if ter == TER.terPRE_SEQ:
                     self.add_held_transaction(tx)
-                elif applied:
-                    self.current.parsed_txs[tx.txid()] = tx
+            self._note_close_stages(t0, t_apply, t_seal)
             return new_lcl, results
 
     def switch_lcl(self, ledger: Ledger) -> None:
@@ -449,20 +492,46 @@ class LedgerMaster:
         self.set_validated(ledger)
         return True
 
-    def _apply_transactions(self, ledger: Ledger, txset: CanonicalTXSet) -> dict[bytes, TER]:
+    def _apply_transactions(
+        self, ledger: Ledger, txset: CanonicalTXSet, spec=None
+    ) -> dict[bytes, TER]:
         """reference: LedgerConsensus::applyTransactions — passes over the
         canonical set, retrying ter* failures (which may succeed once an
-        earlier tx lands), claiming fees on tec*."""
+        earlier tx lands), claiming fees on tec*.
+
+        With a SpecState from the open pass, each tx first consults the
+        delta-replay context: a record whose read set validates against
+        the close's writer map is spliced (recorded delta + meta, no
+        transactor run); everything else runs the full serial apply and
+        poisons its written keys (engine/deltareplay.py)."""
         results: dict[bytes, TER] = {}
         engine = TransactionEngine(ledger)
+        replay = None
+        if spec is not None and self.delta_replay:
+            from ..engine.deltareplay import CloseReplay
+
+            replay = CloseReplay(spec, ledger)
+
+        def apply_one(key_tx, final: bool):
+            tx = key_tx[1]
+            if replay is not None:
+                hit = replay.try_splice(engine, tx, final)
+                if hit is not None:
+                    return hit
+            ter, did_apply = engine.apply_transaction(
+                tx, TxParams.NONE if final else TxParams.RETRY
+            )
+            if replay is not None:
+                replay.note_fallback(tx, engine, did_apply)
+            return ter, did_apply
+
         remaining = txset.items_sorted()
         for pass_no in range(LEDGER_TOTAL_PASSES):
             final_pass = pass_no == LEDGER_TOTAL_PASSES - 1
             retry: list = []
             changes = 0
             for key, tx in remaining:
-                params = TxParams.NONE if final_pass else TxParams.RETRY
-                ter, did_apply = engine.apply_transaction(tx, params)
+                ter, did_apply = apply_one((key, tx), final_pass)
                 results[tx.txid()] = ter
                 if did_apply or ter == TER.tesSUCCESS:
                     changes += 1
@@ -476,7 +545,51 @@ class LedgerMaster:
                 # recorded non-retry results)
                 if remaining and not final_pass:
                     for key, tx in remaining:
-                        ter, _ = engine.apply_transaction(tx, TxParams.NONE)
+                        ter, _ = apply_one((key, tx), True)
                         results[tx.txid()] = ter
                 break
+        if replay is not None:
+            self._note_delta_stats(replay)
         return results
+
+    # -- delta-replay / close-stage observability -------------------------
+
+    def _note_delta_stats(self, replay) -> None:
+        c = replay.counts()
+        self.delta_stats["closes"] += 1
+        for k in ("spliced", "fallback", "invalidated"):
+            self.delta_stats[k] += c[k]
+        self.last_close.update(c)
+
+    def _note_close_stages(self, t0: float, t_apply: float,
+                           t_seal: float) -> None:
+        now = time.perf_counter()
+        stages = {
+            "apply_ms": round((t_apply - t0) * 1000.0, 3),
+            "seal_ms": round((t_seal - t_apply) * 1000.0, 3),
+            "total_ms": round((now - t0) * 1000.0, 3),
+        }
+        self.close_stage_ms.append(stages)
+        self.last_close.update(stages)
+
+    def delta_replay_json(self) -> dict:
+        """spliced/fallback/invalidation counters + close-stage latency
+        percentiles, for server_state / get_counts. Snapshots under the
+        chain lock: RPC worker threads call this while the close thread
+        appends to the stage deque / merges last_close."""
+        with self._lock:
+            out = {
+                "enabled": self.delta_replay,
+                **self.delta_stats,
+                "last_close": dict(self.last_close),
+            }
+            stages = list(self.close_stage_ms)
+        for stage in ("apply_ms", "seal_ms", "total_ms"):
+            if not stages:
+                break
+            vals = sorted(s[stage] for s in stages)
+            out[f"{stage.removesuffix('_ms')}_p50_ms"] = vals[len(vals) // 2]
+            out[f"{stage.removesuffix('_ms')}_p90_ms"] = vals[
+                min(len(vals) - 1, int(len(vals) * 0.9))
+            ]
+        return out

@@ -487,6 +487,10 @@ class Node:
         # persist rows (Python meta/row tail) WHILE the seal tree-hash
         # runs its GIL-releasing native/device batches on a helper thread
         self.ledger_master.persist_prep = build_tx_rows
+        # [close] delta_replay: speculative close-mode execution at
+        # submit + optimistic delta splice at close (serial fallback per
+        # tx on any read-set conflict)
+        self.ledger_master.delta_replay = cfg.close_delta_replay
         self.ops = NetworkOPs(
             self.ledger_master,
             self.job_queue,
@@ -710,6 +714,16 @@ class Node:
                 "depth": self.close_pipeline.pending(),
                 "persisted": self.close_pipeline.persisted,
                 "backpressure_waits": self.close_pipeline.backpressure_waits,
+            },
+        )
+        self.collector.hook(
+            "delta_replay",
+            # snapshot via delta_replay_json: it takes the chain lock, so
+            # the three counters are mutually consistent per sample
+            lambda: {
+                k: v
+                for k, v in self.ledger_master.delta_replay_json().items()
+                if k in ("spliced", "fallback", "invalidated")
             },
         )
         self.collector.start()

@@ -18,6 +18,7 @@ onto the ValidatorNode's internal locking.
 from __future__ import annotations
 
 import itertools
+import logging
 import os
 import socket
 import struct
@@ -61,6 +62,8 @@ from .wire import (
 )
 
 __all__ = ["TcpOverlay"]
+
+log = logging.getLogger("stellard.overlay")
 
 PROTO_VERSION = 1
 # domain prefix for the session-binding signature ("SSN\0")

@@ -63,11 +63,24 @@ class Format:
     type_code: int
     template: tuple[tuple[SField, SOE], ...]
 
-    def known_fields(self) -> set[SField]:
-        return {f for f, _ in self.template}
+    # built once per (immutable) format: these sit on the per-tx apply
+    # hot path via validate_against, where a rebuilt set per call was
+    # measurable at flood rates
+    def known_fields(self) -> frozenset[SField]:
+        cached = self.__dict__.get("_known")
+        if cached is None:
+            cached = frozenset(f for f, _ in self.template)
+            object.__setattr__(self, "_known", cached)
+        return cached
 
-    def required_fields(self) -> set[SField]:
-        return {f for f, soe in self.template if soe == SOE.REQUIRED}
+    def required_fields(self) -> frozenset[SField]:
+        cached = self.__dict__.get("_required")
+        if cached is None:
+            cached = frozenset(
+                f for f, soe in self.template if soe == SOE.REQUIRED
+            )
+            object.__setattr__(self, "_required", cached)
+        return cached
 
 
 def _fmt(name: str, code: int, elems: list[tuple[SField, SOE]]) -> Format:

@@ -45,6 +45,10 @@ class SerializedTransaction:
         self._blob_memo: Optional[tuple[int, bytes]] = None
         self._txid_memo: Optional[tuple[int, bytes]] = None
         self._tx_type_memo: Optional[tuple[int, TxType]] = None
+        # passes_local_checks is a pure function of the object bytes and
+        # runs once per apply — which, with the delta-replay close, is
+        # twice per submit (open check pass + speculative close run)
+        self._local_memo: Optional[tuple[int, tuple[bool, str]]] = None
 
     # -- construction -----------------------------------------------------
 
@@ -164,7 +168,16 @@ class SerializedTransaction:
 
     def passes_local_checks(self) -> tuple[bool, str]:
         """Cheap structural checks before any state access
-        (reference: passesLocalChecks, SerializedTransaction.cpp:350-369)."""
+        (reference: passesLocalChecks, SerializedTransaction.cpp:350-369);
+        memoized, versioned against object mutation."""
+        memo = self._local_memo
+        if memo is not None and memo[0] == self.obj._version:
+            return memo[1]
+        verdict = self._local_checks()
+        self._local_memo = (self.obj._version, verdict)
+        return verdict
+
+    def _local_checks(self) -> tuple[bool, str]:
         fee = self.obj.get(sfFee)
         if fee is None or not fee.is_native or fee.negative:
             return False, "invalid fee"

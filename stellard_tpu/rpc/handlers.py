@@ -320,6 +320,9 @@ def do_server_state(ctx: Context) -> dict:
         # per-stage latency histograms + queue-depth gauges for the
         # ledger-close persistence pipeline
         state["close_pipeline"] = pipeline.get_json()
+    # delta-replay close: spliced/fallback/invalidation counters +
+    # close-stage (apply/seal/total) latency percentiles
+    state["delta_replay"] = node.ledger_master.delta_replay_json()
     return {"state": state}
 
 
@@ -343,6 +346,7 @@ def do_get_counts(ctx: Context) -> dict:
     if pipeline is not None:
         out["close_pipeline"] = pipeline.get_json()
         out["persist_backlog"] = pipeline.pending()
+    out["delta_replay"] = node.ledger_master.delta_replay_json()
     overlay = getattr(node, "overlay", None)
     if overlay is not None:
         out["peers"] = overlay.peer_count()

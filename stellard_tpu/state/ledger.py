@@ -272,6 +272,15 @@ class Ledger:
         self.tx_map.set_item(SHAMapItem(txid, s.data()), TNType.TX_MD)
         return txid
 
+    def record_transaction(self, tx_blob: bytes, meta) -> bytes:
+        """Close-path insert of a tx + its PARSED meta: serializes the
+        meta into the tx map and memoizes the object for persist/publish
+        (the speculative view overrides this to skip a serialization its
+        scratch map would discard)."""
+        txid = self.add_transaction(tx_blob, meta.serialize())
+        self.parsed_metas[txid] = meta
+        return txid
+
     def tx_entries(self):
         """Yield (txid, tx_blob, meta_blob) for every tx in this ledger —
         the one place that knows the TX_MD item layout VL(tx) || VL(meta)

@@ -1,0 +1,212 @@
+"""SpecView: a ledger facade for speculative close-mode execution.
+
+The delta-replay close (engine/deltareplay.py) re-executes each
+open-accepted transaction in CLOSE mode at submit time, against the state
+the real close will start from (the open ledger's state map never mutates
+during the open window, so it IS the parent snapshot the close applies
+onto). This module provides the view that execution runs against:
+
+- an overlay of the speculative writes accumulated so far this open
+  ledger (so same-account sequence chains and dependent txs execute
+  against post-predecessor state, exactly like the serial close), and
+- read/write-set capture in the Block-STM style (Gelashvili et al.,
+  2022): every entry read records (key -> last writer id), every
+  order-book/directory ``state_map.succ`` walk records
+  (cursor -> next key), every write records the final SLE.
+
+Writer ids are the txids of earlier speculative transactions, or the
+PARENT sentinel for state inherited from the parent ledger. At close the
+replay context validates a record by comparing these against the close's
+own writer map — value equality by provenance, not version arithmetic —
+and the succ records against the closing ledger's real state map (phantom
+protection for book walks: an entry INSERTED between cursor and the
+recorded next key must invalidate, which no per-key version can see).
+
+The facade implements exactly the Ledger surface the close-mode engine
+touches (audited in engine/, paths/flow.py, engine/offers.py); anything
+else raising AttributeError is a seam audit failure, not a fallback.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_right, insort
+from typing import Optional
+
+from ..protocol.stobject import STObject
+from ..utils.hashes import HP_TXN_ID, prefix_hash
+from .ledger import Ledger
+
+__all__ = ["SpecView", "PARENT"]
+
+# writer-id sentinel for "inherited from the parent ledger"; never
+# collides with a txid (txids are 32 bytes)
+PARENT = b"\x00parent"
+
+
+class _ShimItem:
+    """Minimal SHAMapItem stand-in for overlay-created keys returned by
+    the succ shim (callers use .tag only — offers.py / paths/flow.py)."""
+
+    __slots__ = ("tag",)
+
+    def __init__(self, tag: bytes):
+        self.tag = tag
+
+
+class _StateMapShim:
+    """state_map facade: parent map merged with the overlay for the
+    ``succ`` order-book walks (engine/offers.py:179, paths/flow.py:275).
+    Every result is captured as a range read."""
+
+    __slots__ = ("_view",)
+
+    def __init__(self, view: "SpecView"):
+        self._view = view
+
+    def succ(self, key: bytes):
+        v = self._view
+        # parent candidate, skipping keys the overlay deleted
+        cur = key
+        while True:
+            item = v._parent.state_map.succ(cur)
+            if item is None or v._overlay.get(item.tag, _MISS) is not None:
+                break
+            cur = item.tag
+        created = v._created_after(key)
+        if item is not None and (created is None or item.tag < created):
+            res = item
+        elif created is not None:
+            res = _ShimItem(created)
+        else:
+            res = None
+        v._succs.append((key, res.tag if res is not None else None))
+        return res
+
+
+class _TxMapShim:
+    """tx_map facade: only ``get`` (Transactor::checkSeq's tefALREADY
+    probe) is reachable in close mode; membership is the speculatively
+    applied set."""
+
+    __slots__ = ("_applied",)
+
+    def __init__(self):
+        self._applied: set[bytes] = set()
+
+    def get(self, txid: bytes):
+        return True if txid in self._applied else None
+
+    def add(self, txid: bytes) -> None:
+        self._applied.add(txid)
+
+
+_MISS = object()
+
+
+class SpecView:
+    """Overlay view over an OPEN ledger with per-tx read/write capture.
+
+    One instance lives for the whole open window; ``begin_tx`` /
+    ``end_tx`` bracket each speculative execution. Callers run under the
+    LedgerMaster lock, so no internal locking."""
+
+    # borrowed verbatim: both read only scalar attrs this view carries
+    reserve = Ledger.reserve
+    scale_fee_load = Ledger.scale_fee_load
+
+    def __init__(self, ledger: Ledger):
+        self._parent = ledger
+        # header scalars the close-mode engine/transactors read; the
+        # close ledger is a sibling successor of the same parent, so
+        # these are byte-equal to what the close view will present
+        self.seq = ledger.seq
+        self.parent_close_time = ledger.parent_close_time
+        self.base_fee = ledger.base_fee
+        self.reference_fee_units = ledger.reference_fee_units
+        self.reserve_base = ledger.reserve_base
+        self.reserve_increment = ledger.reserve_increment
+        self.load_factor = ledger.load_factor
+        # engine-mutated scratch (fee burn, inflation header deltas):
+        # consumed per record, never written back to the real ledger
+        self.tot_coins = ledger.tot_coins
+        self.fee_pool = ledger.fee_pool
+        self.inflation_seq = ledger.inflation_seq
+        self.parsed_metas: dict[bytes, STObject] = {}
+        self.state_map = _StateMapShim(self)
+        self.tx_map = _TxMapShim()
+        # overlay: key -> final SLE (None = deleted); writers: key ->
+        # txid of the last speculative writer
+        self._overlay: dict[bytes, Optional[STObject]] = {}
+        self._writers: dict[bytes, bytes] = {}
+        self._created: list[bytes] = []  # sorted overlay-created keys
+        self._created_set: set[bytes] = set()
+        # per-tx capture
+        self._reads: dict[bytes, bytes] = {}
+        self._succs: list[tuple[bytes, Optional[bytes]]] = []
+        self._writes: list[tuple[bytes, Optional[STObject]]] = []
+        self._txid: bytes = b""
+
+    # -- capture brackets -------------------------------------------------
+
+    def begin_tx(self, txid: bytes) -> None:
+        self._txid = txid
+        self._reads = {}
+        self._succs = []
+        self._writes = []
+
+    def end_tx(self):
+        """-> (reads, succs, writes) captured since begin_tx."""
+        return self._reads, self._succs, self._writes
+
+    # -- Ledger read surface ----------------------------------------------
+
+    def read_entry_pristine(self, index: bytes) -> Optional[STObject]:
+        sle = self._overlay.get(index, _MISS)
+        if sle is not _MISS:
+            if index not in self._reads:
+                self._reads[index] = self._writers[index]
+            return sle
+        if index not in self._reads:
+            self._reads[index] = PARENT
+        return self._parent.read_entry_pristine(index)
+
+    # -- Ledger write surface (reached only via LedgerEntrySet.apply /
+    # the engine's commit tail, i.e. after a successful execution) --------
+
+    def write_entry(self, index: bytes, sle: STObject) -> None:
+        prev = self._overlay.get(index, _MISS)
+        if index not in self._created_set and (prev is _MISS or prev is None):
+            # key springing into existence: it joins the succ-shim merge
+            # list only when the parent map lacks it (existence probe on
+            # the raw map — not an execution read, so not captured)
+            if self._parent.state_map.get(index) is None:
+                insort(self._created, index)
+                self._created_set.add(index)
+        self._overlay[index] = sle
+        self._writers[index] = self._txid
+        self._writes.append((index, sle))
+
+    def delete_entry(self, index: bytes) -> None:
+        if index in self._created_set:
+            self._created_set.remove(index)
+            i = bisect_right(self._created, index) - 1
+            if 0 <= i < len(self._created) and self._created[i] == index:
+                del self._created[i]
+        self._overlay[index] = None
+        self._writers[index] = self._txid
+        self._writes.append((index, None))
+
+    def record_transaction(self, tx_blob: bytes, meta: STObject) -> bytes:
+        """Engine commit-tail seam: membership for the checkSeq probe +
+        the meta object for the record — the meta bytes are never needed
+        here (the splice re-serializes after re-indexing anyway)."""
+        txid = prefix_hash(HP_TXN_ID, tx_blob)
+        self.tx_map.add(txid)
+        self.parsed_metas[txid] = meta
+        return txid
+
+    # -- succ-shim helpers ------------------------------------------------
+
+    def _created_after(self, key: bytes) -> Optional[bytes]:
+        i = bisect_right(self._created, key)
+        return self._created[i] if i < len(self._created) else None

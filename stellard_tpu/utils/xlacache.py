@@ -4,22 +4,53 @@ The big kernels (batched Ed25519 verify, tree hashing) take minutes to
 compile for the CPU backend and tens of seconds for TPU; one on-disk cache
 under the repo root makes every process after the first fast. Used by
 tests/conftest.py and bench.py so the knobs can never drift apart.
+
+The cache directory is keyed by a host-CPU-feature fingerprint: XLA:CPU
+AOT blobs encode the compiling machine's ISA features, and replaying a
+foreign blob can SIGILL an unattended bench (or at best spam the
+machine-feature-mismatch warning every replay). A box with different CPU
+features simply gets its own subdirectory and recompiles once.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
+
+
+def host_cpu_fingerprint() -> str:
+    """Short stable digest of the host's CPU feature set (ISA flags +
+    machine arch). Two hosts share a cache subdir only when an AOT blob
+    compiled on one is guaranteed executable on the other."""
+    feats = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    # one flags line suffices; identical across cores
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    if not feats:
+        feats = platform.processor() or "unknown"
+    key = f"{platform.machine()}|{feats}"
+    return hashlib.sha256(key.encode()).hexdigest()[:12]
 
 
 def enable_compilation_cache(cache_dir: str | None = None) -> str:
-    """Point JAX's persistent compilation cache at `<repo>/.jax_cache`
-    (or `cache_dir`). Safe to call more than once. Returns the dir."""
+    """Point JAX's persistent compilation cache at
+    `<repo>/.jax_cache/<cpu-fingerprint>` (or `cache_dir`, used as given).
+    Safe to call more than once. Returns the dir."""
     import jax
 
     if cache_dir is None:
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
-        cache_dir = os.path.join(pkg_root, ".jax_cache")
+        cache_dir = os.path.join(
+            pkg_root, ".jax_cache", host_cpu_fingerprint()
+        )
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
     return cache_dir

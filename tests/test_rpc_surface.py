@@ -379,6 +379,10 @@ class TestProfileHandler:
     (handlers/Profile.cpp is a stub there; our device plane has real
     work worth tracing)."""
 
+    @pytest.mark.slow  # ~200 s wall: XLA (re)compiles under the active
+    # profiler are not cache-served, making this the single largest
+    # tier-1 cost; the profile door keeps fast coverage via
+    # test_profile_captures_device_trace (same start/capture/stop path)
     def test_trace_lifecycle_captures_xplane(self, tmp_path, node):
         import numpy as np
 

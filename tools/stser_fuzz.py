@@ -1,0 +1,214 @@
+"""Byte-mutation fuzz corpus for the native STObject parser + proto2 codec.
+
+Seeded from VALID blobs (a signed transaction, transaction metadata, a
+trust-line SLE, a directory node, protobuf overlay messages), then
+mutated deterministically: single/multi bit flips, truncations, length-
+field lies (VL/varint prefixes bumped to claim more bytes than exist),
+and random splices. The contract under fuzz is crash-freedom: every
+case either parses or raises a Python exception — the process dying
+(segfault, abort, ASAN report) is the failure signal.
+
+Runs two ways:
+
+- tests/test_stser_fuzz.py imports `run_corpus` for the CI-sized pass
+  (~10^5 cases) against whatever parser stellard_tpu.protocol.stobject
+  resolves (native _stser when buildable, pure Python otherwise);
+- `make -C native fuzz-asan` rebuilds _stser.so with
+  -fsanitize=address,undefined and drives THIS file as a script over the
+  same corpus, with the sanitized extension forced in (STSER_PATH env),
+  so heap overreads that happen to not crash the plain build still get
+  caught.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+DEFAULT_CASES = int(os.environ.get("STSER_FUZZ_CASES", "100000"))
+SEED = int(os.environ.get("STSER_FUZZ_SEED", "20260803"))
+
+
+def _force_stser(path: str) -> None:
+    """Force a specific _stser.so (e.g. the ASAN build) into the loader
+    memo BEFORE protocol.stobject resolves it."""
+    import importlib.machinery
+    import importlib.util
+
+    from stellard_tpu import native
+
+    loader = importlib.machinery.ExtensionFileLoader("_stser", path)
+    spec = importlib.util.spec_from_loader("_stser", loader)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    with native._lock:
+        native._stser_mod = mod
+        native._stser_tried = True
+
+
+def seed_blobs() -> list[bytes]:
+    """Valid serialized forms covering the grammar: VL fields, amounts
+    (native + IOU), inner objects, arrays, account fields."""
+    from stellard_tpu.protocol.formats import LedgerEntryType, TxType
+    from stellard_tpu.protocol.keys import KeyPair
+    from stellard_tpu.protocol.sfields import (
+        sfAffectedNodes,
+        sfAmount,
+        sfBalance,
+        sfDestination,
+        sfFinalFields,
+        sfFlags,
+        sfHighLimit,
+        sfIndexes,
+        sfLedgerEntryType,
+        sfLedgerIndex,
+        sfLowLimit,
+        sfModifiedNode,
+        sfRootIndex,
+        sfTransactionIndex,
+        sfTransactionResult,
+    )
+    from stellard_tpu.protocol.stamount import STAmount
+    from stellard_tpu.protocol.stobject import STArray, STObject
+    from stellard_tpu.protocol.sttx import SerializedTransaction
+
+    master = KeyPair.from_passphrase("masterpassphrase")
+    dest = KeyPair.from_passphrase("fuzz-dest")
+    usd = b"USD" + b"\x00" * 17
+
+    tx = SerializedTransaction.build(
+        TxType.ttPAYMENT, master.account_id, 7, 10,
+        {sfAmount: STAmount.from_iou(usd, dest.account_id, 123456, -2),
+         sfDestination: dest.account_id},
+    )
+    tx.sign(master)
+
+    line = STObject()
+    line[sfLedgerEntryType] = int(LedgerEntryType.ltRIPPLE_STATE)
+    line[sfFlags] = 0x00110000
+    line[sfBalance] = STAmount.from_iou(usd, b"\x00" * 19 + b"\x01", 5, 0)
+    line[sfLowLimit] = STAmount.from_iou(usd, master.account_id, 10**9, 0)
+    line[sfHighLimit] = STAmount.from_iou(usd, dest.account_id, 0, 0)
+
+    dirnode = STObject()
+    dirnode[sfLedgerEntryType] = int(LedgerEntryType.ltDIR_NODE)
+    dirnode[sfRootIndex] = b"\x42" * 32
+    dirnode[sfIndexes] = [bytes([i]) * 32 for i in range(5)]
+
+    node = STObject()
+    node[sfLedgerEntryType] = int(LedgerEntryType.ltACCOUNT_ROOT)
+    node[sfLedgerIndex] = b"\x17" * 32
+    fin = STObject()
+    fin[sfBalance] = STAmount.from_drops(999_999)
+    node[sfFinalFields] = fin
+    affected = STArray()
+    affected.append(sfModifiedNode, node)
+    meta = STObject()
+    meta[sfTransactionIndex] = 3
+    meta[sfAffectedNodes] = affected
+    meta[sfTransactionResult] = 0
+    return [tx.serialize(), line.serialize(), dirnode.serialize(),
+            meta.serialize()]
+
+
+def proto_seed_blobs() -> list[bytes]:
+    """Valid protobuf frames from the overlay codec."""
+    from stellard_tpu.overlay.proto import Encoder
+
+    hello = (
+        Encoder()
+        .varint(1, 10003)
+        .varint(2, 1)
+        .blob(3, b"\x02" + b"\x11" * 32)
+        .blob(4, b"\x30" * 70)
+        .varint(5, 40_000_000)
+        .blob(6, b"\x99" * 32)
+    )
+    nested = Encoder().message(2, Encoder().varint(1, 7).blob(2, b"abc"))
+    txm = Encoder().blob(1, b"\x12\x00\x22\x01\x00").varint(2, 1)
+    return [hello.data(), nested.data(), txm.data()]
+
+
+def mutate(rng: random.Random, blob: bytes) -> bytes:
+    """One deterministic mutation: bit flip(s), truncation, length-field
+    lie (byte bumped — VL prefixes and varints both live inline), or a
+    splice of two regions."""
+    b = bytearray(blob)
+    kind = rng.randrange(5)
+    if not b:
+        return bytes(b)
+    if kind == 0:  # single bit flip
+        i = rng.randrange(len(b))
+        b[i] ^= 1 << rng.randrange(8)
+    elif kind == 1:  # burst of bit flips
+        for _ in range(rng.randrange(2, 9)):
+            i = rng.randrange(len(b))
+            b[i] ^= 1 << rng.randrange(8)
+    elif kind == 2:  # truncation
+        b = b[: rng.randrange(len(b))]
+    elif kind == 3:  # length-field lie: bump a byte to a large value
+        i = rng.randrange(len(b))
+        b[i] = rng.choice((0x7F, 0xC0, 0xF1, 0xFE, 0xFF))
+    else:  # splice two regions (duplicates/reorders length prefixes)
+        if len(b) >= 4:
+            i, j = sorted(rng.randrange(len(b)) for _ in range(2))
+            k = rng.randrange(len(b))
+            b = b[:k] + b[i:j] + b[k:]
+        else:
+            b += bytes([rng.randrange(256)])
+    return bytes(b)
+
+
+def run_corpus(cases: int = DEFAULT_CASES, seed: int = SEED,
+               progress: bool = False) -> dict:
+    """Fuzz both parsers; returns outcome counts. Crash-freedom is the
+    assertion — any Python exception is an accepted outcome."""
+    from stellard_tpu.overlay import proto
+    from stellard_tpu.protocol.stobject import STObject
+
+    rng = random.Random(seed)
+    st_seeds = seed_blobs()
+    pb_seeds = proto_seed_blobs()
+    counts = {"st_ok": 0, "st_err": 0, "pb_ok": 0, "pb_err": 0}
+    n_st = cases * 3 // 4
+    for i in range(cases):
+        if i < n_st:
+            blob = mutate(rng, rng.choice(st_seeds))
+            try:
+                STObject.from_bytes(blob)
+                counts["st_ok"] += 1
+            except Exception:  # noqa: BLE001 — rejection is a pass
+                counts["st_err"] += 1
+        else:
+            blob = mutate(rng, rng.choice(pb_seeds))
+            try:
+                proto.parse(blob)
+                counts["pb_ok"] += 1
+            except Exception:  # noqa: BLE001 — rejection is a pass
+                counts["pb_err"] += 1
+        if progress and i and i % 20000 == 0:
+            print(f"stser-fuzz: {i}/{cases} {counts}", flush=True)
+    return counts
+
+
+def main() -> int:
+    forced = os.environ.get("STSER_PATH")
+    if forced:
+        _force_stser(os.path.abspath(forced))
+    from stellard_tpu.protocol import stobject
+
+    st = stobject._get_stser()
+    print(f"stser-fuzz: native parser {'LOADED' if st else 'absent'}"
+          f"{' (forced ' + forced + ')' if forced else ''}", flush=True)
+    counts = run_corpus(progress=True)
+    print(f"stser-fuzz: done {counts}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
