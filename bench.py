@@ -570,6 +570,42 @@ def bench_delta_replay_flood(backends):
     dre = max(legs["delta_replay"], key=lambda leg: leg["rate"])
     all_details = [leg["detail"] for runs in legs.values() for leg in runs]
     dr = dre["detail"]["delta_replay"]
+
+    # tracing-overhead provenance: one extra delta-replay rep with the
+    # tracer OFF ([trace] enabled=0; the main legs run the default
+    # sampled-on tracer). The enabled-vs-disabled close-p50 delta rides
+    # the provenance block of every line emitted from here on, so
+    # overhead drift across rounds is visible without a dedicated leg.
+    state_dir = tempfile.mkdtemp(prefix="bench-delta-notrace-")
+    try:
+        _dt_nt, _, _, detail_nt = _drive_node(
+            "cpu", txs,
+            cfg_kwargs={
+                "close_delta_replay": True,
+                "trace_enabled": False,
+                "database_path": os.path.join(state_dir, "bench.db"),
+                "node_db_type": "cpplog",
+                "node_db_path": os.path.join(state_dir, "nodestore"),
+            },
+            max_inflight=64,
+            pin_close_time=900_000_000,
+        )
+    finally:
+        shutil.rmtree(state_dir, ignore_errors=True)
+    traced_p50 = dre["detail"]["close_p50_ms"]
+    untraced_p50 = detail_nt["close_p50_ms"]
+    _PROVENANCE_BASE["trace_overhead"] = {
+        "close_p50_ms_traced": traced_p50,
+        "close_p50_ms_untraced": untraced_p50,
+        "delta_ms": round(traced_p50 - untraced_p50, 2),
+        "delta_pct": (
+            round((traced_p50 / untraced_p50 - 1.0) * 100.0, 2)
+            if untraced_p50 else None
+        ),
+        # traced is best-of-reps, untraced a single rep — treat small
+        # negative deltas as noise, not a speedup
+        "note": f"traced best-of-{reps} vs untraced single rep",
+    }
     _emit({
         "metric": "delta_replay_flood_tx_per_sec",
         "value": round(dre["rate"], 2),

@@ -105,6 +105,9 @@ class LedgerConsensus:
         voting=None,
     ):
         self.lm = ledger_master
+        # consensus round events ride the chain's tracing plane (trace
+        # id = the ledger under construction)
+        self.tracer = ledger_master.tracer
         self.adapter = adapter
         self.validations = validations
         self.key = key
@@ -211,9 +214,17 @@ class LedgerConsensus:
         if self.proposing:
             self.our_position.sign(self.key)
             self.adapter.propose(self.our_position)
+            self.tracer.instant(
+                "consensus.propose_out", "consensus", seq=self.seq,
+                propose_seq=0, txs=len(self._pre_close_open_ids),
+            )
         self.adapter.share_tx_set(self.our_set)
         self.acquired[self.our_set.hash()] = self.our_set
         self.state = ConsensusState.ESTABLISH
+        self.tracer.instant(
+            "consensus.state", "consensus", seq=self.seq,
+            state="ESTABLISH", open_ms=self._ms_since(self.round_start),
+        )
         self.consensus_start = self.clock()
         self.last_propose = self.clock()
         # fold in positions that arrived before we closed
@@ -241,12 +252,20 @@ class LedgerConsensus:
             self.max_seen_seq[peer] = prop.propose_seq  # nothing tops this
             for d in self.disputes.values():
                 d.unvote(peer)
+            self.tracer.instant(
+                "consensus.proposal_in", "consensus", seq=self.seq,
+                peer=peer.hex()[:16], bowout=True,
+            )
             return True
         if prop.propose_seq <= self.max_seen_seq.get(peer, -1):
             return False  # stale or replayed
         self.max_seen_seq[peer] = prop.propose_seq
         self.peer_positions[peer] = prop
         self.position_times[peer] = self.clock()
+        self.tracer.instant(
+            "consensus.proposal_in", "consensus", seq=self.seq,
+            peer=peer.hex()[:16], propose_seq=prop.propose_seq,
+        )
         ts = self.acquired.get(prop.tx_set_hash)
         if ts is None:
             ts = self.adapter.acquire_tx_set(prop.tx_set_hash)
@@ -330,6 +349,12 @@ class LedgerConsensus:
             self.prev_round_ms,
         ):
             self.state = ConsensusState.FINISHED
+            self.tracer.instant(
+                "consensus.state", "consensus", seq=self.seq,
+                state="FINISHED", proposers=len(self.peer_positions),
+                agree=agree,
+                establish_ms=self._ms_since(self.consensus_start),
+            )
             self.accept(ct, ct_agree)
 
     def _prune_stale_positions(self) -> None:
@@ -394,10 +419,21 @@ class LedgerConsensus:
             self.our_position = self.our_position.advanced(
                 new_set.hash(), self.our_close_time
             )
+            # avalanche vote switch: our position moved (disputed-tx
+            # votes crossed a threshold and/or the close time converged)
+            self.tracer.instant(
+                "consensus.position_change", "consensus", seq=self.seq,
+                propose_seq=self.our_position.propose_seq,
+                disputes=len(self.disputes), time_pct=time_pct,
+            )
             if self.proposing:
                 self.our_position.sign(self.key)
                 self.adapter.propose(self.our_position)
                 self.last_propose = self.clock()
+                self.tracer.instant(
+                    "consensus.propose_out", "consensus", seq=self.seq,
+                    propose_seq=self.our_position.propose_seq,
+                )
             self.adapter.share_tx_set(new_set)
             self._compare_set(new_set)
 
@@ -461,10 +497,17 @@ class LedgerConsensus:
             # stores its own validation before broadcasting :1023-1045)
             self.validations.add(val)
             self.adapter.send_validation(val)
+            self.tracer.instant(
+                "consensus.validation_out", "consensus", seq=new_lcl.seq,
+            )
         self.lm.check_accept(
             new_lcl.hash(), self.validations.trusted_count_for(new_lcl.hash())
         )
         self.state = ConsensusState.ACCEPTED
+        self.tracer.instant(
+            "consensus.state", "consensus", seq=self.seq,
+            state="ACCEPTED", round_ms=self.round_ms,
+        )
         self.adapter.on_accepted(new_lcl, self.round_ms)
 
     # -- introspection ----------------------------------------------------

@@ -131,9 +131,16 @@ class SpecState:
 class CloseReplay:
     """One close's splice-or-fallback context over a SpecState."""
 
-    def __init__(self, spec: Optional[SpecState], ledger: Ledger):
+    def __init__(self, spec: Optional[SpecState], ledger: Ledger,
+                 tracer=None):
+        from ..node.tracer import get_tracer
+
         self.spec = spec
         self.ledger = ledger
+        self.tracer = tracer if tracer is not None else get_tracer()
+        # why the NEXT fallback runs (set by try_splice on each miss,
+        # consumed by note_fallback's trace mark)
+        self._fallback_reason = "not_attempted"
         self.parent_ok = (
             spec is not None
             and not spec.disabled
@@ -158,21 +165,27 @@ class CloseReplay:
         """-> (ter, did_apply) when the recorded outcome stands in for
         this pass, else None (caller runs the full serial apply)."""
         if not self.parent_ok or self.header_dirty:
+            self._fallback_reason = (
+                "header_dirty" if self.header_dirty else "parent_mismatch"
+            )
             return None
         txid = tx.txid()
         rec = self.spec.records.get(txid)
         if rec is None:
+            self._fallback_reason = "no_record"
             return None
         writers = self.writers
         for k, wid in rec.reads.items():
             if writers.get(k, PARENT) != wid:
                 self.invalidated += 1
+                self._fallback_reason = "read_invalidated"
                 return None
         st = self.ledger.state_map
         for cursor, tag in rec.succs:
             item = st.succ(cursor)
             if (item.tag if item is not None else None) != tag:
                 self.invalidated += 1
+                self._fallback_reason = "succ_invalidated"
                 return None
 
         if not rec.did_apply:
@@ -180,11 +193,13 @@ class CloseReplay:
             # path reports the RAW tec (the claim only runs under NONE)
             self._class[txid] = "spliced"
             ter = rec.raw_ter if not final and _is_tec(rec.raw_ter) else rec.ter
+            self._mark(txid, "spliced", int(ter))
             return ter, False
         if not final and _is_tec(rec.raw_ter):
             # defer the recorded fee claim to final-pass semantics, like
             # the serial path; the caller's tec branch requeues it
             self._class[txid] = "spliced"
+            self._mark(txid, "spliced", int(rec.raw_ter))
             return rec.raw_ter, False
 
         ledger = self.ledger
@@ -202,13 +217,32 @@ class CloseReplay:
                 ledger.write_entry(k, sle)
             writers[k] = txid
         self._class[txid] = "spliced"
+        self._mark(txid, "spliced", int(rec.ter))
         return rec.ter, True
+
+    def _mark(self, txid: bytes, mode: str, ter: Optional[int] = None,
+              reason: Optional[str] = None) -> None:
+        """Per-tx splice/fallback trace mark (sampled): the close-stage
+        node of the transaction's causal span tree, with the fallback
+        reason when the record could not be spliced."""
+        tr = self.tracer
+        if not tr.enabled or not tr.sampled(txid):
+            return
+        attrs = {"mode": mode, "ledger_seq": self.ledger.seq}
+        if ter is not None:
+            attrs["ter"] = ter
+        if reason is not None:
+            attrs["reason"] = reason
+        tr.instant("close.tx", "close", txid=txid, **attrs)
 
     def note_fallback(self, tx: SerializedTransaction,
                       engine: TransactionEngine, did_apply: bool) -> None:
         """A full serial apply ran: poison its written keys so records
         that read them can never splice against diverged values."""
-        self._class[tx.txid()] = "fallback"
+        txid = tx.txid()
+        self._class[txid] = "fallback"
+        self._mark(txid, "fallback", reason=self._fallback_reason)
+        self._fallback_reason = "not_attempted"
         if not did_apply:
             return
         if tx.tx_type in HEADER_TYPES:

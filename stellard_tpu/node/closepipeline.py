@@ -33,57 +33,13 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from .metrics import LatencyHist
+
 log = logging.getLogger("stellard.closepipeline")
 
+# LatencyHist moved to node.metrics (one percentile implementation for
+# the whole node); re-exported here for existing importers
 __all__ = ["ClosePipeline", "LatencyHist"]
-
-
-class LatencyHist:
-    """Fixed-bucket latency histogram (ms): tiny, lock-free enough for a
-    single-writer stage (the drain worker), read-mostly for metrics."""
-
-    BOUNDS = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 250.0, 500.0,
-              1000.0, 5000.0)
-
-    def __init__(self):
-        self.counts = [0] * (len(self.BOUNDS) + 1)
-        self.count = 0
-        self.total_ms = 0.0
-        self.max_ms = 0.0
-
-    def record(self, ms: float) -> None:
-        i = 0
-        for i, b in enumerate(self.BOUNDS):  # noqa: B007
-            if ms <= b:
-                break
-        else:
-            i = len(self.BOUNDS)
-        self.counts[i] += 1
-        self.count += 1
-        self.total_ms += ms
-        self.max_ms = max(self.max_ms, ms)
-
-    def quantile(self, q: float) -> float:
-        """Upper bucket bound holding the q-quantile (0 when empty)."""
-        if not self.count:
-            return 0.0
-        target = q * self.count
-        seen = 0
-        for i, c in enumerate(self.counts):
-            seen += c
-            if seen >= target:
-                return (self.BOUNDS[i] if i < len(self.BOUNDS)
-                        else self.BOUNDS[-1] * 2)
-        return self.BOUNDS[-1] * 2
-
-    def get_json(self) -> dict:
-        return {
-            "count": self.count,
-            "mean_ms": round(self.total_ms / self.count, 3) if self.count else 0.0,
-            "p50_ms": self.quantile(0.5),
-            "p90_ms": self.quantile(0.9),
-            "max_ms": round(self.max_ms, 3),
-        }
 
 
 @dataclass
@@ -107,11 +63,15 @@ class ClosePipeline:
         recover_results: Optional[Callable] = None,  # ledger -> {txid: TER}
         depth: int = 8,
         name: str = "ledger-persist",
+        tracer=None,
     ):
+        from .tracer import get_tracer
+
         self.save_stage = save_stage
         self.txdb_stage = txdb_stage
         self.clf_stage = clf_stage
         self.recover_results = recover_results
+        self.tracer = tracer if tracer is not None else get_tracer()
         self.depth = max(1, int(depth))
         self._lock = threading.Lock()
         self._not_full = threading.Condition(self._lock)
@@ -295,9 +255,13 @@ class ClosePipeline:
 
     def _persist(self, entry: _Entry) -> None:
         t_start = time.perf_counter()
+        seq = entry.ledger.seq
+        tr = self.tracer
         self.stage_hist["queue_wait"].record(
             (t_start - entry.enqueued_at) * 1000.0
         )
+        tr.complete("persist.queue_wait", "persist", entry.enqueued_at,
+                    t_start, seq=seq)
         results = entry.results
         if not results and self.recover_results is not None:
             # ledger we never applied locally (catch-up adoption / history
@@ -310,16 +274,29 @@ class ClosePipeline:
         self.save_stage(entry.ledger)
         t1 = time.perf_counter()
         self.stage_hist["nodestore"].record((t1 - t0) * 1000.0)
+        tr.complete("persist.nodestore", "persist", t0, t1, seq=seq)
         self.txdb_stage(entry.ledger, results)
         t2 = time.perf_counter()
         self.stage_hist["txdb"].record((t2 - t1) * 1000.0)
+        tr.complete("persist.txdb", "persist", t1, t2, seq=seq)
         if entry.kind == "close":
             self.clf_stage(entry.ledger)
             t3 = time.perf_counter()
             self.stage_hist["clf"].record((t3 - t2) * 1000.0)
-        self.stage_hist["total"].record(
-            (time.perf_counter() - t_start) * 1000.0
-        )
+            tr.complete("persist.clf", "persist", t2, t3, seq=seq)
+        t_end = time.perf_counter()
+        self.stage_hist["total"].record((t_end - t_start) * 1000.0)
+        tr.complete("persist.total", "persist", t_start, t_end, seq=seq,
+                    kind=entry.kind, txs=len(results or ()))
+        # per-tx persist marks close out each SAMPLED transaction's
+        # causal tree (submit → verify → apply → close → persist); runs
+        # on the drain worker, off the close path, and the sampling gate
+        # bounds it
+        if results and tr.enabled:
+            for txid in results:
+                if tr.sampled(txid):
+                    tr.instant("persist.tx", "persist", txid=txid,
+                               ledger_seq=seq)
 
     # -- lifecycle ---------------------------------------------------------
 

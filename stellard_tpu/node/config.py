@@ -110,6 +110,17 @@ class Config:
     sntp_servers: list[str] = field(default_factory=list)  # host[:port]
     insight: str = ""  # '' | 'statsd:host:port[:prefix]'
 
+    # -- tracing plane ([trace]) -------------------------------------------
+    # enabled=1 (default): transaction-lifecycle spans recorded into a
+    # bounded ring buffer (node/tracer.py), exported via the
+    # trace_status/trace_dump admin RPCs (Chrome trace-event JSON) and
+    # span-derived stage percentiles through [insight]. sample is the
+    # deterministic per-transaction sampling rate (ledger-scoped spans
+    # are always recorded); capacity bounds the ring.
+    trace_enabled: bool = True
+    trace_capacity: int = 16384
+    trace_sample: float = 0.125
+
     # -- API doors ([rpc_*], [websocket_*]) --------------------------------
     rpc_ip: str = "127.0.0.1"
     rpc_port: Optional[int] = None  # None = disabled, 0 = ephemeral
@@ -201,6 +212,15 @@ class Config:
         cfg.validators_file = one("validators_file", cfg.validators_file)
         cfg.validators_site = one("validators_site", cfg.validators_site)
         cfg.insight = one("insight", cfg.insight)
+        trace = _kv(s.get("trace", []))
+        if "enabled" in trace:
+            cfg.trace_enabled = trace["enabled"].lower() not in (
+                "0", "false", "no", "off"
+            )
+        if "capacity" in trace:
+            cfg.trace_capacity = int(trace["capacity"])
+        if "sample" in trace:
+            cfg.trace_sample = float(trace["sample"])
         cfg.validators = [
             line.split()[0] for line in s.get("validators", [])
         ]  # reference allows trailing comments per line

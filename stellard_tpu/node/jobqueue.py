@@ -125,7 +125,9 @@ class _TypeStats:
 class JobQueue:
     """Priority thread pool with per-type concurrency limits."""
 
-    def __init__(self, threads: int = 4, name: str = "jobq"):
+    def __init__(self, threads: int = 4, name: str = "jobq", tracer=None):
+        from .tracer import get_tracer
+
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._heap: list[Job] = []
@@ -134,6 +136,7 @@ class JobQueue:
         self._stopping = False
         self._threads: list[threading.Thread] = []
         self._name = name
+        self.tracer = tracer if tracer is not None else get_tracer()
         self.set_thread_count(threads)
 
     # -- submission -------------------------------------------------------
@@ -215,6 +218,7 @@ class JobQueue:
                 st.queued -= 1
                 st.running += 1
             t0 = time.monotonic()
+            p0 = time.perf_counter()
             try:
                 job.work()
             except Exception:  # noqa: BLE001 — a job must never kill a worker
@@ -222,10 +226,22 @@ class JobQueue:
 
                 traceback.print_exc()
             now = time.monotonic()
+            p1 = time.perf_counter()
             ms = (now - t0) * 1000
             # load signal includes the time spent waiting in the queue
             # (reference: LoadMonitor::addSamples measures from queue entry)
             wait_ms = (now - job.queued_at) * 1000
+            # queue-wait vs run time per JobType for the tracing plane
+            # (the wait interval is re-anchored onto the tracer's clock:
+            # queued_at is monotonic, spans are perf_counter)
+            tr = self.tracer
+            if tr.enabled:
+                wait_s = max(0.0, t0 - job.queued_at)
+                jt = job.type.name
+                tr.complete(f"jobq.{jt}.wait", "jobq", p0 - wait_s, p0,
+                            job=job.name)
+                tr.complete(f"jobq.{jt}.run", "jobq", p0, p1,
+                            job=job.name)
             with self._lock:
                 st.running -= 1
                 st.finished += 1

@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import deque
 from typing import Callable, Optional
 
 from ..engine.engine import TransactionEngine, TxParams
@@ -22,6 +21,8 @@ from ..node.hashrouter import SF_SIGGOOD
 from ..protocol.sttx import SerializedTransaction
 from ..protocol.ter import TER
 from ..state.ledger import Ledger
+from .metrics import LatencyHist
+from .tracer import STAGE_BOUNDS, get_tracer
 
 __all__ = ["LedgerMaster", "CanonicalTXSet", "LEDGER_TOTAL_PASSES"]
 
@@ -61,10 +62,14 @@ class LedgerMaster:
     """Holds the chain: validated ←closed ←current(open)."""
 
     def __init__(
-        self, hash_batch: Optional[Callable] = None, router=None
+        self, hash_batch: Optional[Callable] = None, router=None,
+        tracer=None,
     ):
         self._lock = threading.RLock()
         self.hash_batch = hash_batch
+        # tracing plane: close-stage spans + per-tx splice/fallback marks
+        # (consensus rounds built over this chain trace through it too)
+        self.tracer = tracer if tracer is not None else get_tracer()
         # HashRouter: close-time re-application consults SF_SIGGOOD so
         # txs verified at submit are not host-re-verified per close
         # (reference: LedgerConsensus::applyTransaction skips checkSign
@@ -108,8 +113,14 @@ class LedgerMaster:
             "closes": 0, "spliced": 0, "fallback": 0, "invalidated": 0,
         }
         self.last_close: dict = {}
-        # per-close stage latencies (ms): apply pass, seal overlap, total
-        self.close_stage_ms: deque = deque(maxlen=256)
+        # per-close stage latency histograms (ms): apply pass, seal
+        # overlap, total — the shared metrics.LatencyHist (fine-grained
+        # bounds: closes live in the 1-500 ms band)
+        self.close_stage_hist: dict[str, LatencyHist] = {
+            "apply": LatencyHist(bounds=STAGE_BOUNDS, interpolate=True),
+            "seal": LatencyHist(bounds=STAGE_BOUNDS, interpolate=True),
+            "total": LatencyHist(bounds=STAGE_BOUNDS, interpolate=True),
+        }
 
     # -- bootstrap --------------------------------------------------------
 
@@ -193,7 +204,9 @@ class LedgerMaster:
         lock."""
         open_ledger = self.current_ledger()
         engine = TransactionEngine(open_ledger)
-        ter, applied = engine.apply_transaction(tx, params)
+        with self.tracer.span("open.apply", "apply", txid=tx.txid(),
+                              ledger_seq=open_ledger.seq):
+            ter, applied = engine.apply_transaction(tx, params)
         if applied:
             # seed the OPEN ledger's parsed-tx memo so the close path
             # reuses this exact object instead of re-parsing the blob
@@ -213,7 +226,9 @@ class LedgerMaster:
                     from ..engine.deltareplay import SpecState
 
                     spec = open_ledger._spec_state = SpecState(open_ledger)
-                spec.speculate(tx)
+                with self.tracer.span("open.speculate", "apply",
+                                      txid=tx.txid()):
+                    spec.speculate(tx)
         return ter, applied
 
     # -- close (standalone / consensus-accept share this tail) ------------
@@ -340,7 +355,7 @@ class LedgerMaster:
                 )
                 if ter == TER.terPRE_SEQ:
                     self.add_held_transaction(tx)
-            self._note_close_stages(t0, t_apply, t_seal)
+            self._note_close_stages(t0, t_apply, t_seal, new_lcl.seq)
             return new_lcl, results
 
     def close_with_txset(
@@ -396,7 +411,7 @@ class LedgerMaster:
                 )
                 if ter == TER.terPRE_SEQ:
                     self.add_held_transaction(tx)
-            self._note_close_stages(t0, t_apply, t_seal)
+            self._note_close_stages(t0, t_apply, t_seal, new_lcl.seq)
             return new_lcl, results
 
     def switch_lcl(self, ledger: Ledger) -> None:
@@ -506,11 +521,12 @@ class LedgerMaster:
         poisons its written keys (engine/deltareplay.py)."""
         results: dict[bytes, TER] = {}
         engine = TransactionEngine(ledger)
+        tracer = self.tracer
         replay = None
         if spec is not None and self.delta_replay:
             from ..engine.deltareplay import CloseReplay
 
-            replay = CloseReplay(spec, ledger)
+            replay = CloseReplay(spec, ledger, tracer=tracer)
 
         def apply_one(key_tx, final: bool):
             tx = key_tx[1]
@@ -523,6 +539,12 @@ class LedgerMaster:
             )
             if replay is not None:
                 replay.note_fallback(tx, engine, did_apply)
+            elif tracer.enabled and tracer.sampled(tx.txid()):
+                # serial close path (delta replay off / no spec): the
+                # per-tx close mark still lands in the causal tree
+                tracer.instant("close.tx", "close", txid=tx.txid(),
+                               mode="serial", ledger_seq=ledger.seq,
+                               ter=int(ter))
             return ter, did_apply
 
         remaining = txset.items_sorted()
@@ -562,34 +584,35 @@ class LedgerMaster:
         self.last_close.update(c)
 
     def _note_close_stages(self, t0: float, t_apply: float,
-                           t_seal: float) -> None:
+                           t_seal: float, seq: int) -> None:
         now = time.perf_counter()
         stages = {
             "apply_ms": round((t_apply - t0) * 1000.0, 3),
             "seal_ms": round((t_seal - t_apply) * 1000.0, 3),
             "total_ms": round((now - t0) * 1000.0, 3),
         }
-        self.close_stage_ms.append(stages)
+        self.close_stage_hist["apply"].record(stages["apply_ms"])
+        self.close_stage_hist["seal"].record(stages["seal_ms"])
+        self.close_stage_hist["total"].record(stages["total_ms"])
         self.last_close.update(stages)
+        tr = self.tracer
+        tr.complete("close.apply", "close", t0, t_apply, seq=seq)
+        tr.complete("close.seal", "close", t_apply, t_seal, seq=seq)
+        tr.complete("close.total", "close", t0, now, seq=seq)
 
     def delta_replay_json(self) -> dict:
         """spliced/fallback/invalidation counters + close-stage latency
         percentiles, for server_state / get_counts. Snapshots under the
         chain lock: RPC worker threads call this while the close thread
-        appends to the stage deque / merges last_close."""
+        records stages / merges last_close."""
         with self._lock:
             out = {
                 "enabled": self.delta_replay,
                 **self.delta_stats,
                 "last_close": dict(self.last_close),
             }
-            stages = list(self.close_stage_ms)
-        for stage in ("apply_ms", "seal_ms", "total_ms"):
-            if not stages:
-                break
-            vals = sorted(s[stage] for s in stages)
-            out[f"{stage.removesuffix('_ms')}_p50_ms"] = vals[len(vals) // 2]
-            out[f"{stage.removesuffix('_ms')}_p90_ms"] = vals[
-                min(len(vals) - 1, int(len(vals) * 0.9))
-            ]
+            if self.close_stage_hist["total"].count:
+                for stage, hist in self.close_stage_hist.items():
+                    out[f"{stage}_p50_ms"] = hist.quantile(0.5)
+                    out[f"{stage}_p90_ms"] = hist.quantile(0.9)
         return out

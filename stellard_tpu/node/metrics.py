@@ -22,10 +22,82 @@ __all__ = [
     "Counter",
     "Gauge",
     "Meter",
+    "LatencyHist",
     "CollectorManager",
     "NullCollector",
     "StatsDCollector",
 ]
+
+
+class LatencyHist:
+    """Fixed-bucket latency histogram (ms): tiny, lock-free enough for a
+    single-writer stage, read-mostly for metrics. The ONE percentile
+    implementation for the whole node — the close pipeline's stage
+    timers, the ledger master's close stages, the verify plane's batch
+    latencies, and the tracer's span-derived stage histograms all share
+    it (they used to carry three divergent ad-hoc copies).
+
+    Quantiles report the upper bound of the bucket holding the target
+    rank (0 when empty); `interpolate=True` refines that to a linear
+    estimate inside the holding bucket (used where the value feeds
+    round-over-round comparisons — bench provenance, close stages —
+    so a drifting p50 moves continuously instead of jumping a whole
+    bucket). `bounds` tunes resolution per instrument; the default
+    decade ladder matches the original close-pipeline buckets.
+    """
+
+    BOUNDS = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 250.0, 500.0,
+              1000.0, 5000.0)
+
+    def __init__(self, bounds: Optional[tuple] = None,
+                 interpolate: bool = False):
+        self.bounds = tuple(bounds) if bounds is not None else self.BOUNDS
+        self.interpolate = interpolate
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.total_ms = 0.0
+        self.max_ms = 0.0
+
+    def record(self, ms: float) -> None:
+        i = 0
+        for i, b in enumerate(self.bounds):  # noqa: B007
+            if ms <= b:
+                break
+        else:
+            i = len(self.bounds)
+        self.counts[i] += 1
+        self.count += 1
+        self.total_ms += ms
+        self.max_ms = max(self.max_ms, ms)
+
+    def quantile(self, q: float) -> float:
+        """Upper bucket bound holding the q-quantile (0 when empty);
+        with `interpolate`, the linear estimate inside that bucket."""
+        if not self.count:
+            return 0.0
+        target = q * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target:
+                hi = (self.bounds[i] if i < len(self.bounds)
+                      else self.bounds[-1] * 2)
+                if not self.interpolate or not c:
+                    return hi
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                frac = (target - (seen - c)) / c
+                return round(lo + frac * (hi - lo), 3)
+        return self.bounds[-1] * 2
+
+    def get_json(self) -> dict:
+        return {
+            "count": self.count,
+            "mean_ms": round(self.total_ms / self.count, 3) if self.count else 0.0,
+            "p50_ms": self.quantile(0.5),
+            "p90_ms": self.quantile(0.9),
+            "p99_ms": self.quantile(0.99),
+            "max_ms": round(self.max_ms, 3),
+        }
 
 
 class Counter:
@@ -169,22 +241,28 @@ class CollectorManager:
     def flush_once(self) -> list[str]:
         lines: list[str] = []
         with self._lock:
-            counters = list(self._counters.values())
             gauges = list(self._gauges.values())
             meters = list(self._meters.values())
             hooks = list(self._hooks.items())
-        for c in counters:
-            prev = self._last_counter_vals.get(c.name, 0)
-            delta = c.value - prev
-            self._last_counter_vals[c.name] = c.value
-            if delta:
-                lines.append(f"{c.name}:{delta}|c")
+            # counter deltas (and the last-seen map they depend on) are
+            # computed UNDER the registry lock: two concurrent flushes
+            # racing _last_counter_vals could double-report a delta
+            for c in list(self._counters.values()):
+                prev = self._last_counter_vals.get(c.name, 0)
+                delta = c.value - prev
+                self._last_counter_vals[c.name] = c.value
+                if delta:
+                    lines.append(f"{c.name}:{delta}|c")
         for g in gauges:
             lines.append(f"{g.name}:{g.value:g}|g")
         for m in meters:
             n = m.drain()
             if n:
-                lines.append(f"{m.name}:{n}|m")
+                # meters drain per-interval event counts; statsd has no
+                # "|m" type (real daemons drop unknown types on the
+                # floor), so they ship as counters — same delta
+                # semantics, a type the server actually aggregates
+                lines.append(f"{m.name}:{n}|c")
         for name, fn in hooks:
             try:
                 for suffix, value in fn().items():

@@ -70,11 +70,15 @@ class NetworkOPs:
         hash_router: HashRouter,
         standalone: bool = True,
         fee_track=None,
+        tracer=None,
     ):
+        from .tracer import get_tracer
+
         self.lm = ledger_master
         self.jq = job_queue
         self.vp = verify_plane
         self.router = hash_router
+        self.tracer = tracer if tracer is not None else get_tracer()
         self.fee_track = fee_track  # loadmgr.LoadFeeTrack or None
         self.standalone = standalone
         self.mode = OperatingMode.FULL if standalone else OperatingMode.DISCONNECTED
@@ -132,21 +136,33 @@ class NetworkOPs:
                 cb(tx, TER.telINSUF_FEE_P, False)
             return
         txid = tx.txid()
+        tr = self.tracer
+        # root of the transaction's causal span tree (trace id = txid):
+        # every later stage — verify wait, intake process, open apply,
+        # close splice/fallback, persist — links back to this span
+        sub = tr.begin("submit", "submit", txid=txid)
         flags = self.router.get_flags(txid)
         if flags & SF_BAD:
+            tr.end(sub, outcome="known_bad")
             if cb:
                 cb(tx, TER.temINVALID, False)
             return
         if flags & SF_SIGGOOD:
             tx.set_sig_verdict(True)
-            self._enqueue_intake(tx, cb)
+            tr.end(sub, outcome="cached_sig")
+            self._enqueue_intake(tx, cb, parent=sub)
             return
+        # cross-thread span: begins here, ends on the verify plane's
+        # flusher thread when the coalesced batch completes the future
+        vtok = tr.begin("verify.wait", "verify", txid=txid, parent=sub)
+        tr.end(sub, outcome="verify_queued")
         fut = self.vp.submit(
             VerifyRequest(tx.signing_pub_key, tx.signing_hash(), tx.signature)
         )
 
         def when_done(f):
             good = bool(f.result()) if not f.exception() else False
+            tr.end(vtok, good=good)
             tx.set_sig_verdict(good)
             self.router.set_flag(txid, SF_SIGGOOD if good else SF_BAD)
             if not good:
@@ -154,11 +170,11 @@ class NetworkOPs:
                 if cb:
                     cb(tx, TER.temINVALID, False)
                 return
-            self._enqueue_intake(tx, cb)
+            self._enqueue_intake(tx, cb, parent=vtok)
 
         fut.add_done_callback(when_done)
 
-    def _enqueue_intake(self, tx, cb) -> None:
+    def _enqueue_intake(self, tx, cb, parent=None) -> None:
         """Ordered intake: verified txs drain FIFO under ONE
         jtTRANSACTION job at a time. One job per tx let the worker pool
         race same-account bursts out of sequence order — a 3000-tx
@@ -170,7 +186,7 @@ class NetworkOPs:
         jobs work there because holds are rare on real traffic; the
         coalescing verify plane makes bursts the NORM here.)"""
         with self._intake_lock:
-            self._intake.append((tx, cb))
+            self._intake.append((tx, cb, parent))
             if self._intake_scheduled:
                 return
             self._intake_scheduled = True
@@ -183,7 +199,7 @@ class NetworkOPs:
                 stranded = list(self._intake)
                 self._intake.clear()
                 self._intake_scheduled = False
-            for s_tx, s_cb in stranded:
+            for s_tx, s_cb, _par in stranded:
                 if s_cb:
                     s_cb(s_tx, TER.telINSUF_FEE_P, False)
 
@@ -195,9 +211,9 @@ class NetworkOPs:
                         return
                     batch = list(self._intake)
                     self._intake.clear()
-                for tx, cb in batch:
+                for tx, cb, parent in batch:
                     try:
-                        self._process_cb(tx, cb)
+                        self._process_cb(tx, cb, parent)
                     except Exception:  # noqa: BLE001 — one bad tx must not
                         # drop the rest of the batch (the per-tx-job design
                         # this replaces lost only the failing tx)
@@ -223,12 +239,16 @@ class NetworkOPs:
                     stranded = list(self._intake)
                     self._intake.clear()
                     self._intake_scheduled = False
-                for s_tx, s_cb in stranded:
+                for s_tx, s_cb, _par in stranded:
                     if s_cb:
                         s_cb(s_tx, TER.telINSUF_FEE_P, False)
 
-    def _process_cb(self, tx, cb):
-        ter, applied = self.process_transaction(tx)
+    def _process_cb(self, tx, cb, parent=None):
+        # the process span parents the open-apply/speculation spans
+        # recorded inside do_transaction (same thread, tls stack)
+        with self.tracer.span("process", "submit", txid=tx.txid(),
+                              parent=parent):
+            ter, applied = self.process_transaction(tx)
         if cb:
             cb(tx, ter, applied)
 

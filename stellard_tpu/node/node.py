@@ -139,6 +139,14 @@ class Node:
         self.config = config or Config()
         cfg = self.config
 
+        # tracing plane FIRST ([trace]): every subsystem below records
+        # its lifecycle spans into this node's ring (node/tracer.py);
+        # trace_status/trace_dump serve it, [insight] ships span-derived
+        # stage percentiles
+        from .tracer import Tracer
+
+        self.tracer = Tracer.from_config(cfg)
+
         # storage plane (reference: NodeStore Manager + main db :330)
         self.nodestore = make_database(
             type=cfg.node_db_type,
@@ -176,6 +184,7 @@ class Node:
             clf_stage=self._commit_clf,
             recover_results=_results_from_meta,
             depth=cfg.close_pipeline_depth,
+            tracer=self.tracer,
         )
 
         # crypto plane (north star: pluggable cpu|tpu batch backends).
@@ -225,6 +234,7 @@ class Node:
             window_ms=cfg.verify_batch_window_ms,
             max_batch=cfg.verify_max_batch,
             min_device_batch=cfg.verify_min_device_batch,
+            tracer=self.tracer,
         )
         self.verify_prewarm: Optional[threading.Thread] = None
         if cfg.signature_backend != "cpu":
@@ -234,7 +244,9 @@ class Node:
             self.verify_prewarm = self.verify_plane.start_prewarm()
 
         # executor (reference: JobQueue :287)
-        self.job_queue = JobQueue(threads=cfg.thread_count())
+        self.job_queue = JobQueue(
+            threads=cfg.thread_count(), tracer=self.tracer
+        )
         self.hash_router = HashRouter()
 
         # load plane (reference: LoadFeeTrack :346, LoadManager :354)
@@ -446,9 +458,13 @@ class Node:
         # ledger chain + brain (networked: the overlay's chain IS ours)
         if self.overlay is not None:
             self.ledger_master = self.overlay.node.lm
+            # the overlay built its own chain before our tracer existed;
+            # repoint it so consensus/close spans land in THIS node's ring
+            self.ledger_master.tracer = self.tracer
         else:
             self.ledger_master = LedgerMaster(
-                hash_batch=self.hasher, router=self.hash_router
+                hash_batch=self.hasher, router=self.hash_router,
+                tracer=self.tracer,
             )
 
         def _fetch_fallback(h: bytes):
@@ -498,6 +514,7 @@ class Node:
             self.hash_router,
             standalone=cfg.standalone,
             fee_track=self.fee_track,
+            tracer=self.tracer,
         )
         # configured skew applies to the ops-plane clock too (standalone
         # closes, status, staleness checks); the SNTP heartbeat COMPOSES
@@ -716,6 +733,9 @@ class Node:
                 "backpressure_waits": self.close_pipeline.backpressure_waits,
             },
         )
+        # span-derived per-stage latency percentiles (trace.<stage>.p50_ms
+        # et al.): the unified latency surface the tracing plane feeds
+        self.collector.hook("trace", self.tracer.statsd_hook)
         self.collector.hook(
             "delta_replay",
             # snapshot via delta_replay_json: it takes the chain lock, so

@@ -318,9 +318,13 @@ class ValidatorNode:
                 return TER.temINVALID, False
             self.router.set_flag(txid, SF_SIGGOOD)
         tx.set_sig_verdict(True)
-        ter, applied = self.lm.do_transaction(
-            tx, TxParams.OPEN_LEDGER | TxParams.RETRY
-        )
+        with self.lm.tracer.span(
+            "submit", "submit", txid=txid,
+            source="local" if local else "overlay",
+        ):
+            ter, applied = self.lm.do_transaction(
+                tx, TxParams.OPEN_LEDGER | TxParams.RETRY
+            )
         if ter == TER.terPRE_SEQ:
             self.lm.add_held_transaction(tx)
         if local and not ter.is_tem:
@@ -449,6 +453,13 @@ class ValidatorNode:
             self.router.set_flag(vid, SF_SIGGOOD)
         val.set_sig_verdict(True)
         with self.lock:
+            # validation arrival on the round timeline (trace id = the
+            # validated ledger's seq when the peer reported one)
+            self.lm.tracer.instant(
+                "consensus.validation_in", "consensus",
+                seq=val.ledger_seq,
+                peer=val.signer.hex()[:16] if val.signer else None,
+            )
             current = self.validations.add(val)
             self.lm.check_accept(
                 val.ledger_hash,

@@ -39,13 +39,16 @@ from ..utils.devicewatch import (
     call_with_deadline,
     resolve_timeouts,
 )
+from .metrics import LatencyHist
 
 log = logging.getLogger("stellard.device")
 
 __all__ = ["VerifyPlane"]
 
-# histogram bucket upper bounds (ms)
-_HIST_EDGES = [1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, float("inf")]
+# per-batch latency bucket upper bounds (ms); the +inf overflow bucket
+# is implicit in LatencyHist
+_HIST_BOUNDS = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
+                1000.0)
 
 
 class _LatencyModel:
@@ -162,7 +165,11 @@ class VerifyPlane:
         cpu_fallback: Optional[BatchVerifier] = None,
         device_first_timeout: Optional[float] = None,
         device_warm_timeout: Optional[float] = None,
+        tracer=None,
     ):
+        from .tracer import get_tracer
+
+        self.tracer = tracer if tracer is not None else get_tracer()
         self.backend_name = backend
         self.verifier: BatchVerifier = make_verifier(backend)
         self.cpu: BatchVerifier = cpu_fallback or (
@@ -203,9 +210,9 @@ class VerifyPlane:
         # the leg still reports a healthy ~1.0 ratio (VERDICT r3 weak #6)
         self.device_sigs = 0
         self.cpu_sigs = 0
-        self._hist: dict[str, list[int]] = {
-            "cpu": [0] * len(_HIST_EDGES),
-            "device": [0] * len(_HIST_EDGES),
+        self._hist: dict[str, LatencyHist] = {
+            "cpu": LatencyHist(bounds=_HIST_BOUNDS),
+            "device": LatencyHist(bounds=_HIST_BOUNDS),
         }
         self._flusher = threading.Thread(
             target=self._flush_loop, name="verify-plane", daemon=True
@@ -258,11 +265,7 @@ class VerifyPlane:
     # -- blocking whole-batch path ---------------------------------------
 
     def _record(self, kind: str, ms: float) -> None:
-        hist = self._hist[kind]
-        for i, edge in enumerate(_HIST_EDGES):
-            if ms <= edge:
-                hist[i] += 1
-                break
+        self._hist[kind].record(ms)
 
     def _pad_buckets(self, n: int) -> set[int]:
         """Pad-bucket shapes the device verifier will compile for a batch
@@ -359,6 +362,7 @@ class VerifyPlane:
             and not self._prewarm_pending
             and self.model.use_device(n)
         )
+        wedged_now = False
         if use_device:
             t0 = time.perf_counter()
             try:
@@ -367,7 +371,8 @@ class VerifyPlane:
                     self._device_deadline(n),
                     label="verify-device",
                 )
-                ms = (time.perf_counter() - t0) * 1000.0
+                t1 = time.perf_counter()
+                ms = (t1 - t0) * 1000.0
                 self._mark_warm(n)
                 self.model.observe_device(n, ms)
                 self.device_batches += 1
@@ -375,22 +380,35 @@ class VerifyPlane:
                 self._record("device", ms)
                 self.batches += 1
                 self.verified += n
+                # batch formation + routing decision evidence: size and
+                # the side the latency model picked, kernel wall time as
+                # the span duration
+                self.tracer.complete(
+                    "verify.batch", "verify", t0, t1,
+                    n=n, routed="device",
+                )
                 return out
             except DeviceWedged as exc:
                 # wedged tunnel: device plane is dead for the process;
                 # this batch (and all future ones) verifies on the CPU
                 self._device_capable = False
                 self.device_wedged = True
+                wedged_now = True
                 log.error("verify plane: %s — falling back to CPU", exc)
         t0 = time.perf_counter()
         out = self.cpu.verify_batch(reqs)
-        ms = (time.perf_counter() - t0) * 1000.0
+        t1 = time.perf_counter()
+        ms = (t1 - t0) * 1000.0
         self.model.observe_cpu(n, ms)
         self.cpu_batches += 1
         self.cpu_sigs += n
         self._record("cpu", ms)
         self.batches += 1
         self.verified += n
+        self.tracer.complete(
+            "verify.batch", "verify", t0, t1, n=n, routed="cpu",
+            **({"wedged_fallback": True} if wedged_now else {}),
+        )
         return out
 
     def stop(self) -> None:
@@ -427,8 +445,10 @@ class VerifyPlane:
             "pending": len(self._pending),
             "model": model,
             "latency_histogram_ms": {
-                "edges": [e for e in _HIST_EDGES if e != float("inf")],
-                "cpu": list(self._hist["cpu"]),
-                "device": list(self._hist["device"]),
+                "edges": list(_HIST_BOUNDS),
+                "cpu": list(self._hist["cpu"].counts),
+                "device": list(self._hist["device"].counts),
+                "cpu_quantiles": self._hist["cpu"].get_json(),
+                "device_quantiles": self._hist["device"].get_json(),
             },
         }

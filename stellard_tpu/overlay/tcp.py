@@ -775,6 +775,12 @@ class TcpOverlay(ConsensusAdapter):
                   else SerializedTransaction.from_bytes(msg.blob))
             txid = tx.txid()
             if self._first_seen(txid, peer):
+                # trace root for an overlay-relayed tx: the first sighting
+                # on this node (the local-submit root is NetworkOPs')
+                node.lm.tracer.instant(
+                    "overlay.tx_in", "submit", txid=txid,
+                    peer=peer.remote[0] if peer.remote else None,
+                )
                 if node.handle_tx(tx):
                     self._relay(msg, except_peer=peer)
                 else:

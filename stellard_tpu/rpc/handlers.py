@@ -323,6 +323,15 @@ def do_server_state(ctx: Context) -> dict:
     # delta-replay close: spliced/fallback/invalidation counters +
     # close-stage (apply/seal/total) latency percentiles
     state["delta_replay"] = node.ledger_master.delta_replay_json()
+    tracer = getattr(node, "tracer", None)
+    if tracer is not None:
+        # tracing plane status; the consensus/close timeline is ADMIN
+        # only — its events carry txids and peer key prefixes, which a
+        # GUEST-reachable method must not leak (trace_status/trace_dump
+        # serve the full detail behind the ADMIN gate)
+        state["trace"] = tracer.status_json(
+            timeline=(ctx.role == Role.ADMIN)
+        )
     return {"state": state}
 
 
@@ -347,10 +356,33 @@ def do_get_counts(ctx: Context) -> dict:
         out["close_pipeline"] = pipeline.get_json()
         out["persist_backlog"] = pipeline.pending()
     out["delta_replay"] = node.ledger_master.delta_replay_json()
+    tracer = getattr(node, "tracer", None)
+    if tracer is not None:
+        out["trace"] = tracer.status_json()  # ADMIN method: timeline ok
     overlay = getattr(node, "overlay", None)
     if overlay is not None:
         out["peers"] = overlay.peer_count()
     return out
+
+
+@handler("trace_status", Role.ADMIN)
+def do_trace_status(ctx: Context) -> dict:
+    """Tracing-plane status: [trace] knobs, ring occupancy, span-derived
+    per-stage latency quantiles, and the recent consensus/close
+    timeline."""
+    return {"trace": ctx.node.tracer.status_json()}
+
+
+@handler("trace_dump", Role.ADMIN)
+def do_trace_dump(ctx: Context) -> dict:
+    """Dump the span ring as Chrome trace-event JSON — loadable directly
+    in Perfetto / chrome://tracing (tools/traceview.py wraps fetch +
+    schema validation). Params: {"reset": true} drains atomically —
+    snapshot + ring clear under one lock hold — so successive dumps
+    window cleanly with no span lost between windows."""
+    return ctx.node.tracer.chrome_trace(
+        reset=bool(ctx.params.get("reset"))
+    )
 
 
 @handler("consensus_info", Role.ADMIN)

@@ -72,12 +72,46 @@ class TestMetrics:
         lines = mgr.flush_once()
         assert "tx.processed:5|c" in lines
         assert "jobq.depth:17|g" in lines
-        assert "peer.msgs:3|m" in lines
+        # meters ship as counters: "|m" is not a statsd metric type and
+        # real statsd daemons drop unknown types on the floor
+        assert "peer.msgs:3|c" in lines
+        assert not any(line.endswith("|m") for line in lines)
         assert "verify.batches:2|g" in lines
         # counters flush deltas, not totals
         mgr.counter("tx.processed").inc(2)
         lines = mgr.flush_once()
         assert "tx.processed:2|c" in lines
+        # meters drain per flush: nothing marked since -> no line
+        assert not any(line.startswith("peer.msgs:") for line in lines)
+
+    def test_concurrent_flushes_never_double_report_counter_deltas(self):
+        """_last_counter_vals updates under _lock: racing flushes must
+        partition a counter's increments, never double-count them."""
+        mgr = CollectorManager(NullCollector())
+        c = mgr.counter("races")
+        seen: list[int] = []
+        stop = threading.Event()
+
+        def flusher():
+            while not stop.is_set():
+                for line in mgr.flush_once():
+                    if line.startswith("races:"):
+                        seen.append(int(line.split(":")[1].split("|")[0]))
+
+        threads = [threading.Thread(target=flusher) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for _ in range(2000):
+            c.inc()
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        seen.extend(
+            int(line.split(":")[1].split("|")[0])
+            for line in mgr.flush_once()
+            if line.startswith("races:")
+        )
+        assert sum(seen) == 2000
 
     def test_statsd_udp_export(self):
         rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)

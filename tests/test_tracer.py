@@ -1,0 +1,385 @@
+"""Tracing plane: the transaction-lifecycle span recorder.
+
+Covers the contracts every future perf PR will argue from:
+- span nesting (thread-local parent stack) and cross-thread handoff
+  (begin on one thread, end on another, parent links intact);
+- bounded ring: wraparound overwrites oldest, drop accounting is exact;
+- sampling determinism: the record/skip decision is a pure function of
+  (txid, rate), so a sampled tx gets its WHOLE tree and an unsampled
+  one contributes nothing anywhere;
+- Chrome trace-event schema of the `trace_dump` RPC (validated with the
+  same hand-rolled validator tools/traceview.py and the tier-1 smoke
+  gate use) and the causal span tree per transaction across
+  submit → verify → close → persist;
+- span-derived stage percentiles through the CollectorManager hook
+  (statsd gauge line format);
+- the overhead budget: tracing enabled must not regress close p50 by
+  more than the 2% budget (interleaved best-of reps, tier-1).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from traceview import validate_chrome_trace, validate_span_trees  # noqa: E402
+
+from stellard_tpu.node.config import Config  # noqa: E402
+from stellard_tpu.node.metrics import CollectorManager, NullCollector  # noqa: E402
+from stellard_tpu.node.node import Node  # noqa: E402
+from stellard_tpu.node.tracer import Tracer, get_tracer  # noqa: E402
+from stellard_tpu.protocol.formats import TxType  # noqa: E402
+from stellard_tpu.protocol.keys import KeyPair  # noqa: E402
+from stellard_tpu.protocol.sfields import sfAmount, sfDestination  # noqa: E402
+from stellard_tpu.protocol.stamount import STAmount  # noqa: E402
+from stellard_tpu.protocol.sttx import SerializedTransaction  # noqa: E402
+from stellard_tpu.rpc.handlers import Context, Role, dispatch  # noqa: E402
+
+MASTER = KeyPair.from_passphrase("masterpassphrase")
+DESTS = [KeyPair.from_passphrase(f"tr-dest-{i}").account_id for i in range(4)]
+
+
+def _payments(n, start_seq=1):
+    txs = []
+    for i in range(n):
+        tx = SerializedTransaction.build(
+            TxType.ttPAYMENT, MASTER.account_id, start_seq + i, 10,
+            {sfAmount: STAmount.from_drops(250_000_000),
+             sfDestination: DESTS[i % len(DESTS)]},
+        )
+        tx.sign(MASTER)
+        txs.append(tx)
+    return txs
+
+
+def _flood(node, txs, per_ledger=50):
+    """Full async pipeline submit (verify plane -> intake -> open
+    ledger), closing every per_ledger; -> per-close wall ms."""
+    done = threading.Semaphore(0)
+    close_ms = []
+    for start in range(0, len(txs), per_ledger):
+        part = [
+            SerializedTransaction.from_bytes(t.serialize())
+            for t in txs[start:start + per_ledger]
+        ]
+        for tx in part:
+            node.ops.submit_transaction(tx, lambda *_a: done.release())
+        for _ in part:
+            done.acquire()
+        t0 = time.perf_counter()
+        node.ops.accept_ledger()
+        close_ms.append((time.perf_counter() - t0) * 1000.0)
+    return close_ms
+
+
+class TestRecorder:
+    def test_span_nesting_links_parents(self):
+        tr = Tracer(capacity=64, sample=1.0)
+        with tr.span("outer", "test") as outer:
+            with tr.span("inner", "test") as inner:
+                assert inner.parent == outer.span_id
+            with tr.span("inner2", "test") as inner2:
+                assert inner2.parent == outer.span_id
+        events = tr.chrome_trace()["traceEvents"]
+        by_name = {e["name"]: e for e in events}
+        assert by_name["inner"]["args"]["parent"] == by_name["outer"]["args"]["span"]
+        assert by_name["outer"]["args"].get("parent") is None
+        # children recorded before the parent ends, all phases complete
+        assert all(e["ph"] == "X" for e in events)
+
+    def test_cross_thread_handoff(self):
+        """begin() on one thread, end() on another: duration measured
+        across the handoff, parent chain intact."""
+        tr = Tracer(capacity=64, sample=1.0)
+        tok = tr.begin("handoff", "test", txid=b"\x01" * 32)
+        child_ids = []
+
+        def other():
+            child = tr.begin("child", "test", txid=b"\x01" * 32, parent=tok)
+            child_ids.append(child.span_id)
+            tr.end(child)
+            tr.end(tok, outcome="done")
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        events = tr.chrome_trace()["traceEvents"]
+        by_name = {e["name"]: e for e in events}
+        assert by_name["child"]["args"]["parent"] == tok.span_id
+        assert by_name["handoff"]["args"]["outcome"] == "done"
+        assert by_name["handoff"]["args"]["trace"] == ("01" * 32)
+
+    def test_end_accepts_none_token(self):
+        """Callers never branch on the sampling decision: end(None) is a
+        no-op (the begin() returned None for an unsampled tx)."""
+        tr = Tracer(capacity=64, sample=0.0)
+        tok = tr.begin("skipped", "test", txid=b"\x02" * 32)
+        assert tok is None
+        tr.end(tok)  # must not raise
+        assert tr.chrome_trace()["traceEvents"] == []
+
+    def test_ring_wraparound(self):
+        tr = Tracer(capacity=16, sample=1.0)
+        for i in range(40):
+            tr.instant(f"ev-{i}", "test")
+        j = tr.get_json()
+        assert j["recorded"] == 40
+        assert j["buffered"] == 16
+        assert j["dropped"] == 24
+        events = tr.chrome_trace()["traceEvents"]
+        assert len(events) == 16
+        # oldest overwritten: exactly the last 16, in order
+        assert [e["name"] for e in events] == [f"ev-{i}" for i in range(24, 40)]
+
+    def test_sampling_determinism(self):
+        txids = [bytes([i]) * 32 for i in range(200)]
+        a = Tracer(sample=0.25)
+        b = Tracer(sample=0.25)
+        va = [a.sampled(t) for t in txids]
+        vb = [b.sampled(t) for t in txids]
+        assert va == vb, "decision must be a pure function of (txid, rate)"
+        assert any(va) and not all(va)
+        # rate edges
+        assert all(Tracer(sample=1.0).sampled(t) for t in txids)
+        assert not any(Tracer(sample=0.0).sampled(t) for t in txids)
+        # a sampled-out tx records nothing through any path
+        t_out = next(t for t, v in zip(txids, va) if not v)
+        a.instant("close.tx", "close", txid=t_out)
+        with a.span("open.apply", "apply", txid=t_out):
+            pass
+        assert a.chrome_trace()["traceEvents"] == []
+        # disabled tracer records nothing at all
+        off = Tracer(enabled=False)
+        off.instant("x", "test")
+        assert not off.sampled(b"\x03" * 32)
+        assert off.chrome_trace()["traceEvents"] == []
+
+    def test_ledger_spans_bypass_sampling(self):
+        tr = Tracer(sample=0.0)
+        t0 = time.perf_counter()
+        tr.complete("close.total", "close", t0, t0 + 0.01, seq=7)
+        events = tr.chrome_trace()["traceEvents"]
+        assert len(events) == 1
+        assert events[0]["args"]["trace"] == "ledger-7"
+
+    def test_stage_hist_and_statsd_hook(self):
+        """Span durations feed the per-stage LatencyHist; the collector
+        hook ships p50/p90/p99 as statsd gauges."""
+        tr = Tracer(sample=1.0)
+        t0 = time.perf_counter()
+        for ms in (2.0, 4.0, 6.0, 8.0, 100.0):
+            tr.complete("close.apply", "close", t0, t0 + ms / 1000.0, seq=1)
+        hook = tr.statsd_hook()
+        assert hook["close.apply.p50_ms"] > 0
+        assert hook["close.apply.p99_ms"] >= hook["close.apply.p50_ms"]
+        mgr = CollectorManager(NullCollector())
+        mgr.hook("trace", tr.statsd_hook)
+        lines = mgr.flush_once()
+        assert any(
+            line.startswith("trace.close.apply.p50_ms:") and line.endswith("|g")
+            for line in lines
+        )
+
+    def test_reset(self):
+        tr = Tracer(capacity=32, sample=1.0)
+        tr.instant("a", "test")
+        tr.reset()
+        j = tr.get_json()
+        assert j["recorded"] == 0 and j["stages"] == {}
+
+
+class TestConfig:
+    def test_trace_section_parses(self):
+        cfg = Config.from_ini("[trace]\nenabled=0\ncapacity=512\nsample=0.5\n")
+        assert cfg.trace_enabled is False
+        assert cfg.trace_capacity == 512
+        assert cfg.trace_sample == 0.5
+        # defaults: sampled-on
+        d = Config()
+        assert d.trace_enabled is True
+        assert 0.0 < d.trace_sample <= 1.0
+        tr = Tracer.from_config(cfg)
+        assert tr.enabled is False and tr.capacity == 512
+
+    def test_default_tracer_exists(self):
+        assert get_tracer() is get_tracer()
+
+
+class TestEndToEnd:
+    def test_trace_dump_schema_and_span_trees(self):
+        """A traced flood produces a valid Chrome trace whose every tx
+        trace spans submit, verify, close, and persist stages with
+        resolvable parent links — via the real RPC handler."""
+        node = Node(Config(trace_sample=1.0)).setup()
+        try:
+            _flood(node, _payments(40), per_ledger=20)
+            assert node.close_pipeline.flush(timeout=60)
+            dump = dispatch(Context(node, {}), "trace_dump")
+            assert validate_chrome_trace(dump) == []
+            assert validate_span_trees(dump) == []
+            events = dump["traceEvents"]
+            names = {e["name"] for e in events}
+            # the pipeline's load-bearing stages all surface
+            for expected in ("submit", "verify.wait", "process",
+                             "open.apply", "verify.batch", "close.apply",
+                             "close.total", "close.tx", "persist.nodestore",
+                             "persist.txdb", "persist.clf", "persist.tx",
+                             "jobq.jtTRANSACTION.run"):
+                assert expected in names, f"missing {expected}"
+            # per-tx causal chain: submit -> verify.wait -> process
+            tx_traces = {
+                (e.get("args") or {}).get("trace")
+                for e in events
+                if len((e.get("args") or {}).get("trace") or "") == 64
+            }
+            assert len(tx_traces) == 40
+        finally:
+            node.stop()
+
+    def test_trace_status_and_counts_surface(self):
+        node = Node(Config(trace_sample=1.0)).setup()
+        try:
+            _flood(node, _payments(10), per_ledger=10)
+            assert node.close_pipeline.flush(timeout=60)
+            status = dispatch(Context(node, {}), "trace_status")["trace"]
+            assert status["enabled"] is True
+            assert status["recorded"] > 0
+            assert "close.total" in status["stages"]
+            assert status["stages"]["close.total"]["count"] == 1
+            # timeline block in server_state + get_counts (ADMIN)
+            state = dispatch(Context(node, {}), "server_state")["state"]
+            assert any(
+                ev["name"] == "close.total" for ev in state["trace"]["timeline"]
+            )
+            counts = dispatch(Context(node, {}), "get_counts")
+            assert counts["trace"]["recorded"] > 0
+            # GUEST server_state gets aggregate status only — the
+            # timeline carries txids/peer prefixes and must not leak
+            # past the ADMIN gate trace_status/trace_dump sit behind
+            guest = dispatch(
+                Context(node, {}, role=Role.GUEST), "server_state"
+            )["state"]
+            assert "timeline" not in guest["trace"]
+            assert guest["trace"]["recorded"] > 0
+            assert "error" in dispatch(
+                Context(node, {}, role=Role.GUEST), "trace_dump"
+            )
+            # close-stage percentiles still surface (now LatencyHist-fed)
+            assert "apply_p50_ms" in state["delta_replay"]
+        finally:
+            node.stop()
+
+    def test_trace_dump_reset_windows(self):
+        node = Node(Config(trace_sample=1.0)).setup()
+        try:
+            _flood(node, _payments(5), per_ledger=5)
+            dump = dispatch(Context(node, {"reset": True}), "trace_dump")
+            assert len(dump["traceEvents"]) > 0
+            dump2 = dispatch(Context(node, {}), "trace_dump")
+            # only events recorded after the reset (possibly none)
+            assert len(dump2["traceEvents"]) < len(dump["traceEvents"])
+        finally:
+            node.stop()
+
+    def test_sampling_prunes_whole_trees(self):
+        """At a fractional rate, an unsampled tx appears NOWHERE (no
+        orphan stage events), and sampled txs keep complete trees."""
+        node = Node(Config(trace_sample=0.25)).setup()
+        try:
+            txs = _payments(60)
+            _flood(node, txs, per_ledger=30)
+            assert node.close_pipeline.flush(timeout=60)
+            dump = dispatch(Context(node, {}), "trace_dump")
+            tracer = node.tracer
+            sampled = {t.txid().hex() for t in txs if tracer.sampled(t.txid())}
+            assert 0 < len(sampled) < 60
+            seen = {}
+            for ev in dump["traceEvents"]:
+                trace = (ev.get("args") or {}).get("trace")
+                if trace and len(trace) == 64:
+                    seen.setdefault(trace, set()).add(ev.get("cat"))
+            assert set(seen) == sampled
+            for cats in seen.values():
+                assert {"submit", "verify", "close", "persist"} <= cats
+        finally:
+            node.stop()
+
+
+class TestOverhead:
+    def test_close_p50_overhead_budget(self):
+        """Tracing enabled (default sampled-on) must cost < 2% close p50
+        vs tracing disabled. Interleaved best-of-3 reps (the PERF.md
+        convention) with a small absolute floor so a noisy CI box can't
+        flake a sub-millisecond delta."""
+        txs = _payments(300)
+        best = {"on": float("inf"), "off": float("inf")}
+        for _rep in range(3):
+            for mode, enabled in (("off", False), ("on", True)):
+                node = Node(Config(trace_enabled=enabled)).setup()
+                try:
+                    close_ms = sorted(_flood(node, txs, per_ledger=100))
+                    p50 = close_ms[len(close_ms) // 2]
+                    best[mode] = min(best[mode], p50)
+                finally:
+                    node.stop()
+        assert best["on"] <= best["off"] * 1.02 + 1.0, (
+            f"tracing overhead over budget: enabled p50 {best['on']:.2f}ms "
+            f"vs disabled {best['off']:.2f}ms"
+        )
+
+
+class TestValidator:
+    def test_schema_validator_catches_breakage(self):
+        assert validate_chrome_trace({"traceEvents": []}) == []
+        assert validate_chrome_trace([]) != []
+        assert validate_chrome_trace({}) != []
+        bad_phase = {"traceEvents": [
+            {"name": "x", "ph": "Z", "ts": 0, "pid": 1, "tid": 1}
+        ]}
+        assert validate_chrome_trace(bad_phase) != []
+        missing_dur = {"traceEvents": [
+            {"name": "x", "ph": "X", "ts": 0, "pid": 1, "tid": 1}
+        ]}
+        assert validate_chrome_trace(missing_dur) != []
+        neg_ts = {"traceEvents": [
+            {"name": "x", "ph": "i", "s": "t", "ts": -5, "pid": 1, "tid": 1}
+        ]}
+        assert validate_chrome_trace(neg_ts) != []
+        ok = {"traceEvents": [
+            {"name": "x", "ph": "X", "ts": 1, "dur": 2, "pid": 1, "tid": 1,
+             "cat": "c", "args": {"trace": "ab"}},
+            {"name": "y", "ph": "i", "s": "t", "ts": 1, "pid": 1, "tid": 1},
+        ]}
+        assert validate_chrome_trace(ok) == []
+
+    def test_span_tree_validator_catches_breakage(self):
+        txid = "ab" * 32
+        complete = {"traceEvents": [
+            {"name": "submit", "cat": "submit", "ph": "X", "ts": 0, "dur": 1,
+             "pid": 1, "tid": 1, "args": {"trace": txid, "span": 1}},
+            {"name": "verify.wait", "cat": "verify", "ph": "X", "ts": 1,
+             "dur": 1, "pid": 1, "tid": 1,
+             "args": {"trace": txid, "span": 2, "parent": 1}},
+            {"name": "close.tx", "cat": "close", "ph": "i", "s": "t", "ts": 2,
+             "pid": 1, "tid": 1, "args": {"trace": txid, "span": 3}},
+            {"name": "persist.tx", "cat": "persist", "ph": "i", "s": "t",
+             "ts": 3, "pid": 1, "tid": 1, "args": {"trace": txid, "span": 4}},
+        ]}
+        assert validate_span_trees(complete) == []
+        # drop the persist stage -> broken tree reported
+        partial = {"traceEvents": complete["traceEvents"][:-1]}
+        assert any("persist" in p for p in validate_span_trees(partial))
+        # dangling parent reference reported
+        dangling = {"traceEvents": [
+            dict(complete["traceEvents"][0],
+                 args={"trace": txid, "span": 9, "parent": 777}),
+        ]}
+        probs = validate_span_trees(dangling)
+        assert any("parent" in p for p in probs)
+        assert validate_span_trees({"traceEvents": []}) != []

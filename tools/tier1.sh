@@ -19,6 +19,16 @@ if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 fi
 tail -1 /tmp/_t1_collect.log
 
+echo "== trace smoke gate (flood -> trace_dump -> schema + span trees) =="
+# boots a standalone node, floods ~200 txs through the full async
+# pipeline, fetches trace_dump over the real HTTP RPC door, and
+# validates the Chrome trace-event JSON AND the per-transaction causal
+# span trees — a broken exporter fails tier-1, not a debugging session
+if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/traceview.py --smoke; then
+  echo "TRACE SMOKE FAILED — trace_dump exporter is broken" >&2
+  exit 2
+fi
+
 echo "== tier-1 test run (ROADMAP.md command) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

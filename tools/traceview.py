@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""traceview: fetch, validate, and save a node's `trace_dump`.
+
+The tracing plane (stellard_tpu/node/tracer.py) exports Chrome
+trace-event JSON through the `trace_dump` admin RPC. This tool wraps the
+three things an operator (and the tier-1 gate) needs around that RPC:
+
+  fetch     POST trace_dump to a node's HTTP RPC door and save the
+            trace to a file Perfetto / chrome://tracing loads directly:
+                python tools/traceview.py --url http://127.0.0.1:5005 \\
+                    -o trace.json
+  validate  schema-check an already-saved dump:
+                python tools/traceview.py --validate trace.json
+  smoke     boot an in-process standalone node, flood ~200 transactions
+            through the full async pipeline, close ledgers, fetch
+            trace_dump over the REAL HTTP door, validate the JSON
+            schema AND the causal span tree per transaction
+            (submit → verify → close → persist):
+                python tools/traceview.py --smoke
+
+The schema validator is hand-rolled (no jsonschema dependency) against
+the trace-event format's documented requirements; `validate_chrome_trace`
+is importable by tests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+# phases from the trace-event format spec (Duration, Complete, Instant,
+# Counter, Async, Flow, Sample, Object, Metadata, Memory-dump, Mark,
+# Clock-sync, Context)
+_KNOWN_PHASES = set("BEXiICbnesftPOoNDMVvRcGT(),")
+_INSTANT_SCOPES = {"g", "p", "t"}
+
+
+def validate_chrome_trace(obj) -> list[str]:
+    """-> list of schema problems (empty = valid Chrome trace-event
+    JSON). Checks the object form: {"traceEvents": [events...], ...}."""
+    problems: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"top level must be an object, got {type(obj).__name__}"]
+    events = obj.get("traceEvents")
+    if not isinstance(events, list):
+        return ["missing or non-array traceEvents"]
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            problems.append(f"{where}: event must be an object")
+            continue
+        ph = ev.get("ph")
+        if not isinstance(ph, str) or len(ph) != 1 or ph not in _KNOWN_PHASES:
+            problems.append(f"{where}: bad phase {ph!r}")
+            continue
+        name = ev.get("name")
+        if ph != "M" and not isinstance(name, str):
+            problems.append(f"{where}: missing/non-string name")
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            problems.append(f"{where}: missing/negative ts")
+        for key in ("pid", "tid"):
+            if not isinstance(ev.get(key), int):
+                problems.append(f"{where}: missing/non-integer {key}")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"{where}: complete event needs dur >= 0")
+        if ph == "i" and ev.get("s") not in _INSTANT_SCOPES:
+            problems.append(f"{where}: instant scope must be g/p/t")
+        if "cat" in ev and not isinstance(ev["cat"], str):
+            problems.append(f"{where}: non-string cat")
+        if "args" in ev and not isinstance(ev["args"], dict):
+            problems.append(f"{where}: non-object args")
+        if len(problems) > 40:
+            problems.append("... (truncated)")
+            break
+    return problems
+
+
+def validate_span_trees(obj, require_stages=(
+    "submit", "verify", "close", "persist",
+)) -> list[str]:
+    """Check the causal structure the tracing plane promises: every
+    transaction trace present in the dump carries events for (at least)
+    the given lifecycle stages, and child spans resolve their parent
+    ids. A tx trace id is the 64-hex txid; ledger traces are
+    "ledger-<seq>"."""
+    problems: list[str] = []
+    by_trace: dict[str, list[dict]] = {}
+    span_ids = set()
+    for ev in obj.get("traceEvents", []):
+        args = ev.get("args") or {}
+        if "span" in args:
+            span_ids.add(args["span"])
+        trace = args.get("trace")
+        if isinstance(trace, str) and len(trace) == 64:
+            by_trace.setdefault(trace, []).append(ev)
+    if not by_trace:
+        return ["no transaction traces in dump"]
+    for trace, evs in by_trace.items():
+        cats = {ev.get("cat") for ev in evs}
+        missing = [c for c in require_stages if c not in cats]
+        if missing:
+            problems.append(
+                f"tx {trace[:16]}: missing stages {missing} (has {sorted(cats)})"
+            )
+        for ev in evs:
+            parent = (ev.get("args") or {}).get("parent")
+            if parent is not None and parent not in span_ids:
+                problems.append(
+                    f"tx {trace[:16]}: span {ev['args'].get('span')} "
+                    f"references unknown parent {parent}"
+                )
+    return problems
+
+
+def fetch_dump(url: str, reset: bool = False, timeout: float = 30.0) -> dict:
+    """POST trace_dump to a node's HTTP RPC door; -> the trace object."""
+    body = json.dumps({
+        "method": "trace_dump",
+        "params": [{"reset": bool(reset)}],
+    }).encode()
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"}
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        reply = json.loads(resp.read())
+    result = reply.get("result", {})
+    if result.get("status") != "success":
+        raise RuntimeError(f"trace_dump failed: {result}")
+    result.pop("status", None)  # transport envelope, not trace data
+    return result
+
+
+# -- smoke gate (tier-1) ----------------------------------------------------
+
+
+def run_smoke(n_txs: int = 200, out: str | None = None) -> int:
+    """Boot a standalone node, flood `n_txs` payments through the full
+    async pipeline, close every 50, fetch trace_dump over the real HTTP
+    door, and fail loudly unless (a) the JSON validates against the
+    trace-event schema and (b) every transaction trace carries its
+    submit/verify/close/persist stages with resolvable parent links."""
+    import threading
+
+    from stellard_tpu.node.config import Config
+    from stellard_tpu.node.node import Node
+    from stellard_tpu.protocol.formats import TxType
+    from stellard_tpu.protocol.keys import KeyPair
+    from stellard_tpu.protocol.sfields import sfAmount, sfDestination
+    from stellard_tpu.protocol.stamount import STAmount
+    from stellard_tpu.protocol.sttx import SerializedTransaction
+
+    # sample=1.0: the smoke asserts EVERY tx has its full span tree
+    node = Node(Config(rpc_port=0, trace_sample=1.0)).setup().serve()
+    try:
+        master = KeyPair.from_passphrase("masterpassphrase")
+        dests = [
+            KeyPair.from_passphrase(f"trace-smoke-{i}").account_id
+            for i in range(8)
+        ]
+        done = threading.Semaphore(0)
+        results = []
+
+        def cb(tx, ter, applied):
+            results.append((ter, applied))
+            done.release()
+
+        for chunk in range(0, n_txs, 50):
+            txs = []
+            for i in range(chunk, min(chunk + 50, n_txs)):
+                tx = SerializedTransaction.build(
+                    TxType.ttPAYMENT, master.account_id, 1 + i, 10,
+                    {sfAmount: STAmount.from_drops(250_000_000),
+                     sfDestination: dests[i % len(dests)]},
+                )
+                tx.sign(master)
+                txs.append(tx)
+            for tx in txs:
+                node.ops.submit_transaction(tx, cb)
+            for _ in txs:
+                done.acquire()
+            node.ops.accept_ledger()
+        if not node.close_pipeline.flush(timeout=60):
+            print("trace smoke: close pipeline failed to drain", file=sys.stderr)
+            return 1
+
+        url = f"http://127.0.0.1:{node.http_server.port}"
+        dump = fetch_dump(url)
+    finally:
+        node.stop()
+
+    problems = validate_chrome_trace(dump)
+    if problems:
+        print("trace smoke: SCHEMA INVALID:", file=sys.stderr)
+        for p in problems[:20]:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    tree_problems = validate_span_trees(dump)
+    if tree_problems:
+        print("trace smoke: SPAN TREES BROKEN:", file=sys.stderr)
+        for p in tree_problems[:20]:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    events = dump["traceEvents"]
+    traces = {
+        (ev.get("args") or {}).get("trace")
+        for ev in events
+        if len((ev.get("args") or {}).get("trace") or "") == 64
+    }
+    if out:
+        with open(out, "w") as fh:
+            json.dump(dump, fh)
+    print(
+        f"trace smoke OK: {len(events)} events, {len(traces)} tx traces, "
+        f"schema valid, span trees causally linked"
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", help="node RPC door, e.g. http://127.0.0.1:5005")
+    ap.add_argument("--validate", metavar="FILE",
+                    help="validate an already-saved dump file")
+    ap.add_argument("--smoke", action="store_true",
+                    help="in-process end-to-end gate (tier-1)")
+    ap.add_argument("--reset", action="store_true",
+                    help="clear the node's ring after dumping")
+    ap.add_argument("-o", "--out", help="write the trace JSON here")
+    ap.add_argument("-n", type=int, default=200,
+                    help="smoke: transactions to flood (default 200)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        return run_smoke(n_txs=args.n, out=args.out)
+    if args.validate:
+        with open(args.validate) as fh:
+            obj = json.load(fh)
+        problems = validate_chrome_trace(obj)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        print("valid" if not problems else f"{len(problems)} problems")
+        return 0 if not problems else 1
+    if args.url:
+        dump = fetch_dump(args.url, reset=args.reset)
+        problems = validate_chrome_trace(dump)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        if args.out:
+            with open(args.out, "w") as fh:
+                json.dump(dump, fh)
+            print(f"wrote {len(dump.get('traceEvents', []))} events to "
+                  f"{args.out} ({'valid' if not problems else 'INVALID'})")
+        return 0 if not problems else 1
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
