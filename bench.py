@@ -411,6 +411,12 @@ def _drive_node(backend, txs, chunk=500, setup_phases=(), cfg_kwargs=None,
     detail["results_digest"] = results_digest.hexdigest()
     detail["close_pipeline"] = node.close_pipeline.get_json()
     detail["delta_replay"] = node.ledger_master.delta_replay_json()
+    # batched-commit-plane honesty: drains/adoptions actually happened
+    # (a 100%-unarmed run would show the old seal cost for the wrong
+    # reason), plus the hash-plane routing snapshot when available
+    detail["tree"] = node.ledger_master.tree_json()
+    if hasattr(node.hasher, "get_json"):
+        detail["hash_routing"] = node.hasher.get_json()
     node.stop()
     return dt, committed, share, detail
 
@@ -633,6 +639,149 @@ def bench_delta_replay_flood(backends):
         "fallback": False,  # host-plane leg: no device involved
     })
     return legs
+
+
+def bench_tree_commit(backends):
+    """State-tree commit-plane leg: apply the SAME 3000-write delta to a
+    populated state tree via per-key set_item/del_item (the pre-PR
+    splice shape) vs ONE sorted bulk merge (SHAMap.bulk_update), then
+    seal (batched tree hash) and flush into a FILE-BACKED cpplog store.
+    Interleaved best-of-K; byte-identity (root hash + flushed node
+    count) asserted per rep. vs_baseline = per-key merge time over bulk
+    merge time — the tentpole's headline ratio. The hash-plane routing
+    snapshot and device share ride BENCH_DETAIL.json like the verify
+    legs, so a routed-out device is self-explaining."""
+    import hashlib
+    import shutil
+    import tempfile
+
+    from stellard_tpu.crypto.backend import make_watched_hasher
+    from stellard_tpu.nodestore import NodeObjectType, make_database
+    from stellard_tpu.state.shamap import SHAMap, SHAMapItem, TNType
+
+    n_base = int(os.environ.get("BENCH_TREE_BASE", "20000"))
+    n_delta = int(os.environ.get("BENCH_TREE_DELTA", "3000"))
+    n_del = n_delta // 10
+    reps = max(1, int(os.environ.get("BENCH_PIPE_REPS", "3")))
+
+    def key(tag: str, i: int) -> bytes:
+        return hashlib.sha256(f"tree-commit:{tag}:{i}".encode()).digest()
+
+    base_items = [
+        SHAMapItem(key("base", i), hashlib.sha512(key("base", i)).digest())
+        for i in range(n_base)
+    ]
+    # delta: half overwrite existing keys, half create new; deletes hit
+    # existing keys the sets don't touch (adversarial for collapse)
+    sets = [
+        SHAMapItem(
+            key("base", i) if i % 2 == 0 else key("new", i),
+            hashlib.sha512(key("delta", i)).digest() * 2,
+        )
+        for i in range(n_delta)
+    ]
+    deletes = [key("base", n_base - 1 - i) for i in range(n_del)]
+
+    for b in backends:
+        hasher = make_watched_hasher(b)
+        base = SHAMap(TNType.ACCOUNT_STATE, hash_batch=hasher)
+        base.bulk_update(base_items)
+        base.get_hash()
+        base_root = base.root
+
+        state_dir = tempfile.mkdtemp(prefix="bench-tree-")
+        db = make_database(
+            type="cpplog", path=os.path.join(state_dir, "nodestore")
+        )
+        # base tree pre-flushed ONCE (unmeasured): each rep's timed
+        # flush then writes the delta only, like a close does — the
+        # per-rep `known` copy re-drives the delta writes while the
+        # content-addressed store dedupes repeats
+        base.flush(
+            db.store_fn(NodeObjectType.ACCOUNT_NODE), db.flushed,
+            store_many=db.store_many_fn(NodeObjectType.ACCOUNT_NODE),
+        )
+        db.sync()
+        base_known = set(db.flushed)
+
+        legs = {"per_key": [], "bulk": []}
+        identical = True
+        try:
+            for _rep in range(reps):
+                rep_hashes = {}
+                for mode in ("per_key", "bulk"):
+                    hasher.device_nodes = hasher.host_nodes = 0
+                    known = set(base_known)
+                    m = SHAMap(TNType.ACCOUNT_STATE, base_root,
+                               hash_batch=hasher)
+                    t0 = time.perf_counter()
+                    if mode == "bulk":
+                        m.bulk_update(sets, deletes)
+                    else:
+                        for item in sets:
+                            m.set_item(SHAMapItem(item.tag, item.data))
+                        for k in deletes:
+                            m.del_item(k)
+                    t_merge = time.perf_counter()
+                    m.get_hash()
+                    t_hash = time.perf_counter()
+                    flushed = m.flush(
+                        db.store_fn(NodeObjectType.ACCOUNT_NODE), known,
+                        store_many=db.store_many_fn(
+                            NodeObjectType.ACCOUNT_NODE
+                        ),
+                    )
+                    db.sync()
+                    t_flush = time.perf_counter()
+                    rep_hashes[mode] = (m.get_hash(), flushed)
+                    legs[mode].append({
+                        "merge_s": t_merge - t0,
+                        "hash_s": t_hash - t_merge,
+                        "flush_s": t_flush - t_hash,
+                        "total_s": t_flush - t0,
+                        "device_nodes": hasher.device_nodes,
+                        "host_nodes": hasher.host_nodes,
+                    })
+                identical = identical and (
+                    rep_hashes["per_key"] == rep_hashes["bulk"]
+                )
+        finally:
+            db.close()
+            shutil.rmtree(state_dir, ignore_errors=True)
+
+        best_pk = min(legs["per_key"], key=lambda r: r["merge_s"])
+        best_bk = min(legs["bulk"], key=lambda r: r["merge_s"])
+        dev = sum(r["device_nodes"] for r in legs["bulk"])
+        host = sum(r["host_nodes"] for r in legs["bulk"])
+        detail = {
+            "per_key": legs["per_key"],
+            "bulk": legs["bulk"],
+            "device_share": (dev / (dev + host)) if dev + host else 0.0,
+        }
+        if hasattr(hasher, "get_json"):
+            detail["hash_routing"] = hasher.get_json()
+        _note_detail("tree_commit_writes_per_sec", b, detail)
+        n_ops = n_delta + n_del
+        _emit({
+            "metric": "tree_commit_writes_per_sec",
+            "value": round(n_ops / best_bk["merge_s"], 1),
+            "unit": "writes/s",
+            # the leg's whole point: bulk merge over per-key application
+            "vs_baseline": round(
+                best_pk["merge_s"] / best_bk["merge_s"], 3
+            ),
+            "per_key_writes_per_sec": round(n_ops / best_pk["merge_s"], 1),
+            "reps": reps,
+            "backend": b,
+            "base_entries": n_base,
+            "delta_writes": n_delta,
+            "delta_deletes": n_del,
+            "seal_ms": round(best_bk["hash_s"] * 1000.0, 2),
+            "flush_ms": round(best_bk["flush_s"] * 1000.0, 2),
+            "hashes_identical": identical,
+            "device_share": round(detail["device_share"], 4),
+            "fallback": b == "cpu",
+        })
 
 
 def _offer_workload(n):
@@ -1039,6 +1188,7 @@ def main() -> None:
             bench_payment_flood,
             bench_pipelined_flood,
             bench_delta_replay_flood,
+            bench_tree_commit,
             bench_offer_mix,
             bench_regular_key_fanout,
             bench_consensus_close,

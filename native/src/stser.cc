@@ -55,6 +55,13 @@ static std::vector<FieldConst> g_fields;   // indexed by cid
 static PyObject *g_container_cb = nullptr;  // Python fallback for containers
 static PyObject *g_cid_name = nullptr;      // interned "cid"
 static PyObject *g_wire_name = nullptr;     // interned "wire_bytes"
+// interned SHAMap node attribute names (pack_nodes)
+static PyObject *g_children_name = nullptr;
+static PyObject *g_nhash_name = nullptr;  // "_hash"
+static PyObject *g_item_name = nullptr;
+static PyObject *g_ntype_name = nullptr;  // "type"
+static PyObject *g_tag_name = nullptr;
+static PyObject *g_data_name = nullptr;
 
 struct Buf {
   std::vector<uint8_t> v;
@@ -249,6 +256,455 @@ static PyObject *stser_register_fields(PyObject *, PyObject *args) {
   Py_RETURN_NONE;
 }
 
+// ---------------------------------------------------------------------------
+// bulk_merge: the SHAMap sorted-delta merge, in C. Applies a whole
+// close's write set to the persistent radix tree in one DFS pass —
+// Leaf objects arrive pre-built from Python and are only referenced;
+// this code constructs the dirty INNER nodes (by calling the Inner
+// class) and raises KeyError for deletes of missing keys, matching
+// state.shamap._bulk_merge byte-for-byte (differential-tested). The
+// canonical-tree property makes the result independent of application
+// order, so parity with per-key set_item/del_item follows.
+
+namespace {
+
+struct MergeCtx {
+  PyObject **keys;        // borrowed 32-byte key objects
+  PyObject **leaves;      // borrowed Leaf | Py_None (= delete)
+  const char **kbytes;    // raw key bytes
+  std::vector<int> dels;  // delete-count prefix array
+  PyObject *inner_cls;
+  PyTypeObject *leaf_type;
+};
+
+static inline int merge_nib(const char *k, int depth) {
+  unsigned char b = static_cast<unsigned char>(k[depth >> 1]);
+  return (depth & 1) ? (b & 0xF) : (b >> 4);
+}
+
+static void merge_key_error(PyObject *key) {
+  PyObject *hx = PyObject_CallMethod(key, "hex", nullptr);
+  if (hx != nullptr) {
+    PyErr_SetObject(PyExc_KeyError, hx);
+    Py_DECREF(hx);
+  }
+}
+
+// children: 16 NEW references (Py_None for empty slots); consumed.
+static PyObject *merge_make_inner(MergeCtx *c, PyObject **children) {
+  PyObject *tup = PyTuple_New(16);
+  if (tup == nullptr) {
+    for (int i = 0; i < 16; i++) Py_XDECREF(children[i]);
+    return nullptr;
+  }
+  for (int i = 0; i < 16; i++) PyTuple_SET_ITEM(tup, i, children[i]);
+  PyObject *out = PyObject_CallFunctionObjArgs(c->inner_cls, tup, nullptr);
+  Py_DECREF(tup);
+  return out;
+}
+
+// Canonical subtree for set-only runs (kb/lv arrays, [lo,hi)); -> new ref.
+static PyObject *merge_build(MergeCtx *c, const char **kb, PyObject **lv,
+                             Py_ssize_t lo, Py_ssize_t hi, int depth) {
+  if (hi - lo == 1) {
+    Py_INCREF(lv[lo]);
+    return lv[lo];
+  }
+  PyObject *children[16];
+  for (int i = 0; i < 16; i++) {
+    children[i] = Py_None;
+    Py_INCREF(Py_None);
+  }
+  Py_ssize_t i = lo;
+  while (i < hi) {
+    int b = merge_nib(kb[i], depth);
+    Py_ssize_t j = i + 1;
+    while (j < hi && merge_nib(kb[j], depth) == b) j++;
+    PyObject *sub = merge_build(c, kb, lv, i, j, depth + 1);
+    if (sub == nullptr) {
+      for (int k = 0; k < 16; k++) Py_XDECREF(children[k]);
+      return nullptr;
+    }
+    Py_DECREF(children[b]);  // the Py_None placeholder
+    children[b] = sub;
+    i = j;
+  }
+  return merge_make_inner(c, children);
+}
+
+// Merge ops[lo:hi) into `node` (borrowed; Py_None = empty subtree);
+// -> NEW reference (Py_None when the subtree empties), nullptr on error.
+static PyObject *merge_node(MergeCtx *c, PyObject *node, Py_ssize_t lo,
+                            Py_ssize_t hi, int depth) {
+  if (lo >= hi) {
+    Py_INCREF(node);
+    return node;
+  }
+  if (node == Py_None) {
+    if (c->dels[hi] != c->dels[lo]) {
+      for (Py_ssize_t i = lo; i < hi; i++) {
+        if (c->leaves[i] == Py_None) {
+          merge_key_error(c->keys[i]);
+          return nullptr;
+        }
+      }
+    }
+    return merge_build(c, c->kbytes, c->leaves, lo, hi, depth);
+  }
+  if (Py_TYPE(node) == c->leaf_type) {
+    PyObject *item = PyObject_GetAttr(node, g_item_name);
+    if (item == nullptr) return nullptr;
+    PyObject *tag = PyObject_GetAttr(item, g_tag_name);
+    Py_DECREF(item);
+    if (tag == nullptr) return nullptr;
+    char *tb;
+    Py_ssize_t tlen;
+    if (PyBytes_AsStringAndSize(tag, &tb, &tlen) < 0 || tlen != 32) {
+      if (!PyErr_Occurred())
+        PyErr_SetString(PyExc_ValueError, "bulk_merge: bad leaf tag");
+      Py_DECREF(tag);
+      return nullptr;
+    }
+    std::vector<const char *> mk;
+    std::vector<PyObject *> ml;  // borrowed
+    mk.reserve(hi - lo + 1);
+    ml.reserve(hi - lo + 1);
+    bool replaced = false, placed = false;
+    for (Py_ssize_t i = lo; i < hi; i++) {
+      const char *k = c->kbytes[i];
+      int cmp = memcmp(tb, k, 32);
+      if (!placed && !replaced && cmp < 0) {
+        mk.push_back(tb);
+        ml.push_back(node);
+        placed = true;
+      }
+      if (cmp == 0) {
+        replaced = true;
+        if (c->leaves[i] != Py_None) {
+          mk.push_back(k);
+          ml.push_back(c->leaves[i]);
+        }
+      } else if (c->leaves[i] == Py_None) {
+        merge_key_error(c->keys[i]);
+        Py_DECREF(tag);
+        return nullptr;
+      } else {
+        mk.push_back(k);
+        ml.push_back(c->leaves[i]);
+      }
+    }
+    if (!replaced && !placed) {
+      mk.push_back(tb);
+      ml.push_back(node);
+    }
+    PyObject *out;
+    if (ml.empty()) {
+      out = Py_None;
+      Py_INCREF(out);
+    } else if (ml.size() == 1) {
+      out = ml[0];
+      Py_INCREF(out);
+    } else {
+      out = merge_build(c, mk.data(), ml.data(), 0,
+                        static_cast<Py_ssize_t>(ml.size()), depth);
+    }
+    Py_DECREF(tag);  // mk/ml borrowed tb/node through this point
+    return out;
+  }
+  // inner node
+  PyObject *ch = PyObject_GetAttr(node, g_children_name);
+  if (ch == nullptr) return nullptr;
+  if (!PyTuple_Check(ch) || PyTuple_GET_SIZE(ch) != 16) {
+    PyErr_SetString(PyExc_ValueError, "bulk_merge: bad children tuple");
+    Py_DECREF(ch);
+    return nullptr;
+  }
+  PyObject *children[16];
+  bool owned[16] = {false};
+  for (int b = 0; b < 16; b++) children[b] = PyTuple_GET_ITEM(ch, b);
+  Py_ssize_t i = lo;
+  bool failed = false;
+  while (i < hi) {
+    int b = merge_nib(c->kbytes[i], depth);
+    Py_ssize_t j = i + 1;
+    while (j < hi && merge_nib(c->kbytes[j], depth) == b) j++;
+    PyObject *sub = merge_node(c, children[b], i, j, depth + 1);
+    if (sub == nullptr) {
+      failed = true;
+      break;
+    }
+    if (owned[b]) Py_DECREF(children[b]);
+    children[b] = sub;
+    owned[b] = true;
+    i = j;
+  }
+  if (failed) {
+    for (int b = 0; b < 16; b++)
+      if (owned[b]) Py_DECREF(children[b]);
+    Py_DECREF(ch);
+    return nullptr;
+  }
+  PyObject *out = nullptr;
+  if (c->dels[hi] != c->dels[lo]) {
+    int live = 0;
+    PyObject *only = nullptr;
+    for (int b = 0; b < 16; b++) {
+      if (children[b] != Py_None) {
+        live++;
+        only = children[b];
+      }
+    }
+    if (live == 0) {
+      out = Py_None;
+      Py_INCREF(out);
+    } else if (live == 1 && Py_TYPE(only) == c->leaf_type) {
+      out = only;  // single-leaf fold-up (del_item parity)
+      Py_INCREF(out);
+    }
+  }
+  if (out == nullptr) {
+    PyObject *tup = PyTuple_New(16);
+    if (tup == nullptr) {
+      for (int b = 0; b < 16; b++)
+        if (owned[b]) Py_DECREF(children[b]);
+      Py_DECREF(ch);
+      return nullptr;
+    }
+    for (int b = 0; b < 16; b++) {
+      if (!owned[b]) Py_INCREF(children[b]);
+      PyTuple_SET_ITEM(tup, b, children[b]);  // steals
+    }
+    out = PyObject_CallFunctionObjArgs(c->inner_cls, tup, nullptr);
+    Py_DECREF(tup);
+  } else {
+    for (int b = 0; b < 16; b++)
+      if (owned[b]) Py_DECREF(children[b]);
+  }
+  Py_DECREF(ch);
+  return out;
+}
+
+}  // namespace
+
+// bulk_merge(root, ops, leaf_cls, inner_cls) -> new root node | None
+static PyObject *stser_bulk_merge(PyObject *, PyObject *args) {
+  PyObject *root, *ops, *leaf_cls, *inner_cls;
+  if (!PyArg_ParseTuple(args, "OOOO", &root, &ops, &leaf_cls, &inner_cls))
+    return nullptr;
+  if (!PyType_Check(leaf_cls)) {
+    PyErr_SetString(PyExc_TypeError, "bulk_merge: leaf_cls must be a type");
+    return nullptr;
+  }
+  PyObject *seq = PySequence_Fast(ops, "bulk_merge expects a sequence");
+  if (seq == nullptr) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  if (n == 0) {
+    Py_DECREF(seq);
+    Py_INCREF(root);
+    return root;
+  }
+  MergeCtx c;
+  std::vector<PyObject *> keys(n), leaves(n);
+  std::vector<const char *> kbytes(n);
+  c.dels.assign(n + 1, 0);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *pair = PySequence_Fast_GET_ITEM(seq, i);
+    if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2) {
+      PyErr_SetString(PyExc_ValueError, "bulk_merge: ops must be pairs");
+      Py_DECREF(seq);
+      return nullptr;
+    }
+    keys[i] = PyTuple_GET_ITEM(pair, 0);
+    leaves[i] = PyTuple_GET_ITEM(pair, 1);
+    char *kb;
+    Py_ssize_t klen;
+    if (PyBytes_AsStringAndSize(keys[i], &kb, &klen) < 0 || klen != 32) {
+      if (!PyErr_Occurred())
+        PyErr_SetString(PyExc_ValueError, "bulk_merge: bad key length");
+      Py_DECREF(seq);
+      return nullptr;
+    }
+    kbytes[i] = kb;
+    c.dels[i + 1] = c.dels[i] + (leaves[i] == Py_None ? 1 : 0);
+  }
+  c.keys = keys.data();
+  c.leaves = leaves.data();
+  c.kbytes = kbytes.data();
+  c.inner_cls = inner_cls;
+  c.leaf_type = reinterpret_cast<PyTypeObject *>(leaf_cls);
+  PyObject *out = merge_node(&c, root, 0, n, 0);
+  Py_DECREF(seq);
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// pack_nodes: the SHAMap flat-buffer node encoder. Packs the
+// prefix-format bytes of a list of Leaf/Inner nodes into ONE contiguous
+// buffer (the exact bytes the hash plane digests AND the NodeStore
+// persists) — replacing the per-node Python payload construction that
+// dominated host seal prep. Byte-contract: identical to
+// state.shamap._encode_nodes_py (differential-tested).
+
+static PyObject *stser_pack_nodes(PyObject *, PyObject *args) {
+  PyObject *nodes;
+  unsigned long hp_inner, hp_txn, hp_txmd, hp_leaf;
+  if (!PyArg_ParseTuple(args, "Okkkk", &nodes, &hp_inner, &hp_txn, &hp_txmd,
+                        &hp_leaf))
+    return nullptr;
+  PyObject *seq = PySequence_Fast(nodes, "pack_nodes expects a sequence");
+  if (seq == nullptr) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  PyObject *offsets = PyList_New(n + 1);
+  if (offsets == nullptr) {
+    Py_DECREF(seq);
+    return nullptr;
+  }
+  std::vector<uint8_t> buf;
+  buf.reserve(static_cast<size_t>(n) * 160);
+  bool failed = false;
+  {
+    PyObject *zero = PyLong_FromLong(0);
+    if (zero == nullptr) failed = true;
+    else PyList_SET_ITEM(offsets, 0, zero);
+  }
+  auto put32be = [&buf](unsigned long v) {
+    buf.push_back(static_cast<uint8_t>((v >> 24) & 0xFF));
+    buf.push_back(static_cast<uint8_t>((v >> 16) & 0xFF));
+    buf.push_back(static_cast<uint8_t>((v >> 8) & 0xFF));
+    buf.push_back(static_cast<uint8_t>(v & 0xFF));
+  };
+  auto put_fixed = [&buf, &failed](PyObject *owner, PyObject *name,
+                                   const char *what) {
+    PyObject *b = PyObject_GetAttr(owner, name);
+    if (b == nullptr) {
+      failed = true;
+      return;
+    }
+    char *p;
+    Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(b, &p, &len) < 0 || len != 32) {
+      if (!PyErr_Occurred())
+        PyErr_Format(PyExc_ValueError, "pack_nodes: bad %s length", what);
+      else
+        PyErr_Format(PyExc_ValueError, "pack_nodes: %s not bytes", what);
+      Py_DECREF(b);
+      failed = true;
+      return;
+    }
+    buf.insert(buf.end(), p, p + 32);
+    Py_DECREF(b);
+  };
+  for (Py_ssize_t i = 0; i < n && !failed; i++) {
+    PyObject *node = PySequence_Fast_GET_ITEM(seq, i);  // borrowed
+    PyObject *children = PyObject_GetAttr(node, g_children_name);
+    if (children != nullptr) {
+      // inner node: prefix + 16 child hashes (zero for empty branches)
+      if (!PyTuple_Check(children) || PyTuple_GET_SIZE(children) != 16) {
+        PyErr_SetString(PyExc_ValueError, "pack_nodes: bad children tuple");
+        Py_DECREF(children);
+        failed = true;
+        break;
+      }
+      put32be(hp_inner);
+      for (int b = 0; b < 16 && !failed; b++) {
+        PyObject *child = PyTuple_GET_ITEM(children, b);  // borrowed
+        if (child == Py_None) {
+          buf.insert(buf.end(), 32, 0);
+        } else {
+          put_fixed(child, g_nhash_name, "child hash (unhashed child?)");
+        }
+      }
+      Py_DECREF(children);
+    } else {
+      if (!PyErr_ExceptionMatches(PyExc_AttributeError)) {
+        failed = true;
+        break;
+      }
+      PyErr_Clear();
+      // leaf node: prefix + data (+ tag for tagged leaf kinds)
+      PyObject *type_obj = PyObject_GetAttr(node, g_ntype_name);
+      if (type_obj == nullptr) {
+        failed = true;
+        break;
+      }
+      long t = PyLong_AsLong(type_obj);
+      Py_DECREF(type_obj);
+      if (PyErr_Occurred()) {
+        failed = true;
+        break;
+      }
+      unsigned long pfx;
+      bool with_tag;
+      if (t == 2) {  // TX_NM
+        pfx = hp_txn;
+        with_tag = false;
+      } else if (t == 3) {  // TX_MD
+        pfx = hp_txmd;
+        with_tag = true;
+      } else if (t == 4) {  // ACCOUNT_STATE
+        pfx = hp_leaf;
+        with_tag = true;
+      } else {
+        PyErr_Format(PyExc_ValueError, "pack_nodes: bad leaf type %ld", t);
+        failed = true;
+        break;
+      }
+      PyObject *item = PyObject_GetAttr(node, g_item_name);
+      if (item == nullptr) {
+        failed = true;
+        break;
+      }
+      PyObject *data = PyObject_GetAttr(item, g_data_name);
+      if (data == nullptr) {
+        Py_DECREF(item);
+        failed = true;
+        break;
+      }
+      char *p;
+      Py_ssize_t len;
+      if (PyBytes_AsStringAndSize(data, &p, &len) < 0) {
+        Py_DECREF(data);
+        Py_DECREF(item);
+        failed = true;
+        break;
+      }
+      put32be(pfx);
+      buf.insert(buf.end(), p, p + len);
+      Py_DECREF(data);
+      if (with_tag) put_fixed(item, g_tag_name, "leaf tag");
+      Py_DECREF(item);
+    }
+    if (failed) break;
+    PyObject *off = PyLong_FromSize_t(buf.size());
+    if (off == nullptr) {
+      failed = true;
+      break;
+    }
+    PyList_SET_ITEM(offsets, i + 1, off);
+  }
+  Py_DECREF(seq);
+  if (failed) {
+    Py_DECREF(offsets);
+    return nullptr;
+  }
+  PyObject *payload = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(buf.data()),
+      static_cast<Py_ssize_t>(buf.size()));
+  if (payload == nullptr) {
+    Py_DECREF(offsets);
+    return nullptr;
+  }
+  PyObject *out = PyTuple_New(2);
+  if (out == nullptr) {
+    Py_DECREF(payload);
+    Py_DECREF(offsets);
+    return nullptr;
+  }
+  PyTuple_SET_ITEM(out, 0, payload);
+  PyTuple_SET_ITEM(out, 1, offsets);
+  return out;
+}
+
 static PyObject *stser_parse(PyObject *, PyObject *);
 static PyObject *stser_register_parse(PyObject *, PyObject *);
 
@@ -259,6 +715,11 @@ static PyMethodDef Methods[] = {
      "register_fields(rows, container_cb)"},
     {"parse", stser_parse, METH_VARARGS,
      "parse(data, pos, inner) -> (STObject, new_pos)"},
+    {"pack_nodes", stser_pack_nodes, METH_VARARGS,
+     "pack_nodes(nodes, hp_inner, hp_txn, hp_txmd, hp_leaf)"
+     " -> (buffer, offsets)"},
+    {"bulk_merge", stser_bulk_merge, METH_VARARGS,
+     "bulk_merge(root, sorted_ops, leaf_cls, inner_cls) -> node | None"},
     {"register_parse", stser_register_parse, METH_VARARGS,
      "register_parse(rows, obj_factory, arr_factory, amount_cb, pathset_cb)"},
     {nullptr, nullptr, 0, nullptr},
@@ -275,7 +736,17 @@ static struct PyModuleDef Module = {
 PyMODINIT_FUNC PyInit__stser(void) {
   g_cid_name = PyUnicode_InternFromString("cid");
   g_wire_name = PyUnicode_InternFromString("wire_bytes");
-  if (g_cid_name == nullptr || g_wire_name == nullptr) return nullptr;
+  g_children_name = PyUnicode_InternFromString("children");
+  g_nhash_name = PyUnicode_InternFromString("_hash");
+  g_item_name = PyUnicode_InternFromString("item");
+  g_ntype_name = PyUnicode_InternFromString("type");
+  g_tag_name = PyUnicode_InternFromString("tag");
+  g_data_name = PyUnicode_InternFromString("data");
+  if (g_cid_name == nullptr || g_wire_name == nullptr ||
+      g_children_name == nullptr || g_nhash_name == nullptr ||
+      g_item_name == nullptr || g_ntype_name == nullptr ||
+      g_tag_name == nullptr || g_data_name == nullptr)
+    return nullptr;
   return PyModule_Create(&Module);
 }
 

@@ -56,6 +56,19 @@ class BatchHasher:
     def prefix_hash_batch(self, prefixes: Sequence[int], payloads: Sequence[bytes]) -> list[bytes]:
         raise NotImplementedError
 
+    def hash_packed(self, buf: bytes, offsets: Sequence[int]) -> list[bytes]:
+        """Hash PACKED messages (state.shamap.encode_nodes layout: every
+        message carries its 4-byte domain prefix, `offsets` is the n+1
+        boundary list). Default adapter slices back into the
+        (prefixes, payloads) shape; real backends override with a
+        zero-slicing path."""
+        prefixes, payloads = [], []
+        for i in range(len(offsets) - 1):
+            msg = buf[offsets[i] : offsets[i + 1]]
+            prefixes.append(int.from_bytes(msg[:4], "big"))
+            payloads.append(msg[4:])
+        return self.prefix_hash_batch(prefixes, payloads)
+
     def __call__(self, prefixes, payloads):
         return self.prefix_hash_batch(prefixes, payloads)
 
@@ -176,6 +189,18 @@ class CpuHasher(BatchHasher):
 
         self.host_nodes += len(prefixes)
         return [prefix_hash(p, d) for p, d in zip(prefixes, payloads)]
+
+    def hash_packed(self, buf, offsets):
+        # a packed message == prefix ‖ payload, and
+        # prefix_hash(p, d) == sha512_half(p4 ‖ d): hash slices directly
+        from ..utils.hashes import sha512_half
+
+        mv = memoryview(buf)
+        n = len(offsets) - 1
+        self.host_nodes += n
+        return [
+            sha512_half(mv[offsets[i] : offsets[i + 1]]) for i in range(n)
+        ]
 
 
 # --------------------------------------------------------------------------
@@ -344,6 +369,18 @@ class TpuHasher(BatchHasher):
     name = "tpu"
 
     def prefix_hash_batch(self, prefixes, payloads):
+        return self._hash_msgs(
+            [p.to_bytes(4, "big") + d for p, d in zip(prefixes, payloads)]
+        )
+
+    def hash_packed(self, buf, offsets):
+        # packed messages (prefix embedded) slice straight into the
+        # device prep — the same single-encoding feed the host path gets
+        return self._hash_msgs(
+            [buf[offsets[i] : offsets[i + 1]] for i in range(len(offsets) - 1)]
+        )
+
+    def _hash_msgs(self, msgs):
         import jax.numpy as jnp
 
         from ..ops.sha512_jax import padded_block_count
@@ -352,16 +389,15 @@ class TpuHasher(BatchHasher):
             pad_leaf_batch,
             sha512_blocks_masked,
         )
-        from ..utils.hashes import prefix_hash
+        from ..utils.hashes import sha512_half
 
-        msgs = [p.to_bytes(4, "big") + d for p, d in zip(prefixes, payloads)]
         out: list[bytes | None] = [None] * len(msgs)
         buckets: dict[int, list[int]] = {}
         for i, m in enumerate(msgs):
             nb = padded_block_count(len(m))
             ladder = next((l for l in LEAF_BLOCK_LADDER if nb <= l), None)
             if ladder is None:  # oversized: host path (rare)
-                out[i] = prefix_hash(prefixes[i], payloads[i])
+                out[i] = sha512_half(m)  # == prefix_hash(prefix, payload)
                 self.host_nodes += 1
             else:
                 buckets.setdefault(ladder, []).append(i)
@@ -426,8 +462,14 @@ class TpuHasher(BatchHasher):
             pad_leaf_batch,
             _pow2,
         )
-        from ..state.shamap import Inner, Leaf, ZERO256, _collect_unhashed
-        from ..utils.hashes import HP_INNER_NODE, prefix_hash
+        from ..state.shamap import (
+            Inner,
+            Leaf,
+            ZERO256,
+            _collect_unhashed,
+            encode_nodes,
+        )
+        from ..utils.hashes import HP_INNER_NODE, sha512_half
 
         levels = _collect_unhashed(root)
         if not levels:
@@ -441,27 +483,32 @@ class TpuHasher(BatchHasher):
         for level in reversed(levels):
             leaves_by_bucket: dict[int, list] = {}
             inners: list = []
+            leaves: list = []
             for node in level:
                 if isinstance(node, Leaf):
-                    p, d = node.hash_payload()
-                    msg = p.to_bytes(4, "big") + d
+                    leaves.append(node)
+                elif node.is_empty():
+                    node._hash = ZERO256
+                    hashed_host += 1
+                else:
+                    inners.append(node)
+            if leaves:
+                # one flat-buffer encoding feeds the whole level's device
+                # prep (the same encoder the host SHA batch consumes)
+                lbuf, loff = encode_nodes(leaves)
+                for i, node in enumerate(leaves):
+                    msg = lbuf[loff[i] : loff[i + 1]]
                     nb = padded_block_count(len(msg))
                     ladder = next(
                         (l for l in LEAF_BLOCK_LADDER if nb <= l), None
                     )
                     if ladder is None:  # oversized leaf: host hash, known
-                        node._hash = prefix_hash(p, d)
+                        node._hash = sha512_half(msg)
                         hashed_host += 1
                     else:
                         leaves_by_bucket.setdefault(ladder, []).append(
                             (node, msg)
                         )
-                else:
-                    if node.is_empty():
-                        node._hash = ZERO256
-                        hashed_host += 1
-                    else:
-                        inners.append(node)
             for ladder, entries in sorted(leaves_by_bucket.items()):
                 for i, (node, _msg) in enumerate(entries):
                     index_of[id(node)] = offset + i
@@ -562,7 +609,14 @@ class CppHasher(BatchHasher):
         self._impl = Sha512Native()
 
     def prefix_hash_batch(self, prefixes, payloads):
+        self.host_nodes += len(prefixes)
         return self._impl.prefix_hash_batch(prefixes, payloads)
+
+    def hash_packed(self, buf, offsets):
+        # the flat-buffer seal path: ONE buffer + offsets array into C,
+        # no per-node join/slice on the Python side
+        self.host_nodes += max(0, len(offsets) - 1)
+        return self._impl.hash_packed(buf, offsets)
 
 
 # registered unconditionally: CppHasher.__init__ raises a clean error on
@@ -571,15 +625,50 @@ class CppHasher(BatchHasher):
 register_hasher("cpp", CppHasher)
 
 
-def make_watched_hasher(backend: str) -> BatchHasher:
+class _RoutedFlat:
+    """Flat-batch facade over a WatchdogHasher for compute_hashes: the
+    routed/watchdogged prefix+packed paths WITHOUT the hash_tree attr
+    (which would recurse back into the watchdog's tree dispatch)."""
+
+    __slots__ = ("_wd",)
+
+    def __init__(self, wd: "WatchdogHasher"):
+        self._wd = wd
+
+    def __call__(self, prefixes, payloads):
+        return self._wd.prefix_hash_batch(prefixes, payloads)
+
+    def prefix_hash_batch(self, prefixes, payloads):
+        return self._wd.prefix_hash_batch(prefixes, payloads)
+
+    def hash_packed(self, buf, offsets):
+        return self._wd.hash_packed(buf, offsets)
+
+
+# flat batches below this never route to a device backend: a handful of
+# residual nodes can never amortize a device round-trip (the incremental
+# seal's drain leftovers are the motivating case). Env-overridable via
+# STELLARD_HASH_MIN_DEVICE_NODES on the watchdog.
+DEVICE_HASH_FLOOR = 64
+
+
+def make_watched_hasher(backend: str,
+                        min_device_nodes: Optional[int] = None) -> BatchHasher:
     """The ONE wiring for a possibly-device hasher: the tpu backend is
     wrapped in the wedge watchdog with a cpu fallback (a hung tunnel
-    must degrade, not freeze); host backends pass through untouched.
-    Used by the node and the bench legs so both always measure/run the
-    identical construction."""
+    must degrade, not freeze) and the small-batch device floor; host
+    backends pass through untouched. Used by the node and the bench
+    legs so both always measure/run the identical construction."""
     hasher = make_hasher(backend)
     if backend == "tpu":
-        hasher = WatchdogHasher(hasher, make_hasher("cpu"))
+        floor = min_device_nodes
+        if floor is None:  # explicit arg > env > device-backend default
+            floor = int(os.environ.get(
+                "STELLARD_HASH_MIN_DEVICE_NODES", str(DEVICE_HASH_FLOOR)
+            ))
+        hasher = WatchdogHasher(
+            hasher, make_hasher("cpu"), min_device_nodes=floor
+        )
     return hasher
 
 
@@ -649,9 +738,15 @@ class _HashCostModel:
     EWMA = 0.3
     REEXPLORE_BOUND = 4.0
 
-    def __init__(self, reexplore_every: int):
+    def __init__(self, reexplore_every: int, min_device_nodes: int = 0):
         self._lock = threading.Lock()
         self._reexplore = reexplore_every
+        # floor knob: batches below this size NEVER route to (or explore)
+        # the device — the incremental seal's residual batches are a few
+        # nodes, far below any plausible device win, and without the
+        # floor every tiny residual would re-trigger per-bucket
+        # exploration (a device round-trip per close)
+        self.min_device_nodes = max(0, int(min_device_nodes))
         self._dev: dict[int, list] = {}   # bucket -> [n_samples, ewma]
         self._host_unit_ms: Optional[float] = None
         self._losses: dict[int, int] = {}  # bucket -> eligible losses
@@ -663,8 +758,23 @@ class _HashCostModel:
     def _ewma(self, cur: Optional[float], ms: float) -> float:
         return ms if cur is None else (1 - self.EWMA) * cur + self.EWMA * ms
 
+    def get_json(self) -> dict:
+        """Routing-model snapshot (bench provenance / BENCH_DETAIL)."""
+        with self._lock:
+            return {
+                "min_device_nodes": self.min_device_nodes,
+                "host_unit_ms": self._host_unit_ms,
+                "buckets": {
+                    str(b): {"samples": s[0], "ewma_ms": s[1]}
+                    for b, s in sorted(self._dev.items())
+                },
+                "losses": {str(b): v for b, v in sorted(self._losses.items())},
+            }
+
     def use_device(self, n: int) -> bool:
         with self._lock:
+            if n < self.min_device_nodes:
+                return False  # below any plausible win size: never explore
             b = self._bucket(n)
             slot = self._dev.setdefault(b, [0, None])
             if slot[1] is None:
@@ -718,7 +828,8 @@ class WatchdogHasher(BatchHasher):
 
     def __init__(self, inner: BatchHasher, fallback: BatchHasher,
                  first_timeout: Optional[float] = None,
-                 warm_timeout: Optional[float] = None):
+                 warm_timeout: Optional[float] = None,
+                 min_device_nodes: Optional[int] = None):
         from ..utils.devicewatch import resolve_timeouts
 
         self.inner = inner
@@ -740,7 +851,29 @@ class WatchdogHasher(BatchHasher):
                 f"STELLARD_HASH_ROUTING must be cost|device, got {mode!r}"
             )
         self._route_by_cost = mode != "device"
-        self._flat = _HashCostModel(reexplore_every=256)
+        # device floor: flat batches below this size never route to the
+        # device, and tree hashing with a caller-supplied dirty-count
+        # hint below it goes straight to the host level-batcher — the
+        # incremental seal's residuals must not burn a device round-trip
+        # per close. Explicit arg wins; STELLARD_HASH_MIN_DEVICE_NODES
+        # next; default 0 (a watchdog wrapped around a HOST inner — the
+        # test harness shape — must not divert its inner's traffic).
+        # make_watched_hasher applies the device-backend default.
+        if min_device_nodes is None:
+            floor = int(os.environ.get("STELLARD_HASH_MIN_DEVICE_NODES", "0"))
+        else:
+            floor = int(min_device_nodes)
+        if floor < 0:
+            raise ValueError(
+                "STELLARD_HASH_MIN_DEVICE_NODES must be >= 0, got "
+                f"{floor}"
+            )
+        self.min_device_nodes = floor
+        self._flat = _HashCostModel(
+            reexplore_every=256, min_device_nodes=floor
+        )
+        # tree model buckets per-node RATE in the size-independent
+        # bucket 1 — the floor applies via the hash_tree hint, not here
         self._tree = _HashCostModel(reexplore_every=64)
 
     @property
@@ -769,19 +902,33 @@ class WatchdogHasher(BatchHasher):
         dlog.error("hash plane: %s — falling back to host hashing", exc)
 
     def prefix_hash_batch(self, prefixes, payloads):
+        return self._routed(
+            len(prefixes),
+            lambda: self.inner.prefix_hash_batch(prefixes, payloads),
+            lambda: self.fallback.prefix_hash_batch(prefixes, payloads),
+        )
+
+    def hash_packed(self, buf, offsets):
+        """Routed flat-buffer hashing (the seal/flush path): same cost
+        model and wedge watchdog as the (prefix, payload) shape."""
+        return self._routed(
+            len(offsets) - 1,
+            lambda: self.inner.hash_packed(buf, offsets),
+            lambda: self.fallback.hash_packed(buf, offsets),
+        )
+
+    def _routed(self, n, device_fn, host_fn):
         import time as _t
 
         from ..utils.devicewatch import DeviceWedged, call_with_deadline
 
-        n = len(prefixes)
-        if not self.device_wedged and n and (
+        if not self.device_wedged and n > 0 and (
             not self._route_by_cost or self._flat.use_device(n)
         ):
             try:
                 t0 = _t.perf_counter()
                 out = call_with_deadline(
-                    lambda: self.inner.prefix_hash_batch(prefixes, payloads),
-                    self._t_first, label="hash-device",
+                    device_fn, self._t_first, label="hash-device",
                 )
                 self._flat.observe_device(
                     n, (_t.perf_counter() - t0) * 1000.0
@@ -790,14 +937,27 @@ class WatchdogHasher(BatchHasher):
             except DeviceWedged as exc:
                 self._wedge(exc)
         t0 = _t.perf_counter()
-        out = self.fallback.prefix_hash_batch(prefixes, payloads)
-        if n:
+        out = host_fn()
+        if n > 0:
             self._flat.observe_host(n, (_t.perf_counter() - t0) * 1000.0)
         return out
 
+    def get_json(self) -> dict:
+        """Hash-plane routing snapshot (bench legs record it next to
+        device_share so a routed-out device is self-explaining)."""
+        return {
+            "backend": self.name,
+            "wedged": self.device_wedged,
+            "device_nodes": self.device_nodes,
+            "host_nodes": self.host_nodes,
+            "min_device_nodes": self.min_device_nodes,
+            "flat_model": self._flat.get_json(),
+            "tree_model": self._tree.get_json(),
+        }
+
     def _host_tree(self, root) -> int:
         """Level-batched host hashing. When the device is healthy this
-        still routes through the WATCHED prefix path (so e.g. a native
+        still routes through the WATCHED flat path (so e.g. a native
         cpp inner without hash_tree is used, watchdogged, for the
         dominant tree workload); once wedged it goes straight to the
         fallback."""
@@ -805,18 +965,24 @@ class WatchdogHasher(BatchHasher):
 
         if self.device_wedged:
             return compute_hashes(root, self.fallback)
-        # plain callable (no hash_tree attr): compute_hashes level-batches
-        return compute_hashes(
-            root, lambda p, d: self.prefix_hash_batch(p, d)
-        )
+        return compute_hashes(root, _RoutedFlat(self))
 
-    def hash_tree(self, root) -> int:
+    def hash_tree(self, root, hint_nodes: Optional[int] = None) -> int:
         import time as _t
 
         from ..utils.devicewatch import DeviceWedged, call_with_deadline
 
         inner_tree = getattr(self.inner, "hash_tree", None)
         if inner_tree is None:
+            return self._host_tree(root)
+        if (
+            hint_nodes is not None
+            and hint_nodes < self.min_device_nodes
+            and self._route_by_cost
+        ):
+            # caller-declared small dirty set (incremental-seal residual
+            # drains): below any plausible device win, and exploring the
+            # device per tiny batch would burn a round-trip per close
             return self._host_tree(root)
         if not self.device_wedged and self._route_by_cost and (
             not self._tree.use_device(1)

@@ -51,6 +51,7 @@ from ..protocol.sfields import sfTransactionIndex
 from ..protocol.sttx import SerializedTransaction
 from ..state.entryset import Action
 from ..state.ledger import Ledger
+from ..state.shamap import SHAMapItem, TNType
 from ..state.specview import PARENT, SpecView
 from .engine import TransactionEngine, TxParams, _is_tec
 
@@ -68,20 +69,39 @@ HEADER_TYPES = frozenset(
 
 class SpecRecord:
     __slots__ = (
-        "raw_ter", "ter", "did_apply", "reads", "succs", "writes",
-        "meta", "fee",
+        "raw_ter", "ter", "did_apply", "reads", "succs", "write_items",
+        "meta", "fee", "meta_blob", "meta_index_off", "net_deletes",
     )
 
-    def __init__(self, raw_ter, ter, did_apply, reads, succs, writes,
+    def __init__(self, raw_ter, ter, did_apply, reads, succs, write_items,
                  meta, fee):
         self.raw_ter = raw_ter  # transactor outcome, pre fee-claim
         self.ter = ter  # final outcome (post claim reprocess)
         self.did_apply = did_apply
         self.reads = reads  # key -> writer id (txid or PARENT)
         self.succs = succs  # [(cursor, next key or None)]
-        self.writes = writes  # [(key, SLE or None=delete)] in apply order
+        # [(key, SHAMapItem or None=delete)], compacted one entry per
+        # key (last write wins), serialized at SPECULATION time — the
+        # splice and the pre-seal building tree share these exact item
+        # objects, so the close window re-serializes nothing
+        self.write_items = write_items
         self.meta = meta  # threaded meta STObject (tes/claim), else None
         self.fee = fee  # drops burned when did_apply
+        # speculation-time meta serialization: the ONLY close-dependent
+        # meta bytes are the sfTransactionIndex u32, so the blob is
+        # serialized once at submit with index 0 and the close patches
+        # the 4 bytes at `meta_index_off` in place of a full re-serialize
+        # (None when the two-serialization diff could not pin the span —
+        # the splice then re-serializes, the always-correct path)
+        self.meta_blob: Optional[bytes] = None
+        self.meta_index_off = -1
+        # keys whose compacted op is a DELETE but which this tx also
+        # CREATED earlier in its own apply order: against a state that
+        # never held the key, the pair nets to nothing (the serial
+        # path's set_item/del_item). A delete key NOT in this set with
+        # no prior state is a genuine missing-key delete and must keep
+        # del_item's KeyError.
+        self.net_deletes: frozenset = frozenset()
 
 
 class SpecState:
@@ -93,6 +113,46 @@ class SpecState:
         self.view = SpecView(ledger)
         self.records: dict[bytes, SpecRecord] = {}
         self.disabled = False  # poisoned overlay -> all-fallback close
+        # incremental-seal building tree ([tree] incremental=1): the
+        # parent state plus every speculated write folded in as it
+        # records, hashed in background batches between closes so the
+        # close's seal only hashes the residual. None = feature off or
+        # fold failure (the close then runs the full seal — never forked)
+        self.building = None
+        self.absorbed: dict[bytes, object] = {}  # key -> item|None folded
+
+    def attach_building(self, state_root, hash_batch) -> None:
+        """Arm the pre-seal building tree over the parent state root."""
+        from ..state.shamap import SHAMap, TNType
+
+        kw = {"hash_batch": hash_batch} if hash_batch is not None else {}
+        self.building = SHAMap(TNType.ACCOUNT_STATE, state_root, **kw)
+        self.absorbed = {}
+
+    def fold_building(self, rec: "SpecRecord") -> int:
+        """Merge one record's write items into the building tree; -> ops
+        folded (0 when the tree is unarmed or the record wrote nothing).
+        Any fold failure disarms the building tree for this open window
+        — the close simply runs its normal full seal."""
+        if self.building is None or not rec.did_apply or not rec.write_items:
+            return 0
+        try:
+            self.building.bulk_update(
+                [it for _k, it in rec.write_items if it is not None],
+                [k for k, it in rec.write_items if it is None],
+                missing_ok=True,  # a tx creating+deleting one key
+                # compacts to a bare delete; the building tree nets it
+            )
+        except Exception:  # noqa: BLE001 — never let pre-hashing break
+            # the open window; the full seal remains the fallback
+            log.exception("building-tree fold failed; disabling "
+                          "incremental seal for this open ledger")
+            self.building = None
+            self.absorbed = {}
+            return 0
+        for k, it in rec.write_items:
+            self.absorbed[k] = it
+        return len(rec.write_items)
 
     def speculate(self, tx: SerializedTransaction) -> None:
         """Close-mode dry run of an open-accepted tx; records the outcome
@@ -108,17 +168,57 @@ class SpecState:
             meta = self.view.parsed_metas.pop(txid, None)
             if did_apply and meta is None:
                 return  # commit tail didn't complete; keep no record
-            self.records[txid] = SpecRecord(
+            # compact + serialize the write set NOW (the submit window),
+            # pinning each SLE as its item's parsed mirror — the close
+            # splices these exact objects, moving the per-write
+            # serialization cost out of the close window entirely
+            compact: dict[bytes, Optional[object]] = {}
+            ever_set: set[bytes] = set()
+            for k, sle in writes:
+                compact[k] = sle
+                if sle is not None:
+                    ever_set.add(k)
+            write_items = []
+            net_deletes = set()
+            for k, sle in compact.items():
+                if sle is None:
+                    write_items.append((k, None))
+                    if k in ever_set:
+                        net_deletes.add(k)
+                else:
+                    item = SHAMapItem(k, sle.serialize())
+                    item.parsed = sle
+                    write_items.append((k, item))
+            rec = SpecRecord(
                 raw_ter=engine.last_raw_ter if engine.last_raw_ter
                 is not None else ter,
                 ter=ter,
                 did_apply=did_apply,
                 reads=reads,
                 succs=succs,
-                writes=writes,
+                write_items=write_items,
                 meta=meta,
                 fee=tx.fee.mantissa if did_apply else 0,
             )
+            if meta is not None:
+                # pin the index span: serialize with index 0 then 1 and
+                # require the diff to be EXACTLY the u32's low byte —
+                # anything else keeps the re-serialize slow path
+                meta[sfTransactionIndex] = 0
+                b0 = meta.serialize()
+                meta[sfTransactionIndex] = 1
+                b1 = meta.serialize()
+                if len(b0) == len(b1):
+                    diffs = [i for i, (a, b) in enumerate(zip(b0, b1))
+                             if a != b]
+                    if (len(diffs) == 1 and diffs[0] >= 3
+                            and b0[diffs[0] - 3 : diffs[0] + 1]
+                            == b"\x00\x00\x00\x00"
+                            and b1[diffs[0]] == 1):
+                        rec.meta_blob = b0
+                        rec.meta_index_off = diffs[0] - 3
+            rec.net_deletes = frozenset(net_deletes)
+            self.records[txid] = rec
         except Exception:  # noqa: BLE001 — a half-applied overlay can't
             # be trusted for ANY later record; the close falls back whole
             log.exception(
@@ -159,6 +259,18 @@ class CloseReplay:
         self.invalidated = 0  # validation failures, counted PER ATTEMPT
         # (a retried record re-validates each pass; the churn is the
         # diagnostic, so attempts are the honest unit here)
+        # batched splice writes: spliced deltas accumulate here and land
+        # through ONE sorted bulk merge (SHAMap.bulk_update) instead of a
+        # per-key nibble walk per write — flushed before anything reads
+        # the trees (a serial fallback apply, a succ validation, or the
+        # end of the apply pass), so reads are always current
+        self._pending_state: dict[bytes, Optional[SHAMapItem]] = {}
+        self._pending_tx: list[SHAMapItem] = []
+        self.bulk_merges = 0
+        self.bulk_merged_keys = 0
+        # incremental-seal adoption outcome (maybe_adopt_prehashed)
+        self.seal_adopt = "off"
+        self.seal_residual = 0
 
     def try_splice(self, engine: TransactionEngine,
                    tx: SerializedTransaction, final: bool):
@@ -180,6 +292,10 @@ class CloseReplay:
                 self.invalidated += 1
                 self._fallback_reason = "read_invalidated"
                 return None
+        if rec.succs and self._pending_state:
+            # succ cursors walk the REAL tree: pending spliced writes
+            # must land before the range reads validate against it
+            self._flush_state()
         st = self.ledger.state_map
         for cursor, tag in rec.succs:
             item = st.succ(cursor)
@@ -204,21 +320,138 @@ class CloseReplay:
 
         ledger = self.ledger
         meta = rec.meta
-        meta[sfTransactionIndex] = engine.tx_seq
+        idx = engine.tx_seq
+        meta[sfTransactionIndex] = idx
         engine.tx_seq += 1
-        ledger.add_transaction(tx.serialize(), meta.serialize())
+        # meta bytes: patch the pinned index span of the speculation-time
+        # serialization; re-serialize only when the span wasn't pinned
+        if rec.meta_blob is not None:
+            p = rec.meta_index_off
+            mb = rec.meta_blob
+            meta_bytes = mb[:p] + idx.to_bytes(4, "big") + mb[p + 4:]
+        else:
+            meta_bytes = meta.serialize()
+        # tx-map insert rides the pending batch (Ledger.tx_item_data is
+        # the one owner of the TX_MD item layout)
+        self._pending_tx.append(
+            SHAMapItem(txid, Ledger.tx_item_data(tx.serialize(), meta_bytes))
+        )
         ledger.parsed_metas[txid] = meta
         ledger.tot_coins -= rec.fee
         ledger.fee_pool += rec.fee
-        for k, sle in rec.writes:
-            if sle is None:
-                ledger.delete_entry(k)
+        pending = self._pending_state
+        for k, item in rec.write_items:
+            if (item is None
+                    and (pending.get(k) is not None
+                         or k in rec.net_deletes)
+                    and self.ledger.state_map.get(k) is None):
+                # the key was created by this batch (an earlier splice)
+                # or by this very tx, and the tree never saw it:
+                # create-then-delete nets to NOTHING (the serial path's
+                # set_item/del_item pair), not a bare delete
+                pending.pop(k, None)
             else:
-                ledger.write_entry(k, sle)
+                pending[k] = item  # speculation-time item: no re-serialize
             writers[k] = txid
         self._class[txid] = "spliced"
         self._mark(txid, "spliced", int(rec.ter))
         return rec.ter, True
+
+    # -- batched tree merge ------------------------------------------------
+
+    def _flush_state(self) -> None:
+        pending = self._pending_state
+        if not pending:
+            return
+        import time as _t
+
+        t0 = _t.perf_counter()
+        self.ledger.state_map.bulk_update(
+            [it for it in pending.values() if it is not None],
+            [k for k, it in pending.items() if it is None],
+        )
+        self.bulk_merges += 1
+        self.bulk_merged_keys += len(pending)
+        self.tracer.complete(
+            "tree.bulk_merge", "close", t0, _t.perf_counter(),
+            seq=self.ledger.seq, map="state", n=len(pending),
+        )
+        pending.clear()
+
+    def _flush_tx(self) -> None:
+        if not self._pending_tx:
+            return
+        import time as _t
+
+        t0 = _t.perf_counter()
+        self.ledger.tx_map.bulk_update(
+            self._pending_tx, leaf_type=TNType.TX_MD
+        )
+        self.bulk_merges += 1
+        self.bulk_merged_keys += len(self._pending_tx)
+        self.tracer.complete(
+            "tree.bulk_merge", "close", t0, _t.perf_counter(),
+            seq=self.ledger.seq, map="tx", n=len(self._pending_tx),
+        )
+        self._pending_tx.clear()
+
+    def flush_pending(self) -> None:
+        """Land every queued spliced write in one sorted bulk merge per
+        map. Called before any serial fallback apply (which reads the
+        trees) and at the end of the apply passes."""
+        self._flush_state()
+        self._flush_tx()
+
+    def maybe_adopt_prehashed(self) -> None:
+        """Swap the close's state root for the pre-hashed building tree
+        when they agree (incremental seal, [tree] incremental=1).
+
+        The building tree is parent-state + all speculated writes,
+        hashed in background batches during the open window. The close's
+        final state map is parent-state + the close's ACTUAL write set —
+        both canonical radix trees, so equality of the per-key final
+        values implies byte-identical roots. This scans every key either
+        side touched, corrects the (usually empty) residual through one
+        bulk merge, and adopts the building root: the seal then hashes
+        only the residual paths. Heavy divergence (mass fallbacks)
+        rejects the swap — re-merging everything would cost more than
+        the full seal it saves. Pure optimization: any failure keeps the
+        normally-built tree and the full seal."""
+        spec = self.spec
+        if spec is None or not self.parent_ok or spec.building is None:
+            self.seal_adopt = "unarmed"
+            return
+        try:
+            building = spec.building
+            final = self.ledger.state_map
+            keys = set(spec.absorbed)
+            keys.update(self.writers)
+            sets, deletes = [], []
+            for k in keys:
+                cur = building.get(k)
+                fin = final.get(k)
+                if cur is fin:  # the splice/fold shared item object
+                    continue
+                if fin is None:
+                    if cur is not None:
+                        deletes.append(k)
+                elif cur is None or cur.data != fin.data:
+                    sets.append(fin)
+            residual = len(sets) + len(deletes)
+            if residual > max(64, len(keys) // 4):
+                self.seal_adopt = "rejected"
+                self.seal_residual = residual
+                return
+            if residual:
+                building.bulk_update(sets, deletes)
+            final.root = building.root
+            self.seal_adopt = "adopted"
+            self.seal_residual = residual
+        except Exception:  # noqa: BLE001 — optimization only: the
+            # normally-built tree + full seal is always correct
+            log.exception("incremental-seal adoption failed; "
+                          "falling back to the full seal")
+            self.seal_adopt = "error"
 
     def _mark(self, txid: bytes, mode: str, ter: Optional[int] = None,
               reason: Optional[str] = None) -> None:
@@ -263,4 +496,8 @@ class CloseReplay:
             "fallback": sum(1 for c in cls if c == "fallback"),
             "invalidated": self.invalidated,
             "parent_ok": self.parent_ok,
+            "bulk_merges": self.bulk_merges,
+            "bulk_merged_keys": self.bulk_merged_keys,
+            "seal_adopt": self.seal_adopt,
+            "seal_residual": self.seal_residual,
         }

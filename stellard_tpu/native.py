@@ -215,6 +215,21 @@ class Sha512Native:
         raw = bytes(out)
         return [raw[i * out_len : (i + 1) * out_len] for i in range(n)]
 
+    def hash_packed(self, buf: bytes, offsets, out_len: int = 32) -> list[bytes]:
+        """Batched SHA-512-half over PACKED messages: `buf` holds every
+        message back to back (domain prefixes already embedded — the
+        SHAMap flat-buffer node encoding), `offsets` is the n+1 boundary
+        list. Zero per-message Python objects cross into C: one buffer,
+        one offsets array, one call (sha512h_batch with NULL prefixes)."""
+        n = len(offsets) - 1
+        if n <= 0:
+            return []
+        arr = (ctypes.c_uint64 * (n + 1))(*offsets)
+        out = (ctypes.c_uint8 * (n * out_len))()
+        self.lib.sha512h_batch(bytes(buf), arr, None, out, n, out_len)
+        raw = bytes(out)
+        return [raw[i * out_len : (i + 1) * out_len] for i in range(n)]
+
 
 class Ed25519HostPrep:
     """Batched h = SHA512(R||A||M) mod l over the C kernel (threaded).

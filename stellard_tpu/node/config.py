@@ -83,6 +83,19 @@ class Config:
     close_pipeline_enabled: bool = True
     close_pipeline_depth: int = 8
 
+    # -- state-tree commit plane ([tree]) ----------------------------------
+    # incremental=1: speculated writes fold into a pre-seal building
+    # tree that a background drainer hashes through the routed hash
+    # plane between closes, so the in-close seal adopts the pre-hashed
+    # root and hashes only the residual (state/shamap.py bulk_update +
+    # engine/deltareplay.py). incremental=0 is the kill-switch: the
+    # full serial seal, which also remains the automatic per-close
+    # fallback whenever adoption cannot apply. drain_batch is how many
+    # folded writes accumulate before a background drain fires — bigger
+    # batches suit the device kernel, smaller ones keep less residual.
+    tree_incremental_seal: bool = True
+    tree_drain_batch: int = 256
+
     # -- ledger close ([close]) --------------------------------------------
     # delta_replay=1: the open-ledger accept also executes the tx once in
     # close mode against a speculative overlay, recording its read/write
@@ -206,6 +219,13 @@ class Config:
             cfg.close_delta_replay = close["delta_replay"].lower() not in (
                 "0", "false", "no", "off"
             )
+        tree = _kv(s.get("tree", []))
+        if "incremental" in tree:
+            cfg.tree_incremental_seal = tree["incremental"].lower() not in (
+                "0", "false", "no", "off"
+            )
+        if "drain_batch" in tree:
+            cfg.tree_drain_batch = int(tree["drain_batch"])
 
         cfg.validation_seed = one("validation_seed", cfg.validation_seed)
         cfg.sntp_servers = [line.split()[0] for line in s.get("sntp_servers", [])]

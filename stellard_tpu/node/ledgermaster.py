@@ -113,6 +113,25 @@ class LedgerMaster:
             "closes": 0, "spliced": 0, "fallback": 0, "invalidated": 0,
         }
         self.last_close: dict = {}
+        # incremental O(dirty) seal ([tree] incremental, default on):
+        # speculated writes fold into a pre-seal "building" tree on the
+        # SpecState, and a background drainer hashes its dirty subtrees
+        # through the routed hash plane between closes — the in-close
+        # seal then adopts the pre-hashed root and hashes only the
+        # residual (engine/deltareplay.py maybe_adopt_prehashed). The
+        # full serial seal remains the per-close fallback, never forked.
+        self.incremental_seal = True
+        self.seal_drain_batch = 256  # writes folded before a drain fires
+        self.tree_stats = {
+            "drains": 0, "drained_nodes": 0, "seal_adopted": 0,
+            "seal_rejected": 0, "seal_residual_keys": 0,
+            "bulk_merges": 0, "bulk_merged_keys": 0,
+        }
+        self._drain_hist = LatencyHist(bounds=STAGE_BOUNDS, interpolate=True)
+        self._drain_cv = threading.Condition()
+        self._drain_pending = 0
+        self._drainer: Optional[threading.Thread] = None
+        self._drain_stop = False
         # per-close stage latency histograms (ms): apply pass, seal
         # overlap, total — the shared metrics.LatencyHist (fine-grained
         # bounds: closes live in the 1-500 ms band)
@@ -226,10 +245,123 @@ class LedgerMaster:
                     from ..engine.deltareplay import SpecState
 
                     spec = open_ledger._spec_state = SpecState(open_ledger)
+                    if self.incremental_seal:
+                        # the open window never mutates the state map, so
+                        # its root IS the parent state the close starts
+                        # from — the building tree folds speculated
+                        # writes onto it and pre-hashes between closes
+                        spec.attach_building(
+                            open_ledger.state_map.root, self.hash_batch
+                        )
                 with self.tracer.span("open.speculate", "apply",
                                       txid=tx.txid()):
                     spec.speculate(tx)
+                rec = spec.records.get(tx.txid())
+                if rec is not None and spec.building is not None:
+                    folded = spec.fold_building(rec)
+                    if folded:
+                        self._note_fold(folded)
         return ter, applied
+
+    # -- incremental-seal background drain --------------------------------
+
+    def _note_fold(self, n_ops: int) -> None:
+        """Count folded writes; past the drain batch, wake the drainer to
+        pre-hash the building tree's dirty subtrees off this thread.
+        drain_batch < 1 disables background drains entirely (folding and
+        root adoption still run; the seal just hashes at close time)."""
+        if self.seal_drain_batch < 1:
+            return
+        with self._drain_cv:
+            self._drain_pending += n_ops
+            if self._drain_pending >= self.seal_drain_batch:
+                if self._drainer is None and not self._drain_stop:
+                    self._drainer = threading.Thread(
+                        target=self._drain_loop, name="seal-drain",
+                        daemon=True,
+                    )
+                    self._drainer.start()
+                self._drain_cv.notify()
+
+    def _drain_loop(self) -> None:
+        from ..state.shamap import compute_hashes
+
+        # the hasher is fixed per LedgerMaster: probe its hash_tree
+        # hint capability once, not one inspect.signature per drain
+        supports_hint: Optional[bool] = None
+        while True:
+            with self._drain_cv:
+                # max(1, batch): a runtime knob change to <1 must idle
+                # the thread (pending only grows via _note_fold, which
+                # gates on the same knob), never spin it
+                while (self._drain_pending < max(1, self.seal_drain_batch)
+                       and not self._drain_stop):
+                    self._drain_cv.wait(timeout=1.0)
+                if self._drain_stop:
+                    return
+                todo = self._drain_pending
+                self._drain_pending = 0
+            # snapshot the building tree UNDER the chain lock, hash it
+            # OUTSIDE: the tree is persistent, so hashing a snapshot
+            # root only fills write-once _hash slots on nodes the
+            # foreground shares — concurrent folds build new paths and
+            # never touch fields this walk writes
+            with self._lock:
+                cur = self.current
+                spec = getattr(cur, "_spec_state", None) if cur else None
+                building = spec.building if spec is not None else None
+                root = building.root if building is not None else None
+                hasher = building.hash_batch if building is not None else None
+            if root is None:
+                continue
+            t0 = time.perf_counter()
+            try:
+                tree_fn = getattr(hasher, "hash_tree", None)
+                if tree_fn is not None:
+                    if supports_hint is None:
+                        import inspect
+
+                        supports_hint = (
+                            "hint_nodes"
+                            in inspect.signature(tree_fn).parameters
+                        )
+                    if supports_hint:
+                        n = tree_fn(root, hint_nodes=todo)
+                    else:
+                        n = tree_fn(root)
+                else:
+                    n = compute_hashes(root, hasher)
+            except Exception:  # noqa: BLE001 — pre-hashing is advisory;
+                # the close's full seal recomputes whatever is missing
+                continue
+            t1 = time.perf_counter()
+            with self._drain_cv:
+                self.tree_stats["drains"] += 1
+                self.tree_stats["drained_nodes"] += n
+            self._drain_hist.record((t1 - t0) * 1000.0)
+            self.tracer.complete("seal.incremental", "seal", t0, t1,
+                                 nodes=n)
+
+    def stop_seal_drainer(self) -> None:
+        """Stop the background pre-hash thread (Node.stop). Idempotent;
+        a stopped LedgerMaster never restarts it."""
+        with self._drain_cv:
+            self._drain_stop = True
+            self._drain_cv.notify_all()
+        t = self._drainer
+        if t is not None:
+            t.join(timeout=5)
+
+    def tree_json(self) -> dict:
+        """Batched-commit-plane counters for get_counts/server_state."""
+        with self._drain_cv:
+            out = dict(self.tree_stats)
+        out["incremental_seal"] = self.incremental_seal
+        out["drain_batch"] = self.seal_drain_batch
+        if self._drain_hist.count:
+            out["drain_p50_ms"] = self._drain_hist.quantile(0.5)
+            out["drain_p90_ms"] = self._drain_hist.quantile(0.9)
+        return out
 
     # -- close (standalone / consensus-accept share this tail) ------------
 
@@ -534,6 +666,9 @@ class LedgerMaster:
                 hit = replay.try_splice(engine, tx, final)
                 if hit is not None:
                     return hit
+                # the serial transactor reads the real trees: queued
+                # spliced writes must land first
+                replay.flush_pending()
             ter, did_apply = engine.apply_transaction(
                 tx, TxParams.NONE if final else TxParams.RETRY
             )
@@ -571,6 +706,12 @@ class LedgerMaster:
                         results[tx.txid()] = ter
                 break
         if replay is not None:
+            replay.flush_pending()
+            if self.incremental_seal:
+                # adopt the pre-hashed building root where it matches the
+                # close's final write set — the seal then hashes only the
+                # residual (full seal stays the automatic fallback)
+                replay.maybe_adopt_prehashed()
             self._note_delta_stats(replay)
         return results
 
@@ -581,6 +722,19 @@ class LedgerMaster:
         self.delta_stats["closes"] += 1
         for k in ("spliced", "fallback", "invalidated"):
             self.delta_stats[k] += c[k]
+        with self._drain_cv:
+            self.tree_stats["bulk_merges"] += c.get("bulk_merges", 0)
+            self.tree_stats["bulk_merged_keys"] += c.get(
+                "bulk_merged_keys", 0
+            )
+            adopt = c.get("seal_adopt")
+            if adopt == "adopted":
+                self.tree_stats["seal_adopted"] += 1
+                self.tree_stats["seal_residual_keys"] += c.get(
+                    "seal_residual", 0
+                )
+            elif adopt in ("rejected", "error"):
+                self.tree_stats["seal_rejected"] += 1
         self.last_close.update(c)
 
     def _note_close_stages(self, t0: float, t_apply: float,

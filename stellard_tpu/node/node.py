@@ -507,6 +507,11 @@ class Node:
         # submit + optimistic delta splice at close (serial fallback per
         # tx on any read-set conflict)
         self.ledger_master.delta_replay = cfg.close_delta_replay
+        # [tree]: incremental O(dirty) seal — speculated writes pre-hash
+        # in background batches between closes; the full seal stays the
+        # automatic fallback (incremental=0 is the kill-switch)
+        self.ledger_master.incremental_seal = cfg.tree_incremental_seal
+        self.ledger_master.seal_drain_batch = cfg.tree_drain_batch
         self.ops = NetworkOPs(
             self.ledger_master,
             self.job_queue,
@@ -837,6 +842,7 @@ class Node:
     def stop(self) -> None:
         self._running.clear()
         self.load_manager.stop()
+        self.ledger_master.stop_seal_drainer()
         if self.overlay is not None:
             stop = getattr(self.overlay, "stop", None)
             if stop is not None:  # embedders may attach bare adapters

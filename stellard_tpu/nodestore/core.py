@@ -138,6 +138,36 @@ class Database:
         """Adapter with the (hash, blob) signature SHAMap.flush expects."""
         return lambda h, d: self.store(type, h, d)
 
+    def store_many(self, type: NodeObjectType,
+                   pairs: list[tuple[bytes, bytes]]) -> None:
+        """Batch store: every (hash, blob) pair lands in `_pending` under
+        ONE lock hold (the flat-buffer flush path — a per-close tree
+        delta is thousands of nodes, and per-node lock round-trips were
+        pure overhead). Async mode wakes the writer once; sync mode
+        drains through the backend's own batch call."""
+        if not pairs:
+            return
+        batch = [NodeObject(type, h, d) for h, d in pairs]
+        with self._lock:
+            if self._write_error is not None:
+                raise RuntimeError("nodestore writer failed") from self._write_error
+            for obj in batch:
+                self._pending[obj.hash] = obj
+            if self._writer is not None:
+                self._wake.notify()
+        if self._writer is None:
+            self.backend.store_batch(batch)
+            with self._lock:
+                for obj in batch:
+                    if self._pending.get(obj.hash) is obj:
+                        del self._pending[obj.hash]
+                    self._cache_unlocked(obj)
+
+    def store_many_fn(self, type: NodeObjectType) -> Callable[[list], None]:
+        """Adapter with the batch signature SHAMap.flush's `store_many`
+        expects."""
+        return lambda pairs: self.store_many(type, pairs)
+
     def sync(self) -> None:
         """Block until all pending writes hit the backend. Raises the
         writer thread's error if the backend failed (otherwise a dead

@@ -323,6 +323,9 @@ def do_server_state(ctx: Context) -> dict:
     # delta-replay close: spliced/fallback/invalidation counters +
     # close-stage (apply/seal/total) latency percentiles
     state["delta_replay"] = node.ledger_master.delta_replay_json()
+    # batched state-tree commit plane: merges, pre-hash drains, seal
+    # adoptions (aggregate counters only — no per-tx detail to gate)
+    state["tree"] = node.ledger_master.tree_json()
     tracer = getattr(node, "tracer", None)
     if tracer is not None:
         # tracing plane status; the consensus/close timeline is ADMIN
@@ -356,6 +359,13 @@ def do_get_counts(ctx: Context) -> dict:
         out["close_pipeline"] = pipeline.get_json()
         out["persist_backlog"] = pipeline.pending()
     out["delta_replay"] = node.ledger_master.delta_replay_json()
+    # batched state-tree commit plane: bulk merges, background pre-hash
+    # drains, seal adoptions (node/ledgermaster.py tree_json)
+    out["tree"] = node.ledger_master.tree_json()
+    # from_store inner-node memo (catch-up fetch path re-parse saver)
+    from ..state.shamap import inner_node_cache
+
+    out["shamap_inner_cache"] = inner_node_cache().get_json()
     tracer = getattr(node, "tracer", None)
     if tracer is not None:
         out["trace"] = tracer.status_json()  # ADMIN method: timeline ok

@@ -261,15 +261,26 @@ class Ledger:
         if cur is None or sequence > cur:
             self.open_tx_seqs[account] = sequence
 
+    @staticmethod
+    def tx_item_data(tx_blob: bytes, metadata: bytes) -> bytes:
+        """The TX_MD item payload: VL(tx) ‖ VL(metadata) — the ONE place
+        that writes this layout (tx_entries/get_transaction read it).
+        Shared by add_transaction and the delta-replay splice's batched
+        tx-map inserts."""
+        s = Serializer()
+        s.add_vl(tx_blob)
+        s.add_vl(metadata)
+        return s.data()
+
     def add_transaction(self, tx_blob: bytes, metadata: bytes) -> bytes:
         """Insert a tx + its metadata into the tx map (reference:
         Ledger::addTransaction w/ metadata — item data is
         VL(tx) || VL(metadata), tag is the tx ID)."""
         txid = prefix_hash(HP_TXN_ID, tx_blob)
-        s = Serializer()
-        s.add_vl(tx_blob)
-        s.add_vl(metadata)
-        self.tx_map.set_item(SHAMapItem(txid, s.data()), TNType.TX_MD)
+        self.tx_map.set_item(
+            SHAMapItem(txid, self.tx_item_data(tx_blob, metadata)),
+            TNType.TX_MD,
+        )
         return txid
 
     def record_transaction(self, tx_blob: bytes, meta) -> bytes:
@@ -396,9 +407,17 @@ class Ledger:
         """Persist both trees + the header into the NodeStore (reference:
         consensus flushDirty + Ledger::pendSaveValidated; header stored as
         hotLEDGER under the ledger hash). Uses the store's `flushed` set so
-        repeated saves only write the delta."""
-        self.state_map.flush(db.store_fn(NodeObjectType.ACCOUNT_NODE), db.flushed)
-        self.tx_map.flush(db.store_fn(NodeObjectType.TRANSACTION_NODE), db.flushed)
+        repeated saves only write the delta; node blobs come off the
+        shared flat-buffer encoding and land via the store's batch door
+        (one lock hold per chunk, not per node)."""
+        self.state_map.flush(
+            db.store_fn(NodeObjectType.ACCOUNT_NODE), db.flushed,
+            store_many=db.store_many_fn(NodeObjectType.ACCOUNT_NODE),
+        )
+        self.tx_map.flush(
+            db.store_fn(NodeObjectType.TRANSACTION_NODE), db.flushed,
+            store_many=db.store_many_fn(NodeObjectType.TRANSACTION_NODE),
+        )
         h = self.hash()
         db.store(NodeObjectType.LEDGER, h,
                  HP_LEDGER_MASTER.to_bytes(4, "big") + self.header_bytes())

@@ -39,7 +39,10 @@ from ..utils.hashes import (
     prefix_hash,
 )
 
-__all__ = ["TNType", "SHAMapItem", "SHAMap", "Leaf", "Inner"]
+__all__ = [
+    "TNType", "SHAMapItem", "SHAMap", "Leaf", "Inner",
+    "encode_nodes", "inner_node_cache",
+]
 
 ZERO256 = b"\x00" * 32
 
@@ -195,6 +198,112 @@ def _del_item(node, key: bytes, depth: int):
     return Inner(tuple(children))
 
 
+def _build_subtree(ops: list, lo: int, hi: int, depth: int):
+    """Canonical subtree for ops[lo:hi] (sorted, unique (key, Leaf)
+    set-ops) under an empty slot. Shared nibble runs recurse once — the
+    path-copy cost of a batch is O(distinct inner nodes), not
+    O(ops × depth). Index-range recursion: no slice copies."""
+    if hi - lo == 1:
+        return ops[lo][1]
+    children = [None] * 16
+    shift_odd = depth & 1
+    byte_i = depth // 2
+    i = lo
+    while i < hi:
+        kb = ops[i][0][byte_i]
+        b = kb & 0xF if shift_odd else kb >> 4
+        j = i + 1
+        while j < hi:
+            kb = ops[j][0][byte_i]
+            if (kb & 0xF if shift_odd else kb >> 4) != b:
+                break
+            j += 1
+        children[b] = _build_subtree(ops, i, j, depth + 1)
+        i = j
+    return Inner(tuple(children))
+
+
+def _bulk_merge(node, ops: list, lo: int, hi: int, depth: int,
+                dels: list):
+    """Merge ops[lo:hi] (sorted, unique (key, Leaf|None); None = delete)
+    into the persistent subtree at `node`; returns the replacement node
+    (None when the subtree empties). One DFS pass: each dirty inner is
+    copied once regardless of how many ops pass through it. Deleting a
+    missing key raises KeyError — exact `_del_item` parity. `dels` is
+    the delete-count prefix array over `ops` (dels[i] = deletes before
+    index i): a subtree whose run carries no deletes can neither empty
+    nor fold up, so the live-child scan is skipped entirely.
+
+    The tree is CANONICAL (structure is a pure function of the final
+    key set: inners exist exactly on shared prefixes of >= 2 leaves, and
+    single-leaf inners collapse), so this produces byte-identical roots
+    to any per-key application of the same final key->value map — the
+    property the differential suite pins."""
+    if lo >= hi:
+        return node
+    if hi - lo == 1:
+        # singleton run: the lean per-key primitives finish the path
+        k, leaf = ops[lo]
+        if leaf is None:
+            return _del_item(node, k, depth)
+        return _set_item(node, k, leaf, depth)
+    if node is None:
+        if dels[hi] != dels[lo]:
+            for i in range(lo, hi):
+                if ops[i][1] is None:
+                    raise KeyError(ops[i][0].hex())
+        return _build_subtree(ops, lo, hi, depth)
+    if isinstance(node, Leaf):
+        tag = node.item.tag
+        merged: list = []
+        replaced = False
+        placed = False
+        for i in range(lo, hi):
+            k, leaf = ops[i]
+            if not placed and not replaced and tag < k:
+                merged.append((tag, node))
+                placed = True
+            if k == tag:
+                replaced = True
+                if leaf is not None:
+                    merged.append((k, leaf))
+            elif leaf is None:
+                raise KeyError(k.hex())
+            else:
+                merged.append((k, leaf))
+        if not replaced and not placed:
+            merged.append((tag, node))
+        if not merged:
+            return None
+        if len(merged) == 1:
+            return merged[0][1]
+        return _build_subtree(merged, 0, len(merged), depth)
+    # inner: partition the sorted run into contiguous nibble runs
+    children = list(node.children)
+    shift_odd = depth & 1
+    byte_i = depth // 2
+    i = lo
+    while i < hi:
+        kb = ops[i][0][byte_i]
+        b = kb & 0xF if shift_odd else kb >> 4
+        j = i + 1
+        while j < hi:
+            kb = ops[j][0][byte_i]
+            if (kb & 0xF if shift_odd else kb >> 4) != b:
+                break
+            j += 1
+        children[b] = _bulk_merge(children[b], ops, i, j, depth + 1, dels)
+        i = j
+    if dels[hi] == dels[lo]:
+        return Inner(tuple(children))  # no deletes below: cannot collapse
+    live = [c for c in children if c is not None]
+    if not live:
+        return None
+    if len(live) == 1 and isinstance(live[0], Leaf):
+        return live[0]  # single-leaf fold-up (del_item parity)
+    return Inner(tuple(children))
+
+
 def _get(node, key: bytes, depth: int) -> Optional[SHAMapItem]:
     while node is not None:
         if isinstance(node, Leaf):
@@ -244,6 +353,81 @@ def _default_hasher(prefixes, payloads):
     return [prefix_hash(p, d) for p, d in zip(prefixes, payloads)]
 
 
+# --------------------------------------------------------------------------
+# flat-buffer node encoding: every dirty node's prefix-format bytes packed
+# into ONE contiguous buffer + offsets, instead of one Python payload
+# object per node. The encoding doubles as (a) the exact hashed message
+# per node (prefix-format blob == hashed bytes) and (b) the exact
+# NodeStore blob, so hashing and flushing share one serialization.
+
+_PFX_INNER = HP_INNER_NODE.to_bytes(4, "big")
+_PFX_LEAF = {t: p.to_bytes(4, "big") for t, p in _LEAF_PREFIX.items()}
+
+_native_pack = None
+_native_merge = None
+_native_resolved = False
+
+
+def _resolve_native():
+    """Bind the C fast paths (native/src/stser.cc pack_nodes +
+    bulk_merge) once; pure-Python loops otherwise. Both are
+    differential-tested byte-equal against the Python implementations."""
+    global _native_pack, _native_merge, _native_resolved
+    if not _native_resolved:
+        _native_resolved = True
+        try:
+            from ..native import load_stser
+
+            mod = load_stser()
+            _native_pack = getattr(mod, "pack_nodes", None)
+            _native_merge = getattr(mod, "bulk_merge", None)
+        except Exception:  # noqa: BLE001 — toolchain-less box: python path
+            _native_pack = _native_merge = None
+
+
+def _resolve_native_pack():
+    _resolve_native()
+    return _native_pack
+
+
+def _resolve_native_merge():
+    _resolve_native()
+    return _native_merge
+
+
+def _encode_nodes_py(nodes) -> tuple[bytes, list[int]]:
+    buf = bytearray()
+    ext = buf.extend
+    offsets = [0]
+    app = offsets.append
+    for node in nodes:
+        if isinstance(node, Inner):
+            ext(_PFX_INNER)
+            for c in node.children:
+                ext(c._hash if c is not None else ZERO256)
+        else:
+            t = node.type
+            ext(_PFX_LEAF[t])
+            ext(node.item.data)
+            if t is not TNType.TX_NM:
+                ext(node.item.tag)
+        app(len(buf))
+    return bytes(buf), offsets
+
+
+def encode_nodes(nodes) -> tuple[bytes, list[int]]:
+    """Pack the prefix-format bytes of `nodes` (Leaf | Inner; inner
+    children must already carry hashes) into one contiguous buffer.
+    Returns (buffer, offsets[n+1]); node i's blob/message is
+    buffer[offsets[i]:offsets[i+1]]."""
+    nodes = nodes if isinstance(nodes, list) else list(nodes)
+    pack = _resolve_native_pack()
+    if pack is not None:
+        return pack(nodes, int(HP_INNER_NODE), int(HP_TXN_ID),
+                    int(HP_TX_NODE), int(HP_LEAF_NODE))
+    return _encode_nodes_py(nodes)
+
+
 def compute_hashes(root, hash_batch: Callable = _default_hasher) -> int:
     """Fill every missing node hash, one batched call per tree level,
     deepest level first. Returns the number of nodes hashed.
@@ -258,8 +442,26 @@ def compute_hashes(root, hash_batch: Callable = _default_hasher) -> int:
         # device-resident across levels, one host transfer at the end
         return hash_batch.hash_tree(root)
     levels = _collect_unhashed(root)
+    packed = getattr(hash_batch, "hash_packed", None)
     n = 0
     for level in reversed(levels):
+        if packed is not None:
+            # flat-buffer path: one contiguous encoding per level feeds
+            # the batch hasher in a single call — no per-node payload
+            # objects (the prep cost that dominated the host seal)
+            targets = []
+            for node in level:
+                if isinstance(node, Inner) and node.is_empty():
+                    node._hash = ZERO256
+                else:
+                    targets.append(node)
+            if targets:
+                buf, offsets = encode_nodes(targets)
+                digests = packed(buf, offsets)
+                for node, dg in zip(targets, digests):
+                    node._hash = dg
+            n += len(targets)
+            continue
         prefixes, payloads = [], []
         for node in level:
             if isinstance(node, Leaf):
@@ -317,6 +519,26 @@ def serialize_node_wire(node) -> bytes:
         return item.data + bytes([_WIRE_TX_NM])
     trailer = _WIRE_STATE if t == TNType.ACCOUNT_STATE else _WIRE_TX_MD
     return item.data + item.tag + bytes([trailer])
+
+
+# process-wide memo of deserialized-and-resolved inner nodes, keyed by
+# node hash (content-addressed, so sharing across stores/trees is always
+# sound). The catch-up fetch path (Ledger.load / replay_range) re-parsed
+# every shared inner of every ledger it materialized; a hit here returns
+# the whole resolved subtree in O(1). Bounded + aged (TaggedCache), with
+# hit/miss counters surfaced in get_counts.
+_INNER_CACHE = None
+
+
+def inner_node_cache():
+    global _INNER_CACHE
+    if _INNER_CACHE is None:
+        from ..utils.taggedcache import TaggedCache
+
+        _INNER_CACHE = TaggedCache(
+            "shamap_inners", target_size=4096, expiration_s=300.0
+        )
+    return _INNER_CACHE
 
 
 class InnerStub:
@@ -467,15 +689,64 @@ class SHAMap:
 
     def del_item(self, key: bytes) -> None:
         root = _del_item(self.root, key, 0)
+        self.root = self._normalize_root(root)
+
+    def bulk_update(self, sets=(), deletes=(),
+                    leaf_type: Optional[TNType] = None,
+                    missing_ok: bool = False) -> int:
+        """Apply a whole write set in ONE key-sorted DFS pass: `sets` are
+        SHAMapItems (replace-or-insert), `deletes` are keys (KeyError if
+        missing — del_item parity). Shared path prefixes are copied once
+        instead of once per write, which is what makes a close's spliced
+        delta O(distinct dirty nodes) instead of O(writes x depth).
+
+        Byte-contract: the resulting root (and hash) is identical to
+        applying the same final key->value map through per-key
+        set_item/del_item in any order — the tree is canonical in the
+        final key set. A key in both `sets` and `deletes` is a caller
+        bug (ValueError); duplicate keys within `sets` keep the LAST
+        item. With `missing_ok`, deletes of keys absent from the tree
+        are dropped instead of raising (a compacted create-then-delete
+        nets to nothing). Returns the number of distinct keys applied."""
+        lt = leaf_type or self.leaf_type
+        ops: dict[bytes, Optional[Leaf]] = {}
+        for item in sets:
+            ops[item.tag] = Leaf(item, lt)
+        for key in deletes:
+            if ops.get(key) is not None:
+                raise ValueError(
+                    f"key {key.hex()[:16]} in both sets and deletes"
+                )
+            if missing_ok and self.get(key) is None:
+                continue
+            ops[key] = None
+        if not ops:
+            return 0
+        sorted_ops = sorted(ops.items())
+        merge_c = _resolve_native_merge()
+        if merge_c is not None:
+            root = merge_c(self.root, sorted_ops, Leaf, Inner)
+        else:
+            dels = [0] * (len(sorted_ops) + 1)
+            for i, (_k, leaf) in enumerate(sorted_ops):
+                dels[i + 1] = dels[i] + (leaf is None)
+            root = _bulk_merge(
+                self.root, sorted_ops, 0, len(sorted_ops), 0, dels
+            )
+        self.root = self._normalize_root(root)
+        return len(ops)
+
+    @staticmethod
+    def _normalize_root(root):
+        """The tree root is always an inner node (reference keeps a root
+        inner even for a single item)."""
         if root is None:
-            root = EMPTY_INNER
-        elif isinstance(root, Leaf):
-            # the tree root is always an inner node (reference keeps a root
-            # inner even for a single item)
+            return EMPTY_INNER
+        if isinstance(root, Leaf):
             children = [None] * 16
             children[_nibble(root.item.tag, 0)] = root
-            root = Inner(tuple(children))
-        self.root = root
+            return Inner(tuple(children))
+        return root
 
     # -- hashing / snapshots ---------------------------------------------
 
@@ -539,8 +810,13 @@ class SHAMap:
 
     # -- NodeStore integration -------------------------------------------
 
+    # encode-and-store chunk size: bounds the shared buffer so flushing
+    # a whole genesis tree never materializes the full serialization
+    FLUSH_CHUNK = 8192
+
     def flush(self, store: Callable[[bytes, bytes], None],
-              known: Optional[set] = None) -> int:
+              known: Optional[set] = None,
+              store_many: Optional[Callable[[list], None]] = None) -> int:
         """Hash everything, then persist every node the target store does
         not yet have, as (hash → prefix-format blob). Returns the number of
         nodes written.
@@ -552,26 +828,45 @@ class SHAMap:
         to the delta, not total state. The set is per-store — flushing the
         same tree into a second store writes everything again there
         (the reference's flushDirty dirty-list behaves the same way).
+
+        The write set serializes through the flat-buffer node encoder
+        (the same encoding the hash plane consumes — a prefix-format
+        blob IS the hashed byte sequence), not per-node
+        serialize_node_prefix calls; with `store_many` (a batch sink,
+        e.g. Database.store_many_fn) each chunk lands in the store in
+        one call instead of one lock round-trip per node.
         """
         self.get_hash()
         if known is None:
             known = set()
-        count = 0
+        nodes: list = []
 
         def visit(node):
-            nonlocal count
             if node is None or node._hash in known:
                 return
             if isinstance(node, Inner):
                 for c in node.children:
                     visit(c)
-            store(node._hash, serialize_node_prefix(node))
-            known.add(node._hash)
-            count += 1
+            nodes.append(node)  # post-order: children land before parents
 
         if not (isinstance(self.root, Inner) and self.root.is_empty()):
             visit(self.root)
-        return count
+        for start in range(0, len(nodes), self.FLUSH_CHUNK):
+            chunk = nodes[start : start + self.FLUSH_CHUNK]
+            buf, offsets = encode_nodes(chunk)
+            if store_many is not None:
+                store_many([
+                    (node._hash, buf[offsets[i] : offsets[i + 1]])
+                    for i, node in enumerate(chunk)
+                ])
+            else:
+                for i, node in enumerate(chunk):
+                    store(node._hash, buf[offsets[i] : offsets[i + 1]])
+            # mark flushed only AFTER the store accepted the chunk: a
+            # failing store must leave the flush retryable, never a
+            # known-set claiming nodes the backend never saw
+            known.update(node._hash for node in chunk)
+        return len(nodes)
 
     @classmethod
     def from_store(
@@ -581,17 +876,30 @@ class SHAMap:
         leaf_type: TNType = TNType.ACCOUNT_STATE,
         hash_batch: Callable = _default_hasher,
         verify: bool = True,
+        use_cache: bool = True,
     ) -> "SHAMap":
         """Materialize a full tree from a content-addressed store
         (reference: SHAMap fetchNodeExternal path). Raises KeyError on a
         missing node (the seam where network acquisition hooks in) and,
         with `verify` (default), ValueError when a fetched blob does not
         hash to its key (the reference verifies fetched nodes the same
-        way, SHAMapTreeNode ctor hashValid path)."""
+        way, SHAMapTreeNode ctor hashValid path).
+
+        With `use_cache` (default), resolved inner nodes memoize in the
+        process-wide `inner_node_cache()` keyed by node hash — a hit
+        returns a whole already-verified subtree, so materializing
+        successive ledgers of a chain re-parses only the delta. Nodes
+        are immutable + content-addressed, which is what makes the
+        sharing sound across stores and trees."""
         if root_hash == ZERO256:
             return cls(leaf_type, EMPTY_INNER, hash_batch)
+        cache = inner_node_cache() if use_cache else None
 
         def load(h: bytes):
+            if cache is not None:
+                hit = cache.get(h)
+                if hit is not None:
+                    return hit
             blob = fetch(h)
             if blob is None:
                 raise KeyError(f"missing node {h.hex()}")
@@ -611,6 +919,8 @@ class SHAMap:
                     load(ch) if ch != ZERO256 else None for ch in node.child_hashes
                 )
                 node = Inner(children, hash=h)
+                if cache is not None:
+                    cache.put(h, node)
             else:
                 node._hash = h
             return node

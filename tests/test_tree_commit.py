@@ -1,0 +1,634 @@
+"""Batched state-tree commit plane: the byte contracts.
+
+Four surfaces, each pinned against the serial/per-key ground truth:
+
+- ``SHAMap.bulk_update`` (sorted one-pass delta merge, C + Python
+  implementations) must be byte-identical to per-key
+  ``set_item``/``del_item`` for ANY final key->value map — randomized
+  mixed streams, adversarial shared-prefix keys, delete-driven
+  collapse, structural sharing across snapshots;
+- the flat-buffer node encoder (native ``pack_nodes`` + Python
+  fallback) must produce exactly the per-node prefix-format blobs, and
+  flush-through-the-encoder must store the same bytes the old per-node
+  serializer did;
+- the incremental seal (building tree + background drain + root
+  adoption) must close byte-identically to the full seal across
+  adversarial deletes and a mid-stream snapshot;
+- the hash router's ``min_device_nodes`` floor must keep small batches
+  off the device without disturbing measured routing above it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+
+import pytest
+
+from stellard_tpu.crypto.backend import (
+    BatchHasher,
+    CpuHasher,
+    WatchdogHasher,
+    _HashCostModel,
+)
+from stellard_tpu.nodestore import NodeObjectType, make_database
+from stellard_tpu.state.shamap import (
+    EMPTY_INNER,
+    Inner,
+    Leaf,
+    SHAMap,
+    SHAMapItem,
+    TNType,
+    ZERO256,
+    _bulk_merge,
+    _collect_unhashed,
+    _encode_nodes_py,
+    _resolve_native_merge,
+    _resolve_native_pack,
+    encode_nodes,
+    inner_node_cache,
+    serialize_node_prefix,
+)
+
+
+def h(x) -> bytes:
+    return hashlib.sha256(repr(x).encode()).digest()
+
+
+def shared_prefix_key(base: bytes, nibbles: int, salt) -> bytes:
+    """A key sharing `nibbles` leading nibbles with `base` (adversarial
+    deep leaf-collision chains)."""
+    raw = bytearray(h(("sp", salt)))
+    for i in range(nibbles):
+        b = base[i // 2]
+        if i % 2 == 0:
+            raw[i // 2] = (b & 0xF0) | (raw[i // 2] & 0x0F)
+        else:
+            raw[i // 2] = (raw[i // 2] & 0xF0) | (b & 0x0F)
+    return bytes(raw)
+
+
+def apply_per_key(m: SHAMap, sets, deletes) -> None:
+    for item in sets:
+        m.set_item(SHAMapItem(item.tag, item.data))
+    for k in deletes:
+        m.del_item(k)
+
+
+def python_bulk_update(m: SHAMap, sets, deletes) -> None:
+    """bulk_update forced through the pure-Python merge (the
+    toolchain-less fallback), regardless of the native binding."""
+    ops = {}
+    for item in sets:
+        ops[item.tag] = Leaf(item, m.leaf_type)
+    for k in deletes:
+        ops[k] = None
+    sorted_ops = sorted(ops.items())
+    dels = [0] * (len(sorted_ops) + 1)
+    for i, (_k, leaf) in enumerate(sorted_ops):
+        dels[i + 1] = dels[i] + (leaf is None)
+    root = _bulk_merge(m.root, sorted_ops, 0, len(sorted_ops), 0, dels)
+    m.root = m._normalize_root(root)
+
+
+class TestBulkUpdateDifferential:
+    """Randomized set/delete streams: bulk (C and Python) vs per-key."""
+
+    def test_randomized_streams_byte_identical(self):
+        rng = random.Random(1234)
+        for trial in range(25):
+            keys = [h((trial, i)) for i in range(rng.randrange(2, 120))]
+            # adversarial: keys sharing deep nibble prefixes
+            for i in range(len(keys) // 3):
+                keys.append(
+                    shared_prefix_key(keys[i], rng.randrange(1, 12),
+                                      (trial, i))
+                )
+            m_ref, m_c, m_py = SHAMap(), SHAMap(), SHAMap()
+            live: set = set()
+            for round_ in range(4):
+                chosen = {}
+                for k in keys:
+                    r = rng.random()
+                    if r < 0.5:
+                        chosen[k] = "set"
+                    elif r < 0.7 and k in live:
+                        chosen[k] = "del"
+                sets, dels = [], []
+                for k, op in chosen.items():
+                    if op == "set":
+                        data = h((trial, round_, k))[: rng.randrange(1, 32)]
+                        sets.append(SHAMapItem(k, data or b"x"))
+                    else:
+                        dels.append(k)
+                live |= {s.tag for s in sets}
+                live -= set(dels)
+                apply_per_key(m_ref, sets, dels)
+                m_c.bulk_update(sets, dels)
+                python_bulk_update(m_py, sets, dels)
+                assert m_c.get_hash() == m_ref.get_hash()
+                assert m_py.get_hash() == m_ref.get_hash()
+                assert len(m_c) == len(m_ref) == len(m_py)
+
+    def test_empty_inner_collapse_and_delete_all(self):
+        keys = [h(("col", i)) for i in range(40)]
+        m_ref, m_bulk = SHAMap(), SHAMap()
+        sets = [SHAMapItem(k, b"v") for k in keys]
+        apply_per_key(m_ref, sets, [])
+        m_bulk.bulk_update(sets)
+        # delete down to a single survivor: every transient inner must
+        # fold up identically
+        survivors = keys[:1]
+        dels = keys[1:]
+        apply_per_key(m_ref, [], dels)
+        m_bulk.bulk_update([], dels)
+        assert m_bulk.get_hash() == m_ref.get_hash()
+        assert [i.tag for i in m_bulk.items()] == survivors
+        # and to empty
+        m_ref.del_item(survivors[0])
+        m_bulk.bulk_update([], survivors)
+        assert m_bulk.get_hash() == m_ref.get_hash() == ZERO256
+        assert m_bulk.root is EMPTY_INNER
+
+    def test_missing_delete_raises_keyerror(self):
+        m = SHAMap()
+        m.bulk_update([SHAMapItem(h(1), b"a")])
+        with pytest.raises(KeyError):
+            m.bulk_update([], [h(2)])
+        # missing_ok drops it instead (the compacted create-then-delete)
+        before = m.get_hash()
+        m.bulk_update([], [h(2)], missing_ok=True)
+        assert m.get_hash() == before
+
+    def test_set_and_delete_same_key_rejected(self):
+        m = SHAMap()
+        m.bulk_update([SHAMapItem(h(1), b"a")])
+        with pytest.raises(ValueError):
+            m.bulk_update([SHAMapItem(h(1), b"b")], [h(1)])
+
+    def test_duplicate_sets_last_wins(self):
+        m_ref, m_bulk = SHAMap(), SHAMap()
+        m_ref.set_item(SHAMapItem(h(1), b"first"))
+        m_ref.set_item(SHAMapItem(h(1), b"second"))
+        m_bulk.bulk_update(
+            [SHAMapItem(h(1), b"first"), SHAMapItem(h(1), b"second")]
+        )
+        assert m_bulk.get_hash() == m_ref.get_hash()
+
+    def test_snapshot_structural_sharing_preserved(self):
+        base = SHAMap()
+        base.bulk_update([SHAMapItem(h(("s", i)), b"v" * 20)
+                          for i in range(200)])
+        base.get_hash()
+        snap = base.snapshot()
+        snap_hash = snap.get_hash()
+        snap_root = snap.root
+        # a delta touching a few branches must leave the snapshot frozen
+        # and SHARE every untouched branch by object identity
+        sets = [SHAMapItem(h(("s", i)), b"w" * 25) for i in range(10)]
+        base.bulk_update(sets, [h(("s", 42))])
+        assert snap.get_hash() == snap_hash
+        assert snap.root is snap_root
+        dirty = {s.tag[0] >> 4 for s in sets} | {h(("s", 42))[0] >> 4}
+        shared = untouched = 0
+        for b in range(16):
+            if b in dirty:
+                continue
+            untouched += 1
+            if base.root.children[b] is snap_root.children[b]:
+                shared += 1
+        assert untouched > 0 and shared == untouched
+
+    def test_mid_stream_snapshot_stays_frozen(self):
+        m = SHAMap()
+        hashes = []
+        snaps = []
+        rng = random.Random(7)
+        live = []
+        for round_ in range(6):
+            sets = [SHAMapItem(h(("m", round_, i)), bytes([round_]) * 9)
+                    for i in range(30)]
+            dels = [live.pop(rng.randrange(len(live)))
+                    for _ in range(min(5, len(live)))]
+            live += [s.tag for s in sets]
+            m.bulk_update(sets, dels)
+            snaps.append(m.snapshot())
+            hashes.append(m.get_hash())
+        for snap, expect in zip(snaps, hashes):
+            assert snap.get_hash() == expect
+
+
+class TestFlatBufferEncoder:
+    def _tree(self, n=150, leaf_type=TNType.ACCOUNT_STATE):
+        m = SHAMap(leaf_type)
+        for i in range(n):
+            m.set_item(SHAMapItem(h(("e", i)), h(("d", i)) * 2))
+        return m
+
+    def test_encoder_matches_per_node_serializer(self):
+        m = self._tree()
+        nodes = [n for lv in _collect_unhashed(m.root) for n in lv]
+        m.get_hash()
+        buf, offsets = encode_nodes(nodes)
+        assert len(offsets) == len(nodes) + 1
+        for i, node in enumerate(nodes):
+            assert buf[offsets[i]:offsets[i + 1]] == \
+                serialize_node_prefix(node)
+
+    def test_native_and_python_encoders_agree(self):
+        if _resolve_native_pack() is None:
+            pytest.skip("native pack unavailable")
+        for leaf_type in (TNType.ACCOUNT_STATE, TNType.TX_MD, TNType.TX_NM):
+            m = self._tree(80, leaf_type)
+            nodes = [n for lv in _collect_unhashed(m.root) for n in lv]
+            m.get_hash()
+            assert encode_nodes(nodes) == _encode_nodes_py(nodes)
+
+    def test_packed_hashing_matches_default(self):
+        m1, m2 = self._tree(), self._tree()
+        m2.hash_batch = CpuHasher()  # has hash_packed -> flat-buffer path
+        assert m1.get_hash() == m2.get_hash()
+
+    def test_flush_via_encoder_byte_identical_and_batched(self):
+        m = self._tree()
+        stored: dict[bytes, bytes] = {}
+        batches: list[int] = []
+
+        def store_many(pairs):
+            batches.append(len(pairs))
+            stored.update(pairs)
+
+        n = m.flush(lambda hh, d: stored.__setitem__(hh, d),
+                    store_many=store_many)
+        assert n == len(stored) and batches  # batch sink actually used
+        # every stored blob equals the old per-node serialization and
+        # round-trips from_store
+        for node_hash, blob in stored.items():
+            from stellard_tpu.utils.hashes import sha512_half
+
+            assert sha512_half(blob) == node_hash
+        rebuilt = SHAMap.from_store(m.get_hash(), stored.get,
+                                    use_cache=False)
+        assert rebuilt.get_hash() == m.get_hash()
+
+    def test_flush_known_set_incremental(self):
+        m = self._tree()
+        writes: list = []
+        known: set = set()
+        assert m.flush(lambda hh, d: writes.append(hh), known) > 0
+        assert m.flush(lambda hh, d: writes.append(hh), known) == 0
+
+    def test_failed_flush_stays_retryable(self):
+        """A store that raises must NOT leave the known set claiming
+        nodes the backend never saw (review regression: known was
+        populated during the visit, before any store ran)."""
+        m = self._tree()
+        known: set = set()
+
+        def broken_store(hh, d):
+            raise RuntimeError("nodestore writer failed")
+
+        with pytest.raises(RuntimeError):
+            m.flush(broken_store, known)
+        assert not known  # nothing persisted -> nothing marked flushed
+        stored: dict = {}
+        assert m.flush(lambda hh, d: stored.__setitem__(hh, d), known) > 0
+        rebuilt = SHAMap.from_store(m.get_hash(), stored.get,
+                                    use_cache=False)
+        assert rebuilt.get_hash() == m.get_hash()
+
+    def test_database_store_many_round_trip(self):
+        db = make_database(type="memory")
+        m = self._tree()
+        m.flush(db.store_fn(NodeObjectType.ACCOUNT_NODE), db.flushed,
+                store_many=db.store_many_fn(NodeObjectType.ACCOUNT_NODE))
+        db.sync()
+        rebuilt = SHAMap.from_store(
+            m.get_hash(),
+            lambda hh: (db.fetch(hh).data if db.fetch(hh) else None),
+            use_cache=False,
+        )
+        assert rebuilt.get_hash() == m.get_hash()
+
+
+class TestFromStoreCache:
+    def test_hits_counted_and_bytes_identical(self):
+        cache = inner_node_cache()
+        before_puts = len(cache)
+        m = SHAMap()
+        for i in range(120):
+            m.set_item(SHAMapItem(h(("c", i)), b"payload" * 3))
+        stored: dict[bytes, bytes] = {}
+        m.flush(lambda hh, d: stored.__setitem__(hh, d))
+        root = m.get_hash()
+
+        first = SHAMap.from_store(root, stored.get)
+        assert len(cache) > before_puts  # inners memoized
+        h0, m0 = cache.hits, cache.misses
+        fetches: list = []
+
+        def counting_fetch(hh):
+            fetches.append(hh)
+            return stored.get(hh)
+
+        second = SHAMap.from_store(root, counting_fetch)
+        assert cache.hits > h0  # shared inners served from the memo
+        assert not fetches  # the root inner hit covers the whole tree
+        assert first.get_hash() == second.get_hash() == root
+        assert sorted(i.tag for i in second.items()) == \
+            sorted(i.tag for i in first.items())
+
+    def test_cache_opt_out(self):
+        m = SHAMap()
+        for i in range(40):
+            m.set_item(SHAMapItem(h(("o", i)), b"x" * 10))
+        stored: dict[bytes, bytes] = {}
+        m.flush(lambda hh, d: stored.__setitem__(hh, d))
+        SHAMap.from_store(m.get_hash(), stored.get)  # populate
+        fetches: list = []
+
+        def counting_fetch(hh):
+            fetches.append(hh)
+            return stored.get(hh)
+
+        SHAMap.from_store(m.get_hash(), counting_fetch, use_cache=False)
+        assert fetches  # opt-out really bypasses the memo
+
+
+class TestMinDeviceNodesFloor:
+    def test_cost_model_floor_blocks_exploration(self):
+        m = _HashCostModel(reexplore_every=8, min_device_nodes=64)
+        assert not m.use_device(1)
+        assert not m.use_device(63)  # below floor: never explore
+        assert m.use_device(64)  # at floor: unmeasured -> explore
+        assert m.use_device(4096)
+
+    def test_floor_zero_keeps_old_behavior(self):
+        m = _HashCostModel(reexplore_every=8)
+        assert m.use_device(1)  # unmeasured: explore, as before
+
+    class _Counting(BatchHasher):
+        name = "fake-dev"
+
+        def __init__(self):
+            self.flat_calls = 0
+            self.tree_calls = 0
+            self.device_nodes = 0
+            self.host_nodes = 0
+
+        def prefix_hash_batch(self, prefixes, payloads):
+            self.flat_calls += 1
+            return CpuHasher().prefix_hash_batch(prefixes, payloads)
+
+        def hash_tree(self, root):
+            self.tree_calls += 1
+            from stellard_tpu.state.shamap import compute_hashes
+
+            return compute_hashes(root, CpuHasher())
+
+    def test_watchdog_floor_routes_small_batches_to_host(self):
+        dev, host = self._Counting(), self._Counting()
+        wd = WatchdogHasher(dev, host, first_timeout=30, warm_timeout=30,
+                            min_device_nodes=16)
+        wd.prefix_hash_batch([0x1234] * 4, [b"x" * 20] * 4)
+        assert dev.flat_calls == 0 and host.flat_calls == 1
+        wd.prefix_hash_batch([0x1234] * 32, [b"x" * 20] * 32)
+        assert dev.flat_calls == 1  # above the floor: explored
+
+    def test_watchdog_tree_hint_floor(self):
+        dev, host = self._Counting(), self._Counting()
+        wd = WatchdogHasher(dev, host, first_timeout=30, warm_timeout=30,
+                            min_device_nodes=16)
+        def mk():
+            mm = SHAMap()
+            for i in range(10):
+                mm.set_item(SHAMapItem(h(("t", i)), b"y" * 12))
+            return mm
+
+        expect = mk().get_hash()
+        m = mk()  # fresh nodes: nothing pre-hashed
+        # small declared dirty set: host level-batcher, not the device
+        n = wd.hash_tree(m.root, hint_nodes=4)
+        assert n > 0 and dev.tree_calls == 0
+        assert m.root._hash == expect
+        # a big hint reaches the device tree pipeline
+        m2 = SHAMap()
+        for i in range(10):
+            m2.set_item(SHAMapItem(h(("t2", i)), b"z" * 12))
+        wd.hash_tree(m2.root, hint_nodes=400)
+        assert dev.tree_calls == 1
+
+    def test_watchdog_routing_snapshot(self):
+        dev, host = self._Counting(), self._Counting()
+        wd = WatchdogHasher(dev, host, first_timeout=30,
+                            min_device_nodes=16)
+        wd.prefix_hash_batch([0x1234] * 2, [b"x"] * 2)
+        snap = wd.get_json()
+        assert snap["min_device_nodes"] == 16
+        assert snap["flat_model"]["min_device_nodes"] == 16
+        assert "buckets" in snap["flat_model"]
+
+
+class TestIncrementalSealByteIdentity:
+    """Full close-path identity: incremental seal vs full seal vs serial
+    re-apply, over workloads with creates, overwrites and DELETES
+    (offer cancels), plus a mid-stream snapshot consumer."""
+
+    def _run(self, incremental, delta_replay=True, drain_batch=8):
+        from stellard_tpu.engine.engine import TxParams
+        from stellard_tpu.node.ledgermaster import LedgerMaster
+        from stellard_tpu.protocol.formats import TxType
+        from stellard_tpu.protocol.keys import KeyPair
+        from stellard_tpu.protocol.sfields import (
+            sfAmount,
+            sfDestination,
+            sfLimitAmount,
+            sfOfferSequence,
+            sfTakerGets,
+            sfTakerPays,
+        )
+        from stellard_tpu.protocol.stamount import STAmount
+        from stellard_tpu.protocol.sttx import SerializedTransaction
+
+        master = KeyPair.from_passphrase("masterpassphrase")
+        gw = KeyPair.from_passphrase("tree-gw")
+        USD = b"USD" + b"\x00" * 17
+        OPEN = TxParams.OPEN_LEDGER | TxParams.RETRY
+
+        def build(tx_type, kp, seq, fields):
+            tx = SerializedTransaction.build(
+                tx_type, kp.account_id, seq, 10, fields
+            )
+            tx.sign(kp)
+            return SerializedTransaction.from_bytes(tx.serialize())
+
+        lm = LedgerMaster()
+        lm.delta_replay = delta_replay
+        lm.incremental_seal = incremental
+        lm.seal_drain_batch = drain_batch
+        lm.start_new_ledger(master.account_id, close_time=1000)
+        try:
+            hashes = []
+            # phase 1: fund the gateway + fan-out payments (creates)
+            seq = 1
+            phase = [build(TxType.ttPAYMENT, master, seq,
+                           {sfAmount: STAmount.from_drops(1_000_000_000),
+                            sfDestination: gw.account_id})]
+            seq += 1
+            for i in range(12):
+                dest = KeyPair.from_passphrase(f"tree-d{i}").account_id
+                phase.append(build(
+                    TxType.ttPAYMENT, master, seq,
+                    {sfAmount: STAmount.from_drops(250_000_000),
+                     sfDestination: dest},
+                ))
+                seq += 1
+            for tx in phase:
+                lm.do_transaction(tx, OPEN)
+            closed, _ = lm.close_and_advance(2000, 30)
+            hashes.append(closed.hash())
+            snap = closed.snapshot()  # mid-stream snapshot consumer
+            snap_hash = snap.hash()
+            # phase 2: offers created then cancelled (adversarial
+            # deletes: created-then-deleted entries inside one close)
+            phase = []
+            gw_seq = 1
+            for i in range(4):
+                phase.append(build(
+                    TxType.ttOFFER_CREATE, gw, gw_seq,
+                    {sfTakerPays: STAmount.from_drops((50 + i) * 1_000_000),
+                     sfTakerGets: STAmount.from_iou(
+                         USD, gw.account_id, 100, 0)},
+                ))
+                gw_seq += 1
+            for i in range(2):
+                phase.append(build(
+                    TxType.ttOFFER_CANCEL, gw, gw_seq,
+                    {sfOfferSequence: 1 + i},
+                ))
+                gw_seq += 1
+            for tx in phase:
+                lm.do_transaction(tx, OPEN)
+            closed, _ = lm.close_and_advance(2030, 30)
+            hashes.append(closed.hash())
+            # phase 3: overwrites of hot entries
+            phase = [build(TxType.ttPAYMENT, master, seq + i,
+                           {sfAmount: STAmount.from_drops(1_000_000),
+                            sfDestination: gw.account_id})
+                     for i in range(10)]
+            for tx in phase:
+                lm.do_transaction(tx, OPEN)
+            closed, _ = lm.close_and_advance(2060, 30)
+            hashes.append(closed.hash())
+            assert snap.hash() == snap_hash  # snapshot stayed frozen
+            return hashes, lm.tree_json()
+        finally:
+            lm.stop_seal_drainer()
+
+    def test_incremental_matches_full_and_serial(self):
+        h_incr, tree = self._run(incremental=True)
+        h_full, _ = self._run(incremental=False)
+        h_serial, _ = self._run(incremental=False, delta_replay=False)
+        assert h_incr == h_full == h_serial
+        # the incremental run actually engaged (honesty check)
+        assert tree["seal_adopted"] >= 1
+        assert tree["bulk_merges"] >= 1
+
+    def test_kill_switch_off_never_arms(self):
+        _hashes, tree = self._run(incremental=False)
+        assert tree["seal_adopted"] == 0
+        assert tree["drains"] == 0
+
+    def test_drain_batch_zero_disables_drains_not_adoption(self):
+        """[tree] drain_batch=0: no background drain thread (and no
+        busy-loop — review finding), but folding + root adoption still
+        produce byte-identical closes."""
+        h0, tree0 = self._run(incremental=True, drain_batch=0)
+        h1, _ = self._run(incremental=False)
+        assert h0 == h1
+        assert tree0["drains"] == 0
+        assert tree0["seal_adopted"] >= 1
+
+
+class TestCompactedCreateThenDelete:
+    """A tx that creates AND deletes the same key compacts its record to
+    a bare delete; against a state that never held the key the splice
+    must net it to NOTHING (serial set_item/del_item parity) — not
+    crash the close flush with a KeyError (review regression)."""
+
+    def _splice_record(self, writes_script):
+        """Drive one synthetic SpecRecord through a real CloseReplay on
+        a fresh chain; returns (ledger, ok)."""
+        from stellard_tpu.engine.deltareplay import (
+            CloseReplay,
+            SpecRecord,
+            SpecState,
+        )
+        from stellard_tpu.engine.engine import TransactionEngine
+        from stellard_tpu.node.ledgermaster import LedgerMaster
+        from stellard_tpu.protocol.formats import TxType
+        from stellard_tpu.protocol.keys import KeyPair
+        from stellard_tpu.protocol.sfields import (
+            sfAmount,
+            sfDestination,
+            sfTransactionIndex,
+        )
+        from stellard_tpu.protocol.stamount import STAmount
+        from stellard_tpu.protocol.stobject import STObject
+        from stellard_tpu.protocol.sttx import SerializedTransaction
+        from stellard_tpu.protocol.ter import TER
+
+        master = KeyPair.from_passphrase("masterpassphrase")
+        lm = LedgerMaster()
+        lm.start_new_ledger(master.account_id, close_time=1000)
+        open_ledger = lm.current_ledger()
+        spec = SpecState(open_ledger)
+        tx = SerializedTransaction.build(
+            TxType.ttPAYMENT, master.account_id, 1, 10,
+            {sfAmount: STAmount.from_drops(1_000_000),
+             sfDestination: KeyPair.from_passphrase("ctd-d").account_id},
+        )
+        tx.sign(master)
+        # hand-built record: the engine never produces this shape via
+        # payments, so script the write set directly (the compaction
+        # in speculate() is mirrored by constructing write_items +
+        # net_deletes exactly as it would)
+        compact: dict = {}
+        ever_set: set = set()
+        for k, item in writes_script:
+            compact[k] = item
+            if item is not None:
+                ever_set.add(k)
+        write_items = [(k, it) for k, it in compact.items()]
+        meta = STObject()
+        meta[sfTransactionIndex] = 0
+        rec = SpecRecord(
+            raw_ter=TER.tesSUCCESS, ter=TER.tesSUCCESS, did_apply=True,
+            reads={}, succs=[], write_items=write_items, meta=meta,
+            fee=10,
+        )
+        rec.net_deletes = frozenset(
+            k for k, it in compact.items() if it is None and k in ever_set
+        )
+        spec.records[tx.txid()] = rec
+
+        close_ledger = lm.closed_ledger().open_successor()
+        replay = CloseReplay(spec, close_ledger)
+        engine = TransactionEngine(close_ledger)
+        hit = replay.try_splice(engine, tx, final=True)
+        assert hit == (TER.tesSUCCESS, True)
+        replay.flush_pending()  # the regression raised KeyError here
+        return close_ledger, replay
+
+    def test_bare_delete_of_created_key_nets_to_nothing(self):
+        k = h("ctd-key")
+        item = SHAMapItem(k, b"ephemeral")
+        ledger, _replay = self._splice_record([(k, item), (k, None)])
+        assert ledger.state_map.get(k) is None
+        # and the tx itself landed in the tx map
+        assert len(list(ledger.tx_map.leaves())) == 1
+
+    def test_genuine_missing_delete_still_raises(self):
+        k = h("ctd-missing")
+        with pytest.raises(KeyError):
+            self._splice_record([(k, None)])  # never created: del_item parity

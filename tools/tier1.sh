@@ -29,6 +29,15 @@ if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/traceview.py --smoke; then
   exit 2
 fi
 
+echo "== seal-equivalence smoke gate (incremental vs full seal) =="
+# boots a node with the incremental seal on (default), floods 200 txs,
+# and shadow-recomputes every close's ledger hash with a from-scratch
+# full seal — a wrong pre-hashed node fails CI, not a consensus round
+if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/sealsmoke.py; then
+  echo "SEAL SMOKE FAILED — incremental seal diverged from full seal" >&2
+  exit 2
+fi
+
 echo "== tier-1 test run (ROADMAP.md command) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
