@@ -341,8 +341,15 @@ def _drive_node(backend, txs, chunk=500, setup_phases=(), cfg_kwargs=None,
     from stellard_tpu.node.config import Config
     from stellard_tpu.node.node import Node
 
+    # admission control stays ON but non-binding: these legs measure
+    # at-capacity throughput with single-account chunks the adaptive
+    # cap/account-chain limits would otherwise (nondeterministically)
+    # shed, breaking the byte-identity discipline. The overload_flood
+    # leg pins its own small caps and exercises the queue for real.
+    cfg = {"txq_min_cap": 1_000_000, "txq_max_cap": 1_000_000,
+           **(cfg_kwargs or {})}
     node = Node(
-        Config(signature_backend=backend, **(cfg_kwargs or {}))
+        Config(signature_backend=backend, **cfg)
     ).setup()
     if pin_close_time is not None:
         # deterministic close-time schedule (one resolution step per
@@ -636,6 +643,229 @@ def bench_delta_replay_flood(backends):
         "results_identical": len(
             {d["results_digest"] for d in all_details}
         ) == 1,
+        "fallback": False,  # host-plane leg: no device involved
+    })
+    return legs
+
+
+def _overload_payments(n, senders=32, fee_of=None):
+    """Round-robin multi-account flood: `senders` accounts each paying a
+    DISJOINT destination with sequential seqs (disjoint so delta-replay
+    splices are not serialized through one hot account), fee tier per
+    sender so the queue has something to order."""
+    from stellard_tpu.protocol.formats import TxType
+    from stellard_tpu.protocol.keys import KeyPair
+    from stellard_tpu.protocol.sfields import sfAmount, sfDestination
+    from stellard_tpu.protocol.stamount import STAmount
+    from stellard_tpu.protocol.sttx import SerializedTransaction
+
+    kps = [KeyPair.from_passphrase(f"ovb-{i}") for i in range(senders)]
+    dests = [KeyPair.from_passphrase(f"ovb-dest-{i}").account_id
+             for i in range(senders)]
+    fee_of = fee_of or (lambda i: 10 + (i % 7))
+    txs = []
+    per = -(-n // senders)
+    for seq in range(1, per + 1):
+        for i, kp in enumerate(kps):
+            if len(txs) >= n:
+                break
+            tx = SerializedTransaction.build(
+                TxType.ttPAYMENT, kp.account_id, seq, fee_of(i),
+                {sfAmount: STAmount.from_drops(250_000_000),
+                 sfDestination: dests[i]},
+            )
+            tx.sign(kp)
+            txs.append(tx)
+    return kps, txs
+
+
+def _drive_overload(txs, senders, cap, chunk, txq_on, state_dir):
+    """Flood driver with per-tx submit->validated latency tracking. The
+    inter-close open window is modeled by waiting out the deferred
+    queue speculation (unmeasured — production open windows are seconds
+    long); the measured close is accept_ledger alone."""
+    import threading
+
+    from stellard_tpu.node.config import Config
+    from stellard_tpu.node.node import Node
+    from stellard_tpu.protocol.formats import TxType
+    from stellard_tpu.protocol.sfields import sfAmount, sfDestination
+    from stellard_tpu.protocol.stamount import STAmount
+    from stellard_tpu.protocol.sttx import SerializedTransaction
+
+    node = Node(Config(
+        txq_enabled=txq_on,
+        txq_min_cap=cap, txq_max_cap=cap,
+        txq_ledgers_in_queue=8, txq_account_cap=128,
+        database_path=os.path.join(state_dir, "bench.db"),
+        node_db_type="cpplog",
+        node_db_path=os.path.join(state_dir, "nodestore"),
+    )).setup()
+    closes_done = [0]
+    node.ops.network_time = lambda: 910_000_000 + closes_done[0] * 30
+    done = threading.Semaphore(0)
+
+    def cb(tx, ter, applied):
+        done.release()
+
+    # fund the senders, unmeasured (escalation-proof fee: never queues)
+    master = node.master_keys
+    for i, kp in enumerate(senders):
+        tx = SerializedTransaction.build(
+            TxType.ttPAYMENT, master.account_id, 1 + i, 10_000_000,
+            {sfAmount: STAmount.from_drops(2_000_000_000),
+             sfDestination: kp.account_id},
+        )
+        tx.sign(master)
+        node.ops.submit_transaction(tx, cb)
+    for _ in senders:
+        done.acquire()
+    node.ops.accept_ledger()
+    closes_done[0] += 1
+
+    def wait_spec_drain(timeout=5.0):
+        # model the inter-close open window: the deferred promotion +
+        # queue-aware speculation land before the next close fires
+        if txq_on:
+            node.txq.quiesce(timeout)
+
+    txs = _fresh(txs)
+    submit_at = {}
+    latencies = []
+    close_ms = []
+
+    def close_once():
+        c0 = time.perf_counter()
+        _closed, results = node.ops.accept_ledger()
+        c1 = time.perf_counter()
+        close_ms.append((c1 - c0) * 1000.0)
+        closes_done[0] += 1
+        for txid in results:
+            t_sub = submit_at.pop(txid, None)
+            if t_sub is not None:
+                latencies.append((c1 - t_sub) * 1000.0)
+
+    t0 = time.perf_counter()
+    for start in range(0, len(txs), chunk):
+        part = txs[start:start + chunk]
+        for tx in part:
+            submit_at[tx.txid()] = time.perf_counter()
+            node.ops.submit_transaction(tx, cb)
+        for _ in part:
+            done.acquire()
+        wait_spec_drain()
+        close_once()
+    # drain: the queue empties through promotion (queue-off has none)
+    for _ in range(32):
+        if not txq_on or len(node.txq) == 0:
+            break
+        wait_spec_drain()
+        close_once()
+    node.close_pipeline.flush(timeout=300)
+    dt = time.perf_counter() - t0
+
+    close_sorted = sorted(close_ms)
+    lat_sorted = sorted(latencies)
+
+    def q(xs, p):
+        return round(xs[min(len(xs) - 1, int(p * len(xs)))], 2) if xs else None
+
+    detail = {
+        "mode": "queue_on" if txq_on else "queue_off",
+        "wall_s": round(dt, 3),
+        "closes": len(close_ms),
+        "close_p50_ms": q(close_sorted, 0.50),
+        "close_p90_ms": q(close_sorted, 0.90),
+        "close_max_ms": q(close_sorted, 1.0),
+        "validated": len(latencies),
+        "submitted": len(txs),
+        "submit_to_validated_ms": {
+            "p50": q(lat_sorted, 0.50),
+            "p90": q(lat_sorted, 0.90),
+            "p99": q(lat_sorted, 0.99),
+        },
+        "txq": node.txq.get_json(),
+        "held": len(node.ledger_master.held),
+        "delta_replay": node.ledger_master.delta_replay_json(),
+    }
+    node.stop()
+    return detail
+
+
+def bench_overload_flood(backends):
+    """Admission-control leg: interleaved queue-on vs queue-off floods
+    at 4x a pinned per-ledger capacity, plus a queue-on at-capacity
+    reference run. The acceptance shape: queue-on keeps close p50
+    within ~25% of its at-capacity value under the 4x flood (the soft
+    cap + promotion bound every close) while queue-off's closes grow
+    4x; submit->validated latency percentiles and eviction counts ride
+    the emitted line. Host-plane leg (file-backed stores, pinned close
+    times); `[txq]` caps are pinned (min_cap == max_cap) so "capacity"
+    is a controlled constant, not an EWMA moving target."""
+    import shutil
+    import tempfile
+
+    cap = int(os.environ.get("BENCH_OVERLOAD_CAP", "125"))
+    n = int(os.environ.get("BENCH_FLOOD_N", "3000"))
+    reps = max(1, int(os.environ.get("BENCH_OVERLOAD_REPS", "2")))
+    senders, flood_txs = _overload_payments(n)
+    _kps, cap_txs = _overload_payments(cap * max(4, n // (4 * cap)))
+
+    legs = {"at_capacity_on": [], "flood_on": [], "flood_off": []}
+    plans = (
+        ("at_capacity_on", cap_txs, cap, True),
+        ("flood_on", flood_txs, 4 * cap, True),
+        ("flood_off", flood_txs, 4 * cap, False),
+    )
+    for _rep in range(reps):
+        for mode, txs, chunk, txq_on in plans:
+            state_dir = tempfile.mkdtemp(prefix=f"bench-ovl-{mode}-")
+            try:
+                legs[mode].append(_drive_overload(
+                    txs, senders, cap, chunk, txq_on, state_dir
+                ))
+            finally:
+                shutil.rmtree(state_dir, ignore_errors=True)
+    for mode, runs in legs.items():
+        _note_detail("overload_flood_close_p50_ms", mode, runs)
+
+    best = {m: min(runs, key=lambda r: r["close_p50_ms"] or 1e9)
+            for m, runs in legs.items()}
+    atc = best["at_capacity_on"]["close_p50_ms"] or 0.0
+    on = best["flood_on"]
+    off = best["flood_off"]
+    txq = on["txq"]
+    promoted = txq["promoted"] or 1
+    _emit({
+        "metric": "overload_flood_close_p50_ms",
+        "value": on["close_p50_ms"],
+        "unit": "ms",
+        # vs_baseline = queue-off p50 over queue-on p50 (>1: the queue
+        # kept closes bounded while the uncapped node degraded)
+        "vs_baseline": round(
+            (off["close_p50_ms"] or 0.0) / (on["close_p50_ms"] or 1.0), 3
+        ),
+        "reps": reps,
+        "capacity": cap,
+        "flood_rate_x": 4,
+        "at_capacity_close_p50_ms": atc,
+        "within_pct_of_capacity": round(
+            ((on["close_p50_ms"] or 0.0) / atc - 1.0) * 100.0, 1
+        ) if atc else None,
+        "queue_off_close_p50_ms": off["close_p50_ms"],
+        "queue_off_close_max_ms": off["close_max_ms"],
+        "submit_to_validated_ms_on": on["submit_to_validated_ms"],
+        "submit_to_validated_ms_off": off["submit_to_validated_ms"],
+        "validated_on": on["validated"],
+        "validated_off": off["validated"],
+        "evicted": txq["evicted"],
+        "rejected": txq["rejected"],
+        "promoted": txq["promoted"],
+        "promote_spliced": txq["promote_spliced"],
+        "promote_splice_rate": round(
+            txq["promote_spliced"] / promoted, 3
+        ),
+        "held_pile": on["held"],
         "fallback": False,  # host-plane leg: no device involved
     })
     return legs
@@ -1188,6 +1418,7 @@ def main() -> None:
             bench_payment_flood,
             bench_pipelined_flood,
             bench_delta_replay_flood,
+            bench_overload_flood,
             bench_tree_commit,
             bench_offer_mix,
             bench_regular_key_fanout,

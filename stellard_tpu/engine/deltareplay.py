@@ -71,6 +71,7 @@ class SpecRecord:
     __slots__ = (
         "raw_ter", "ter", "did_apply", "reads", "succs", "write_items",
         "meta", "fee", "meta_blob", "meta_index_off", "net_deletes",
+        "origin",
     )
 
     def __init__(self, raw_ter, ter, did_apply, reads, succs, write_items,
@@ -102,6 +103,11 @@ class SpecRecord:
         # no prior state is a genuine missing-key delete and must keep
         # del_item's KeyError.
         self.net_deletes: frozenset = frozenset()
+        # where the speculation ran: "submit" (open-ledger accept) or
+        # "promote" (queue-aware deferred speculation after a TxQ
+        # promotion) — splice marks carry it so the admission plane's
+        # promote_spliced counters stay honest
+        self.origin = "submit"
 
 
 class SpecState:
@@ -154,9 +160,12 @@ class SpecState:
             self.absorbed[k] = it
         return len(rec.write_items)
 
-    def speculate(self, tx: SerializedTransaction) -> None:
+    def speculate(self, tx: SerializedTransaction,
+                  origin: str = "submit") -> None:
         """Close-mode dry run of an open-accepted tx; records the outcome
-        and folds its writes into the overlay for successors."""
+        and folds its writes into the overlay for successors. `origin`
+        is "submit" for the open-accept path and "promote" for the
+        TxQ's deferred queue-aware speculation."""
         if self.disabled or tx.tx_type in HEADER_TYPES:
             return
         txid = tx.txid()
@@ -218,6 +227,7 @@ class SpecState:
                         rec.meta_blob = b0
                         rec.meta_index_off = diffs[0] - 3
             rec.net_deletes = frozenset(net_deletes)
+            rec.origin = origin
             self.records[txid] = rec
         except Exception:  # noqa: BLE001 — a half-applied overlay can't
             # be trusted for ANY later record; the close falls back whole
@@ -354,7 +364,7 @@ class CloseReplay:
                 pending[k] = item  # speculation-time item: no re-serialize
             writers[k] = txid
         self._class[txid] = "spliced"
-        self._mark(txid, "spliced", int(rec.ter))
+        self._mark(txid, "spliced", int(rec.ter), origin=rec.origin)
         return rec.ter, True
 
     # -- batched tree merge ------------------------------------------------
@@ -454,7 +464,8 @@ class CloseReplay:
             self.seal_adopt = "error"
 
     def _mark(self, txid: bytes, mode: str, ter: Optional[int] = None,
-              reason: Optional[str] = None) -> None:
+              reason: Optional[str] = None,
+              origin: Optional[str] = None) -> None:
         """Per-tx splice/fallback trace mark (sampled): the close-stage
         node of the transaction's causal span tree, with the fallback
         reason when the record could not be spliced."""
@@ -466,6 +477,8 @@ class CloseReplay:
             attrs["ter"] = ter
         if reason is not None:
             attrs["reason"] = reason
+        if origin is not None and origin != "submit":
+            attrs["origin"] = origin
         tr.instant("close.tx", "close", txid=txid, **attrs)
 
     def note_fallback(self, tx: SerializedTransaction,
@@ -488,6 +501,11 @@ class CloseReplay:
         for idx, _sle, action in les.entries():
             if action != Action.CACHED:
                 self.writers[idx] = marker
+
+    def classes(self) -> dict[bytes, str]:
+        """Per-tx final splice/fallback classification — consumed by the
+        admission plane's queue-aware-speculation counters."""
+        return dict(self._class)
 
     def counts(self) -> dict:
         cls = self._class.values()

@@ -96,6 +96,22 @@ class Config:
     tree_incremental_seal: bool = True
     tree_drain_batch: int = 256
 
+    # -- admission control ([txq]) -----------------------------------------
+    # enabled=1: post-verify intake routes through the TxQ (node/txq.py)
+    # — a soft per-ledger cap adapted to measured close capacity, an
+    # escalating open-ledger fee above it, and a bounded fee-priority
+    # queue with per-account sequence chains, replace-by-fee, cheapest-
+    # first eviction and close-time promotion. enabled=0 is the
+    # kill-switch: the direct-apply path, byte-for-byte.
+    txq_enabled: bool = True
+    txq_ledgers_in_queue: int = 20    # queue bound = soft cap x this
+    txq_account_cap: int = 10         # max queued txs per account
+    txq_retry_fee_pct: int = 25       # replace-by-fee bump requirement
+    txq_retention_ledgers: int = 20   # queued-entry expiry horizon
+    txq_min_cap: int = 256            # soft-cap floor (txs per ledger)
+    txq_max_cap: int = 100_000        # soft-cap ceiling
+    txq_target_close_ms: float = 2000.0  # close budget the cap targets
+
     # -- ledger close ([close]) --------------------------------------------
     # delta_replay=1: the open-ledger accept also executes the tx once in
     # close mode against a speculative overlay, recording its read/write
@@ -214,6 +230,22 @@ class Config:
             )
         if "depth" in cp:
             cfg.close_pipeline_depth = int(cp["depth"])
+        txq = _kv(s.get("txq", []))
+        if "enabled" in txq:
+            cfg.txq_enabled = txq["enabled"].lower() not in (
+                "0", "false", "no", "off"
+            )
+        for key, attr, conv in (
+            ("ledgers_in_queue", "txq_ledgers_in_queue", int),
+            ("account_cap", "txq_account_cap", int),
+            ("retry_fee_pct", "txq_retry_fee_pct", int),
+            ("retention_ledgers", "txq_retention_ledgers", int),
+            ("min_cap", "txq_min_cap", int),
+            ("max_cap", "txq_max_cap", int),
+            ("target_close_ms", "txq_target_close_ms", float),
+        ):
+            if key in txq:
+                setattr(cfg, attr, conv(txq[key]))
         close = _kv(s.get("close", []))
         if "delta_replay" in close:
             cfg.close_delta_replay = close["delta_replay"].lower() not in (

@@ -29,6 +29,14 @@ __all__ = ["LedgerMaster", "CanonicalTXSet", "LEDGER_TOTAL_PASSES"]
 # reference: applyTransactions retry sizing (LedgerConsensus.cpp:1935-2070)
 LEDGER_TOTAL_PASSES = 4
 
+# held-pile bounds (reference: mHeldTransactions is unbounded — a
+# single-account sequence-gap flood pinned memory forever): entries
+# expire after this many closes, and the pile itself is capped with
+# FIFO eviction. With the TxQ enabled the pile is absorbed into the
+# fee-ordered queue instead and these bounds are the fallback path.
+HELD_EXPIRE_LEDGERS = 16
+HELD_CAP = 1024
+
 
 class CanonicalTXSet:
     """Salted canonical ordering (reference: misc/CanonicalTXSet.{h,cpp}):
@@ -97,7 +105,13 @@ class LedgerMaster:
             Callable[[bytes], Optional[tuple[int, bytes]]]
         ] = None
         # txns held for a future ledger (reference: mHeldTransactions)
-        self.held: dict[tuple[bytes, int], SerializedTransaction] = {}
+        # value is (tx, expire_seq): bounded + expired by ledger seq so
+        # a sequence-gap flood cannot pin memory forever
+        self.held: dict[tuple[bytes, int], tuple[SerializedTransaction, int]] = {}
+        self.held_stats = {"evicted": 0, "expired": 0}
+        # admission-control plane ([txq]): wired by Node; promotion of
+        # queued txs into each new open ledger happens at _open_next
+        self.txq = None
         self.min_validations = 0  # quorum for checkAccept
         self.on_validated: Optional[Callable[[Ledger], None]] = None
         # optional persist-row materializer (Node wires build_tx_rows):
@@ -202,13 +216,39 @@ class LedgerMaster:
 
     def add_held_transaction(self, tx: SerializedTransaction) -> None:
         with self._lock:
-            self.held[(tx.account, tx.sequence)] = tx
+            now = self.closed.seq if self.closed is not None else 0
+            self._hold(tx, now + HELD_EXPIRE_LEDGERS)
+
+    def _hold(self, tx: SerializedTransaction, expire_seq: int) -> None:
+        """Insert with the pile's cap: a full pile evicts its OLDEST
+        entry (insertion order) rather than growing without bound."""
+        key = (tx.account, tx.sequence)
+        if key in self.held:
+            # re-hold after a retry keeps the ORIGINAL horizon — a
+            # never-applicable tx must not refresh itself forever
+            expire_seq = min(expire_seq, self.held[key][1])
+        elif len(self.held) >= HELD_CAP:
+            self.held.pop(next(iter(self.held)))
+            self.held_stats["evicted"] += 1
+        self.held[key] = (tx, expire_seq)
+
+    def _drain_held(self) -> list[tuple[SerializedTransaction, int]]:
+        """Take every live (tx, expire_seq) pair, dropping expired
+        entries. Caller holds the lock."""
+        now = self.closed.seq if self.closed is not None else 0
+        entries = list(self.held.values())
+        self.held.clear()
+        live = []
+        for tx, expire in entries:
+            if expire < now:
+                self.held_stats["expired"] += 1
+            else:
+                live.append((tx, expire))
+        return live
 
     def take_held_transactions(self) -> list[SerializedTransaction]:
         with self._lock:
-            txs = list(self.held.values())
-            self.held.clear()
-            return txs
+            return [tx for tx, _expire in self._drain_held()]
 
     # -- apply to the open ledger (reference: doTransaction) --------------
 
@@ -216,11 +256,14 @@ class LedgerMaster:
         with self._lock:
             return self._open_apply(tx, params)
 
-    def _open_apply(self, tx: SerializedTransaction,
-                    params: TxParams) -> tuple[TER, bool]:
+    def _open_apply(self, tx: SerializedTransaction, params: TxParams,
+                    speculate: bool = True) -> tuple[TER, bool]:
         """Apply to the open ledger; on accept, seed the parsed-tx memo
         and run the speculative close-mode execution. Caller holds the
-        lock."""
+        lock. `speculate=False` defers the close-mode dry run — the TxQ
+        promotion path uses it to keep the (expensive) speculation OFF
+        the close window and re-runs it on a deferred job
+        (TxQ._drain_deferred_spec -> _speculate_open)."""
         open_ledger = self.current_ledger()
         engine = TransactionEngine(open_ledger)
         with self.tracer.span("open.apply", "apply", txid=tx.txid(),
@@ -239,29 +282,43 @@ class LedgerMaster:
             # makes the SpecView's parent reads equal to the state the
             # close will start from (a close-mode apply through this
             # path would break it)
-            if self.delta_replay and (int(params) & int(TxParams.OPEN_LEDGER)):
-                spec = getattr(open_ledger, "_spec_state", None)
-                if spec is None:
-                    from ..engine.deltareplay import SpecState
-
-                    spec = open_ledger._spec_state = SpecState(open_ledger)
-                    if self.incremental_seal:
-                        # the open window never mutates the state map, so
-                        # its root IS the parent state the close starts
-                        # from — the building tree folds speculated
-                        # writes onto it and pre-hashes between closes
-                        spec.attach_building(
-                            open_ledger.state_map.root, self.hash_batch
-                        )
-                with self.tracer.span("open.speculate", "apply",
-                                      txid=tx.txid()):
-                    spec.speculate(tx)
-                rec = spec.records.get(tx.txid())
-                if rec is not None and spec.building is not None:
-                    folded = spec.fold_building(rec)
-                    if folded:
-                        self._note_fold(folded)
+            if speculate and (int(params) & int(TxParams.OPEN_LEDGER)):
+                self._speculate_open(open_ledger, tx)
         return ter, applied
+
+    def _speculate_open(self, open_ledger: Ledger,
+                        tx: SerializedTransaction,
+                        origin: str = "submit") -> None:
+        """Close-mode dry run of an open-accepted tx against the open
+        window's speculative overlay (engine/deltareplay.py), creating
+        the SpecState on first use. `origin` tags the record so the
+        queue's promotion counters can tell spliced-promoted txs apart
+        from submit-time speculation."""
+        if not self.delta_replay:
+            return
+        spec = getattr(open_ledger, "_spec_state", None)
+        if spec is None:
+            from ..engine.deltareplay import SpecState
+
+            spec = open_ledger._spec_state = SpecState(open_ledger)
+            if self.incremental_seal:
+                # the open window never mutates the state map, so
+                # its root IS the parent state the close starts
+                # from — the building tree folds speculated
+                # writes onto it and pre-hashes between closes
+                spec.attach_building(
+                    open_ledger.state_map.root, self.hash_batch
+                )
+        if tx.txid() in spec.records:
+            return
+        with self.tracer.span("open.speculate", "apply",
+                              txid=tx.txid(), origin=origin):
+            spec.speculate(tx, origin=origin)
+        rec = spec.records.get(tx.txid())
+        if rec is not None and spec.building is not None:
+            folded = spec.fold_building(rec)
+            if folded:
+                self._note_fold(folded)
 
     # -- incremental-seal background drain --------------------------------
 
@@ -471,7 +528,7 @@ class LedgerMaster:
             self._seal(new_lcl, results)
             t_seal = time.perf_counter()
             self._push_closed(new_lcl)
-            self.current = new_lcl.open_successor()
+            self._open_next(new_lcl, (t_apply - t0) * 1000.0)
 
             # standalone trusts its own closes (reference: standalone mode
             # skips validations; checkAccept quorum handles the net case)
@@ -480,13 +537,6 @@ class LedgerMaster:
                 if self.on_validated:
                     self.on_validated(new_lcl)
 
-            # re-apply held txns to the new open ledger
-            for tx in self.take_held_transactions():
-                ter, _applied = self._open_apply(
-                    tx, TxParams.OPEN_LEDGER | TxParams.RETRY
-                )
-                if ter == TER.terPRE_SEQ:
-                    self.add_held_transaction(tx)
             self._note_close_stages(t0, t_apply, t_seal, new_lcl.seq)
             return new_lcl, results
 
@@ -526,25 +576,61 @@ class LedgerMaster:
             self._seal(new_lcl, results)
             t_seal = time.perf_counter()
             self._push_closed(new_lcl)
-            self.current = new_lcl.open_successor()
 
-            # re-apply: our open-ledger txns that missed consensus, then
-            # held; SF_SIGGOOD verdicts from submit time carry over so
-            # the re-apply never host-re-verifies
+            # re-apply: our open-ledger txns that missed consensus first
+            # (they are the lower sequences), then held/queued;
+            # SF_SIGGOOD verdicts from submit time carry over so the
+            # re-apply never host-re-verifies
             consensus_ids = {tx.txid() for tx in txs}
             leftovers = [
                 self._parse_with_verdict(open_ledger, txid, blob)
                 for txid, blob, _meta in open_ledger.tx_entries()
                 if txid not in consensus_ids
-            ] + self.take_held_transactions()
-            for tx in leftovers:
+            ]
+            self._open_next(new_lcl, (t_apply - t0) * 1000.0,
+                            leftovers=leftovers)
+            self._note_close_stages(t0, t_apply, t_seal, new_lcl.seq)
+            return new_lcl, results
+
+    def _open_next(self, new_lcl: Ledger, apply_ms: float,
+                   leftovers: list = ()) -> None:
+        """Open the successor ledger and replenish it: consensus
+        leftovers first, then the held pile / admission queue. With the
+        TxQ enabled this is the promotion site — held terPRE_SEQ txs are
+        absorbed into the fee-ordered queue and the best-paying eligible
+        queued txs fill the new open ledger up to the soft cap (the
+        [txq] enabled=0 kill-switch keeps the legacy held re-apply path
+        byte-for-byte). Caller holds the lock."""
+        self.current = new_lcl.open_successor()
+        for tx in leftovers:
+            ter, _applied = self._open_apply(
+                tx, TxParams.OPEN_LEDGER | TxParams.RETRY
+            )
+            if ter == TER.terPRE_SEQ:
+                self._hold_or_queue(tx)
+        txq = self.txq
+        if txq is not None and txq.enabled:
+            # fold any held entries (validator/networked submit path
+            # still feeds the pile directly) into the queue, then
+            # promote; capacity model feeds from this close's apply pass
+            for tx, expire in self._drain_held():
+                txq.absorb_held(tx, self, expire)
+            txq.after_close(self, new_lcl, apply_ms)
+        else:
+            for tx, expire in self._drain_held():
                 ter, _applied = self._open_apply(
                     tx, TxParams.OPEN_LEDGER | TxParams.RETRY
                 )
                 if ter == TER.terPRE_SEQ:
-                    self.add_held_transaction(tx)
-            self._note_close_stages(t0, t_apply, t_seal, new_lcl.seq)
-            return new_lcl, results
+                    self._hold(tx, expire)
+
+    def _hold_or_queue(self, tx: SerializedTransaction) -> None:
+        """terPRE_SEQ disposition: the fee-ordered queue when the TxQ is
+        enabled, the (bounded) held pile otherwise."""
+        if self.txq is not None and self.txq.enabled:
+            self.txq.absorb_held(tx, self)
+        else:
+            self.add_held_transaction(tx)
 
     def switch_lcl(self, ledger: Ledger) -> None:
         """Adopt a different (acquired) last-closed ledger — the network
@@ -719,6 +805,10 @@ class LedgerMaster:
 
     def _note_delta_stats(self, replay) -> None:
         c = replay.counts()
+        if self.txq is not None and self.txq.enabled:
+            # queue-aware speculation honesty: which of the txs the
+            # queue promoted into this window spliced vs fell back
+            self.txq.note_close_classes(replay.classes())
         self.delta_stats["closes"] += 1
         for k in ("spliced", "fallback", "invalidated"):
             self.delta_stats[k] += c[k]

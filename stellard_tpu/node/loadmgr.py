@@ -41,6 +41,12 @@ class LoadFeeTrack:
     def __init__(self):
         self._lock = threading.Lock()
         self._local = NORMAL_FEE
+        # admission-queue component ([txq]): the escalated open-ledger
+        # requirement fed back by TxQ.after_close — folded into
+        # load_factor so server_info / the `server` stream / fee RPC
+        # all see the admission price, but EXCLUDED from network_floor
+        # (it is local open-ledger state other nodes do not share)
+        self._queue = NORMAL_FEE
         # source -> (fee, report_time, expiry): per-reporter so one
         # healthy cluster member cannot overwrite another's elevated
         # report (reference keeps per-node ClusterNodeStatus entries,
@@ -142,10 +148,32 @@ class LoadFeeTrack:
                 best = max(best, fee)
         return best
 
+    def set_queue_fee(self, fee: int) -> None:
+        """Queue-pressure feedback from the admission plane (TxQ): the
+        current escalated open-ledger fee level, 1/256 units."""
+        fee = max(NORMAL_FEE, min(MAX_FEE, int(fee)))
+        with self._lock:
+            changed = fee != self._queue
+            self._queue = fee
+        if changed:
+            self._fire_change()
+
+    @property
+    def queue_fee(self) -> int:
+        with self._lock:
+            return self._queue
+
+    @property
+    def network_floor(self) -> int:
+        """The fee floor peers would apply (local + remote load only —
+        never our queue escalation): the relay gate for queued txs."""
+        with self._lock:
+            return max(self._local, self._live_remote())
+
     @property
     def load_factor(self) -> int:
         with self._lock:
-            return max(self._local, self._live_remote())
+            return max(self._local, self._live_remote(), self._queue)
 
     @property
     def is_loaded(self) -> bool:
@@ -155,10 +183,11 @@ class LoadFeeTrack:
         with self._lock:
             remote = self._live_remote()
             return {
-                "load_factor": max(self._local, remote),
+                "load_factor": max(self._local, remote, self._queue),
                 "load_base": NORMAL_FEE,
                 "local_fee": self._local,
                 "remote_fee": remote,
+                "queue_fee": self._queue,
             }
 
 

@@ -40,9 +40,27 @@ class LocalTxs:
         self.reapplied = 0
 
     def push_back(self, ledger_seq: int, tx: SerializedTransaction) -> None:
-        """Track a locally-submitted tx (reference push_back)."""
+        """Track a locally-submitted tx (reference push_back). A
+        RE-submission of a known txid revives the entry — it must not be
+        shadowed by a stale `failed` mark or an old retry horizon (a tx
+        queued by the admission plane and later evicted is resubmitted
+        by the client with the same txid; the old setdefault left the
+        original entry in place, permanently un-retriable once failed)."""
         with self._lock:
-            self._txns.setdefault(tx.txid(), _LocalTx(tx, ledger_seq))
+            cur = self._txns.get(tx.txid())
+            if cur is None:
+                self._txns[tx.txid()] = _LocalTx(tx, ledger_seq)
+            else:
+                cur.failed = False
+                cur.submit_seq = max(cur.submit_seq, ledger_seq)
+
+    def remove(self, txid: bytes) -> bool:
+        """Stop tracking a tx (wired as TxQ.on_drop: admission-queue
+        eviction / expiry / promote-drop): the queue's drop decision
+        must also stop the cross-round re-apply, and the next client
+        resubmission starts a fresh retry horizon."""
+        with self._lock:
+            return self._txns.pop(txid, None) is not None
 
     def __contains__(self, txid: bytes) -> bool:
         with self._lock:

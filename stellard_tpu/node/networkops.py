@@ -71,6 +71,7 @@ class NetworkOPs:
         standalone: bool = True,
         fee_track=None,
         tracer=None,
+        txq=None,
     ):
         from .tracer import get_tracer
 
@@ -80,6 +81,11 @@ class NetworkOPs:
         self.router = hash_router
         self.tracer = tracer if tracer is not None else get_tracer()
         self.fee_track = fee_track  # loadmgr.LoadFeeTrack or None
+        # admission-control plane ([txq], node/txq.py): post-verify
+        # intake routes through TxQ.admit when enabled — soft open-
+        # ledger cap, escalating fee, fee-priority queue (terQUEUED);
+        # enabled=0 (or None) is the legacy direct-apply path
+        self.txq = txq
         self.standalone = standalone
         self.mode = OperatingMode.FULL if standalone else OperatingMode.DISCONNECTED
         self.master_lock = threading.RLock()  # reference: getApp().getMasterLock()
@@ -277,13 +283,27 @@ class NetworkOPs:
         params = TxParams.OPEN_LEDGER
         if admin:
             params |= TxParams.ADMIN
+        txq = self.txq
+        use_txq = txq is not None and txq.enabled
         with self.master_lock:
             if self.fee_track is not None:
                 # load-scaled open-ledger fee: Transactor::payFee reads the
                 # ledger's load_factor (reference: scaleFeeLoad via
-                # LoadFeeTrack) and rejects under-payers with telINSUF_FEE_P
-                self.lm.current_ledger().load_factor = self.fee_track.load_factor
-            ter, did_apply = self.lm.do_transaction(tx, params)
+                # LoadFeeTrack) and rejects under-payers with telINSUF_FEE_P.
+                # The NETWORK floor only (local + remote) — never the queue
+                # escalation component: TxQ.admit already prices admission,
+                # and folding it here would double-gate — the stamped value
+                # rides open_successor into the next window, where payFee
+                # would reject the very txs the queue is promoting
+                # (telINSUF_FEE_P -> retriable -> promotion starves).
+                self.lm.current_ledger().load_factor = self.fee_track.network_floor
+            if use_txq:
+                # admission control: soft open-ledger cap + escalating
+                # fee; under-payers above the cap queue (terQUEUED) or
+                # shed, terPRE_SEQ holds fold into the queue fee-ordered
+                ter, did_apply = txq.admit(tx, self.lm, params)
+            else:
+                ter, did_apply = self.lm.do_transaction(tx, params)
         self.stats["processed"] += 1
 
         # status bookkeeping (reference :500-533). Only tem (malformed) is
@@ -294,9 +314,16 @@ class NetworkOPs:
         elif ter.is_tem:
             status = TxStatus.INVALID
             self.router.set_flag(txid, SF_BAD)
+        elif ter == TER.terQUEUED:
+            # waiting in the admission queue for a later ledger
+            status = TxStatus.HELD
+            self.stats["queued"] = self.stats.get("queued", 0) + 1
         elif ter == TER.terPRE_SEQ:
-            # future sequence: hold for the next ledger (reference :516-524)
-            self.lm.add_held_transaction(tx)
+            # future sequence: hold for the next ledger (reference
+            # :516-524). With the TxQ enabled admit() already queued or
+            # shed it and never returns terPRE_SEQ from this path.
+            if not use_txq:
+                self.lm.add_held_transaction(tx)
             status = TxStatus.HELD
             self.stats["held"] += 1
         else:
@@ -311,17 +338,41 @@ class NetworkOPs:
         # a transiently-failing submission (e.g. telINSUF_FEE_P under
         # load) must still relay on its later successful resubmit, while
         # a successful one must not become a per-resubmit broadcast
-        # amplifier (swap_set returns newly-set exactly for this gate)
+        # amplifier (swap_set returns newly-set exactly for this gate).
+        # A QUEUED tx relays only once it meets the current NETWORK fee
+        # floor (other nodes would drop an under-payer anyway); a queued
+        # tx below the floor relays when promotion applies it
+        # (publish_closed_ledger drains TxQ.drain_relay).
         if not ter.is_tem and (did_apply or ter == TER.terPRE_SEQ):
-            prev_peers, newly = self.router.swap_set(txid, set(), SF_RELAYED)
-            if newly:
-                if self.relay_tx is not None:
-                    # prev_peers = peers this tx already arrived from;
-                    # they are excluded from the fan-out
-                    self.relay_tx(tx, prev_peers)
-                if self.local_push is not None:
-                    self.local_push(self.lm.closed_ledger().seq, tx)
+            self.relay_applied(tx)
+        elif ter == TER.terQUEUED and txq is not None and (
+            txq.meets_network_floor(tx, self.lm.current_ledger())
+        ):
+            # a queued tx at the network floor relays, but is NOT
+            # LocalTxs-tracked yet: the queue owns its retry, and the
+            # validator's LocalTxs re-apply would bypass admission
+            # (tracking starts when promotion applies it — see
+            # publish_closed_ledger's drain)
+            self.relay_applied(tx, track=False)
         return ter, did_apply
+
+    def relay_applied(self, tx: SerializedTransaction,
+                      track: bool = True) -> bool:
+        """Relay (+ optional local-retry tracking) for a tx this node
+        accepted — shared by the submit path and the TxQ promotion
+        drain. The SF_RELAYED swap_set gate makes the broadcast
+        exactly-once per txid; returns whether THIS call won it."""
+        prev_peers, newly = self.router.swap_set(
+            tx.txid(), set(), SF_RELAYED
+        )
+        if newly:
+            if self.relay_tx is not None:
+                # prev_peers = peers this tx already arrived from;
+                # they are excluded from the fan-out
+                self.relay_tx(tx, prev_peers)
+            if track and self.local_push is not None:
+                self.local_push(self.lm.closed_ledger().seq, tx)
+        return newly
 
     # -- standalone close (reference: NetworkOPs::acceptLedger) ------------
 
@@ -333,8 +384,11 @@ class NetworkOPs:
             if self.fee_track is not None:
                 # refresh before close: held-tx retries inside
                 # close_and_advance must see the CURRENT load, not the
-                # factor stamped by the last submission
-                self.lm.current_ledger().load_factor = self.fee_track.load_factor
+                # factor stamped by the last submission. NETWORK floor
+                # only, same as the submit path: the queue-escalation
+                # component must never reach a window payFee gates, or
+                # promotion double-prices the txs the queue admits
+                self.lm.current_ledger().load_factor = self.fee_track.network_floor
             closed, results = self.lm.close_and_advance(
                 close_time=self.network_time(),
                 close_resolution=self.lm.closed_ledger().close_resolution,
@@ -348,6 +402,19 @@ class NetworkOPs:
         """Status promotion + ledger-closed sinks, shared by the
         standalone close above and the networked consensus path (the
         WS ledger/transactions streams hang off on_ledger_closed)."""
+        if self.txq is not None:
+            # promoted txs whose relay waited out the chain lock (and
+            # the fee floor) broadcast here, outside the close path —
+            # BEFORE the COMMITTED promotion below: a deferred-promoted
+            # tx commits in the very close being published, and its
+            # HELD->INCLUDED transition must land first or it would
+            # stay INCLUDED forever. Promotion applied it, so it always
+            # (re-)enters LocalTxs tracking even when the fee floor
+            # already relayed it at queue time.
+            for tx in self.txq.drain_relay():
+                self._record_status(tx.txid(), TxStatus.INCLUDED)
+                if not self.relay_applied(tx) and self.local_push is not None:
+                    self.local_push(self.lm.closed_ledger().seq, tx)
         for txid, _ter in results.items():
             if self.on_tx_result.get(txid) == TxStatus.INCLUDED:
                 self._record_status(txid, TxStatus.COMMITTED)

@@ -255,6 +255,16 @@ class Node:
         self.fee_track = LoadFeeTrack()
         self.load_manager = LoadManager(self.job_queue, self.fee_track)
 
+        # admission-control plane ([txq], node/txq.py): soft open-ledger
+        # cap + escalating fee + bounded fee-priority queue between the
+        # verify plane and the open ledger; wired into NetworkOPs
+        # (admit) and LedgerMaster (promotion at _open_next) below
+        from .txq import TxQ
+
+        self.txq = TxQ.from_config(
+            cfg, fee_track=self.fee_track, tracer=self.tracer
+        )
+
         # trust + anti-DoS planes (reference: UNL :323, PoW factory :352,
         # LedgerCleaner)
         from ..utils.pow import PowFactory
@@ -512,6 +522,16 @@ class Node:
         # automatic fallback (incremental=0 is the kill-switch)
         self.ledger_master.incremental_seal = cfg.tree_incremental_seal
         self.ledger_master.seal_drain_batch = cfg.tree_drain_batch
+        # [txq]: the ledger chain promotes queued txs at _open_next and
+        # the queue's deferred (off-close-path) speculation rides the
+        # job queue; in networked mode the overlay's shared chain gets
+        # the same queue, so consensus closes promote too
+        self.ledger_master.txq = self.txq
+        from .jobqueue import JobType as _JT
+
+        self.txq.spec_dispatch = lambda thunk: self.job_queue.add_job(
+            _JT.jtTRANSACTION, "txqSpeculate", thunk
+        )
         self.ops = NetworkOPs(
             self.ledger_master,
             self.job_queue,
@@ -520,6 +540,7 @@ class Node:
             standalone=cfg.standalone,
             fee_track=self.fee_track,
             tracer=self.tracer,
+            txq=self.txq,
         )
         # configured skew applies to the ops-plane clock too (standalone
         # closes, status, staleness checks); the SNTP heartbeat COMPOSES
@@ -534,6 +555,10 @@ class Node:
             self.ops.master_lock = self.overlay.node.lock
             self.ops.relay_tx = self.overlay.broadcast_tx
             self.ops.local_push = self.overlay.node.local_txs.push_back
+            # a queued local tx the admission plane drops (eviction /
+            # expiry / promote-drop) must stop re-applying across
+            # rounds; a client resubmit then starts a fresh horizon
+            self.txq.on_drop = self.overlay.node.local_txs.remove
         elif cfg.close_pipeline_enabled:
             # standalone: the ledger-closed sink ENQUEUES — ledger N's
             # NodeStore/txdb/CLF writes overlap ledger N+1's verify/apply
@@ -731,6 +756,15 @@ class Node:
             "load", lambda: {"factor": self.fee_track.load_factor}
         )
         self.collector.hook(
+            "txq",
+            lambda: {
+                "size": len(self.txq),
+                "expected": self.txq.metrics.txns_expected,
+                "evicted": self.txq.stats["evicted"],
+                "promoted": self.txq.stats["promoted"],
+            },
+        )
+        self.collector.hook(
             "close_pipeline",
             lambda: {
                 "depth": self.close_pipeline.pending(),
@@ -925,6 +959,15 @@ class Node:
             raise RuntimeError(
                 "close_ledger: persistence pipeline failed to drain within "
                 "60s — storage stalled or wedged"
+            )
+        # synchronous contract extends to the admission plane: the
+        # deferred open-window replenish (promotion + queue-aware
+        # speculation) lands before this returns, so a caller's next
+        # close sees the promoted txs (perf paths stay deferred)
+        if not self.txq.quiesce(timeout=30):
+            raise RuntimeError(
+                "close_ledger: admission-queue replenish failed to land "
+                "within 30s — job queue stalled or wedged"
             )
         return out
 

@@ -88,6 +88,10 @@ class TER(IntEnum):
     terPRE_SEQ = -92
     terLAST = -91
     terNO_RIPPLE = -90
+    # admission control (reference: rippled TxQ/FeeEscalation): the tx
+    # was valid but paid less than the escalated open-ledger fee; it
+    # waits in the fee-priority queue for a later ledger
+    terQUEUED = -89
 
     # -- success -----------------------------------------------------------
     tesSUCCESS = 0
@@ -159,6 +163,7 @@ _DESCRIPTIONS = {
     TER.tesSUCCESS: "The transaction was applied.",
     TER.tefPAST_SEQ: "This sequence number has already past.",
     TER.terPRE_SEQ: "Missing/inapplicable prior transaction.",
+    TER.terQUEUED: "Held until the open ledger fee drops or capacity frees.",
     TER.terNO_ACCOUNT: "The source account does not exist.",
     TER.terINSUF_FEE_B: "Account balance can't pay fee.",
     TER.temBAD_SIGNATURE: "A signature is provided for a non-signing field.",

@@ -326,6 +326,11 @@ def do_server_state(ctx: Context) -> dict:
     # batched state-tree commit plane: merges, pre-hash drains, seal
     # adoptions (aggregate counters only — no per-tx detail to gate)
     state["tree"] = node.ledger_master.tree_json()
+    txq = getattr(node, "txq", None)
+    if txq is not None:
+        # admission-control plane: queue depth, soft cap, escalated
+        # open-ledger fee level (aggregate only — no txids)
+        state["txq"] = txq.get_json()
     tracer = getattr(node, "tracer", None)
     if tracer is not None:
         # tracing plane status; the consensus/close timeline is ADMIN
@@ -336,6 +341,36 @@ def do_server_state(ctx: Context) -> dict:
             timeline=(ctx.role == Role.ADMIN)
         )
     return {"state": state}
+
+
+@handler("fee")
+def do_fee(ctx: Context) -> dict:
+    """Admission-control fee oracle (reference: rippled's `fee` method,
+    handlers/Fee1.cpp): current open-ledger size vs the adaptive soft
+    cap, queue occupancy, and the fee (drops + 1/256 levels) required
+    to enter the open ledger right now."""
+    node = ctx.node
+    led = node.ledger_master.current_ledger()
+    txq = getattr(node, "txq", None)
+    if txq is None:
+        # load-factor-only fallback (no admission plane wired)
+        base = led.base_fee
+        factor = node.fee_track.load_factor if node.fee_track else 256
+        return {
+            "drops": {
+                "base_fee": str(base),
+                "minimum_fee": str(base),
+                "open_ledger_fee": str(base * factor // 256),
+            },
+            "levels": {
+                "reference_level": "256",
+                "open_ledger_level": str(factor),
+            },
+            "ledger_current_index": led.seq,
+        }
+    out = txq.fee_json(led)
+    out["enabled"] = txq.enabled
+    return out
 
 
 @handler("get_counts", Role.ADMIN)
@@ -358,6 +393,15 @@ def do_get_counts(ctx: Context) -> dict:
     if pipeline is not None:
         out["close_pipeline"] = pipeline.get_json()
         out["persist_backlog"] = pipeline.pending()
+    txq = getattr(node, "txq", None)
+    if txq is not None:
+        # admission-control plane: queue depth/caps + admit/evict/
+        # promote counters incl. the queue-aware-speculation split
+        out["txq"] = txq.get_json()
+    out["held"] = {
+        "count": len(node.ledger_master.held),
+        **node.ledger_master.held_stats,
+    }
     out["delta_replay"] = node.ledger_master.delta_replay_json()
     # batched state-tree commit plane: bulk merges, background pre-hash
     # drains, seal adoptions (node/ledgermaster.py tree_json)
@@ -696,6 +740,13 @@ def do_account_info(ctx: Context) -> dict:
     j["index"] = indexes.account_root_index(account_id).hex().upper()
     out = _ledger_ident(led)
     out["account_data"] = j
+    if ctx.params.get("queue"):
+        # admission-queue block (reference: account_info queue_data):
+        # this account's queued sequence chain, fee levels, total
+        # queued fee spend
+        txq = getattr(ctx.node, "txq", None)
+        if txq is not None:
+            out["queue_data"] = txq.account_json(account_id)
     return out
 
 
@@ -967,7 +1018,16 @@ def do_submit(ctx: Context) -> dict:
     ter, _applied = ctx.node.ops.process_transaction(
         tx, admin=(ctx.role == Role.ADMIN)
     )
-    return _engine_result(ter, tx)
+    out = _engine_result(ter, tx)
+    if ter == TER.terQUEUED:
+        # admission control queued it: tell the caller what entering the
+        # open ledger would have cost (and would cost on resubmit)
+        txq = getattr(ctx.node, "txq", None)
+        if txq is not None:
+            led = ctx.node.ledger_master.current_ledger()
+            out["queued"] = True
+            out["open_ledger_fee"] = str(txq.open_ledger_fee(led))
+    return out
 
 
 @handler("sign")

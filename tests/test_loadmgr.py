@@ -98,6 +98,116 @@ class TestLoadFeeTrack:
         assert ft.remote_reports() == []
 
 
+class TestLoadFeeTrackConcurrency:
+    """The track is hammered from several threads at once in production:
+    the LoadManager watchdog (raise/lower), peer threads (set_remote_fee)
+    and the TxQ close path (set_queue_fee), while RPC workers read
+    load_factor. These tests pin the invariants that must hold under
+    that interleaving."""
+
+    def test_concurrent_raise_lower_remote_bounded(self):
+        import threading
+
+        ft = LoadFeeTrack()
+        from stellard_tpu.node.loadmgr import MAX_FEE
+
+        stop = threading.Event()
+        violations = []
+
+        def reader():
+            while not stop.is_set():
+                f = ft.load_factor
+                if not (NORMAL_FEE <= f <= MAX_FEE):
+                    violations.append(f)
+                j = ft.get_json()
+                if j["load_factor"] < max(j["local_fee"], j["remote_fee"],
+                                          j["queue_fee"]):
+                    violations.append(j)
+
+        def raiser():
+            for _ in range(400):
+                ft.raise_local_fee()
+
+        def lowerer():
+            for _ in range(400):
+                ft.lower_local_fee()
+
+        def remote(i):
+            src = bytes([i]) * 33
+            for t in range(200):
+                ft.set_remote_fee(NORMAL_FEE * (1 + t % 7), source=src,
+                                  report_time=t)
+
+        threads = (
+            [threading.Thread(target=raiser) for _ in range(3)]
+            + [threading.Thread(target=lowerer) for _ in range(3)]
+            + [threading.Thread(target=remote, args=(i,)) for i in range(3)]
+            + [threading.Thread(target=reader) for _ in range(2)]
+        )
+        for t in threads[:-2]:
+            t.start()
+        for t in threads[-2:]:
+            t.start()
+        for t in threads[:-2]:
+            t.join()
+        stop.set()
+        for t in threads[-2:]:
+            t.join()
+        assert not violations
+        # after the storm: lowering fully decays back to normal
+        for _ in range(200):
+            ft.lower_local_fee()
+        ft._remote.clear()
+        ft.set_queue_fee(0)
+        assert ft.load_factor == NORMAL_FEE
+
+    def test_load_factor_monotone_under_pure_raise_flood(self):
+        """During a sustained overload (only raises arriving, remote
+        reports static) sampled load_factor must never move DOWN — a
+        dip would let a flood burst through under the stale lower fee."""
+        import threading
+
+        ft = LoadFeeTrack()
+        ft.set_remote_fee(512, source=b"\x09" * 33, report_time=1)
+        samples = []
+        stop = threading.Event()
+
+        def sampler():
+            while not stop.is_set():
+                samples.append(ft.load_factor)
+
+        s = threading.Thread(target=sampler)
+        s.start()
+        for _ in range(300):
+            ft.raise_local_fee()
+        stop.set()
+        s.join()
+        assert samples == sorted(samples)
+
+    def test_stale_remote_expiry_under_concurrent_readers(self):
+        """Remote-report expiry is evaluated inside load_factor reads;
+        concurrent readers must agree the report died after its TTL and
+        the fee floor returns to the local component."""
+        import threading
+
+        ft = LoadFeeTrack()
+        ft.REMOTE_TTL = 0.05
+        ft.set_remote_fee(4096, source=b"\x0a" * 33, report_time=7)
+        assert ft.load_factor == 4096
+        time.sleep(0.08)
+        results = []
+        threads = [
+            threading.Thread(target=lambda: results.append(ft.load_factor))
+            for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == [NORMAL_FEE] * 8
+        assert ft.remote_reports() == []
+
+
 class TestLoadManager:
     def test_overload_raises_then_recovers(self):
         jq = JobQueue(threads=2)

@@ -1,0 +1,764 @@
+"""Admission-control plane tests (node/txq.py + its integrations).
+
+Covers the [txq] subsystem end to end: the adaptive soft cap and
+escalation curve, queue admission (replace-by-fee, per-account chains,
+account caps, cheapest-first eviction, expiry), close-time promotion in
+fee order, byte-identity of the enabled=0 kill-switch at capacity, the
+bounded/expiring held pile, queue-aware speculation (promoted txs
+splice at their close), the LoadFeeTrack queue-fee feedback, the
+LocalTxs resubmit regression, and the RPC surfaces (fee,
+account_info queue block, submit terQUEUED, get_counts/server_state).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from stellard_tpu.node import ledgermaster as lm_mod
+from stellard_tpu.node.config import Config
+from stellard_tpu.node.localtxs import LocalTxs
+from stellard_tpu.node.loadmgr import NORMAL_FEE, LoadFeeTrack
+from stellard_tpu.node.node import Node
+from stellard_tpu.node.txq import NORMAL_LEVEL, FeeMetrics, fee_level
+from stellard_tpu.protocol.formats import TxType
+from stellard_tpu.protocol.keys import KeyPair
+from stellard_tpu.protocol.sfields import sfAmount, sfBalance, sfDestination
+from stellard_tpu.protocol.stamount import STAmount
+from stellard_tpu.protocol.sttx import SerializedTransaction
+from stellard_tpu.protocol.ter import TER
+from stellard_tpu.rpc.handlers import Context, Role, dispatch
+
+XRP = 1_000_000
+
+
+def payment(kp, seq, dest, drops, fee=10):
+    tx = SerializedTransaction.build(
+        TxType.ttPAYMENT, kp.account_id, seq, fee,
+        {sfAmount: STAmount.from_drops(drops), sfDestination: dest},
+    )
+    tx.sign(kp)
+    return tx
+
+
+def make_node(**cfg_kwargs):
+    node = Node(Config(**cfg_kwargs)).setup()
+    # deterministic close times: one resolution step per close
+    closes = [0]
+    real_close = node.close_ledger
+
+    def close():
+        closes[0] += 1
+        return real_close()
+
+    node.ops.network_time = lambda: 900_000_000 + closes[0] * 30
+    node.close_ledger = close
+    return node
+
+
+def fund(node, kp, drops=2_000 * XRP):
+    # the fee beats any escalation these small test caps can produce, so
+    # funding always enters the open ledger directly
+    seq = node._fund_seq = getattr(node, "_fund_seq", 0) + 1
+    ter, ok = node.submit(
+        payment(node.master_keys, seq, kp.account_id, drops, fee=10_000_000)
+    )
+    assert ok, ter
+
+
+class TestFeeMetrics:
+    def test_required_level_curve(self):
+        m = FeeMetrics(min_cap=8, max_cap=8)
+        assert m.txns_expected == 8
+        assert m.required_level(0) == NORMAL_LEVEL
+        assert m.required_level(7) == NORMAL_LEVEL
+        at_cap = m.required_level(8)
+        assert at_cap > NORMAL_LEVEL
+        # quadratic growth above the cap
+        assert m.required_level(16) > 2 * at_cap
+
+    def test_cap_adapts_to_measured_capacity(self):
+        m = FeeMetrics(min_cap=8, max_cap=1000, target_close_ms=100.0)
+        # 1 ms/tx measured -> 100 txs fit the 100 ms budget
+        for _ in range(8):
+            m.note_close(50, 50.0)
+        assert 90 <= m.txns_expected <= 110
+        # closes slow down 10x -> the cap shrinks toward 10
+        for _ in range(16):
+            m.note_close(50, 500.0)
+        assert m.txns_expected <= 16
+        # empty closes carry no signal
+        before = m.txns_expected
+        m.note_close(0, 1000.0)
+        assert m.txns_expected == before
+
+    def test_clamps(self):
+        m = FeeMetrics(min_cap=8, max_cap=16, target_close_ms=1000.0)
+        m.note_close(100, 0.001)  # absurdly fast: clamp at max
+        assert m.txns_expected == 16
+        for _ in range(16):
+            m.note_close(10, 10_000.0)  # absurdly slow: clamp at min
+        assert m.txns_expected == 8
+
+
+class TestAdmission:
+    """Queue admission against a pinned cap (min_cap == max_cap)."""
+
+    @pytest.fixture
+    def node(self):
+        n = make_node(txq_min_cap=4, txq_max_cap=4,
+                      txq_ledgers_in_queue=2, txq_account_cap=3)
+        yield n
+        n.stop()
+
+    @pytest.fixture
+    def funded(self, node):
+        senders = [KeyPair.from_passphrase(f"adm-{i}") for i in range(8)]
+        for s in senders:
+            fund(node, s)
+        node.close_ledger()
+        return senders
+
+    def test_direct_under_cap_then_queue_above(self, node, funded):
+        senders = funded
+        results = []
+        for i, s in enumerate(senders):
+            ter, ok = node.submit(
+                payment(s, 1, node.master_keys.account_id, XRP)
+            )
+            results.append((ter, ok))
+        # first 4 fill the open ledger, the rest queue
+        assert [r for r, ok in results[:4]] == [TER.tesSUCCESS] * 4
+        assert all(r == TER.terQUEUED for r, _ in results[4:])
+        assert len(node.txq) == 4
+        # the escalated fee buys entry even above the cap
+        rich = senders[0]
+        fee = int(dispatch(
+            Context(node=node, params={}, role=Role.ADMIN), "fee"
+        )["drops"]["open_ledger_fee"])
+        assert fee > 10
+        ter, ok = node.submit(
+            payment(rich, 2, node.master_keys.account_id, XRP, fee=fee)
+        )
+        assert ter == TER.tesSUCCESS and ok
+
+    def test_replace_by_fee(self, node, funded):
+        senders = funded
+        for s in senders[:4]:
+            node.submit(payment(s, 1, node.master_keys.account_id, XRP))
+        q = senders[4]
+        ter, _ = node.submit(payment(q, 1, node.master_keys.account_id, XRP, fee=100))
+        assert ter == TER.terQUEUED
+        # an insufficient bump (<25%) is rejected resubmittably
+        ter, _ = node.submit(payment(q, 1, node.master_keys.account_id, XRP, fee=110))
+        assert ter == TER.telINSUF_FEE_P
+        # >= 25% bump replaces the queued entry
+        ter, _ = node.submit(payment(q, 1, node.master_keys.account_id, XRP, fee=125))
+        assert ter == TER.terQUEUED
+        assert node.txq.stats["replaced"] == 1
+        qd = node.txq.account_json(q.account_id)
+        assert qd["txn_count"] == 1
+        assert int(qd["transactions"][0]["fee_level"]) == fee_level(125, 10)
+
+    def test_account_chain_cap(self, node, funded):
+        senders = funded
+        for s in senders[:4]:
+            node.submit(payment(s, 1, node.master_keys.account_id, XRP))
+        q = senders[5]
+        for seq in (1, 2, 3):
+            ter, _ = node.submit(payment(q, seq, node.master_keys.account_id, XRP))
+            assert ter == TER.terQUEUED
+        ter, _ = node.submit(payment(q, 4, node.master_keys.account_id, XRP))
+        assert ter == TER.telINSUF_FEE_P  # account_cap=3
+
+    def test_eviction_cheapest_first(self, node, funded):
+        senders = funded
+        for s in senders[:4]:
+            node.submit(payment(s, 1, node.master_keys.account_id, XRP))
+        # fill the queue bound (max_size = 4*2 = 8) with cheap entries
+        cheap = senders[4:8]
+        for s in cheap:
+            for seq in (1, 2):
+                ter, _ = node.submit(
+                    payment(s, seq, node.master_keys.account_id, XRP, fee=10)
+                )
+                assert ter == TER.terQUEUED
+        assert len(node.txq) == node.txq.max_size == 8
+        # an equal-fee newcomer is shed (FIFO within level: no eviction)
+        ter, _ = node.submit(
+            payment(senders[0], 2, node.master_keys.account_id, XRP, fee=10)
+        )
+        assert ter == TER.telINSUF_FEE_P
+        # a better-paying newcomer evicts the cheapest
+        ter, _ = node.submit(
+            payment(senders[0], 2, node.master_keys.account_id, XRP, fee=40)
+        )
+        assert ter == TER.terQUEUED
+        assert node.txq.stats["evicted"] == 1
+        assert len(node.txq) == 8
+
+    def test_eviction_never_gaps_own_chain(self, node, funded):
+        """A full queue must shed a newcomer rather than evict the
+        newcomer's OWN chain tail to make room for its later sequence —
+        that would manufacture an unpromotable mid-chain gap."""
+        senders = funded
+        for s in senders[:4]:
+            node.submit(payment(s, 1, node.master_keys.account_id, XRP))
+        q = senders[4]
+        # fill the whole bound (8) from ONE account (account_cap is 3
+        # here, so use a node-level override)
+        node.txq.account_cap = 16
+        for seq in range(1, 9):
+            ter, _ = node.submit(
+                payment(q, seq, node.master_keys.account_id, XRP, fee=10)
+            )
+            assert ter == TER.terQUEUED
+        # a much better-paying seq 9 from the SAME account must be shed,
+        # not evict seq 8 out from under itself
+        ter, _ = node.submit(
+            payment(q, 9, node.master_keys.account_id, XRP, fee=500)
+        )
+        assert ter == TER.telINSUF_FEE_P
+        assert sorted(node.txq._accounts[q.account_id]) == list(range(1, 9))
+        assert node.txq.stats["evicted"] == 0
+
+    def test_drop_hook_fires_on_evict_and_expiry(self, node, funded):
+        senders = funded
+        dropped = []
+        node.txq.on_drop = dropped.append
+        node.txq.retention_ledgers = 1  # horizons stamp at queue time
+        for s in senders[:4]:
+            node.submit(payment(s, 1, node.master_keys.account_id, XRP))
+        # fill the bound with cheap entries from several accounts, then
+        # evict one with a better-paying newcomer
+        for s in senders[4:8]:
+            for seq in (1, 2):
+                node.submit(payment(s, seq, node.master_keys.account_id,
+                                    XRP, fee=10))
+        tx_evictor = payment(senders[0], 2, node.master_keys.account_id,
+                             XRP, fee=40)
+        assert node.submit(tx_evictor)[0] == TER.terQUEUED
+        assert len(dropped) == 1  # the evicted chain tail
+        # expiry notifies too (anything promotion doesn't drain first)
+        for _ in range(3):
+            node.close_ledger()
+        assert len(dropped) >= 2
+
+    def test_queue_rejects_hopeless_txs(self, node, funded):
+        senders = funded
+        for s in senders[:4]:
+            node.submit(payment(s, 1, node.master_keys.account_id, XRP))
+        ghost = KeyPair.from_passphrase("txq-ghost")
+        ter, _ = node.submit(payment(ghost, 1, node.master_keys.account_id, XRP))
+        assert ter == TER.terNO_ACCOUNT
+        # past sequence can never apply
+        ter, _ = node.submit(
+            payment(node.master_keys, 1, senders[0].account_id, XRP)
+        )
+        assert ter == TER.tefPAST_SEQ
+
+    def test_expiry_by_ledger_seq(self, node, funded):
+        senders = funded
+        for s in senders[:4]:
+            node.submit(payment(s, 1, node.master_keys.account_id, XRP))
+        gap = senders[6]
+        node.txq.retention_ledgers = 2
+        ter, _ = node.submit(payment(gap, 5, node.master_keys.account_id, XRP))
+        assert ter == TER.terQUEUED  # future seq: can never promote
+        for _ in range(4):
+            node.close_ledger()
+        assert node.txq.stats["expired"] >= 1
+        assert node.txq.account_json(gap.account_id)["txn_count"] == 0
+
+    def test_malformed_fee_future_seq_never_held_and_dropped(self, node,
+                                                             funded):
+        """A non-native-fee tx takes the malformed-fee bypass in
+        admit(). NetworkOPs skips the legacy hold pile when the queue is
+        on, so terPRE_SEQ escaping that bypass would report HELD while
+        silently dropping the tx. Today the engine's passes_local_checks
+        gate makes that unreachable (temINVALID before the sequence
+        check); this pins the contract either way — the outcome must be
+        a hard reject or terQUEUED, never a HELD status with the tx in
+        no retry structure."""
+        s = funded[0]
+        tx = SerializedTransaction.build(
+            TxType.ttPAYMENT, s.account_id, 5, 10,
+            {sfAmount: STAmount.from_drops(XRP),
+             sfDestination: node.master_keys.account_id},
+        )
+        from stellard_tpu.node.networkops import TxStatus
+        from stellard_tpu.protocol.sfields import sfFee
+        tx.obj[sfFee] = STAmount.from_iou(b"USD\0" * 5, s.account_id, 10, 0)
+        tx.sign(s)
+        ter, did_apply = node.submit(tx)
+        assert ter == TER.temINVALID and not did_apply
+        assert node.tx_status(tx.txid()) == TxStatus.INVALID
+        assert node.txq.account_json(s.account_id)["txn_count"] == 0
+
+    def test_chain_cumulative_spend_bounded_by_balance(self, node):
+        """The WHOLE chain's queued fees must be payable, not just each
+        tx's own: a chain whose cumulative fees exceed the balance would
+        squat in the queue as unpromotable terINSUF_FEE_B retries until
+        expiry. Future-seq txs queue regardless of fee level (the
+        terPRE_SEQ fold), so high fees are the easiest squat vector."""
+        poor = KeyPair.from_passphrase("cumul-poor")
+        fund(node, poor, drops=300 * XRP)
+        node.close_ledger()
+        fee = 120 * XRP  # each affordable alone; three exceed 300
+        for seq, want in ((5, TER.terQUEUED), (6, TER.terQUEUED),
+                          (7, TER.terINSUF_FEE_B)):
+            ter, _ = node.submit(payment(
+                poor, seq, node.master_keys.account_id, 1, fee=fee
+            ))
+            assert ter == want, (seq, ter)
+        assert node.txq.account_json(poor.account_id)["txn_count"] == 2
+
+
+class TestPromotion:
+    def test_fee_order_drain(self):
+        """A drained queue validates strictly in fee-level order."""
+        node = make_node(txq_min_cap=2, txq_max_cap=2)
+        node.txq.spec_dispatch = None  # inline speculation: deterministic
+        senders = [KeyPair.from_passphrase(f"promo-{i}") for i in range(6)]
+        for s in senders:
+            fund(node, s)
+        node.close_ledger()
+        fees = [10, 11, 12, 13, 14, 15]  # submit cheapest first
+        txs = [
+            payment(s, 1, node.master_keys.account_id, XRP, fee=f)
+            for s, f in zip(senders, fees)
+        ]
+        for tx in txs:
+            node.submit(tx)
+        # open holds the 2 direct ones; 4 queued
+        assert len(node.txq) == 4
+        landed = {}
+        for _ in range(4):
+            closed, results = node.ops.accept_ledger()
+            for txid in results:
+                landed[txid] = closed.seq
+        by_fee = {tx.fee.mantissa: landed.get(tx.txid()) for tx in txs}
+        assert all(v is not None for v in by_fee.values()), by_fee
+        # the queued ones (12..15) drain highest-fee-first
+        assert by_fee[15] <= by_fee[14] <= by_fee[13] <= by_fee[12]
+        assert node.txq.stats["promoted"] == 4
+        node.stop()
+
+    def test_account_chain_promotes_in_sequence(self):
+        node = make_node(txq_min_cap=2, txq_max_cap=2)
+        node.txq.spec_dispatch = None
+        a = KeyPair.from_passphrase("chain-a")
+        b = KeyPair.from_passphrase("chain-b")
+        for s in (a, b):
+            fund(node, s)
+        node.close_ledger()
+        # fill the open window
+        node.submit(payment(b, 1, node.master_keys.account_id, XRP, fee=500))
+        node.submit(payment(b, 2, node.master_keys.account_id, XRP, fee=500))
+        # queue a 3-tx chain where the LATER seqs pay more: promotion
+        # must still apply seq 1 first (chains stay ordered)
+        for seq, fee in ((1, 10), (2, 40), (3, 80)):
+            ter, _ = node.submit(
+                payment(a, seq, node.master_keys.account_id, XRP, fee=fee)
+            )
+            assert ter == TER.terQUEUED
+        for _ in range(3):
+            node.close_ledger()
+        led = node.ledger_master.closed_ledger()
+        root = led.account_root(a.account_id)
+        from stellard_tpu.protocol.sfields import sfSequence
+
+        assert root[sfSequence] == 4  # all three applied, in order
+        assert node.txq.stats["promoted"] == 3
+        node.stop()
+
+    def test_deferred_promotion_reaches_committed_status(self):
+        """A queued tx promoted on the deferred job must end COMMITTED
+        once its ledger closes — the HELD->INCLUDED transition from the
+        relay drain lands BEFORE the publish's COMMITTED promotion."""
+        from stellard_tpu.node.networkops import TxStatus
+
+        node = make_node(txq_min_cap=2, txq_max_cap=2)
+        senders = [KeyPair.from_passphrase(f"st-{i}") for i in range(3)]
+        for s in senders:
+            fund(node, s)
+        node.close_ledger()
+        for s in senders[:2]:
+            node.submit(payment(s, 1, node.master_keys.account_id, XRP))
+        queued_tx = payment(senders[2], 1, node.master_keys.account_id, XRP)
+        ter, _ = node.submit(queued_tx)
+        assert ter == TER.terQUEUED
+        assert node.tx_status(queued_tx.txid()) == TxStatus.HELD
+        node.close_ledger()  # promotes (deferred; close_ledger quiesces)
+        node.close_ledger()  # commits + publishes
+        assert node.tx_status(queued_tx.txid()) == TxStatus.COMMITTED
+        node.stop()
+
+    def test_queue_aware_speculation_splices(self):
+        """Promoted txs splice at their close via the deferred
+        speculation (no transactor re-execution) — the get_counts.txq
+        honesty counter for the queue-aware-speculation claim."""
+        node = make_node(txq_min_cap=4, txq_max_cap=4)
+        node.txq.spec_dispatch = None  # run spec inline (deterministic)
+        senders = [KeyPair.from_passphrase(f"spec-{i}") for i in range(12)]
+        for s in senders:
+            fund(node, s)
+        node.close_ledger()
+        # disjoint destinations: payments create per-sender accounts, so
+        # canonical-order scrambling cannot invalidate overlay reads
+        # (a shared hot destination falls back by design)
+        for i, s in enumerate(senders):
+            dest = KeyPair.from_passphrase(f"spec-dest-{i}").account_id
+            node.submit(payment(s, 1, dest, 250 * XRP))
+        for _ in range(4):
+            node.close_ledger()
+        j = node.txq.get_json()
+        assert j["promoted"] == 8  # 4 direct + 8 promoted
+        assert j["promote_spliced"] == 8
+        assert j["promote_fallback"] == 0
+        node.stop()
+
+    def test_promotion_budget_respects_open_occupancy(self):
+        """_promote fills UP TO the soft cap: txs already in the open
+        window (consensus leftovers, an earlier promotion pass) count
+        against the budget, so a second pass cannot stack a full budget
+        on top and close an oversized ledger."""
+        node = make_node(txq_min_cap=2, txq_max_cap=2, txq_account_cap=4)
+        node.txq.spec_dispatch = None
+        senders = [KeyPair.from_passphrase(f"bud-{i}") for i in range(6)]
+        for s in senders:
+            fund(node, s)
+        node.close_ledger()
+        for s in senders:
+            node.submit(payment(s, 1, node.master_keys.account_id, XRP))
+        assert len(node.txq) == 4  # 2 direct, 4 queued
+        node.close_ledger()  # inline promotion fills the window to 2
+        lm = node.ledger_master
+        assert node.txq.open_size(lm.current_ledger()) == 2
+        # a second (stacked/stale) pass finds zero budget: the window is
+        # already at the cap, so nothing more promotes into it
+        with lm._lock:
+            again = node.txq._promote(lm)
+        assert again == 0
+        assert node.txq.open_size(lm.current_ledger()) == 2
+        assert len(node.txq) == 2
+        node.stop()
+
+    def test_stale_deferred_job_skips_moved_window(self):
+        """A deferred promotion job that runs after its target window
+        already closed must SKIP (the newer close's job owns the new
+        window) — a backed-up job queue must not promote twice into one
+        window."""
+        node = make_node(txq_min_cap=2, txq_max_cap=2)
+        jobs = []
+        node.txq.spec_dispatch = lambda thunk: (jobs.append(thunk), True)[1]
+        senders = [KeyPair.from_passphrase(f"stale-{i}") for i in range(5)]
+        for s in senders:
+            fund(node, s)
+        node.ops.accept_ledger()
+        jobs.clear()  # replenish jobs for the pre-flood closes
+        for s in senders:
+            node.submit(payment(s, 1, node.master_keys.account_id, XRP))
+        node.ops.accept_ledger()   # job A targets window N
+        node.ops.accept_ledger()   # job B targets window N+1; A is stale
+        assert len(jobs) == 2
+        job_a, job_b = jobs
+        before = node.txq.stats["promoted"]
+        job_a()  # stale: its window moved on -> must be a no-op
+        assert node.txq.stats["promoted"] == before
+        assert node.txq.open_size(node.ledger_master.current_ledger()) == 0
+        job_b()  # current: promotes into the live window
+        assert node.txq.stats["promoted"] == before + 2
+        node.stop()
+
+
+class TestKillSwitchIdentity:
+    def _drive(self, enabled):
+        node = make_node(txq_enabled=enabled, txq_min_cap=64, txq_max_cap=64)
+        if enabled:
+            node.txq.spec_dispatch = None
+        senders = [KeyPair.from_passphrase(f"ident-{i}") for i in range(4)]
+        for s in senders:
+            fund(node, s)
+        hashes = [node.close_ledger()[0].hash()]
+        # at-capacity workload with a sequence gap thrown in: the gap is
+        # held (enabled=0) or queued (enabled=1) and lands next close
+        results_log = []
+        for rnd in range(3):
+            for i, s in enumerate(senders):
+                node.submit(payment(s, rnd + 1, node.master_keys.account_id,
+                                    XRP, fee=10 + i))
+            if rnd == 0:
+                # future seq for sender 0 — a terPRE_SEQ hold
+                node.submit(payment(senders[0], 3, node.master_keys.account_id,
+                                    2 * XRP))
+            closed, results = node.ops.accept_ledger()
+            hashes.append(closed.hash())
+            results_log.append(sorted(
+                (txid.hex(), int(ter)) for txid, ter in results.items()
+            ))
+        closed, results = node.ops.accept_ledger()  # gap tx lands
+        hashes.append(closed.hash())
+        results_log.append(sorted(
+            (txid.hex(), int(ter)) for txid, ter in results.items()
+        ))
+        node.stop()
+        return hashes, results_log
+
+    def test_enabled_0_vs_1_byte_identical_at_capacity(self):
+        h0, r0 = self._drive(enabled=False)
+        h1, r1 = self._drive(enabled=True)
+        assert h0 == h1  # every close byte-identical
+        assert r0 == r1
+
+
+class TestHeldPileBounds:
+    """Satellite: the legacy held dict is capped and expires by seq."""
+
+    def test_cap_evicts_oldest(self, monkeypatch):
+        monkeypatch.setattr(lm_mod, "HELD_CAP", 4)
+        node = make_node(txq_enabled=False)
+        a = KeyPair.from_passphrase("held-a")
+        fund(node, a)
+        node.close_ledger()
+        for seq in range(10, 17):  # 7 gapped holds, cap 4
+            ter, _ = node.submit(payment(a, seq, node.master_keys.account_id, XRP))
+            assert ter == TER.terPRE_SEQ
+        assert len(node.ledger_master.held) == 4
+        assert node.ledger_master.held_stats["evicted"] == 3
+        node.stop()
+
+    def test_expiry_by_ledger_seq(self, monkeypatch):
+        monkeypatch.setattr(lm_mod, "HELD_EXPIRE_LEDGERS", 2)
+        node = make_node(txq_enabled=False)
+        a = KeyPair.from_passphrase("held-b")
+        fund(node, a)
+        node.close_ledger()
+        ter, _ = node.submit(payment(a, 9, node.master_keys.account_id, XRP))
+        assert ter == TER.terPRE_SEQ
+        for _ in range(4):
+            node.close_ledger()
+        assert len(node.ledger_master.held) == 0
+        assert node.ledger_master.held_stats["expired"] >= 1
+        node.stop()
+
+    def test_rehold_keeps_original_horizon(self, monkeypatch):
+        monkeypatch.setattr(lm_mod, "HELD_EXPIRE_LEDGERS", 3)
+        node = make_node(txq_enabled=False)
+        a = KeyPair.from_passphrase("held-c")
+        fund(node, a)
+        node.close_ledger()
+        node.submit(payment(a, 9, node.master_keys.account_id, XRP))
+        key = next(iter(node.ledger_master.held))
+        first_expire = node.ledger_master.held[key][1]
+        node.close_ledger()  # re-held with the SAME horizon
+        assert node.ledger_master.held[key][1] == first_expire
+        node.stop()
+
+    def test_rejected_held_absorption_fires_drop_hook(self):
+        """A held tx the queue REFUSES at absorption (queue full of
+        better payers) is dropped — the drop hook must fire so LocalTxs
+        stops the cross-round re-apply; silent discard would let the tx
+        bypass admission forever."""
+        node = make_node(txq_min_cap=2, txq_max_cap=2,
+                         txq_ledgers_in_queue=1)
+        node.txq.spec_dispatch = None
+        senders = [KeyPair.from_passphrase(f"habs-{i}") for i in range(5)]
+        for s in senders:
+            fund(node, s)
+        node.close_ledger()
+        for s in senders[:2]:
+            node.submit(payment(s, 1, node.master_keys.account_id, XRP,
+                                fee=500))
+        for s in senders[2:4]:  # fill the queue (max_size = 2)
+            ter, _ = node.submit(payment(
+                s, 1, node.master_keys.account_id, XRP, fee=100
+            ))
+            assert ter == TER.terQUEUED
+        dropped = []
+        node.txq.on_drop = dropped.append
+        held = payment(senders[4], 3, node.master_keys.account_id, XRP)
+        node.ledger_master.add_held_transaction(held)
+        node.close_ledger()  # absorption finds the queue full -> drop
+        assert held.txid() in dropped
+        assert node.txq.account_json(senders[4].account_id)["txn_count"] == 0
+        node.stop()
+
+
+class TestLocalTxsResubmit:
+    """Satellite: a queued-then-evicted local tx stays resubmittable."""
+
+    def test_push_back_revives_failed_entry(self):
+        lt = LocalTxs()
+        kp = KeyPair.from_passphrase("lt")
+        tx = payment(kp, 1, KeyPair.from_passphrase("lt2").account_id, XRP)
+        lt.push_back(5, tx)
+        lt._txns[tx.txid()].failed = True  # apply_to_open marked it
+        # resubmission (same txid) must revive tracking, not be
+        # shadowed by the stale failed mark
+        lt.push_back(9, tx)
+        assert not lt._txns[tx.txid()].failed
+        assert lt._txns[tx.txid()].submit_seq == 9
+
+    def test_remove_unshadows(self):
+        lt = LocalTxs()
+        kp = KeyPair.from_passphrase("lt3")
+        tx = payment(kp, 1, KeyPair.from_passphrase("lt4").account_id, XRP)
+        lt.push_back(5, tx)
+        assert tx.txid() in lt
+        assert lt.remove(tx.txid())
+        assert tx.txid() not in lt
+        lt.push_back(6, tx)  # fresh horizon after eviction
+        assert lt._txns[tx.txid()].submit_seq == 6
+
+
+class TestQueueFeeFeedback:
+    def test_queue_fee_folds_into_load_factor_not_floor(self):
+        ft = LoadFeeTrack()
+        assert ft.load_factor == NORMAL_FEE
+        ft.set_queue_fee(4 * NORMAL_FEE)
+        assert ft.load_factor == 4 * NORMAL_FEE
+        assert ft.queue_fee == 4 * NORMAL_FEE
+        # the NETWORK floor excludes local admission escalation
+        assert ft.network_floor == NORMAL_FEE
+        assert ft.get_json()["queue_fee"] == 4 * NORMAL_FEE
+        ft.set_queue_fee(0)  # clamped at normal
+        assert ft.load_factor == NORMAL_FEE
+
+    def test_close_feeds_escalation_into_track(self):
+        node = make_node(txq_min_cap=2, txq_max_cap=2)
+        node.txq.spec_dispatch = None  # inline replenish: deterministic
+        senders = [KeyPair.from_passphrase(f"fb-{i}") for i in range(6)]
+        for s in senders:
+            fund(node, s)
+        node.close_ledger()
+        for s in senders:
+            node.submit(payment(s, 1, node.master_keys.account_id, XRP))
+        node.close_ledger()
+        # promotion refilled the open ledger to the cap: the escalated
+        # entry price is visible as the track's queue component
+        assert node.fee_track.queue_fee > NORMAL_FEE
+        assert node.fee_track.load_factor >= node.fee_track.queue_fee
+        # drain fully: feedback decays back to normal
+        for _ in range(4):
+            node.close_ledger()
+        assert node.fee_track.queue_fee == NORMAL_FEE
+        node.stop()
+
+    def test_queue_fee_never_stamps_the_open_ledger(self):
+        """The submit path stamps the ledger's load_factor with the
+        NETWORK floor only: folding the queue escalation in would make
+        payFee double-price admission — a base-fee tx submitted while
+        the open window has room would shed telINSUF_FEE_P instead of
+        applying (and a promoted cheap tx would starve the same way)."""
+        node = make_node(txq_min_cap=4, txq_max_cap=4)
+        node.txq.spec_dispatch = None
+        a = KeyPair.from_passphrase("stamp-a")
+        fund(node, a)
+        node.close_ledger()
+        # simulate standing queue pressure from an earlier close
+        node.fee_track.set_queue_fee(500 * NORMAL_FEE)
+        ter, ok = node.submit(payment(a, 1, node.master_keys.account_id, XRP))
+        assert (ter, ok) == (TER.tesSUCCESS, True)  # room -> applies
+        assert node.ledger_master.current_ledger().load_factor == NORMAL_FEE
+        # genuine NETWORK load still gates payFee through the stamp
+        for _ in range(8):
+            node.fee_track.raise_local_fee()
+        ter, ok = node.submit(payment(a, 2, node.master_keys.account_id, XRP))
+        assert ter == TER.telINSUF_FEE_P and not ok
+        assert (node.ledger_master.current_ledger().load_factor
+                == node.fee_track.network_floor > NORMAL_FEE)
+        node.stop()
+
+
+class TestRpcSurfaces:
+    @pytest.fixture
+    def node(self):
+        n = make_node(txq_min_cap=2, txq_max_cap=2)
+        n.txq.spec_dispatch = None
+        yield n
+        n.stop()
+
+    def _ctx(self, node, params=None, role=Role.ADMIN):
+        return Context(node=node, params=params or {}, role=role)
+
+    def test_fee_method(self, node):
+        out = dispatch(self._ctx(node), "fee")
+        assert out["levels"]["reference_level"] == "256"
+        assert out["expected_ledger_size"] == "2"
+        assert int(out["drops"]["open_ledger_fee"]) >= 10
+
+    def test_submit_returns_queued_with_open_fee(self, node):
+        senders = [KeyPair.from_passphrase(f"rpc-{i}") for i in range(3)]
+        for s in senders:
+            fund(node, s)
+        node.close_ledger()
+        for s in senders[:2]:
+            node.submit(payment(s, 1, node.master_keys.account_id, XRP))
+        tx = payment(senders[2], 1, node.master_keys.account_id, XRP)
+        out = dispatch(
+            self._ctx(node, {"tx_blob": tx.serialize().hex()}, Role.GUEST),
+            "submit",
+        )
+        assert out["engine_result"] == "terQUEUED"
+        assert out["queued"] is True
+        assert int(out["open_ledger_fee"]) > 10
+
+    def test_account_info_queue_block(self, node):
+        senders = [KeyPair.from_passphrase(f"ai-{i}") for i in range(3)]
+        for s in senders:
+            fund(node, s)
+        node.close_ledger()
+        for s in senders[:2]:
+            node.submit(payment(s, 1, node.master_keys.account_id, XRP))
+        q = senders[2]
+        node.submit(payment(q, 1, node.master_keys.account_id, XRP))
+        from stellard_tpu.protocol.keys import encode_account_id
+
+        out = dispatch(
+            self._ctx(node, {"account": encode_account_id(q.account_id),
+                             "queue": True}),
+            "account_info",
+        )
+        assert out["queue_data"]["txn_count"] == 1
+        assert out["queue_data"]["lowest_sequence"] == 1
+
+    def test_counts_and_state_blocks(self, node):
+        counts = dispatch(self._ctx(node), "get_counts")
+        assert "txq" in counts and counts["txq"]["enabled"] is True
+        assert "held" in counts
+        state = dispatch(self._ctx(node), "server_state")["state"]
+        assert state["txq"]["size"] == 0
+        assert "txns_expected" in state["txq"]["metrics"]
+
+
+class TestOverloadBounded:
+    def test_4x_flood_keeps_closes_at_cap(self):
+        """The acceptance shape in miniature: a flood 4x the cap never
+        grows a closed ledger past the cap, the queue stays bounded,
+        and the held pile stays empty."""
+        node = make_node(txq_min_cap=8, txq_max_cap=8,
+                         txq_ledgers_in_queue=2, txq_account_cap=10)
+        node.txq.spec_dispatch = None
+        senders = [KeyPair.from_passphrase(f"ov-{i}") for i in range(8)]
+        for s in senders:
+            fund(node, s)
+        node.close_ledger()
+        dests = [KeyPair.from_passphrase(f"ov-dest-{i}").account_id
+                 for i in range(8)]
+        sizes = []
+        for rnd in range(4):
+            for seq in range(rnd * 4 + 1, rnd * 4 + 5):
+                for i, s in enumerate(senders):  # 32/round at cap 8;
+                    # later rounds pay more so the bound evicts, not
+                    # just sheds; disjoint dests keep splices clean
+                    node.submit(payment(s, seq, dests[i], 250 * XRP,
+                                        fee=10 + 5 * rnd + seq))
+            closed, _ = node.ops.accept_ledger()
+            sizes.append(len(list(closed.tx_entries())))
+            assert len(node.txq) <= node.txq.max_size
+            assert len(node.ledger_master.held) == 0
+        assert max(sizes) <= 8
+        j = node.txq.get_json()
+        assert j["evicted"] > 0  # the bound actually bit
+        assert j["promote_spliced"] > 0
+        node.stop()

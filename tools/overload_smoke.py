@@ -1,0 +1,195 @@
+#!/usr/bin/env python3
+"""Overload-admission smoke gate (tier-1).
+
+Boots a standalone node with a small pinned admission cap, floods it at
+~4x that capacity through the full async pipeline, and fails loudly
+unless the admission-control plane ([txq], node/txq.py) holds the line:
+
+- the RPC door stays responsive DURING the flood (server_state / fee
+  round-trips over the real HTTP door under a hard latency bound),
+- no closed ledger ever exceeds the soft cap,
+- the queue drains in fee order (higher-fee senders validate no later
+  than lower-fee senders),
+- the legacy held pile does not grow (queued holds are fee-ordered, not
+  an unbounded side dict),
+- the queue itself stays within its configured bound.
+
+Run: JAX_PLATFORMS=cpu python tools/overload_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+CAP = 16          # pinned soft cap (min_cap == max_cap)
+SENDERS = 16      # one fee tier per sender
+ROUNDS = 4        # rounds of 4x-cap floods
+XRP = 1_000_000
+
+
+def rpc(url: str, method: str, params: dict | None = None) -> dict:
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(
+            {"method": method, "params": [params or {}]}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def main() -> int:
+    from stellard_tpu.node.config import Config
+    from stellard_tpu.node.node import Node
+    from stellard_tpu.protocol.formats import TxType
+    from stellard_tpu.protocol.keys import KeyPair
+    from stellard_tpu.protocol.sfields import sfAmount, sfDestination
+    from stellard_tpu.protocol.stamount import STAmount
+    from stellard_tpu.protocol.sttx import SerializedTransaction
+
+    node = Node(Config(
+        rpc_port=0,
+        txq_min_cap=CAP, txq_max_cap=CAP,
+        txq_ledgers_in_queue=8, txq_account_cap=8,
+    )).setup().serve()
+    failures: list[str] = []
+    try:
+        url = f"http://127.0.0.1:{node.http_server.port}"
+        master = KeyPair.from_passphrase("masterpassphrase")
+        senders = [KeyPair.from_passphrase(f"ov-smoke-{i}")
+                   for i in range(SENDERS)]
+        dests = [KeyPair.from_passphrase(f"ov-smoke-dest-{i}").account_id
+                 for i in range(SENDERS)]
+
+        def payment(kp, seq, dest, drops, fee):
+            tx = SerializedTransaction.build(
+                TxType.ttPAYMENT, kp.account_id, seq, fee,
+                {sfAmount: STAmount.from_drops(drops),
+                 sfDestination: dest},
+            )
+            tx.sign(kp)
+            return tx
+
+        done = threading.Semaphore(0)
+
+        def cb(tx, ter, applied):
+            done.release()
+
+        # fund the senders (escalation-proof fee: funding never queues)
+        for i, s in enumerate(senders):
+            node.ops.submit_transaction(
+                payment(master, i + 1, s.account_id, 2_000 * XRP,
+                        fee=10_000_000), cb,
+            )
+        for _ in senders:
+            done.acquire()
+        node.ops.accept_ledger()
+
+        # flood at 4x the cap: each round submits 4*CAP txs, one fee
+        # tier per sender (fee 10+i), disjoint destinations
+        submitted: dict[bytes, int] = {}  # txid -> sender index
+        rpc_ms: list[float] = []
+        sizes = []  # every close's size — flood rounds AND drain
+        for rnd in range(ROUNDS):
+            for k in range(4):
+                for i, s in enumerate(senders):
+                    seq = rnd * 4 + k + 1
+                    tx = payment(s, seq, dests[i], 250 * XRP, fee=10 + i)
+                    submitted[tx.txid()] = i
+                    node.ops.submit_transaction(tx, cb)
+            for _ in range(4 * SENDERS):
+                done.acquire()
+            # RPC responsiveness DURING the flood
+            for method in ("server_state", "fee"):
+                t0 = time.perf_counter()
+                out = rpc(url, method)
+                dt = (time.perf_counter() - t0) * 1000.0
+                rpc_ms.append(dt)
+                if "error" in str(out)[:200].lower() and "result" not in out:
+                    failures.append(f"RPC {method} errored mid-flood: {out}")
+            closed, _res = node.ops.accept_ledger()
+            # the cap must hold in the very rounds we flood, not just
+            # the easy post-flood drain regime below
+            sizes.append(len(list(closed.tx_entries())))
+            if len(node.ledger_master.held) != 0:
+                failures.append(
+                    f"held pile grew to {len(node.ledger_master.held)} "
+                    f"in round {rnd} — holds must ride the queue"
+                )
+            if len(node.txq) > node.txq.max_size:
+                failures.append(
+                    f"queue exceeded its bound: {len(node.txq)} > "
+                    f"{node.txq.max_size}"
+                )
+
+        if max(rpc_ms) > 2000.0:
+            failures.append(
+                f"RPC latency collapsed under flood: max {max(rpc_ms):.0f} ms"
+            )
+
+        # drain: close until the queue is empty (bounded by retention);
+        # quiesce models the inter-close open window so the deferred
+        # promotion lands between closes
+        landed: dict[bytes, int] = {}  # txid -> ledger seq
+        for _ in range(24):
+            node.txq.quiesce()
+            closed, results = node.ops.accept_ledger()
+            sizes.append(len(list(closed.tx_entries())))
+            for txid in results:
+                if txid in submitted:
+                    landed[txid] = closed.seq
+            if len(node.txq) == 0:
+                break
+        if len(node.txq) != 0:
+            failures.append(f"queue failed to drain: {len(node.txq)} left")
+        if max(sizes) > CAP:
+            failures.append(
+                f"a closed ledger exceeded the soft cap: {max(sizes)} > {CAP}"
+            )
+
+        # fee-order drain: a sender's LAST tx to land is its drain
+        # completion; higher-fee senders must complete no later than
+        # lower-fee senders among fully-landed tiers
+        last_by_sender: dict[int, int] = {}
+        for txid, i in submitted.items():
+            if txid in landed:
+                last_by_sender[i] = max(
+                    last_by_sender.get(i, 0), landed[txid]
+                )
+        tiers = sorted(last_by_sender)  # sender idx == fee order
+        for lo, hi in zip(tiers, tiers[1:]):
+            if last_by_sender[hi] > last_by_sender[lo]:
+                failures.append(
+                    f"fee-order violation: sender {hi} (fee {10 + hi}) "
+                    f"drained at seq {last_by_sender[hi]} AFTER sender "
+                    f"{lo} (fee {10 + lo}) at {last_by_sender[lo]}"
+                )
+
+        j = node.txq.get_json()
+        if j["promoted"] == 0:
+            failures.append("promotion never ran — queue is a black hole")
+    finally:
+        node.stop()
+
+    if failures:
+        print("overload smoke FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print(
+        f"overload smoke OK: {ROUNDS * 4 * SENDERS} txs at 4x cap {CAP}, "
+        f"max close size {max(sizes)}, queue drained in fee order, "
+        f"max mid-flood RPC {max(rpc_ms):.0f} ms, "
+        f"promoted {j['promoted']} evicted {j['evicted']} "
+        f"spliced {j['promote_spliced']}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,17 @@ if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/sealsmoke.py; then
   exit 2
 fi
 
+echo "== overload-admission smoke gate (4x flood -> bounded closes, fee-order drain) =="
+# boots a node with a pinned small admission cap, floods it at 4x that
+# capacity through the full async pipeline, and asserts the RPC door
+# stays responsive, no close exceeds the cap, the queue drains in fee
+# order, and the held pile never grows — overload behavior is CI-gated,
+# not a bench-day anecdote
+if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/overload_smoke.py; then
+  echo "OVERLOAD SMOKE FAILED — admission-control plane is broken" >&2
+  exit 2
+fi
+
 echo "== tier-1 test run (ROADMAP.md command) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
