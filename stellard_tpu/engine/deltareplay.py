@@ -55,7 +55,7 @@ from ..state.shamap import SHAMapItem, TNType
 from ..state.specview import PARENT, SpecView
 from .engine import TransactionEngine, TxParams, _is_tec
 
-__all__ = ["SpecState", "CloseReplay", "HEADER_TYPES"]
+__all__ = ["SpecState", "CloseReplay", "HEADER_TYPES", "execute_record"]
 
 log = logging.getLogger("stellard.deltareplay")
 
@@ -71,7 +71,7 @@ class SpecRecord:
     __slots__ = (
         "raw_ter", "ter", "did_apply", "reads", "succs", "write_items",
         "meta", "fee", "meta_blob", "meta_index_off", "net_deletes",
-        "origin",
+        "origin", "index",
     )
 
     def __init__(self, raw_ter, ter, did_apply, reads, succs, write_items,
@@ -108,6 +108,83 @@ class SpecRecord:
         # promotion) — splice marks carry it so the admission plane's
         # promote_spliced counters stay honest
         self.origin = "submit"
+        # speculation index within the open window: the canonical fold
+        # order for the pre-seal building tree and the Block-STM commit
+        # order of the parallel executor (engine/specexec.py). None
+        # until assigned by SpecState.speculate / the executor.
+        self.index: Optional[int] = None
+
+
+def execute_record(view, tx: SerializedTransaction,
+                   origin: str = "submit") -> SpecRecord:
+    """Run the close-mode engine over ``view`` (which must be inside a
+    ``begin_tx`` bracket) and build the SpecRecord: compacted write set
+    serialized NOW (the splice and the pre-seal building tree share
+    these exact item objects), net-delete classification, and the
+    metadata index-span pin.
+
+    The ONE record builder: the serial submit-path speculation, the
+    parallel executor's in-process workers, and its process workers all
+    run this exact code, which is what makes their records byte-equal.
+    Exceptions propagate — the caller decides whether a failure poisons
+    the whole overlay (serial) or just retries the task (parallel)."""
+    txid = tx.txid()
+    engine = TransactionEngine(view)
+    ter, did_apply = engine.apply_transaction(tx, TxParams.NONE)
+    reads, succs, writes = view.end_tx()
+    meta = view.parsed_metas.pop(txid, None)
+    # compact + serialize the write set NOW (the submit window),
+    # pinning each SLE as its item's parsed mirror — the close
+    # splices these exact objects, moving the per-write
+    # serialization cost out of the close window entirely
+    compact: dict[bytes, Optional[object]] = {}
+    ever_set: set[bytes] = set()
+    for k, sle in writes:
+        compact[k] = sle
+        if sle is not None:
+            ever_set.add(k)
+    write_items = []
+    net_deletes = set()
+    for k, sle in compact.items():
+        if sle is None:
+            write_items.append((k, None))
+            if k in ever_set:
+                net_deletes.add(k)
+        else:
+            item = SHAMapItem(k, sle.serialize())
+            item.parsed = sle
+            write_items.append((k, item))
+    rec = SpecRecord(
+        raw_ter=engine.last_raw_ter if engine.last_raw_ter
+        is not None else ter,
+        ter=ter,
+        did_apply=did_apply,
+        reads=reads,
+        succs=succs,
+        write_items=write_items,
+        meta=meta,
+        fee=tx.fee.mantissa if did_apply else 0,
+    )
+    if meta is not None:
+        # pin the index span: serialize with index 0 then 1 and
+        # require the diff to be EXACTLY the u32's low byte —
+        # anything else keeps the re-serialize slow path
+        meta[sfTransactionIndex] = 0
+        b0 = meta.serialize()
+        meta[sfTransactionIndex] = 1
+        b1 = meta.serialize()
+        if len(b0) == len(b1):
+            diffs = [i for i, (a, b) in enumerate(zip(b0, b1))
+                     if a != b]
+            if (len(diffs) == 1 and diffs[0] >= 3
+                    and b0[diffs[0] - 3 : diffs[0] + 1]
+                    == b"\x00\x00\x00\x00"
+                    and b1[diffs[0]] == 1):
+                rec.meta_blob = b0
+                rec.meta_index_off = diffs[0] - 3
+    rec.net_deletes = frozenset(net_deletes)
+    rec.origin = origin
+    return rec
 
 
 class SpecState:
@@ -126,6 +203,18 @@ class SpecState:
         # fold failure (the close then runs the full seal — never forked)
         self.building = None
         self.absorbed: dict[bytes, object] = {}  # key -> item|None folded
+        # speculation-index authority for this open window: the serial
+        # path and the parallel executor's dispatch both allocate from
+        # it (under the chain lock), so fold/commit order is one total
+        # order however the records were produced
+        self.next_index = 0
+        self._folded_max = -1
+
+    def alloc_index(self) -> int:
+        """Next speculation index (caller holds the chain lock)."""
+        i = self.next_index
+        self.next_index += 1
+        return i
 
     def attach_building(self, state_root, hash_batch) -> None:
         """Arm the pre-seal building tree over the parent state root."""
@@ -139,9 +228,22 @@ class SpecState:
         """Merge one record's write items into the building tree; -> ops
         folded (0 when the tree is unarmed or the record wrote nothing).
         Any fold failure disarms the building tree for this open window
-        — the close simply runs its normal full seal."""
+        — the close simply runs its normal full seal.
+
+        Ordering contract: folds must arrive in strictly increasing
+        speculation-index order — the building tree is "parent state
+        plus speculated writes IN ORDER", and an out-of-order fold
+        (a parallel-scheduler bug) would silently bake a stale value
+        into the pre-seal tree. That bug class must fail LOUDLY here,
+        before the bulk merge, not surface as a close-time hash
+        divergence."""
         if self.building is None or not rec.did_apply or not rec.write_items:
             return 0
+        if rec.index is not None and rec.index <= self._folded_max:
+            raise AssertionError(
+                f"fold_building out of order: index {rec.index} after "
+                f"{self._folded_max} — scheduler commit-order bug"
+            )
         try:
             self.building.bulk_update(
                 [it for _k, it in rec.write_items if it is not None],
@@ -156,79 +258,35 @@ class SpecState:
             self.building = None
             self.absorbed = {}
             return 0
+        if rec.index is not None:
+            self._folded_max = rec.index
         for k, it in rec.write_items:
             self.absorbed[k] = it
         return len(rec.write_items)
 
-    def speculate(self, tx: SerializedTransaction,
-                  origin: str = "submit") -> None:
+    def speculate(self, tx: SerializedTransaction, origin: str = "submit",
+                  index: Optional[int] = None) -> Optional["SpecRecord"]:
         """Close-mode dry run of an open-accepted tx; records the outcome
         and folds its writes into the overlay for successors. `origin`
         is "submit" for the open-accept path and "promote" for the
-        TxQ's deferred queue-aware speculation."""
+        TxQ's deferred queue-aware speculation. `index` pins the
+        speculation index (the parallel executor's serial-fallback path
+        commits out-of-band and already holds the task's index); serial
+        callers let it allocate. Returns the record that executed (also
+        when it was not retained) so the executor's commit thread can
+        ship its write set to process workers — serial callers ignore
+        it."""
         if self.disabled or tx.tx_type in HEADER_TYPES:
-            return
+            return None
         txid = tx.txid()
         self.view.begin_tx(txid)
         try:
-            engine = TransactionEngine(self.view)
-            ter, did_apply = engine.apply_transaction(tx, TxParams.NONE)
-            reads, succs, writes = self.view.end_tx()
-            meta = self.view.parsed_metas.pop(txid, None)
-            if did_apply and meta is None:
-                return  # commit tail didn't complete; keep no record
-            # compact + serialize the write set NOW (the submit window),
-            # pinning each SLE as its item's parsed mirror — the close
-            # splices these exact objects, moving the per-write
-            # serialization cost out of the close window entirely
-            compact: dict[bytes, Optional[object]] = {}
-            ever_set: set[bytes] = set()
-            for k, sle in writes:
-                compact[k] = sle
-                if sle is not None:
-                    ever_set.add(k)
-            write_items = []
-            net_deletes = set()
-            for k, sle in compact.items():
-                if sle is None:
-                    write_items.append((k, None))
-                    if k in ever_set:
-                        net_deletes.add(k)
-                else:
-                    item = SHAMapItem(k, sle.serialize())
-                    item.parsed = sle
-                    write_items.append((k, item))
-            rec = SpecRecord(
-                raw_ter=engine.last_raw_ter if engine.last_raw_ter
-                is not None else ter,
-                ter=ter,
-                did_apply=did_apply,
-                reads=reads,
-                succs=succs,
-                write_items=write_items,
-                meta=meta,
-                fee=tx.fee.mantissa if did_apply else 0,
-            )
-            if meta is not None:
-                # pin the index span: serialize with index 0 then 1 and
-                # require the diff to be EXACTLY the u32's low byte —
-                # anything else keeps the re-serialize slow path
-                meta[sfTransactionIndex] = 0
-                b0 = meta.serialize()
-                meta[sfTransactionIndex] = 1
-                b1 = meta.serialize()
-                if len(b0) == len(b1):
-                    diffs = [i for i, (a, b) in enumerate(zip(b0, b1))
-                             if a != b]
-                    if (len(diffs) == 1 and diffs[0] >= 3
-                            and b0[diffs[0] - 3 : diffs[0] + 1]
-                            == b"\x00\x00\x00\x00"
-                            and b1[diffs[0]] == 1):
-                        rec.meta_blob = b0
-                        rec.meta_index_off = diffs[0] - 3
-            rec.net_deletes = frozenset(net_deletes)
-            rec.origin = origin
+            rec = execute_record(self.view, tx, origin)
+            if rec.did_apply and rec.meta is None:
+                return rec  # commit tail didn't complete; keep no record
+            rec.index = self.alloc_index() if index is None else index
             self.records[txid] = rec
+            return rec
         except Exception:  # noqa: BLE001 — a half-applied overlay can't
             # be trusted for ANY later record; the close falls back whole
             log.exception(
@@ -236,6 +294,7 @@ class SpecState:
                 "this ledger", txid.hex()[:16],
             )
             self.disabled = True
+            return None
 
 
 class CloseReplay:

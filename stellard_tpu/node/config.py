@@ -112,6 +112,25 @@ class Config:
     txq_max_cap: int = 100_000        # soft-cap ceiling
     txq_target_close_ms: float = 2000.0  # close budget the cap targets
 
+    # -- parallel speculation ([spec]) -------------------------------------
+    # workers=N (N>1): submitted and TxQ-promoted transactions execute
+    # speculatively across an N-worker Block-STM pool with optimistic
+    # read validation and ordered commit at the chain's speculation
+    # index (engine/specexec.py); the close drains the window before
+    # splicing. workers=1 (default) is the kill-switch: the serial
+    # inline speculation path, byte-for-byte. mode selects the worker
+    # transport: "process" (fork workers around the GIL — the scaling
+    # path), "thread" (in-process, GIL-bound — the concurrency-hammer
+    # configuration), "manual" (no workers; tests drive seeded
+    # schedules). max_retries bounds optimistic re-execution before the
+    # committing thread falls back to a serial in-order apply;
+    # drain_timeout_s bounds how long a close waits on the pool before
+    # completing the window serially itself.
+    spec_workers: int = 1
+    spec_mode: str = "process"
+    spec_max_retries: int = 3
+    spec_drain_timeout_s: float = 10.0
+
     # -- ledger close ([close]) --------------------------------------------
     # delta_replay=1: the open-ledger accept also executes the tx once in
     # close mode against a speculative overlay, recording its read/write
@@ -246,6 +265,22 @@ class Config:
         ):
             if key in txq:
                 setattr(cfg, attr, conv(txq[key]))
+        spec = _kv(s.get("spec", []))
+        if "workers" in spec:
+            cfg.spec_workers = int(spec["workers"])
+        if "mode" in spec:
+            cfg.spec_mode = spec["mode"].lower()
+            if cfg.spec_mode not in ("process", "thread", "manual"):
+                # a parallelism toggle must not fail open into an
+                # unintended transport
+                raise ValueError(
+                    f"[spec] mode must be process/thread/manual, "
+                    f"got {cfg.spec_mode!r}"
+                )
+        if "max_retries" in spec:
+            cfg.spec_max_retries = int(spec["max_retries"])
+        if "drain_timeout_s" in spec:
+            cfg.spec_drain_timeout_s = float(spec["drain_timeout_s"])
         close = _kv(s.get("close", []))
         if "delta_replay" in close:
             cfg.close_delta_replay = close["delta_replay"].lower() not in (

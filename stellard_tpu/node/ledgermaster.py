@@ -21,7 +21,7 @@ from ..node.hashrouter import SF_SIGGOOD
 from ..protocol.sttx import SerializedTransaction
 from ..protocol.ter import TER
 from ..state.ledger import Ledger
-from .metrics import LatencyHist
+from .metrics import AtomicCounters, LatencyHist
 from .tracer import STAGE_BOUNDS, get_tracer
 
 __all__ = ["LedgerMaster", "CanonicalTXSet", "LEDGER_TOTAL_PASSES"]
@@ -123,10 +123,20 @@ class LedgerMaster:
         # a SpecView, and the close splices the recorded delta when the
         # read set still validates (engine/deltareplay.py)
         self.delta_replay = True
-        self.delta_stats = {
-            "closes": 0, "spliced": 0, "fallback": 0, "invalidated": 0,
-        }
+        # close-info counters live in one AtomicCounters bundle: the
+        # close path, the TxQ's deferred promotion job, and the parallel
+        # executor's commit thread all feed close-adjacent counters from
+        # their own threads, and bare `dict +=` would lose updates
+        self.delta_stats = AtomicCounters(
+            "closes", "spliced", "fallback", "invalidated",
+        )
         self.last_close: dict = {}
+        # parallel speculative executor ([spec] workers=N, engine/
+        # specexec.py): when active, _speculate_open dispatches to the
+        # worker pool instead of executing inline, and the close drains
+        # the window before consuming the records. None/inactive keeps
+        # the serial inline path byte-for-byte.
+        self.spec_executor = None
         # incremental O(dirty) seal ([tree] incremental, default on):
         # speculated writes fold into a pre-seal "building" tree on the
         # SpecState, and a background drainer hashes its dirty subtrees
@@ -144,6 +154,8 @@ class LedgerMaster:
         self._drain_hist = LatencyHist(bounds=STAGE_BOUNDS, interpolate=True)
         self._drain_cv = threading.Condition()
         self._drain_pending = 0
+        self._drain_kick = False
+        self._drain_busy = False
         self._drainer: Optional[threading.Thread] = None
         self._drain_stop = False
         # per-close stage latency histograms (ms): apply pass, seal
@@ -311,6 +323,25 @@ class LedgerMaster:
                 )
         if tx.txid() in spec.records:
             return
+        ex = self.spec_executor
+        if ex is not None and ex.active:
+            # parallel plane: dispatch to the worker pool (O(1) under
+            # the chain lock — the execution itself runs on workers and
+            # commits in index order off this thread). Folding into the
+            # building tree rides the commit step via _note_fold.
+            session = getattr(spec, "_exec_session", None)
+            if session is None and ex.can_accept:
+                session = spec._exec_session = ex.begin_window(
+                    spec, open_ledger, on_fold=self._note_fold,
+                )
+            if session is not None:
+                if ex.dispatch(session, tx, origin):
+                    return
+                # executor refused (stopping / pool dead): seal the
+                # window so no late commit races the serial path, then
+                # fall through
+                ex.end_window(session, timeout=ex.drain_timeout_s)
+                spec._exec_session = None
         with self.tracer.span("open.speculate", "apply",
                               txid=tx.txid(), origin=origin):
             spec.speculate(tx, origin=origin)
@@ -322,6 +353,15 @@ class LedgerMaster:
 
     # -- incremental-seal background drain --------------------------------
 
+    def _ensure_drainer_locked(self) -> None:
+        """Lazily start the seal-drain thread; caller holds _drain_cv."""
+        if self._drainer is None and not self._drain_stop:
+            self._drainer = threading.Thread(
+                target=self._drain_loop, name="seal-drain",
+                daemon=True,
+            )
+            self._drainer.start()
+
     def _note_fold(self, n_ops: int) -> None:
         """Count folded writes; past the drain batch, wake the drainer to
         pre-hash the building tree's dirty subtrees off this thread.
@@ -332,13 +372,33 @@ class LedgerMaster:
         with self._drain_cv:
             self._drain_pending += n_ops
             if self._drain_pending >= self.seal_drain_batch:
-                if self._drainer is None and not self._drain_stop:
-                    self._drainer = threading.Thread(
-                        target=self._drain_loop, name="seal-drain",
-                        daemon=True,
-                    )
-                    self._drainer.start()
+                self._ensure_drainer_locked()
                 self._drain_cv.notify()
+
+    def kick_seal_drain(self, wait_s: float = 0.0) -> None:
+        """Flush the sub-batch fold residual to the background pre-hash
+        thread NOW (the parallel executor's pre-close advisory drain
+        lands folds in a burst right before the close — without a kick
+        they would sit below the drain-batch threshold and get hashed
+        inside the close's lock window instead of outside it). With
+        ``wait_s``, block up to that long for the drainer to go idle so
+        a caller about to close sees the pre-hash actually finished —
+        still outside any lock, and bounded."""
+        if self.seal_drain_batch < 1:
+            return
+        with self._drain_cv:
+            if self._drain_pending > 0:
+                self._ensure_drainer_locked()
+                self._drain_kick = True
+                self._drain_cv.notify()
+            if wait_s > 0:
+                deadline = time.perf_counter() + wait_s
+                while (self._drain_pending > 0 or self._drain_kick
+                       or self._drain_busy) and not self._drain_stop:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._drain_cv.wait(min(remaining, 0.05))
 
     def _drain_loop(self) -> None:
         from ..state.shamap import compute_hashes
@@ -352,12 +412,15 @@ class LedgerMaster:
                 # the thread (pending only grows via _note_fold, which
                 # gates on the same knob), never spin it
                 while (self._drain_pending < max(1, self.seal_drain_batch)
+                       and not self._drain_kick
                        and not self._drain_stop):
                     self._drain_cv.wait(timeout=1.0)
                 if self._drain_stop:
                     return
                 todo = self._drain_pending
                 self._drain_pending = 0
+                self._drain_kick = False
+                self._drain_busy = True
             # snapshot the building tree UNDER the chain lock, hash it
             # OUTSIDE: the tree is persistent, so hashing a snapshot
             # root only fills write-once _hash slots on nodes the
@@ -370,6 +433,9 @@ class LedgerMaster:
                 root = building.root if building is not None else None
                 hasher = building.hash_batch if building is not None else None
             if root is None:
+                with self._drain_cv:
+                    self._drain_busy = False
+                    self._drain_cv.notify_all()
                 continue
             t0 = time.perf_counter()
             try:
@@ -390,11 +456,16 @@ class LedgerMaster:
                     n = compute_hashes(root, hasher)
             except Exception:  # noqa: BLE001 — pre-hashing is advisory;
                 # the close's full seal recomputes whatever is missing
+                with self._drain_cv:
+                    self._drain_busy = False
+                    self._drain_cv.notify_all()
                 continue
             t1 = time.perf_counter()
             with self._drain_cv:
                 self.tree_stats["drains"] += 1
                 self.tree_stats["drained_nodes"] += n
+                self._drain_busy = False
+                self._drain_cv.notify_all()
             self._drain_hist.record((t1 - t0) * 1000.0)
             self.tracer.complete("seal.incremental", "seal", t0, t1,
                                  nodes=n)
@@ -513,6 +584,7 @@ class LedgerMaster:
                 getattr(open_ledger, "_spec_state", None)
                 if self.delta_replay else None
             )
+            self._drain_spec(spec)
             results = self._apply_transactions(new_lcl, txset, spec=spec)
             t_apply = time.perf_counter()
 
@@ -566,6 +638,7 @@ class LedgerMaster:
                 getattr(open_ledger, "_spec_state", None)
                 if self.delta_replay else None
             )
+            self._drain_spec(spec)
             results = self._apply_transactions(new_lcl, txset, spec=spec)
             t_apply = time.perf_counter()
 
@@ -623,6 +696,26 @@ class LedgerMaster:
                 )
                 if ter == TER.terPRE_SEQ:
                     self._hold(tx, expire)
+
+    def _drain_spec(self, spec) -> None:
+        """Seal the open window's parallel-speculation session before
+        the close consumes its records: every dispatched task commits
+        (in-flight work finishes through the pool; a wedged pool's
+        remainder is executed serially in index order on this thread —
+        the close-side fallback batch also drains through the executor).
+        No-op on the serial path. Caller holds the chain lock; the
+        commit machinery never takes it, so waiting here cannot
+        deadlock."""
+        ex = self.spec_executor
+        session = getattr(spec, "_exec_session", None) if spec else None
+        if ex is None or session is None:
+            return
+        t0 = time.perf_counter()
+        ex.end_window(session)
+        spec._exec_session = None
+        self.tracer.complete("spec.drain", "close", t0,
+                             time.perf_counter(),
+                             dispatched=len(session.tasks))
 
     def _hold_or_queue(self, tx: SerializedTransaction) -> None:
         """terPRE_SEQ disposition: the fee-ordered queue when the TxQ is
@@ -809,9 +902,12 @@ class LedgerMaster:
             # queue-aware speculation honesty: which of the txs the
             # queue promoted into this window spliced vs fell back
             self.txq.note_close_classes(replay.classes())
-        self.delta_stats["closes"] += 1
-        for k in ("spliced", "fallback", "invalidated"):
-            self.delta_stats[k] += c[k]
+        # one atomic multi-key bump: concurrent readers (RPC threads,
+        # the metrics collector) never see a torn closes/spliced pair
+        self.delta_stats.add_many(
+            closes=1, spliced=c["spliced"], fallback=c["fallback"],
+            invalidated=c["invalidated"],
+        )
         with self._drain_cv:
             self.tree_stats["bulk_merges"] += c.get("bulk_merges", 0)
             self.tree_stats["bulk_merged_keys"] += c.get(
@@ -852,11 +948,13 @@ class LedgerMaster:
         with self._lock:
             out = {
                 "enabled": self.delta_replay,
-                **self.delta_stats,
+                **self.delta_stats.snapshot(),
                 "last_close": dict(self.last_close),
             }
             if self.close_stage_hist["total"].count:
                 for stage, hist in self.close_stage_hist.items():
                     out[f"{stage}_p50_ms"] = hist.quantile(0.5)
                     out[f"{stage}_p90_ms"] = hist.quantile(0.9)
+        if self.spec_executor is not None:
+            out["spec"] = self.spec_executor.get_json()
         return out

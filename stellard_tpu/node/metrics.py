@@ -23,10 +23,62 @@ __all__ = [
     "Gauge",
     "Meter",
     "LatencyHist",
+    "AtomicCounters",
     "CollectorManager",
     "NullCollector",
     "StatsDCollector",
 ]
+
+
+class AtomicCounters:
+    """A named-counter bundle under ONE lock.
+
+    The close-info counters (spliced/fallback/invalidated) and the
+    parallel-speculation counters are incremented from several threads —
+    the close path, the TxQ's deferred promotion job, and the executor's
+    commit thread — so per-dict `+=` on a plain dict would lose updates.
+    One shared lock for the whole bundle keeps multi-key updates (e.g. a
+    commit bumping committed AND retries) atomic as a group, which a
+    per-counter lock could not."""
+
+    __slots__ = ("_lock", "_vals")
+
+    def __init__(self, *names, **initial):
+        self._lock = threading.Lock()
+        self._vals: dict = {name: 0 for name in names}
+        self._vals.update(initial)
+
+    def add(self, name: str, n=1) -> None:
+        with self._lock:
+            self._vals[name] = self._vals.get(name, 0) + n
+
+    def add_many(self, **deltas) -> None:
+        """Atomically apply several deltas (one lock hold)."""
+        with self._lock:
+            for name, n in deltas.items():
+                self._vals[name] = self._vals.get(name, 0) + n
+
+    def set(self, name: str, value) -> None:
+        with self._lock:
+            self._vals[name] = value
+
+    def get(self, name: str):
+        with self._lock:
+            return self._vals.get(name, 0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._vals)
+
+    def __getitem__(self, name: str):
+        return self.get(name)
+
+    def keys(self):
+        """Mapping protocol (with __getitem__): ``dict(counters)`` and
+        ``**counters`` both work, so an AtomicCounters can drop in where
+        a plain stats dict used to live."""
+        with self._lock:
+            return list(self._vals)
 
 
 class LatencyHist:

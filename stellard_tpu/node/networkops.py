@@ -380,6 +380,22 @@ class NetworkOPs:
         """Close the open ledger immediately (standalone `ledger_accept`
         admin RPC; the JS integration tests drive closes this way,
         SURVEY §4.3)."""
+        ex = getattr(self.lm, "spec_executor", None)
+        if ex is not None and ex.active:
+            # advisory pre-drain OUTSIDE the close lock: let in-flight
+            # worker speculation commit while submissions can still
+            # interleave, so the in-lock drain inside close_and_advance
+            # is (usually) a no-op and the lock hold stays at splice
+            # cost. Never forces — the close-side drain owns that.
+            spec = getattr(self.lm.current, "_spec_state", None)
+            session = getattr(spec, "_exec_session", None) if spec else None
+            if session is not None:
+                ex.drain(session, timeout=1.0, force=False)
+                # the drain just landed a burst of building-tree folds;
+                # hash them on the background drainer BEFORE the close
+                # takes the lock, not inside its seal window (bounded
+                # wait — still outside every lock)
+                self.lm.kick_seal_drain(wait_s=0.25)
         with self.master_lock:
             if self.fee_track is not None:
                 # refresh before close: held-tx retries inside

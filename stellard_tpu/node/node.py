@@ -522,6 +522,22 @@ class Node:
         # automatic fallback (incremental=0 is the kill-switch)
         self.ledger_master.incremental_seal = cfg.tree_incremental_seal
         self.ledger_master.seal_drain_batch = cfg.tree_drain_batch
+        # [spec]: parallel speculative executor — workers>1 dispatches
+        # open-window speculation to a Block-STM worker pool with
+        # optimistic validation and ordered commit (engine/specexec.py);
+        # workers=1 keeps the serial inline path byte-for-byte
+        from ..engine.specexec import SpecExecutor
+
+        self.spec_executor = SpecExecutor(
+            workers=cfg.spec_workers, mode=cfg.spec_mode,
+            max_retries=cfg.spec_max_retries, tracer=self.tracer,
+            drain_timeout_s=cfg.spec_drain_timeout_s,
+        )
+        if self.spec_executor.active:
+            # fork the process workers NOW, before the window machinery
+            # is hot (fewer live threads at fork time)
+            self.spec_executor.start()
+        self.ledger_master.spec_executor = self.spec_executor
         # [txq]: the ledger chain promotes queued txs at _open_next and
         # the queue's deferred (off-close-path) speculation rides the
         # job queue; in networked mode the overlay's shared chain gets
@@ -775,6 +791,17 @@ class Node:
         # span-derived per-stage latency percentiles (trace.<stage>.p50_ms
         # et al.): the unified latency surface the tracing plane feeds
         self.collector.hook("trace", self.tracer.statsd_hook)
+        if self.spec_executor.active:
+            self.collector.hook(
+                "spec",
+                lambda: {
+                    k: v
+                    for k, v in self.spec_executor.counters.snapshot()
+                    .items()
+                    if k in ("dispatched", "committed", "retries",
+                             "validation_aborts", "serial_fallbacks")
+                },
+            )
         self.collector.hook(
             "delta_replay",
             # snapshot via delta_replay_json: it takes the chain lock, so
@@ -876,6 +903,9 @@ class Node:
     def stop(self) -> None:
         self._running.clear()
         self.load_manager.stop()
+        # the executor first: any open speculation window completes
+        # serially before the chain machinery below winds down
+        self.spec_executor.stop()
         self.ledger_master.stop_seal_drainer()
         if self.overlay is not None:
             stop = getattr(self.overlay, "stop", None)

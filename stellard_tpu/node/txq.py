@@ -674,10 +674,16 @@ class TxQ:
         lm = self._lm
         if lm is None:
             return
+        ex = getattr(lm, "spec_executor", None)
+        # with the parallel executor active, _speculate_open is an O(1)
+        # dispatch instead of a full close-mode execution, so a much
+        # larger batch fits under one chain-lock hold and the worker
+        # pool fills in one burst
+        step = 128 if ex is not None and ex.active else 16
         while True:
             with self._lock:
-                batch = self._pending_spec[:16]
-                del self._pending_spec[:16]
+                batch = self._pending_spec[:step]
+                del self._pending_spec[:step]
             if not batch:
                 return
             with lm._lock:

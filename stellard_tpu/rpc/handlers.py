@@ -326,6 +326,11 @@ def do_server_state(ctx: Context) -> dict:
     # batched state-tree commit plane: merges, pre-hash drains, seal
     # adoptions (aggregate counters only — no per-tx detail to gate)
     state["tree"] = node.ledger_master.tree_json()
+    spec_ex = getattr(node, "spec_executor", None)
+    if spec_ex is not None:
+        # parallel speculation plane: worker pool + scheduler counters
+        # (dispatched/committed/retries/aborts — aggregate only)
+        state["spec"] = spec_ex.get_json()
     txq = getattr(node, "txq", None)
     if txq is not None:
         # admission-control plane: queue depth, soft cap, escalated
@@ -406,6 +411,10 @@ def do_get_counts(ctx: Context) -> dict:
     # batched state-tree commit plane: bulk merges, background pre-hash
     # drains, seal adoptions (node/ledgermaster.py tree_json)
     out["tree"] = node.ledger_master.tree_json()
+    spec_ex = getattr(node, "spec_executor", None)
+    if spec_ex is not None:
+        # parallel speculation plane (engine/specexec.py)
+        out["spec"] = spec_ex.get_json()
     # from_store inner-node memo (catch-up fetch path re-parse saver)
     from ..state.shamap import inner_node_cache
 

@@ -1,0 +1,176 @@
+#!/usr/bin/env python3
+"""Parallel-speculation equivalence smoke gate (tools/tier1.sh).
+
+Boots a standalone node with the Block-STM worker pool ON
+([spec] workers=4, process transport), floods ~200 payments through the
+full async pipeline closing every 50, then runs the SAME workload with
+the SAME pinned close-time schedule through a workers=1 (serial inline
+speculation) node. Every close must be byte-identical between the two
+runs — ledger hash AND per-tx results — and the parallel run's splice
+rate must not regress: the pool's job is to produce the same records
+the serial path would have, so a close that falls back more often under
+the pool is a scheduler bug even when the hashes happen to agree.
+
+The gate also refuses to pass vacuously: the parallel run must actually
+have dispatched through the pool and committed optimistically (not
+completed every window via the forced-serial drain).
+
+Exit 0 on per-close byte equality + splice parity; 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def run_flood(workers: int, n_txs: int, chunk: int = 50):
+    """One standalone-node flood; -> per-close evidence + counters."""
+    import hashlib
+    import threading
+
+    from stellard_tpu.node.config import Config
+    from stellard_tpu.node.node import Node
+    from stellard_tpu.protocol.formats import TxType
+    from stellard_tpu.protocol.keys import KeyPair
+    from stellard_tpu.protocol.sfields import sfAmount, sfDestination
+    from stellard_tpu.protocol.stamount import STAmount
+    from stellard_tpu.protocol.sttx import SerializedTransaction
+
+    node = Node(Config(spec_workers=workers, spec_mode="process")).setup()
+    closes = []
+    try:
+        # deterministic close-time schedule: the two runs happen
+        # seconds apart and must close on identical times to be
+        # byte-comparable
+        closes_done = [0]
+        node.ops.network_time = lambda: 900_000_000 + closes_done[0] * 30
+
+        master = KeyPair.from_passphrase("masterpassphrase")
+        senders = [KeyPair.from_passphrase(f"spec-smoke-s{i}")
+                   for i in range(8)]
+        dests = [KeyPair.from_passphrase(f"spec-smoke-d{i}").account_id
+                 for i in range(8)]
+        done = threading.Semaphore(0)
+
+        def cb(tx, ter, applied):
+            done.release()
+
+        def submit_all(txs):
+            for tx in txs:
+                node.ops.submit_transaction(tx, cb)
+            for _ in txs:
+                done.acquire()
+
+        # setup (unmeasured, still compared): fund the senders
+        fund = []
+        for i, s in enumerate(senders):
+            tx = SerializedTransaction.build(
+                TxType.ttPAYMENT, master.account_id, 1 + i, 10,
+                {sfAmount: STAmount.from_drops(5_000_000_000),
+                 sfDestination: s.account_id},
+            )
+            tx.sign(master)
+            fund.append(tx)
+        submit_all(fund)
+        node.ops.accept_ledger()
+        closes_done[0] += 1
+
+        seqs = {s.account_id: 1 for s in senders}
+        built = 0
+        lm = node.ledger_master
+        while built < n_txs:
+            txs = []
+            for _ in range(min(chunk, n_txs - built)):
+                s = senders[built % len(senders)]
+                tx = SerializedTransaction.build(
+                    TxType.ttPAYMENT, s.account_id, seqs[s.account_id],
+                    10,
+                    {sfAmount: STAmount.from_drops(1_000_000),
+                     sfDestination: dests[built % len(dests)]},
+                )
+                tx.sign(s)
+                seqs[s.account_id] += 1
+                txs.append(tx)
+                built += 1
+            before = lm.delta_stats.snapshot()
+            submit_all(txs)
+            closed, results = node.ops.accept_ledger()
+            closes_done[0] += 1
+            after = lm.delta_stats.snapshot()
+            digest = hashlib.sha256()
+            for txid in sorted(results):
+                digest.update(txid + bytes([int(results[txid]) & 0xFF]))
+            closes.append({
+                "seq": closed.seq,
+                "hash": closed.hash().hex(),
+                "results": digest.hexdigest(),
+                "n": len(results),
+                "spliced": after["spliced"] - before["spliced"],
+                "fallback": after["fallback"] - before["fallback"],
+            })
+        spec = node.spec_executor.get_json()
+        return closes, spec
+    finally:
+        node.stop()
+
+
+def run_smoke(n_txs: int = 200) -> int:
+    par_closes, par_spec = run_flood(4, n_txs)
+    ser_closes, _ = run_flood(1, n_txs)
+
+    bad = 0
+    if len(par_closes) != len(ser_closes):
+        print(
+            f"spec smoke: close count diverged — parallel "
+            f"{len(par_closes)} vs serial {len(ser_closes)}",
+            file=sys.stderr,
+        )
+        return 1
+    for p, s in zip(par_closes, ser_closes):
+        if p["hash"] != s["hash"] or p["results"] != s["results"]:
+            print(
+                f"spec smoke: ledger {p['seq']} DIVERGED — workers=4 "
+                f"{p['hash'][:16]} vs serial {s['hash'][:16]}",
+                file=sys.stderr,
+            )
+            bad += 1
+        if p["spliced"] < s["spliced"]:
+            print(
+                f"spec smoke: ledger {p['seq']} splice-rate REGRESSED — "
+                f"workers=4 spliced {p['spliced']}/{p['n']} vs serial "
+                f"{s['spliced']}/{s['n']}", file=sys.stderr,
+            )
+            bad += 1
+    if bad:
+        return 1
+
+    # anti-vacuity: the pool must have done the speculating
+    if par_spec["dispatched"] < n_txs:
+        print(
+            f"spec smoke: pool only saw {par_spec['dispatched']}/{n_txs} "
+            f"txs — the parallel path was not exercised", file=sys.stderr,
+        )
+        return 1
+    if par_spec["serial_fallbacks"] > n_txs // 2:
+        print(
+            f"spec smoke: {par_spec['serial_fallbacks']} serial fallbacks "
+            f"out of {n_txs} — the pool is not committing optimistically",
+            file=sys.stderr,
+        )
+        return 1
+    spliced = sum(c["spliced"] for c in par_closes)
+    total = sum(c["n"] for c in par_closes)
+    print(
+        f"spec smoke OK: {len(par_closes)} closes byte-identical to the "
+        f"serial shadow at workers=4 (spliced={spliced}/{total} "
+        f"committed={par_spec['committed']} retries={par_spec['retries']} "
+        f"aborts={par_spec['validation_aborts']} "
+        f"serial_fallbacks={par_spec['serial_fallbacks']} "
+        f"forced_drains={par_spec['drains_forced']})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+    sys.exit(run_smoke(n))

@@ -38,6 +38,17 @@ if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/sealsmoke.py; then
   exit 2
 fi
 
+echo "== parallel-speculation smoke gate (workers=4 flood vs serial shadow) =="
+# boots a node with the Block-STM worker pool on (workers=4, process
+# transport), floods 200 txs through the full async pipeline, then
+# replays the identical workload through a workers=1 node: every close
+# must match byte-for-byte and the splice rate must not regress — the
+# parallel plane's byte-identity invariant is CI-gated per close
+if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/specsmoke.py; then
+  echo "SPEC SMOKE FAILED — parallel speculation diverged from serial" >&2
+  exit 2
+fi
+
 echo "== overload-admission smoke gate (4x flood -> bounded closes, fee-order drain) =="
 # boots a node with a pinned small admission cap, floods it at 4x that
 # capacity through the full async pipeline, and asserts the RPC door
