@@ -190,6 +190,285 @@ int64_t cpplog_get(void* handle, const uint8_t* key, uint8_t* out,
 
 uint64_t cpplog_count(void* handle) { return ((Store*)handle)->count; }
 
+// iterate every live record through a callback (ctypes CFUNCTYPE on the
+// Python side). Deletion/export/crash-recovery audits need iteration on
+// every durable backend; the index already holds every key, so this is
+// one pass over the slots with one read per record. A nonzero callback
+// return stops the scan early. Returns records visited, or -1 on a read
+// error (a record the index points at that cannot be read back is
+// corruption, not end-of-data).
+typedef int (*cpplog_iter_cb)(void* ctx, const uint8_t* key, uint8_t type,
+                              const uint8_t* blob, uint32_t len);
+
+int64_t cpplog_iterate(void* handle, cpplog_iter_cb cb, void* ctx) {
+  Store* s = (Store*)handle;
+  if (!s->f) return -1;
+  if (fflush(s->f) != 0) return -1;  // buffered appends must be visible
+  std::vector<uint8_t> buf(65536);
+  int64_t visited = 0;
+  for (const Slot& sl : s->slots) {
+    if (!sl.offset) continue;
+    uint64_t body = sl.offset - 1;
+    fseek(s->f, (long)(body - 37), SEEK_SET);
+    uint8_t hdr[5];
+    if (!read_exact(s->f, hdr, 5)) return -1;
+    uint32_t body_len;
+    memcpy(&body_len, hdr, 4);
+    if (body_len < 1) return -1;
+    if (body_len > buf.size()) buf.resize(body_len);
+    fseek(s->f, (long)body, SEEK_SET);
+    if (!read_exact(s->f, buf.data(), body_len)) return -1;
+    visited++;
+    if (cb(ctx, sl.key, buf[0], buf.data() + 1, body_len - 1) != 0) break;
+  }
+  fseek(s->f, 0, SEEK_END);
+  return visited;
+}
+
+// ---------------------------------------------------------------------------
+// segstore: native primitives for the segmented log-structured backend
+// (stellard_tpu/nodestore/segstore.py). The Python side owns segments,
+// durability, checkpoint files and compaction policy; the C side owns
+// the three O(store)/O(batch) inner loops a 1M-node store cannot afford
+// in the interpreter: the in-memory key index, the one-call append-image
+// pack from the flat-buffer node encoding, and the open-time segment
+// replay that rebuilds the index without a per-record Python round-trip.
+//
+// loc encoding (shared contract with segstore.py): 64-bit
+//   (seg_id << 44) | record_offset
+// record layout (shared with cpplog so torn-tail logic stays uniform):
+//   [u32 body_len LE | u8 flags=0 | 32B key | u8 type | blob]
+// body_len counts the type byte + blob; a record is 37 + body_len bytes.
+
+namespace {
+
+constexpr uint64_t kTombLoc = ~0ull;  // slot marker: removed entry
+
+struct SegIdx {
+  std::vector<Slot> slots;  // offset field stores loc+1 (0 empty, ~0 tomb)
+  uint64_t live = 0;
+  uint64_t used = 0;  // live + tombstones (grow trigger)
+
+  size_t mask() const { return slots.size() - 1; }
+};
+
+static void segidx_insert(SegIdx* x, const uint8_t* key, uint64_t loc_plus1) {
+  size_t i = key_hash(key) & x->mask();
+  size_t first_tomb = SIZE_MAX;
+  while (x->slots[i].offset != 0) {
+    if (x->slots[i].offset == kTombLoc) {
+      if (first_tomb == SIZE_MAX) first_tomb = i;
+    } else if (memcmp(x->slots[i].key, key, 32) == 0) {
+      x->slots[i].offset = loc_plus1;  // overwrite: latest write wins
+      return;
+    }
+    i = (i + 1) & x->mask();
+  }
+  if (first_tomb != SIZE_MAX) {
+    i = first_tomb;  // reuse the tombstone: bounded probe chains
+  } else {
+    x->used++;
+  }
+  memcpy(x->slots[i].key, key, 32);
+  x->slots[i].offset = loc_plus1;
+  x->live++;
+}
+
+static void segidx_grow(SegIdx* x, size_t min_size) {
+  size_t size = x->slots.size();
+  while (size < min_size || x->live * 10 >= size * 6) size *= 2;
+  std::vector<Slot> old = std::move(x->slots);
+  x->slots.assign(size, Slot{});
+  x->live = x->used = 0;
+  for (const Slot& sl : old)
+    if (sl.offset != 0 && sl.offset != kTombLoc)
+      segidx_insert(x, sl.key, sl.offset);
+}
+
+static void segidx_maybe_grow(SegIdx* x, uint64_t incoming) {
+  if ((x->used + incoming) * 10 >= x->slots.size() * 7)
+    segidx_grow(x, x->slots.size() * 2);
+}
+
+}  // namespace
+
+void* segidx_new(uint64_t cap_hint) {
+  SegIdx* x = new SegIdx();
+  size_t size = 1 << 12;
+  while (size * 7 < (cap_hint ? cap_hint : 1) * 10) size *= 2;
+  x->slots.assign(size, Slot{});
+  return x;
+}
+
+void segidx_free(void* h) { delete (SegIdx*)h; }
+
+uint64_t segidx_count(void* h) { return ((SegIdx*)h)->live; }
+
+int segidx_put_batch(void* h, uint64_t n, const uint8_t* keys,
+                     const uint64_t* locs) {
+  SegIdx* x = (SegIdx*)h;
+  segidx_maybe_grow(x, n);
+  for (uint64_t i = 0; i < n; i++) {
+    if (locs[i] >= kTombLoc - 1) return -1;  // loc+1 would collide w/ tomb
+    segidx_maybe_grow(x, 1);
+    segidx_insert(x, keys + 32 * i, locs[i] + 1);
+  }
+  return 0;
+}
+
+int64_t segidx_get(void* h, const uint8_t* key) {
+  SegIdx* x = (SegIdx*)h;
+  size_t i = key_hash(key) & x->mask();
+  while (x->slots[i].offset != 0) {
+    if (x->slots[i].offset != kTombLoc &&
+        memcmp(x->slots[i].key, key, 32) == 0)
+      return (int64_t)(x->slots[i].offset - 1);
+    i = (i + 1) & x->mask();
+  }
+  return -1;
+}
+
+// remove `key` iff its loc equals expect_loc (pass ~0 to remove
+// unconditionally) — the compare-and-delete the sweep's re-append race
+// needs: a key re-written after the dead-set snapshot has a new loc and
+// must survive. Returns 1 removed, 0 not present / loc mismatch.
+int segidx_remove(void* h, const uint8_t* key, uint64_t expect_loc) {
+  SegIdx* x = (SegIdx*)h;
+  size_t i = key_hash(key) & x->mask();
+  while (x->slots[i].offset != 0) {
+    if (x->slots[i].offset != kTombLoc &&
+        memcmp(x->slots[i].key, key, 32) == 0) {
+      if (expect_loc + 1 != 0 && x->slots[i].offset != expect_loc + 1)
+        return 0;
+      x->slots[i].offset = kTombLoc;
+      x->live--;
+      return 1;
+    }
+    i = (i + 1) & x->mask();
+  }
+  return 0;
+}
+
+// mask[i]=1 where keys[i] is NOT in the index — the batch dedup filter
+// (one call per store_batch instead of one segidx_get per node). Also
+// dedups WITHIN the batch: the second occurrence of a key gets mask 0.
+void segidx_filter_new(void* h, uint64_t n, const uint8_t* keys,
+                       uint8_t* mask) {
+  SegIdx* x = (SegIdx*)h;
+  for (uint64_t i = 0; i < n; i++)
+    mask[i] = segidx_get(h, keys + 32 * i) < 0 ? 1 : 0;
+  // in-batch duplicates: keep the first occurrence only (content-
+  // addressed, so both carry identical bytes)
+  if (n > 1) {
+    SegIdx seen;
+    seen.slots.assign(1 << 12, Slot{});
+    for (uint64_t i = 0; i < n; i++) {
+      if (!mask[i]) continue;
+      if (segidx_get(&seen, keys + 32 * i) >= 0) {
+        mask[i] = 0;
+        continue;
+      }
+      segidx_maybe_grow(&seen, 1);
+      segidx_insert(&seen, keys + 32 * i, 1);
+    }
+  }
+  (void)x;
+}
+
+// serialize every live entry as [32B key | u64 loc LE] for the index
+// checkpoint; returns entries written (stops at cap_entries).
+uint64_t segidx_dump(void* h, uint8_t* out, uint64_t cap_entries) {
+  SegIdx* x = (SegIdx*)h;
+  uint64_t n = 0;
+  for (const Slot& sl : x->slots) {
+    if (sl.offset == 0 || sl.offset == kTombLoc) continue;
+    if (n >= cap_entries) break;
+    memcpy(out + n * 40, sl.key, 32);
+    uint64_t loc = sl.offset - 1;
+    memcpy(out + n * 40 + 32, &loc, 8);
+    n++;
+  }
+  return n;
+}
+
+// bulk-load a checkpoint blob (n entries of [32B key | u64 loc LE]) —
+// the open path for a 1M-node store; one call, no Python per entry.
+int segidx_load(void* h, const uint8_t* blob, uint64_t n) {
+  SegIdx* x = (SegIdx*)h;
+  segidx_maybe_grow(x, n);
+  for (uint64_t i = 0; i < n; i++) {
+    uint64_t loc;
+    memcpy(&loc, blob + i * 40 + 32, 8);
+    if (loc >= kTombLoc - 1) return -1;
+    segidx_maybe_grow(x, 1);
+    segidx_insert(x, blob + i * 40, loc + 1);
+  }
+  return 0;
+}
+
+// build the one-append segment image for n records whose blobs live in
+// ONE contiguous buffer (the pack_nodes flat-buffer output, consumed
+// as-is): [u32 body_len | u8 flags | 32B key | u8 type | blob] each.
+// Returns total bytes written, or -1 when cap is too small.
+int64_t segstore_pack(uint64_t n, const uint8_t* keys, const uint8_t* types,
+                      const uint8_t* blobs, const uint64_t* offsets,
+                      uint8_t* out, uint64_t cap) {
+  uint64_t pos = 0;
+  for (uint64_t i = 0; i < n; i++) {
+    uint64_t blen = offsets[i + 1] - offsets[i];
+    uint64_t rec = 37 + 1 + blen;
+    if (pos + rec > cap || blen + 1 > 0xFFFFFFFFull) return -1;
+    uint32_t body_len = (uint32_t)(blen + 1);
+    memcpy(out + pos, &body_len, 4);
+    out[pos + 4] = 0;
+    memcpy(out + pos + 5, keys + 32 * i, 32);
+    out[pos + 37] = types[i];
+    memcpy(out + pos + 38, blobs + offsets[i], blen);
+    pos += rec;
+  }
+  return (int64_t)pos;
+}
+
+// scan one segment file from byte offset `start`, inserting every valid
+// record into the index with loc = (seg_id << 44) | record_offset
+// (later records overwrite earlier ones — ascending replay order makes
+// the newest location win). Stops at the first torn record. Returns the
+// clean end offset (callers truncate the ACTIVE segment there), or -1
+// when the file cannot be opened. out_records/out_bytes accumulate the
+// replay counters the checkpointed-open tests pin.
+int64_t segstore_replay(void* h, const char* path, uint32_t seg_id,
+                        uint64_t start, uint64_t* out_records,
+                        uint64_t* out_bytes) {
+  SegIdx* x = (SegIdx*)h;
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  fseek(f, 0, SEEK_END);
+  uint64_t end = (uint64_t)ftell(f);
+  if (start > end) start = end;
+  fseek(f, (long)start, SEEK_SET);
+  uint64_t off = start;
+  uint64_t recs = 0, bytes = 0;
+  for (;;) {
+    uint8_t hdr[5];
+    if (!read_exact(f, hdr, 5)) break;
+    uint32_t body_len;
+    memcpy(&body_len, hdr, 4);
+    if (body_len < 1 || off + 37 + body_len > end) break;  // torn tail
+    uint8_t key[32];
+    if (!read_exact(f, key, 32)) break;
+    if (fseek(f, (long)body_len, SEEK_CUR) != 0) break;
+    segidx_maybe_grow(x, 1);
+    segidx_insert(x, key, (((uint64_t)seg_id << 44) | off) + 1);
+    off += 37 + body_len;
+    recs++;
+    bytes += 37 + body_len;
+  }
+  fclose(f);
+  if (out_records) *out_records += recs;
+  if (out_bytes) *out_bytes += bytes;
+  return (int64_t)off;
+}
+
 int cpplog_sync(void* handle) {
   FILE* f = ((Store*)handle)->f;
   if (!f || fflush(f) != 0) return -1;

@@ -24,6 +24,7 @@ __all__ = [
     "Ed25519HostPrep",
     "Ed25519NativeVerify",
     "CppLogLib",
+    "SegIdxNative",
 ]
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
@@ -168,6 +169,65 @@ def _bind(lib: ctypes.CDLL) -> None:
         lib.has_ed25519_verify = True
     except AttributeError:
         lib.has_ed25519_verify = False
+
+    # segstore primitives (segmented log-structured NodeStore) — newer
+    # symbols, bound leniently like the ed25519 batch kernels
+    try:
+        lib.segidx_new.argtypes = [ctypes.c_uint64]
+        lib.segidx_new.restype = ctypes.c_void_p
+        lib.segidx_free.argtypes = [ctypes.c_void_p]
+        lib.segidx_free.restype = None
+        lib.segidx_count.argtypes = [ctypes.c_void_p]
+        lib.segidx_count.restype = ctypes.c_uint64
+        lib.segidx_put_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.segidx_put_batch.restype = ctypes.c_int
+        lib.segidx_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.segidx_get.restype = ctypes.c_int64
+        lib.segidx_remove.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.segidx_remove.restype = ctypes.c_int
+        lib.segidx_filter_new.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, u8p,
+        ]
+        lib.segidx_filter_new.restype = None
+        lib.segidx_dump.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64]
+        lib.segidx_dump.restype = ctypes.c_uint64
+        lib.segidx_load.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.segidx_load.restype = ctypes.c_int
+        lib.segstore_pack.argtypes = [
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+            u8p, ctypes.c_uint64,
+        ]
+        lib.segstore_pack.restype = ctypes.c_int64
+        lib.segstore_replay.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.segstore_replay.restype = ctypes.c_int64
+        lib.has_segstore = True
+    except AttributeError:
+        lib.has_segstore = False
+
+    try:
+        lib.CPPLOG_ITER_CB = ctypes.CFUNCTYPE(
+            ctypes.c_int, ctypes.c_void_p, u8p, ctypes.c_uint8, u8p,
+            ctypes.c_uint32,
+        )
+        lib.cpplog_iterate.argtypes = [
+            ctypes.c_void_p, lib.CPPLOG_ITER_CB, ctypes.c_void_p,
+        ]
+        lib.cpplog_iterate.restype = ctypes.c_int64
+        lib.has_cpplog_iterate = True
+    except AttributeError:
+        lib.has_cpplog_iterate = False
 
     lib.cpplog_open.argtypes = [ctypes.c_char_p]
     lib.cpplog_open.restype = ctypes.c_void_p
@@ -320,6 +380,91 @@ class Ed25519NativeVerify:
         return out
 
 
+class SegIdxNative:
+    """Native open-addressed key→loc index for the segstore backend
+    (key = 32-byte content hash, loc = (seg_id << 44) | record_offset).
+    NOT thread-safe — the owning backend serializes access under its own
+    lock. The pure-Python mirror lives in nodestore/segstore.py and is
+    differential-tested against this."""
+
+    def __init__(self, cap_hint: int = 0):
+        self.lib = load_native()
+        if self.lib is None or not getattr(self.lib, "has_segstore", False):
+            raise RuntimeError("native segstore primitives unavailable")
+        self._h = self.lib.segidx_new(cap_hint)
+        if not self._h:
+            raise MemoryError("segidx_new failed")
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self.lib.segidx_free(h)
+
+    def __len__(self) -> int:
+        return int(self.lib.segidx_count(self._h))
+
+    def get(self, key: bytes):
+        loc = self.lib.segidx_get(self._h, key)
+        return None if loc < 0 else int(loc)
+
+    def put_batch(self, packed_keys: bytes, locs: list[int]) -> None:
+        n = len(locs)
+        arr = (ctypes.c_uint64 * n)(*locs)
+        if self.lib.segidx_put_batch(self._h, n, packed_keys, arr) != 0:
+            raise ValueError("segidx_put_batch: loc out of range")
+
+    def remove(self, key: bytes, expect_loc=None) -> bool:
+        exp = (2**64 - 1) if expect_loc is None else int(expect_loc)
+        return bool(self.lib.segidx_remove(self._h, key, exp))
+
+    def filter_new(self, packed_keys: bytes, n: int) -> bytes:
+        """Byte mask: 1 where keys[i] is absent from the index (in-batch
+        duplicates also masked off after their first occurrence)."""
+        out = (ctypes.c_uint8 * n)()
+        self.lib.segidx_filter_new(self._h, n, packed_keys, out)
+        return bytes(out)
+
+    def dump(self) -> bytes:
+        """Checkpoint image: live entries as [32B key | u64 loc LE]."""
+        n = len(self)
+        out = (ctypes.c_uint8 * (n * 40))()
+        got = self.lib.segidx_dump(self._h, out, n)
+        return bytes(out[: int(got) * 40])
+
+    def load(self, blob: bytes) -> None:
+        n = len(blob) // 40
+        if self.lib.segidx_load(self._h, blob, n) != 0:
+            raise ValueError("segidx_load: corrupt checkpoint entry")
+
+    def pack_records(self, packed_keys: bytes, types: bytes, buf,
+                     offsets) -> bytes:
+        """One-call append image from the flat-buffer node encoding."""
+        n = len(types)
+        arr = (ctypes.c_uint64 * (n + 1))(*offsets)
+        cap = (len(buf) if not isinstance(buf, memoryview) else buf.nbytes) \
+            + n * 38
+        out = (ctypes.c_uint8 * cap)()
+        got = self.lib.segstore_pack(
+            n, packed_keys, types, bytes(buf), arr, out, cap
+        )
+        if got < 0:
+            raise ValueError("segstore_pack failed")
+        return bytes(out[: int(got)])
+
+    def replay(self, path: str, seg_id: int, start: int) -> tuple:
+        """Scan one segment file into the index; returns
+        (clean_end_offset, records, bytes)."""
+        recs = ctypes.c_uint64(0)
+        byts = ctypes.c_uint64(0)
+        end = self.lib.segstore_replay(
+            self._h, path.encode(), seg_id, start,
+            ctypes.byref(recs), ctypes.byref(byts),
+        )
+        if end < 0:
+            raise OSError(f"segstore_replay failed: {path}")
+        return int(end), int(recs.value), int(byts.value)
+
+
 class CppLogLib:
     """ctypes handle for one cpplog store. Thread-safe via a Python lock
     (the C side shares one FILE* between reads and appends)."""
@@ -367,6 +512,29 @@ class CppLogLib:
     def count(self) -> int:
         with self._lock:
             return int(self.lib.cpplog_count(self._handle))
+
+    def iterate(self):
+        """Yield every live (key, type_byte, blob) record. The native
+        callback scan snapshots into a Python list under the store lock
+        (the C side shares one FILE* with appends), then yields outside
+        it so consumers can interleave fetches/puts."""
+        if not getattr(self.lib, "has_cpplog_iterate", False):
+            raise OSError("native library predates cpplog_iterate")
+        out: list[tuple[bytes, int, bytes]] = []
+
+        def cb(_ctx, key, type_byte, blob, length):
+            out.append((
+                bytes(key[:32]), int(type_byte),
+                bytes(blob[:length]) if length else b"",
+            ))
+            return 0
+
+        cfun = self.lib.CPPLOG_ITER_CB(cb)
+        with self._lock:
+            n = self.lib.cpplog_iterate(self._handle, cfun, None)
+        if n < 0:
+            raise OSError("cpplog_iterate failed")
+        return iter(out)
 
     def sync(self) -> None:
         with self._lock:

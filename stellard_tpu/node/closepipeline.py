@@ -44,11 +44,12 @@ __all__ = ["ClosePipeline", "LatencyHist"]
 
 @dataclass
 class _Entry:
-    kind: str  # "close" (all stages) | "repair" (no CLF pointer)
-    ledger: object
+    kind: str  # "close" (all stages) | "repair" (no CLF) | "task" (fn)
+    ledger: object  # None for "task" entries
     results: dict
     done: Optional[Callable] = None  # done(results) after persist, in order
     on_failed: Optional[Callable] = None
+    fn: Optional[Callable] = None  # "task" body, runs on the drain worker
     enqueued_at: float = field(default_factory=time.perf_counter)
 
 
@@ -130,6 +131,18 @@ class ClosePipeline:
         self._submit(_Entry("repair", ledger, results or {}, done, on_failed),
                      max(self.depth, 256))
 
+    def submit_task(self, fn: Callable, done: Optional[Callable] = None,
+                    on_failed: Optional[Callable] = None) -> None:
+        """Queue a storage-maintenance task to run ON the drain worker,
+        in order with the persists around it. The online-deletion sweep
+        applies through here: while the task runs, no save_stage can be
+        mid-flight, so a flush that already passed its known-set check
+        can never land after the sweep deleted the nodes it skipped."""
+        self._submit(
+            _Entry("task", None, {}, done, on_failed, fn=fn),
+            max(self.depth, 256),
+        )
+
     @staticmethod
     def _fail(entry: _Entry) -> None:
         """Fire the submitter's failure accounting; its exceptions must
@@ -168,9 +181,10 @@ class ClosePipeline:
             self._queue.append(entry)
             self._ensure_worker()
             self.depth_hwm = max(self.depth_hwm, len(self._queue))
-            h = entry.ledger.hash()
-            self._by_hash[h] = entry
-            self._by_seq[entry.ledger.seq] = entry
+            if entry.ledger is not None:
+                h = entry.ledger.hash()
+                self._by_hash[h] = entry
+                self._by_seq[entry.ledger.seq] = entry
             self._not_empty.notify()
 
     # -- read-your-writes lookups -----------------------------------------
@@ -225,18 +239,22 @@ class ClosePipeline:
                 ok = True
             except Exception:  # noqa: BLE001 — keep persisting later ledgers
                 self.failed += 1
-                log.exception(
-                    "persist failed for ledger seq %d", entry.ledger.seq
-                )
+                if entry.ledger is not None:
+                    log.exception(
+                        "persist failed for ledger seq %d", entry.ledger.seq
+                    )
+                else:
+                    log.exception("pipeline task failed")
                 self._fail(entry)
             finally:
                 with self._lock:
                     self._active = None
-                    h = entry.ledger.hash()
-                    if self._by_hash.get(h) is entry:
-                        del self._by_hash[h]
-                    if self._by_seq.get(entry.ledger.seq) is entry:
-                        del self._by_seq[entry.ledger.seq]
+                    if entry.ledger is not None:
+                        h = entry.ledger.hash()
+                        if self._by_hash.get(h) is entry:
+                            del self._by_hash[h]
+                        if self._by_seq.get(entry.ledger.seq) is entry:
+                            del self._by_seq[entry.ledger.seq]
                     # every completion notifies: wait_for_closes watches
                     # individual entries, not just the queue-empty edge
                     self._idle.notify_all()
@@ -254,6 +272,9 @@ class ClosePipeline:
                     )
 
     def _persist(self, entry: _Entry) -> None:
+        if entry.kind == "task":
+            entry.fn()
+            return
         t_start = time.perf_counter()
         seq = entry.ledger.seq
         tr = self.tracer

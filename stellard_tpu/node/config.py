@@ -60,6 +60,21 @@ class Config:
     node_db_type: str = "memory"
     node_db_path: str = ""
     node_db_compression: str = ""  # "" | zlib (cpplog snappy-role knob)
+    # segstore durability: fsync (one fsync per flush batch — the
+    # default), batch (group commit: one fsync per group_commit_ms
+    # window), async (page cache only outside rolls/checkpoints/close)
+    node_db_durability: str = "fsync"
+    node_db_group_commit_ms: float = 5.0
+    node_db_segment_mb: int = 64       # segment roll size
+    node_db_checkpoint_mb: int = 32    # index snapshot every N MB appended
+    node_db_compact_ratio: float = 0.5  # rewrite segments below this live%
+    # online deletion (rippled SHAMapStore online_delete role): retain N
+    # validated ledgers; unreachable nodes are mark-and-swept and their
+    # segments compacted so disk stays bounded near the live set. 0=off.
+    node_db_online_delete: int = 0
+    # sweep every K validated ledgers (0 = retain/2)
+    node_db_online_delete_interval: int = 0
+    node_db_synchronous: str = ""      # sqlite PRAGMA synchronous= pass
     database_path: str = ""
 
     # -- crypto plane (TPU-native knobs; pattern of [node_db] type=) -------
@@ -226,6 +241,27 @@ class Config:
         cfg.node_db_path = node_db.get("path", cfg.node_db_path)
         cfg.node_db_compression = node_db.get(
             "compression", cfg.node_db_compression).lower()
+        if "durability" in node_db:
+            cfg.node_db_durability = node_db["durability"].lower()
+            if cfg.node_db_durability not in ("fsync", "batch", "async"):
+                # a durability toggle must not fail open into a default
+                raise ValueError(
+                    f"[node_db] durability must be fsync/batch/async, "
+                    f"got {cfg.node_db_durability!r}"
+                )
+        for key, attr, conv in (
+            ("group_commit_ms", "node_db_group_commit_ms", float),
+            ("segment_mb", "node_db_segment_mb", int),
+            ("checkpoint_mb", "node_db_checkpoint_mb", int),
+            ("compact_ratio", "node_db_compact_ratio", float),
+            ("online_delete", "node_db_online_delete", int),
+            ("online_delete_interval", "node_db_online_delete_interval",
+             int),
+        ):
+            if key in node_db:
+                setattr(cfg, attr, conv(node_db[key]))
+        cfg.node_db_synchronous = node_db.get(
+            "synchronous", cfg.node_db_synchronous).lower()
         cfg.database_path = one("database_path", cfg.database_path)
 
         sig = _kv(s.get("signature_backend", []))

@@ -1,19 +1,38 @@
-"""LedgerCleaner: background integrity checker over stored ledgers.
+"""LedgerCleaner: background integrity checker over stored ledgers, and
+OnlineDeleter: rippled-style storage rotation.
 
-Role parity with /root/reference/src/ripple_app/ledger/LedgerCleaner.cpp
-(448 LoC): walk a range of persisted ledgers, verify each loads from the
-NodeStore with its recorded hash (Ledger.load recomputes and compares),
-verify parent-hash chain linkage against the header index, and count /
-report what is broken so the operator (or the acquisition plane) can
-repair. Driven by the `ledger_cleaner` admin RPC like the reference.
+LedgerCleaner role parity with
+/root/reference/src/ripple_app/ledger/LedgerCleaner.cpp (448 LoC): walk
+a range of persisted ledgers, verify each loads from the NodeStore with
+its recorded hash (Ledger.load recomputes and compares), verify
+parent-hash chain linkage against the header index, and count / report
+what is broken so the operator (or the acquisition plane) can repair.
+Driven by the `ledger_cleaner` admin RPC like the reference.
+
+OnlineDeleter fills production rippled's ``SHAMapStore`` online_delete
+role (``src/ripple/app/misc/SHAMapStoreImp.cpp``): retain the last N
+validated ledgers, mark every node reachable from their roots, sweep
+the rest out of the store, and let the segstore compactor reclaim the
+dead segments — a validator's disk stays bounded near the live set
+under an arbitrarily long flood. Where rippled rotates whole backend
+instances (copy live into the writable store, archive the old one),
+the segmented backend deletes in place: same policy, no double-write
+of the live set. The sweep's apply step runs ON the close pipeline's
+drain worker (ClosePipeline.submit_task) so no NodeStore flush can be
+mid-flight when entries are removed — the flush known-set race
+(a flush skipping a node the sweep is about to delete) is closed by
+ordering, and the segstore's own in-sweep guards (dedup off +
+recent-key protection) cover every writer that isn't the drain worker.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
+import time
 from typing import Optional
 
-__all__ = ["LedgerCleaner"]
+__all__ = ["LedgerCleaner", "OnlineDeleter"]
 
 
 class LedgerCleaner:
@@ -179,4 +198,202 @@ class LedgerCleaner:
                 "repairs_requested": self.repairs_requested,
                 "repaired": self.repaired,
                 "repairs_failed": self.repairs_failed,
+            }
+
+
+class OnlineDeleter:
+    """Rotation-driven online deletion (see module docstring).
+
+    Lifecycle per sweep:
+
+    1. ``on_validated(seq)`` — called from the drain worker after each
+       CLF commit — starts a background mark thread every ``interval``
+       validated ledgers;
+    2. the mark thread arms the store's sweep guards
+       (``Database.begin_sweep``) and walks every node reachable from
+       the retained ledgers' roots ([seq-retain+1, seq]): header blob,
+       state tree, tx tree — shared subtrees walk once via the live
+       set itself;
+    3. the apply step is submitted to the close pipeline
+       (``submit_task``): ON the drain worker it catch-up-marks any
+       ledger persisted since the mark started (their headers are in
+       txdb by drain order), then ``Database.apply_sweep`` removes
+       everything else, purges the façade's cache/known-set, and the
+       segstore compactor + checkpoint make the deletion durable and
+       reclaim the bytes.
+    """
+
+    def __init__(self, node, retain: int, interval: int = 0):
+        self.node = node
+        self.retain = max(1, int(retain))
+        self.interval = int(interval) if interval > 0 else max(
+            1, self.retain // 2
+        )
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_sweep_seq = 0
+        # one sweep generation at a time: the backend's sweep guards
+        # (_recent_keys / dedup-off) are single-generation state, so a
+        # new begin_sweep must not fire while a previous generation's
+        # apply task is still queued on the drain worker
+        self._apply_pending = False
+        # counters (node_store observability block)
+        self.sweeps_started = 0
+        self.sweeps_completed = 0
+        self.nodes_removed = 0
+        self.last_marked = 0
+        self.last_removed = 0
+        self.last_sweep_ms = 0.0
+        self.last_retain_floor = 0
+
+    # -- hooks -------------------------------------------------------------
+
+    def on_validated(self, seq: int) -> None:
+        """Drain-worker hook (after a durable CLF commit): start a sweep
+        every `interval` validated ledgers. Cheap when idle."""
+        with self._lock:
+            if self._stop.is_set():
+                return
+            if self._thread is not None and self._thread.is_alive():
+                return
+            if self._apply_pending:
+                return  # previous generation's apply not yet landed
+            if seq - self._last_sweep_seq < self.interval:
+                return
+            self._last_sweep_seq = seq
+            self.sweeps_started += 1
+            self._thread = threading.Thread(
+                target=self._run, args=(seq,), daemon=True,
+                name="online-delete",
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=10)
+
+    # -- sweep -------------------------------------------------------------
+
+    def _run(self, validated_seq: int) -> None:
+        db = self.node.nodestore
+        t0 = time.perf_counter()
+        try:
+            db.begin_sweep()
+            live: set[bytes] = set()
+            lo = max(1, validated_seq - self.retain + 1)
+            self.last_retain_floor = lo
+            for seq in range(lo, validated_seq + 1):
+                if self._stop.is_set():
+                    db.cancel_sweep()
+                    return
+                self._mark_seq(seq, live)
+        except Exception:  # noqa: BLE001 — a failed mark must disarm
+            db.cancel_sweep()
+            logging.getLogger("stellard.cleaner").exception(
+                "online-delete mark failed (sweep skipped)"
+            )
+            return
+
+        def apply_task():
+            # ON the drain worker: no save_stage can be concurrent
+            try:
+                if self._stop.is_set():
+                    db.cancel_sweep()
+                    return
+                try:
+                    # catch-up mark: ledgers persisted since the mark
+                    # began — contiguous from validated_seq+1, walked by
+                    # direct header lookup (the Ledgers table is never
+                    # pruned, so a full ledger_seqs() scan here would
+                    # grow without bound and stall the drain worker)
+                    seq = validated_seq + 1
+                    while True:
+                        hdr = self.node.txdb.get_ledger_header(seq=seq)
+                        if hdr is None:
+                            break
+                        self._mark_seq(seq, live)
+                        seq += 1
+                    removed = db.apply_sweep(live)
+                except Exception:  # noqa: BLE001
+                    db.cancel_sweep()
+                    logging.getLogger("stellard.cleaner").exception(
+                        "online-delete apply failed (sweep skipped)"
+                    )
+                    return
+                with self._lock:
+                    self.sweeps_completed += 1
+                    self.nodes_removed += removed
+                    self.last_marked = len(live)
+                    self.last_removed = removed
+                    self.last_sweep_ms = round(
+                        (time.perf_counter() - t0) * 1000.0, 2
+                    )
+            finally:
+                with self._lock:
+                    self._apply_pending = False
+
+        def apply_failed():
+            db.cancel_sweep()
+            with self._lock:
+                self._apply_pending = False
+
+        with self._lock:
+            self._apply_pending = True
+        self.node.close_pipeline.submit_task(
+            apply_task, on_failed=apply_failed
+        )
+
+    def _mark_seq(self, seq: int, live: set) -> None:
+        hdr = self.node.txdb.get_ledger_header(seq=seq)
+        if hdr is None:
+            return
+        live.add(hdr["hash"])  # the stored header object itself
+        self._mark_tree(hdr["account_hash"], live)
+        self._mark_tree(hdr["tx_hash"], live)
+
+    def _mark_tree(self, root_hash: bytes, live: set) -> None:
+        """Mark every reachable node by walking stored blobs directly
+        (prefix-format: an inner node is HP_INNER_NODE + 16 child
+        hashes) — no SHAMap materialization, and the live set itself
+        memoizes shared subtrees across retained ledgers."""
+        from ..state.shamap import ZERO256
+        from ..utils.hashes import HP_INNER_NODE
+
+        inner_prefix = HP_INNER_NODE.to_bytes(4, "big")
+        db = self.node.nodestore
+        stack = [root_hash]
+        while stack:
+            h = stack.pop()
+            if h == ZERO256 or h in live:
+                continue
+            # facade fetch (pending writes must be visible) but without
+            # cache insertion: an O(live-set) walk would otherwise
+            # evict every hot close-path entry each sweep
+            obj = db.fetch(h, populate_cache=False)
+            if obj is None:
+                continue  # history gap: nothing below it to retain
+            live.add(h)
+            blob = obj.data
+            if blob[:4] == inner_prefix:
+                for i in range(16):
+                    stack.append(blob[4 + 32 * i: 36 + 32 * i])
+
+    def get_json(self) -> dict:
+        with self._lock:
+            return {
+                "retain": self.retain,
+                "interval": self.interval,
+                "running": (
+                    self._thread is not None and self._thread.is_alive()
+                ),
+                "sweeps_started": self.sweeps_started,
+                "sweeps_completed": self.sweeps_completed,
+                "nodes_removed": self.nodes_removed,
+                "last_marked": self.last_marked,
+                "last_removed": self.last_removed,
+                "last_sweep_ms": self.last_sweep_ms,
+                "last_retain_floor": self.last_retain_floor,
             }

@@ -148,13 +148,23 @@ class Node:
         self.tracer = Tracer.from_config(cfg)
 
         # storage plane (reference: NodeStore Manager + main db :330)
-        self.nodestore = make_database(
-            type=cfg.node_db_type,
-            **({"path": cfg.node_db_path} if cfg.node_db_path else {}),
-            **({"compression": cfg.node_db_compression}
-               if cfg.node_db_compression and cfg.node_db_type == "cpplog"
-               else {}),
-        )
+        db_kwargs = {}
+        if cfg.node_db_path:
+            db_kwargs["path"] = cfg.node_db_path
+        if cfg.node_db_compression and cfg.node_db_type == "cpplog":
+            db_kwargs["compression"] = cfg.node_db_compression
+        if cfg.node_db_type == "segstore":
+            db_kwargs.update(
+                durability=cfg.node_db_durability,
+                group_commit_ms=cfg.node_db_group_commit_ms,
+                segment_bytes=cfg.node_db_segment_mb << 20,
+                checkpoint_bytes=cfg.node_db_checkpoint_mb << 20,
+                compact_ratio=cfg.node_db_compact_ratio,
+                tracer=self.tracer,
+            )
+        if cfg.node_db_type == "sqlite" and cfg.node_db_synchronous:
+            db_kwargs["synchronous"] = cfg.node_db_synchronous
+        self.nodestore = make_database(type=cfg.node_db_type, **db_kwargs)
         self.txdb = TxDatabase(cfg.database_path or ":memory:")
 
         # stellar CLF plane: SQL mirror + LCL pointer (reference:
@@ -186,6 +196,28 @@ class Node:
             depth=cfg.close_pipeline_depth,
             tracer=self.tracer,
         )
+
+        # online deletion (rippled SHAMapStore online_delete role): a
+        # rotation sweep driven from the validated-close stream keeps a
+        # validator's disk bounded near the live set ([node_db]
+        # online_delete=N; requires a backend with liveness — segstore)
+        self.online_deleter = None
+        if cfg.node_db_online_delete > 0:
+            if not getattr(
+                self.nodestore.backend, "supports_online_delete", False
+            ):
+                raise ValueError(
+                    f"[node_db] online_delete requires a backend with "
+                    f"liveness accounting (segstore), not "
+                    f"{cfg.node_db_type!r}"
+                )
+            from .ledgercleaner import OnlineDeleter
+
+            self.online_deleter = OnlineDeleter(
+                self,
+                retain=cfg.node_db_online_delete,
+                interval=cfg.node_db_online_delete_interval,
+            )
 
         # crypto plane (north star: pluggable cpu|tpu batch backends).
         # Device hashers run under the wedge watchdog: the tunnel's
@@ -911,6 +943,8 @@ class Node:
             stop = getattr(self.overlay, "stop", None)
             if stop is not None:  # embedders may attach bare adapters
                 stop()
+        if self.online_deleter is not None:
+            self.online_deleter.stop()
         # drain-on-stop guarantee: everything queued persists before the
         # stores close (the CLF pointer lands on the last closed ledger)
         self.close_pipeline.stop(timeout=60)
@@ -952,6 +986,10 @@ class Node:
         # must never move the CLF resume pointer backwards.
         prev = self.ledger_master.get_ledger_by_hash(ledger.parent_hash)
         self.clf.commit_ledger_close(ledger, prev)
+        if self.online_deleter is not None:
+            # rotation hook: runs on the drain worker AFTER the ledger
+            # is fully durable; cheap check, sweeps happen in background
+            self.online_deleter.on_validated(ledger.seq)
 
     def _persist_tx_rows(self, ledger: Ledger, results: dict) -> None:
         """Header + tx rows in ONE sqlite transaction (close-pipeline txdb

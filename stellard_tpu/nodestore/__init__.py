@@ -15,12 +15,15 @@ from .core import (
     make_database,
 )
 from . import backends as _backends  # noqa: F401  (registers built-ins)
+from . import segstore as _segstore  # noqa: F401  (registers segstore)
+from .segstore import SegStoreBackend
 
 __all__ = [
     "NodeObject",
     "NodeObjectType",
     "Backend",
     "Database",
+    "SegStoreBackend",
     "register_backend",
     "make_backend",
     "make_database",

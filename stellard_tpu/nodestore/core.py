@@ -58,6 +58,27 @@ class Backend:
     def store_batch(self, batch: list[NodeObject]) -> None:
         raise NotImplementedError
 
+    def store_packed(self, type: NodeObjectType, hashes, buf,
+                     offsets) -> int:
+        """Batch store straight from the flat-buffer node encoding
+        (state.shamap.encode_nodes: node i's blob — which IS its hashed
+        byte sequence — lives at buf[offsets[i]:offsets[i+1]]).
+        `hashes` is a list of 32-byte keys or one packed 32n buffer.
+        Backends with a one-append door (segstore) override this; the
+        default decodes into NodeObjects for plain store_batch."""
+        n = len(offsets) - 1
+        if n <= 0:
+            return 0
+        if isinstance(hashes, (bytes, bytearray)):
+            hashes = [bytes(hashes[32 * i: 32 * i + 32]) for i in range(n)]
+        mv = memoryview(buf)
+        self.store_batch([
+            NodeObject(type, hashes[i],
+                       bytes(mv[offsets[i]: offsets[i + 1]]))
+            for i in range(n)
+        ])
+        return n
+
     def iterate(self) -> Iterator[NodeObject]:
         raise NotImplementedError
 
@@ -94,6 +115,10 @@ class Database:
         # hashes known to be durably in THIS store — the `known` set for
         # SHAMap.flush incremental writes
         self.flushed: set[bytes] = set()
+        # fetch counters (the node_store observability block)
+        self.cache_hits = 0
+        self.backend_fetches = 0
+        self.backend_misses = 0
         self._cache: dict[bytes, NodeObject] = {}
         self._cache_size = cache_size
         self._pending: dict[bytes, NodeObject] = {}
@@ -111,14 +136,24 @@ class Database:
 
     # -- public api -------------------------------------------------------
 
-    def fetch(self, hash: bytes) -> Optional[NodeObject]:
+    def fetch(self, hash: bytes, *,
+              populate_cache: bool = True) -> Optional[NodeObject]:
+        """`populate_cache=False` serves O(store) scans (the online-
+        deletion mark walk) that must still see pending writes but must
+        not flush the hot close-path entries out of the LRU."""
         with self._lock:
             obj = self._pending.get(hash) or self._cache.get(hash)
-        if obj is not None:
-            return obj
+            if obj is not None:
+                self.cache_hits += 1
+                return obj
+            self.backend_fetches += 1
         obj = self.backend.fetch(hash)
         if obj is not None:
-            self._cache_put(obj)
+            if populate_cache:
+                self._cache_put(obj)
+        else:
+            with self._lock:
+                self.backend_misses += 1
         return obj
 
     def store(self, type: NodeObjectType, hash: bytes, data: bytes) -> None:
@@ -168,6 +203,64 @@ class Database:
         expects."""
         return lambda pairs: self.store_many(type, pairs)
 
+    def store_packed(self, type: NodeObjectType, hashes, buf,
+                     offsets) -> int:
+        """Flat-buffer batch door (SHAMap.flush `store_packed` sink):
+        the whole chunk goes to the backend in ONE synchronous call —
+        blob == hashed bytes, zero per-node objects on the segstore
+        path. Runs on the caller's thread (the close pipeline's drain
+        worker), bypassing the pending map: content-addressed writes
+        need no ordering against the async writer, and read-your-writes
+        holds because the backend indexes the batch before returning."""
+        with self._lock:
+            if self._write_error is not None:
+                raise RuntimeError("nodestore writer failed") \
+                    from self._write_error
+        return self.backend.store_packed(type, hashes, buf, offsets)
+
+    def store_packed_fn(self, type: NodeObjectType) -> Callable:
+        """Adapter with the (hashes, buf, offsets) signature
+        SHAMap.flush's `store_packed` expects."""
+        return lambda hashes, buf, offsets: self.store_packed(
+            type, hashes, buf, offsets
+        )
+
+    # -- online deletion ---------------------------------------------------
+
+    def begin_sweep(self) -> None:
+        """Arm the backend's sweep guards (see SegStoreBackend)."""
+        begin = getattr(self.backend, "begin_sweep", None)
+        if begin is None:
+            raise NotImplementedError(
+                f"backend {self.backend.name!r} does not support "
+                f"online deletion"
+            )
+        begin()
+
+    def cancel_sweep(self) -> None:
+        cancel = getattr(self.backend, "cancel_sweep", None)
+        if cancel is not None:
+            cancel()
+
+    def apply_sweep(self, live: set) -> int:
+        """Remove every stored node not in `live`, then purge the
+        façade's own state for the removed keys: the cache must stop
+        resolving them and — critically — the `flushed` known-set must
+        forget them, or a later flush would skip re-writing a deleted
+        node a new ledger re-created. Returns nodes removed."""
+        apply = getattr(self.backend, "apply_sweep", None)
+        if apply is None:
+            raise NotImplementedError(
+                f"backend {self.backend.name!r} does not support "
+                f"online deletion"
+            )
+        removed = apply(live)
+        with self._lock:
+            for key in removed:
+                self._cache.pop(key, None)
+        self.flushed.difference_update(removed)
+        return len(removed)
+
     def sync(self) -> None:
         """Block until all pending writes hit the backend. Raises the
         writer thread's error if the backend failed (otherwise a dead
@@ -180,6 +273,11 @@ class Database:
                 self._wake.wait(0.01)
             if self._write_error is not None:
                 raise RuntimeError("nodestore writer failed") from self._write_error
+        # durability barrier: backends with deferred fsync (segstore
+        # durability=batch|async) flush their group-commit window too
+        backend_sync = getattr(self.backend, "sync", None)
+        if backend_sync is not None:
+            backend_sync()
 
     def close(self) -> None:
         try:
@@ -191,6 +289,26 @@ class Database:
             if self._writer:
                 self._writer.join(timeout=5)
             self.backend.close()
+
+    def get_json(self) -> dict:
+        """The `node_store` observability block (server_state /
+        get_counts): façade cache + write-behind stats, plus whatever
+        the backend itself reports (segstore: segments, live ratio,
+        appends/fsyncs, compaction and checkpoint counters)."""
+        with self._lock:
+            out = {
+                "cache_size": len(self._cache),
+                "cache_hits": self.cache_hits,
+                "backend_fetches": self.backend_fetches,
+                "backend_misses": self.backend_misses,
+                "pending_writes": len(self._pending),
+                "flushed_known": len(self.flushed),
+                "backend": self.backend.name,
+            }
+        backend_json = getattr(self.backend, "get_json", None)
+        if backend_json is not None:
+            out["backend_stats"] = backend_json()
+        return out
 
     # -- internals --------------------------------------------------------
 

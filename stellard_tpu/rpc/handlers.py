@@ -320,6 +320,13 @@ def do_server_state(ctx: Context) -> dict:
         # per-stage latency histograms + queue-depth gauges for the
         # ledger-close persistence pipeline
         state["close_pipeline"] = pipeline.get_json()
+    # storage plane: aggregate counters only (appends, bytes, fsyncs,
+    # fetch hit/miss, segments, live ratio, compaction/sweep counts —
+    # no filesystem paths on a GUEST-reachable method)
+    state["node_store"] = node.nodestore.get_json()
+    deleter = getattr(node, "online_deleter", None)
+    if deleter is not None:
+        state["node_store"]["online_delete"] = deleter.get_json()
     # delta-replay close: spliced/fallback/invalidation counters +
     # close-stage (apply/seal/total) latency percentiles
     state["delta_replay"] = node.ledger_master.delta_replay_json()
@@ -403,6 +410,12 @@ def do_get_counts(ctx: Context) -> dict:
         # admission-control plane: queue depth/caps + admit/evict/
         # promote counters incl. the queue-aware-speculation split
         out["txq"] = txq.get_json()
+    # storage plane: façade cache + backend stats (segstore: segments,
+    # live ratio, appends/fsyncs, checkpoint/compaction/sweep counters)
+    out["node_store"] = node.nodestore.get_json()
+    deleter = getattr(node, "online_deleter", None)
+    if deleter is not None:
+        out["node_store"]["online_delete"] = deleter.get_json()
     out["held"] = {
         "count": len(node.ledger_master.held),
         **node.ledger_master.held_stats,

@@ -408,19 +408,26 @@ class Ledger:
         consensus flushDirty + Ledger::pendSaveValidated; header stored as
         hotLEDGER under the ledger hash). Uses the store's `flushed` set so
         repeated saves only write the delta; node blobs come off the
-        shared flat-buffer encoding and land via the store's batch door
-        (one lock hold per chunk, not per node)."""
+        shared flat-buffer encoding and are handed through the packed
+        door AS-IS — (hashes, buf, offsets), blob == hashed bytes — so
+        a log-structured backend lands the whole delta as one segment
+        append (other backends decode once inside the façade)."""
         self.state_map.flush(
             db.store_fn(NodeObjectType.ACCOUNT_NODE), db.flushed,
-            store_many=db.store_many_fn(NodeObjectType.ACCOUNT_NODE),
+            store_packed=db.store_packed_fn(NodeObjectType.ACCOUNT_NODE),
         )
         self.tx_map.flush(
             db.store_fn(NodeObjectType.TRANSACTION_NODE), db.flushed,
-            store_many=db.store_many_fn(NodeObjectType.TRANSACTION_NODE),
+            store_packed=db.store_packed_fn(NodeObjectType.TRANSACTION_NODE),
         )
         h = self.hash()
-        db.store(NodeObjectType.LEDGER, h,
-                 HP_LEDGER_MASTER.to_bytes(4, "big") + self.header_bytes())
+        # the header rides the same SYNCHRONOUS door as the trees: the
+        # close pipeline commits txdb/CLF right after save() returns,
+        # and a header blob parked in the async write-behind queue at
+        # that moment would be lost by a crash — leaving a CLF-covered
+        # ledger whose root object never resolves
+        blob = HP_LEDGER_MASTER.to_bytes(4, "big") + self.header_bytes()
+        db.store_packed(NodeObjectType.LEDGER, [h], blob, [0, len(blob)])
         return h
 
     @classmethod

@@ -816,7 +816,8 @@ class SHAMap:
 
     def flush(self, store: Callable[[bytes, bytes], None],
               known: Optional[set] = None,
-              store_many: Optional[Callable[[list], None]] = None) -> int:
+              store_many: Optional[Callable[[list], None]] = None,
+              store_packed: Optional[Callable] = None) -> int:
         """Hash everything, then persist every node the target store does
         not yet have, as (hash → prefix-format blob). Returns the number of
         nodes written.
@@ -834,7 +835,12 @@ class SHAMap:
         blob IS the hashed byte sequence), not per-node
         serialize_node_prefix calls; with `store_many` (a batch sink,
         e.g. Database.store_many_fn) each chunk lands in the store in
-        one call instead of one lock round-trip per node.
+        one call instead of one lock round-trip per node. With
+        `store_packed` (the flat-buffer sink, Database.store_packed_fn)
+        the encoded chunk is handed through AS-IS — (hashes, buf,
+        offsets), no per-node blob slices at all — which a
+        log-structured backend turns into one contiguous segment
+        append.
         """
         self.get_hash()
         if known is None:
@@ -854,7 +860,9 @@ class SHAMap:
         for start in range(0, len(nodes), self.FLUSH_CHUNK):
             chunk = nodes[start : start + self.FLUSH_CHUNK]
             buf, offsets = encode_nodes(chunk)
-            if store_many is not None:
+            if store_packed is not None:
+                store_packed([node._hash for node in chunk], buf, offsets)
+            elif store_many is not None:
                 store_many([
                     (node._hash, buf[offsets[i] : offsets[i + 1]])
                     for i, node in enumerate(chunk)

@@ -1,0 +1,197 @@
+#!/usr/bin/env python3
+"""Storage crash-recovery smoke gate (tools/tier1.sh).
+
+For each durable NodeStore backend (segstore, cpplog):
+
+1. spawn a child process that boots a standalone file-backed node and
+   floods payments through the full async pipeline, closing every 25
+   and printing each durable close;
+2. SIGKILL the child mid-flood — with closes landing continuously, the
+   kill lands mid-flush often enough to leave torn tails;
+3. reopen the stores in THIS process and assert the durability
+   invariant the close pipeline's stage order promises: every ledger
+   whose txdb header committed (header commits AFTER the NodeStore
+   flush, in drain order) must fully resolve from the reopened store —
+   header hash, state tree, tx tree, every node verified against its
+   content hash by Ledger.load.
+
+A torn tail must be truncated away silently (both backends recover by
+replay); a ledger that persisted before the kill but cannot resolve
+after reopen is a storage-plane corruption bug and fails the gate.
+
+Exit 0 when both backends pass; 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CLOSES_BEFORE_KILL = 4
+MIN_RESOLVED = 3
+
+
+def child_flood(backend: str, state_dir: str) -> None:
+    """Flood forever (until killed), printing CLOSED <seq> per close."""
+    import threading
+
+    from stellard_tpu.node.config import Config
+    from stellard_tpu.node.node import Node
+    from stellard_tpu.protocol.formats import TxType
+    from stellard_tpu.protocol.keys import KeyPair
+    from stellard_tpu.protocol.sfields import sfAmount, sfDestination
+    from stellard_tpu.protocol.stamount import STAmount
+    from stellard_tpu.protocol.sttx import SerializedTransaction
+
+    node = Node(Config(
+        node_db_type=backend,
+        node_db_path=os.path.join(state_dir, "nodestore"),
+        database_path=os.path.join(state_dir, "stellard.db"),
+        # small segments so the kill also exercises roll boundaries
+        **({"node_db_segment_mb": 1} if backend == "segstore" else {}),
+    )).setup()
+    master = KeyPair.from_passphrase("masterpassphrase")
+    dests = [KeyPair.from_passphrase(f"storage-smoke-{i}").account_id
+             for i in range(8)]
+    done = threading.Semaphore(0)
+
+    def cb(tx, ter, applied):
+        done.release()
+
+    seq = 1
+    while True:
+        txs = []
+        for i in range(25):
+            tx = SerializedTransaction.build(
+                TxType.ttPAYMENT, master.account_id, seq, 10,
+                {sfAmount: STAmount.from_drops(250_000_000),
+                 sfDestination: dests[i % len(dests)]},
+            )
+            tx.sign(master)
+            txs.append(tx)
+            seq += 1
+        for tx in txs:
+            node.ops.submit_transaction(tx, cb)
+        for _ in txs:
+            done.acquire()
+        node.ops.accept_ledger()
+        # report the last DURABLY persisted close (pipeline drained):
+        # the parent kills somewhere after CLOSES_BEFORE_KILL of these
+        node.close_pipeline.flush(timeout=60)
+        print(f"CLOSED {node.ledger_master.closed_ledger().seq}",
+              flush=True)
+
+
+def verify_reopen(backend: str, state_dir: str) -> int:
+    """-> number of fully-resolved persisted ledgers; raises on any
+    persisted-but-unresolvable ledger."""
+    from stellard_tpu.node.txdb import TxDatabase
+    from stellard_tpu.nodestore import make_database
+    from stellard_tpu.state.ledger import Ledger
+
+    db = make_database(
+        type=backend, path=os.path.join(state_dir, "nodestore")
+    )
+    txdb = TxDatabase(os.path.join(state_dir, "stellard.db"))
+    try:
+        seqs = txdb.ledger_seqs()
+        if not seqs:
+            raise AssertionError("no persisted ledgers after kill")
+        resolved = 0
+        for seq in seqs:
+            hdr = txdb.get_ledger_header(seq=seq)
+            led = Ledger.load(db, hdr["hash"])  # verifies every node
+            if led.hash() != hdr["hash"]:
+                raise AssertionError(
+                    f"seq {seq}: reloaded hash mismatch"
+                )
+            resolved += 1
+        stats = getattr(db.backend, "get_json", lambda: {})()
+        print(f"  [{backend}] reopened: {resolved} ledgers resolved, "
+              f"replayed_records={stats.get('replayed_records', 'n/a')} "
+              f"from_checkpoint={stats.get('opened_from_checkpoint')}")
+        return resolved
+    finally:
+        db.close()
+        txdb.close()
+
+
+def run_one(backend: str) -> bool:
+    state_dir = tempfile.mkdtemp(prefix=f"storage-smoke-{backend}-")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child", backend,
+         state_dir],
+        stdout=subprocess.PIPE, text=True, env=env, cwd=REPO,
+    )
+    closes = 0
+    deadline = time.monotonic() + 240
+    try:
+        while closes < CLOSES_BEFORE_KILL:
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"[{backend}] child made {closes} closes before the "
+                    f"240s budget — flood stalled"
+                )
+            line = child.stdout.readline()
+            if not line:
+                raise AssertionError(
+                    f"[{backend}] child exited early (rc={child.poll()})"
+                )
+            if line.startswith("CLOSED"):
+                closes += 1
+        # kill MID-FLUSH: the next close's persist is in flight right
+        # after a CLOSED line ~continuously; no sleep = maximum tear
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait(timeout=30)
+        resolved = verify_reopen(backend, state_dir)
+        if resolved < MIN_RESOLVED:
+            raise AssertionError(
+                f"[{backend}] only {resolved} ledgers resolved "
+                f"(need >= {MIN_RESOLVED}) — anti-vacuity"
+            )
+        print(f"  [{backend}] OK")
+        return True
+    except AssertionError as exc:
+        print(f"STORAGE SMOKE FAILED: {exc}", file=sys.stderr)
+        return False
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait(timeout=10)
+        import shutil
+
+        shutil.rmtree(state_dir, ignore_errors=True)
+
+
+def main() -> int:
+    if len(sys.argv) >= 4 and sys.argv[1] == "--child":
+        child_flood(sys.argv[2], sys.argv[3])
+        return 0
+    backends = ["segstore"]
+    # cpplog needs the native toolchain; skip cleanly where absent
+    try:
+        from stellard_tpu.native import load_native
+
+        if load_native() is not None:
+            backends.append("cpplog")
+        else:
+            print("  [cpplog] skipped: native toolchain unavailable")
+    except Exception:  # noqa: BLE001
+        print("  [cpplog] skipped: native toolchain unavailable")
+    ok = True
+    for backend in backends:
+        print(f"== storage crash-recovery: {backend} ==", flush=True)
+        ok = run_one(backend) and ok
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,6 +49,17 @@ if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/specsmoke.py; then
   exit 2
 fi
 
+echo "== storage crash-recovery smoke gate (SIGKILL mid-flush -> reopen -> resolve) =="
+# floods a file-backed node per durable backend (segstore, cpplog),
+# SIGKILLs it mid-flush, reopens the stores, and asserts every ledger
+# whose txdb header committed fully resolves (every node content-
+# verified) — torn-tail recovery and the pipeline's durability ordering
+# are CI-gated, not an ops-day discovery
+if ! JAX_PLATFORMS=cpu timeout -k 10 500 python tools/storagesmoke.py; then
+  echo "STORAGE SMOKE FAILED — crash recovery is broken" >&2
+  exit 2
+fi
+
 echo "== overload-admission smoke gate (4x flood -> bounded closes, fee-order drain) =="
 # boots a node with a pinned small admission cap, floods it at 4x that
 # capacity through the full async pipeline, and asserts the RPC door
