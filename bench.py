@@ -1783,6 +1783,54 @@ def bench_replay(backends):
     return rates
 
 
+def bench_scenario_matrix(backends):
+    """Adversarial scenario matrix (stellard_tpu/testkit): one JSON line
+    per scenario — convergence, commit completeness, splice/fallback
+    rates under hostile workloads, byzantine defense counts, cold-node
+    catch-up counters, TxQ fairness verdicts. Wall-clock is incidental
+    (the simnet is discrete-time); the VALUE is the scenario outcome,
+    with converged+single_hash as the pass/fail spine. Deterministic:
+    the same seed re-emits identical scorecard fields."""
+    from stellard_tpu.testkit import MATRIX, build_scenario, run_simnet
+
+    seed = int(os.environ.get("BENCH_SCENARIO_SEED", "7"))
+    for name in MATRIX:
+        t0 = time.perf_counter()
+        card = run_simnet(build_scenario(name, seed=seed))
+        wall_s = time.perf_counter() - t0
+        ok = card["converged"] and card["single_hash"]
+        line = {
+            "metric": f"scenario_{name}",
+            "value": 1.0 if ok else 0.0,
+            "unit": "converged_single_hash",
+            "vs_baseline": 1.0 if ok else 0.0,
+            "seed": seed,
+            "wall_s": round(wall_s, 2),
+            "submitted": card["submitted"],
+            "committed": card["committed"],
+            "tail_steps": card["tail_steps"],
+            "splice": card["splice"],
+            "fault_digest": card["fault_digest"],
+        }
+        if card.get("byzantine"):
+            line["byzantine"] = card["byzantine"]
+        if "catchup" in card:
+            line["catchup"] = {
+                "synced": card["catchup"]["synced"],
+                **{k: card["catchup"]["segfetch"][k] for k in (
+                    "segments", "records", "timeouts", "retries",
+                    "backoffs", "peer_switches", "garbage_peers",
+                )},
+            }
+        if "txq" in card:
+            line["txq"] = {
+                k: card["txq"][k] for k in (
+                    "queued", "fee_order_drain", "no_starvation",
+                )
+            }
+        _emit(line)
+
+
 def bench_mesh():
     """SURVEY §2.9 mapping #3: the sharded verify step on an 8-virtual-
     device CPU mesh, as a throughput number (a sharding/collective
@@ -1893,6 +1941,7 @@ def main() -> None:
             bench_regular_key_fanout,
             bench_consensus_close,
             bench_replay,
+            bench_scenario_matrix,
         ):
             try:
                 fn(backends)

@@ -103,6 +103,7 @@ class LedgerConsensus:
         hash_batch: Optional[Callable] = None,
         idle_interval: int = LEDGER_IDLE_INTERVAL,
         voting=None,
+        note_byzantine: Optional[Callable] = None,
     ):
         self.lm = ledger_master
         # consensus round events ride the chain's tracing plane (trace
@@ -118,6 +119,9 @@ class LedgerConsensus:
         self.hash_batch = hash_batch
         self.idle_interval = idle_interval
         self.voting = voting  # consensus.voting.VotingBox or None
+        # defense sink (ValidatorNode.note_byzantine): recognized hostile
+        # proposals are counted, never silently dropped
+        self.note_byzantine = note_byzantine or (lambda kind, **kw: None)
 
         self.prev_ledger = prev_ledger
         self.prev_hash = prev_ledger.hash()
@@ -143,6 +147,10 @@ class LedgerConsensus:
         # staleness prunes so a replayed old proposal can't re-register a
         # departed proposer
         self.max_seen_seq: dict[bytes, int] = {}
+        # (peer, propose_seq) -> (tx_set_hash, close_time): detects a key
+        # SIGNING two different positions at one sequence (equivocation)
+        # vs a mere duplicate relay of the same position
+        self._seen_positions: dict[tuple[bytes, int], tuple[bytes, int]] = {}
         self.last_propose: Optional[float] = None
         self.acquired: dict[bytes, TxSet] = {}
         self.disputes: dict[bytes, DisputedTx] = {}
@@ -257,8 +265,22 @@ class LedgerConsensus:
                 peer=peer.hex()[:16], bowout=True,
             )
             return True
+        position = (prop.tx_set_hash, prop.close_time)
         if prop.propose_seq <= self.max_seen_seq.get(peer, -1):
-            return False  # stale or replayed
+            # stale or replayed. Distinguish a harmless duplicate relay
+            # from EQUIVOCATION — the same key signing a DIFFERENT
+            # position at a sequence it already used. Either way the
+            # first-seen position stands and quorum math never counts a
+            # proposer twice (peer_positions is keyed by peer).
+            prev = self._seen_positions.get((peer, prop.propose_seq))
+            if prev is not None and prev != position:
+                self.note_byzantine("conflicting_proposal", peer=peer,
+                                    propose_seq=prop.propose_seq)
+            else:
+                self.note_byzantine("duplicate_proposal", peer=peer,
+                                    propose_seq=prop.propose_seq)
+            return False
+        self._seen_positions[(peer, prop.propose_seq)] = position
         self.max_seen_seq[peer] = prop.propose_seq
         self.peer_positions[peer] = prop
         self.position_times[peer] = self.clock()
@@ -287,8 +309,13 @@ class LedgerConsensus:
             return
         self.compared.add(h)
         # new disputes from the symmetric difference with our set
-        # (reference: createDisputes via SHAMap::compare)
-        for txid in self.our_set.differences(txset):
+        # (reference: createDisputes via SHAMap::compare). SORTED:
+        # differences() is a Python set, and iterating it raw leaks the
+        # process's string-hash seed into dispute creation and relay
+        # ORDER — which reorders wire messages and thus peers' apply
+        # order, breaking cross-process reproducibility of a seeded
+        # simnet run (found by the scenario smoke's determinism gate)
+        for txid in sorted(self.our_set.differences(txset)):
             if txid not in self.disputes:
                 blob = self.our_set.get(txid) or txset.get(txid) or b""
                 self.disputes[txid] = DisputedTx(
@@ -495,7 +522,7 @@ class LedgerConsensus:
             val.sign(self.key)
             # count our own validation toward quorum (reference: accept
             # stores its own validation before broadcasting :1023-1045)
-            self.validations.add(val)
+            self.validations.add(val, local=True)
             self.adapter.send_validation(val)
             self.tracer.instant(
                 "consensus.validation_out", "consensus", seq=new_lcl.seq,

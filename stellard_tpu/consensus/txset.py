@@ -14,7 +14,12 @@ from typing import Callable, Iterator, Optional
 from ..protocol.sttx import SerializedTransaction
 from ..state.shamap import SHAMap, SHAMapItem, TNType
 
-__all__ = ["TxSet"]
+__all__ = ["TxSet", "MAX_TXSET_BLOBS"]
+
+# defense cap on a relayed candidate set: a byzantine peer must not buy
+# unbounded parse/hash work with one TxSetData message. Generous — the
+# 4x-overload bench closes ~3k-tx ledgers; an honest set stays far under.
+MAX_TXSET_BLOBS = 8192
 
 
 class TxSet:

@@ -28,6 +28,10 @@ class ValidationsStore:
         self.is_trusted = is_trusted  # node pubkey -> on our UNL?
         self.now = now  # network time (seconds since network epoch)
         self.max_ledgers = max_ledgers
+        # defense sink (ValidatorNode.note_byzantine, set post-init):
+        # equivocating / stale / duplicated validations are counted —
+        # they were already harmless to quorum math, now they are visible
+        self.note_byzantine = None
         # ledger hash -> {signer -> validation}
         self.by_ledger: dict[bytes, dict[bytes, STValidation]] = {}
         # signer -> its latest current validation
@@ -39,20 +43,53 @@ class ValidationsStore:
         t = val.signing_time
         return (now - LEDGER_VAL_INTERVAL) < t < (now + LEDGER_EARLY_INTERVAL)
 
-    def add(self, val: STValidation) -> bool:
+    def add(self, val: STValidation, local: bool = False) -> bool:
         """Store a (signature-checked) validation. Returns True when it is
-        current and should be relayed (reference: addValidation :72-120)."""
+        current and should be relayed (reference: addValidation :72-120).
+        ``local`` marks our own just-built validation (never charged to
+        the defense counters)."""
         val.trusted = self.is_trusted(val.signer)
         now = self.now()
         current = self._is_current(val, now)
+        note = self.note_byzantine if not local else None
         with self._lock:
-            self.by_ledger.setdefault(val.ledger_hash, {})[val.signer] = val
+            per_signer = self.by_ledger.setdefault(val.ledger_hash, {})
+            dup = (
+                val.signer in per_signer
+                and per_signer[val.signer].signing_time == val.signing_time
+            )
+            per_signer[val.signer] = val
             self._trim()
             if current:
                 prev = self.current.get(val.signer)
+                conflicting = (
+                    prev is not None
+                    and prev.ledger_hash != val.ledger_hash
+                    and prev.ledger_seq is not None
+                    and prev.ledger_seq == val.ledger_seq
+                )
                 if prev is None or prev.signing_time < val.signing_time:
                     self.current[val.signer] = val
+                    # one key signing TWO ledgers at one seq: the newer
+                    # statement REPLACES the older in the election (a
+                    # signer never holds two current votes) and the
+                    # equivocation is counted
+                    if conflicting and note is not None:
+                        note("conflicting_validation", peer=val.signer,
+                             seq=val.ledger_seq)
                     return True
+                if note is not None:
+                    if conflicting:
+                        note("conflicting_validation", peer=val.signer,
+                             seq=val.ledger_seq)
+                    elif dup:
+                        note("duplicate_validation", peer=val.signer)
+                return False
+        if note is not None:
+            # signing time outside the currency window: replayed history
+            # or a far-future stamp — stored for the per-hash record,
+            # zero electoral weight
+            note("stale_validation", peer=val.signer)
         return False
 
     def _trim(self) -> None:

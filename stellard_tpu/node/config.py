@@ -74,6 +74,10 @@ class Config:
     node_db_online_delete: int = 0
     # sweep every K validated ledgers (0 = retain/2)
     node_db_online_delete_interval: int = 0
+    # trim txdb SQL history rows (tx/account-tx/ledger headers) below
+    # the same retention horizon on the same drain worker (the
+    # NodeStore sweep alone leaves the SQL mirror growing forever)
+    node_db_sql_trim: int = 1
     node_db_synchronous: str = ""      # sqlite PRAGMA synchronous= pass
     database_path: str = ""
 
@@ -255,6 +259,7 @@ class Config:
             ("checkpoint_mb", "node_db_checkpoint_mb", int),
             ("compact_ratio", "node_db_compact_ratio", float),
             ("online_delete", "node_db_online_delete", int),
+            ("sql_trim", "node_db_sql_trim", int),
             ("online_delete_interval", "node_db_online_delete_interval",
              int),
         ):

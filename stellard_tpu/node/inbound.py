@@ -6,19 +6,38 @@ header → tx tree → state tree; trigger/takeNodes) and InboundLedgers.cpp
 (container with dedup). Used for catch-up: when validations show the
 network is on a ledger we don't have, we acquire it and switch
 (reference: NetworkOPs::checkLastClosedLedger → switchLastClosedLedger).
+
+``SegmentCatchup`` is the segment-granular bulk path layered under the
+tree walk: instead of pulling a cold node's whole state one
+GetLedger/LedgerData node wave at a time, it transfers entire store
+segments (nodestore/segstore ``fetch_segment`` — contiguous byte ranges
+whose every record is self-verifying: key == SHA-512-half of the blob)
+into the local NodeStore, after which the tree acquisition resolves
+almost everything via ``local_fetch`` and only the tip delta crosses the
+wire node-by-node. Faults are first-class: per-request timeout on the
+node's own clock, bounded retries with exponential backoff + seeded
+jitter, peer scoring on failure, and per-peer fallback when a peer
+serves garbage (a record whose bytes do not hash to its key).
 """
 
 from __future__ import annotations
 
+import struct
 from typing import Callable, Optional
 
-from ..overlay.wire import GetLedger, LedgerData
+from ..overlay.wire import GetLedger, GetSegments, LedgerData, SegmentData
 from ..state.ledger import Ledger, parse_header, strip_ledger_prefix
 from ..state.shamap import SHAMap, TNType
 from ..state.shamapsync import IncompleteMap, SHAMapNodeID
 from ..utils.hashes import HP_LEDGER_MASTER, prefix_hash
 
-__all__ = ["InboundLedger", "InboundLedgers", "serve_get_ledger"]
+__all__ = [
+    "InboundLedger",
+    "InboundLedgers",
+    "SegmentCatchup",
+    "iter_segment_records",
+    "serve_get_ledger",
+]
 
 # GetLedger.what codes
 W_HEADER = 0
@@ -397,3 +416,385 @@ def _descend(tree: SHAMap, nid: SHAMapNodeID):
             return None
         node = node.children[nb]
     return node
+
+
+# -- segment-granular catch-up ---------------------------------------------
+
+# segstore record layout (shared with cpplog, nodestore/segstore.py):
+# [u32 body_len LE | u8 flags | 32B key | u8 type | blob]
+_SEG_REC_HEADER = 37
+
+
+def iter_segment_records(data: bytes):
+    """Parse one segment's raw bytes into (key, type_byte, blob) records.
+    A trailing partial record (snapshot of a growing active segment) is
+    ignored; a structurally impossible length raises ValueError so the
+    caller can treat the whole transfer as garbage."""
+    off, n = 0, len(data)
+    while off + _SEG_REC_HEADER <= n:
+        body_len, flags = struct.unpack_from("<IB", data, off)
+        if body_len < 1 or body_len > (64 << 20):
+            raise ValueError(f"segment record length {body_len} at {off}")
+        if flags != 0:
+            raise ValueError(f"unknown segment record flags {flags}")
+        end = off + _SEG_REC_HEADER + body_len
+        if end > n:
+            break  # torn tail of an active-segment snapshot
+        key = data[off + 5: off + 37]
+        body = data[off + _SEG_REC_HEADER: end]
+        yield key, body[0], body[1:]
+        off = end
+
+
+class SegmentCatchup:
+    """Bulk segment transfer into the local NodeStore (see module doc).
+
+    Transport-agnostic and clock-driven: the owner supplies ``send(peer,
+    msg)``, ``peers()`` (candidate peer ids, stable order), a monotonic
+    ``clock()`` and a ``store(type_byte, key, blob)`` sink; ``tick(now)``
+    advances timeouts/retries. On the deterministic simnet the clock is
+    virtual, so every timeout, retry and backoff replays bit-identically
+    for a given seed.
+    """
+
+    # a finished session (done OR fallback) re-arms after this long, so
+    # a transient first-episode failure can never disable the bulk path
+    # for the node's lifetime
+    REARM_S = 60.0
+    # a segment transfer may exceed its manifest-advertised size only by
+    # this much (the active segment grows between manifest and fetch);
+    # anything bigger is a hostile total and condemns the peer
+    GROWTH_SLACK = 8 << 20
+    # absolute per-segment ceiling, manifest or not
+    MAX_SEGMENT_TRANSFER = 512 << 20
+
+    def __init__(
+        self,
+        send: Callable[[object, object], None],
+        peers: Callable[[], list],
+        store: Callable[[int, bytes, bytes], None],
+        clock: Callable[[], float],
+        request_timeout: float = 4.0,
+        max_retries: int = 8,
+        backoff_base: float = 1.0,
+        backoff_max: float = 30.0,
+        seed: int = 0,
+        note_byzantine: Optional[Callable] = None,
+        on_complete: Optional[Callable[[], None]] = None,
+    ):
+        import random
+        import threading
+
+        from .metrics import AtomicCounters
+
+        # one lock for every public entry point: over TCP, replies land
+        # on per-peer reader threads while tick() runs on the timer
+        # thread — unsynchronized interleaving could double-charge
+        # timeouts for answered requests or abandon a healthy transfer.
+        # The simnet is single-threaded; an uncontended lock is free.
+        self._lock = threading.RLock()
+        self.send = send
+        self.peers = peers
+        self.store = store
+        self.clock = clock
+        self.request_timeout = request_timeout
+        self.max_retries = max_retries
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.rng = random.Random(0xCA7C ^ seed)
+        self.note_byzantine = note_byzantine
+        self.on_complete = on_complete
+        self.active = False
+        self.state = "idle"  # idle | manifest | fetch | done | fallback
+        self._finished_at: Optional[float] = None  # for can_start rearm
+        self.counters = AtomicCounters(
+            "started", "completed", "requests", "replies", "timeouts",
+            "retries", "backoffs", "peer_switches", "garbage_records",
+            "garbage_peers", "fallbacks", "segments", "records", "bytes",
+            "late_replies",
+        )
+        self._reset_session()
+
+    def _reset_session(self) -> None:
+        self._queue: list[int] = []      # segment ids still to fetch
+        self._sizes: dict[int, int] = {}  # manifest-advertised sizes
+        self._cur_seg: Optional[int] = None
+        self._cur_total = 0
+        self._buf = bytearray()
+        self._want: Optional[tuple] = None  # ("manifest",) | ("seg", id)
+        self._deadline: Optional[float] = None
+        self._backoff_until = 0.0
+        self._attempts = 0               # for the CURRENT want
+        self._peer = None
+        self._peer_failures: dict = {}
+        self._bad_peers: set = set()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def can_start(self, now: float) -> bool:
+        """A new session may begin: never ran, or the previous one
+        (completed or fallen back) finished REARM_S ago."""
+        with self._lock:
+            if self.active:
+                return False
+            if self.state == "idle":
+                return True
+            return (
+                self._finished_at is not None
+                and now - self._finished_at >= self.REARM_S
+            )
+
+    def start(self) -> bool:
+        """Begin (or ignore if already running) a catch-up session.
+        Returns whether a new session started."""
+        with self._lock:
+            if self.active:
+                return False
+            self._reset_session()
+            self.active = True
+            self.state = "manifest"
+            self._want = ("manifest",)
+            self.counters.add("started")
+            self._send_current(self.clock())
+            return True
+
+    def stop(self) -> None:
+        with self._lock:
+            self.active = False
+            self.state = "idle"
+            self._want = None
+
+    # -- peer selection ----------------------------------------------------
+
+    def _eligible_peers(self) -> list:
+        return [p for p in self.peers() if p not in self._bad_peers]
+
+    def _pick_peer(self):
+        """Fewest recorded failures wins; ties break on list order (the
+        owner supplies a stable order, so runs replay identically)."""
+        cands = self._eligible_peers()
+        if not cands:
+            return None
+        return min(
+            cands, key=lambda p: (self._peer_failures.get(p, 0),
+                                  cands.index(p))
+        )
+
+    def _maybe_switch_peer(self) -> None:
+        best = self._pick_peer()
+        if best is not None and best != self._peer:
+            self._peer = best
+            self.counters.add("peer_switches")
+
+    # -- request machinery -------------------------------------------------
+
+    def _send_current(self, now: float) -> None:
+        if self._want is None:
+            return
+        if self._peer is None:
+            self._peer = self._pick_peer()
+        if self._peer is None:
+            self._fallback("no_peers")
+            return
+        if self._want[0] == "manifest":
+            msg = GetSegments(-1, 0)
+        else:
+            msg = GetSegments(self._want[1], len(self._buf))
+        self.counters.add("requests")
+        self._deadline = now + self.request_timeout
+        try:
+            self.send(self._peer, msg)
+        except Exception:  # noqa: BLE001 — a dead transport is a timeout
+            pass
+
+    def tick(self, now: float) -> None:
+        """Advance timeouts/backoff; the owner calls this from its timer."""
+        with self._lock:
+            self._tick_locked(now)
+
+    def _tick_locked(self, now: float) -> None:
+        if not self.active or self._want is None:
+            return
+        if self._deadline is not None and now >= self._deadline:
+            # request timed out: score the peer, back off exponentially
+            # (seeded jitter decorrelates a fleet of cold nodes), rotate
+            # to the best-scoring other peer, give up after max_retries
+            self._deadline = None
+            self.counters.add("timeouts")
+            if self._peer is not None:
+                self._peer_failures[self._peer] = (
+                    self._peer_failures.get(self._peer, 0) + 1
+                )
+            self._attempts += 1
+            if self._attempts > self.max_retries:
+                self._fallback("retries_exhausted")
+                return
+            delay = min(
+                self.backoff_max,
+                self.backoff_base * (2 ** (self._attempts - 1)),
+            )
+            delay *= 1.0 + 0.25 * self.rng.random()  # jitter
+            self._backoff_until = now + delay
+            self.counters.add("backoffs")
+            self._maybe_switch_peer()
+            return
+        if self._deadline is None and now >= self._backoff_until:
+            self.counters.add("retries")
+            self._send_current(now)
+
+    # -- replies -----------------------------------------------------------
+
+    def on_manifest(self, peer, segments: list) -> None:
+        with self._lock:
+            if not self.active or self._want != ("manifest",):
+                self.counters.add("late_replies")
+                return
+            if peer != self._peer:
+                self.counters.add("late_replies")
+                return
+            self.counters.add("replies")
+            self._attempts = 0
+            self._deadline = None
+            self._sizes = {int(s[0]): int(s[1]) for s in segments}
+            self._queue = sorted(self._sizes)
+            if not self._queue:
+                self._complete()
+                return
+            self.state = "fetch"
+            self._next_segment()
+
+    def _next_segment(self) -> None:
+        if not self._queue:
+            self._complete()
+            return
+        self._cur_seg = self._queue.pop(0)
+        self._cur_total = 0
+        self._buf = bytearray()
+        self._want = ("seg", self._cur_seg)
+        self._send_current(self.clock())
+
+    def on_data(self, peer, msg: SegmentData) -> None:
+        with self._lock:
+            if (
+                not self.active
+                or self._want is None
+                or self._want[0] != "seg"
+                or msg.seg_id != self._want[1]
+                or peer != self._peer
+                or msg.offset != len(self._buf)
+            ):
+                self.counters.add("late_replies")
+                return
+            self.counters.add("replies")
+            self._attempts = 0
+            self._deadline = None
+            # transfer-size defense: the claimed total is bounded by the
+            # manifest-advertised size (plus active-segment growth
+            # slack) and a hard ceiling — a hostile total must never buy
+            # unbounded buffering on the very node this path defends
+            limit = min(
+                self.MAX_SEGMENT_TRANSFER,
+                self._sizes.get(msg.seg_id, 0) + self.GROWTH_SLACK,
+            )
+            if msg.total > limit or len(self._buf) + len(msg.data) > limit:
+                self._condemn_peer(peer, "oversized_transfer")
+                return
+            if len(self._buf) < msg.total and not msg.data:
+                # the peer claims more bytes exist but sent none: it
+                # cannot serve what it advertised — treating the torn
+                # buffer as a complete segment would silently record a
+                # partial transfer as success
+                self._condemn_peer(peer, "short_transfer")
+                return
+            self._buf.extend(msg.data)
+            self._cur_total = msg.total
+            if len(self._buf) < self._cur_total:
+                self._send_current(self.clock())  # next chunk
+                return
+            self._ingest_segment(peer)
+
+    def _condemn_peer(self, peer, why: str) -> None:
+        """Per-peer fallback: this peer served garbage (bad records, a
+        hostile total, or a short transfer) — condemn it for the session
+        and refetch the SAME segment elsewhere; only an out-of-peers
+        session falls back to the node-granular walk."""
+        self.counters.add("garbage_peers")
+        if self.note_byzantine is not None:
+            self.note_byzantine("garbage_segment", peer=None,
+                                seg=self._cur_seg, why=why)
+        self._bad_peers.add(peer)
+        self._peer = None
+        if not self._eligible_peers():
+            self._fallback("all_peers_garbage")
+            return
+        self._buf = bytearray()
+        self._maybe_switch_peer()
+        self._send_current(self.clock())
+
+    def _ingest_segment(self, peer) -> None:
+        """Verify and store a completed segment transfer. Every record is
+        content-addressed, so garbage is detected per record without any
+        out-of-band trust; ONE bad record condemns the transfer and the
+        serving peer (per-peer fallback), never the whole session."""
+        good: list[tuple[bytes, int, bytes]] = []
+        bad = 0
+        try:
+            for key, type_byte, blob in iter_segment_records(bytes(self._buf)):
+                if _sha512_half(blob) == key:
+                    good.append((key, type_byte, blob))
+                else:
+                    bad += 1
+        except ValueError:
+            bad += 1
+        if bad:
+            self.counters.add("garbage_records", bad)
+            self._condemn_peer(peer, "bad_records")
+            return
+        for key, type_byte, blob in good:
+            try:
+                self.store(type_byte, key, blob)
+            except Exception:  # noqa: BLE001 — a failed local write must
+                pass           # not kill the session; the tree walk re-fetches
+        self.counters.add_many(
+            segments=1, records=len(good), bytes=len(self._buf)
+        )
+        self._next_segment()
+
+    # -- terminal states ---------------------------------------------------
+
+    def _complete(self) -> None:
+        self.active = False
+        self.state = "done"
+        self._want = None
+        self._finished_at = self.clock()
+        self.counters.add("completed")
+        if self.on_complete is not None:
+            try:
+                self.on_complete()
+            except Exception:  # noqa: BLE001 — completion hook only
+                pass
+
+    def _fallback(self, reason: str) -> None:
+        """Give up on the bulk path for THIS session: the node-granular
+        GetLedger walk (always running underneath) remains the way
+        forward, and can_start re-arms a fresh session after REARM_S —
+        one bad episode must not disable bulk catch-up forever. Loud in
+        the counters, silent in behavior — graceful degradation."""
+        self.active = False
+        self.state = "fallback"
+        self._want = None
+        self._finished_at = self.clock()
+        self.counters.add("fallbacks")
+
+    def get_json(self) -> dict:
+        out = self.counters.snapshot()
+        with self._lock:
+            out["state"] = self.state
+            out["active"] = self.active
+        return out
+
+
+def _sha512_half(blob: bytes) -> bytes:
+    from ..utils.hashes import sha512_half
+
+    return sha512_half(blob)

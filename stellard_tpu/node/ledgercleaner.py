@@ -223,12 +223,18 @@ class OnlineDeleter:
        reclaim the bytes.
     """
 
-    def __init__(self, node, retain: int, interval: int = 0):
+    def __init__(self, node, retain: int, interval: int = 0,
+                 sql_trim: bool = True):
         self.node = node
         self.retain = max(1, int(retain))
         self.interval = int(interval) if interval > 0 else max(
             1, self.retain // 2
         )
+        # also trim the txdb SQL mirror (tx rows, account index, ledger
+        # headers, validations) below the same horizon, on the same
+        # drain worker — nodestore-only rotation leaves SQLite growing
+        # without bound ([node_db] sql_trim=0 opts out)
+        self.sql_trim = bool(sql_trim)
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -246,6 +252,8 @@ class OnlineDeleter:
         self.last_removed = 0
         self.last_sweep_ms = 0.0
         self.last_retain_floor = 0
+        self.sql_rows_trimmed = 0
+        self.last_sql_trimmed = 0
 
     # -- hooks -------------------------------------------------------------
 
@@ -306,9 +314,9 @@ class OnlineDeleter:
                 try:
                     # catch-up mark: ledgers persisted since the mark
                     # began — contiguous from validated_seq+1, walked by
-                    # direct header lookup (the Ledgers table is never
-                    # pruned, so a full ledger_seqs() scan here would
-                    # grow without bound and stall the drain worker)
+                    # direct header lookup (a full ledger_seqs() scan
+                    # here would stall the drain worker, and before SQL
+                    # trimming existed it also grew without bound)
                     seq = validated_seq + 1
                     while True:
                         hdr = self.node.txdb.get_ledger_header(seq=seq)
@@ -323,7 +331,24 @@ class OnlineDeleter:
                         "online-delete apply failed (sweep skipped)"
                     )
                     return
+                trimmed = 0
+                if self.sql_trim:
+                    # SQL mirror rotation, ON the drain worker (it owns
+                    # every txdb write, so no batch can be concurrent):
+                    # the horizon is the same retain floor the mark used
+                    try:
+                        trimmed = sum(
+                            self.node.txdb.trim_below(lo).values()
+                        )
+                    except Exception:  # noqa: BLE001 — trimming is an
+                        # optimization over intact history; never fail
+                        # the sweep for it
+                        logging.getLogger("stellard.cleaner").exception(
+                            "online-delete SQL trim failed (skipped)"
+                        )
                 with self._lock:
+                    self.sql_rows_trimmed += trimmed
+                    self.last_sql_trimmed = trimmed
                     self.sweeps_completed += 1
                     self.nodes_removed += removed
                     self.last_marked = len(live)
@@ -396,4 +421,7 @@ class OnlineDeleter:
                 "last_removed": self.last_removed,
                 "last_sweep_ms": self.last_sweep_ms,
                 "last_retain_floor": self.last_retain_floor,
+                "sql_trim": self.sql_trim,
+                "sql_rows_trimmed": self.sql_rows_trimmed,
+                "last_sql_trimmed": self.last_sql_trimmed,
             }

@@ -54,6 +54,19 @@ class LocalTxs:
                 cur.failed = False
                 cur.submit_seq = max(cur.submit_seq, ledger_seq)
 
+    def rebase(self, ledger_seq: int) -> int:
+        """Fresh retry horizon for every tracked tx, used at fork repair
+        (LCL switch): the expiry horizon counts ledgers on the chain a
+        tx could have been INCLUDED in — after adopting the network's
+        chain (whose seq may be far past submit_seq + HOLD_LEDGERS), a
+        client tx submitted to the losing side must get its HOLD_LEDGERS
+        retries against the authoritative chain, not be silently expired
+        by a seq jump it never saw. Returns entries rebased."""
+        with self._lock:
+            for item in self._txns.values():
+                item.submit_seq = max(item.submit_seq, ledger_seq)
+            return len(self._txns)
+
     def remove(self, txid: bytes) -> bool:
         """Stop tracking a tx (wired as TxQ.on_drop: admission-queue
         eviction / expiry / promote-drop): the queue's drop decision

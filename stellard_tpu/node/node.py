@@ -217,6 +217,7 @@ class Node:
                 self,
                 retain=cfg.node_db_online_delete,
                 interval=cfg.node_db_online_delete_interval,
+                sql_trim=bool(cfg.node_db_sql_trim),
             )
 
         # crypto plane (north star: pluggable cpu|tpu batch backends).
@@ -479,6 +480,29 @@ class Node:
                 return obj.data if obj is not None else None
 
             self.overlay.node.inbound.local_fetch = _local_node_blob
+
+            # segment-granular catch-up (ROADMAP item 4 follow-on): a
+            # cold/lagging node bulk-transfers whole store segments from
+            # a peer (wire GetSegments/SegmentData over PR 7's
+            # fetch_segment read door) so the tree acquisition above
+            # resolves locally; timeout/retry/backoff/peer-scoring in
+            # node/inbound.SegmentCatchup, counters in get_counts
+            backend = self.nodestore.backend
+            if hasattr(backend, "fetch_segment"):
+                from ..nodestore.core import NodeObjectType
+                from .inbound import SegmentCatchup
+
+                vn = self.overlay.node
+                vn.segment_source = backend
+                vn.segment_catchup = SegmentCatchup(
+                    send=self.overlay.send_segments_request,
+                    peers=self.overlay.segment_peers,
+                    store=lambda tb, key, blob: self.nodestore.store(
+                        NodeObjectType(tb), key, blob
+                    ),
+                    clock=self.overlay._clock,
+                    note_byzantine=vn.note_byzantine,
+                )
 
             # persistence rides the close pipeline's dedicated ORDERED
             # worker, NOT the consensus tick (the hook fires under the
@@ -924,7 +948,12 @@ class Node:
                         self._last_rounds = rounds
                         self._last_round_at = now
                     recently = now - getattr(self, "_last_round_at", 0.0) < 60.0
-                    if rounds > 0 and recently:
+                    if vn.degraded:
+                        # closing without quorum validation: report
+                        # TRACKING honestly instead of a confident FULL
+                        # from a node whose ledgers nobody signs
+                        self.ops.mode = OperatingMode.TRACKING
+                    elif rounds > 0 and recently:
                         self.ops.mode = OperatingMode.FULL
                     elif self.overlay.peer_count() > 0:
                         self.ops.mode = OperatingMode.CONNECTED

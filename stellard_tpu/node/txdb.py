@@ -46,6 +46,16 @@ class TxDatabase:
             """CREATE INDEX IF NOT EXISTS AcctTxIDIndex ON
                  AccountTransactions(TransID)"""
         )
+        # retention trimming deletes by ledger-seq range (reference:
+        # DBInit.cpp TxLgrIndex / AcctTxLgrIndex back the same walk)
+        cur.execute(
+            """CREATE INDEX IF NOT EXISTS TxLgrIndex ON
+                 Transactions(LedgerSeq)"""
+        )
+        cur.execute(
+            """CREATE INDEX IF NOT EXISTS AcctTxLgrIndex ON
+                 AccountTransactions(LedgerSeq)"""
+        )
         cur.execute(
             """CREATE TABLE IF NOT EXISTS Ledgers (
                  LedgerHash TEXT PRIMARY KEY, LedgerSeq INTEGER,
@@ -292,6 +302,63 @@ class TxDatabase:
                 "SELECT LedgerSeq FROM Ledgers ORDER BY LedgerSeq"
             ).fetchall()
         return [r[0] for r in rows]
+
+    def trim_below(self, ledger_seq: int) -> dict:
+        """Delete transaction/ledger history rows STRICTLY below the
+        retention horizon — the SQL half of online deletion (the
+        NodeStore sweep bounds the tree store; without this the txdb
+        mirror grows forever under [node_db] online_delete rotation).
+        One transaction, then a WAL truncate so the file's high-water
+        mark actually stops climbing. Returns rows deleted per table."""
+        with self._lock:
+            cur = self._conn.cursor()
+            hashes = [
+                r[0] for r in cur.execute(
+                    "SELECT LedgerHash FROM Ledgers WHERE LedgerSeq < ?",
+                    (ledger_seq,),
+                )
+            ]
+            deleted = {}
+            cur.executemany(
+                "DELETE FROM Validations WHERE LedgerHash = ?",
+                [(h,) for h in hashes],
+            )
+            deleted["validations"] = max(cur.rowcount, 0)
+            cur.execute(
+                "DELETE FROM Transactions WHERE LedgerSeq < ?",
+                (ledger_seq,),
+            )
+            deleted["transactions"] = cur.rowcount
+            cur.execute(
+                "DELETE FROM AccountTransactions WHERE LedgerSeq < ?",
+                (ledger_seq,),
+            )
+            deleted["account_transactions"] = cur.rowcount
+            cur.execute(
+                "DELETE FROM Ledgers WHERE LedgerSeq < ?", (ledger_seq,)
+            )
+            deleted["ledgers"] = cur.rowcount
+            self._conn.commit()
+            # bound the WAL too: a delete-heavy transaction otherwise
+            # leaves the whole trimmed range sitting in the -wal file
+            cur.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+        return deleted
+
+    def counts(self) -> dict:
+        """Row counts per table (observability + the disk-bound test)."""
+        with self._lock:
+            cur = self._conn.cursor()
+            return {
+                "transactions": cur.execute(
+                    "SELECT COUNT(*) FROM Transactions"
+                ).fetchone()[0],
+                "account_transactions": cur.execute(
+                    "SELECT COUNT(*) FROM AccountTransactions"
+                ).fetchone()[0],
+                "ledgers": cur.execute(
+                    "SELECT COUNT(*) FROM Ledgers"
+                ).fetchone()[0],
+            }
 
     def save_validation(self, ledger_hash: bytes, node_public: bytes,
                         sign_time: int, raw: bytes) -> None:

@@ -97,6 +97,19 @@ class UniqueNodeList:
                 rec["last_seq"] = max(rec["last_seq"], ledger_seq)
             rec["last_seen"] = time.time()
 
+    def on_byzantine(self, public: bytes, kind: str) -> None:
+        """Per-validator misbehavior bookkeeping: recognized hostile
+        inputs attributable to a SIGNING key (equivocating proposals,
+        conflicting validations, bad signatures claiming this key).
+        Reported by `unl_list`/`unl_score` so an operator can see WHICH
+        trusted validator is misbehaving, not just that one is."""
+        with self._lock:
+            rec = self._seen.setdefault(
+                public, {"validations": 0, "last_seq": 0, "last_seen": 0.0}
+            )
+            byz = rec.setdefault("byzantine", {})
+            byz[kind] = byz.get(kind, 0) + 1
+
     # -- persistence ------------------------------------------------------
 
     def save(self) -> None:
@@ -128,5 +141,6 @@ class UniqueNodeList:
                     "trusted": True,
                     "validations": seen.get("validations", 0),
                     "last_ledger_seq": seen.get("last_seq", 0),
+                    "byzantine_events": dict(seen.get("byzantine", {})),
                 })
             return out

@@ -49,6 +49,14 @@ def _locked(method):
 
 
 class ValidatorNode:
+    # closed-vs-validated lag (in ledgers) beyond which the node reports
+    # itself degraded: it is still CLOSING rounds (closing needs no
+    # quorum) but the network is not validating them — an operator must
+    # see "tracking", not a confident "proposing/full" from a node whose
+    # chain nobody else signs (reference: NetworkOPs::setMode demotes on
+    # lost consensus)
+    DEGRADE_LAG = 4
+
     def __init__(
         self,
         key: KeyPair,
@@ -85,9 +93,27 @@ class ValidatorNode:
 
         self.lm = LedgerMaster(hash_batch=hash_batch)
         self.lm.min_validations = quorum
+        # byzantine-defense counters (`byzantine.*` in get_counts): every
+        # hostile input the node recognized and neutralized bumps one of
+        # these and emits a `byzantine.<kind>` tracer instant — the
+        # anti-vacuity evidence the adversarial scenarios assert on
+        from .metrics import AtomicCounters
+
+        self.defense = AtomicCounters(
+            "bad_proposal_sig", "bad_validation_sig",
+            "conflicting_proposal", "duplicate_proposal",
+            "conflicting_validation", "duplicate_validation",
+            "stale_validation", "untrusted_validation",
+            "oversized_txset", "txset_mismatch", "malformed_frame",
+            "garbage_segment",
+        )
+        # optional sink for per-peer misbehavior bookkeeping (the overlay
+        # wires UniqueNodeList.on_byzantine here)
+        self.on_byzantine: Optional[Callable[[str, Optional[bytes]], None]] = None
         self.validations = ValidationsStore(
             is_trusted=lambda pk: pk in self.unl, now=network_time
         )
+        self.validations.note_byzantine = self.note_byzantine
         # shared with the application container when one embeds this
         # validator: RPC-plane and peer-plane sig verdicts / suppression
         # must be ONE state (reference: a single getApp().getHashRouter())
@@ -120,6 +146,38 @@ class ValidatorNode:
             send=adapter.request_ledger_data, hash_batch=hash_batch
         )
         self.inbound.on_complete = self._ledger_acquired
+        # segment-granular catch-up plane (node/inbound.SegmentCatchup):
+        # wired by the owner when a segment-capable store exists.
+        # `segment_source` answers peers' GetSegments (an object with
+        # segments()/fetch_segment(), i.e. the segstore backend).
+        self.segment_catchup = None
+        self.segment_source = None
+        # honest health reporting (see DEGRADE_LAG): transitions are
+        # tracer-visible and counted, state rides consensus_info and the
+        # container's operating mode
+        self._degraded = False
+        self.degrade_transitions = 0
+        # last VALIDATED seq the LocalTxs inclusion-sweep ran against
+        self._local_sweep_seq = 0
+
+    # -- byzantine defense -------------------------------------------------
+
+    def note_byzantine(self, kind: str, peer: Optional[bytes] = None,
+                       **info) -> None:
+        """Record one recognized-and-neutralized hostile input: counter
+        (`defense`), tracer instant (`byzantine.<kind>`), and — when the
+        offender is an identified signer — the per-validator misbehavior
+        bookkeeping hook (UNL plane)."""
+        self.defense.add(kind)
+        self.lm.tracer.instant(
+            "byzantine." + kind, "consensus",
+            peer=peer.hex()[:16] if peer else None, **info,
+        )
+        if self.on_byzantine is not None and peer is not None:
+            try:
+                self.on_byzantine(kind, peer)
+            except Exception:  # noqa: BLE001 — bookkeeping must not
+                pass           # interfere with message handling
 
     # -- lifecycle --------------------------------------------------------
 
@@ -145,6 +203,7 @@ class ValidatorNode:
             hash_batch=self.hash_batch,
             idle_interval=self.idle_interval,
             voting=self.voting,
+            note_byzantine=self.note_byzantine,
         )
 
     @_locked
@@ -161,6 +220,40 @@ class ValidatorNode:
             self.inbound.expire_stale()
             for il in list(self.inbound.live.values()):
                 self.inbound.trigger(il)
+        # the segment bulk path's timeout/retry/backoff clock
+        if self.segment_catchup is not None:
+            self.segment_catchup.tick(self.clock())
+        self._update_health()
+
+    # -- health ------------------------------------------------------------
+
+    @property
+    def degraded(self) -> bool:
+        """True while we close ledgers the network does not validate
+        (quorum lost — partition, killed peers, or a fork we are on the
+        wrong side of)."""
+        return self._degraded
+
+    @property
+    def validator_state(self) -> str:
+        if self._degraded:
+            return "tracking"
+        return "proposing" if self.proposing else "observing"
+
+    def _update_health(self) -> None:
+        closed = self.lm.closed_ledger().seq
+        validated = self.lm.validated.seq if self.lm.validated else 0
+        degraded = (closed - validated) > self.DEGRADE_LAG
+        if degraded == self._degraded:
+            return
+        self._degraded = degraded
+        self.degrade_transitions += 1
+        self.lm.tracer.instant(
+            "consensus.degraded" if degraded else "consensus.recovered",
+            "consensus",
+            closed_seq=closed, validated_seq=validated,
+            state=self.validator_state,
+        )
 
     # -- catch-up ---------------------------------------------------------
 
@@ -233,6 +326,16 @@ class ValidatorNode:
                 self.inbound.abandon(cur)
             self._lcl_acquiring = best
             self.inbound.acquire(best, for_lcl=True)
+            # a cold/lagging node kicking off catch-up also starts the
+            # segment bulk transfer: whole store segments land locally
+            # so the tree walk above resolves via local_fetch instead of
+            # per-node network waves. can_start rate-limits to one
+            # session at a time, re-armed REARM_S after the last ended.
+            if (
+                self.segment_catchup is not None
+                and self.segment_catchup.can_start(self.clock())
+            ):
+                self.segment_catchup.start()
 
     def _ledger_acquired(self, ledger: Ledger) -> None:
         """Acquisition finished (reference: InboundLedger LADispatch →
@@ -270,6 +373,17 @@ class ValidatorNode:
         for led in reversed(chain):
             self._fire_on_ledger(led)
         self.begin_round()
+        # fork-repair client contract: local submissions that rode the
+        # LOSING chain re-apply against the adopted one with a fresh
+        # retry horizon — without the rebase, the adoption's seq jump
+        # silently expired them out of LocalTxs (found by the
+        # partition_kills scenario: 40/69 client txs lost)
+        if len(self.local_txs):
+            self.local_txs.rebase(ledger.seq)
+            self._sweep_local_txs()
+            self.local_txs.apply_to_open(
+                self.lm, TxParams.OPEN_LEDGER | TxParams.RETRY
+            )
 
     def _fire_on_ledger(self, ledger: Ledger) -> None:
         for cb in self.on_ledger:
@@ -293,13 +407,26 @@ class ValidatorNode:
         self.rounds_completed += 1
         self._fire_on_ledger(ledger)
         # local submissions that missed this ledger re-apply to the new
-        # open ledger; landed/expired ones sweep (reference LocalTxs)
-        self.local_txs.sweep(ledger)
+        # open ledger; landed/expired ones sweep (reference LocalTxs).
+        # The sweep runs against VALIDATED ledgers only — sweeping the
+        # just-closed ledger treated inclusion in a ledger the network
+        # never validated as done, so a client tx committed on a LOSING
+        # solo fork vanished at fork repair instead of re-applying
+        # (found by the partition_kills scenario)
+        self._sweep_local_txs()
         if len(self.local_txs):
             self.local_txs.apply_to_open(
                 self.lm, TxParams.OPEN_LEDGER | TxParams.RETRY
             )
         self.begin_round()
+
+    def _sweep_local_txs(self) -> None:
+        """Inclusion/expiry sweep against the latest quorum-validated
+        ledger (once per validated seq)."""
+        val = self.lm.validated
+        if val is not None and val.seq != self._local_sweep_seq:
+            self._local_sweep_seq = val.seq
+            self.local_txs.sweep(val)
 
     # -- transaction submission ------------------------------------------
 
@@ -422,6 +549,9 @@ class ValidatorNode:
         if not (flags & SF_SIGGOOD):
             if not self._verify([prop]):
                 self.router.set_flag(pid, SF_BAD)
+                self.note_byzantine(
+                    "bad_proposal_sig", peer=prop.node_public
+                )
                 return False
             self.router.set_flag(pid, SF_SIGGOOD)
         prop.set_sig_verdict(True)
@@ -449,9 +579,19 @@ class ValidatorNode:
         if not (flags & SF_SIGGOOD):
             if not self._verify([val]):
                 self.router.set_flag(vid, SF_BAD)
+                self.note_byzantine(
+                    "bad_validation_sig", peer=val.signer or None
+                )
                 return False
             self.router.set_flag(vid, SF_SIGGOOD)
         val.set_sig_verdict(True)
+        if val.signer not in self.unl:
+            # a correctly-signed validation from a key outside the UNL
+            # (byzantine "self-signed" validation): stored untrusted —
+            # zero quorum weight — but counted as evidence
+            self.note_byzantine(
+                "untrusted_validation", peer=val.signer or None
+            )
         with self.lock:
             # validation arrival on the round timeline (trace id = the
             # validated ledger's seq when the peer reported one)
@@ -490,6 +630,58 @@ class ValidatorNode:
 
         return serve_get_ledger(self.lm.get_ledger_by_hash(msg.ledger_hash), msg)
 
+    def serve_get_segments(self, msg):
+        """Answer a peer's GetSegments from the wired segment source
+        (segstore backend): manifest for seg_id < 0, else one bounded
+        chunk of the segment's raw bytes. NOT under the master lock —
+        segment reads are pure store IO and must not stall consensus."""
+        from ..overlay.wire import SEGMENT_CHUNK, SegmentData
+
+        src = self.segment_source
+        if src is None:
+            return None
+        if msg.seg_id < 0:
+            rows = [
+                (d["id"], d["size"], d["live_bytes"], bool(d["active"]))
+                for d in src.segments()
+            ]
+            return SegmentData(seg_id=-1, segments=rows)
+        off = max(0, int(msg.offset))
+        try:
+            # chunked read: serving a multi-chunk transfer must not
+            # re-read the whole segment per request
+            got = src.fetch_segment(msg.seg_id, offset=off,
+                                    length=SEGMENT_CHUNK)
+        except TypeError:  # sources without the chunk signature
+            got = src.fetch_segment(msg.seg_id)
+            if got is None:
+                return None
+            meta, data = got
+            return SegmentData(
+                seg_id=msg.seg_id, total=len(data), offset=off,
+                data=data[off: off + SEGMENT_CHUNK],
+            )
+        if got is None:
+            return None
+        meta, data = got
+        return SegmentData(
+            seg_id=msg.seg_id,
+            total=int(meta["size"]),
+            offset=off,
+            data=data,
+        )
+
+    def handle_segment_data(self, peer, msg) -> None:
+        """Route a SegmentData reply into the bulk catch-up machinery
+        (`peer` is the transport's peer id — simnet nid / node public)."""
+        sc = self.segment_catchup
+        if sc is None:
+            return
+        if msg.seg_id < 0:
+            sc.on_manifest(peer, msg.segments)
+        else:
+            sc.on_data(peer, msg)
+
     @_locked
     def handle_txset(self, txset: TxSet) -> None:
         """A shared/acquired candidate set arrived
@@ -527,6 +719,14 @@ class ValidatorNode:
         info = {
             "rounds_completed": self.rounds_completed,
             "validation_count": self.validations.size(),
+            # honest health: "tracking" while we close ledgers nobody
+            # validates, "proposing"/"observing" otherwise
+            "validator_state": self.validator_state,
+            "degraded": self._degraded,
+            "closed_seq": self.lm.closed_ledger().seq,
+            "validated_seq": (
+                self.lm.validated.seq if self.lm.validated else 0
+            ),
         }
         if self.round is not None:
             info["round"] = self.round.get_json()

@@ -658,17 +658,26 @@ class SegStoreBackend(Backend):
                 for sid, seg in sorted(self._segs.items())
             ]
 
-    def fetch_segment(self, seg_id: int) -> Optional[tuple[dict, bytes]]:
-        """(meta, raw bytes) of one whole segment — contiguous hashed
-        byte ranges for catch-up serving: every record's blob is exactly
-        its hashed prefix-format bytes, so a receiver can verify each
-        record against its key without per-node round-trips."""
+    def fetch_segment(self, seg_id: int, offset: int = 0,
+                      length: Optional[int] = None,
+                      ) -> Optional[tuple[dict, bytes]]:
+        """(meta, raw bytes) of one segment — contiguous hashed byte
+        ranges for catch-up serving: every record's blob is exactly its
+        hashed prefix-format bytes, so a receiver can verify each record
+        against its key without per-node round-trips. ``offset``/
+        ``length`` bound the read so a chunked wire transfer costs
+        O(chunk) per request, not O(segment); meta always carries the
+        FULL segment size."""
         with self._lock:
             seg = self._segs.get(seg_id)
             if seg is None:
                 return None
             fd = self._read_fd(seg_id)
-            data = os.pread(fd, seg.size, 0)
+            off = max(0, int(offset))
+            n = seg.size - off
+            if length is not None:
+                n = min(n, int(length))
+            data = os.pread(fd, n, off) if n > 0 else b""
             return (
                 {
                     "id": seg_id,

@@ -38,7 +38,15 @@ GOSSIP_MAX = 12  # numberOfEndpoints = 2 * maxHops
 LIVECACHE_TTL = 30.0
 GOSSIP_INTERVAL = 5.0  # reference secondsPerMessage
 VALENCE_MAX = 10
-REDIAL_BACKOFF = 15.0  # seconds after a failed dial before retrying
+# reconnect hygiene: first failure backs a target off REDIAL_BACKOFF
+# seconds, consecutive failures double it up to REDIAL_BACKOFF_MAX, and
+# a deterministic per-(address, failure-count) jitter of up to 25%
+# decorrelates a fleet restarting against one dead seed. on_success
+# resets the ladder. (reference: connection attempts ride timer ticks;
+# the explicit ladder guarantees no tight redial spin against a
+# refusing/dead address regardless of timer rate.)
+REDIAL_BACKOFF = 15.0
+REDIAL_BACKOFF_MAX = 300.0
 
 
 class Bootcache:
@@ -170,6 +178,9 @@ class PeerFinder:
         self.livecache = Livecache(clock=self._clock)
         self._lock = threading.Lock()
         self._last_fail: dict[tuple[str, int], float] = {}
+        self._fail_count: dict[tuple[str, int], int] = {}
+        self.backoff_base = REDIAL_BACKOFF
+        self.backoff_max = REDIAL_BACKOFF_MAX
         for a in self.fixed:
             self.bootcache.insert(a)
 
@@ -179,11 +190,28 @@ class PeerFinder:
         self.bootcache.on_success(addr)
         with self._lock:
             self._last_fail.pop(addr, None)
+            self._fail_count.pop(addr, None)
 
     def on_failure(self, addr: tuple[str, int]) -> None:
         self.bootcache.on_failure(addr)
         with self._lock:
             self._last_fail[addr] = self._clock()
+            self._fail_count[addr] = self._fail_count.get(addr, 0) + 1
+
+    def backoff_delay(self, addr: tuple[str, int]) -> float:
+        """Current redial delay for an address: exponential in its
+        consecutive-failure count, capped, with deterministic jitter
+        (pure function of address and count — testable, yet two nodes
+        dialing one dead seed still spread out)."""
+        import zlib
+
+        with self._lock:
+            n = self._fail_count.get(addr, 0)
+        if n == 0:
+            return 0.0
+        delay = min(self.backoff_max, self.backoff_base * (2 ** (n - 1)))
+        seed = zlib.crc32(f"{addr[0]}:{addr[1]}:{n}".encode())
+        return delay * (1.0 + 0.25 * (seed % 1000) / 1000.0)
 
     # -- gossip -----------------------------------------------------------
 
@@ -246,7 +274,7 @@ class PeerFinder:
             if a in connected or a in dialing or a in targets:
                 return False
             last = self._last_fail.get(a)
-            return last is None or now - last >= REDIAL_BACKOFF
+            return last is None or now - last >= self.backoff_delay(a)
 
         for a in self.fixed:
             if eligible(a):

@@ -9,12 +9,22 @@ exercised together; only the transport is simulated.
 Topology is a full mesh by default; links can be cut (partitions) and
 given per-step latency. Time advances only via `step()`, so every run is
 bit-for-bit reproducible.
+
+Fault plane (the testkit scenario runner drives these): per-link
+drop/duplicate/extra-delay/jitter probabilities from ONE seeded RNG
+(`seed=` — identical seed, identical fault pattern), validator
+kill/revive (a down node neither sends, receives, nor ticks — in-flight
+messages to it are discarded at delivery), and per-SOURCE frame readers
+so one peer's malformed bytes can never desync another peer's framing
+(the TCP overlay gets this isolation from per-session sockets; the
+simulated transport must provide it explicitly).
 """
 
 from __future__ import annotations
 
 import heapq
 import itertools
+import random
 from typing import Callable, Optional
 
 from ..consensus.consensus import ConsensusAdapter
@@ -27,8 +37,10 @@ from ..state.ledger import Ledger
 from .wire import (
     FrameReader,
     GetLedger,
+    GetSegments,
     LedgerData,
     ProposeSet,
+    SegmentData,
     TxMessage,
     TxSetData,
     ValidationMessage,
@@ -58,7 +70,9 @@ class SimValidator(ConsensusAdapter):
     ):
         self.net = net
         self.nid = nid
-        self.reader = FrameReader()
+        # one reader per SOURCE: a byzantine peer's garbage must desync
+        # only its own stream, exactly like a per-session TCP socket
+        self.readers: dict[int, FrameReader] = {}
         self.node = ValidatorNode(
             key=key,
             unl=unl,
@@ -115,7 +129,16 @@ class SimValidator(ConsensusAdapter):
     # -- delivery ---------------------------------------------------------
 
     def deliver(self, src: int, data: bytes) -> None:
-        msgs = list(self.reader.feed(data))
+        reader = self.readers.setdefault(src, FrameReader())
+        try:
+            msgs = list(reader.feed(data))
+        except ValueError:
+            # malformed frame / out-of-schema type: drop THIS source's
+            # stream state (a real session would disconnect), count the
+            # offense, keep every other peer's framing intact
+            self.readers[src] = FrameReader()
+            self.node.note_byzantine("malformed_frame", peer_nid=src)
+            return
         # one delivery often carries several relayed txs: parse each
         # once and batch their signature verification through the plane
         # before dispatching. An unparseable tx drops only ITSELF —
@@ -148,12 +171,32 @@ class SimValidator(ConsensusAdapter):
         elif isinstance(msg, ValidationMessage):
             node.handle_validation(STValidation.from_bytes(msg.blob))
         elif isinstance(msg, TxSetData):
+            from ..consensus.txset import MAX_TXSET_BLOBS
+
+            if len(msg.tx_blobs) > MAX_TXSET_BLOBS:
+                # oversized candidate set: refuse before parsing a single
+                # blob (a byzantine peer must not buy O(huge) parse work)
+                node.note_byzantine("oversized_txset", peer_nid=src)
+                return
             ts = TxSet(node.hash_batch)
+            intact = True
             for blob in msg.tx_blobs:
-                tx = SerializedTransaction.from_bytes(blob)
+                try:
+                    tx = SerializedTransaction.from_bytes(blob)
+                except Exception:  # noqa: BLE001 — hostile blob
+                    intact = False
+                    break
                 ts.add(tx.txid(), blob)
-            if ts.hash() == msg.set_hash:  # integrity: recomputed root
+            if intact and ts.hash() == msg.set_hash:  # recomputed root
                 node.handle_txset(ts)
+            else:
+                node.note_byzantine("txset_mismatch", peer_nid=src)
+        elif isinstance(msg, GetSegments):
+            reply = node.serve_get_segments(msg)
+            if reply is not None:
+                self.net.send(self.nid, src, frame(reply))
+        elif isinstance(msg, SegmentData):
+            node.handle_segment_data(src, msg)
         elif isinstance(msg, GetLedger):
             reply = node.serve_get_ledger(msg)
             if reply is not None:
@@ -172,6 +215,7 @@ class SimNet:
         idle_interval: int = 4,
         genesis_account: Optional[bytes] = None,
         voting_factory=None,
+        seed: int = 0,
     ):
         self.step_ms = step_ms
         self.latency_ms = latency_steps * step_ms
@@ -180,6 +224,18 @@ class SimNet:
         # (deliver_at_ms, seq, dst, bytes)
         self._queue: list = []
         self._links_down: set[tuple[int, int]] = set()
+        # fault plane: ONE seeded stream drives every probabilistic
+        # fault, so a given seed replays the identical fault pattern
+        self.seed = seed
+        self.rng = random.Random(0x5EED ^ seed)
+        # (src, dst) -> {"drop": p, "dup": p, "delay_steps": n,
+        #               "jitter_steps": n} (directional)
+        self._link_faults: dict[tuple[int, int], dict] = {}
+        self._down: set[int] = set()
+        self.net_stats = {
+            "sent": 0, "dropped_link": 0, "dropped_fault": 0,
+            "dropped_down": 0, "duplicated": 0, "delayed": 0,
+        }
         self.accept_log: list[tuple[int, int, bytes]] = []  # (nid, seq, hash)
 
         self.keys = [
@@ -225,6 +281,46 @@ class SimNet:
             for b in group_b:
                 self.cut_link(a, b)
 
+    def set_link_fault(
+        self,
+        a: int,
+        b: int,
+        drop: float = 0.0,
+        dup: float = 0.0,
+        delay_steps: int = 0,
+        jitter_steps: int = 0,
+        bidirectional: bool = True,
+    ) -> None:
+        """Degrade a link: `drop`/`dup` are per-message probabilities,
+        `delay_steps` adds fixed latency, `jitter_steps` adds a uniform
+        random extra delay (which also REORDERS messages relative to the
+        base-latency ones — heapq delivery is by arrival time)."""
+        fault = {
+            "drop": drop, "dup": dup,
+            "delay_steps": delay_steps, "jitter_steps": jitter_steps,
+        }
+        self._link_faults[(a, b)] = fault
+        if bidirectional:
+            self._link_faults[(b, a)] = dict(fault)
+
+    def clear_link_fault(self, a: int, b: int) -> None:
+        self._link_faults.pop((a, b), None)
+        self._link_faults.pop((b, a), None)
+
+    # -- validator kill/revive --------------------------------------------
+
+    def kill(self, nid: int) -> None:
+        """Silence a validator: no sends, no deliveries, no timer ticks.
+        In-flight messages TO it are discarded at delivery time (a dead
+        process loses its socket buffers)."""
+        self._down.add(nid)
+
+    def revive(self, nid: int) -> None:
+        self._down.discard(nid)
+
+    def is_down(self, nid: int) -> bool:
+        return nid in self._down
+
     # -- transport --------------------------------------------------------
 
     def broadcast(self, src: int, data: bytes) -> None:
@@ -233,12 +329,34 @@ class SimNet:
                 self.send(src, dst, data)
 
     def send(self, src: int, dst: int, data: bytes) -> None:
-        if (src, dst) in self._links_down:
+        if src in self._down or dst in self._down:
+            self.net_stats["dropped_down"] += 1
             return
-        heapq.heappush(
-            self._queue,
-            (self.time_ms + self.latency_ms, next(self._seq), dst, src, data),
-        )
+        if (src, dst) in self._links_down:
+            self.net_stats["dropped_link"] += 1
+            return
+        self.net_stats["sent"] += 1
+        delay_ms = self.latency_ms
+        fault = self._link_faults.get((src, dst))
+        copies = 1
+        if fault is not None:
+            if fault["drop"] and self.rng.random() < fault["drop"]:
+                self.net_stats["dropped_fault"] += 1
+                return
+            if fault["dup"] and self.rng.random() < fault["dup"]:
+                copies = 2
+                self.net_stats["duplicated"] += 1
+            extra = fault["delay_steps"]
+            if fault["jitter_steps"]:
+                extra += self.rng.randrange(fault["jitter_steps"] + 1)
+            if extra:
+                delay_ms += extra * self.step_ms
+                self.net_stats["delayed"] += 1
+        for _ in range(copies):
+            heapq.heappush(
+                self._queue,
+                (self.time_ms + delay_ms, next(self._seq), dst, src, data),
+            )
 
     def on_ledger_accepted(self, nid: int, ledger: Ledger) -> None:
         self.accept_log.append((nid, ledger.seq, ledger.hash()))
@@ -260,9 +378,16 @@ class SimNet:
             self.time_ms += self.step_ms
             while self._queue and self._queue[0][0] <= self.time_ms:
                 _at, _seq, dst, src, data = heapq.heappop(self._queue)
+                if dst in self._down:
+                    # a dead process loses its socket buffers; messages
+                    # already in flight FROM a freshly-killed node still
+                    # arrive (they left its kernel before the crash)
+                    self.net_stats["dropped_down"] += 1
+                    continue
                 self.validators[dst].deliver(src, data)
             for v in self.validators:
-                v.node.on_timer()
+                if v.nid not in self._down:
+                    v.node.on_timer()
 
     def run_until(
         self, pred: Callable[[], bool], max_steps: int = 200

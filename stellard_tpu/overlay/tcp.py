@@ -50,11 +50,13 @@ from .wire import (
     Endpoints,
     FrameReader,
     GetLedger,
+    GetSegments,
     GetTxSet,
     Hello,
     LedgerData,
     Ping,
     ProposeSet,
+    SegmentData,
     TxMessage,
     TxSetData,
     ValidationMessage,
@@ -248,6 +250,14 @@ class TcpOverlay(ConsensusAdapter):
             proposing=proposing,
             router=router,
         )
+        if unl_store is not None:
+            # per-validator misbehavior bookkeeping: defense events with
+            # an identified trusted signer land on its UNL row
+            def _note_unl(kind: str, peer_pub: bytes) -> None:
+                if peer_pub in unl_store:
+                    unl_store.on_byzantine(peer_pub, kind)
+
+            self.node.on_byzantine = _note_unl
         self.peers: dict[bytes, _Peer] = {}  # node pubkey -> session
         self._dialing: set[tuple[str, int]] = set()  # dials in flight
         self.peerfinder = PeerFinder(
@@ -628,6 +638,9 @@ class TcpOverlay(ConsensusAdapter):
             # malformed frame / unknown message type (version skew): charge
             # and close this peer cleanly instead of killing the reader
             # thread (reference: PeerImp charge(feeInvalidRequest))
+            self.node.note_byzantine(
+                "malformed_frame", peer=peer.node_public or None
+            )
             self._charge(peer, FEE_INVALID_REQUEST)
         finally:
             with self._peers_lock:
@@ -847,13 +860,31 @@ class TcpOverlay(ConsensusAdapter):
             if accepted <= 0:  # oversized (-1) or all-garbage (0)
                 self._charge(peer, FEE_UNWANTED_DATA)
         elif isinstance(msg, TxSetData):
+            from ..consensus.txset import MAX_TXSET_BLOBS
+
+            if len(msg.tx_blobs) > MAX_TXSET_BLOBS:
+                # oversized candidate set: refused before parsing a
+                # single blob — one message must not buy O(huge) work
+                node.note_byzantine(
+                    "oversized_txset", peer=peer.node_public or None
+                )
+                self._charge(peer, FEE_BAD_DATA)
+                return
             ts = TxSet(node.hash_batch)
+            intact = True
             for blob in msg.tx_blobs:
-                tx = SerializedTransaction.from_bytes(blob)
+                try:
+                    tx = SerializedTransaction.from_bytes(blob)
+                except Exception:  # noqa: BLE001 — hostile blob
+                    intact = False
+                    break
                 ts.add(tx.txid(), blob)
-            if ts.hash() == msg.set_hash:
+            if intact and ts.hash() == msg.set_hash:
                 node.handle_txset(ts)
             else:
+                node.note_byzantine(
+                    "txset_mismatch", peer=peer.node_public or None
+                )
                 self._charge(peer, FEE_BAD_DATA)
         elif isinstance(msg, GetTxSet):
             ts = node.txset_cache.get(msg.set_hash)
@@ -866,6 +897,12 @@ class TcpOverlay(ConsensusAdapter):
             reply = node.serve_get_ledger(msg)
             if reply is not None:
                 peer.send(frame(reply))
+        elif isinstance(msg, GetSegments):
+            reply = node.serve_get_segments(msg)
+            if reply is not None:
+                peer.send(frame(reply))
+        elif isinstance(msg, SegmentData):
+            node.handle_segment_data(peer.node_public, msg)
         elif isinstance(msg, LedgerData):
             # only replies that actually advanced an acquisition score —
             # unsolicited LedgerData must not buy routing preference.
@@ -994,6 +1031,21 @@ class TcpOverlay(ConsensusAdapter):
             target = min(peers, key=_acq_score)
         target.acq_requests += 1
         target.send(frame(msg))
+
+    # segment catch-up transport hooks (node/inbound.SegmentCatchup)
+
+    def segment_peers(self) -> list[bytes]:
+        """Stable-ordered candidate peers for bulk segment transfer."""
+        with self._peers_lock:
+            return [pub for pub in sorted(self.peers) if self.peers[pub].alive]
+
+    def send_segments_request(self, peer_pub: bytes, msg) -> None:
+        with self._peers_lock:
+            p = self.peers.get(peer_pub)
+        if p is None or not p.alive:
+            raise OSError("segment peer gone")
+        p.acq_requests += 1
+        p.send(frame(msg))
 
     def on_accepted(self, ledger: Ledger, round_ms: int) -> None:
         self.node.round_accepted(ledger, round_ms)

@@ -29,6 +29,17 @@ framework-internal shape; only their byte encoding follows ripple.proto:
     ValidationMessage <-> TMValidation    (mt 41)
     GetObjects     <-> TMGetObjectByHash  (mt 42, query=true)
     ObjectsData    <-> TMGetObjectByHash  (mt 42, query=false)
+
+Two EXTENSION messages (mt 54/55, outside ripple.proto — both ends of a
+stellard-tpu private net speak them; a reference peer would reject them
+as out-of-schema, which is why the segment catch-up plane only engages
+against peers that answered a manifest request):
+
+    GetSegments    (mt 54)  segment-granular catch-up: manifest request
+                            (seg_id < 0) or one chunk of one segment
+    SegmentData    (mt 55)  manifest reply or a verified-by-content
+                            chunk of a store segment (nodestore/segstore
+                            ``fetch_segment`` read door)
 """
 
 from __future__ import annotations
@@ -58,6 +69,9 @@ __all__ = [
     "ClusterUpdate",
     "GetObjects",
     "ObjectsData",
+    "GetSegments",
+    "SegmentData",
+    "SEGMENT_CHUNK",
     "encode_message",
     "decode_message",
     "frame",
@@ -93,6 +107,9 @@ class MessageType(IntEnum):
     HAVE_TX_SET = 35
     VALIDATION = 41
     GET_OBJECTS = 42
+    # stellard-tpu extensions (outside ripple.proto)
+    GET_SEGMENTS = 54
+    SEGMENT_DATA = 55
 
 
 @dataclass
@@ -221,6 +238,35 @@ class ClusterUpdate:
     `repeated` — a member reports all cluster nodes it knows)."""
 
     nodes: list = field(default_factory=list)  # [ClusterStatus, ...]
+
+
+# one SegmentData chunk's payload budget: large enough that a few round
+# trips move a whole segment, small enough that one request's timeout
+# clock covers a bounded transfer
+SEGMENT_CHUNK = 1 << 20
+
+
+@dataclass
+class GetSegments:
+    """Segment-granular catch-up request: ``seg_id < 0`` asks for the
+    peer's segment manifest; otherwise one chunk of segment ``seg_id``
+    starting at ``offset``."""
+
+    seg_id: int = -1
+    offset: int = 0
+
+
+@dataclass
+class SegmentData:
+    """Manifest reply (``seg_id < 0``, ``segments`` rows) or one chunk of
+    one segment: ``total`` is the full segment size so the fetcher knows
+    when it holds the whole byte range."""
+
+    seg_id: int = -1
+    total: int = 0
+    offset: int = 0
+    data: bytes = b""
+    segments: list = field(default_factory=list)  # (id, size, live, active)
 
 
 @dataclass
@@ -480,6 +526,56 @@ def _dec_endpoints(buf: bytes) -> Endpoints:
     return Endpoints(out)
 
 
+def _enc_get_segments(m: GetSegments) -> bytes:
+    # seg_id rides +1 so the manifest sentinel (-1) stays a valid varint
+    return (
+        Encoder().varint(1, m.seg_id + 1).varint(2, m.offset).data()
+    )
+
+
+def _dec_get_segments(buf: bytes) -> GetSegments:
+    f = parse(buf)
+    return GetSegments(
+        seg_id=first_int(f, 1) - 1, offset=first_int(f, 2)
+    )
+
+
+def _enc_segment_data(m: SegmentData) -> bytes:
+    e = Encoder()
+    e.varint(1, m.seg_id + 1)
+    e.varint(2, m.total)
+    e.varint(3, m.offset)
+    if m.data:
+        e.blob(4, m.data)
+    for sid, size, live, active in m.segments:
+        row = (
+            Encoder().varint(1, sid + 1).varint(2, size)
+            .varint(3, live).varint(4, 1 if active else 0)
+        )
+        e.message(5, row)
+    return e.data()
+
+
+def _dec_segment_data(buf: bytes) -> SegmentData:
+    f = parse(buf)
+    segments = []
+    for sub in f.get(5, []):
+        rf = parse(sub)
+        segments.append((
+            first_int(rf, 1) - 1,
+            first_int(rf, 2),
+            first_int(rf, 3),
+            bool(first_int(rf, 4)),
+        ))
+    return SegmentData(
+        seg_id=first_int(f, 1) - 1,
+        total=first_int(f, 2),
+        offset=first_int(f, 3),
+        data=first_bytes(f, 4, b""),
+        segments=segments,
+    )
+
+
 def _enc_get_objects(m: GetObjects) -> bytes:
     e = Encoder()
     e.varint(1, 0)  # type otUNKNOWN
@@ -526,6 +622,8 @@ _ENCODERS = {
     ValidationMessage: (MessageType.VALIDATION, _enc_validation),
     GetObjects: (MessageType.GET_OBJECTS, _enc_get_objects),
     ObjectsData: (MessageType.GET_OBJECTS, _enc_objects_data),
+    GetSegments: (MessageType.GET_SEGMENTS, _enc_get_segments),
+    SegmentData: (MessageType.SEGMENT_DATA, _enc_segment_data),
 }
 
 _DECODERS = {
@@ -541,6 +639,8 @@ _DECODERS = {
     MessageType.HAVE_TX_SET: _dec_have_set,
     MessageType.VALIDATION: _dec_validation,
     MessageType.GET_OBJECTS: _dec_get_objects,
+    MessageType.GET_SEGMENTS: _dec_get_segments,
+    MessageType.SEGMENT_DATA: _dec_segment_data,
 }
 
 

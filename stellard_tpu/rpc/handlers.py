@@ -438,6 +438,23 @@ def do_get_counts(ctx: Context) -> dict:
     overlay = getattr(node, "overlay", None)
     if overlay is not None:
         out["peers"] = overlay.peer_count()
+        vn = getattr(overlay, "node", None)
+        if vn is not None:
+            # byzantine-defense counters: hostile inputs recognized and
+            # neutralized (bad sigs, equivocation, oversized/forged
+            # txsets, malformed frames, garbage segments)
+            defense = getattr(vn, "defense", None)
+            if defense is not None:
+                out["byzantine"] = defense.snapshot()
+            # catch-up acquisition plane: live tree acquisitions plus
+            # the segment bulk path's timeout/retry/backoff counters
+            acq = {
+                "inbound_live": len(vn.inbound.live),
+            }
+            sc = getattr(vn, "segment_catchup", None)
+            if sc is not None:
+                acq["segfetch"] = sc.get_json()
+            out["acquisition"] = acq
     return out
 
 

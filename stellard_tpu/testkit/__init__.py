@@ -1,0 +1,30 @@
+"""Adversarial scenario plane.
+
+One scenario definition — validators, fault schedule, workload,
+byzantine slots — drives TWO transports:
+
+- the deterministic in-process simnet (``scenario.run_simnet``): seeded,
+  discrete-time, bit-reproducible — the same seed replays the identical
+  fault schedule and produces the identical per-scenario scorecard
+  (FoundationDB's deterministic-simulation argument, SIGMOD 2021);
+- the real TCP+TLS process net (``scenario.run_tcp``, tools/netlab.py
+  plumbing): wall-clock, kill -9 real processes — the same scenario
+  shape under genuine sockets and schedulers.
+
+Yuan et al. (OSDI 2014) found most catastrophic distributed-system
+failures hide in untested error-handling paths reachable by SIMPLE fault
+injection; this package makes those paths a regression-gated surface
+(tools/scenariosmoke.py in tier-1) instead of a soak-day anecdote.
+"""
+
+from .schedule import FaultSchedule
+from .scenario import Scenario, run_simnet
+from .scenarios import MATRIX, build_scenario
+
+__all__ = [
+    "FaultSchedule",
+    "Scenario",
+    "run_simnet",
+    "MATRIX",
+    "build_scenario",
+]

@@ -1,0 +1,499 @@
+"""Scenario runner + per-scenario scorecard.
+
+A ``Scenario`` is pure data (validators, fault schedule builder,
+workload builder, byzantine slots, catch-up/admission knobs). The
+simnet runner replays it deterministically: one seed → one fault
+schedule → one scorecard, byte-identical across runs (pinned by test
+and by tools/scenariosmoke.py). The TCP runner (testkit.tcpnet) drives
+the kill/revive + flood subset of the same definitions against real
+processes.
+
+Scorecard fields (doc/scenarios.md):
+
+    converged / tail_steps / final_seq / final_hash / single_hash
+    validated_seqs   per-validator validated seq at the end
+    submitted / committed / commit_rate
+    splice           delta-replay spliced/fallback/invalidated (summed
+                     over honest validators)
+    byzantine        defense counters summed over honest validators
+    byzantine_emitted  what the hostile slots actually sent (anti-vacuity)
+    degraded_transitions  honest proposing→tracking→proposing flips
+    catchup          cold-node segment-path counters + synced flag
+    txq              admission stats + fairness verdicts (fee-order
+                     drain, no-starvation, replace-by-fee)
+    net              transport-level sent/dropped/duplicated/delayed
+    fault_digest     digest of the replayed fault schedule
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field as dc_field
+from typing import Callable, Optional
+
+from ..engine.engine import TxParams
+from ..overlay.simnet import SimNet
+from ..overlay.wire import frame
+from ..protocol.sttx import SerializedTransaction
+from ..protocol.ter import TER
+from .schedule import FaultSchedule
+from .workloads import TxFactory
+
+__all__ = ["Scenario", "run_simnet", "apply_event"]
+
+
+@dataclass
+class Scenario:
+    name: str
+    seed: int = 0
+    n_validators: int = 4
+    quorum: int = 3
+    steps: int = 60
+    latency_steps: int = 1
+    idle_interval: int = 4
+    # builders: called with (schedule, scenario) / (factory, rng, scenario)
+    build_schedule: Optional[Callable] = None
+    build_workload: Optional[Callable] = None
+    # nid -> behavior tuple (testkit.byzantine.BEHAVIORS subset)
+    byzantine: dict = dc_field(default_factory=dict)
+    # cold-node catch-up: nids silenced from step 0, revived at join_at,
+    # syncing via the segment bulk path; `segments` gives every honest
+    # validator a real segstore the scenario persists closed ledgers to
+    cold_nodes: tuple = ()
+    join_at: int = 0
+    segments: bool = False
+    segment_bytes: int = 65536  # segstore floors at 64 KiB
+    garbage_server: Optional[int] = None   # serving nid that corrupts
+    kill_server_at: Optional[int] = None   # kill the 2nd server mid-sync
+    # admission plane: attach a per-validator TxQ (pinned soft cap) and
+    # route injected txs through admit() on every validator
+    txq_cap: Optional[int] = None
+    # convergence tail
+    converge_extra: int = 2
+    max_tail_steps: int = 240
+    transports: tuple = ("simnet",)
+
+
+def apply_event(net: SimNet, ev) -> None:
+    kw = dict(ev.kwargs)
+    if ev.kind == "partition":
+        net.partition(set(ev.args[0]), set(ev.args[1]))
+    elif ev.kind == "heal":
+        for a in ev.args[0]:
+            for b in ev.args[1]:
+                net.heal_link(a, b)
+    elif ev.kind == "kill":
+        net.kill(ev.args[0])
+    elif ev.kind == "revive":
+        net.revive(ev.args[0])
+    elif ev.kind == "link_fault":
+        net.set_link_fault(ev.args[0], ev.args[1], **kw)
+    elif ev.kind == "clear_link_fault":
+        net.clear_link_fault(ev.args[0], ev.args[1])
+    else:
+        raise ValueError(f"unknown fault kind {ev.kind!r}")
+
+
+class _GarbageSegmentSource:
+    """Wraps a segment source so every served segment carries one
+    flipped blob byte — content-verification at the fetcher must catch
+    it and fall back to another peer."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def segments(self):
+        return self.inner.segments()
+
+    def fetch_segment(self, seg_id, offset=0, length=None):
+        got = self.inner.fetch_segment(seg_id, offset=offset,
+                                       length=length)
+        if got is None:
+            return None
+        meta, data = got
+        if offset == 0 and len(data) > 41:
+            b = bytearray(data)
+            b[40] ^= 0xFF  # inside the first record's blob
+            data = bytes(b)
+        return meta, data
+
+
+def _setup_segments(net: SimNet, scn: Scenario, tmp_factory):
+    """Give every honest serving validator a real segstore the accepted
+    ledgers persist into, and the cold node a local store + the
+    SegmentCatchup bulk fetcher."""
+    from ..node.inbound import SegmentCatchup
+    from ..nodestore.core import NodeObjectType, make_database
+
+    dbs = {}
+    serving = [
+        i for i in range(scn.n_validators)
+        if i not in scn.cold_nodes and i not in scn.byzantine
+    ]
+    for i in serving:
+        db = make_database(
+            type="segstore", path=tmp_factory(f"seg-{i}"),
+            durability="async", segment_bytes=scn.segment_bytes,
+            async_writes=False,
+        )
+        dbs[i] = db
+        v = net.validators[i]
+        v.node.on_ledger.append(lambda led, db=db: led.save(db))
+        src = db.backend
+        if scn.garbage_server == i:
+            src = _GarbageSegmentSource(src)
+        v.node.segment_source = src
+
+    catchups = {}
+    for nid in scn.cold_nodes:
+        cold = net.validators[nid]
+        colddb = make_database(type="memory", async_writes=False)
+        dbs[nid] = colddb
+
+        def _local_fetch(h, colddb=colddb):
+            obj = colddb.fetch(h)
+            return obj.data if obj is not None else None
+
+        cold.node.inbound.local_fetch = _local_fetch
+        sc = SegmentCatchup(
+            send=lambda peer, msg, nid=nid: net.send(
+                nid, peer, frame(msg)
+            ),
+            peers=lambda serving=serving: list(serving),
+            store=lambda tb, key, blob, colddb=colddb: colddb.store(
+                NodeObjectType(tb), key, blob
+            ),
+            clock=net.clock,
+            request_timeout=4.0,
+            backoff_base=1.0,
+            backoff_max=8.0,
+            seed=scn.seed,
+            note_byzantine=cold.node.note_byzantine,
+        )
+        cold.node.segment_catchup = sc
+        catchups[nid] = sc
+    return dbs, catchups
+
+
+def _attach_txqs(net: SimNet, scn: Scenario) -> dict:
+    from ..node.txq import FeeMetrics, TxQ
+
+    txqs = {}
+    for i in range(scn.n_validators):
+        if i in scn.byzantine or i in scn.cold_nodes:
+            continue
+        txq = TxQ(
+            metrics=FeeMetrics(
+                min_cap=scn.txq_cap, max_cap=scn.txq_cap
+            ),
+            ledgers_in_queue=20,
+        )
+        net.validators[i].node.lm.txq = txq
+        txqs[i] = txq
+    return txqs
+
+
+def _inject(net: SimNet, scn: Scenario, nid: int,
+            tx: SerializedTransaction, txqs: dict,
+            admissions: list) -> None:
+    """One workload item enters the net. Without an admission plane it
+    rides the normal client path (apply locally + flood). With TxQs
+    attached, EVERY honest validator runs admit() on its own copy — the
+    production shape where a flood reaches each node's admission gate."""
+    if not txqs:
+        if net.is_down(nid) or nid in scn.byzantine:
+            nid = next(
+                i for i in range(scn.n_validators)
+                if not net.is_down(i) and i not in scn.byzantine
+            )
+        net.validators[nid].submit_client_tx(tx)
+        return
+    params = TxParams.OPEN_LEDGER | TxParams.RETRY
+    blob = tx.serialize()
+    first = True
+    for i, txq in txqs.items():
+        if net.is_down(i):
+            continue
+        copy = SerializedTransaction.from_bytes(blob)
+        copy.set_sig_verdict(True)  # pre-verified client submission
+        v = net.validators[i]
+        with v.node.lock:
+            ter, applied = txq.admit(copy, v.node.lm, params)
+        if first:
+            admissions.append(
+                (tx.txid(), int(ter), bool(applied), tx.fee.mantissa)
+            )
+            first = False
+
+
+def _count_committed(watch, workload) -> int:
+    """Workload (sender, sequence) pairs consumed on the FINAL validated
+    chain of the watch validator. Sequence consumption is fork-proof
+    ground truth: a sequence can only advance by applying the one
+    workload tx that carries it (replace-by-fee pairs count once — the
+    chain can only have taken one of the bids)."""
+    from ..protocol.sfields import sfSequence
+
+    final = watch.node.lm.validated
+    if final is None:
+        return 0
+    next_seq: dict[bytes, int] = {}
+    pairs = set()
+    for _at, _nid, tx in workload:
+        acct = tx.account
+        if acct not in next_seq:
+            root = final.account_root(acct)
+            next_seq[acct] = root[sfSequence] if root is not None else 1
+        if tx.sequence < next_seq[acct]:
+            pairs.add((acct, tx.sequence))
+    return len(pairs)
+
+
+def _fairness(admissions: list, commits: dict) -> dict:
+    """Admission-plane fairness verdicts from observable outcomes on
+    validator 0's chain: fee-ordered drain (queued high-fee txs commit
+    no later, on average, than queued low-fee ones), no-starvation
+    (every queued tx eventually commits), replace-by-fee (a replaced
+    sequence commits at most once)."""
+    queued = [
+        (txid, fee) for txid, ter, _applied, fee in admissions
+        if ter == int(TER.terQUEUED)
+    ]
+    out = {
+        "admitted": sum(1 for _t, ter, a, _f in admissions if a),
+        "queued": len(queued),
+        "rejected": sum(
+            1 for _t, ter, a, _f in admissions
+            if not a and ter != int(TER.terQUEUED)
+        ),
+    }
+    if not queued:
+        out.update(fee_order_drain=True, no_starvation=True)
+        return out
+    landed = [(fee, commits[txid]) for txid, fee in queued
+              if txid in commits]
+    out["queued_committed"] = len(landed)
+    # replaced originals never commit, so starvation counts only the
+    # LAST bid per (account, seq) — admissions dedup by txid upstream
+    out["no_starvation"] = len(landed) >= max(1, int(0.9 * len(queued)))
+    if len(landed) >= 4:
+        landed.sort(key=lambda p: -p[0])
+        k = max(1, len(landed) // 4)
+        top = sum(seq for _f, seq in landed[:k]) / k
+        bot = sum(seq for _f, seq in landed[-k:]) / k
+        out["fee_order_drain"] = top <= bot + 1e-9
+    else:
+        out["fee_order_drain"] = True
+    return out
+
+
+def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
+    """Execute one scenario on the deterministic simnet; returns the
+    scorecard. `tmpdir` is required for segment scenarios (the serving
+    validators persist real segstores there); its CONTENT never enters
+    the scorecard, so determinism holds across paths."""
+    import os
+    import tempfile
+
+    from .byzantine import ByzantineValidator
+
+    net = SimNet(
+        scn.n_validators, quorum=scn.quorum,
+        latency_steps=scn.latency_steps,
+        idle_interval=scn.idle_interval, seed=scn.seed,
+    )
+    # swap hostile slots in BEFORE start() so their genesis matches
+    byz_validators = {}
+    for nid, behaviors in scn.byzantine.items():
+        bv = ByzantineValidator(
+            net, nid, net.keys[nid],
+            {k.public for k in net.keys}, scn.quorum or 0,
+            scn.idle_interval, behaviors=behaviors, seed=scn.seed,
+        )
+        net.validators[nid] = bv
+        byz_validators[nid] = bv
+
+    # schedule: user events + the cold-node join choreography
+    sched = FaultSchedule(scn.seed)
+    if scn.build_schedule is not None:
+        scn.build_schedule(sched, scn)
+    for nid in scn.cold_nodes:
+        sched.kill(0, nid, revive_at=scn.join_at)
+    if scn.kill_server_at is not None:
+        # the cold node's CURRENT server (2nd in order once the garbage
+        # server condemned itself) dies mid-sync; revived for the tail
+        victims = [
+            i for i in range(scn.n_validators)
+            if i not in scn.cold_nodes and i not in scn.byzantine
+            and i != scn.garbage_server
+        ]
+        sched.kill(scn.kill_server_at, victims[0],
+                   revive_at=scn.kill_server_at + 10)
+
+    # workload
+    fac = TxFactory(seed=scn.seed)
+    wl_rng = random.Random(0x301C ^ scn.seed)
+    workload = []
+    if scn.build_workload is not None:
+        workload = scn.build_workload(fac, wl_rng, scn)
+    by_step: dict[int, list] = {}
+    for at, nid, tx in workload:
+        by_step.setdefault(at, []).append((nid, tx))
+
+    own_tmp = None
+    dbs, catchups = {}, {}
+    if scn.segments:
+        if tmpdir is None:
+            own_tmp = tempfile.mkdtemp(prefix="scn-seg-")
+            tmpdir = own_tmp
+        dbs, catchups = _setup_segments(
+            net, scn, lambda name: os.path.join(tmpdir, name)
+        )
+    txqs = _attach_txqs(net, scn) if scn.txq_cap else {}
+
+    honest = [
+        i for i in range(scn.n_validators) if i not in scn.byzantine
+    ]
+    # committed txids observed on ANY honest validator's accept feed —
+    # one observer is not enough: fork-repair adoption can skip
+    # unresolvable intermediate ledgers (no on_ledger fires for them),
+    # so a lagging node's feed under-reports txs the net committed
+    watch = net.validators[honest[0]]
+    commits: dict[bytes, int] = {}
+
+    def _record(led):
+        for txid, _blob, _meta in led.tx_entries():
+            commits.setdefault(txid, led.seq)
+
+    for i in honest:
+        net.validators[i].node.on_ledger.append(_record)
+
+    net.start()
+    admissions: list = []
+    submitted = 0
+    try:
+        for step in range(scn.steps):
+            for ev in sched.events_at(step):
+                apply_event(net, ev)
+            for nid, tx in by_step.get(step, ()):
+                _inject(net, scn, nid, tx, txqs, admissions)
+                submitted += 1
+            for bv in byz_validators.values():
+                if not net.is_down(bv.nid):
+                    bv.act(step)
+            net.step()
+
+        # drain the remaining schedule (heals/revives past the horizon)
+        for ev in sorted(
+            (e for e in sched.events if e.at >= scn.steps),
+            key=lambda e: (e.at, e.order),
+        ):
+            if ev.kind in ("heal", "revive", "clear_link_fault"):
+                apply_event(net, ev)
+
+        # convergence tail: every honest validator quorum-validated on
+        # one identical chain, `converge_extra` ledgers past the top
+        def _hseqs():
+            return [
+                net.validators[i].node.lm.validated.seq
+                if net.validators[i].node.lm.validated else 0
+                for i in honest
+            ]
+
+        # two-phase tail: first reach the convergence target, then keep
+        # stepping until the committed-tx count is QUIESCENT (held /
+        # queued / disputed txs land a few rounds after the flood ends —
+        # judging commit counts at first convergence undercounts them)
+        target = max(_hseqs()) + scn.converge_extra
+        tail = 0
+        last_commits, stable = -1, 0
+        while tail < scn.max_tail_steps:
+            if min(_hseqs()) >= target:
+                if len(commits) == last_commits:
+                    stable += 1
+                    if stable >= 3 * scn.idle_interval:
+                        break
+                else:
+                    stable = 0
+                    last_commits = len(commits)
+            net.step()
+            tail += 1
+        converged = min(_hseqs()) >= target
+        common = min(_hseqs())
+        hashes = {
+            net.validators[i].node.lm.ledger_history.get(common)
+            for i in honest
+        }
+        hashes.discard(None)
+
+        splice: dict[str, int] = {}
+        defense: dict[str, int] = {}
+        degraded_transitions = 0
+        for i in honest:
+            vn = net.validators[i].node
+            for k, v in vn.lm.delta_stats.snapshot().items():
+                if isinstance(v, int):
+                    splice[k] = splice.get(k, 0) + v
+            for k, v in vn.defense.snapshot().items():
+                defense[k] = defense.get(k, 0) + v
+            degraded_transitions += vn.degrade_transitions
+
+        card = {
+            "scenario": scn.name,
+            "seed": scn.seed,
+            "transport": "simnet",
+            "steps": scn.steps,
+            "tail_steps": tail,
+            "converged": converged,
+            "final_seq": common,
+            "final_hash": (
+                next(iter(hashes)).hex() if len(hashes) == 1 else None
+            ),
+            "single_hash": len(hashes) == 1,
+            "validated_seqs": _hseqs(),
+            "submitted": submitted,
+            "committed": _count_committed(watch, workload),
+            "rounds": len(net.accept_log),
+            "net": dict(net.net_stats),
+            "splice": splice,
+            "byzantine": {k: v for k, v in defense.items() if v},
+            "byzantine_emitted": {
+                nid: dict(bv.emitted)
+                for nid, bv in byz_validators.items()
+            },
+            "degraded_transitions": degraded_transitions,
+            "fault_digest": sched.digest(),
+        }
+        if catchups:
+            nid = scn.cold_nodes[0]
+            cold = net.validators[nid].node
+            cold_seq = cold.lm.validated.seq if cold.lm.validated else 0
+            card["catchup"] = {
+                "cold_nid": nid,
+                "cold_validated_seq": cold_seq,
+                "synced": (
+                    converged
+                    and cold_seq >= common
+                    and cold.lm.ledger_history.get(common)
+                    == next(iter(hashes), None)
+                ),
+                "segfetch": catchups[nid].get_json(),
+            }
+        if txqs:
+            q0 = txqs[honest[0]]
+            card["txq"] = {
+                "stats": dict(q0.stats),
+                "remaining": len(q0),
+                **_fairness(admissions, commits),
+            }
+        return card
+    finally:
+        for db in dbs.values():
+            try:
+                db.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        if own_tmp is not None:
+            import shutil
+
+            shutil.rmtree(own_tmp, ignore_errors=True)

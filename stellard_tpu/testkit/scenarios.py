@@ -1,0 +1,154 @@
+"""The scenario matrix (doc/scenarios.md): the four adversarial shapes
+the ISSUE/ROADMAP name, as parameterized builders. ``build_scenario``
+is the single entry the smoke, the tests, and the bench leg share.
+
+(a) partition_kills   partitions healing on schedule + rotating
+                      validator kills, under payment flood
+(b) byzantine         a trusted-but-hostile validator emitting
+                      equivocations, forged/stale validations,
+                      oversized txsets and malformed frames
+(c) cold_catchup      a node joining mid-flood syncs via the segment
+                      bulk path; the first server serves garbage, the
+                      second is killed mid-sync
+(d) hostile workloads hot_account / order_books / fee_gaming
+"""
+
+from __future__ import annotations
+
+from .scenario import Scenario
+from .workloads import (
+    fee_gaming,
+    hot_account_flood,
+    order_book_crossfire,
+    payment_flood,
+)
+
+__all__ = ["MATRIX", "build_scenario"]
+
+
+def _funded_flood(workload_fn, n_txs, end_margin: int = 6, **wl_kw):
+    """Fund the scenario accounts during the opening steps, then run the
+    hostile stream over the remaining window (`end_margin` steps of
+    quiet tail let queues/holds drain before convergence is judged)."""
+
+    def build(fac, rng, scn):
+        items = [(0, 0, tx) for tx in fac.fund_all()]
+        items += workload_fn(
+            fac, rng, start=6, end=scn.steps - end_margin, n=n_txs,
+            n_validators=scn.n_validators, **wl_kw,
+        )
+        items.sort(key=lambda it: it[0])
+        return items
+
+    return build
+
+
+def scenario_partition_kills(seed: int = 0) -> Scenario:
+    def schedule(sched, scn):
+        # an even split that must stall (safety), healing on schedule,
+        # then rotating single-validator kills under continuing flood
+        sched.partition(14, {0, 1}, {2, 3, 4}, heal_at=26)
+        sched.rotate_kills(
+            range(scn.n_validators), start=34, every=12, downtime=5,
+            count=3,
+        )
+
+    return Scenario(
+        name="partition_kills", seed=seed, n_validators=5, quorum=3,
+        steps=80,
+        build_schedule=schedule,
+        build_workload=_funded_flood(payment_flood, 60),
+    )
+
+
+def scenario_chaos(seed: int = 0, steps: int = 120,
+                   kill_every: int = 40, downtime: int = 5) -> Scenario:
+    """Rotating validator kills under continuous flood — the pre-graft
+    chaos-soak shape, now ONE definition driven through BOTH transports
+    (tools/chaos_soak.py runs it on the real TCP net; the smoke and the
+    matrix run it deterministically on the simnet)."""
+    kills = max(1, (steps - 20) // kill_every)
+
+    def schedule(sched, scn):
+        sched.rotate_kills(
+            range(scn.n_validators), start=14, every=kill_every,
+            downtime=downtime, count=kills,
+        )
+
+    return Scenario(
+        name="chaos", seed=seed, n_validators=4, quorum=3,
+        steps=steps,
+        build_schedule=schedule,
+        build_workload=_funded_flood(
+            payment_flood, max(24, steps // 2)
+        ),
+        transports=("simnet", "tcp"),
+    )
+
+
+def scenario_byzantine(seed: int = 0) -> Scenario:
+    return Scenario(
+        name="byzantine", seed=seed, n_validators=4, quorum=3,
+        steps=70,
+        byzantine={3: (
+            "equivocate", "duplicate", "forge", "stale", "garbage",
+            "oversized",
+        )},
+        build_workload=_funded_flood(payment_flood, 40),
+    )
+
+
+def scenario_cold_catchup(seed: int = 0) -> Scenario:
+    return Scenario(
+        name="cold_catchup", seed=seed, n_validators=5, quorum=3,
+        steps=90,
+        cold_nodes=(4,), join_at=40,
+        segments=True, segment_bytes=65536,
+        garbage_server=0,       # first pick serves garbage → per-peer
+        kill_server_at=44,      # fallback, then the next server dies
+                                # right as the transfer lands on it
+        build_workload=_funded_flood(payment_flood, 70),
+        max_tail_steps=300,
+    )
+
+
+def scenario_hot_account(seed: int = 0) -> Scenario:
+    return Scenario(
+        name="hot_account", seed=seed, n_validators=4, quorum=3,
+        steps=60,
+        build_workload=_funded_flood(hot_account_flood, 80),
+    )
+
+
+def scenario_order_books(seed: int = 0) -> Scenario:
+    return Scenario(
+        name="order_books", seed=seed, n_validators=4, quorum=3,
+        steps=70,
+        build_workload=_funded_flood(order_book_crossfire, 60),
+    )
+
+
+def scenario_fee_gaming(seed: int = 0) -> Scenario:
+    return Scenario(
+        name="fee_gaming", seed=seed, n_validators=4, quorum=3,
+        steps=96,
+        txq_cap=6,
+        # flood ends ~36 steps before the horizon: the queue must DRAIN
+        # in fee order (the fairness checks judge the drained outcome)
+        build_workload=_funded_flood(fee_gaming, 70, end_margin=36),
+    )
+
+
+MATRIX = {
+    "partition_kills": scenario_partition_kills,
+    "chaos": scenario_chaos,
+    "byzantine": scenario_byzantine,
+    "cold_catchup": scenario_cold_catchup,
+    "hot_account": scenario_hot_account,
+    "order_books": scenario_order_books,
+    "fee_gaming": scenario_fee_gaming,
+}
+
+
+def build_scenario(name: str, seed: int = 0) -> Scenario:
+    return MATRIX[name](seed)

@@ -1,0 +1,112 @@
+"""Fault-schedule DSL: a declarative, step-indexed list of network
+faults, built once (optionally with a seeded RNG for randomized
+placement) and then REPLAYED — the schedule is data, so a seed maps to
+exactly one fault pattern and the scorecard can carry a digest of it.
+
+Event kinds map 1:1 onto the simnet's fault plane:
+
+    partition(at, a, b[, heal_at])     cut every a<->b link (+ heal)
+    kill(at, nid[, revive_at])         silence a validator (+ revive)
+    link_fault(at, a, b, ..., until=)  drop/dup/delay/jitter on a link
+    rotate_kills(nids, ...)            chaos-soak style rotating victims
+
+The TCP runner consumes the same schedule but only supports the kinds a
+process net can express (kill/revive); a scenario tagged for both
+transports must restrict itself to that subset.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+from dataclasses import dataclass
+
+__all__ = ["FaultEvent", "FaultSchedule"]
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    at: int       # step index (simnet) / ~seconds (tcp)
+    order: int    # tiebreak: schedule-build order, stable across runs
+    kind: str
+    args: tuple = ()
+    kwargs: tuple = ()  # sorted (key, value) pairs
+
+
+class FaultSchedule:
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self.rng = random.Random(0xFA17 ^ seed)
+        self.events: list[FaultEvent] = []
+        self._order = 0
+
+    def add(self, at: int, kind: str, *args, **kwargs) -> "FaultSchedule":
+        self.events.append(FaultEvent(
+            int(at), self._order, kind, tuple(args),
+            tuple(sorted(kwargs.items())),
+        ))
+        self._order += 1
+        return self
+
+    # -- composite builders ------------------------------------------------
+
+    def partition(self, at: int, group_a, group_b,
+                  heal_at: int | None = None) -> "FaultSchedule":
+        self.add(at, "partition", tuple(sorted(group_a)),
+                 tuple(sorted(group_b)))
+        if heal_at is not None:
+            self.add(heal_at, "heal", tuple(sorted(group_a)),
+                     tuple(sorted(group_b)))
+        return self
+
+    def kill(self, at: int, nid: int,
+             revive_at: int | None = None) -> "FaultSchedule":
+        self.add(at, "kill", nid)
+        if revive_at is not None:
+            self.add(revive_at, "revive", nid)
+        return self
+
+    def link_fault(self, at: int, a: int, b: int,
+                   until: int | None = None, **fault) -> "FaultSchedule":
+        self.add(at, "link_fault", a, b, **fault)
+        if until is not None:
+            self.add(until, "clear_link_fault", a, b)
+        return self
+
+    def rotate_kills(self, nids, start: int, every: int, downtime: int,
+                     count: int) -> "FaultSchedule":
+        """Chaos-soak shape: every `every` steps from `start`, kill a
+        seeded-random victim for `downtime` steps, `count` times.
+        Victims never overlap (a revive always lands before the next
+        kill when downtime < every)."""
+        nids = list(nids)
+        at = start
+        for _ in range(count):
+            victim = self.rng.choice(nids)
+            self.kill(at, victim, revive_at=at + downtime)
+            at += every
+        return self
+
+    # -- replay ------------------------------------------------------------
+
+    def events_at(self, step: int) -> list[FaultEvent]:
+        return sorted(
+            (e for e in self.events if e.at == step),
+            key=lambda e: e.order,
+        )
+
+    def max_step(self) -> int:
+        return max((e.at for e in self.events), default=0)
+
+    def describe(self) -> list[tuple]:
+        """Canonical, deterministic event list (scorecard material)."""
+        return [
+            (e.at, e.order, e.kind, e.args, e.kwargs)
+            for e in sorted(self.events, key=lambda e: (e.at, e.order))
+        ]
+
+    def digest(self) -> str:
+        """Stable digest of the whole schedule: two runs of one seed must
+        agree on this, and the smoke pins it."""
+        h = hashlib.sha256(repr(self.describe()).encode())
+        return h.hexdigest()[:16]

@@ -282,6 +282,160 @@ class TestValidationsStore:
         assert store.current_ledger_weights() == {H(2): 1}
 
 
+# -- byzantine inputs at the unit level -----------------------------------
+
+
+class _NullAdapter:
+    def propose(self, proposal):
+        pass
+
+    def share_tx_set(self, txset):
+        pass
+
+    def acquire_tx_set(self, set_hash):
+        return None
+
+    def send_validation(self, val):
+        pass
+
+    def request_ledger_data(self, msg):
+        pass
+
+    def relay_disputed_tx(self, blob):
+        pass
+
+    def on_accepted(self, ledger, round_ms):
+        pass
+
+
+def _node(keys, quorum=2):
+    from stellard_tpu.node.validator import ValidatorNode
+
+    now = [10_000]
+    node = ValidatorNode(
+        key=keys[0],
+        unl={k.public for k in keys},
+        adapter=_NullAdapter(),
+        quorum=quorum,
+        network_time=lambda: now[0],
+        clock=lambda: float(now[0]),
+    )
+    node.start(b"\x07" * 20, close_time=now[0])
+    return node, now
+
+
+class TestByzantineInputs:
+    """Hostile consensus inputs must be counted, dropped, and never
+    double-counted toward quorum (ISSUE 9 satellite)."""
+
+    def test_conflicting_proposals_one_key_count_once(self):
+        keys = [kp(i) for i in range(3)]
+        node, _now = _node(keys)
+        prev = node.lm.closed_ledger().hash()
+        real = LedgerProposal(prev, 0, H(2), 30)
+        real.sign(keys[1])
+        assert node.handle_proposal(real)
+        # same key, same propose_seq, DIFFERENT position: equivocation
+        fake = LedgerProposal(prev, 0, H(3), 30)
+        fake.sign(keys[1])
+        assert not node.handle_proposal(fake)
+        assert node.defense["conflicting_proposal"] == 1
+        # the first-seen position stands; the proposer counts ONCE
+        assert node.round.peer_positions[keys[1].public].tx_set_hash == H(2)
+        assert len(node.round.peer_positions) == 1
+
+    def test_duplicate_proposal_counted_and_dropped(self):
+        keys = [kp(i) for i in range(3)]
+        node, _now = _node(keys)
+        prev = node.lm.closed_ledger().hash()
+        p = LedgerProposal(prev, 0, H(2), 30)
+        p.sign(keys[1])
+        assert node.handle_proposal(p)
+        replay = LedgerProposal(prev, 0, H(2), 30, p.node_public,
+                                p.signature)
+        assert not node.handle_proposal(replay)
+        assert node.defense["duplicate_proposal"] == 1
+        assert node.defense["conflicting_proposal"] == 0
+        assert len(node.round.peer_positions) == 1
+
+    def test_bogus_validation_signature_counted_never_stored(self):
+        keys = [kp(i) for i in range(3)]
+        node, now = _node(keys)
+        target = H(9)
+        v = STValidation.build(target, signing_time=now[0], ledger_seq=5)
+        v.sign(keys[1])
+        blob = bytearray(v.serialize())
+        # corrupt the signature in the wire image
+        tampered = STValidation.from_bytes(bytes(blob))
+        from stellard_tpu.protocol.sfields import sfSignature
+
+        sig = bytearray(tampered.signature)
+        sig[0] ^= 0xFF
+        tampered.obj[sfSignature] = bytes(sig)
+        tampered = STValidation.from_bytes(tampered.serialize())
+        assert not node.handle_validation(tampered)
+        assert node.defense["bad_validation_sig"] == 1
+        assert node.validations.trusted_count_for(target) == 0
+
+    def test_untrusted_selfsigned_validation_zero_quorum_weight(self):
+        keys = [kp(i) for i in range(3)]
+        node, now = _node(keys)
+        rogue = kp(77)  # correctly signed, NOT on the UNL
+        v = STValidation.build(H(9), signing_time=now[0], ledger_seq=5)
+        v.sign(rogue)
+        node.handle_validation(v)
+        assert node.defense["untrusted_validation"] == 1
+        assert node.validations.trusted_count_for(H(9)) == 0
+
+    def test_replayed_stale_validation_counted_not_current(self):
+        from stellard_tpu.consensus.timing import LEDGER_VAL_INTERVAL
+
+        keys = [kp(i) for i in range(3)]
+        node, now = _node(keys)
+        old = STValidation.build(
+            H(4), signing_time=now[0] - LEDGER_VAL_INTERVAL - 60,
+            ledger_seq=2,
+        )
+        old.sign(keys[1])
+        assert not node.handle_validation(old)
+        assert node.defense["stale_validation"] == 1
+        # stored for the per-hash record but never a current vote
+        assert node.validations.current_trusted() == []
+        # replaying it N more times never double-counts toward quorum
+        for _ in range(3):
+            node.handle_validation(
+                STValidation.from_bytes(old.serialize())
+            )
+        assert node.validations.trusted_count_for(H(4)) == 1
+
+    def test_duplicate_current_validation_counts_once(self):
+        keys = [kp(i) for i in range(3)]
+        node, now = _node(keys)
+        v = STValidation.build(H(9), signing_time=now[0], ledger_seq=5)
+        v.sign(keys[1])
+        assert node.handle_validation(v)
+        assert not node.handle_validation(
+            STValidation.from_bytes(v.serialize())
+        )
+        assert node.defense["duplicate_validation"] == 1
+        assert node.validations.trusted_count_for(H(9)) == 1
+
+    def test_conflicting_validations_same_seq_counted(self):
+        keys = [kp(i) for i in range(3)]
+        node, now = _node(keys)
+        v1 = STValidation.build(H(1), signing_time=now[0], ledger_seq=5)
+        v1.sign(keys[1])
+        node.handle_validation(v1)
+        v2 = STValidation.build(H(2), signing_time=now[0] + 1,
+                                ledger_seq=5)
+        v2.sign(keys[1])
+        node.handle_validation(v2)
+        assert node.defense["conflicting_validation"] == 1
+        # one key, one current electoral vote (the newer statement)
+        weights = node.validations.current_ledger_weights()
+        assert weights.get(H(2)) == 1 and H(1) not in weights
+
+
 # -- VerifyPlane integration ----------------------------------------------
 
 

@@ -119,6 +119,80 @@ class TestUnits:
         assert sample[0] == ("0.0.0.0", 1000, 0)
         assert ("10.1.1.1", 3000, 3) in sample
 
+    def test_reconnect_backoff_exponential_with_jitter(self):
+        """Consecutive dial failures back an address off exponentially
+        (base * 2^(n-1), capped) with deterministic jitter; success
+        resets the ladder (ISSUE 9 satellite: no tight reconnect spin
+        against a dead address)."""
+        now = [0.0]
+        pf = PeerFinder(fixed=[("127.0.0.1", 1000)], clock=lambda: now[0])
+        addr = ("127.0.0.1", 1000)
+        assert pf.backoff_delay(addr) == 0.0
+        delays = []
+        for _ in range(5):
+            pf.on_failure(addr)
+            delays.append(pf.backoff_delay(addr))
+        # exponential ladder: every rung at least ~1.6x the previous
+        # (2x growth, jitter bounded at +25%)
+        for a, b in zip(delays, delays[1:]):
+            assert b >= a * 1.6
+        # jitter present but bounded
+        base = pf.backoff_base
+        assert base <= delays[0] <= base * 1.25
+        # capped
+        for _ in range(10):
+            pf.on_failure(addr)
+        assert pf.backoff_delay(addr) <= pf.backoff_max * 1.25
+        # jitter is a pure function: same count, same delay
+        assert pf.backoff_delay(addr) == pf.backoff_delay(addr)
+        # dial_targets honors the CURRENT rung
+        assert addr not in pf.dial_targets(set(), set(), 0, 0)
+        now[0] += pf.backoff_max * 1.25 + 1
+        assert addr in pf.dial_targets(set(), set(), 0, 0)
+        # success resets the ladder
+        pf.on_success(addr)
+        assert pf.backoff_delay(addr) == 0.0
+        pf.on_failure(addr)
+        assert pf.backoff_delay(addr) <= pf.backoff_base * 1.25
+
+    def test_refusing_socket_dials_are_backed_off(self):
+        """A live overlay dialing an address that refuses connections
+        must space its attempts out on the backoff ladder instead of
+        redialing every connect-loop tick."""
+        # a port that actively refuses: bind+close so nothing listens
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        refused_port = s.getsockname()[1]
+        s.close()
+        port = free_ports(1)[0]
+        key = KeyPair.from_passphrase("backoff-test")
+        ov = make_overlay(
+            key, set(), port, [("127.0.0.1", refused_port)],
+            lambda: 0, time.monotonic,
+        )
+        # fast ladder so the test observes >1 rung quickly
+        ov.peerfinder.backoff_base = 0.4
+        attempts = []
+        orig = ov.peerfinder.on_failure
+
+        def counting_failure(addr):
+            attempts.append(time.monotonic())
+            orig(addr)
+
+        ov.peerfinder.on_failure = counting_failure
+        ov.start_network()
+        try:
+            time.sleep(3.0)
+        finally:
+            ov.stop()
+        # a tight spin would rack up dozens of dials in 3s (the dial
+        # itself fails in ~1ms on ECONNREFUSED); the ladder allows only
+        # a handful, and the gaps must GROW
+        assert 1 <= len(attempts) <= 6, attempts
+        if len(attempts) >= 3:
+            gaps = [b - a for a, b in zip(attempts, attempts[1:])]
+            assert gaps[-1] > gaps[0] * 1.5
+
     def test_resource_decay_and_drop(self):
         now = [0.0]
         rm = ResourceManager(clock=lambda: now[0])

@@ -1,0 +1,441 @@
+"""Adversarial scenario plane: fault-schedule DSL, simnet fault hooks,
+segment-granular catch-up, byzantine defense evidence, degradation
+reporting, and scorecard determinism."""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from stellard_tpu.node.inbound import SegmentCatchup, iter_segment_records
+from stellard_tpu.overlay.simnet import SimNet
+from stellard_tpu.overlay.wire import GetSegments, SegmentData
+from stellard_tpu.testkit import (
+    FaultSchedule,
+    MATRIX,
+    Scenario,
+    build_scenario,
+    run_simnet,
+)
+from stellard_tpu.testkit.scenarios import scenario_chaos
+from stellard_tpu.testkit.workloads import TxFactory, payment_flood
+from stellard_tpu.utils.hashes import sha512_half
+
+
+# -- schedule DSL ----------------------------------------------------------
+
+
+class TestFaultSchedule:
+    def test_same_seed_same_schedule(self):
+        def build(seed):
+            s = FaultSchedule(seed)
+            s.partition(10, {0, 1}, {2, 3}, heal_at=20)
+            s.rotate_kills([0, 1, 2, 3], start=30, every=10, downtime=3,
+                           count=4)
+            s.link_fault(5, 0, 2, until=15, drop=0.3, jitter_steps=2)
+            return s
+
+        a, b = build(7), build(7)
+        assert a.describe() == b.describe()
+        assert a.digest() == b.digest()
+        assert build(8).digest() != a.digest()
+
+    def test_events_at_ordered(self):
+        s = FaultSchedule(0)
+        s.kill(5, 2)
+        s.partition(5, {0}, {1})
+        evs = s.events_at(5)
+        assert [e.kind for e in evs] == ["kill", "partition"]
+        assert s.events_at(6) == []
+
+    def test_rotate_kills_bounded(self):
+        s = FaultSchedule(3)
+        s.rotate_kills([0, 1, 2], start=10, every=10, downtime=4, count=3)
+        kills = [e for e in s.events if e.kind == "kill"]
+        revives = [e for e in s.events if e.kind == "revive"]
+        assert len(kills) == len(revives) == 3
+        for k, r in zip(kills, revives):
+            assert r.at == k.at + 4
+
+
+# -- simnet fault hooks ----------------------------------------------------
+
+
+class TestSimnetFaults:
+    def test_drop_fault_loses_messages(self):
+        net = SimNet(3, seed=1)
+        net.set_link_fault(0, 1, drop=1.0)
+        net.start()
+        net.step(6)
+        assert net.net_stats["dropped_fault"] > 0
+
+    def test_dup_and_jitter_counted(self):
+        net = SimNet(3, seed=1)
+        net.set_link_fault(0, 1, dup=1.0, jitter_steps=3)
+        net.start()
+        net.step(8)
+        assert net.net_stats["duplicated"] > 0
+        assert net.net_stats["delayed"] > 0
+
+    def test_kill_silences_and_revive_rejoins(self):
+        net = SimNet(4, quorum=3, seed=2)
+        net.start()
+        net.run_until(lambda: net.all_validated_at_least(2), 40)
+        net.kill(3)
+        assert net.is_down(3)
+        stalled = net.validated_seqs()[3]
+        net.step(12)
+        assert net.validated_seqs()[3] == stalled  # dead node frozen
+        assert net.net_stats["dropped_down"] > 0
+        net.revive(3)
+        target = max(net.validated_seqs()) + 2
+        assert net.run_until(
+            lambda: net.all_validated_at_least(target), 120
+        )
+
+    def test_malformed_frame_isolated_per_source(self):
+        net = SimNet(3, seed=0)
+        net.start()
+        v = net.validators[0]
+        # garbage from node 2 must not break node 1's stream
+        v.deliver(2, b"\xff\xff\xff\xff\xff\xff")
+        assert v.node.defense["malformed_frame"] == 1
+        net.run_until(lambda: net.all_validated_at_least(2), 40)
+        assert net.validated_seqs()[0] >= 2
+
+    def test_seeded_fault_pattern_reproducible(self):
+        def run(seed):
+            net = SimNet(3, seed=seed)
+            net.set_link_fault(0, 1, drop=0.4, dup=0.2, jitter_steps=2)
+            net.start()
+            net.step(15)
+            return dict(net.net_stats)
+
+        assert run(5) == run(5)
+        assert run(5) != run(6)
+
+
+# -- segment records / SegmentCatchup -------------------------------------
+
+
+def _record(blob: bytes, type_byte: int = 3) -> bytes:
+    import struct
+
+    key = sha512_half(blob)
+    body = bytes([type_byte]) + blob
+    return struct.pack("<IB", len(body), 0) + key + body
+
+
+class TestSegmentRecords:
+    def test_roundtrip_and_torn_tail(self):
+        data = _record(b"hello") + _record(b"world" * 10)
+        recs = list(iter_segment_records(data + data[:10]))
+        assert [r[2] for r in recs] == [b"hello", b"world" * 10]
+        assert all(sha512_half(r[2]) == r[0] for r in recs)
+
+    def test_bad_flags_raise(self):
+        data = bytearray(_record(b"x"))
+        data[4] = 9  # flags byte
+        with pytest.raises(ValueError):
+            list(iter_segment_records(bytes(data)))
+
+
+class _FakeNet:
+    """Scripted transport for SegmentCatchup unit tests."""
+
+    def __init__(self):
+        self.sent = []      # (peer, msg) — delivered
+        self.attempts = []  # (peer, msg) — including lost ones
+        self.dead: set = set()
+
+    def send(self, peer, msg):
+        self.attempts.append((peer, msg))
+        if peer in self.dead:
+            return  # silently lost — the timeout path must handle it
+        self.sent.append((peer, msg))
+
+
+class TestSegmentCatchup:
+    def _mk(self, net, peers=("a", "b", "c"), **kw):
+        stored = []
+        clock = [0.0]
+        sc = SegmentCatchup(
+            send=net.send,
+            peers=lambda: list(peers),
+            store=lambda tb, k, b: stored.append((tb, k, b)),
+            clock=lambda: clock[0],
+            request_timeout=2.0,
+            backoff_base=1.0,
+            backoff_max=4.0,
+            seed=1,
+            **kw,
+        )
+        return sc, stored, clock
+
+    def test_happy_path_chunked(self):
+        net = _FakeNet()
+        sc, stored, clock = self._mk(net)
+        sc.start()
+        peer, msg = net.sent.pop()
+        assert isinstance(msg, GetSegments) and msg.seg_id == -1
+        seg = _record(b"n1") + _record(b"n2" * 40)
+        sc.on_manifest(peer, [(0, len(seg), len(seg), False)])
+        peer2, msg2 = net.sent.pop()
+        assert msg2.seg_id == 0 and msg2.offset == 0
+        # two chunks
+        sc.on_data(peer2, SegmentData(0, len(seg), 0, seg[:30]))
+        peer3, msg3 = net.sent.pop()
+        assert msg3.offset == 30
+        sc.on_data(peer3, SegmentData(0, len(seg), 30, seg[30:]))
+        assert sc.state == "done" and not sc.active
+        assert len(stored) == 2
+        assert sc.counters["records"] == 2
+        assert sc.counters["completed"] == 1
+
+    def test_timeout_backoff_and_peer_switch(self):
+        net = _FakeNet()
+        sc, _stored, clock = self._mk(net)
+        net.dead.add("a")
+        sc.start()
+        first_peer = net.attempts[-1][0]
+        assert first_peer == "a"  # stable order: first pick
+        # request times out, backs off exponentially, switches peer
+        clock[0] = 2.5
+        sc.tick(clock[0])
+        assert sc.counters["timeouts"] == 1
+        assert sc.counters["backoffs"] == 1
+        n_before = len(net.attempts)
+        sc.tick(clock[0] + 0.1)  # still inside backoff window
+        assert len(net.attempts) == n_before
+        clock[0] += 2.0  # past base backoff (1s * jitter<=1.25)
+        sc.tick(clock[0])
+        assert sc.counters["retries"] == 1
+        assert net.attempts[-1][0] == "b"  # scored away from the dead peer
+        assert sc.counters["peer_switches"] >= 1
+
+    def test_retries_exhausted_falls_back(self):
+        net = _FakeNet()
+        sc, _stored, clock = self._mk(net, peers=("a",),
+                                      max_retries=2)
+        net.dead.add("a")
+        sc.start()
+        for _ in range(12):
+            clock[0] += 8.0
+            sc.tick(clock[0])
+        assert sc.state == "fallback"
+        assert not sc.active
+        assert sc.counters["fallbacks"] == 1
+
+    def test_garbage_peer_condemned_and_segment_refetched(self):
+        net = _FakeNet()
+        noted = []
+        sc, stored, clock = self._mk(
+            net, note_byzantine=lambda kind, **kw: noted.append(kind)
+        )
+        sc.start()
+        peer, _ = net.sent.pop()
+        good = _record(b"good-node")
+        bad = bytearray(good)
+        bad[-1] ^= 0xFF  # blob byte flip: hash mismatch
+        sc.on_manifest(peer, [(0, len(good), len(good), False)])
+        peer2, _ = net.sent.pop()
+        sc.on_data(peer2, SegmentData(0, len(bad), 0, bytes(bad)))
+        assert sc.counters["garbage_records"] == 1
+        assert sc.counters["garbage_peers"] == 1
+        assert "garbage_segment" in noted
+        # refetched from ANOTHER peer, then completes
+        peer3, msg3 = net.sent.pop()
+        assert peer3 != peer2 and msg3.seg_id == 0
+        sc.on_data(peer3, SegmentData(0, len(good), 0, good))
+        assert sc.state == "done"
+        assert len(stored) == 1
+
+    def test_all_peers_garbage_falls_back(self):
+        net = _FakeNet()
+        sc, _stored, clock = self._mk(net, peers=("a", "b"))
+        sc.start()
+        peer, _ = net.sent.pop()
+        good = _record(b"zz")
+        bad = bytearray(good)
+        bad[-1] ^= 1
+        sc.on_manifest(peer, [(0, len(good), len(good), False)])
+        for _ in range(2):
+            p, _m = net.sent.pop()
+            sc.on_data(p, SegmentData(0, len(bad), 0, bytes(bad)))
+        assert sc.state == "fallback"
+        assert sc.counters["fallbacks"] == 1
+
+    def test_late_replies_ignored(self):
+        net = _FakeNet()
+        sc, _stored, _clock = self._mk(net)
+        sc.start()
+        peer, _ = net.sent.pop()
+        sc.on_data(peer, SegmentData(3, 10, 0, b"x" * 10))
+        assert sc.counters["late_replies"] == 1
+
+    def test_hostile_total_condemns_peer_not_ram(self):
+        """A peer claiming total far beyond the manifest size must be
+        condemned, not buffered into an OOM."""
+        net = _FakeNet()
+        sc, _stored, _clock = self._mk(net)
+        sc.start()
+        peer, _ = net.sent.pop()
+        seg = _record(b"tiny")
+        sc.on_manifest(peer, [(0, len(seg), len(seg), False)])
+        peer2, _ = net.sent.pop()
+        sc.on_data(peer2, SegmentData(0, 1 << 50, 0, b"x" * 1024))
+        assert sc.counters["garbage_peers"] == 1
+        assert len(sc._buf) == 0  # nothing hostile retained
+        # refetch moved to another peer
+        peer3, msg3 = net.sent.pop()
+        assert peer3 != peer2 and msg3.seg_id == 0
+
+    def test_short_empty_reply_condemns_not_completes(self):
+        """An empty chunk while the buffer is short of total must NOT
+        count the torn buffer as a completed segment."""
+        net = _FakeNet()
+        sc, stored, _clock = self._mk(net)
+        sc.start()
+        peer, _ = net.sent.pop()
+        seg = _record(b"abcdef")
+        sc.on_manifest(peer, [(0, len(seg), len(seg), False)])
+        peer2, _ = net.sent.pop()
+        sc.on_data(peer2, SegmentData(0, len(seg), 0, b""))
+        assert sc.counters["segments"] == 0
+        assert sc.counters["garbage_peers"] == 1
+        assert not stored
+
+    def test_session_rearms_after_cooldown(self):
+        """A fallback (or completion) must not disable the bulk path
+        forever: can_start re-arms after REARM_S."""
+        net = _FakeNet()
+        sc, _stored, clock = self._mk(net, peers=("a",), max_retries=1)
+        net.dead.add("a")
+        sc.start()
+        for _ in range(8):
+            clock[0] += 10.0
+            sc.tick(clock[0])
+        assert sc.state == "fallback"
+        assert not sc.can_start(clock[0])
+        clock[0] += sc.REARM_S + 1
+        assert sc.can_start(clock[0])
+        net.dead.clear()
+        assert sc.start()
+        assert sc.counters["started"] == 2
+
+
+# -- degradation reporting -------------------------------------------------
+
+
+class TestDegradation:
+    def test_quorum_loss_reports_tracking_then_recovers(self):
+        net = SimNet(4, quorum=3, seed=3)
+        net.start()
+        net.run_until(lambda: net.all_validated_at_least(2), 40)
+        v0 = net.validators[0].node
+        assert v0.validator_state == "proposing"
+        net.partition({0, 1}, {2, 3})
+        # solo-closing without quorum validation must degrade honestly
+        net.run_until(lambda: v0.degraded, 120)
+        assert v0.degraded
+        assert v0.validator_state == "tracking"
+        assert v0.consensus_info()["validator_state"] == "tracking"
+        for a in (0, 1):
+            for b in (2, 3):
+                net.heal_link(a, b)
+        net.run_until(lambda: not v0.degraded, 200)
+        assert not v0.degraded
+        assert v0.validator_state == "proposing"
+        assert v0.degrade_transitions >= 2
+
+
+# -- scenarios end-to-end --------------------------------------------------
+
+
+class TestScenarios:
+    def test_matrix_names_buildable(self):
+        for name in MATRIX:
+            scn = build_scenario(name, seed=1)
+            assert scn.name in (name, "chaos")
+
+    def test_byzantine_scenario_defends_and_converges(self):
+        card = run_simnet(build_scenario("byzantine", seed=3))
+        assert card["converged"] and card["single_hash"]
+        byz = card["byzantine"]
+        # anti-vacuity: every hostile behavior left counter evidence
+        for kind in ("bad_validation_sig", "untrusted_validation",
+                     "stale_validation", "oversized_txset",
+                     "malformed_frame", "duplicate_proposal",
+                     "conflicting_proposal"):
+            assert byz.get(kind, 0) > 0, f"{kind} never exercised"
+        emitted = card["byzantine_emitted"][3]
+        assert all(v > 0 for v in emitted.values())
+
+    def test_cold_catchup_scenario(self):
+        card = run_simnet(build_scenario("cold_catchup", seed=5))
+        assert card["converged"] and card["single_hash"]
+        cu = card["catchup"]
+        assert cu["synced"], "cold node never joined the validated chain"
+        sf = cu["segfetch"]
+        assert sf["records"] > 0 and sf["segments"] > 0
+        # the garbage server was caught and the killed server survived
+        # via timeout/retry/backoff to another peer
+        assert sf["garbage_peers"] >= 1
+        assert sf["timeouts"] >= 1 and sf["backoffs"] >= 1
+        assert sf["peer_switches"] >= 2
+
+    def test_fee_gaming_fairness(self):
+        card = run_simnet(build_scenario("fee_gaming", seed=2))
+        assert card["converged"] and card["single_hash"]
+        q = card["txq"]
+        assert q["queued"] > 0, "queue never engaged"
+        assert q["fee_order_drain"], "queue drained out of fee order"
+        assert q["no_starvation"], "queued txs starved"
+        assert q["remaining"] == 0
+
+    def test_partition_kills_and_chaos_converge(self):
+        # seed 7 is the regression seed: it exposed LocalTxs dropping
+        # fork-reverted client txs at repair (sweep against unvalidated
+        # solo-fork ledgers) and the expiry seq-jump at LCL switch —
+        # full commit here pins both fixes
+        for name in ("partition_kills", "chaos"):
+            for seed in (7, 11):
+                card = run_simnet(build_scenario(name, seed=seed))
+                assert card["converged"] and card["single_hash"], name
+                assert card["committed"] == card["submitted"], (
+                    name, seed, card["committed"], card["submitted"],
+                )
+
+    def test_hostile_workloads_exercise_fallbacks(self):
+        card = run_simnet(build_scenario("hot_account", seed=2))
+        assert card["converged"] and card["single_hash"]
+        # hot-account contention must actually stress the splice plane
+        assert card["splice"].get("fallback", 0) > 0
+
+    def test_scorecard_deterministic_across_runs(self):
+        import json
+
+        for name in ("byzantine", "cold_catchup"):
+            scn_a = build_scenario(name, seed=42)
+            scn_b = build_scenario(name, seed=42)
+            a = json.dumps(run_simnet(scn_a), sort_keys=True)
+            b = json.dumps(run_simnet(scn_b), sort_keys=True)
+            assert a == b, f"{name}: scorecard diverged across runs"
+
+    def test_small_custom_scenario(self):
+        scn = Scenario(
+            name="mini", seed=1, n_validators=3, quorum=2, steps=30,
+            build_workload=lambda fac, rng, s: [
+                (0, 0, tx) for tx in fac.fund_all()
+            ] + payment_flood(
+                fac, rng, start=4, end=24, n=10, n_validators=3
+            ),
+        )
+        card = run_simnet(scn)
+        assert card["converged"] and card["single_hash"]
+        assert card["committed"] == card["submitted"] == 19
+
+    def test_chaos_scenario_shared_across_transports(self):
+        scn = scenario_chaos(seed=1)
+        assert set(scn.transports) == {"simnet", "tcp"}

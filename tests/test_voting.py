@@ -101,6 +101,49 @@ class TestUnits:
         assert fv.do_voting(L(), vals([15, 15, None, None])) == []
 
 
+class TestByzantineVoting:
+    def test_replayed_validation_single_voice_in_amendment_tally(self):
+        """A byzantine validator replaying its amendment-voting
+        validation (and equivocating its vote) gets ONE voice: the
+        voting inputs come from ValidationsStore.validations_for, which
+        keys per signer, so replays and re-votes collapse to the latest
+        statement instead of stacking toward the 80% line."""
+        from stellard_tpu.consensus.validations import ValidationsStore
+        from stellard_tpu.protocol.keys import KeyPair
+
+        keys = [KeyPair.from_passphrase(f"vote-{i}") for i in range(4)]
+        trusted = {k.public for k in keys}
+        now = [10_000]
+        store = ValidationsStore(lambda pk: pk in trusted,
+                                 lambda: now[0])
+        noted = []
+        store.note_byzantine = lambda kind, **kw: noted.append(kind)
+        parent = b"\x42" * 32
+        # one honest YES vote; the byzantine node replays ITS yes vote
+        # three times and then re-votes with a different amendment set
+        honest = STValidation.build(parent, signing_time=now[0],
+                                    amendments=[AMENDMENT_X])
+        honest.sign(keys[1])
+        store.add(honest)
+        byz = STValidation.build(parent, signing_time=now[0],
+                                 amendments=[AMENDMENT_X])
+        byz.sign(keys[0])
+        for _ in range(3):
+            store.add(STValidation.from_bytes(byz.serialize()))
+        revote = STValidation.build(parent, signing_time=now[0] + 1,
+                                    amendments=[AMENDMENT_X, AMENDMENT_Y])
+        revote.sign(keys[0])
+        store.add(revote)
+        vals = store.validations_for(parent)
+        assert len(vals) == 2  # one entry per signer, not five
+        assert "duplicate_validation" in noted
+        # the byzantine signer's LATEST statement is its one voice
+        by_signer = {v.signer: v for v in vals}
+        assert set(by_signer[keys[0].public].amendments) == {
+            AMENDMENT_X, AMENDMENT_Y
+        }
+
+
 class TestConsensusVoting:
     def test_amendment_and_fee_enacted_via_consensus(self):
         net = SimNet(

@@ -1,178 +1,44 @@
 """Randomized chaos soak over a REAL 4-validator TCP+TLS net.
 
-The standalone, longer-running sibling of
-tests/test_multiproc_net.py::test_load_restart_convergence (the r4
-build-time soak that surfaced the fork-repair fixes): continuous RPC
-payment load while a validator is killed and revived every ~45s
-(rotating victims), for `minutes` (default 12). Ends by asserting every
-validator is quorum-validated on one advancing chain with one hash, and
-prints a JSON summary line. Validators are always torn down, even on a
-failed run.
+Now a thin wrapper over the scenario plane: the SAME `chaos` scenario
+definition (stellard_tpu/testkit/scenarios.py — rotating validator
+kills under continuous flood) that tools/scenariosmoke.py replays
+deterministically on the simnet runs here against real processes via
+testkit.tcpnet.run_tcp. Ends by asserting every validator is
+quorum-validated on one advancing chain with one hash, and prints a
+JSON scorecard line. Validators are always torn down, even on a failed
+run.
 
-Usage: python tools/chaos_soak.py [minutes] [> CHAOS_SOAK.log]
+Usage: python tools/chaos_soak.py [minutes] [seed] [> CHAOS_SOAK.log]
 """
 
 from __future__ import annotations
 
 import json
 import os
-import random
-import subprocess
 import sys
-import tempfile
-import threading
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from netlab import (  # noqa: E402
-    free_ports,
-    rpc,
-    spawn_validator,
-    validator_config,
-)
-from stellard_tpu.protocol.keys import KeyPair  # noqa: E402
+from stellard_tpu.testkit.scenarios import scenario_chaos  # noqa: E402
+from stellard_tpu.testkit.tcpnet import run_tcp  # noqa: E402
 
 MINUTES = float(sys.argv[1]) if len(sys.argv) > 1 else 12.0
-N = 4
+SEED = int(sys.argv[2]) if len(sys.argv) > 2 else 7
 
 
 def main() -> None:
-    tmp = tempfile.mkdtemp(prefix="chaos-")
-    ports = free_ports(2 * N)
-    peer_ports, rpc_ports = ports[:N], ports[N:]
-    keys = [KeyPair.from_passphrase(f"chaos-val-{i}") for i in range(N)]
-    cfg_paths = []
-    for i in range(N):
-        p = os.path.join(tmp, f"v{i}.cfg")
-        open(p, "w").write(
-            validator_config(i, keys, peer_ports, rpc_ports[i])
-        )
-        cfg_paths.append(p)
-
-    procs: list = [None] * N
-
-    def respawn(i):
-        procs[i] = spawn_validator(cfg_paths[i])
-
-    for i in range(N):
-        respawn(i)
-
-    try:
-        _run(procs, respawn, rpc_ports)
-    finally:
-        # ALWAYS tear the net down — a failed run must not leak four
-        # validator processes holding ports and CPU
-        for p in procs:
-            if p is None:
-                continue
-            p.terminate()
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
-
-
-def _run(procs, respawn, rpc_ports) -> None:
-    def meshed():
-        try:
-            return all(
-                rpc(p, "server_info")["info"]["peers"] == N - 1
-                for p in rpc_ports
-            )
-        except Exception:
-            return False
-
-    t0 = time.monotonic()
-    while not meshed():
-        if time.monotonic() - t0 > 120:
-            raise SystemExit("net never meshed")
-        time.sleep(2)
-    print(f"meshed in {time.monotonic()-t0:.0f}s", flush=True)
-
-    master = KeyPair.from_passphrase("masterpassphrase")
-    stop = threading.Event()
-    stats = {"submitted": 0, "errors": 0, "kills": 0}
-
-    def load():
-        i = 0
-        while not stop.is_set():
-            try:
-                rpc(rpc_ports[i % N], "submit", {
-                    "secret": "masterpassphrase",
-                    "tx_json": {
-                        "TransactionType": "Payment",
-                        "Account": master.human_account_id,
-                        "Destination": KeyPair.from_passphrase(
-                            f"chaos-dst-{i % 5}"
-                        ).human_account_id,
-                        "Amount": str(1_500_000_000),
-                    },
-                }, timeout=15)
-                stats["submitted"] += 1
-            except Exception:
-                stats["errors"] += 1
-            i += 1
-            stop.wait(1.0)
-
-    t = threading.Thread(target=load, daemon=True)
-    t.start()
-    rng = random.Random(7)
-    deadline = time.monotonic() + MINUTES * 60
-    try:
-        while time.monotonic() < deadline:
-            time.sleep(45)
-            victim = rng.randrange(N)
-            procs[victim].terminate()
-            try:
-                procs[victim].wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                procs[victim].kill()
-            stats["kills"] += 1
-            time.sleep(4)
-            respawn(victim)
-            print(f"t+{time.monotonic()-t0:.0f}s killed/revived v{victim} "
-                  f"(submitted={stats['submitted']})", flush=True)
-    finally:
-        stop.set()
-        t.join(timeout=10)
-
-    def seqs():
-        out = []
-        for p in rpc_ports:
-            try:
-                out.append(
-                    rpc(p, "server_info")["info"]["validated_ledger"]["seq"]
-                )
-            except Exception:
-                out.append(-1)
-        return out
-
-    target = max(seqs()) + 2
-    t1 = time.monotonic()
-    last = seqs()
-    while min(last) < target:
-        if time.monotonic() - t1 > 180:
-            raise SystemExit(f"no convergence: {last}")
-        time.sleep(3)
-        last = seqs()
-    # use the LAST in-loop observation — a fresh RPC round-trip here can
-    # transiently fail and would poison `common` with a -1
-    common = min(last)
-    hashes = {
-        rpc(p, "ledger", {"ledger_index": common})["ledger"]["hash"]
-        for p in rpc_ports
-    }
-    ok = len(hashes) == 1
-    print(json.dumps({
-        "chaos_minutes": MINUTES, "kills": stats["kills"],
-        "submitted": stats["submitted"], "errors": stats["errors"],
-        "final_validated_seqs": last, "single_hash": ok,
-        "summary": True,
-    }), flush=True)
-    if not ok:
-        raise SystemExit(f"FORK at {common}: {hashes}")
+    steps = max(60, int(MINUTES * 60))  # 1 step ~= 1 second
+    scn = scenario_chaos(seed=SEED, steps=steps, kill_every=45,
+                         downtime=5)
+    card = run_tcp(scn)
+    card["chaos_minutes"] = MINUTES
+    card["summary"] = True
+    print(json.dumps(card), flush=True)
+    if not card["converged"]:
+        raise SystemExit(f"no convergence: {card['validated_seqs']}")
+    if not card["single_hash"]:
+        raise SystemExit(f"FORK at {card['final_seq']}")
 
 
 if __name__ == "__main__":

@@ -1,115 +1,26 @@
-"""Shared net-lab helpers for the multi-process validator harnesses —
-imported by tests/test_multiproc_net.py and tools/chaos_soak.py so the
-config template, launcher, and RPC helper exist in exactly one place.
+"""Shared net-lab helpers for the multi-process validator harnesses.
+
+The implementation moved into the package
+(stellard_tpu/testkit/tcpnet.py) so the scenario plane's TCP runner,
+tests/test_multiproc_net.py and tools/chaos_soak.py share exactly one
+config template, launcher, and RPC helper; this module re-exports the
+original names for the path-hacking tool scripts.
 """
 
 from __future__ import annotations
 
-import json
 import os
-import socket
-import subprocess
 import sys
-import time
-import urllib.request
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SPEED = 5.0  # virtual seconds per real second (clock_speed knob)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def free_ports(n: int) -> list[int]:
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
-
-
-def rpc(port: int, method: str, params: dict | None = None, timeout=5.0):
-    body = json.dumps({"method": method, "params": [params or {}]}).encode()
-    req = urllib.request.Request(
-        f"http://127.0.0.1:{port}/",
-        data=body,
-        headers={"Content-Type": "application/json"},
-    )
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        return json.load(resp)["result"]
-
-
-def wait_until(pred, timeout: float, interval: float = 0.5):
-    deadline = time.monotonic() + timeout
-    last = None
-    while time.monotonic() < deadline:
-        try:
-            last = pred()
-            if last:
-                return last
-        except Exception:
-            pass
-        time.sleep(interval)
-    return last
-
-
-def validator_config(i: int, keys, peer_ports, rpc_port, ws_port=None,
-                     quorum=3, speed=SPEED) -> str:
-    """One validator's INI (the shape the reference's private-net
-    example config documents: UNL of the OTHER validators, fixed peer
-    list, quorum)."""
-    n = len(keys)
-    others_keys = "\n".join(
-        keys[j].human_node_public for j in range(n) if j != i
-    )
-    others_addrs = "\n".join(
-        f"127.0.0.1 {peer_ports[j]}" for j in range(n) if j != i
-    )
-    ws = f"\n[websocket_port]\n{ws_port}\n" if ws_port is not None else ""
-    return f"""
-[standalone]
-0
-
-[node_db]
-type=memory
-
-[signature_backend]
-type=cpu
-
-[validation_seed]
-{keys[i].human_seed}
-
-[validators]
-{others_keys}
-
-[validation_quorum]
-{quorum}
-
-[peer_port]
-{peer_ports[i]}
-
-[peer_ssl]
-require
-
-[ips]
-{others_addrs}
-
-[clock_speed]
-{speed}
-
-[rpc_port]
-{rpc_port}
-{ws}"""
-
-
-def spawn_validator(cfg_path: str, stdout=subprocess.DEVNULL):
-    """Launch one validator process from its config (never grabbing the
-    TPU tunnel)."""
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    return subprocess.Popen(
-        [sys.executable, "-m", "stellard_tpu", "--conf", cfg_path,
-         "--start"],
-        cwd=REPO, env=env, stdout=stdout, stderr=subprocess.STDOUT,
-    )
+from stellard_tpu.testkit.tcpnet import (  # noqa: E402,F401
+    REPO,
+    SPEED,
+    free_ports,
+    rpc,
+    run_tcp,
+    spawn_validator,
+    validator_config,
+    wait_until,
+)

@@ -25,6 +25,12 @@ import threading
 import time
 import urllib.request
 
+import os
+
+# runnable as "python tools/overload_smoke.py" from anywhere: a script in
+# tools/ does not get the repo root on sys.path by itself
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 CAP = 16          # pinned soft cap (min_cap == max_cap)
 SENDERS = 16      # one fee tier per sender
 ROUNDS = 4        # rounds of 4x-cap floods

@@ -17,6 +17,12 @@ from __future__ import annotations
 
 import sys
 
+import os
+
+# runnable as "python tools/sealsmoke.py" from anywhere: a script in
+# tools/ does not get the repo root on sys.path by itself
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def full_seal_hashes(ledger) -> tuple[bytes, bytes, bytes]:
     """(tx_hash, account_hash, ledger_hash) recomputed from scratch:

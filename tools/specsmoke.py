@@ -22,6 +22,12 @@ from __future__ import annotations
 
 import sys
 
+import os
+
+# runnable as "python tools/specsmoke.py" from anywhere: a script in
+# tools/ does not get the repo root on sys.path by itself
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def run_flood(workers: int, n_txs: int, chunk: int = 50):
     """One standalone-node flood; -> per-close evidence + counters."""

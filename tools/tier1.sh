@@ -60,6 +60,18 @@ if ! JAX_PLATFORMS=cpu timeout -k 10 500 python tools/storagesmoke.py; then
   exit 2
 fi
 
+echo "== adversarial scenario smoke gate (partition + byzantine + catch-up, seeded) =="
+# replays three deterministic simnet scenarios twice each with one
+# seed: honest validators must converge on ONE identical chain, the two
+# runs must produce byte-identical scorecards (a wall clock or unseeded
+# RNG leaking into the deterministic transport fails here), and the
+# hostile inputs must leave counter evidence (anti-vacuity) — byzantine
+# defenses, catch-up retry/backoff/garbage-fallback, partition drops
+if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/scenariosmoke.py; then
+  echo "SCENARIO SMOKE FAILED — adversarial plane is broken" >&2
+  exit 2
+fi
+
 echo "== overload-admission smoke gate (4x flood -> bounded closes, fee-order drain) =="
 # boots a node with a pinned small admission cap, floods it at 4x that
 # capacity through the full async pipeline, and asserts the RPC door

@@ -27,8 +27,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import urllib.request
+
+# runnable as "python tools/traceview.py" from anywhere: a script in
+# tools/ does not get the repo root on sys.path by itself
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # phases from the trace-event format spec (Duration, Complete, Instant,
 # Counter, Async, Flow, Sample, Object, Metadata, Memory-dump, Mark,
