@@ -55,6 +55,12 @@ class Config:
     standalone: bool = True
     start_up: str = "fresh"  # fresh | load
     ledger_history: int = 256  # reference [ledger_history]
+    # [node] mode=validator|follower — follower is the read-only tier
+    # (doc/follower.md): no consensus rounds, validated ledgers ingested
+    # from the net (bulk GetSegments catch-up + validation tailing),
+    # reads served from the last validated snapshot with the result
+    # cache on by default. "validator" is the classic networked node.
+    node_mode: str = "validator"
 
     # -- storage ([node_db], [database_path]) ------------------------------
     node_db_type: str = "memory"
@@ -188,6 +194,29 @@ class Config:
     trace_capacity: int = 16384
     trace_sample: float = 0.125
 
+    # -- subscription fanout ([subs]) --------------------------------------
+    # shards=N partitions InfoSub/RPCSub event delivery across N worker
+    # threads (subscribers pinned to one shard so per-client order
+    # holds); 0 delivers inline on the publishing thread (the legacy
+    # path — one slow consumer then stalls publish for everyone).
+    # sendq_cap bounds each client's pending-event queue (drop-OLDEST
+    # on overflow: a slow reader sees a gap, never a stale stream);
+    # evict_drops is the consecutive-drop threshold after which a slow
+    # consumer is evicted outright. Counters ride get_counts `subs`.
+    subs_shards: int = 4
+    subs_sendq_cap: int = 512
+    subs_evict_drops: int = 64
+    # RPCSub HTTP-push retry (reference RPCSub keeps a retry deque):
+    # bounded attempts with exponential backoff + jitter per event
+    subs_push_retries: int = 5
+
+    # -- validated-seq result cache ([rpc_cache]) --------------------------
+    # whole-result memo for the hot read RPCs (account_info,
+    # book_offers, ledger, account_tx), keyed by validated ledger seq —
+    # entries are immutable by construction and a new validated seq
+    # invalidates the whole generation (rpc/readplane.py). size=0 off.
+    rpc_cache_size: int = 8192
+
     # -- API doors ([rpc_*], [websocket_*]) --------------------------------
     rpc_ip: str = "127.0.0.1"
     rpc_port: Optional[int] = None  # None = disabled, 0 = ephemeral
@@ -237,6 +266,16 @@ class Config:
         if "standalone" in s:
             cfg.standalone = one("standalone", "1") not in ("0", "false", "no")
         cfg.start_up = one("start_up", cfg.start_up).lower()
+        node_sec = _kv(s.get("node", []))
+        if "mode" in node_sec:
+            cfg.node_mode = node_sec["mode"].lower()
+            if cfg.node_mode not in ("validator", "follower"):
+                # a mode toggle must not fail open into a validator that
+                # proposes when the operator believes it is read-only
+                raise ValueError(
+                    f"[node] mode must be validator/follower, "
+                    f"got {cfg.node_mode!r}"
+                )
         if one("ledger_history"):
             cfg.ledger_history = int(one("ledger_history"))
 
@@ -334,6 +373,19 @@ class Config:
             )
         if "drain_batch" in tree:
             cfg.tree_drain_batch = int(tree["drain_batch"])
+
+        subs = _kv(s.get("subs", []))
+        for key, attr in (
+            ("shards", "subs_shards"),
+            ("sendq_cap", "subs_sendq_cap"),
+            ("evict_drops", "subs_evict_drops"),
+            ("push_retries", "subs_push_retries"),
+        ):
+            if key in subs:
+                setattr(cfg, attr, int(subs[key]))
+        rpc_cache = _kv(s.get("rpc_cache", []))
+        if "size" in rpc_cache:
+            cfg.rpc_cache_size = int(rpc_cache["size"])
 
         cfg.validation_seed = one("validation_seed", cfg.validation_seed)
         cfg.sntp_servers = [line.split()[0] for line in s.get("sntp_servers", [])]

@@ -95,6 +95,11 @@ class NetworkOPs:
         # peer-id set it arrived from) / track it for re-apply across
         # rounds (reference: processTransaction relay step + LocalTxs
         # client-submit tracking)
+        # read plane (rpc/readplane.py, wired by Node): the serving
+        # side's immutable validated-snapshot pointer — publish hands it
+        # each closed ledger so read RPCs resolve "validated" without
+        # ever taking the chain lock
+        self.read_plane = None
         self.relay_tx: Optional[
             Callable[[SerializedTransaction, set[int]], None]
         ] = None
@@ -436,6 +441,18 @@ class NetworkOPs:
                 self._record_status(txid, TxStatus.COMMITTED)
         for sink in self.on_ledger_closed:
             sink(closed, results)
+        if self.read_plane is not None:
+            # hand the serving side its persisted-tip floor — AFTER the
+            # sinks, so by the time the validated-seq cache opens this
+            # epoch the persistence pipeline already holds the ledger's
+            # entry and the SQL-index RPCs' read-your-writes wait
+            # (_await_history) covers it; in networked mode this whole
+            # method runs post-persist on the drain worker. The read
+            # plane publishes min(persisted, validated): a degraded
+            # solo close never masquerades as validated state, and on a
+            # quorum net the epoch opens when the validation floor
+            # catches up (LedgerMaster.on_validated -> note_validated).
+            self.read_plane.note_persisted(closed)
 
     def _record_status(self, txid: bytes, status: TxStatus) -> None:
         m = self.on_tx_result

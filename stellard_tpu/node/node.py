@@ -383,6 +383,16 @@ class Node:
         # consensus and the RPC plane then share one LedgerMaster and
         # serialize on one master lock
         self.overlay = None
+        # [node] mode=follower (doc/follower.md): the read-only serving
+        # tier — no consensus rounds, validated ledgers ingested from
+        # the net, reads served from the last validated snapshot
+        self.follower = cfg.node_mode == "follower"
+        if self.follower and (cfg.standalone or not cfg.peer_port):
+            raise ValueError(
+                "[node] mode=follower requires a networked node "
+                "([peer_port] set, standalone=0) — a follower ingests "
+                "validated ledgers from its peers"
+            )
         if cfg.peer_port and not cfg.standalone:
             from ..overlay.tcp import TcpOverlay
 
@@ -460,6 +470,7 @@ class Node:
                     cfg.database_path + ".bootcache" if cfg.database_path else None
                 ),
                 proposing=self.validation_keys is not None,
+                follower=self.follower,
                 router=self.hash_router,
                 job_dispatch=self._peer_job_dispatch,
                 peer_tls=peer_tls,
@@ -618,6 +629,33 @@ class Node:
         # closes, status, staleness checks); the SNTP heartbeat COMPOSES
         # its measured correction with this base (see _heartbeat)
         self.ops.net_time_offset = int(cfg.network_time_offset)
+
+        # read plane (rpc/readplane.py): the serving side's immutable
+        # validated-snapshot pointer + validated-seq result cache. Read
+        # RPCs resolve "validated" from the pointer (never the chain
+        # lock); the hot four read RPCs memoize whole results per
+        # validated seq. The snapshot is min(persisted, validated):
+        # publish_closed_ledger feeds the persisted floor after its
+        # sinks (a cache epoch never opens before the SQL-index
+        # read-your-writes wait can see its ledger), on_validated
+        # below feeds the quorum floor.
+        from ..rpc.readplane import ReadPlane, ResultCache
+
+        self.read_cache = (
+            ResultCache(cfg.rpc_cache_size)
+            if cfg.rpc_cache_size > 0 else None
+        )
+        self.read_plane = ReadPlane(cache=self.read_cache)
+        self.ops.read_plane = self.read_plane
+        # the validated floor: on a quorum net validations land after
+        # the close persisted, and this hook is what opens the epoch
+        # (the read plane publishes min(persisted, validated))
+        self.ledger_master.on_validated = self.read_plane.note_validated
+        # follower consistency contract (doc/follower.md): selector-less
+        # read RPCs serve the last VALIDATED snapshot, not the open
+        # ledger — the read tier's answers are immutable and identical
+        # across every follower at the same validated seq
+        self.serve_validated_default = self.follower
         if self.overlay is not None:
             # one master lock for consensus + RPC over the shared chain,
             # and the relay/local-retry seams (reference: the relay step
@@ -755,7 +793,15 @@ class Node:
         WSDoors :817-868, RPCDoor :877-891)."""
         from ..rpc.infosub import SubscriptionManager
 
-        self.subs = SubscriptionManager(self.ops)
+        cfg0 = self.config
+        self.subs = SubscriptionManager(
+            self.ops,
+            shards=cfg0.subs_shards,
+            sendq_cap=cfg0.subs_sendq_cap,
+            evict_drops=cfg0.subs_evict_drops,
+            push_retries=cfg0.subs_push_retries,
+            tracer=self.tracer,
+        )
         # `server` stream: publish on load-factor movement (pubServer)
         self.fee_track.on_change.append(self.subs.pub_server_status)
         door_state_dir: list[str] = []  # one shared auto-cert dir per serve
@@ -844,6 +890,25 @@ class Node:
                 "backpressure_waits": self.close_pipeline.backpressure_waits,
             },
         )
+        # subscription-fanout + read-cache gauges (ROADMAP item 3):
+        # published/delivered/dropped/evicted and cache hit rates ride
+        # the same statsd surface as everything else
+        self.collector.hook(
+            "subs",
+            lambda: {
+                k: v for k, v in self.subs.get_json().items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+            },
+        )
+        if self.read_cache is not None:
+            self.collector.hook(
+                "cache",
+                lambda: {
+                    k: v
+                    for k, v in self.read_cache.get_json().items()
+                    if isinstance(v, (int, float))
+                },
+            )
         # span-derived per-stage latency percentiles (trace.<stage>.p50_ms
         # et al.): the unified latency surface the tracing plane feeds
         self.collector.hook("trace", self.tracer.statsd_hook)
@@ -943,12 +1008,26 @@ class Node:
                     from .networkops import OperatingMode
 
                     vn = self.overlay.node
-                    rounds = vn.rounds_completed
+                    # a follower's "round" is an ingested validated
+                    # ledger: TRACKING while the tail advances (it
+                    # tracks the net without proposing), CONNECTED/
+                    # DISCONNECTED from peer health otherwise
+                    rounds = (
+                        vn.ledgers_ingested if vn.follower
+                        else vn.rounds_completed
+                    )
                     if rounds > getattr(self, "_last_rounds", 0):
                         self._last_rounds = rounds
                         self._last_round_at = now
                     recently = now - getattr(self, "_last_round_at", 0.0) < 60.0
-                    if vn.degraded:
+                    if vn.follower:
+                        if rounds > 0 and recently:
+                            self.ops.mode = OperatingMode.TRACKING
+                        elif self.overlay.peer_count() > 0:
+                            self.ops.mode = OperatingMode.CONNECTED
+                        else:
+                            self.ops.mode = OperatingMode.DISCONNECTED
+                    elif vn.degraded:
                         # closing without quorum validation: report
                         # TRACKING honestly instead of a confident FULL
                         # from a node whose ledgers nobody signs
@@ -977,6 +1056,8 @@ class Node:
         # drain-on-stop guarantee: everything queued persists before the
         # stores close (the CLF pointer lands on the last closed ledger)
         self.close_pipeline.stop(timeout=60)
+        if self.subs is not None:
+            self.subs.stop()
         self.collector.stop()
         if self.sntp is not None:
             self.sntp.stop()

@@ -21,6 +21,11 @@ class TxDatabase:
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.Lock()
         self._in_batch = False
+        # retention floor: rows strictly below this ledger seq were
+        # deleted by trim_below (sql_trim rotation). account_tx uses it
+        # to reject markers/windows pointing into trimmed history with
+        # a clean lgrIdxInvalid instead of a silent empty page.
+        self.retain_floor = 0
         cur = self._conn.cursor()
         cur.execute("PRAGMA journal_mode=WAL")
         # reference: DBInit.cpp TxnDBInit / LedgerDBInit
@@ -339,6 +344,10 @@ class TxDatabase:
             )
             deleted["ledgers"] = cur.rowcount
             self._conn.commit()
+            # the floor rises only once the deletion actually
+            # committed: a failed trim must not lock out history whose
+            # rows are all still present
+            self.retain_floor = max(self.retain_floor, int(ledger_seq))
             # bound the WAL too: a delete-heavy transaction otherwise
             # leaves the whole trimmed range sitting in the -wal file
             cur.execute("PRAGMA wal_checkpoint(TRUNCATE)")

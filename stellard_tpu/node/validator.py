@@ -72,6 +72,7 @@ class ValidatorNode:
         voting=None,
         lock=None,
         router: Optional[HashRouter] = None,
+        follower: bool = False,
     ):
         import threading
 
@@ -87,7 +88,15 @@ class ValidatorNode:
         self.clock = clock
         self.hash_batch = hash_batch
         self.verify_many = verify_many  # VerifyPlane.verify_many or None
-        self.proposing = proposing
+        self.proposing = proposing and not follower
+        # follower mode ([node] mode=follower, ROADMAP item 3): this
+        # node NEVER runs consensus rounds — it tails validated-ledger
+        # announcements from trusted validators, acquires each validated
+        # ledger (bulk GetSegments catch-up + the node-granular tree
+        # walk, every record/hash content-verified), and adopts it.
+        # The whole read RPC + subscription surface then serves from
+        # the ingested chain at wire speed, off the write path.
+        self.follower = follower
         self.idle_interval = idle_interval
         self.voting = voting  # consensus.voting.VotingBox or None
 
@@ -152,6 +161,15 @@ class ValidatorNode:
         # segments()/fetch_segment(), i.e. the segstore backend).
         self.segment_catchup = None
         self.segment_source = None
+        # follower ingest observability (`follower.ingest` spans +
+        # get_counts block): validation-seen -> adopted latency per
+        # ingested ledger, plus plain counters
+        from .metrics import LatencyHist
+        from .tracer import STAGE_BOUNDS
+
+        self.ingest_hist = LatencyHist(bounds=STAGE_BOUNDS, interpolate=True)
+        self.ledgers_ingested = 0
+        self._ingest_t0: dict[bytes, float] = {}
         # honest health reporting (see DEGRADE_LAG): transitions are
         # tracer-visible and counted, state rides consensus_info and the
         # container's operating mode
@@ -187,6 +205,11 @@ class ValidatorNode:
 
     def begin_round(self) -> None:
         """reference: NetworkOPs::beginConsensus → make_LedgerConsensus"""
+        if self.follower:
+            # a follower never drives rounds: its chain advances only by
+            # adopting validated ledgers (the catch-up/tailing path)
+            self.round = None
+            return
         self.txset_cache.clear()
         self.round = LedgerConsensus(
             prev_ledger=self.lm.closed_ledger(),
@@ -236,9 +259,28 @@ class ValidatorNode:
 
     @property
     def validator_state(self) -> str:
+        if self.follower:
+            return "follower"
         if self._degraded:
             return "tracking"
         return "proposing" if self.proposing else "observing"
+
+    def follower_json(self) -> dict:
+        """Ingest-plane counters for get_counts (follower mode)."""
+        out = {
+            "ledgers_ingested": self.ledgers_ingested,
+            "validated_seq": (
+                self.lm.validated.seq if self.lm.validated else 0
+            ),
+            "acquisitions_live": len(self.inbound.live),
+        }
+        if self.ingest_hist.count:
+            out["ingest_p50_ms"] = self.ingest_hist.quantile(0.5)
+            out["ingest_p99_ms"] = self.ingest_hist.quantile(0.99)
+        sc = self.segment_catchup
+        if sc is not None:
+            out["segfetch"] = sc.get_json()
+        return out
 
     def _update_health(self) -> None:
         closed = self.lm.closed_ledger().seq
@@ -303,9 +345,21 @@ class ValidatorNode:
         if key(best) <= key(ours_hash):  # covers best == ours_hash
             self._lcl_candidate = None
             return
-        if self._lcl_candidate != best:
-            self._lcl_candidate = best  # hysteresis: confirm next tick
+        if self._lcl_candidate != best and not self.follower:
+            # hysteresis: confirm next tick. A follower skips it — it
+            # never closes rounds of its own, so there is no healthy
+            # mid-accept transient to protect, and tailing latency is
+            # the product (validation seen -> adoption kicked at once)
+            self._lcl_candidate = best
             return
+        self._lcl_candidate = best
+        if self.follower and best not in self._ingest_t0:
+            # ingest span clock starts at the first sighting of the
+            # target (bounded: adoption pops; a never-adopted target
+            # ages out with the oldest entries)
+            if len(self._ingest_t0) >= 256:
+                self._ingest_t0.pop(next(iter(self._ingest_t0)))
+            self._ingest_t0[best] = _time.perf_counter()
         led = self.lm.get_ledger_by_hash(best)
         if led is not None:
             self._adopt_network_lcl(led)
@@ -358,6 +412,16 @@ class ValidatorNode:
         self.lm.check_accept(
             ledger.hash(), self.validations.trusted_count_for(ledger.hash())
         )
+        if self.follower:
+            # ingest observability: validation-seen -> adopted latency
+            now = _time.perf_counter()
+            t0 = self._ingest_t0.pop(ledger.hash(), None)
+            self.ledgers_ingested += 1
+            if t0 is not None:
+                self.ingest_hist.record((now - t0) * 1000.0)
+                self.lm.tracer.complete(
+                    "follower.ingest", "follower", t0, now, seq=ledger.seq
+                )
         # a multi-ledger jump must hand EVERY resolvable intermediate
         # ledger to the persistence plane oldest-first, or the txdb gets
         # a permanent hole for the skipped range (unresolvable ancestors
@@ -605,6 +669,11 @@ class ValidatorNode:
                 val.ledger_hash,
                 self.validations.trusted_count_for(val.ledger_hash),
             )
+            if current and self.follower:
+                # steady-state tailing: a fresh trusted validation IS
+                # the new-validated-ledger announcement — elect/acquire
+                # now instead of waiting out the next timer tick
+                self._check_lcl()
             return current
 
     @_locked

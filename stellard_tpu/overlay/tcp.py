@@ -228,6 +228,7 @@ class TcpOverlay(ConsensusAdapter):
         router=None,
         job_dispatch: Optional[Callable[[str, Callable], None]] = None,
         peer_tls=None,
+        follower: bool = False,
     ):
         self.key = key
         self.port = port
@@ -249,6 +250,7 @@ class TcpOverlay(ConsensusAdapter):
             verify_many=verify_many,
             proposing=proposing,
             router=router,
+            follower=follower,
         )
         if unl_store is not None:
             # per-validator misbehavior bookkeeping: defense events with

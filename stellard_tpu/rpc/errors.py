@@ -29,6 +29,7 @@ ERRORS = {
     "notImpl": (72, "Not implemented."),
     "notSupported": (73, "Operation not supported."),
     "notSynced": (55, "Not synced to the network."),
+    "lgrIdxInvalid": (57, "Ledger index below the retained history floor."),
     "transactionNotFound": (24, "Transaction not found."),
     "fieldNotFoundTransaction": (63, "Field 'transaction' not found."),
 }

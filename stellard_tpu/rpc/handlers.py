@@ -71,6 +71,11 @@ class Context:
     role: Role = Role.ADMIN
     infosub: Optional[InfoSub] = None
     subs: Optional[SubscriptionManager] = None
+    # set by the result-cache wrapper (rpc/readplane.py): the exact
+    # validated ledger this request was keyed against — _select_ledger
+    # resolves "validated" to it so the computed result always matches
+    # its cache key even if the tip advances mid-request
+    pinned_validated: Any = None
 
 
 HANDLERS: dict[str, tuple[Callable[[Context], dict], Role]] = {}
@@ -86,7 +91,12 @@ def handler(name: str, role: Role = Role.GUEST):
 
 def dispatch(ctx: Context, method: str) -> dict:
     """-> result dict; error results carry {"error": ...} (reference:
-    RPCHandler::doCommand wraps into status:error)."""
+    RPCHandler::doCommand wraps into status:error).
+
+    The hot read RPCs route through the validated-seq result cache
+    (rpc/readplane.py) when the request targets validated state — a
+    cache entry is immutable by construction (a validated ledger never
+    changes), invalidated wholesale by the next validated seq."""
     entry = HANDLERS.get(method)
     if entry is None:
         return RPCError("unknownCmd").to_json()
@@ -94,7 +104,9 @@ def dispatch(ctx: Context, method: str) -> dict:
     if need_role == Role.ADMIN and ctx.role != Role.ADMIN:
         return RPCError("noPermission").to_json()
     try:
-        return fn(ctx)
+        from .readplane import cached_dispatch
+
+        return cached_dispatch(ctx, method, lambda: fn(ctx))
     except RPCError as exc:
         return exc.to_json()
     except Exception as exc:  # noqa: BLE001 — handler bug must not kill the door
@@ -134,7 +146,16 @@ def _load_historical(ctx: Context, ledger_hash: bytes) -> Optional[Ledger]:
 
 def _select_ledger(ctx: Context) -> Ledger:
     """reference: RPC::lookupLedger (impl/LookupLedger.cpp) — by
-    ledger_hash, numeric ledger_index, or current|closed|validated."""
+    ledger_hash, numeric ledger_index, or current|closed|validated.
+
+    Read RPCs never take the chain lock here (pinned by test): the
+    current/closed/validated tips resolve from bare attribute reads —
+    the chain swaps whole immutable objects under its own lock, so a
+    racing reader sees either tip, both complete — and "validated"
+    prefers the read plane's published snapshot (the pointer
+    publish_closed_ledger hands the serving side). A follower serves
+    the VALIDATED snapshot for selector-less requests (doc/follower.md
+    consistency contract)."""
     lm = ctx.node.ledger_master
     p = ctx.params
     if p.get("ledger_hash"):
@@ -143,7 +164,13 @@ def _select_ledger(ctx: Context) -> Ledger:
         if led is None:
             raise RPCError("lgrNotFound")
         return led
-    idx = p.get("ledger_index", "current")
+    idx = p.get("ledger_index")
+    if idx is None:
+        idx = (
+            "validated"
+            if getattr(ctx.node, "serve_validated_default", False)
+            else "current"
+        )
     if isinstance(idx, int) or (isinstance(idx, str) and idx.isdigit()):
         led = lm.get_ledger_by_seq(int(idx))
         if led is None:
@@ -160,13 +187,24 @@ def _select_ledger(ctx: Context) -> Ledger:
             raise RPCError("lgrNotFound")
         return led
     if idx == "current":
-        return lm.current_ledger()
-    if idx == "closed":
-        return lm.closed_ledger()
-    if idx == "validated":
-        if lm.validated is None:
+        led = lm.current
+        if led is None:
             raise RPCError("lgrNotFound")
-        return lm.validated
+        return led
+    if idx == "closed":
+        led = lm.closed
+        if led is None:
+            raise RPCError("lgrNotFound")
+        return led
+    if idx == "validated":
+        from .readplane import serving_validated
+
+        led = ctx.pinned_validated
+        if led is None:
+            led = serving_validated(ctx.node)
+        if led is None:
+            raise RPCError("lgrNotFound")
+        return led
     raise RPCError("invalidParams", f"bad ledger_index {idx!r}")
 
 
@@ -343,6 +381,11 @@ def do_server_state(ctx: Context) -> dict:
         # admission-control plane: queue depth, soft cap, escalated
         # open-ledger fee level (aggregate only — no txids)
         state["txq"] = txq.get_json()
+    # read plane: serving snapshot seq + result-cache hit rates
+    # (aggregate counters only — no params/keys on a GUEST method)
+    cache = getattr(node, "read_cache", None)
+    if cache is not None:
+        state["read_cache"] = cache.get_json()
     tracer = getattr(node, "tracer", None)
     if tracer is not None:
         # tracing plane status; the consensus/close timeline is ADMIN
@@ -432,6 +475,18 @@ def do_get_counts(ctx: Context) -> dict:
     from ..state.shamap import inner_node_cache
 
     out["shamap_inner_cache"] = inner_node_cache().get_json()
+    # subscription-fanout plane (`subs.*`): shards, bounded-queue drops,
+    # slow-consumer evictions, publish→deliver lag, HTTP-push stats
+    subs = getattr(node, "subs", None)
+    if subs is not None:
+        out["subs"] = subs.get_json()
+    # validated-seq result cache + serving snapshot (rpc/readplane.py)
+    cache = getattr(node, "read_cache", None)
+    if cache is not None:
+        out["read_cache"] = cache.get_json()
+    plane = getattr(node, "read_plane", None)
+    if plane is not None:
+        out["read_plane"] = plane.get_json()
     tracer = getattr(node, "tracer", None)
     if tracer is not None:
         out["trace"] = tracer.status_json()  # ADMIN method: timeline ok
@@ -440,6 +495,10 @@ def do_get_counts(ctx: Context) -> dict:
         out["peers"] = overlay.peer_count()
         vn = getattr(overlay, "node", None)
         if vn is not None:
+            if getattr(vn, "follower", False):
+                # follower ingest plane: ledgers adopted, validation-
+                # seen -> adopted latency, live acquisitions, segfetch
+                out["follower"] = vn.follower_json()
             # byzantine-defense counters: hostile inputs recognized and
             # neutralized (bad sigs, equivocation, oversized/forged
             # txsets, malformed frames, garbage segments)
@@ -920,6 +979,31 @@ def do_account_tx(ctx: Context) -> dict:
             after = (int(marker["ledger"]), int(marker["seq"]))
         except (TypeError, KeyError, ValueError):
             raise RPCError("invalidParams", "malformed marker")
+    # sql_trim retention floor: rows strictly below it were deleted by
+    # online-deletion rotation. A marker pointing below the floor (a
+    # pager resuming across a trim) and a window lying entirely below
+    # it must both fail CLEANLY — a silent empty page would end a
+    # well-behaved pagination loop as if history were complete
+    floor = getattr(ctx.node.txdb, "retain_floor", 0)
+    if floor > 0:
+        if after is not None and after[0] < floor:
+            raise RPCError(
+                "lgrIdxInvalid",
+                f"marker ledger {after[0]} is below the retained "
+                f"history floor {floor}",
+            )
+        if max_l < floor:
+            raise RPCError(
+                "lgrIdxInvalid",
+                f"requested window ends below the retained history "
+                f"floor {floor}",
+            )
+        if min_l < floor:
+            # window straddles the floor: serve what exists and REPORT
+            # the effective (clamped) minimum — the reference's
+            # effective-range echo — so a pager can see the truncation
+            # instead of reading a quietly complete-looking history
+            min_l = floor
     # fetch one extra row: its presence means the walk was truncated and
     # a resume marker must be returned (AccountTx.cpp resumeToken)
     rows = ctx.node.txdb.account_transactions(

@@ -5,11 +5,23 @@ Reference: src/ripple_net/rpc/InfoSub.cpp + NetworkOPsImp's mSub* maps
 `transactions_proposed` (rt_transactions), per-`accounts` and per-`books`
 subscriptions. WS connections implement the InfoSub sink; closes fan out
 from the close path.
+
+Fan-out is SHARDED ([subs] shards=N, ROADMAP item 3): event delivery
+rides N worker threads, each subscriber pinned to one shard so its
+per-client order holds, with a bounded per-client send queue
+(drop-OLDEST on overflow — a slow reader sees a gap, never a stale
+stream) and slow-consumer eviction past a consecutive-drop threshold.
+The publishing thread (in networked mode: the ordered persist worker)
+only ENQUEUES — one wedged websocket can never stall publish for the
+other 10k subscribers. shards=0 is the legacy inline path (tests that
+want synchronous delivery construct the manager that way).
 """
 
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
 from typing import Callable, Optional
 
 from ..protocol.sttx import SerializedTransaction
@@ -35,19 +47,160 @@ class InfoSub:
         # request id -> decoded {src, dst, dst_amount, send_max, echo}
         self.path_requests: dict[int, dict] = {}
         self._next_path_id = 0
+        # sharded-fanout state (owned by the shard's lock, not this
+        # object): bounded pending-event queue + slow-consumer tracking
+        self.sendq: deque = deque()
+        self.queued = False      # currently in its shard's ready ring
+        self.drop_run = 0        # consecutive drops (resets on delivery)
+        self.dropped = 0
+        self.evicted = False
+
+
+class _FanoutShard:
+    """One fanout worker: a ready-ring of subscribers with pending
+    events, drained FIFO per subscriber. All queue state is guarded by
+    this shard's lock; the actual send runs OUTSIDE it."""
+
+    # per-turn drain budget: bounds how long one chatty subscriber can
+    # hold the worker before the ring rotates
+    DRAIN_BURST = 16
+
+    def __init__(self, mgr: "SubscriptionManager", idx: int):
+        self.mgr = mgr
+        self.idx = idx
+        self.cv = threading.Condition()
+        self.ready: deque[InfoSub] = deque()
+        self._stop = False
+        self._idle = True
+        self.thread = threading.Thread(
+            target=self._run, name=f"subs-fanout-{idx}", daemon=True
+        )
+        self.thread.start()
+
+    def enqueue(self, sub: InfoSub, msg: dict, now: float) -> None:
+        mgr = self.mgr
+        evict = False
+        with self.cv:
+            if sub.evicted:
+                return
+            if len(sub.sendq) >= mgr.sendq_cap:
+                # drop-OLDEST: the freshest state wins; the client sees
+                # a gap, never a stale stream stretching back minutes
+                sub.sendq.popleft()
+                sub.dropped += 1
+                sub.drop_run += 1
+                mgr._bump("dropped_events")
+                if sub.drop_run >= mgr.evict_drops:
+                    sub.evicted = True
+                    evict = True
+            if not evict:
+                sub.sendq.append((msg, now))
+                mgr._bump("published")
+                if not sub.queued:
+                    sub.queued = True
+                    self.ready.append(sub)
+                    self.cv.notify()
+        if evict:
+            mgr._evict(sub, reason="slow_consumer")
+
+    def _run(self) -> None:
+        mgr = self.mgr
+        while True:
+            with self.cv:
+                while not self.ready and not self._stop:
+                    self._idle = True
+                    self.cv.notify_all()  # flush() waits on idle
+                    self.cv.wait(timeout=1.0)
+                if self._stop:
+                    return
+                self._idle = False
+                sub = self.ready.popleft()
+                batch = []
+                for _ in range(self.DRAIN_BURST):
+                    if not sub.sendq:
+                        break
+                    batch.append(sub.sendq.popleft())
+                if sub.sendq:
+                    self.ready.append(sub)  # rotate: fairness
+                else:
+                    sub.queued = False
+            dead = False
+            for msg, t_enq in batch:
+                try:
+                    sub.send(msg)
+                except Exception:  # noqa: BLE001 — a dead subscriber must
+                    dead = True    # not break the fan-out plane
+                    break
+                now = time.perf_counter()
+                lag_ms = (now - t_enq) * 1000.0
+                with mgr._stats_lock:
+                    mgr.lag_hist.record(lag_ms)
+                    mgr.stats["delivered"] += 1
+                sub.drop_run = 0
+                if (
+                    mgr.tracer is not None
+                    and mgr.tracer.enabled
+                    and msg.get("type") == "ledgerClosed"
+                    and sub.id % 256 == 1
+                ):
+                    # sampled publish→deliver spans (`subs.fanout`): one
+                    # representative per ~256 subscribers per close, so
+                    # a 10k-subscriber fanout leaves evidence without
+                    # flooding the ring
+                    mgr.tracer.complete(
+                        "subs.fanout", "publish", t_enq, now,
+                        shard=self.idx, sub=sub.id,
+                        seq=msg.get("ledger_index"),
+                    )
+            if dead:
+                mgr._evict(sub, reason="dead")
+
+    def drained(self) -> bool:
+        with self.cv:
+            return self._idle and not self.ready
+
+    def stop(self) -> None:
+        with self.cv:
+            self._stop = True
+            self.cv.notify_all()
+        self.thread.join(timeout=5)
 
 
 class SubscriptionManager:
     """Fan-out hub wired into NetworkOPs' close/tx hooks."""
 
-    def __init__(self, ops):
+    def __init__(self, ops, shards: int = 0, sendq_cap: int = 512,
+                 evict_drops: int = 64, push_retries: int = 5,
+                 tracer=None):
+        from ..node.metrics import LatencyHist
+        from ..node.tracer import STAGE_BOUNDS
+
         self.ops = ops
+        self.tracer = tracer
+        self.sendq_cap = max(1, int(sendq_cap))
+        self.evict_drops = max(1, int(evict_drops))
+        self.push_retries = int(push_retries)
         self._lock = threading.Lock()
         self._subs: dict[int, InfoSub] = {}
         # url -> RpcSub (reference: NetworkOPs mRpcSubMap): HTTP-callback
         # subscriptions outlive any one request; found/created by
         # `subscribe` with a url (admin-only)
         self.rpc_subs: dict[str, InfoSub] = {}
+        # fanout plane: publish→deliver lag + drop/evict accounting.
+        # stats writes ride the shard locks (or the publish thread when
+        # inline), so plain int bumps under those locks suffice.
+        self.stats = {
+            "published": 0, "delivered": 0, "dropped_events": 0,
+            "slow_evicted": 0, "dead_evicted": 0,
+        }
+        # one lock for the shared counters + lag histogram: enqueues
+        # ride per-shard locks and deliveries ride worker threads, so
+        # bare `+=` across shards would lose updates
+        self._stats_lock = threading.Lock()
+        self.lag_hist = LatencyHist(bounds=STAGE_BOUNDS, interpolate=True)
+        self._shards: list[_FanoutShard] = [
+            _FanoutShard(self, i) for i in range(max(0, int(shards)))
+        ]
         ops.on_ledger_closed.append(self._pub_ledger)
         ops.on_proposed_tx.append(self._pub_proposed)
 
@@ -59,11 +212,16 @@ class SubscriptionManager:
         with self._lock:
             sub = self.rpc_subs.get(url)
             if sub is None:
-                sub = RpcSub(url, username, password)
+                sub = RpcSub(url, username, password,
+                             max_retries=self.push_retries)
                 self.rpc_subs[url] = sub
             elif username or password:
                 sub.set_credentials(username, password)
-            return sub
+        # slow-consumer eviction for the HTTP-push side too: a url whose
+        # listener keeps exhausting delivery retries is dead weight and
+        # gets pruned outright (rpcsub.py fires this past its threshold)
+        sub.on_dead = lambda s=sub: self._evict(s, reason="slow_consumer")
+        return sub
 
     def rpc_sub_lookup(self, url: str):
         """Find only (unsubscribe must never create — a typo'd url would
@@ -181,7 +339,7 @@ class SubscriptionManager:
                     ],
                     **req.get("echo", {}),
                 }
-                self._safe_send(sub, msg)
+                self._deliver(sub, msg)
 
     def unsubscribe_accounts(self, sub: InfoSub, accounts: list[bytes],
                              proposed: bool = False) -> None:
@@ -222,7 +380,7 @@ class SubscriptionManager:
         }
         for sub in self._each():
             if "ledger" in sub.streams:
-                self._safe_send(sub, msg)
+                self._deliver(sub, msg)
         # accepted transactions (reference: pubAcceptedTransaction)
         for txid, blob, meta in ledger.tx_entries():
             tx = ledger.parse_tx(txid, blob)
@@ -255,7 +413,7 @@ class SubscriptionManager:
         }
         for sub in self._each():
             if "server" in sub.streams:
-                self._safe_send(sub, msg)
+                self._deliver(sub, msg)
 
     def _pub_proposed(self, tx: SerializedTransaction, ter: TER) -> None:
         self._pub_tx(tx, ter, ledger=None, validated=False)
@@ -309,13 +467,96 @@ class SubscriptionManager:
             if sub.accounts_proposed & touched:
                 wants = True
             if wants:
-                self._safe_send(sub, msg)
+                self._deliver(sub, msg)
 
-    def _safe_send(self, sub: InfoSub, msg: dict) -> None:
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self.stats[key] += n
+
+    def _deliver(self, sub: InfoSub, msg: dict) -> None:
+        """Route one event: shard enqueue (bounded, async) when the
+        fanout plane is on, inline send otherwise."""
+        if self._shards:
+            shard = self._shards[sub.id % len(self._shards)]
+            shard.enqueue(sub, msg, time.perf_counter())
+            return
+        self._bump("published")
         try:
             sub.send(msg)
+            self._bump("delivered")
         except Exception:  # noqa: BLE001 — a dead subscriber must not break the pub path
             self.remove(sub.id)
+            self._bump("dead_evicted")
+
+    def _evict(self, sub: InfoSub, reason: str) -> None:
+        """Drop a subscriber the fanout plane gave up on (slow consumer
+        past the drop threshold, or a dead sink). Idempotent: the slow
+        path and a later dead-sink detection may both fire for one
+        sub."""
+        with self._lock:
+            already = getattr(sub, "_evict_done", False)
+            sub._evict_done = True
+            sub.evicted = True
+            self._subs.pop(sub.id, None)
+            url = getattr(sub, "url", None)
+            if url is not None and self.rpc_subs.get(url) is sub:
+                del self.rpc_subs[url]
+        if already:
+            return
+        self._bump(
+            "slow_evicted" if reason == "slow_consumer" else "dead_evicted"
+        )
+        close = getattr(sub, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Block until every shard drained its queues (tests/smokes that
+        assert on delivered events; the serving path never calls it)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(s.drained() for s in self._shards):
+                return True
+            time.sleep(0.002)
+        return all(s.drained() for s in self._shards)
+
+    def stop(self) -> None:
+        for s in self._shards:
+            s.stop()
+        with self._lock:
+            rpc_subs = list(self.rpc_subs.values())
+        for sub in rpc_subs:
+            close = getattr(sub, "close", None)
+            if close is not None:
+                close()
+
+    def get_json(self) -> dict:
+        """`subs.*` counters for get_counts: fanout shape, publish /
+        deliver / drop / evict counts, publish→deliver lag quantiles,
+        and the HTTP-push (RPCSub) delivery aggregate."""
+        with self._lock:
+            n_subs = len(self._subs)
+            rpc_list = list(self.rpc_subs.values())
+        out = {
+            "subscribers": n_subs,
+            "rpc_subs": len(rpc_list),
+            "shards": len(self._shards),
+            "sendq_cap": self.sendq_cap,
+            "evict_drops": self.evict_drops,
+            **self.stats,
+        }
+        if self.lag_hist.count:
+            out["fanout_lag_p50_ms"] = self.lag_hist.quantile(0.5)
+            out["fanout_lag_p99_ms"] = self.lag_hist.quantile(0.99)
+        push = {"sent": 0, "retries": 0, "failures": 0, "dropped": 0}
+        for sub in rpc_list:
+            for k in push:
+                push[k] += getattr(sub, "stats", {}).get(k, 0)
+        out["push"] = push
+        return out
 
 
 def _tx_json_with_hash(tx: SerializedTransaction) -> dict:

@@ -68,6 +68,14 @@ class Scenario:
     # admission plane: attach a per-validator TxQ (pinned soft cap) and
     # route injected txs through admit() on every validator
     txq_cap: Optional[int] = None
+    # parallel speculation plane ([spec] workers=N, PR 8 follow-on):
+    # attach a thread-mode SpecExecutor to every honest validator so
+    # open-window speculation runs on a real worker pool UNDER the
+    # scenario's faults. Worker timing is wall-clock, so the per-run
+    # splice/retry counters are not replay-deterministic — the gate is
+    # HASH IDENTITY: the final chain must match the workers=1 run of
+    # the same seed byte-for-byte (tools/scenariosmoke.py).
+    spec_workers: int = 1
     # convergence tail
     converge_extra: int = 2
     max_tail_steps: int = 240
@@ -354,6 +362,18 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
     honest = [
         i for i in range(scn.n_validators) if i not in scn.byzantine
     ]
+    # parallel speculation under faults: thread-mode pools (the simnet
+    # is in-process; forking workers per validator would be pure
+    # overhead) on every honest validator's chain
+    spec_execs = []
+    if scn.spec_workers > 1:
+        from ..engine.specexec import SpecExecutor
+
+        for i in honest:
+            ex = SpecExecutor(workers=scn.spec_workers, mode="thread")
+            ex.start()
+            net.validators[i].node.lm.spec_executor = ex
+            spec_execs.append(ex)
     # committed txids observed on ANY honest validator's accept feed —
     # one observer is not enough: fork-repair adoption can skip
     # unresolvable intermediate ledgers (no on_ledger fires for them),
@@ -486,8 +506,29 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
                 "remaining": len(q0),
                 **_fairness(admissions, commits),
             }
+        if spec_execs:
+            # anti-vacuity evidence for the spec-pool legs: the pools
+            # actually dispatched/committed work (wall-clock-dependent
+            # counts — excluded from determinism comparisons by design)
+            agg: dict[str, int] = {}
+            for ex in spec_execs:
+                for k, v in ex.counters.snapshot().items():
+                    if isinstance(v, int):
+                        agg[k] = agg.get(k, 0) + v
+            card["spec"] = {
+                "workers": scn.spec_workers,
+                "dispatched": agg.get("dispatched", 0),
+                "committed": agg.get("committed", 0),
+                "retries": agg.get("retries", 0),
+                "serial_fallbacks": agg.get("serial_fallbacks", 0),
+            }
         return card
     finally:
+        for ex in spec_execs:
+            try:
+                ex.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
         for db in dbs.values():
             try:
                 db.close()

@@ -440,11 +440,15 @@ class TestServerStream:
             sub = InfoSub(got.append)
             n.subs.subscribe_streams(sub, ["server"])
             n.fee_track.raise_local_fee()
+            # delivery rides the sharded fanout workers now — drain
+            # them before asserting on the in-process sink
+            assert n.subs.flush(timeout=5.0)
             statuses = [m for m in got if m.get("type") == "serverStatus"]
             assert statuses, got
             assert statuses[-1]["load_factor"] > 256
             before = len(statuses)
             n.fee_track.lower_local_fee()
+            assert n.subs.flush(timeout=5.0)
             statuses = [m for m in got if m.get("type") == "serverStatus"]
             # the lowering itself must publish, and recovery lands back
             # at the normal factor

@@ -1,0 +1,547 @@
+"""Follower read plane: validated-snapshot pointer, validated-seq
+result caches, sharded subscription fanout, RPCSub retry, and the
+account_tx retention-floor contract (ISSUE 10 / ROADMAP item 3)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import pytest
+
+jax.config.update("jax_platforms", "cpu")
+
+from stellard_tpu.node.config import Config  # noqa: E402
+from stellard_tpu.node.node import Node  # noqa: E402
+from stellard_tpu.protocol.formats import TxType  # noqa: E402
+from stellard_tpu.protocol.keys import KeyPair  # noqa: E402
+from stellard_tpu.protocol.sfields import sfAmount, sfDestination  # noqa: E402
+from stellard_tpu.protocol.stamount import STAmount  # noqa: E402
+from stellard_tpu.protocol.sttx import SerializedTransaction  # noqa: E402
+from stellard_tpu.rpc.handlers import Context, Role, dispatch  # noqa: E402
+from stellard_tpu.rpc.readplane import ReadPlane, ResultCache  # noqa: E402
+
+
+@pytest.fixture
+def node():
+    n = Node(Config(signature_backend="cpu")).setup()
+    yield n
+    n.stop()
+
+
+def fund(n: Node, kp: KeyPair, drops: int = 1_000_000_000) -> None:
+    master = n.master_keys
+    root = n.ledger_master.current_ledger().account_root(master.account_id)
+    from stellard_tpu.protocol.sfields import sfSequence
+
+    tx = SerializedTransaction.build(
+        TxType.ttPAYMENT, master.account_id, root[sfSequence], 10,
+        {sfAmount: STAmount.from_drops(drops),
+         sfDestination: kp.account_id},
+    )
+    tx.sign(master)
+    ter, applied = n.submit(tx)
+    assert applied, ter
+
+
+def call(n: Node, method: str, role: Role = Role.ADMIN, **params) -> dict:
+    return dispatch(Context(n, params, role), method)
+
+
+class TestResultCache:
+    def test_hit_miss_and_epoch_invalidation(self):
+        c = ResultCache(capacity=4)
+        assert c.get(5, "m", "k") is None
+        c.on_new_seq(5)
+        c.put(5, "m", "k", {"v": 1})
+        assert c.get(5, "m", "k") == {"v": 1}
+        # a stale-seq get/put never hits/lands
+        assert c.get(4, "m", "k") is None
+        c.put(4, "m", "k2", {"v": 2})
+        assert c.get(5, "m", "k2") is None
+        # new seq invalidates the whole generation
+        c.on_new_seq(6)
+        assert c.get(5, "m", "k") is None
+        assert c.get(6, "m", "k") is None
+        assert c.get_json()["invalidated"] == 1
+
+    def test_capacity_bound(self):
+        c = ResultCache(capacity=2)
+        c.on_new_seq(1)
+        c.put(1, "m", "a", {})
+        c.put(1, "m", "b", {})
+        c.put(1, "m", "c", {})  # over capacity: refused, not grown
+        j = c.get_json()
+        assert j["entries"] == 2 and j["overflow"] == 1
+
+    def test_hit_returns_copy(self):
+        c = ResultCache()
+        c.on_new_seq(1)
+        c.put(1, "m", "k", {"v": 1})
+        got = c.get(1, "m", "k")
+        got["status"] = "success"  # door annotation must not leak back
+        assert "status" not in c.get(1, "m", "k")
+
+
+class TestReadPlane:
+    def test_publish_monotonic(self, node):
+        rp = node.read_plane
+        lcl1, _ = node.close_ledger()
+        assert rp.snapshot() is not None
+        assert rp.snapshot().seq == lcl1.seq
+        lcl2, _ = node.close_ledger()
+        assert rp.snapshot().seq == lcl2.seq
+        # a historical republish never regresses the tip
+        rp.publish(lcl1)
+        assert rp.snapshot().seq == lcl2.seq
+
+    def test_held_chain_lock_does_not_block_validated_reads(self, node):
+        """THE acceptance pin: read RPCs against the last validated
+        snapshot must complete while the chain lock (master lock AND
+        the LedgerMaster lock) is held by a writer."""
+        alice = KeyPair.from_passphrase("rp-alice")
+        fund(node, alice)
+        node.close_ledger()
+
+        locked = threading.Event()
+        release = threading.Event()
+
+        def hold_locks():
+            with node.ops.master_lock:
+                with node.ledger_master._lock:
+                    locked.set()
+                    release.wait(timeout=30)
+
+        t = threading.Thread(target=hold_locks, daemon=True)
+        t.start()
+        assert locked.wait(timeout=5)
+        try:
+            done = {}
+
+            def read():
+                for sel in ("validated", "closed", "current", None):
+                    params = {"account": alice.human_account_id}
+                    if sel is not None:
+                        params["ledger_index"] = sel
+                    r = dispatch(Context(node, params, Role.GUEST),
+                                 "account_info")
+                    done[sel] = r
+            reader = threading.Thread(target=read, daemon=True)
+            reader.start()
+            reader.join(timeout=5)
+            assert not reader.is_alive(), (
+                "account_info blocked on the held chain lock"
+            )
+            for sel, r in done.items():
+                assert "account_data" in r, (sel, r)
+        finally:
+            release.set()
+            t.join(timeout=5)
+
+    def test_dispatch_caches_validated_reads(self, node):
+        alice = KeyPair.from_passphrase("rp-cache")
+        fund(node, alice)
+        node.close_ledger()
+        params = {"account": alice.human_account_id,
+                  "ledger_index": "validated"}
+        r1 = dispatch(Context(node, dict(params), Role.GUEST),
+                      "account_info")
+        assert "account_data" in r1
+        before = node.read_cache.get_json()["hits"]
+        r2 = dispatch(Context(node, dict(params), Role.GUEST),
+                      "account_info")
+        assert r2["account_data"] == r1["account_data"]
+        assert node.read_cache.get_json()["hits"] == before + 1
+        # a new validated seq invalidates: next read is a miss again
+        node.close_ledger()
+        misses = node.read_cache.get_json()["misses"]
+        dispatch(Context(node, dict(params), Role.GUEST), "account_info")
+        assert node.read_cache.get_json()["misses"] > misses
+
+    def test_quorum_lag_epoch_opens_on_validation(self, node):
+        """On a quorum net the persist floor lands before the
+        validation floor: the snapshot must stay behind min(persisted,
+        validated) and the epoch must open when the validation
+        arrives — not a full round later, and never before persist."""
+        lcl, _ = node.close_ledger()
+        rp = ReadPlane(cache=ResultCache())
+        # persist floor arrives first (validations still in flight):
+        # nothing serves yet
+        rp.note_persisted(lcl)
+        assert rp.snapshot() is None
+        # validation floor catches up: epoch opens at the min
+        rp.note_validated(lcl)
+        assert rp.snapshot() is lcl
+        assert rp.cache.get_json()["seq"] == lcl.seq
+        # follower shape: validated-before-persisted must NOT advance
+        # the snapshot past the persisted floor
+        lcl2, _ = node.close_ledger()
+        rp.note_validated(lcl2)
+        assert rp.snapshot() is lcl
+        rp.note_persisted(lcl2)
+        assert rp.snapshot() is lcl2
+
+    def test_account_tx_cached_only_when_bounded_by_validated(self, node):
+        """account_tx's SQL index also holds closed-but-unvalidated
+        ledgers — only windows explicitly bounded at or below the
+        validated seq are pure functions of the snapshot."""
+        alice = KeyPair.from_passphrase("rp-atx")
+        fund(node, alice)
+        node.close_ledger()
+        val_seq = node.read_plane.snapshot().seq
+        # unbounded window: never cached
+        p = {"account": alice.human_account_id}
+        dispatch(Context(node, dict(p), Role.GUEST), "account_tx")
+        hits = node.read_cache.get_json()["hits"]
+        dispatch(Context(node, dict(p), Role.GUEST), "account_tx")
+        assert node.read_cache.get_json()["hits"] == hits
+        # bounded at the validated seq: cached
+        p = {"account": alice.human_account_id,
+             "ledger_index_min": 1, "ledger_index_max": val_seq}
+        r1 = dispatch(Context(node, dict(p), Role.GUEST), "account_tx")
+        assert r1["transactions"]
+        hits = node.read_cache.get_json()["hits"]
+        r2 = dispatch(Context(node, dict(p), Role.GUEST), "account_tx")
+        assert node.read_cache.get_json()["hits"] == hits + 1
+        assert r2["transactions"] == r1["transactions"]
+
+    def test_current_reads_not_cached(self, node):
+        """A "current" read reflects the mutable open ledger — it must
+        never come from the immutable validated-seq cache."""
+        alice = KeyPair.from_passphrase("rp-cur")
+        fund(node, alice)
+        node.close_ledger()
+        p = {"account": alice.human_account_id, "ledger_index": "current"}
+        dispatch(Context(node, dict(p), Role.GUEST), "account_info")
+        hits = node.read_cache.get_json()["hits"]
+        dispatch(Context(node, dict(p), Role.GUEST), "account_info")
+        assert node.read_cache.get_json()["hits"] == hits
+
+    def test_follower_default_serves_validated(self, node):
+        """With the follower's serve-validated default, selector-less
+        reads resolve the validated snapshot (and cache)."""
+        alice = KeyPair.from_passphrase("rp-def")
+        fund(node, alice)
+        node.close_ledger()
+        node.serve_validated_default = True
+        try:
+            snap_seq = node.read_plane.snapshot().seq
+            r = dispatch(
+                Context(node, {"account": alice.human_account_id},
+                        Role.GUEST),
+                "account_info",
+            )
+            assert r["ledger_index"] == snap_seq
+            hits = node.read_cache.get_json()["hits"]
+            dispatch(
+                Context(node, {"account": alice.human_account_id},
+                        Role.GUEST),
+                "account_info",
+            )
+            assert node.read_cache.get_json()["hits"] == hits + 1
+        finally:
+            node.serve_validated_default = False
+
+
+class TestShardedFanout:
+    def _mgr(self, node, **kw):
+        from stellard_tpu.rpc.infosub import SubscriptionManager
+
+        return SubscriptionManager(node.ops, **kw)
+
+    def test_ordered_delivery_across_shards(self, node):
+        from stellard_tpu.rpc.infosub import InfoSub
+
+        mgr = self._mgr(node, shards=3)
+        try:
+            got: dict[int, list] = {}
+            subs = []
+            for i in range(8):
+                lst: list = []
+                sub = InfoSub(lst.append)
+                got[sub.id] = lst
+                mgr.subscribe_streams(sub, ["ledger"])
+                subs.append(sub)
+            for n_ev in range(50):
+                msg = {"type": "ledgerClosed", "ledger_index": n_ev}
+                for sub in subs:
+                    mgr._deliver(sub, msg)
+            assert mgr.flush(timeout=10.0)
+            for sub in subs:
+                seqs = [m["ledger_index"] for m in got[sub.id]]
+                assert seqs == list(range(50)), (
+                    f"sub {sub.id} out of order/lossy: {seqs[:10]}..."
+                )
+            j = mgr.get_json()
+            assert j["delivered"] == 400 and j["dropped_events"] == 0
+            assert j["fanout_lag_p99_ms"] >= 0.0
+        finally:
+            mgr.stop()
+
+    def test_slow_consumer_bounded_and_evicted(self, node):
+        """A consumer whose queue keeps overflowing (its shard worker
+        wedged mid-send) drops OLDEST events within the cap and is
+        evicted outright past the consecutive-drop threshold — it can
+        never pin unbounded memory on the publish path."""
+        from stellard_tpu.rpc.infosub import InfoSub
+
+        mgr = self._mgr(node, shards=1, sendq_cap=4, evict_drops=3)
+        try:
+            gate = threading.Event()
+            first_in = threading.Event()
+
+            def slow_sink(msg):
+                first_in.set()
+                gate.wait(timeout=30)
+
+            slow = InfoSub(slow_sink)
+            mgr.subscribe_streams(slow, ["ledger"])
+
+            # wedge the worker in the slow sink, then overflow its queue
+            mgr._deliver(slow, {"type": "ledgerClosed", "i": -1})
+            assert first_in.wait(timeout=5)
+            for i in range(12):  # cap 4 → drops → eviction at 3 drops
+                mgr._deliver(slow, {"type": "ledgerClosed", "i": i})
+            assert len(slow.sendq) <= 4
+            gate.set()
+            assert mgr.flush(timeout=10.0)
+            j = mgr.get_json()
+            assert j["dropped_events"] >= 3
+            assert j["slow_evicted"] == 1
+            assert slow.evicted
+            # the evicted sub is gone from the registry and further
+            # publishes to it are no-ops
+            with mgr._lock:
+                assert slow.id not in mgr._subs
+            mgr._deliver(slow, {"type": "ledgerClosed", "i": 99})
+            assert mgr.get_json()["slow_evicted"] == 1
+        finally:
+            gate.set()
+            mgr.stop()
+
+    def test_publish_path_never_blocks_on_slow_consumer(self, node):
+        """The close-path publisher only enqueues: a wedged subscriber
+        must not stall _pub_ledger for everyone else."""
+        from stellard_tpu.rpc.infosub import InfoSub
+
+        mgr = self._mgr(node, shards=2, sendq_cap=8)
+        try:
+            gate = threading.Event()
+            slow = InfoSub(lambda m: gate.wait(timeout=30))
+            mgr.subscribe_streams(slow, ["ledger", "transactions"])
+            alice = KeyPair.from_passphrase("fan-alice")
+            fund(node, alice)
+            t0 = time.perf_counter()
+            node.close_ledger()  # fires _pub_ledger through mgr
+            publish_s = time.perf_counter() - t0
+            assert publish_s < 5.0, (
+                f"publish stalled {publish_s:.1f}s behind a wedged sink"
+            )
+        finally:
+            gate.set()
+            mgr.stop()
+
+    def test_inline_mode_unchanged(self, node):
+        """shards=0 keeps the synchronous legacy path (tests and
+        embedders that assert right after close)."""
+        from stellard_tpu.rpc.infosub import InfoSub
+
+        mgr = self._mgr(node)  # shards=0
+        got: list = []
+        sub = InfoSub(got.append)
+        mgr.subscribe_streams(sub, ["ledger"])
+        node.close_ledger()
+        assert any(m.get("type") == "ledgerClosed" for m in got)
+
+
+class TestRpcSubRetry:
+    def _listener(self, fail_first: int, status_after: int = 200):
+        import http.server
+
+        state = {"calls": 0, "bodies": []}
+        delivered = threading.Event()
+
+        class Sink(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                import json as _json
+
+                state["calls"] += 1
+                n = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(n)
+                if state["calls"] <= fail_first:
+                    self.send_response(500)
+                    self.end_headers()
+                    return
+                state["bodies"].append(_json.loads(body))
+                delivered.set()
+                self.send_response(status_after)
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Sink)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, state, delivered
+
+    def test_retry_with_backoff_then_delivery(self):
+        from stellard_tpu.rpc.rpcsub import RpcSub
+
+        srv, state, delivered = self._listener(fail_first=2)
+        try:
+            sub = RpcSub(f"http://127.0.0.1:{srv.server_port}/",
+                         max_retries=5, backoff_base=0.05,
+                         backoff_max=0.2)
+            sub._enqueue({"type": "ledgerClosed", "ledger_index": 7})
+            assert delivered.wait(timeout=15), "event never delivered"
+            # the sender thread bumps `sent` after the HTTP roundtrip
+            # completes — poll briefly
+            deadline = time.monotonic() + 5
+            while sub.stats["sent"] < 1 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert state["calls"] == 3  # 2 failures + 1 success
+            assert sub.stats["retries"] == 2
+            assert sub.stats["sent"] == 1
+            assert sub.stats["dropped"] == 0
+            ev = state["bodies"][0]["params"][0]
+            assert ev["seq"] == 1 and ev["ledger_index"] == 7
+            sub.close()
+        finally:
+            srv.shutdown()
+
+    def test_retries_exhausted_drops_and_evicts(self):
+        from stellard_tpu.rpc.rpcsub import RpcSub
+
+        dead = threading.Event()
+        # a port nothing listens on: every POST fails instantly
+        sub = RpcSub("http://127.0.0.1:9/", max_retries=1,
+                     backoff_base=0.01, backoff_max=0.02)
+        sub.EVICT_FAILURES = 2
+        sub.on_dead = dead.set
+        for i in range(3):
+            sub._enqueue({"type": "ledgerClosed", "ledger_index": i})
+        assert dead.wait(timeout=15), "on_dead never fired"
+        assert sub.stats["dropped"] >= 2
+        assert sub.stats["retries"] >= 1
+        sub.close()
+
+    def test_order_preserved_across_retry(self):
+        from stellard_tpu.rpc.rpcsub import RpcSub
+
+        srv, state, delivered = self._listener(fail_first=1)
+        try:
+            sub = RpcSub(f"http://127.0.0.1:{srv.server_port}/",
+                         max_retries=3, backoff_base=0.05,
+                         backoff_max=0.1)
+            sub._enqueue({"type": "a"})
+            sub._enqueue({"type": "b"})
+            deadline = time.monotonic() + 15
+            while len(state["bodies"]) < 2 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            seqs = [b["params"][0]["seq"] for b in state["bodies"]]
+            assert seqs == [1, 2], f"retry reordered events: {seqs}"
+            sub.close()
+        finally:
+            srv.shutdown()
+
+
+class TestAccountTxRetentionFloor:
+    def _flood_closes(self, node, n_closes=4):
+        alice = KeyPair.from_passphrase("floor-alice")
+        fund(node, alice)
+        node.close_ledger()
+        for _ in range(n_closes - 1):
+            fund(node, alice, drops=1_000_000)
+            node.close_ledger()
+        return alice
+
+    def test_marker_below_floor_errors(self, node):
+        alice = self._flood_closes(node)
+        node.txdb.trim_below(4)
+        r = call(node, "account_tx", account=alice.human_account_id,
+                 marker={"ledger": 2, "seq": 0})
+        assert r.get("error") == "lgrIdxInvalid", r
+        # backward paging resuming below the floor errors too
+        r = call(node, "account_tx", account=alice.human_account_id,
+                 forward=False, marker={"ledger": 3, "seq": 0})
+        assert r.get("error") == "lgrIdxInvalid", r
+
+    def test_window_below_floor_errors(self, node):
+        alice = self._flood_closes(node)
+        node.txdb.trim_below(4)
+        r = call(node, "account_tx", account=alice.human_account_id,
+                 ledger_index_min=1, ledger_index_max=3)
+        assert r.get("error") == "lgrIdxInvalid", r
+
+    def test_straddling_window_clamps_and_reports_floor(self, node):
+        """A window straddling the floor serves what exists and echoes
+        the EFFECTIVE minimum — a pager can see the truncation instead
+        of reading a quietly complete-looking history."""
+        alice = self._flood_closes(node)
+        node.txdb.trim_below(4)
+        r = call(node, "account_tx", account=alice.human_account_id,
+                 ledger_index_min=1, ledger_index_max=10)
+        assert "error" not in r, r
+        assert r["ledger_index_min"] == 4, r["ledger_index_min"]
+        for t in r["transactions"]:
+            assert t["tx"]["ledger_index"] >= 4
+
+    def test_failed_trim_does_not_raise_floor(self, node):
+        alice = self._flood_closes(node)
+        node.txdb.close()
+        try:
+            node.txdb.trim_below(4)
+        except Exception:
+            pass
+        assert node.txdb.retain_floor == 0
+
+    def test_valid_paging_above_floor_still_works(self, node):
+        alice = self._flood_closes(node)
+        node.txdb.trim_below(4)
+        r = call(node, "account_tx", account=alice.human_account_id)
+        assert "transactions" in r and r["transactions"], r
+        for t in r["transactions"]:
+            assert t["tx"]["hash"]
+        # a marker AT/above the floor resumes cleanly
+        r = call(node, "account_tx", account=alice.human_account_id,
+                 marker={"ledger": 4, "seq": 0})
+        assert "error" not in r, r
+
+    def test_no_floor_no_gate(self, node):
+        alice = self._flood_closes(node)
+        r = call(node, "account_tx", account=alice.human_account_id,
+                 marker={"ledger": 1, "seq": 0})
+        assert "error" not in r, r
+
+
+class TestFollowerFlag:
+    def test_follower_requires_networked(self):
+        with pytest.raises(ValueError, match="follower"):
+            Node(Config(node_mode="follower", standalone=True))
+
+    def test_follower_validator_never_rounds(self):
+        from stellard_tpu.node.validator import ValidatorNode
+
+        class _Adapter:
+            def request_ledger_data(self, msg):
+                pass
+
+        kp = KeyPair.from_passphrase("fol-v")
+        vn = ValidatorNode(
+            key=kp, unl={kp.public}, adapter=_Adapter(), quorum=1,
+            network_time=lambda: 0, follower=True,
+        )
+        vn.start(KeyPair.from_passphrase("masterpassphrase").account_id)
+        assert vn.round is None
+        assert vn.proposing is False
+        assert vn.validator_state == "follower"
+        vn.begin_round()
+        assert vn.round is None
+        j = vn.follower_json()
+        assert j["ledgers_ingested"] == 0
+
+    def test_config_rejects_unknown_mode(self):
+        with pytest.raises(ValueError, match="mode"):
+            Config.from_ini("[node]\nmode=observer\n")

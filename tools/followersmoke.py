@@ -1,0 +1,274 @@
+"""Tier-1 follower smoke: the read-plane scale-out tier as a gate.
+
+Boots a LEADER (networked solo validator, quorum=1) and a FOLLOWER
+([node] mode=follower) over a real TCP peer link, floods the leader,
+and asserts the whole follower contract end-to-end:
+
+- ingest identity: the follower's ledger hash at EVERY validated seq is
+  byte-identical to the leader's (the ledger hash covers the state and
+  tx tree roots, so this is state-root identity);
+- cold catch-up: the follower boots AFTER the leader has closed
+  ledgers and must join the validated chain (bulk segment path armed);
+- serving mid-flood: read RPCs answered from the follower's real HTTP
+  door WHILE the leader floods, resolved against the validated
+  snapshot, with the validated-seq result cache taking hits;
+- subscription order: ledgerClosed events delivered through the
+  sharded fanout arrive in strictly increasing seq order, and per-tx
+  events ride along;
+- no rounds: the follower never runs consensus (rounds_completed == 0).
+
+Runtime: ~30-60s (clock_speed-accelerated consensus).
+
+Usage: python tools/followersmoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SPEED = 5.0
+
+
+def fail(msg: str) -> None:
+    print(f"FOLLOWER SMOKE FAILED: {msg}", file=sys.stderr)
+    raise SystemExit(2)
+
+
+def main() -> None:
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from stellard_tpu.node.config import Config
+    from stellard_tpu.node.node import Node
+    from stellard_tpu.protocol.formats import TxType
+    from stellard_tpu.protocol.keys import KeyPair
+    from stellard_tpu.protocol.sfields import sfAmount, sfDestination
+    from stellard_tpu.protocol.stamount import STAmount
+    from stellard_tpu.protocol.sttx import SerializedTransaction
+    from stellard_tpu.rpc.infosub import InfoSub
+    from stellard_tpu.testkit.tcpnet import free_ports, rpc, wait_until
+
+    tmp = tempfile.mkdtemp(prefix="followersmoke-")
+    leader_peer, follower_peer = free_ports(2)
+    val_key = KeyPair.from_passphrase("followersmoke-leader")
+
+    leader = Node(Config(
+        standalone=False,
+        signature_backend="cpu",
+        node_db_type="segstore",
+        node_db_path=os.path.join(tmp, "leader-ns"),
+        database_path=os.path.join(tmp, "leader.db"),
+        validation_seed=val_key.human_seed,
+        validation_quorum=1,
+        peer_port=leader_peer,
+        clock_speed=SPEED,
+        rpc_port=0,
+    )).setup().serve()
+
+    follower = None
+    try:
+        # phase 1: leader alone closes a few ledgers so the follower
+        # later boots COLD and must catch up
+        master = leader.master_keys
+
+        def payment(seq: int, dest: bytes) -> SerializedTransaction:
+            tx = SerializedTransaction.build(
+                TxType.ttPAYMENT, master.account_id, seq, 10,
+                {sfAmount: STAmount.from_drops(250_000_000),
+                 sfDestination: dest},
+            )
+            tx.sign(master)
+            return tx
+
+        dests = [KeyPair.from_passphrase(f"fsmoke-{i}").account_id
+                 for i in range(8)]
+        acked = threading.Semaphore(0)
+
+        def cb(_tx, _ter, _applied):
+            acked.release()
+
+        next_seq = 1
+        for _ in range(30):
+            leader.ops.submit_transaction(
+                payment(next_seq, dests[next_seq % len(dests)]), cb)
+            next_seq += 1
+        for _ in range(30):
+            acked.acquire()
+
+        def leader_validated():
+            v = leader.ledger_master.validated
+            return v.seq if v is not None else 0
+
+        if not wait_until(lambda: leader_validated() >= 3, 90, 0.5):
+            fail(f"leader never validated 3 ledgers solo "
+                 f"(validated={leader_validated()})")
+
+        # phase 2: boot the follower cold
+        follower = Node(Config(
+            standalone=False,
+            node_mode="follower",
+            signature_backend="cpu",
+            node_db_type="segstore",
+            node_db_path=os.path.join(tmp, "follower-ns"),
+            database_path=os.path.join(tmp, "follower.db"),
+            validators=[val_key.human_node_public],
+            validation_quorum=1,
+            peer_port=follower_peer,
+            ips=[f"127.0.0.1 {leader_peer}"],
+            clock_speed=SPEED,
+            rpc_port=0,
+        )).setup().serve()
+        fport = follower.http_server.port
+
+        # subscription plane: ledger + account streams through the
+        # sharded fanout (in-process sink; the WS door rides the same
+        # manager and is covered by the RPC-server suite)
+        events: list[dict] = []
+        sub = InfoSub(events.append)
+        follower.subs.subscribe_streams(sub, ["ledger", "transactions"])
+        follower.subs.subscribe_accounts(sub, [dests[0]])
+
+        def follower_validated():
+            v = follower.ledger_master.validated
+            return v.seq if v is not None else 0
+
+        if not wait_until(
+            lambda: follower_validated() >= leader_validated() - 1
+            and follower_validated() >= 3, 120, 0.5,
+        ):
+            fail(f"follower never caught up (follower="
+                 f"{follower_validated()}, leader={leader_validated()})")
+
+        # phase 3: flood the leader WHILE reading from the follower
+        reads = {"ok": 0, "err": 0}
+        stop_flood = threading.Event()
+
+        def flood():
+            nonlocal next_seq
+            while not stop_flood.is_set():
+                for _ in range(10):
+                    leader.ops.submit_transaction(
+                        payment(next_seq, dests[next_seq % len(dests)]),
+                        cb,
+                    )
+                    next_seq += 1
+                time.sleep(0.05)
+
+        flooder = threading.Thread(target=flood, daemon=True)
+        flooder.start()
+        t_end = time.monotonic() + 15.0
+        master_id = master.human_account_id
+        while time.monotonic() < t_end:
+            try:
+                r = rpc(fport, "account_info", {"account": master_id})
+                if r.get("status") == "success" and "account_data" in r:
+                    reads["ok"] += 1
+                else:
+                    reads["err"] += 1
+                r = rpc(fport, "ledger", {"ledger_index": "validated"})
+                if r.get("status") != "success":
+                    reads["err"] += 1
+            except Exception:
+                reads["err"] += 1
+            time.sleep(0.02)
+        stop_flood.set()
+        flooder.join(timeout=5)
+
+        if reads["ok"] < 20:
+            fail(f"follower served too few reads mid-flood: {reads}")
+        if reads["err"] > reads["ok"] // 10:
+            fail(f"follower read errors mid-flood: {reads}")
+
+        # let the tail drain: follower converges on the leader's tip
+        target = leader_validated()
+        if not wait_until(lambda: follower_validated() >= target, 120, 0.5):
+            fail(f"follower stalled at {follower_validated()} "
+                 f"(leader={target})")
+
+        # gate 1: state-root byte identity at EVERY validated seq
+        common = min(leader_validated(), follower_validated())
+        lh = leader.ledger_master.ledger_history
+        fh = follower.ledger_master.ledger_history
+        checked = 0
+        for seq in range(2, common + 1):
+            a, b = lh.get(seq), fh.get(seq)
+            if a is None or b is None:
+                continue  # aged out of the bounded index
+            if a != b:
+                fail(f"ledger hash mismatch at seq {seq}: "
+                     f"{a.hex()} != {b.hex()}")
+            checked += 1
+        if checked < 3:
+            fail(f"too few comparable seqs ({checked})")
+
+        # gate 2: the follower never ran consensus, and actually
+        # ingested (anti-vacuity)
+        vn = follower.overlay.node
+        if vn.rounds_completed != 0:
+            fail(f"follower completed {vn.rounds_completed} consensus "
+                 f"rounds — it must never close")
+        if vn.ledgers_ingested < 3:
+            fail(f"follower ingested only {vn.ledgers_ingested} ledgers")
+
+        # gate 3: the result cache took hits (repeated identical read
+        # against one validated seq) and reads resolved from the
+        # validated snapshot
+        for _ in range(5):
+            rpc(fport, "account_info", {"account": master_id})
+        cj = follower.read_cache.get_json()
+        if cj["hits"] <= 0:
+            fail(f"validated-seq result cache never hit: {cj}")
+        if follower.read_plane.snapshot() is None:
+            fail("follower read plane never published a snapshot")
+
+        # gate 4: subscription events delivered IN ORDER through the
+        # sharded fanout
+        if not follower.subs.flush(timeout=10.0):
+            fail("fanout shards never drained")
+        closed_seqs = [e["ledger_index"] for e in events
+                       if e.get("type") == "ledgerClosed"]
+        if len(closed_seqs) < 3:
+            fail(f"too few ledgerClosed events: {closed_seqs}")
+        if closed_seqs != sorted(closed_seqs) or len(set(closed_seqs)) != len(
+            closed_seqs
+        ):
+            fail(f"ledgerClosed events out of order: {closed_seqs}")
+        if not any(e.get("type") == "transaction" for e in events):
+            fail("no transaction events delivered")
+
+        sj = follower.subs.get_json()
+        print(json.dumps({
+            "follower_smoke": "ok",
+            "validated_seq": common,
+            "seqs_hash_checked": checked,
+            "ledgers_ingested": vn.ledgers_ingested,
+            "reads_mid_flood": reads,
+            "cache": {k: cj[k] for k in ("hits", "misses", "hit_rate")},
+            "subs": {k: sj[k] for k in ("published", "delivered",
+                                        "dropped_events")},
+            "segfetch_started": (
+                vn.segment_catchup.get_json()["started"]
+                if vn.segment_catchup is not None else 0
+            ),
+            "ledger_closed_events": len(closed_seqs),
+        }), flush=True)
+    finally:
+        if follower is not None:
+            follower.stop()
+        leader.stop()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

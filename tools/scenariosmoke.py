@@ -88,6 +88,36 @@ def main() -> None:
         fail(f"byzantine: {card['submitted'] - card['committed']} "
              f"client txs lost under hostile peer")
 
+    # (2b) spec-pool under faults (PR 8 follow-on): the chaos and
+    # partition scenarios re-run with [spec] workers=2 thread pools on
+    # every honest validator. Worker timing is wall-clock, so the
+    # splice/retry counters are not replay-deterministic — the gate is
+    # HASH IDENTITY: the parallel run must converge on the exact chain
+    # the serial run of the same seed produced, under the same faults.
+    for name in ("chaos", "partition_kills"):
+        serial = run_simnet(build_scenario(name, seed=SEED))
+        spec_scn = build_scenario(name, seed=SEED)
+        spec_scn.spec_workers = 2
+        spec_card = run_simnet(spec_scn)
+        print(json.dumps(spec_card), flush=True)
+        if not spec_card["converged"]:
+            fail(f"{name}+spec: validators never converged "
+                 f"({spec_card['validated_seqs']})")
+        if not spec_card["single_hash"]:
+            fail(f"{name}+spec: FORK at seq {spec_card['final_seq']}")
+        if (spec_card["final_seq"] != serial["final_seq"]
+                or spec_card["final_hash"] != serial["final_hash"]):
+            fail(f"{name}+spec: workers=2 chain diverged from serial "
+                 f"(seq {spec_card['final_seq']} vs "
+                 f"{serial['final_seq']}, hash "
+                 f"{spec_card['final_hash']} vs {serial['final_hash']})")
+        if spec_card.get("spec", {}).get("dispatched", 0) <= 0:
+            fail(f"{name}+spec: worker pool dispatched nothing "
+                 f"(anti-vacuity)")
+        if spec_card["committed"] != spec_card["submitted"]:
+            fail(f"{name}+spec: only {spec_card['committed']}/"
+                 f"{spec_card['submitted']} committed under workers=2")
+
     # (3) cold-node catch-up under fire
     card = run_twice("cold_catchup")
     cu = card["catchup"]
@@ -103,7 +133,9 @@ def main() -> None:
 
     print(json.dumps({
         "scenario_smoke": "ok", "seed": SEED,
-        "scenarios": ["partition_kills", "byzantine", "cold_catchup"],
+        "scenarios": ["partition_kills", "byzantine",
+                      "chaos+spec2", "partition_kills+spec2",
+                      "cold_catchup"],
         "deterministic": True,
     }), flush=True)
 

@@ -72,6 +72,18 @@ if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/scenariosmoke.py; then
   exit 2
 fi
 
+echo "== follower read-plane smoke gate (leader+follower over TCP, identity + serving) =="
+# boots a solo leader validator and a cold follower over a real TCP
+# peer link, floods the leader, and asserts: follower ledger hashes
+# byte-identical to the leader's at every validated seq, read RPCs
+# served from the follower's HTTP door mid-flood with the validated-seq
+# cache hitting, subscription events in order through the sharded
+# fanout, and zero consensus rounds on the follower
+if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/followersmoke.py; then
+  echo "FOLLOWER SMOKE FAILED — read-plane tier is broken" >&2
+  exit 2
+fi
+
 echo "== overload-admission smoke gate (4x flood -> bounded closes, fee-order drain) =="
 # boots a node with a pinned small admission cap, floods it at 4x that
 # capacity through the full async pipeline, and asserts the RPC door
