@@ -1831,6 +1831,81 @@ def bench_scenario_matrix(backends):
         _emit(line)
 
 
+def bench_overlay_fanin(backends):
+    """Overlay fan-in leg (ISSUE 11): the flood_survival scenario at
+    100 vs 1000 simnet nodes — 5-validator core, relay-peer tier,
+    squelched validator-message relay, enforced resource pricing, one
+    byzantine flooder hammering its neighbor set. One JSON line per
+    size recording:
+
+      - relay sends per validator per round (the squelched gossip
+        cost; with squelch=8 the per-node fan-out bound is 13 at BOTH
+        sizes — peer-count-independent, which is the whole point);
+      - drop latency: virtual ms of flooding before the first honest
+        node walked the flooder's balance to DROP and refused it;
+      - convergence + commit completeness under fire, and the close
+        cadence vs the same seed with no flooder.
+
+    Wall-clock is incidental (discrete-time simnet); the VALUE is the
+    bounded fan-out and the enforcement latency. Deterministic per
+    seed."""
+    from stellard_tpu.testkit.scenario import run_simnet
+    from stellard_tpu.testkit.scenarios import scenario_flood_survival
+
+    seed = int(os.environ.get("BENCH_FANIN_SEED", "7"))
+    steps = 44
+    for total in (100, 1000):
+        scn = scenario_flood_survival(
+            seed=seed, n_peers=total - 5, steps=steps
+        )
+        t0 = time.perf_counter()
+        card = run_simnet(scn)
+        wall_s = time.perf_counter() - t0
+        base = run_simnet(scenario_flood_survival(
+            seed=seed, n_peers=total - 5, steps=steps, flooder=False,
+        ))
+        relay = card.get("relay", {})
+        rounds = max(1, card["final_seq"])
+        relay_events = (
+            relay.get("relay_proposal", 0) + relay.get("relay_validation", 0)
+        )
+        per_validator_round = relay_events / (scn.n_validators * rounds)
+        fl = next(iter(card["flooders"].values()))
+        ok = (
+            card["converged"] and card["single_hash"]
+            and card["committed"] >= card["submitted"]
+            and relay.get("relay_fanout_max", 0)
+            <= scn.squelch_size + scn.n_validators
+            and fl["refused_by"] >= scn.flooders[0]["fan"]
+            and card["final_seq"] >= 0.75 * base["final_seq"]
+        )
+        _emit({
+            "metric": f"overlay_fanin_{total}",
+            "value": round(per_validator_round, 1),
+            "unit": "relay_events/validator/round",
+            "vs_baseline": 1.0 if ok else 0.0,
+            "seed": seed,
+            "nodes": total,
+            "wall_s": round(wall_s, 2),
+            "relay_fanout_max": relay.get("relay_fanout_max", 0),
+            "squelch_bound": scn.squelch_size + scn.n_validators,
+            "drop_latency_ms": fl.get("first_refusal_ms"),
+            "flooder_refused_by": fl["refused_by"],
+            "resource": {
+                k: card["resource"][k] for k in (
+                    "charged", "warned", "dropped", "refused", "throttled",
+                )
+            },
+            "final_seq": card["final_seq"],
+            "baseline_seq": base["final_seq"],
+            "converged_single_hash": bool(
+                card["converged"] and card["single_hash"]
+            ),
+            "committed": card["committed"],
+            "submitted": card["submitted"],
+        })
+
+
 def bench_follower_fanout(backends):
     """Follower read-plane leg (ISSUE 10 / ROADMAP item 3): a LEADER
     validator (separate process, quorum=1, flooded over its HTTP door)
@@ -2218,6 +2293,7 @@ def main() -> None:
             bench_consensus_close,
             bench_replay,
             bench_scenario_matrix,
+            bench_overlay_fanin,
             bench_follower_fanout,
         ):
             try:

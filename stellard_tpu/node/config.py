@@ -236,10 +236,23 @@ class Config:
     websocket_ssl_cert: str = ""  # [websocket_ssl_cert]
     websocket_ssl_key: str = ""  # [websocket_ssl_key]
 
-    # -- overlay ([peer_ip]/[peer_port]/[ips]) -----------------------------
+    # -- overlay ([peer_ip]/[peer_port]/[ips]/[overlay]) -------------------
     peer_ip: str = "127.0.0.1"
     peer_port: int = 0  # 0 = disabled
     ips: list[str] = field(default_factory=list)  # bootstrap peers host:port
+    # [overlay] defense plane (doc/overlay.md): squelch= is the relay
+    # subset size per validator (0 = full flood, the kill-switch that
+    # reproduces pre-squelch behavior byte-for-byte);
+    # squelch_rotate= ledgers per subset rotation epoch; sendq_cap=
+    # bounds each peer's outbound queue (drop-oldest on overflow, 0 =
+    # built-in default) and sendq_evict_drops= is the consecutive-drop
+    # threshold that evicts a wedged peer; rpc_resource= prices RPC
+    # clients with the peer charge schedule (admin IPs exempt)
+    overlay_squelch: int = 8
+    overlay_squelch_rotate: int = 16
+    overlay_sendq_cap: int = 0
+    overlay_sendq_evict_drops: int = 0
+    overlay_rpc_resource: bool = True
     # [peer_ssl]: "" = plaintext, "allow" = TLS out + autodetect in,
     # "require" = TLS only (plaintext peers refused). Reference peers are
     # always SSL (PeerImp.h:88-90); "allow" exists for mixed-net upgrades.
@@ -437,6 +450,19 @@ class Config:
         if one("peer_port"):
             cfg.peer_port = int(one("peer_port"))
         cfg.ips = list(s.get("ips", []))
+        ov = _kv(s.get("overlay", []))
+        for key, attr in (
+            ("squelch", "overlay_squelch"),
+            ("squelch_rotate", "overlay_squelch_rotate"),
+            ("sendq_cap", "overlay_sendq_cap"),
+            ("sendq_evict_drops", "overlay_sendq_evict_drops"),
+        ):
+            if key in ov:
+                setattr(cfg, attr, int(ov[key]))
+        if "rpc_resource" in ov:
+            cfg.overlay_rpc_resource = ov["rpc_resource"].lower() not in (
+                "0", "false", "no", "off"
+            )
         if one("peer_ssl"):
             cfg.peer_ssl = one("peer_ssl").lower()
             if cfg.peer_ssl not in ("", "allow", "require"):

@@ -69,11 +69,20 @@ class HashRouter:
     def add_suppression_peer(self, h: bytes, peer: int) -> bool:
         """Record that `peer` sent `h`; True if this hash is NEW
         (i.e. should be processed, not a duplicate)."""
+        return self.note_peer(h, peer)[0]
+
+    def note_peer(self, h: bytes, peer: int) -> tuple[bool, bool]:
+        """Suppression with re-send attribution: (is_new, same_peer_dup).
+        ``same_peer_dup`` is True when THIS peer already sent this hash —
+        an honest relay mesh delivers each hash once per neighbor, so a
+        same-peer re-send is the flooder signature the resource plane
+        charges (cross-peer duplicates stay free)."""
         with self._lock:
             known = h in self._map
             e = self._get(h)
+            resend = peer in e.peers
             e.peers.add(peer)
-            return not known
+            return not known, known and resend
 
     def get_flags(self, h: bytes) -> int:
         with self._lock:

@@ -481,6 +481,7 @@ class SegmentCatchup:
         seed: int = 0,
         note_byzantine: Optional[Callable] = None,
         on_complete: Optional[Callable[[], None]] = None,
+        on_condemn: Optional[Callable] = None,
     ):
         import random
         import threading
@@ -504,6 +505,11 @@ class SegmentCatchup:
         self.rng = random.Random(0xCA7C ^ seed)
         self.note_byzantine = note_byzantine
         self.on_complete = on_complete
+        # unified peer scoring seam: a condemned peer also takes a
+        # resource charge on its overlay endpoint (the owner wires
+        # this to TcpOverlay.charge_peer with FEE_GARBAGE_SEGMENT), so
+        # relay, catch-up, and admission privilege degrade together
+        self.on_condemn = on_condemn
         self.active = False
         self.state = "idle"  # idle | manifest | fetch | done | fallback
         self._finished_at: Optional[float] = None  # for can_start rearm
@@ -722,6 +728,11 @@ class SegmentCatchup:
         if self.note_byzantine is not None:
             self.note_byzantine("garbage_segment", peer=None,
                                 seg=self._cur_seg, why=why)
+        if self.on_condemn is not None:
+            try:
+                self.on_condemn(peer)
+            except Exception:  # noqa: BLE001 — the charge is bookkeeping;
+                pass           # session fallback below must still run
         self._bad_peers.add(peer)
         self._peer = None
         if not self._eligible_peers():

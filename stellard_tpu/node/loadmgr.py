@@ -47,6 +47,12 @@ class LoadFeeTrack:
         # all see the admission price, but EXCLUDED from network_floor
         # (it is local open-ledger state other nodes do not share)
         self._queue = NORMAL_FEE
+        # overlay abuse-pressure component: the resource plane's
+        # aggregate peer pressure mapped onto the fee scale
+        # (set_network_pressure). Included in network_floor — it is
+        # genuine local load, exactly like the job-queue component —
+        # so relay gating and payFee both see it
+        self._overlay = NORMAL_FEE
         # source -> (fee, report_time, expiry): per-reporter so one
         # healthy cluster member cannot overwrite another's elevated
         # report (reference keeps per-node ClusterNodeStatus entries,
@@ -163,17 +169,37 @@ class LoadFeeTrack:
         with self._lock:
             return self._queue
 
+    def set_network_pressure(self, fee: int) -> None:
+        """Abuse-pressure feedback from the overlay's resource plane:
+        the aggregate peer charge pressure expressed on the 1/256 fee
+        scale (NORMAL_FEE = no abuse). Rises while the peer set as a
+        whole is paying charges, decays back with the balances."""
+        fee = max(NORMAL_FEE, min(MAX_FEE, int(fee)))
+        with self._lock:
+            changed = fee != self._overlay
+            self._overlay = fee
+        if changed:
+            self._fire_change()
+
+    @property
+    def overlay_fee(self) -> int:
+        with self._lock:
+            return self._overlay
+
     @property
     def network_floor(self) -> int:
-        """The fee floor peers would apply (local + remote load only —
-        never our queue escalation): the relay gate for queued txs."""
+        """The fee floor peers would apply (local + remote + overlay
+        abuse pressure — never our queue escalation): the relay gate
+        for queued txs."""
         with self._lock:
-            return max(self._local, self._live_remote())
+            return max(self._local, self._live_remote(), self._overlay)
 
     @property
     def load_factor(self) -> int:
         with self._lock:
-            return max(self._local, self._live_remote(), self._queue)
+            return max(
+                self._local, self._live_remote(), self._queue, self._overlay
+            )
 
     @property
     def is_loaded(self) -> bool:
@@ -183,11 +209,14 @@ class LoadFeeTrack:
         with self._lock:
             remote = self._live_remote()
             return {
-                "load_factor": max(self._local, remote, self._queue),
+                "load_factor": max(
+                    self._local, remote, self._queue, self._overlay
+                ),
                 "load_base": NORMAL_FEE,
                 "local_fee": self._local,
                 "remote_fee": remote,
                 "queue_fee": self._queue,
+                "overlay_fee": self._overlay,
             }
 
 

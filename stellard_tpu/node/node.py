@@ -480,6 +480,12 @@ class Node:
                 cluster={
                     decode_node_public(v) for v in cfg.cluster_nodes
                 } or None,
+                # [overlay] defense plane: squelch subset size/rotation
+                # + the per-peer sendq discipline (doc/overlay.md)
+                squelch_size=cfg.overlay_squelch,
+                squelch_rotate=cfg.overlay_squelch_rotate,
+                sendq_cap=cfg.overlay_sendq_cap,
+                sendq_evict_drops=cfg.overlay_sendq_evict_drops,
             )
 
             # catch-up acquisitions resolve nodes from OUR NodeStore
@@ -503,6 +509,8 @@ class Node:
                 from ..nodestore.core import NodeObjectType
                 from .inbound import SegmentCatchup
 
+                from ..overlay.resource import FEE_GARBAGE_SEGMENT
+
                 vn = self.overlay.node
                 vn.segment_source = backend
                 vn.segment_catchup = SegmentCatchup(
@@ -513,6 +521,15 @@ class Node:
                     ),
                     clock=self.overlay._clock,
                     note_byzantine=vn.note_byzantine,
+                    # unified peer scoring: a peer condemned for a
+                    # garbage segment transfer takes a FEE_BAD_DATA-
+                    # class charge on its overlay endpoint, so the same
+                    # balance that gates relay/admission sees the
+                    # catch-up offense too (segment_peers() already
+                    # excludes WARN-or-worse endpoints)
+                    on_condemn=lambda pub: self.overlay.charge_peer(
+                        pub, FEE_GARBAGE_SEGMENT
+                    ),
                 )
 
             # persistence rides the close pipeline's dedicated ORDERED
@@ -629,6 +646,18 @@ class Node:
         # closes, status, staleness checks); the SNTP heartbeat COMPOSES
         # its measured correction with this base (see _heartbeat)
         self.ops.net_time_offset = int(cfg.network_time_offset)
+
+        # RPC-door resource pricing ([overlay] rpc_resource=1): one
+        # decaying charge balance per CLIENT IP, priced with the peer
+        # fee schedule's FEE_*_RPC charges (overlay/resource.py) —
+        # abusive RPC clients warn/drop exactly like abusive peers.
+        # [rpc_admin_allow] IPs are exempt (the reference never charges
+        # admin requests), swept on the maintenance timer below.
+        self.rpc_resources = None
+        if cfg.overlay_rpc_resource:
+            from ..overlay.resource import ResourceManager as _RM
+
+            self.rpc_resources = _RM(admin=set(cfg.admin_ips))
 
         # read plane (rpc/readplane.py): the serving side's immutable
         # validated-snapshot pointer + validated-seq result cache. Read
@@ -963,6 +992,16 @@ class Node:
                     "sweep",
                     self.ledger_master.ledgers_by_hash.sweep,
                 )
+                # RPC-client charge-table expiry on the same maintenance
+                # timer (reference: Logic::periodicActivity rides the
+                # sweep timer) — idle client endpoints age out so a
+                # long-lived node's map stays bounded. The PEER table's
+                # sweep already rides the overlay's own gossip timer.
+                if self.rpc_resources is not None:
+                    self.job_queue.add_job(
+                        JobType.jtSWEEP, "rpcResourceSweep",
+                        self.rpc_resources.sweep,
+                    )
                 # disk-space guard (reference: doSweep fatals under 512MB
                 # free, Application.cpp:1098-1106): stopping cleanly now
                 # beats corrupting the stores on a full disk later

@@ -32,6 +32,7 @@ __all__ = [
     "FEE_INVALID_SIGNATURE",
     "FEE_UNWANTED_DATA",
     "FEE_BAD_DATA",
+    "FEE_GARBAGE_SEGMENT",
     "FEE_INVALID_RPC",
     "FEE_REFERENCE_RPC",
     "FEE_EXCEPTION_RPC",
@@ -73,6 +74,12 @@ FEE_HIGH_BURDEN_RPC = Charge(300, "heavy RPC")
 FEE_PATH_FIND_UPDATE = Charge(100, "path update")
 FEE_NEW_VALID_TX = Charge(10, "valid tx")
 FEE_SATISFIED_REQUEST = Charge(10, "needed data")
+# FEE_BAD_DATA-class condemnation for a peer that served a garbage
+# segment transfer (SegmentCatchup's per-peer fallback): one condemned
+# transfer lands the endpoint PAST the warning line — relay/catch-up
+# demotion — and a second pushes it over the DROP line, so the catch-up
+# scorer and the overlay's drop gate act on ONE unified balance
+FEE_GARBAGE_SEGMENT = Charge(800, "garbage segment transfer")
 
 WARNING_THRESHOLD = 500
 DROP_THRESHOLD = 1500
@@ -121,8 +128,12 @@ class ResourceManager:
         self._entries: dict[str, _Entry] = {}
         self._lock = threading.Lock()
         self.admin = admin or set()
-        self.dropped = 0
+        self.dropped = 0      # charges that crossed the DROP line
         self.charged = 0
+        self.warned = 0       # charges that crossed the WARN line
+        self.refused = 0      # admissions refused (note_refused)
+        self.throttled = 0    # inbound messages shed at WARN (note_throttled)
+        self.disconnects = 0  # sessions torn down on DROP (note_disconnect)
 
     def key(self, addr: tuple) -> str:
         return self._key_fn(addr)
@@ -144,8 +155,11 @@ class ResourceManager:
                 self.dropped += 1
                 return Disposition.DROP
             if bal >= WARNING_THRESHOLD:
-                e.warned = True
+                if not e.warned:  # count CROSSINGS, not charges-at-WARN
+                    e.warned = True
+                    self.warned += 1
                 return Disposition.WARN
+            e.warned = False  # decayed under the line: re-arm the crossing
             return Disposition.OK
 
     def balance(self, addr: tuple) -> float:
@@ -153,10 +167,54 @@ class ResourceManager:
             e = self._entries.get(self.key(addr))
             return e.decayed(self._clock()) if e else 0.0
 
+    def status(self, addr: tuple) -> str:
+        """Current Disposition from the decayed balance, charging nothing."""
+        if self.key(addr) in self.admin:
+            return Disposition.OK
+        bal = self.balance(addr)
+        if bal >= DROP_THRESHOLD:
+            return Disposition.DROP
+        if bal >= WARNING_THRESHOLD:
+            return Disposition.WARN
+        return Disposition.OK
+
+    def is_throttled(self, addr: tuple) -> bool:
+        """WARN-or-worse: the overlay sheds this endpoint's non-essential
+        inbound (tx gossip, endpoint gossip, bulk serving) until the
+        balance decays back under the warning line."""
+        return (
+            self.key(addr) not in self.admin
+            and self.balance(addr) >= WARNING_THRESHOLD
+        )
+
     def should_admit(self, addr: tuple) -> bool:
         """Admission gate for new inbound connections: a dropped endpoint
         stays rejected until its balance decays under the drop line."""
-        return self.balance(addr) < DROP_THRESHOLD
+        return (
+            self.key(addr) in self.admin
+            or self.balance(addr) < DROP_THRESHOLD
+        )
+
+    def note_refused(self, addr: tuple) -> None:
+        self.refused += 1
+
+    def note_throttled(self, n: int = 1) -> None:
+        self.throttled += n
+
+    def note_disconnect(self) -> None:
+        self.disconnects += 1
+
+    def aggregate_pressure(self) -> float:
+        """Network-wide abuse pressure: the sum of all decayed balances
+        relative to the warning threshold. ~0 on a healthy net; >= 1.0
+        means the combined charge inflow equals one endpoint pinned at
+        WARN. The overlay maps this onto LoadFeeTrack so local fees rise
+        while the whole peer set misbehaves (reference: Logic::periodic
+        feeding the load fee from importers)."""
+        now = self._clock()
+        with self._lock:
+            total = sum(e.decayed(now) for e in self._entries.values())
+        return total / float(WARNING_THRESHOLD)
 
     def sweep(self) -> None:
         """Expire idle entries (reference secondsUntilExpiration)."""
@@ -173,10 +231,19 @@ class ResourceManager:
     def get_json(self) -> dict:
         now = self._clock()
         with self._lock:
+            # bound the reported table: at 1000-peer fan-in the full
+            # entry dict would dominate every get_counts payload
+            items = sorted(
+                ((k, e.decayed(now)) for k, e in self._entries.items()),
+                key=lambda kv: -kv[1],
+            )
             return {
-                "entries": {
-                    k: round(e.decayed(now), 1) for k, e in self._entries.items()
-                },
+                "entries": {k: round(bal, 1) for k, bal in items[:64]},
+                "entry_count": len(items),
                 "charged": self.charged,
+                "warned": self.warned,
                 "dropped": self.dropped,
+                "refused": self.refused,
+                "throttled": self.throttled,
+                "disconnects": self.disconnects,
             }

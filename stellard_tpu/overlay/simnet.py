@@ -25,6 +25,7 @@ from __future__ import annotations
 import heapq
 import itertools
 import random
+import struct
 from typing import Callable, Optional
 
 from ..consensus.consensus import ConsensusAdapter
@@ -34,6 +35,15 @@ from ..node.validator import ValidatorNode
 from ..protocol.keys import KeyPair
 from ..protocol.sttx import SerializedTransaction
 from ..state.ledger import Ledger
+from ..utils.hashes import sha512_half
+from .resource import (
+    FEE_BAD_DATA,
+    FEE_INVALID_REQUEST,
+    FEE_UNWANTED_DATA,
+    Disposition,
+    ResourceManager,
+)
+from .squelch import SQUELCH_ROTATE, SquelchPolicy
 from .wire import (
     FrameReader,
     GetLedger,
@@ -47,7 +57,7 @@ from .wire import (
     frame,
 )
 
-__all__ = ["SimNet", "SimValidator"]
+__all__ = ["SimNet", "SimValidator", "RelayPeer"]
 
 # network-epoch start time for simulations (seconds since 2000)
 SIM_START_NTIME = 10_000_000
@@ -73,6 +83,13 @@ class SimValidator(ConsensusAdapter):
         # one reader per SOURCE: a byzantine peer's garbage must desync
         # only its own stream, exactly like a per-session TCP socket
         self.readers: dict[int, FrameReader] = {}
+        # enforced resource pricing (set by the net when enabled): one
+        # decaying charge balance per SOURCE nid; DROP refuses further
+        # deliveries until the balance decays (disconnect + gated
+        # readmission, collapsed onto the simulated transport)
+        self.resources: Optional[ResourceManager] = None
+        # squelch policy (set by the net when squelch_size > 0)
+        self.squelch: Optional[SquelchPolicy] = None
         self.node = ValidatorNode(
             key=key,
             unl=unl,
@@ -88,7 +105,14 @@ class SimValidator(ConsensusAdapter):
     # -- ConsensusAdapter -------------------------------------------------
 
     def propose(self, proposal) -> None:
-        self.net.broadcast(self.nid, frame(ProposeSet.from_proposal(proposal)))
+        data = frame(ProposeSet.from_proposal(proposal))
+        if self.squelch is not None:
+            self.net.relay_validator(
+                self.nid, proposal.node_public or self.node.key.public,
+                data, self.squelch, kind="relay_proposal",
+            )
+        else:
+            self.net.broadcast(self.nid, data)
 
     def share_tx_set(self, txset: TxSet) -> None:
         blobs = [blob for _txid, blob in txset.blobs()]
@@ -98,7 +122,14 @@ class SimValidator(ConsensusAdapter):
         return self.node.txset_cache.get(set_hash)
 
     def send_validation(self, val: STValidation) -> None:
-        self.net.broadcast(self.nid, frame(ValidationMessage(val.serialize())))
+        data = frame(ValidationMessage(val.serialize()))
+        if self.squelch is not None:
+            self.net.relay_validator(
+                self.nid, val.signer or self.node.key.public, data,
+                self.squelch, kind="relay_validation",
+            )
+        else:
+            self.net.broadcast(self.nid, data)
 
     def relay_disputed_tx(self, blob: bytes) -> None:
         self.net.broadcast(self.nid, frame(TxMessage(blob)))
@@ -129,6 +160,14 @@ class SimValidator(ConsensusAdapter):
     # -- delivery ---------------------------------------------------------
 
     def deliver(self, src: int, data: bytes) -> None:
+        if self.resources is not None and not self.resources.should_admit(
+            (src,)
+        ):
+            # endpoint above the DROP line: the session analog is a
+            # disconnect + refused readmission until the balance decays
+            self.resources.note_refused((src,))
+            self.net.note_refusal(self.nid, src)
+            return
         reader = self.readers.setdefault(src, FrameReader())
         try:
             msgs = list(reader.feed(data))
@@ -138,7 +177,25 @@ class SimValidator(ConsensusAdapter):
             # offense, keep every other peer's framing intact
             self.readers[src] = FrameReader()
             self.node.note_byzantine("malformed_frame", peer_nid=src)
+            self._charge(src, FEE_INVALID_REQUEST)
             return
+        if self.resources is not None and msgs and self.resources.is_throttled(
+            (src,)
+        ):
+            # WARN throttling: shed the endpoint's tx gossip before any
+            # parse/verify work; consensus traffic still flows. Shed
+            # traffic still pays, so a sustained flood walks past WARN
+            # to DROP instead of parking at the throttle forever.
+            kept = [m for m in msgs if not isinstance(m, TxMessage)]
+            if len(kept) != len(msgs):
+                from .resource import Charge
+
+                n_shed = len(msgs) - len(kept)
+                self.resources.note_throttled(n_shed)
+                self._charge(src, Charge(
+                    FEE_UNWANTED_DATA.cost * n_shed, "throttled flood"
+                ))
+                msgs = kept
         # one delivery often carries several relayed txs: parse each
         # once and batch their signature verification through the plane
         # before dispatching. An unparseable tx drops only ITSELF —
@@ -149,7 +206,7 @@ class SimValidator(ConsensusAdapter):
                 try:
                     parsed[i] = SerializedTransaction.from_bytes(m.blob)
                 except Exception:  # noqa: BLE001 — malformed relay
-                    pass
+                    self._charge(src, FEE_BAD_DATA)
         if len(parsed) > 1:
             try:
                 self.node.prefetch_tx_sigs(list(parsed.values()))
@@ -162,14 +219,44 @@ class SimValidator(ConsensusAdapter):
             else:
                 self._dispatch(src, msg)
 
+    def _charge(self, src: int, fee) -> None:
+        if self.resources is None:
+            return
+        if self.resources.charge((src,), fee) == Disposition.DROP:
+            self.resources.note_disconnect()
+
     def _dispatch(self, src: int, msg) -> None:
         node = self.node
         # TxMessages are handled (parse-once + batched sig prefetch) in
         # deliver(), the only caller
         if isinstance(msg, ProposeSet):
-            node.handle_proposal(msg.to_proposal())
+            if self.squelch is not None:
+                data = frame(msg)
+                is_new, dup = node.router.note_peer(sha512_half(data), src)
+                if dup:
+                    self._charge(src, FEE_UNWANTED_DATA)
+                if is_new and node.handle_proposal(msg.to_proposal()):
+                    self.net.relay_validator(
+                        self.nid, msg.node_public, data, self.squelch,
+                        exclude=(src,), kind="relay_proposal",
+                    )
+            else:
+                node.handle_proposal(msg.to_proposal())
         elif isinstance(msg, ValidationMessage):
-            node.handle_validation(STValidation.from_bytes(msg.blob))
+            if self.squelch is not None:
+                data = frame(msg)
+                is_new, dup = node.router.note_peer(sha512_half(data), src)
+                if dup:
+                    self._charge(src, FEE_UNWANTED_DATA)
+                if is_new:
+                    val = STValidation.from_bytes(msg.blob)
+                    if node.handle_validation(val):
+                        self.net.relay_validator(
+                            self.nid, val.signer or b"", data, self.squelch,
+                            exclude=(src,), kind="relay_validation",
+                        )
+            else:
+                node.handle_validation(STValidation.from_bytes(msg.blob))
         elif isinstance(msg, TxSetData):
             from ..consensus.txset import MAX_TXSET_BLOBS
 
@@ -177,6 +264,7 @@ class SimValidator(ConsensusAdapter):
                 # oversized candidate set: refuse before parsing a single
                 # blob (a byzantine peer must not buy O(huge) parse work)
                 node.note_byzantine("oversized_txset", peer_nid=src)
+                self._charge(src, FEE_BAD_DATA)
                 return
             ts = TxSet(node.hash_batch)
             intact = True
@@ -191,6 +279,7 @@ class SimValidator(ConsensusAdapter):
                 node.handle_txset(ts)
             else:
                 node.note_byzantine("txset_mismatch", peer_nid=src)
+                self._charge(src, FEE_BAD_DATA)
         elif isinstance(msg, GetSegments):
             reply = node.serve_get_segments(msg)
             if reply is not None:
@@ -205,6 +294,96 @@ class SimValidator(ConsensusAdapter):
             node.handle_ledger_data(msg)
 
 
+class RelayPeer:
+    """A lightweight non-validator overlay node for production-fan-in
+    scenarios: it parses wire frames, dedups, enforces resource pricing
+    on its sources, and re-relays validator messages through squelch
+    subsets — WITHOUT running consensus or verifying signatures. This is
+    what makes 500-1000-node simnets tractable: the validator core stays
+    full ValidatorNodes, the fan-in tier costs a frame parse + k sends
+    per message. Client txs are NOT re-relayed (the injection path
+    already floods them to every node), so the relay tier's traffic is
+    exactly the squelched proposal/validation gossip the scenario
+    measures."""
+
+    SEEN_CAP = 8192
+
+    def __init__(self, net: "SimNet", nid: int):
+        self.net = net
+        self.nid = nid
+        self.readers: dict[int, FrameReader] = {}
+        # message hash -> set of sources that delivered it (bounded,
+        # insertion-ordered eviction) — the HashRouter role
+        self.seen: dict[bytes, set[int]] = {}
+        self.resources: Optional[ResourceManager] = None
+        self.squelch: Optional[SquelchPolicy] = None
+        self.malformed = 0
+
+    def _charge(self, src: int, fee) -> None:
+        if self.resources is not None:
+            self.resources.charge((src,), fee)
+
+    def _note_seen(self, h: bytes, src: int) -> tuple[bool, bool]:
+        sources = self.seen.get(h)
+        if sources is None:
+            if len(self.seen) >= self.SEEN_CAP:
+                self.seen.pop(next(iter(self.seen)))
+            self.seen[h] = {src}
+            return True, False
+        dup = src in sources
+        sources.add(src)
+        return False, dup
+
+    def deliver(self, src: int, data: bytes) -> None:
+        if self.resources is not None and not self.resources.should_admit(
+            (src,)
+        ):
+            self.resources.note_refused((src,))
+            self.net.note_refusal(self.nid, src)
+            return
+        reader = self.readers.setdefault(src, FrameReader())
+        try:
+            msgs = list(reader.feed(data))
+        except ValueError:
+            self.readers[src] = FrameReader()
+            self.malformed += 1
+            self._charge(src, FEE_INVALID_REQUEST)
+            return
+        throttled = (
+            self.resources is not None
+            and bool(msgs)
+            and self.resources.is_throttled((src,))
+        )
+        for msg in msgs:
+            if isinstance(msg, ProposeSet):
+                self._relay(src, msg, msg.node_public)
+            elif isinstance(msg, ValidationMessage):
+                try:
+                    signer = STValidation.from_bytes(msg.blob).signer or b""
+                except Exception:  # noqa: BLE001 — hostile blob
+                    self._charge(src, FEE_BAD_DATA)
+                    continue
+                self._relay(src, msg, signer)
+            elif isinstance(msg, TxMessage) and throttled:
+                self.resources.note_throttled()
+                self._charge(src, FEE_UNWANTED_DATA)  # shed traffic pays
+
+    def _relay(self, src: int, msg, signer: bytes) -> None:
+        data = frame(msg)
+        is_new, dup = self._note_seen(sha512_half(data), src)
+        if dup:
+            self._charge(src, FEE_UNWANTED_DATA)
+        if is_new and self.squelch is not None:
+            kind = (
+                "relay_proposal" if isinstance(msg, ProposeSet)
+                else "relay_validation"
+            )
+            self.net.relay_validator(
+                self.nid, signer, data, self.squelch,
+                exclude=(src,), kind=kind,
+            )
+
+
 class SimNet:
     def __init__(
         self,
@@ -216,6 +395,10 @@ class SimNet:
         genesis_account: Optional[bytes] = None,
         voting_factory=None,
         seed: int = 0,
+        n_peers: int = 0,
+        squelch_size: int = 0,
+        squelch_rotate: int = SQUELCH_ROTATE,
+        resources: bool = False,
     ):
         self.step_ms = step_ms
         self.latency_ms = latency_steps * step_ms
@@ -236,6 +419,11 @@ class SimNet:
             "sent": 0, "dropped_link": 0, "dropped_fault": 0,
             "dropped_down": 0, "duplicated": 0, "delayed": 0,
         }
+        # src nid -> set of dsts that refused its deliveries (DROP gate)
+        self.refusals: dict[int, set[int]] = {}
+        # src nid -> virtual ms of the FIRST refusal (drop latency: how
+        # long a flooder ran before the first honest node shut the door)
+        self.first_refusal_ms: dict[int, int] = {}
         self.accept_log: list[tuple[int, int, bytes]] = []  # (nid, seq, hash)
 
         self.keys = [
@@ -243,6 +431,8 @@ class SimNet:
             for i in range(n_validators)
         ]
         unl = {k.public for k in self.keys}
+        self.unl = unl
+        self.idle_interval = idle_interval
         q = quorum if quorum is not None else (n_validators * 3 + 3) // 4
         self.validators = [
             SimValidator(
@@ -256,6 +446,34 @@ class SimNet:
             )
             for i in range(n_validators)
         ]
+        # production fan-in shape: a small trusted validator core plus a
+        # relay-peer tier (nids n_validators..n_validators+n_peers-1)
+        self.peers = [
+            RelayPeer(self, n_validators + j) for j in range(n_peers)
+        ]
+        self.nodes: list = list(self.validators) + list(self.peers)
+        # validator-message squelching (0 = full flood, byte-for-byte
+        # today's behavior — the [overlay] squelch=0 kill-switch)
+        self.squelch_size = squelch_size
+        self.squelch_rotate = squelch_rotate
+        self.resources_enabled = resources
+        if squelch_size > 0 or resources:
+            # fan-out / defense evidence (only materialized when the
+            # defense plane is on, so legacy scorecards stay identical)
+            self.net_stats.update({
+                "relay_proposal": 0, "relay_validation": 0,
+                "relay_fanout_max": 0, "refused": 0,
+            })
+        for node in self.nodes:
+            if squelch_size > 0:
+                node.squelch = SquelchPolicy(
+                    size=squelch_size, rotate=squelch_rotate,
+                    relayer_id=struct.pack(">I", node.nid),
+                )
+            if resources:
+                node.resources = ResourceManager(
+                    key_fn=lambda a: a[0], clock=self.clock,
+                )
         self.genesis_account = genesis_account
 
     # -- clocks -----------------------------------------------------------
@@ -324,9 +542,68 @@ class SimNet:
     # -- transport --------------------------------------------------------
 
     def broadcast(self, src: int, data: bytes) -> None:
-        for dst in range(len(self.validators)):
+        for dst in range(len(self.nodes)):
             if dst != src:
                 self.send(src, dst, data)
+
+    def sim_seq(self) -> int:
+        """Approximate ledger cadence for the squelch epoch clock: the
+        deterministic virtual-time analog of 'rotate every N ledgers'."""
+        return self.time_ms // max(1, self.step_ms * self.idle_interval)
+
+    def relay_validator(
+        self, src: int, signer: bytes, data: bytes, policy: SquelchPolicy,
+        exclude: tuple = (), kind: str = "relay_proposal",
+    ) -> None:
+        """Squelched fan-out of one validator message: the deterministic
+        rotating subset for (signer, epoch, relayer) plus the validator
+        core; untrusted signers demoted. Fan-out evidence rides
+        net_stats so scenarios can assert the bound. The subset ranks
+        over ALL other nodes and the message's source is filtered from
+        the RESULT (excluding it from the ranking input would alias the
+        policy memo across sources — same candidate count, different
+        members — echoing relays back to their sender for an epoch)."""
+        n_val = len(self.validators)
+        cands = [i for i in range(len(self.nodes)) if i != src]
+        subset = policy.subset(
+            signer, self.sim_seq(), cands,
+            key_fn=lambda i: struct.pack(">I", i),
+            trusted=lambda i: i < n_val,
+            demoted=bool(signer) and signer not in self.unl,
+        )
+        targets = [dst for dst in subset if dst not in exclude]
+        for dst in targets:
+            self.send(src, dst, data)
+        self.net_stats[kind] += 1
+        if len(targets) > self.net_stats["relay_fanout_max"]:
+            self.net_stats["relay_fanout_max"] = len(targets)
+
+    def note_refusal(self, dst: int, src: int) -> None:
+        self.net_stats["refused"] = self.net_stats.get("refused", 0) + 1
+        self.refusals.setdefault(src, set()).add(dst)
+        self.first_refusal_ms.setdefault(src, self.time_ms)
+
+    def resource_json(self) -> dict:
+        """`resource.*` evidence aggregated over every enforcing node —
+        the counter block flood scenarios assert on (charges paid, WARN
+        crossings, DROP crossings, shed messages, refused deliveries)."""
+        agg = {
+            "charged": 0, "warned": 0, "dropped": 0,
+            "refused": 0, "throttled": 0,
+        }
+        for node in self.nodes:
+            rm = node.resources
+            if rm is None:
+                continue
+            agg["charged"] += rm.charged
+            agg["warned"] += rm.warned
+            agg["dropped"] += rm.dropped
+            agg["refused"] += rm.refused
+            agg["throttled"] += rm.throttled
+        agg["refusing_nodes"] = {
+            src: len(dsts) for src, dsts in sorted(self.refusals.items())
+        }
+        return agg
 
     def send(self, src: int, dst: int, data: bytes) -> None:
         if src in self._down or dst in self._down:
@@ -384,7 +661,7 @@ class SimNet:
                     # arrive (they left its kernel before the crash)
                     self.net_stats["dropped_down"] += 1
                     continue
-                self.validators[dst].deliver(src, data)
+                self.nodes[dst].deliver(src, data)
             for v in self.validators:
                 if v.nid not in self._down:
                     v.node.on_timer()

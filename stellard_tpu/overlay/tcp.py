@@ -41,9 +41,11 @@ from .resource import (
     FEE_BAD_DATA,
     FEE_INVALID_REQUEST,
     FEE_INVALID_SIGNATURE,
+    FEE_REQUEST_NO_REPLY,
     FEE_UNWANTED_DATA,
     ResourceManager,
 )
+from .squelch import SQUELCH_ROTATE, SQUELCH_SIZE, SquelchPolicy
 from .wire import (
     ClusterStatus,
     ClusterUpdate,
@@ -77,6 +79,16 @@ class _Peer:
     # instead of blocking the caller (consensus timer / relay threads
     # must NEVER wait on a socket — reference: PeerImp's async writes)
     SENDQ_DEPTH = 256
+    # graceful degradation (the infosub sendq discipline applied to the
+    # overlay): overflow drops the OLDEST queued frame — a slow reader
+    # sees a gap its acquisition machinery repairs, never a stale
+    # stream — and this many CONSECUTIVE overflow events evicts the
+    # peer outright (it is wedged, not slow)
+    EVICT_DROPS = 64
+    # writer coalescing: drain up to this many queued bytes into ONE
+    # sendall — a relay burst of small frames becomes one size-bounded
+    # batch write instead of a syscall per frame
+    WRITE_COALESCE = 256 * 1024
 
     # never-recycled session ids for HashRouter suppression sets (id()
     # can be reused by a later peer object within the router's 300s hold,
@@ -84,9 +96,15 @@ class _Peer:
     _NEXT_UID = itertools.count(1)
 
     def __init__(self, sock: socket.socket, inbound: bool,
-                 addr: Optional[tuple[str, int]] = None):
+                 addr: Optional[tuple[str, int]] = None,
+                 sendq_depth: Optional[int] = None,
+                 evict_drops: Optional[int] = None):
         import queue
 
+        if sendq_depth:
+            self.SENDQ_DEPTH = int(sendq_depth)  # instance override
+        if evict_drops:
+            self.EVICT_DROPS = int(evict_drops)
         self.uid = next(_Peer._NEXT_UID)
         # serializes SSL_read/SSL_write on a TLS socket: one OpenSSL SSL*
         # must not run concurrent operations from two threads (the writer
@@ -114,6 +132,11 @@ class _Peer:
         self.sendq: "queue.Queue[Optional[bytes]]" = queue.Queue(
             maxsize=self.SENDQ_DEPTH
         )
+        # sendq shedding evidence (aggregated into the overlay's
+        # `resource`/`squelch` observability blocks)
+        self.sendq_dropped = 0
+        self._consec_drops = 0
+        self.evicted = False
         self._writer: Optional[threading.Thread] = None
         self.alive = True
         self.established_at = 0.0
@@ -129,8 +152,11 @@ class _Peer:
 
     def send(self, data: bytes) -> None:
         """Non-blocking enqueue; the per-peer writer thread drains. A
-        full queue means a slow/stalled reader — drop the peer rather
-        than wedge the sender (the master lock may be held here)."""
+        full queue sheds the OLDEST queued frame (never the sender's
+        thread — the master lock may be held here); EVICT_DROPS
+        consecutive overflows means the reader is wedged, not slow, and
+        the peer is evicted so one dead peer can never hold a sendq's
+        worth of every relay wave forever."""
         import queue
 
         if not self.alive:
@@ -146,22 +172,60 @@ class _Peer:
         try:
             self.sendq.put_nowait(data)
         except queue.Full:
-            self.close()
+            self.sendq_dropped += 1
+            self._consec_drops += 1
+            if self._consec_drops >= self.EVICT_DROPS:
+                self.evicted = True
+                self.close()
+                return
+            try:
+                self.sendq.get_nowait()  # drop-OLDEST
+            except queue.Empty:
+                pass
+            try:
+                self.sendq.put_nowait(data)
+            except queue.Full:
+                pass  # racing senders refilled it: this frame sheds
+        else:
+            self._consec_drops = 0
 
     def _write_loop(self) -> None:
+        import queue
+
         while True:
             data = self.sendq.get()
             if data is None or not self.alive:
                 return
-            try:
-                if self.is_tls:
-                    with self.io_lock:
-                        self.sock.sendall(data)
-                else:
-                    self.sock.sendall(data)  # SO_SNDTIMEO bounds each write
-            except OSError:
-                self.alive = False
+            # coalesce a backlog burst into one bounded write: frames
+            # are self-delimiting, so concatenation is free batching
+            if len(data) < self.WRITE_COALESCE:
+                chunks = [data]
+                size = len(data)
+                while size < self.WRITE_COALESCE:
+                    try:
+                        nxt = self.sendq.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is None:  # close sentinel: flush then exit
+                        self._flush(b"".join(chunks))
+                        return
+                    chunks.append(nxt)
+                    size += len(nxt)
+                data = b"".join(chunks) if len(chunks) > 1 else data
+            if not self._flush(data):
                 return
+
+    def _flush(self, data: bytes) -> bool:
+        try:
+            if self.is_tls:
+                with self.io_lock:
+                    self.sock.sendall(data)
+            else:
+                self.sock.sendall(data)  # SO_SNDTIMEO bounds each write
+            return True
+        except OSError:
+            self.alive = False
+            return False
 
     def recv_locked(self, bufsize: int = 65536) -> Optional[bytes]:
         """One recv honoring the TLS serialization rule. Returns None on
@@ -229,6 +293,10 @@ class TcpOverlay(ConsensusAdapter):
         job_dispatch: Optional[Callable[[str, Callable], None]] = None,
         peer_tls=None,
         follower: bool = False,
+        squelch_size: int = SQUELCH_SIZE,
+        squelch_rotate: int = SQUELCH_ROTATE,
+        sendq_cap: int = 0,
+        sendq_evict_drops: int = 0,
     ):
         self.key = key
         self.port = port
@@ -269,6 +337,26 @@ class TcpOverlay(ConsensusAdapter):
             bootcache_path=bootcache_path,
         )
         self.resources = ResourceManager(key_fn=resource_key_fn)
+        # validator-message squelching ([overlay] squelch=): every relay
+        # (and origin send) of a proposal/validation goes to the
+        # deterministic rotating subset for its SIGNER instead of the
+        # whole peer set; squelch_size=0 is the full-flood kill-switch
+        self.squelch = SquelchPolicy(
+            size=squelch_size, rotate=squelch_rotate,
+            relayer_id=key.public,
+        )
+        self.sendq_cap = int(sendq_cap)
+        self.sendq_evict_drops = int(sendq_evict_drops)
+        # overlay defense evidence (`resource.*`/`squelch.*` naming,
+        # doc/observability.md): relay fan-outs, throttled/dup sheds,
+        # sendq drops/evictions — the counters scenario gates assert on
+        from ..node.metrics import AtomicCounters
+
+        self.overlay_stats = AtomicCounters(
+            "relay_proposal", "relay_validation", "relay_fanout_max",
+            "throttled_msgs", "dup_charges", "sendq_dropped",
+            "sendq_evicted", "squelch_demoted",
+        )
         self.unl_store = unl_store  # node.unl.UniqueNodeList or None
         # same-operator cluster (reference mtCLUSTER): members share their
         # load fee so the whole cluster escalates together
@@ -437,12 +525,15 @@ class TcpOverlay(ConsensusAdapter):
         (reference: PeerImp::onHandshake/recvHello). Outbound TLS wrapping
         happens in _dial (where a failed handshake can fall back to a
         plaintext redial); inbound autodetects here."""
-        peer = _Peer(sock, inbound, addr)
+        peer = _Peer(sock, inbound, addr,
+                     sendq_depth=self.sendq_cap,
+                     evict_drops=self.sendq_evict_drops)
         peer.is_tls = tls
         try:
             if inbound and not self.resources.should_admit(peer.remote):
                 # endpoint balance still above the drop line: refuse
                 # reconnects until it decays (reference Logic::newInboundEndpoint)
+                self.resources.note_refused(peer.remote)
                 peer.close()
                 return
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
@@ -598,6 +689,7 @@ class TcpOverlay(ConsensusAdapter):
                     peer.established_at = now
                     peer.established_mono = time.monotonic()
                     self.peers[peer.node_public] = peer
+                    self.squelch.bump()  # peer churn re-ranks subsets
                 exclude = set(self._self_addrs)
             if refused:
                 # inbound slots exhausted: REDIRECT the connector to
@@ -648,8 +740,14 @@ class TcpOverlay(ConsensusAdapter):
             with self._peers_lock:
                 if self.peers.get(peer.node_public) is peer:
                     del self.peers[peer.node_public]
+                    self.squelch.bump()
                 if peer.addr is not None:
                     self._dialing.discard(peer.addr)
+            if peer.sendq_dropped or peer.evicted:
+                self.overlay_stats.add_many(
+                    sendq_dropped=peer.sendq_dropped,
+                    sendq_evicted=1 if peer.evicted else 0,
+                )
             peer.close()
             # a dial whose session never established (refused handshake,
             # slot redirect) or died within seconds must BACK OFF instead
@@ -734,6 +832,33 @@ class TcpOverlay(ConsensusAdapter):
                 return
             peer.last_recv = time.monotonic()
             msgs = list(peer.reader.feed(data))
+            # WARN throttling (enforced resource pricing): while this
+            # endpoint's balance sits above the warning line its
+            # NON-ESSENTIAL inbound is shed before any parse/verify work
+            # — tx gossip, endpoint gossip, and bulk-serving requests.
+            # Consensus messages (proposals/validations/acquisition
+            # replies) still flow: throttling a warned-but-honest peer
+            # must degrade its gossip, never the network's liveness.
+            if msgs and self.resources.is_throttled(peer.remote):
+                kept = [
+                    m for m in msgs
+                    if not isinstance(m, (TxMessage, Endpoints, GetSegments,
+                                          GetLedger))
+                ]
+                if len(kept) != len(msgs):
+                    n_shed = len(msgs) - len(kept)
+                    self.resources.note_throttled(n_shed)
+                    self.overlay_stats.add("throttled_msgs", n_shed)
+                    msgs = kept
+                    # shed traffic still pays (reference: discarded
+                    # data is charged feeUnwantedData): a flooder that
+                    # keeps sending through its WARN throttle walks on
+                    # to DROP instead of parking at WARN forever
+                    from .resource import Charge
+
+                    self._charge(peer, Charge(
+                        FEE_UNWANTED_DATA.cost * n_shed, "throttled flood"
+                    ))
             # a single read often carries a burst of relayed txs: parse
             # each ONCE and verify their signatures in one plane call
             # before dispatching (an unparseable tx stays None here and
@@ -768,8 +893,11 @@ class TcpOverlay(ConsensusAdapter):
 
     def _charge(self, peer: _Peer, fee) -> None:
         """Charge the peer's endpoint; disconnect on DROP (reference:
-        PeerImp.cpp:129-131 charge(feeInvalidSignature) → Logic drop)."""
+        PeerImp.cpp:129-131 charge(feeInvalidSignature) → Logic drop).
+        The dropped endpoint then stays refused at inbound admission
+        (should_admit in _session) until its balance decays."""
         if self.resources.charge(peer.remote, fee) == Disposition.DROP:
+            self.resources.note_disconnect()
             peer.close()
 
     def _charge_if_bad(self, peer: _Peer, suppression_id: bytes) -> None:
@@ -810,7 +938,10 @@ class TcpOverlay(ConsensusAdapter):
                 # otherwise (bare-overlay tests)
                 def do_proposal(prop=prop, pid=pid, peer=peer, msg=msg):
                     if node.handle_proposal(prop):
-                        self._relay(msg, except_peer=peer)
+                        self._relay_validator_msg(
+                            msg, prop.node_public, except_peer=peer,
+                            kind="relay_proposal",
+                        )
                     else:
                         self._charge_if_bad(peer, pid)
 
@@ -832,7 +963,10 @@ class TcpOverlay(ConsensusAdapter):
                             self.unl_store.on_validation(
                                 val.signer, val.ledger_seq
                             )
-                        self._relay(msg, except_peer=peer)
+                        self._relay_validator_msg(
+                            msg, val.signer or b"", except_peer=peer,
+                            kind="relay_validation",
+                        )
                     else:
                         self._charge_if_bad(peer, vid)
 
@@ -895,14 +1029,23 @@ class TcpOverlay(ConsensusAdapter):
             if ts is not None:
                 blobs = [blob for _t, blob in ts.blobs()]
                 peer.send(frame(TxSetData(msg.set_hash, blobs)))
+            else:
+                # unsatisfiable request: a tiny charge an honest prober
+                # never notices but a request-hammer accumulates
+                # (reference: charge(feeRequestNoReply))
+                self._charge(peer, FEE_REQUEST_NO_REPLY)
         elif isinstance(msg, GetLedger):
             reply = node.serve_get_ledger(msg)
             if reply is not None:
                 peer.send(frame(reply))
+            else:
+                self._charge(peer, FEE_REQUEST_NO_REPLY)
         elif isinstance(msg, GetSegments):
             reply = node.serve_get_segments(msg)
             if reply is not None:
                 peer.send(frame(reply))
+            else:
+                self._charge(peer, FEE_REQUEST_NO_REPLY)
         elif isinstance(msg, SegmentData):
             node.handle_segment_data(peer.node_public, msg)
         elif isinstance(msg, LedgerData):
@@ -918,8 +1061,16 @@ class TcpOverlay(ConsensusAdapter):
             peer.send(frame(Ping(True, msg.seq)))
 
     def _first_seen(self, h: bytes, peer: _Peer) -> bool:
-        """HashRouter relay suppression (reference: addSuppressionPeer)."""
-        return self.node.router.add_suppression_peer(h, peer.uid)
+        """HashRouter relay suppression (reference: addSuppressionPeer)
+        with re-send pricing: an honest mesh delivers each hash at most
+        once per neighbor, so the SAME peer re-sending a suppressed hash
+        is the duplicate-flood signature and takes FEE_UNWANTED_DATA
+        (cross-peer duplicates — normal flood overlap — stay free)."""
+        is_new, same_peer_dup = self.node.router.note_peer(h, peer.uid)
+        if same_peer_dup:
+            self.overlay_stats.add("dup_charges")
+            self._charge(peer, FEE_UNWANTED_DATA)
+        return is_new
 
     def _schedule(self, kind: str, thunk: Callable) -> None:
         if self.job_dispatch is not None:
@@ -938,6 +1089,55 @@ class TcpOverlay(ConsensusAdapter):
 
     def _broadcast(self, msg) -> None:
         self._relay(msg, None)
+
+    def _squelch_targets(
+        self, signer: bytes, except_peer: Optional[_Peer] = None
+    ) -> list:
+        """Relay targets for one validator's message: the deterministic
+        rotating subset for (signer, epoch) plus every trusted-validator
+        peer; untrusted signers are demoted (smaller subset, no forced
+        validator inclusion). squelch off → all peers (full flood).
+
+        The subset is computed over the FULL peer set and the sending
+        peer filtered from the RESULT — excluding it from the ranking
+        input would alias the subset memo across different senders
+        (same candidate count, different members), relaying messages
+        back to their own sender for a whole epoch."""
+        with self._peers_lock:
+            peers = [p for p in self.peers.values() if p.alive]
+        if not self.squelch.enabled:
+            return [p for p in peers if p is not except_peer]
+        unl = self.node.unl
+        demoted = bool(signer) and signer not in unl
+        if demoted:
+            self.overlay_stats.add("squelch_demoted")
+        seq = self.node.lm.closed_ledger().seq
+        subset = self.squelch.subset(
+            signer, seq, peers,
+            key_fn=lambda p: p.node_public,
+            trusted=lambda p: p.node_public in unl,
+            demoted=demoted,
+        )
+        return [p for p in subset if p is not except_peer]
+
+    def _relay_validator_msg(
+        self, msg, signer: bytes,
+        except_peer: Optional[_Peer] = None,
+        kind: str = "relay_proposal",
+    ) -> None:
+        """Squelched relay of a proposal/validation (reference overlay
+        squelching role): fan-out bounded by the squelch subset size
+        plus the UNL peer count, never by the peer count."""
+        targets = self._squelch_targets(signer, except_peer)
+        if not targets:
+            return
+        data = frame(msg)
+        for p in targets:
+            p.send(data)
+        stats = self.overlay_stats
+        stats.add(kind)
+        if len(targets) > stats.get("relay_fanout_max"):
+            stats.set("relay_fanout_max", len(targets))
 
     # -- timer ------------------------------------------------------------
 
@@ -979,6 +1179,18 @@ class TcpOverlay(ConsensusAdapter):
                     for p in members:
                         p.send(status)
                 self.resources.sweep()
+            if self.fee_track is not None:
+                # aggregate peer pressure → local fee: while the peer
+                # set as a whole is paying charges, the open-ledger
+                # price rises (NORMAL_FEE x pressure, pressure = total
+                # balance / WARN threshold) and decays with the
+                # balances — network-wide abuse costs the abusers
+                from ..node.loadmgr import NORMAL_FEE
+
+                pressure = self.resources.aggregate_pressure()
+                self.fee_track.set_network_pressure(
+                    int(NORMAL_FEE * max(1.0, pressure))
+                )
             # Half-open detection: a crashed peer (no FIN/RST) leaves our
             # reader blocked in recv with alive=True forever, which would
             # also suppress redials. Ping idle peers; drop ones silent past
@@ -998,7 +1210,14 @@ class TcpOverlay(ConsensusAdapter):
     # -- ConsensusAdapter -------------------------------------------------
 
     def propose(self, proposal) -> None:
-        self._broadcast(ProposeSet.from_proposal(proposal))
+        # own proposals ride the same squelched fan-out as relays: at
+        # production peer counts a validator's origin broadcast is the
+        # other O(peers) send path, and the gossip subsets carry the
+        # message the rest of the way
+        self._relay_validator_msg(
+            ProposeSet.from_proposal(proposal), self.key.public,
+            kind="relay_proposal",
+        )
 
     def share_tx_set(self, txset: TxSet) -> None:
         blobs = [blob for _t, blob in txset.blobs()]
@@ -1012,7 +1231,10 @@ class TcpOverlay(ConsensusAdapter):
 
     def send_validation(self, val: STValidation) -> None:
         self.node.router.set_flag(val.validation_id(), SF_RELAYED)
-        self._broadcast(ValidationMessage(val.serialize()))
+        self._relay_validator_msg(
+            ValidationMessage(val.serialize()), self.key.public,
+            kind="relay_validation",
+        )
 
     def relay_disputed_tx(self, blob: bytes) -> None:
         self._broadcast(TxMessage(blob))
@@ -1037,9 +1259,35 @@ class TcpOverlay(ConsensusAdapter):
     # segment catch-up transport hooks (node/inbound.SegmentCatchup)
 
     def segment_peers(self) -> list[bytes]:
-        """Stable-ordered candidate peers for bulk segment transfer."""
+        """Stable-ordered candidate peers for bulk segment transfer.
+        Unified scoring: an endpoint at WARN or worse (charged for
+        garbage, floods, or a condemned transfer) loses the catch-up
+        privilege along with its relay/admission standing."""
         with self._peers_lock:
-            return [pub for pub in sorted(self.peers) if self.peers[pub].alive]
+            cands = [
+                (pub, self.peers[pub].remote)
+                for pub in sorted(self.peers)
+                if self.peers[pub].alive
+            ]
+        return [
+            pub for pub, remote in cands
+            if not self.resources.is_throttled(remote)
+        ]
+
+    def charge_peer(self, peer_pub: bytes, fee) -> str:
+        """Charge a peer identified by node key (the SegmentCatchup
+        condemnation seam): returns the Disposition; DROP disconnects,
+        and the endpoint stays refused at inbound admission until its
+        balance decays."""
+        with self._peers_lock:
+            p = self.peers.get(peer_pub)
+        if p is None:
+            return Disposition.OK
+        disp = self.resources.charge(p.remote, fee)
+        if disp == Disposition.DROP:
+            self.resources.note_disconnect()
+            p.close()
+        return disp
 
     def send_segments_request(self, peer_pub: bytes, msg) -> None:
         with self._peers_lock:
@@ -1082,6 +1330,16 @@ class TcpOverlay(ConsensusAdapter):
     def peer_count(self) -> int:
         with self._peers_lock:
             return len(self.peers)
+
+    def squelch_json(self) -> dict:
+        """`squelch.*` observability block: policy + relay fan-out
+        evidence + sendq shedding (live peers' counts folded in)."""
+        out = self.squelch.get_json()
+        out.update(self.overlay_stats.snapshot())
+        with self._peers_lock:
+            live_drops = sum(p.sendq_dropped for p in self.peers.values())
+        out["sendq_dropped"] += live_drops
+        return out
 
     def peers_json(self) -> list[dict]:
         """reference: OverlayImpl::json / handlers/Peers.cpp row shape."""

@@ -32,6 +32,10 @@ ERRORS = {
     "lgrIdxInvalid": (57, "Ledger index below the retained history floor."),
     "transactionNotFound": (24, "Transaction not found."),
     "fieldNotFoundTransaction": (63, "Field 'transaction' not found."),
+    # resource pricing on the RPC doors (reference rpcSLOW_DOWN): the
+    # client's charge balance crossed the drop line — requests refuse
+    # until it decays
+    "slowDown": (10, "You are placing too much load on the server."),
 }
 
 

@@ -116,6 +116,69 @@ def dispatch(ctx: Context, method: str) -> dict:
         return RPCError("internal", str(exc)).to_json()
 
 
+# -- RPC resource pricing (doc/overlay.md charging schedule) ---------------
+#
+# Every non-admin request charges its client's endpoint with the SAME
+# fee schedule the peer overlay uses (overlay/resource.py FEE_*_RPC):
+# burden-classed per method, extra on malformed/unknown requests, WARN
+# is advisory (rpc_warning attaches `warning: "load"` to responses —
+# the reference's load warning), DROP refuses with rpcSLOW_DOWN until
+# the balance decays. Admin-allowed IPs are exempt.
+
+def rpc_method_fee(method: Optional[str]):
+    from ..overlay.resource import (
+        FEE_HIGH_BURDEN_RPC,
+        FEE_INVALID_RPC,
+        FEE_LOW_BURDEN_RPC,
+        FEE_MEDIUM_BURDEN_RPC,
+        FEE_REFERENCE_RPC,
+    )
+
+    if not method or method not in HANDLERS:
+        return FEE_INVALID_RPC
+    if method in ("server_info", "server_state", "fee", "ping", "random"):
+        return FEE_REFERENCE_RPC          # cheap reference data
+    if method in ("account_tx", "ledger", "ledger_data", "book_offers",
+                  "path_find", "subscribe"):
+        return FEE_MEDIUM_BURDEN_RPC      # history walks / tree dumps
+    if method in ("sign", "submit"):
+        return FEE_HIGH_BURDEN_RPC if method == "sign" else (
+            FEE_LOW_BURDEN_RPC            # submit: verify + apply work
+        )
+    return FEE_REFERENCE_RPC
+
+
+def charge_rpc_client(node, client_ip: str, method: Optional[str],
+                      role: Role) -> Optional[dict]:
+    """Charge one inbound RPC request against its client's balance.
+    Returns an error-result dict when the request must be REFUSED
+    (balance at/above the drop line), else None. Admin-role requests
+    and admin-exempt IPs are never charged."""
+    rm = getattr(node, "rpc_resources", None)
+    if rm is None or not client_ip or role == Role.ADMIN:
+        return None
+    from ..overlay.resource import Disposition
+
+    addr = (client_ip, 0)
+    if not rm.should_admit(addr):
+        rm.note_refused(addr)
+        return RPCError("slowDown").to_json()
+    if rm.charge(addr, rpc_method_fee(method)) == Disposition.DROP:
+        rm.note_disconnect()
+        return RPCError("slowDown").to_json()
+    return None
+
+
+def rpc_warning(node, client_ip: str, role: Role) -> Optional[str]:
+    """Advisory back-off signal for a served request: "load" while the
+    client's balance sits in WARN (the doors attach it to the response
+    so a client can slow down BEFORE it gets hard-refused)."""
+    rm = getattr(node, "rpc_resources", None)
+    if rm is None or not client_ip or role == Role.ADMIN:
+        return None
+    return "load" if rm.is_throttled((client_ip, 0)) else None
+
+
 # -- helpers ---------------------------------------------------------------
 
 
@@ -490,8 +553,19 @@ def do_get_counts(ctx: Context) -> dict:
     tracer = getattr(node, "tracer", None)
     if tracer is not None:
         out["trace"] = tracer.status_json()  # ADMIN method: timeline ok
+    # resource-pricing plane (`resource.*`): per-endpoint charge
+    # balances + warn/drop/refuse/throttle evidence for the peer
+    # overlay and the RPC doors (doc/overlay.md charging schedule)
+    resource: dict = {}
+    rpc_rm = getattr(node, "rpc_resources", None)
+    if rpc_rm is not None:
+        resource["rpc"] = rpc_rm.get_json()
     overlay = getattr(node, "overlay", None)
     if overlay is not None:
+        resource["peers"] = overlay.resources.get_json()
+        # squelch plane (`squelch.*`): relay fan-out bound evidence +
+        # sendq shedding (doc/overlay.md degradation contract)
+        out["squelch"] = overlay.squelch_json()
         out["peers"] = overlay.peer_count()
         vn = getattr(overlay, "node", None)
         if vn is not None:
@@ -514,6 +588,8 @@ def do_get_counts(ctx: Context) -> dict:
             if sc is not None:
                 acq["segfetch"] = sc.get_json()
             out["acquisition"] = acq
+    if resource:
+        out["resource"] = resource
     return out
 
 
@@ -1513,6 +1589,10 @@ def do_print(ctx: Context) -> dict:
     if overlay is not None:
         out["app"]["peerfinder"] = overlay.peerfinder.get_json()
         out["app"]["resources"] = overlay.resources.get_json()
+        out["app"]["squelch"] = overlay.squelch_json()
+    rpc_rm = getattr(node, "rpc_resources", None)
+    if rpc_rm is not None:
+        out["app"]["rpc_resources"] = rpc_rm.get_json()
     return out
 
 
@@ -1746,11 +1826,18 @@ def do_nickname_info(ctx: Context) -> dict:
 
 @handler("blacklist", Role.ADMIN)
 def do_blacklist(ctx: Context) -> dict:
-    """reference: handlers/BlackList.cpp — resource-manager balances."""
+    """reference: handlers/BlackList.cpp — resource-manager balances
+    for BOTH charge planes: peer overlay endpoints and RPC clients."""
     overlay = getattr(ctx.node, "overlay", None)
-    if overlay is None:
-        return {"blacklist": {}}
-    return {"blacklist": overlay.resources.get_json()}
+    out = {
+        "blacklist": (
+            overlay.resources.get_json() if overlay is not None else {}
+        ),
+    }
+    rpc_rm = getattr(ctx.node, "rpc_resources", None)
+    if rpc_rm is not None:
+        out["rpc"] = rpc_rm.get_json()
+    return out
 
 
 @handler("profile", Role.ADMIN)

@@ -24,22 +24,42 @@ __all__ = ["HttpRpcServer", "process_http_request"]
 _MAX_BODY = 10 * 1024 * 1024
 
 
-def process_http_request(node, body: bytes, role: Role = Role.ADMIN) -> dict:
-    """Decode one JSON-RPC request body → response object."""
+def process_http_request(node, body: bytes, role: Role = Role.ADMIN,
+                         client_ip: str = "") -> dict:
+    """Decode one JSON-RPC request body → response object. Non-admin
+    requests charge the client's resource balance (FEE_*_RPC schedule);
+    a client past the drop line gets rpcSLOW_DOWN until it decays."""
+    from .handlers import charge_rpc_client
+
     try:
         req = json.loads(body)
     except ValueError:
-        return {"result": RPCError("invalidParams", "malformed JSON").to_json()
-                | {"status": "error"}}
+        refused = charge_rpc_client(node, client_ip, None, role)  # charged
+        err = refused or RPCError("invalidParams", "malformed JSON").to_json()
+        return {"result": err | {"status": "error"}}
     method = req.get("method")
     params_list = req.get("params") or [{}]
     params = params_list[0] if isinstance(params_list, list) and params_list else {}
     if not isinstance(params, dict):
         params = {}
     if not isinstance(method, str):
-        return {"result": RPCError("unknownCmd").to_json() | {"status": "error"}}
+        refused = charge_rpc_client(node, client_ip, None, role)
+        err = refused or RPCError("unknownCmd").to_json()
+        return {"result": err | {"status": "error"}}
+    refused = charge_rpc_client(node, client_ip, method, role)
+    if refused is not None:
+        result = refused | {"status": "error"}
+        out = {"result": result}
+        if "id" in req:
+            out["id"] = req["id"]
+        return out
     result = dispatch(Context(node=node, params=params, role=role), method)
     result["status"] = "error" if "error" in result else "success"
+    from .handlers import rpc_warning
+
+    warn = rpc_warning(node, client_ip, role)
+    if warn is not None:
+        result["warning"] = warn
     out = {"result": result}
     if "id" in req:
         out["id"] = req["id"]
@@ -91,9 +111,12 @@ class HttpRpcServer:
                 if request_line.startswith("GET"):
                     payload = b'{"status": "ok"}'
                 else:
+                    peer = writer.get_extra_info("peername")
                     payload = json.dumps(
                         process_http_request(
-                            self.node, body, _role_for_peer(self.node, writer)
+                            self.node, body,
+                            _role_for_peer(self.node, writer),
+                            client_ip=peer[0] if peer else "",
                         )
                     ).encode()
                 writer.write(

@@ -129,6 +129,8 @@ class WsRpcServer:
             from .http_server import _role_for_peer
 
             role = _role_for_peer(self.node, writer)
+            peer = writer.get_extra_info("peername")
+            client_ip = peer[0] if peer else ""
 
             buffer = b""
             while True:
@@ -149,7 +151,7 @@ class WsRpcServer:
                         continue
                     message, buffer = buffer, b""
                     resp = await loop.run_in_executor(
-                        None, self._process, message, sub, role
+                        None, self._process, message, sub, role, client_ip
                     )
                     await send_async(json.dumps(resp).encode())
         except (asyncio.IncompleteReadError, ConnectionError):
@@ -159,21 +161,46 @@ class WsRpcServer:
                 self.subs.remove(sub.id)
             writer.close()
 
-    def _process(self, message: bytes, sub: InfoSub, role: Role) -> dict:
-        """reference: WSConnection::invokeCommand — jtCLIENT job body."""
+    def _process(self, message: bytes, sub: InfoSub, role: Role,
+                 client_ip: str = "") -> dict:
+        """reference: WSConnection::invokeCommand — jtCLIENT job body.
+        Non-admin commands charge the client's resource balance (same
+        FEE_*_RPC schedule as the HTTP door); a client past the drop
+        line gets rpcSLOW_DOWN until its balance decays."""
+        from .handlers import charge_rpc_client
+
         try:
             req = json.loads(message)
         except ValueError:
+            refused = charge_rpc_client(self.node, client_ip, None, role)
+            if refused is not None:
+                return {"type": "response", "status": "error",
+                        "result": refused}
             return {"type": "error", "error": "jsonInvalid"}
         command = req.get("command")
         if not isinstance(command, str):
+            refused = charge_rpc_client(self.node, client_ip, None, role)
+            if refused is not None:
+                return {"type": "response", "status": "error",
+                        "result": refused}
             return {"type": "error", "error": "missingCommand"}
         params = {k: v for k, v in req.items() if k not in ("command", "id")}
+        refused = charge_rpc_client(self.node, client_ip, command, role)
+        if refused is not None:
+            out = {"type": "response", "status": "error", "result": refused}
+            if "id" in req:
+                out["id"] = req["id"]
+            return out
         result = dispatch(
             Context(node=self.node, params=params, role=role,
                     infosub=sub, subs=self.subs),
             command,
         )
+        from .handlers import rpc_warning
+
+        warn = rpc_warning(self.node, client_ip, role)
+        if warn is not None:
+            result["warning"] = warn
         status = "error" if "error" in result else "success"
         out = {"type": "response", "status": status, "result": result}
         if "id" in req:

@@ -27,11 +27,12 @@ import random
 from ..consensus.proposal import LedgerProposal
 from ..consensus.txset import MAX_TXSET_BLOBS
 from ..consensus.validation import STValidation
-from ..overlay.simnet import SimValidator
+from ..overlay.simnet import RelayPeer, SimValidator
 from ..overlay.wire import ProposeSet, TxSetData, ValidationMessage, frame
 from ..protocol.keys import KeyPair
 
-__all__ = ["ByzantineValidator", "BEHAVIORS"]
+__all__ = ["ByzantineValidator", "BEHAVIORS", "FlooderPeer",
+           "FLOOD_BEHAVIORS"]
 
 BEHAVIORS = (
     "equivocate", "duplicate", "forge", "stale", "garbage", "oversized",
@@ -148,3 +149,100 @@ class ByzantineValidator(SimValidator):
             self.net.broadcast(
                 self.nid, frame(ValidationMessage(old.serialize()))
             )
+
+
+FLOOD_BEHAVIORS = ("garbage_flood", "dup_flood", "junk_tx_flood")
+
+
+class FlooderPeer(RelayPeer):
+    """A hostile relay-tier peer for the production-fan-in scenarios:
+    it floods honest nodes at a configurable burst rate with
+
+        garbage_flood    malformed frames — absurd length prefixes and
+                         out-of-schema message types (FEE_INVALID_REQUEST
+                         per frame at every receiver)
+        dup_flood        the SAME fabricated proposal frame re-sent to
+                         the same targets every step — the same-source
+                         duplicate signature the resource plane prices
+                         (FEE_UNWANTED_DATA per re-send)
+        junk_tx_flood    TxMessage frames carrying unparseable blobs
+                         (FEE_BAD_DATA at every validator that tries)
+
+    The defense contract the scenarios assert: every honest node's
+    ResourceManager walks this peer's balance to DROP, deliveries from
+    it are then REFUSED (disconnect + gated readmission, visible in
+    ``net.refusals``/`resource.*` counters), and honest consensus close
+    cadence holds within budget of the no-flooder run of the same seed.
+    Deterministic: all randomness rides one seeded rng.
+    """
+
+    def __init__(self, net, nid: int, behaviors=FLOOD_BEHAVIORS,
+                 seed: int = 0, burst: int = 8, fan: int = 16):
+        super().__init__(net, nid)
+        self.behaviors = frozenset(behaviors)
+        self.rng = random.Random(0xF700D ^ seed ^ nid)
+        self.burst = burst  # frames per target per step
+        self.fan = fan      # targets per step
+        self.emitted: dict[str, int] = {b: 0 for b in self.behaviors}
+        # one fabricated proposal frame, re-sent forever (the dup flood)
+        fake = LedgerProposal(
+            prev_ledger=bytes(32), propose_seq=1,
+            tx_set_hash=bytes([0xF1] * 32), close_time=1,
+        )
+        fake.sign(KeyPair.from_passphrase(f"flooder-{seed}-{nid}"))
+        self._dup_frame = frame(ProposeSet.from_proposal(fake))
+        # a STABLE neighbor set, like a real overlay session list: a
+        # flooder hammers the peers it is connected to, which is what
+        # walks those endpoints' balances to DROP (spraying one frame
+        # across 1000 nodes never crosses any threshold — that shape is
+        # the tx-flood economics TxQ already prices). Two validators
+        # are always among the victims so the defense evidence lands on
+        # the consensus core too.
+        self._neighbors: list[int] = []
+
+    def _targets(self) -> list[int]:
+        if not self._neighbors:
+            n = len(self.net.nodes)
+            n_val = len(self.net.validators)
+            picks = [v for v in range(min(2, n_val)) if v != self.nid]
+            while len(picks) < min(self.fan, n - 1):
+                dst = self.rng.randrange(n)
+                if dst != self.nid and dst not in picks:
+                    picks.append(dst)
+            self._neighbors = picks
+        return self._neighbors
+
+    def act(self, step: int) -> None:
+        """Called by the scenario runner once per step."""
+        targets = self._targets()
+        if "garbage_flood" in self.behaviors:
+            for dst in targets:
+                for _ in range(self.burst):
+                    self.emitted["garbage_flood"] += 1
+                    if self.rng.random() < 0.5:
+                        # absurd length prefix: FrameReader raises
+                        self.net.send(
+                            self.nid, dst, b"\xff\xff\xff\xff\x00\x1e"
+                        )
+                    else:
+                        # out-of-schema message type (mt 99)
+                        self.net.send(
+                            self.nid, dst,
+                            (3).to_bytes(4, "big") + (99).to_bytes(2, "big")
+                            + b"\x00\x01\x02",
+                        )
+        if "dup_flood" in self.behaviors:
+            for dst in targets:
+                for _ in range(self.burst):
+                    self.emitted["dup_flood"] += 1
+                    self.net.send(self.nid, dst, self._dup_frame)
+        if "junk_tx_flood" in self.behaviors:
+            from ..overlay.wire import TxMessage
+
+            for dst in targets:
+                for _ in range(self.burst):
+                    self.emitted["junk_tx_flood"] += 1
+                    blob = bytes(
+                        self.rng.randrange(256) for _ in range(24)
+                    )
+                    self.net.send(self.nid, dst, frame(TxMessage(blob)))

@@ -56,6 +56,17 @@ class Scenario:
     build_workload: Optional[Callable] = None
     # nid -> behavior tuple (testkit.byzantine.BEHAVIORS subset)
     byzantine: dict = dc_field(default_factory=dict)
+    # production fan-in plane (ISSUE 11): a lightweight relay-peer tier
+    # of n_peers non-validator nodes, validator-message squelching
+    # (squelch_size=0 = full flood, byte-for-byte the legacy transport)
+    # and enforced per-source resource pricing on every honest node
+    n_peers: int = 0
+    squelch_size: int = 0
+    squelch_rotate: int = 16
+    resources: bool = False
+    # relay-tier flooders: peer-tier index -> kwargs for FlooderPeer
+    # (behaviors/burst/fan); nid = n_validators + index
+    flooders: dict = dc_field(default_factory=dict)
     # cold-node catch-up: nids silenced from step 0, revived at join_at,
     # syncing via the segment bulk path; `segments` gives every honest
     # validator a real segstore the scenario persists closed ledgers to
@@ -303,12 +314,14 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
     import os
     import tempfile
 
-    from .byzantine import ByzantineValidator
+    from .byzantine import ByzantineValidator, FlooderPeer
 
     net = SimNet(
         scn.n_validators, quorum=scn.quorum,
         latency_steps=scn.latency_steps,
         idle_interval=scn.idle_interval, seed=scn.seed,
+        n_peers=scn.n_peers, squelch_size=scn.squelch_size,
+        squelch_rotate=scn.squelch_rotate, resources=scn.resources,
     )
     # swap hostile slots in BEFORE start() so their genesis matches
     byz_validators = {}
@@ -319,7 +332,22 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
             scn.idle_interval, behaviors=behaviors, seed=scn.seed,
         )
         net.validators[nid] = bv
+        net.nodes[nid] = bv
         byz_validators[nid] = bv
+    # relay-tier flooders (ISSUE 11 flood-survival shape): swap hostile
+    # peers into the relay tier, inheriting the slot's squelch/resource
+    # attachments so their DELIVERIES behave like any peer's — only
+    # their act() is hostile
+    flooder_peers = {}
+    for idx, spec in scn.flooders.items():
+        nid = scn.n_validators + int(idx)
+        old = net.nodes[nid]
+        fp = FlooderPeer(net, nid, seed=scn.seed, **spec)
+        fp.squelch = old.squelch
+        fp.resources = old.resources
+        net.peers[int(idx)] = fp
+        net.nodes[nid] = fp
+        flooder_peers[nid] = fp
 
     # schedule: user events + the cold-node join choreography
     sched = FaultSchedule(scn.seed)
@@ -401,6 +429,9 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
             for bv in byz_validators.values():
                 if not net.is_down(bv.nid):
                     bv.act(step)
+            for fp in flooder_peers.values():
+                if not net.is_down(fp.nid):
+                    fp.act(step)
             net.step()
 
         # drain the remaining schedule (heals/revives past the horizon)
@@ -484,6 +515,33 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
             "degraded_transitions": degraded_transitions,
             "fault_digest": sched.digest(),
         }
+        if scn.squelch_size or scn.n_peers:
+            # relay fan-out evidence: the squelch bound the flood gate
+            # asserts (fan-out <= squelch_size + n_validators, never
+            # the peer count)
+            card["relay"] = {
+                k: net.net_stats.get(k, 0)
+                for k in ("relay_proposal", "relay_validation",
+                          "relay_fanout_max")
+            }
+        if scn.resources:
+            # `resource.*` evidence: charges paid, WARN/DROP crossings,
+            # throttled sheds, refused deliveries per honest node
+            card["resource"] = net.resource_json()
+        if flooder_peers:
+            card["flooders"] = {
+                str(nid): {
+                    "emitted": dict(fp.emitted),
+                    # how many honest nodes reached DROP for this source
+                    # and refused its deliveries (disconnect + gated
+                    # readmission, collapsed onto the sim transport)
+                    "refused_by": len(net.refusals.get(nid, ())),
+                    # drop latency: virtual ms of flooding before the
+                    # first honest node shut the door
+                    "first_refusal_ms": net.first_refusal_ms.get(nid),
+                }
+                for nid, fp in sorted(flooder_peers.items())
+            }
         if catchups:
             nid = scn.cold_nodes[0]
             cold = net.validators[nid].node

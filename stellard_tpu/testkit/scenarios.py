@@ -128,6 +128,35 @@ def scenario_order_books(seed: int = 0) -> Scenario:
     )
 
 
+def scenario_flood_survival(
+    seed: int = 0,
+    n_peers: int = 495,
+    squelch: int = 8,
+    flooder: bool = True,
+    steps: int = 48,
+) -> Scenario:
+    """Overlay at production fan-in (ISSUE 11): a 5-validator core plus
+    an n_peers relay tier (500 nodes at the default), squelched
+    validator-message relay, enforced resource pricing on every honest
+    node, and one hostile relay peer flooding garbage + duplicates +
+    junk txs. The gate (tools/floodsmoke.py): honest validators
+    converge on ONE hash, the flooder's endpoint reaches DROP at the
+    nodes it floods and is refused readmission (`resource.*`
+    counters), relay fan-out stays <= squelch + |UNL| (never the peer
+    count), and close cadence holds within budget of the
+    ``flooder=False`` run of the same seed."""
+    return Scenario(
+        name="flood_survival" if flooder else "flood_baseline",
+        seed=seed, n_validators=5, quorum=4, steps=steps,
+        n_peers=n_peers, squelch_size=squelch, resources=True,
+        flooders=(
+            {0: {"burst": 8, "fan": 24}} if flooder else {}
+        ),
+        build_workload=_funded_flood(payment_flood, 30),
+        max_tail_steps=160,
+    )
+
+
 def scenario_fee_gaming(seed: int = 0) -> Scenario:
     return Scenario(
         name="fee_gaming", seed=seed, n_validators=4, quorum=3,
@@ -147,6 +176,7 @@ MATRIX = {
     "hot_account": scenario_hot_account,
     "order_books": scenario_order_books,
     "fee_gaming": scenario_fee_gaming,
+    "flood_survival": scenario_flood_survival,
 }
 
 

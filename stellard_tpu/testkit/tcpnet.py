@@ -33,7 +33,7 @@ from .workloads import TxFactory
 
 __all__ = [
     "free_ports", "rpc", "wait_until", "validator_config",
-    "spawn_validator", "run_tcp", "REPO", "SPEED",
+    "spawn_validator", "run_tcp", "hostile_flood", "REPO", "SPEED",
 ]
 
 REPO = os.path.dirname(
@@ -138,6 +138,136 @@ def spawn_validator(cfg_path: str, stdout=subprocess.DEVNULL):
          "--start"],
         cwd=REPO, env=env, stdout=stdout, stderr=subprocess.STDOUT,
     )
+
+
+def hostile_flood(
+    peer_port: int,
+    frames: int = 200,
+    mode: str = "junk_tx",
+    host: str = "127.0.0.1",
+    passphrase: str = "tcp-flooder",
+    reconnects: int = 3,
+) -> dict:
+    """The byzantine matrix promoted onto the REAL TCP net (carried
+    PR 8 follow-on): a hostile client that completes a genuine
+    nonce+signed-hello handshake with a throwaway key, then floods the
+    victim with hostile frames until the victim's resource plane drops
+    it. Modes:
+
+        junk_tx    TxMessage frames with unparseable blobs
+                   (FEE_BAD_DATA per frame at the victim)
+        garbage    out-of-schema message types (kills the session per
+                   frame — exercised via `reconnects` handshake loops)
+
+    Returns {"sent", "disconnected", "reconnect_refused"} — the caller
+    asserts the victim disconnected the flooder AND refuses its
+    readmission (the `resource.*` drop gate), while staying healthy.
+    Works against any plaintext [peer_port] (in-process TcpOverlay or
+    a spawned validator)."""
+    from ..overlay.tcp import HP_SESSION, PROTO_VERSION
+    from ..overlay.wire import FrameReader, Hello, TxMessage, frame
+    from ..utils.hashes import prefix_hash
+
+    key = KeyPair.from_passphrase(passphrase)
+    rng = random.Random(0x7C9F)
+    stats = {"sent": 0, "disconnected": False, "reconnect_refused": False}
+
+    def handshake(sock) -> bool:
+        sock.settimeout(5.0)
+        nonce = os.urandom(32)
+        while nonce[0] == 0x16:  # never look like a TLS ClientHello
+            nonce = os.urandom(32)
+        sock.sendall(nonce)
+        theirs = b""
+        while len(theirs) < 32:
+            chunk = sock.recv(32 - len(theirs))
+            if not chunk:
+                return False
+            theirs += chunk
+        session_hash = prefix_hash(
+            HP_SESSION, min(nonce, theirs) + max(nonce, theirs)
+        )
+        hello = Hello(
+            PROTO_VERSION, 35_000_000, key.public,
+            key.sign(session_hash), 1, b"\x00" * 32, 0,
+        )
+        sock.sendall(frame(hello))
+        reader = FrameReader()
+        while True:
+            data = sock.recv(65536)
+            if not data:
+                return False
+            if reader.feed(data):
+                return True
+
+    def closed(sock, timeout=10.0) -> bool:
+        sock.settimeout(timeout)
+        try:
+            while True:
+                if sock.recv(65536) == b"":
+                    return True
+        except (ConnectionResetError, BrokenPipeError):
+            return True
+        except OSError:
+            return False
+
+    for _episode in range(max(1, reconnects)):
+        try:
+            sock = socket.create_connection((host, peer_port), timeout=5.0)
+        except OSError:
+            stats["reconnect_refused"] = True
+            return stats
+        try:
+            if not handshake(sock):
+                # refused before/at hello: the admission gate is shut
+                stats["reconnect_refused"] = stats["disconnected"]
+                return stats
+            for _ in range(frames):
+                if mode == "garbage":
+                    data = (
+                        (3).to_bytes(4, "big") + (99).to_bytes(2, "big")
+                        + b"\x00\x01\x02"
+                    )
+                else:
+                    blob = bytes(rng.randrange(256) for _ in range(24))
+                    data = frame(TxMessage(blob))
+                try:
+                    sock.sendall(data)
+                except OSError:
+                    stats["disconnected"] = True
+                    break
+                stats["sent"] += 1
+            if not stats["disconnected"]:
+                stats["disconnected"] = closed(sock)
+        except OSError:
+            stats["disconnected"] = True
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if stats["disconnected"]:
+            # probe readmission: a dropped endpoint must be refused at
+            # accept (closed without a nonce) until its balance decays
+            try:
+                probe = socket.create_connection(
+                    (host, peer_port), timeout=5.0
+                )
+            except OSError:
+                stats["reconnect_refused"] = True
+                return stats
+            try:
+                probe.settimeout(5.0)
+                got = b""
+                try:
+                    got = probe.recv(32)
+                except (socket.timeout, OSError):
+                    got = b""
+                stats["reconnect_refused"] = got == b""
+            finally:
+                probe.close()
+            return stats
+    return stats
 
 
 TCP_EVENT_KINDS = {"kill", "revive"}

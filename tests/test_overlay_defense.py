@@ -1,0 +1,644 @@
+"""Overlay defense plane (ISSUE 11): enforced resource pricing,
+validator-message squelching, bounded per-peer sendqs, RPC-door
+pricing, and the 200+-node flood-survival scenario.
+
+Covers the acceptance spine:
+- squelch determinism (same UNL + seq -> same subset, cross-process),
+  rotation across epochs AND on peer churn, kill-switch;
+- byte-identical convergence squelched-vs-flooded on one seed;
+- resource enforcement: WARN throttle, DROP disconnect + gated
+  readmission, sweep/expiry on a fake clock, aggregate pressure ->
+  LoadFeeTrack;
+- the sendq discipline (drop-oldest + eviction);
+- FEE_*_RPC pricing on the HTTP/WS doors with admin exemption;
+- SegmentCatchup condemnation taking a FEE_GARBAGE_SEGMENT charge
+  (unified peer scoring);
+- flood survival at 100 nodes with cross-process scorecard identity,
+  and the hostile client against a REAL TCP overlay (byzantine matrix
+  promoted onto genuine sockets).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import subprocess
+import sys
+import time
+import types
+
+import pytest
+
+from stellard_tpu.node.hashrouter import HashRouter
+from stellard_tpu.node.loadmgr import NORMAL_FEE, LoadFeeTrack
+from stellard_tpu.overlay.resource import (
+    DROP_THRESHOLD,
+    FEE_BAD_DATA,
+    FEE_GARBAGE_SEGMENT,
+    FEE_INVALID_SIGNATURE,
+    SECONDS_UNTIL_EXPIRATION,
+    WARNING_THRESHOLD,
+    Charge,
+    Disposition,
+    ResourceManager,
+)
+from stellard_tpu.overlay.squelch import SquelchPolicy, relay_rank
+from stellard_tpu.protocol.keys import KeyPair
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- resource manager ------------------------------------------------------
+
+
+class TestResourceManager:
+    def _rm(self, now):
+        return ResourceManager(
+            key_fn=lambda a: a[0], clock=lambda: now[0]
+        )
+
+    def test_warn_then_drop_with_counters(self):
+        now = [0.0]
+        rm = self._rm(now)
+        addr = ("1.2.3.4", 0)
+        disp = Disposition.OK
+        while disp == Disposition.OK:
+            disp = rm.charge(addr, FEE_BAD_DATA)
+        assert disp == Disposition.WARN
+        assert rm.warned == 1 and rm.is_throttled(addr)
+        assert rm.status(addr) == Disposition.WARN
+        while disp != Disposition.DROP:
+            disp = rm.charge(addr, FEE_BAD_DATA)
+        assert rm.dropped >= 1
+        assert not rm.should_admit(addr)
+        # decay under the drop line re-admits
+        now[0] += 300.0
+        assert rm.should_admit(addr)
+
+    def test_sweep_expires_idle_entries_fake_clock(self):
+        """Satellite pin: sweep() expiry semantics on a fake clock —
+        entries idle past SECONDS_UNTIL_EXPIRATION vanish; active ones
+        with a live balance survive."""
+        now = [0.0]
+        rm = self._rm(now)
+        rm.charge(("idle", 0), FEE_INVALID_SIGNATURE)
+        now[0] = 10.0
+        rm.charge(("busy", 0), Charge(100_000, "big"))
+        now[0] = SECONDS_UNTIL_EXPIRATION + 5.0  # idle aged out; busy not
+        rm.sweep()
+        ent = rm.get_json()["entries"]
+        assert "idle" not in ent and "busy" in ent
+        # and once everything decays to dust, sweep empties the table
+        now[0] += 3000.0
+        rm.sweep()
+        assert rm.get_json()["entries"] == {}
+        assert rm.get_json()["entry_count"] == 0
+
+    def test_admin_exemption(self):
+        now = [0.0]
+        rm = ResourceManager(
+            key_fn=lambda a: a[0], clock=lambda: now[0], admin={"admin"}
+        )
+        for _ in range(100):
+            assert rm.charge(("admin", 0), FEE_INVALID_SIGNATURE) == (
+                Disposition.OK
+            )
+        assert rm.should_admit(("admin", 0))
+        assert not rm.is_throttled(("admin", 0))
+
+    def test_aggregate_pressure_rises_and_decays(self):
+        now = [0.0]
+        rm = self._rm(now)
+        assert rm.aggregate_pressure() == 0.0
+        for i in range(4):
+            rm.charge((f"p{i}", 0), Charge(WARNING_THRESHOLD, "x"))
+        assert rm.aggregate_pressure() == pytest.approx(4.0)
+        now[0] += 32.0  # one half-life
+        assert rm.aggregate_pressure() == pytest.approx(2.0, rel=0.01)
+
+    def test_note_counters(self):
+        now = [0.0]
+        rm = self._rm(now)
+        rm.note_refused(("x", 0))
+        rm.note_throttled(3)
+        rm.note_disconnect()
+        j = rm.get_json()
+        assert (j["refused"], j["throttled"], j["disconnects"]) == (1, 3, 1)
+
+    def test_warned_counts_crossings_not_charges(self):
+        """Review-pass regression: an endpoint parked between WARN and
+        DROP bumps `warned` once per CROSSING, not once per charge —
+        and decaying under the line re-arms the crossing."""
+        now = [0.0]
+        rm = self._rm(now)
+        addr = ("w", 0)
+        rm.charge(addr, Charge(WARNING_THRESHOLD + 50, "x"))
+        assert rm.warned == 1
+        for _ in range(20):  # charges while already warned: no bumps
+            assert rm.charge(addr, Charge(1, "tick")) == Disposition.WARN
+        assert rm.warned == 1
+        now[0] += 300.0  # decay far under the line
+        assert rm.charge(addr, Charge(1, "ok")) == Disposition.OK
+        rm.charge(addr, Charge(WARNING_THRESHOLD + 50, "x"))  # re-cross
+        assert rm.warned == 2
+
+
+class TestLoadFeePressure:
+    def test_network_pressure_feeds_floor_and_factor(self):
+        ft = LoadFeeTrack()
+        assert ft.network_floor == NORMAL_FEE
+        ft.set_network_pressure(NORMAL_FEE * 3)
+        assert ft.network_floor == NORMAL_FEE * 3
+        assert ft.load_factor == NORMAL_FEE * 3
+        assert ft.get_json()["overlay_fee"] == NORMAL_FEE * 3
+        ft.set_network_pressure(0)  # clamped to NORMAL
+        assert ft.network_floor == NORMAL_FEE and not ft.is_loaded
+
+
+class TestHashRouterDupAttribution:
+    def test_same_peer_resend_flagged(self):
+        r = HashRouter()
+        h = b"\x11" * 32
+        assert r.note_peer(h, 1) == (True, False)   # new
+        assert r.note_peer(h, 2) == (False, False)  # cross-peer dup: free
+        assert r.note_peer(h, 1) == (False, True)   # same-peer re-send
+        # legacy boolean API unchanged
+        assert r.add_suppression_peer(b"\x22" * 32, 9) is True
+        assert r.add_suppression_peer(b"\x22" * 32, 9) is False
+
+
+# -- squelch ---------------------------------------------------------------
+
+
+class TestSquelchDeterminism:
+    CANDS = [bytes([i]) * 32 for i in range(24)]
+
+    def test_pure_function_and_rotation(self):
+        p = SquelchPolicy(size=6, rotate=16, relayer_id=b"R" * 32)
+        signer = b"V" * 32
+        a = p.subset(signer, 100, self.CANDS, key_fn=lambda c: c)
+        b = p.subset(signer, 100, self.CANDS, key_fn=lambda c: c)
+        assert a == b and len(a) == 6
+        # epoch rotation: seqs in one epoch agree, crossing rotates
+        same = p.subset(signer, 111, self.CANDS, key_fn=lambda c: c)
+        assert same == a  # 100//16 == 111//16
+        rotated = p.subset(signer, 160, self.CANDS, key_fn=lambda c: c)
+        assert rotated != a
+
+    def test_cross_process_identity(self):
+        """Same UNL + seq -> the same relay subset in ANOTHER process
+        with a different PYTHONHASHSEED (no hash-seed leakage)."""
+        p = SquelchPolicy(size=6, rotate=16, relayer_id=b"R" * 32)
+        ours = [
+            c.hex() for c in p.subset(
+                b"V" * 32, 100, self.CANDS, key_fn=lambda c: c
+            )
+        ]
+        script = (
+            "import json\n"
+            "from stellard_tpu.overlay.squelch import SquelchPolicy\n"
+            "cands = [bytes([i]) * 32 for i in range(24)]\n"
+            "p = SquelchPolicy(size=6, rotate=16, relayer_id=b'R' * 32)\n"
+            "out = p.subset(b'V' * 32, 100, cands, key_fn=lambda c: c)\n"
+            "print(json.dumps([c.hex() for c in out]))\n"
+        )
+        env = dict(os.environ)
+        env["PYTHONHASHSEED"] = "31337"
+        env["JAX_PLATFORMS"] = "cpu"
+        theirs = json.loads(subprocess.check_output(
+            [sys.executable, "-c", script], env=env, cwd=REPO,
+        ))
+        assert theirs == ours
+
+    def test_rotation_on_peer_churn(self):
+        """The subset is always ranked over the CURRENT candidates: a
+        departed member vanishes immediately (bump() drops the memo)."""
+        p = SquelchPolicy(size=6, rotate=16, relayer_id=b"R" * 32)
+        signer = b"V" * 32
+        a = p.subset(signer, 100, self.CANDS, key_fn=lambda c: c)
+        survivors = [c for c in self.CANDS if c != a[0]]
+        p.bump()
+        b = p.subset(signer, 100, survivors, key_fn=lambda c: c)
+        assert a[0] not in b and len(b) == 6
+        # rank order among survivors is stable: b is a superset-ranked
+        # re-pick, not a reshuffle
+        assert b[:5] == [c for c in a[1:6]]
+
+    def test_trusted_always_included_and_demotion(self):
+        p = SquelchPolicy(size=4, rotate=16, demote_factor=4,
+                          relayer_id=b"R" * 32)
+        trusted = set(self.CANDS[20:])
+        full = p.subset(
+            b"V" * 32, 5, self.CANDS, key_fn=lambda c: c,
+            trusted=lambda c: c in trusted,
+        )
+        assert trusted <= set(full)
+        demoted = p.subset(
+            b"E" * 32, 5, self.CANDS, key_fn=lambda c: c,
+            trusted=lambda c: c in trusted, demoted=True,
+        )
+        assert len(demoted) == 1  # size // demote_factor, no inclusion
+
+    def test_kill_switch_full_flood(self):
+        p = SquelchPolicy(size=0)
+        assert not p.enabled
+        assert p.subset(b"V" * 32, 1, self.CANDS, key_fn=lambda c: c) == (
+            self.CANDS
+        )
+
+    def test_rank_is_relayer_salted(self):
+        # two relayers pick different subsets (k-out digraph, not one
+        # global k-subset that would strand messages)
+        a = relay_rank(b"V" * 32, 3, b"A" * 32, b"c" * 32)
+        b = relay_rank(b"V" * 32, 3, b"B" * 32, b"c" * 32)
+        assert a != b
+
+    def test_memo_never_aliases_across_senders(self):
+        """Review-pass regression: callers rank over the FULL candidate
+        set and filter the sender from the RESULT. Excluding the sender
+        from the ranking INPUT aliased the (count-keyed) memo across
+        senders, echoing relays back to whoever sent the message."""
+        from stellard_tpu.overlay.simnet import SimNet
+
+        net = SimNet(2, n_peers=8, squelch_size=3)
+        relayer = net.nodes[2]
+        sent: list[tuple[int, int]] = []
+        net.send = lambda src, dst, data: sent.append((src, dst))
+        for sender in (3, 4, 5, 6):
+            net.relay_validator(
+                relayer.nid, net.keys[0].public, b"x", relayer.squelch,
+                exclude=(sender,),
+            )
+            echoes = [d for s, d in sent if d == sender]
+            assert not echoes, f"relay echoed back to sender {sender}"
+            sent.clear()
+
+
+# -- simnet: squelched vs flooded ------------------------------------------
+
+
+class TestSimnetSquelch:
+    def test_squelched_vs_flooded_byte_identical_chain(self):
+        """One seed, squelch on vs off: the converged chain is
+        byte-identical (same final seq, same final hash, same commit
+        set) — squelching changes the relay graph, never the outcome."""
+        from stellard_tpu.testkit.scenario import run_simnet
+        from stellard_tpu.testkit.scenarios import scenario_flood_survival
+
+        flood = run_simnet(scenario_flood_survival(
+            seed=3, n_peers=20, steps=36, flooder=False, squelch=0,
+        ))
+        squelched = run_simnet(scenario_flood_survival(
+            seed=3, n_peers=20, steps=36, flooder=False, squelch=4,
+        ))
+        assert flood["converged"] and squelched["converged"]
+        assert flood["single_hash"] and squelched["single_hash"]
+        assert squelched["final_seq"] == flood["final_seq"]
+        assert squelched["final_hash"] == flood["final_hash"]
+        assert squelched["committed"] == flood["committed"]
+        # anti-vacuity: the squelched run actually relayed via subsets,
+        # bounded by size + |UNL|
+        assert squelched["relay"]["relay_proposal"] > 0
+        assert 0 < squelched["relay"]["relay_fanout_max"] <= 4 + 5
+
+    def test_legacy_net_shape_unchanged(self):
+        """squelch=0 + no peers: the net is byte-for-byte the legacy
+        transport — no relay tier, no new net_stats keys, origin
+        broadcast only."""
+        from stellard_tpu.overlay.simnet import SimNet
+
+        net = SimNet(4)
+        assert net.nodes == net.validators
+        assert "relay_fanout_max" not in net.net_stats
+        assert all(v.squelch is None and v.resources is None
+                   for v in net.validators)
+
+
+# -- flood survival (small, fast) ------------------------------------------
+
+
+class TestFloodSurvival:
+    def _card(self, **kw):
+        from stellard_tpu.testkit.scenario import run_simnet
+        from stellard_tpu.testkit.scenarios import scenario_flood_survival
+
+        return run_simnet(scenario_flood_survival(
+            seed=11, n_peers=45, steps=40, **kw
+        ))
+
+    def test_flooder_dropped_and_net_converges(self):
+        card = self._card()
+        assert card["converged"] and card["single_hash"]
+        assert card["committed"] == card["submitted"]
+        res = card["resource"]
+        assert res["dropped"] > 0 and res["refused"] > 0
+        assert res["throttled"] > 0 and res["warned"] > 0
+        fl = next(iter(card["flooders"].values()))
+        assert fl["refused_by"] >= 24  # the whole flooded neighbor set
+        assert fl["first_refusal_ms"] is not None
+        assert card["relay"]["relay_fanout_max"] <= 8 + 5
+
+    def test_scorecard_cross_process_identical(self):
+        """Seed-determinism ACROSS processes (different PYTHONHASHSEED):
+        the acceptance criterion that keeps the flood gate replayable."""
+        ours = self._card()
+        script = (
+            "import json\n"
+            "from stellard_tpu.testkit.scenario import run_simnet\n"
+            "from stellard_tpu.testkit.scenarios import "
+            "scenario_flood_survival\n"
+            "card = run_simnet(scenario_flood_survival("
+            "seed=11, n_peers=45, steps=40))\n"
+            "print(json.dumps(card, sort_keys=True, default=str))\n"
+        )
+        env = dict(os.environ)
+        env["PYTHONHASHSEED"] = "424242"
+        env["JAX_PLATFORMS"] = "cpu"
+        out = subprocess.check_output(
+            [sys.executable, "-c", script], env=env, cwd=REPO,
+            timeout=300,
+        )
+        theirs = out.decode().strip().splitlines()[-1]
+        assert theirs == json.dumps(ours, sort_keys=True, default=str)
+
+
+# -- sendq discipline ------------------------------------------------------
+
+
+class TestSendqDiscipline:
+    def _peer(self, depth=4, evict=6):
+        import socket as _socket
+
+        from stellard_tpu.overlay.tcp import _Peer
+
+        a, b = _socket.socketpair()
+        p = _Peer(a, inbound=False, sendq_depth=depth, evict_drops=evict)
+        p._writer = object()  # writer "running" but never draining
+        return p, b
+
+    def test_drop_oldest_never_blocks_sender(self):
+        p, other = self._peer(depth=4, evict=100)
+        for i in range(10):
+            p.send(struct.pack(">I", i))
+        assert p.sendq.qsize() == 4
+        assert p.sendq_dropped == 6
+        # OLDEST were shed: the queue holds the newest four
+        held = [struct.unpack(">I", p.sendq.get_nowait())[0]
+                for _ in range(4)]
+        assert held == [6, 7, 8, 9]
+        assert p.alive
+        other.close()
+        p.close()
+
+    def test_consecutive_overflow_evicts(self):
+        p, other = self._peer(depth=2, evict=5)
+        for i in range(12):
+            p.send(b"x" * 8)
+        assert p.evicted and not p.alive
+        other.close()
+
+    def test_successful_send_resets_drop_streak(self):
+        p, other = self._peer(depth=2, evict=3)
+        p.send(b"a")
+        p.send(b"b")
+        p.send(b"c")  # overflow 1
+        p.send(b"d")  # overflow 2
+        p.sendq.get_nowait()
+        p.sendq.get_nowait()  # drain (the writer's job)
+        p.send(b"e")  # success -> streak resets
+        assert p._consec_drops == 0 and not p.evicted
+        p.send(b"f")
+        p.send(b"g")  # overflow 1 again — streak restarted, no eviction
+        assert p.alive
+        other.close()
+        p.close()
+
+
+# -- RPC door pricing ------------------------------------------------------
+
+
+class TestRpcDoorPricing:
+    def _node(self, admin=()):
+        return types.SimpleNamespace(
+            rpc_resources=ResourceManager(admin=set(admin))
+        )
+
+    def test_heavy_client_hits_slowdown(self):
+        from stellard_tpu.rpc.handlers import Role, charge_rpc_client
+
+        node = self._node()
+        refused = None
+        for _ in range(50):
+            refused = charge_rpc_client(node, "9.9.9.9", "sign", Role.GUEST)
+            if refused is not None:
+                break
+        assert refused is not None and refused["error"] == "slowDown"
+        assert node.rpc_resources.dropped >= 1
+        # and the door REFUSES (charge-free) until the balance decays
+        again = charge_rpc_client(node, "9.9.9.9", "server_info",
+                                  Role.GUEST)
+        assert again is not None and node.rpc_resources.refused >= 1
+
+    def test_admin_never_charged(self):
+        from stellard_tpu.rpc.handlers import Role, charge_rpc_client
+
+        node = self._node(admin={"10.0.0.1"})
+        for _ in range(100):
+            assert charge_rpc_client(
+                node, "10.0.0.1", "sign", Role.GUEST
+            ) is None
+            assert charge_rpc_client(
+                node, "9.9.9.9", "sign", Role.ADMIN
+            ) is None
+        assert node.rpc_resources.dropped == 0
+
+    def test_http_door_charges_and_refuses(self):
+        from stellard_tpu.rpc.http_server import process_http_request
+        from stellard_tpu.rpc.handlers import Role
+
+        node = self._node()
+        body = json.dumps({"method": "sign", "params": [{}]}).encode()
+        last = None
+        for _ in range(50):
+            last = process_http_request(
+                node, body, role=Role.GUEST, client_ip="6.6.6.6"
+            )
+            if last["result"].get("error") == "slowDown":
+                break
+        assert last["result"]["error"] == "slowDown"
+
+    def test_malformed_requests_charged(self):
+        from stellard_tpu.rpc.http_server import process_http_request
+        from stellard_tpu.rpc.handlers import Role
+
+        node = self._node()
+        process_http_request(node, b"{not json", role=Role.GUEST,
+                             client_ip="6.6.6.7")
+        assert node.rpc_resources.balance(("6.6.6.7", 0)) > 0
+
+    def test_malformed_path_honors_drop_gate(self):
+        """Review-pass regression: a client past the drop line sending
+        MALFORMED bodies gets slowDown, not normal error processing."""
+        from stellard_tpu.rpc.http_server import process_http_request
+        from stellard_tpu.rpc.handlers import Role
+
+        node = self._node()
+        node.rpc_resources.charge(("6.6.6.8", 0), Charge(10_000, "flood"))
+        r = process_http_request(node, b"{not json", role=Role.GUEST,
+                                 client_ip="6.6.6.8")
+        assert r["result"]["error"] == "slowDown"
+        r = process_http_request(
+            node, json.dumps({"method": 7}).encode(),
+            role=Role.GUEST, client_ip="6.6.6.8",
+        )
+        assert r["result"]["error"] == "slowDown"
+
+    def test_warn_advisory_field_on_served_responses(self):
+        """Review-pass regression: a client in WARN (but not DROP) gets
+        `warning: "load"` attached to served responses — the documented
+        advisory back-off signal."""
+        from stellard_tpu.rpc.http_server import process_http_request
+        from stellard_tpu.rpc.handlers import Role, rpc_warning
+
+        node = self._node()
+        ip = "6.6.6.9"
+        node.rpc_resources.charge((ip, 0), Charge(WARNING_THRESHOLD, "x"))
+        node.rpc_resources.charge((ip, 0), Charge(100, "x"))  # stay warned
+        assert rpc_warning(node, ip, Role.GUEST) == "load"
+        assert rpc_warning(node, ip, Role.ADMIN) is None
+        r = process_http_request(
+            node, json.dumps({"method": "server_info"}).encode(),
+            role=Role.GUEST, client_ip=ip,
+        )
+        assert r["result"].get("warning") == "load"
+
+
+# -- unified peer scoring (catch-up condemnation -> overlay charge) --------
+
+
+class TestCondemnCharge:
+    def test_condemned_transfer_fires_on_condemn(self):
+        from stellard_tpu.node.inbound import SegmentCatchup
+
+        condemned = []
+        sent = []
+        now = [0.0]
+        sc = SegmentCatchup(
+            send=lambda peer, msg: sent.append((peer, msg)),
+            peers=lambda: ["p1", "p2"],
+            store=lambda tb, k, b: None,
+            clock=lambda: now[0],
+            on_condemn=condemned.append,
+        )
+        sc.start()
+        sc.on_manifest("p1", [(0, 64, 64, True)])
+        # one garbage record: key != sha512h(blob)
+        blob = b"\x00garbage"
+        body = bytes([0]) + blob  # type byte + blob
+        rec = (
+            struct.pack("<IB", len(body), 0) + b"\xab" * 32 + body
+        )
+        from stellard_tpu.overlay.wire import SegmentData
+
+        sc.on_data("p1", SegmentData(
+            seg_id=0, total=len(rec), offset=0, data=rec,
+        ))
+        assert condemned == ["p1"]
+        assert sc.counters.get("garbage_peers") == 1
+        # session continues on the OTHER peer (per-peer fallback)
+        assert sc.active and sent[-1][0] == "p2"
+
+    def test_fee_garbage_segment_magnitude(self):
+        # one condemnation lands the endpoint PAST the warning line
+        # (relay/catch-up demotion), a second crosses the DROP line
+        assert FEE_GARBAGE_SEGMENT.cost > WARNING_THRESHOLD
+        assert 2 * FEE_GARBAGE_SEGMENT.cost >= DROP_THRESHOLD
+
+
+# -- real TCP: hostile client vs a live overlay ----------------------------
+
+
+class TestTcpHostileFlood:
+    @pytest.fixture()
+    def victim(self):
+        ports = free_ports_local(1)
+        key = KeyPair.from_passphrase("flood-victim")
+        ov = make_overlay(key, ports[0])
+        ov.start(KeyPair.from_passphrase("masterpassphrase").account_id,
+                 close_time=20_000_000)
+        yield ov
+        ov.stop()
+
+    def test_junk_tx_flood_dropped_and_refused(self, victim):
+        """The byzantine matrix on the REAL TCP net: a handshaked
+        hostile client flooding junk-tx frames is charged per frame,
+        disconnected at the DROP line, and refused readmission."""
+        from stellard_tpu.testkit.tcpnet import hostile_flood
+
+        stats = hostile_flood(victim.port, frames=200, mode="junk_tx")
+        assert stats["disconnected"], stats
+        assert stats["reconnect_refused"], stats
+        j = victim.resources.get_json()
+        assert j["dropped"] >= 1 and j["disconnects"] >= 1
+        assert j["refused"] >= 1
+        assert not victim.resources.should_admit(("127.0.0.1", 0))
+
+    def test_charge_peer_unifies_catchup_scoring(self, victim):
+        """charge_peer (the SegmentCatchup condemnation seam) demotes a
+        live peer out of segment_peers at WARN and disconnects at
+        DROP."""
+        import socket as _socket
+
+        from stellard_tpu.overlay.tcp import _Peer
+
+        a, b = _socket.socketpair()
+        peer = _Peer(a, inbound=True)
+        peer.node_public = b"\x02" + b"\x77" * 32
+        peer.remote = ("10.1.1.1", 9999)
+        with victim._peers_lock:
+            victim.peers[peer.node_public] = peer
+        assert victim.segment_peers() == [peer.node_public]
+        assert victim.charge_peer(
+            peer.node_public, FEE_GARBAGE_SEGMENT
+        ) == Disposition.WARN
+        assert victim.segment_peers() == []  # catch-up privilege gone
+        assert victim.charge_peer(
+            peer.node_public, FEE_GARBAGE_SEGMENT
+        ) == Disposition.DROP
+        assert not peer.alive  # relay/admission gone with it
+        with victim._peers_lock:
+            victim.peers.pop(peer.node_public, None)
+        b.close()
+
+
+def free_ports_local(n: int) -> list[int]:
+    import socket as _socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def make_overlay(key, port):
+    from stellard_tpu.overlay.tcp import TcpOverlay
+
+    t0 = time.monotonic()
+    clock = lambda: (time.monotonic() - t0) * 5.0  # noqa: E731
+    return TcpOverlay(
+        key=key,
+        unl={key.public},
+        quorum=1,
+        port=port,
+        peer_addrs=[],
+        network_time=lambda: 20_000_000 + int(clock()),
+        clock=clock,
+        timer_interval=0.2,
+        idle_interval=4,
+    )

@@ -185,7 +185,11 @@ class TestNewHandlers:
                     account=ALICE.human_account_id)["error"] == "actNotFound"
         assert call(node, "unl_network")["message"]
         assert call(node, "connect", ip="127.0.0.1")["error"] == "notSynced"
-        assert call(node, "blacklist") == {"blacklist": {}}
+        # no overlay (standalone): empty peer table; the RPC-client
+        # charge plane reports its (empty) balance table alongside
+        bl = call(node, "blacklist")
+        assert bl["blacklist"] == {}
+        assert bl["rpc"]["entries"] == {} and bl["rpc"]["dropped"] == 0
         assert call(node, "log_rotate")["message"]
 
     def test_account_tx_old_shape(self, node):

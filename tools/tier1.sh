@@ -72,6 +72,20 @@ if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/scenariosmoke.py; then
   exit 2
 fi
 
+echo "== overlay flood smoke gate (200-peer simnet, byzantine flooder -> DROP, squelch bound) =="
+# runs the flood_survival scenario (5-validator core + 195 relay peers,
+# squelched relay, enforced resource pricing, one hostile flooder)
+# twice on one seed: honest validators converge on ONE hash with the
+# full workload committed, the flooder's endpoint reaches DROP at every
+# flooded neighbor and is refused readmission (resource.* counters),
+# relay fan-out stays <= squelch_size + |UNL| (never the peer count),
+# close cadence holds within 25% of the no-flooder baseline, and the
+# scorecards are byte-identical across runs
+if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/floodsmoke.py; then
+  echo "FLOOD SMOKE FAILED — overlay defense plane is broken" >&2
+  exit 2
+fi
+
 echo "== follower read-plane smoke gate (leader+follower over TCP, identity + serving) =="
 # boots a solo leader validator and a cold follower over a real TCP
 # peer link, floods the leader, and asserts: follower ledger hashes
