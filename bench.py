@@ -1831,6 +1831,38 @@ def bench_scenario_matrix(backends):
         _emit(line)
 
 
+def bench_scenario_fuzz(backends):
+    """Scenario-search leg (ROADMAP item 5): coverage-guided vs uniform
+    random scenario generation over the same seeded budget — distinct
+    scorecard DYNAMICS states reached per N runs (testkit.search's
+    coverage map). The novelty bias must at least match uniform
+    sampling (vs_baseline = guided/uniform distinct states, >= 1.0 is
+    the pass line; tools/scenariofuzz.py --smoke gates the same
+    comparison in tier-1). Also records invariant violations found per
+    arm — on a healthy tree both are 0; anything else is a bug the
+    fuzz smoke will be failing on. Deterministic per seed."""
+    from stellard_tpu.testkit.search import coverage_comparison
+
+    seed = int(os.environ.get("BENCH_FUZZ_SEED", "7"))
+    n = int(os.environ.get("BENCH_FUZZ_N", "30"))
+    t0 = time.perf_counter()
+    cmp = coverage_comparison(seed, n)
+    _emit({
+        "metric": "scenario_fuzz_coverage",
+        "value": cmp["guided_distinct"],
+        "unit": "distinct_states",
+        "vs_baseline": round(
+            cmp["guided_distinct"] / max(1, cmp["uniform_distinct"]), 3
+        ),
+        "seed": seed,
+        "runs_per_arm": n,
+        "uniform_distinct": cmp["uniform_distinct"],
+        "guided_violations": cmp["guided_violations"],
+        "uniform_violations": cmp["uniform_violations"],
+        "wall_s": round(time.perf_counter() - t0, 1),
+    })
+
+
 def bench_overlay_fanin(backends):
     """Overlay fan-in leg (ISSUE 11): the flood_survival scenario at
     100 vs 1000 simnet nodes — 5-validator core, relay-peer tier,
@@ -2293,6 +2325,7 @@ def main() -> None:
             bench_consensus_close,
             bench_replay,
             bench_scenario_matrix,
+            bench_scenario_fuzz,
             bench_overlay_fanin,
             bench_follower_fanout,
         ):

@@ -506,7 +506,9 @@ class LedgerConsensus:
 
         if self.voting is not None:
             self.voting.on_ledger_closed(new_lcl)
-        if self.proposing:
+        if self.proposing and self.validations.can_sign(new_lcl.seq):
+            # can_sign: never a second validation at a seq we already
+            # voted (fork repair abstains; see ValidationsStore)
             extra = (
                 self.voting.validation_fields(new_lcl)
                 if self.voting is not None

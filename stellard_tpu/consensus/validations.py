@@ -36,6 +36,13 @@ class ValidationsStore:
         self.by_ledger: dict[bytes, dict[bytes, STValidation]] = {}
         # signer -> its latest current validation
         self.current: dict[bytes, STValidation] = {}
+        # highest ledger seq WE have signed a validation for
+        # (reference: Validations::canValidateSeq — a validator's issued
+        # seqs are strictly increasing, so fork repair can never make an
+        # honest key sign two different ledgers at one seq; without this
+        # two overlapping "quorums" could validate different ledgers at
+        # one seq, a fork the scenario fuzzer actually reached)
+        self.local_high_seq = 0
 
     def _is_current(self, val: STValidation, now: int) -> bool:
         """reference: isCurrent — reject far-future and stale signing
@@ -52,6 +59,8 @@ class ValidationsStore:
         now = self.now()
         current = self._is_current(val, now)
         note = self.note_byzantine if not local else None
+        if local and val.ledger_seq is not None:
+            self.local_high_seq = max(self.local_high_seq, val.ledger_seq)
         with self._lock:
             per_signer = self.by_ledger.setdefault(val.ledger_hash, {})
             dup = (
@@ -91,6 +100,13 @@ class ValidationsStore:
             # zero electoral weight
             note("stale_validation", peer=val.signer)
         return False
+
+    def can_sign(self, seq: Optional[int]) -> bool:
+        """May WE issue a validation for this seq? Strictly increasing
+        issued seqs (reference: canValidateSeq) — after fork repair a
+        validator abstains at seqs it already voted rather than signing
+        a second, conflicting statement."""
+        return seq is None or seq > self.local_high_seq
 
     def _trim(self) -> None:
         while len(self.by_ledger) > self.max_ledgers:

@@ -50,7 +50,8 @@ W_STATE_TREE = 2
 class InboundLedger:
     """One acquisition session (reference: InboundLedger.cpp:93-265)."""
 
-    def __init__(self, ledger_hash: bytes, hash_batch: Optional[Callable] = None):
+    def __init__(self, ledger_hash: bytes, hash_batch: Optional[Callable] = None,
+                 now: Optional[float] = None):
         import time as _time
 
         self.hash = ledger_hash
@@ -60,7 +61,7 @@ class InboundLedger:
         self.tx_map: Optional[IncompleteMap] = None
         self.state_map: Optional[IncompleteMap] = None
         self.failed = False
-        self.created_at = _time.monotonic()
+        self.created_at = _time.monotonic() if now is None else now
         self.last_progress = self.created_at
         # True when the LCL catch-up path requested this ledger; repair
         # acquisitions (LedgerCleaner) must NEVER route through LCL
@@ -200,8 +201,15 @@ class InboundLedgers:
 
     def __init__(self, send: Callable[[GetLedger], None],
                  hash_batch: Optional[Callable] = None,
-                 local_fetch: Optional[Callable[[bytes], Optional[bytes]]] = None):
+                 local_fetch: Optional[Callable[[bytes], Optional[bytes]]] = None,
+                 clock: Optional[Callable[[], float]] = None):
+        import time as _time
+
         self.send = send  # broadcast/anycast a GetLedger to peers
+        # progress/expiry clock: the NODE's clock (virtual on the
+        # deterministic simnet — wall-clock deadlines never fire there,
+        # which once let a dead acquisition pin LCL catch-up forever)
+        self.clock = clock if clock is not None else _time.monotonic
         self.hash_batch = hash_batch
         # optional hash -> prefix-blob lookup into local storage so
         # acquisitions only fetch the DELTA over the wire
@@ -221,9 +229,7 @@ class InboundLedgers:
     RECENT_CAP = 256
 
     def _mark_recent(self, ledger_hash: bytes) -> None:
-        import time as _time
-
-        now = _time.monotonic()
+        now = self.clock()
         self._recent.pop(ledger_hash, None)  # re-insert at newest position
         self._recent[ledger_hash] = now
         if len(self._recent) > self.RECENT_CAP:
@@ -237,10 +243,8 @@ class InboundLedgers:
                 del self._recent[next(iter(self._recent))]
 
     def recently_done(self, ledger_hash: bytes) -> bool:
-        import time as _time
-
         t = self._recent.get(ledger_hash)
-        return t is not None and _time.monotonic() - t < self.RECENT_TTL
+        return t is not None and self.clock() - t < self.RECENT_TTL
 
     def acquire(
         self, ledger_hash: bytes, callback: Optional[Callable] = None,
@@ -255,7 +259,8 @@ class InboundLedgers:
         if callback is not None:
             self._callbacks.setdefault(ledger_hash, []).append(callback)
         if il is None:
-            il = InboundLedger(ledger_hash, self.hash_batch)
+            il = InboundLedger(ledger_hash, self.hash_batch,
+                               now=self.clock())
             il.for_lcl = for_lcl
             self.live[ledger_hash] = il
             self.trigger(il)
@@ -284,9 +289,7 @@ class InboundLedgers:
                 if blob is not None:
                     il.take_header(strip_ledger_prefix(blob))
             if il.header is not None and il.resolve_local(self.local_fetch):
-                import time as _time
-
-                il.last_progress = _time.monotonic()
+                il.last_progress = self.clock()
             if self._finish(il):
                 return
         for req in il.next_requests():
@@ -318,10 +321,9 @@ class InboundLedgers:
         """Drop acquisitions that made no progress for `max_age_s` —
         unserveable requests (e.g. history no peer holds) must not pin
         sessions and re-broadcast forever (reference: PeerSet failure
-        timeouts). Returns the number expired."""
-        import time as _time
-
-        now = _time.monotonic()
+        timeouts). Runs on the injected clock (virtual on the simnet).
+        Returns the number expired."""
+        now = self.clock()
         stale = [
             h
             for h, il in self.live.items()
@@ -352,9 +354,7 @@ class InboundLedgers:
         else:
             progressed = il.take_nodes(msg.what, msg.nodes)
         if progressed:
-            import time as _time
-
-            il.last_progress = _time.monotonic()
+            il.last_progress = self.clock()
         if self._finish(il):
             return max(progressed, 1) if not il.failed else progressed
         if progressed:
@@ -390,20 +390,26 @@ def serve_get_ledger(ledger: Optional[Ledger], msg: GetLedger) -> Optional[Ledge
         node = _descend(tree, nid)
         if node is None:
             continue
-        nodes.append((nid.encode(), serialize_node_prefix(node)))
-        # FAT reply (reference: fetch-pack / 'fat' related-node serving):
-        # include one extra level under each served inner node, budget-
-        # bounded — the acquirer's frontier matching consumes multi-level
-        # replies, so each round trip moves the sync two levels
-        if hasattr(node, "children") and len(nodes) < MAX_REPLY_NODES:
-            for branch, child in enumerate(node.children):
-                if child is None:
-                    continue
-                if len(nodes) >= MAX_REPLY_NODES:
-                    break
-                nodes.append(
-                    (nid.child(branch).encode(), serialize_node_prefix(child))
-                )
+        # FAT reply (reference: fetch-pack / 'fat' related-node
+        # serving): greedy preorder DFS under each requested node,
+        # budget-bounded. Preorder guarantees every child lands AFTER
+        # its parent in the reply, so the acquirer's frontier matching
+        # consumes the whole pack in one pass. Depth-first (not one
+        # level) matters: order-book directory keys share 24-byte
+        # prefixes, so state trees carry ~48-nibble single-child chain
+        # paths — serving one level per round trip made deep-tree
+        # catch-up structurally slower than the close cadence (a
+        # scenario-fuzzer find: a revived validator could NEVER catch
+        # up under an order-book workload).
+        stack = [(nid, node)]
+        while stack and len(nodes) < MAX_REPLY_NODES:
+            cur_id, cur = stack.pop()
+            nodes.append((cur_id.encode(), serialize_node_prefix(cur)))
+            if hasattr(cur, "children"):
+                for branch in range(len(cur.children) - 1, -1, -1):
+                    child = cur.children[branch]
+                    if child is not None:
+                        stack.append((cur_id.child(branch), child))
     if not nodes:
         return None
     return LedgerData(msg.ledger_hash, ledger.seq, msg.what, nodes)

@@ -192,7 +192,16 @@ class LedgerMaster:
     def _push_closed(self, ledger: Ledger) -> None:
         self.closed = ledger
         h = ledger.hash()
-        self.ledger_history[ledger.seq] = h
+        # the validated chain is AUTHORITATIVE for its index slots: a
+        # stale round churning out a late close at an already-validated
+        # seq (fork-repair flapping) must not clobber the validated
+        # entry — its validation is already refused by can_sign, and
+        # the history index must stay the validated truth (scenario-
+        # fuzzer find: honest histories permanently disagreed after a
+        # partition healed through competing branches)
+        floor = self.validated.seq if self.validated is not None else 0
+        if ledger.seq > floor or self.ledger_history.get(ledger.seq) is None:
+            self.ledger_history[ledger.seq] = h
         if len(self.ledger_history) > 8192:
             # bound the seq index too; full history stays in txdb/nodestore
             del self.ledger_history[min(self.ledger_history)]
@@ -754,15 +763,7 @@ class LedgerMaster:
         full two-tree Ledger.load under the master lock — and stops at
         the validated floor, which no switch may rewrite."""
         floor = self.validated.seq if self.validated is not None else 0
-
-        def resolve(h: bytes) -> Optional[tuple[int, bytes]]:
-            led = self.ledgers_by_hash.get(h)
-            if led is not None:
-                return led.seq, led.parent_hash
-            if self.header_fetch is not None:
-                return self.header_fetch(h)
-            return None
-
+        resolve = self._resolve_header
         cur_hash = ledger.parent_hash
         confirmed_down_to = ledger.seq
         while True:
@@ -791,18 +792,64 @@ class LedgerMaster:
         while len(self.ledger_history) > 8192:
             del self.ledger_history[min(self.ledger_history)]
 
+    def _resolve_header(self, h: bytes) -> Optional[tuple[int, bytes]]:
+        """(seq, parent_hash) for a ledger hash, from the in-memory
+        cache or the LIGHT header fetch — never a full two-tree load
+        under the master lock."""
+        led = self.ledgers_by_hash.get(h)
+        if led is not None:
+            return led.seq, led.parent_hash
+        if self.header_fetch is not None:
+            return self.header_fetch(h)
+        return None
+
     def set_validated(self, ledger: Ledger) -> None:
         """A quorum of trusted validations arrived for this ledger
         (reference: LedgerMaster::checkAccept tail, :705-750)."""
         with self._lock:
             if self.validated is not None and ledger.seq <= self.validated.seq:
                 return
+            prev_floor = (
+                self.validated.seq if self.validated is not None else 0
+            )
             self.validated = ledger
             # a quorum-validated ledger is the strongest possible signal
             # for its index slot: repair any orphan entry left by a fork
             # healed without an LCL switch (LedgerHistory mismatch role)
             self.ledger_history[ledger.seq] = ledger.hash()
             self.ledgers_by_hash.put(ledger.hash(), ledger)
+            # and for every slot it SKIPPED: when validation jumps a
+            # seq range (contested rounds, a revived node), the new
+            # tip's ancestry is authoritative for the gap — without
+            # this, a node that closed an orphan inside the gap served
+            # that orphan from its history forever (scenario-fuzzer
+            # find: honest histories permanently disagreed at a seq
+            # below the validated floor)
+            # bounded: never walk (or grow the index) past the 8192
+            # history bound — a cold node whose first validation lands
+            # at a high seq must not do seq-many header reads under
+            # the master lock
+            prev_floor = max(prev_floor, ledger.seq - 256)
+            cur_hash = ledger.parent_hash
+            seq = ledger.seq - 1
+            while seq > prev_floor:
+                self.ledger_history[seq] = cur_hash
+                info = self._resolve_header(cur_hash)
+                if info is None:
+                    # deeper ancestry unresolvable from memory/headers:
+                    # any remaining gap entries are unconfirmable —
+                    # probably this node's own orphan-branch closes from
+                    # before the jump. Same policy as the switch_lcl
+                    # repair: serving NOTHING beats serving a hash the
+                    # network never validated (re-resolvable later via
+                    # stored history / LedgerCleaner).
+                    for s in range(prev_floor + 1, seq):
+                        self.ledger_history.pop(s, None)
+                    break
+                _seq, cur_hash = info
+                seq -= 1
+            while len(self.ledger_history) > 8192:
+                del self.ledger_history[min(self.ledger_history)]
         if self.on_validated:
             self.on_validated(ledger)
 

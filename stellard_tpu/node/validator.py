@@ -137,6 +137,10 @@ class ValidatorNode:
         self._peer_prevs: dict[bytes, tuple[bytes, int]] = {}
         self._lcl_candidate: Optional[bytes] = None  # election hysteresis
         self._lcl_acquiring: Optional[bytes] = None  # single-flight catch-up
+        # highest trusted-validation seq seen for the pinned target when
+        # the session started — the election retargets past a transfer
+        # the net has clearly outrun (see _check_lcl)
+        self._lcl_acquiring_seq: Optional[int] = None
         self._tick = 0
         # fired for EVERY ledger that becomes our LCL — locally-closed
         # rounds AND catch-up adoptions — so the persistence plane never
@@ -148,11 +152,19 @@ class ValidatorNode:
         self.rounds_completed = 0
         # peer tx sets seen this round (simnet share / TMHaveTransactionSet)
         self.txset_cache: dict[bytes, TxSet] = {}
+        # recent trusted proposals, stashed ACROSS rounds (reference:
+        # Consensus::recentPeerPositions_ + playbackProposals): a node
+        # that adopts the network LCL mid-round must be able to replay
+        # the positions that flew by BEFORE its begin_round, or it sits
+        # in the round alone, closes a late solo ledger, and diverges —
+        # the scenario fuzzer's catch-up limit cycle (fuzz_convergence)
+        self._recent_proposals: dict[bytes, list] = {}
         # catch-up: ledger acquisition sessions (reference: InboundLedgers)
         from .inbound import InboundLedgers
 
         self.inbound = InboundLedgers(
-            send=adapter.request_ledger_data, hash_batch=hash_batch
+            send=adapter.request_ledger_data, hash_batch=hash_batch,
+            clock=clock,
         )
         self.inbound.on_complete = self._ledger_acquired
         # segment-granular catch-up plane (node/inbound.SegmentCatchup):
@@ -197,6 +209,11 @@ class ValidatorNode:
             except Exception:  # noqa: BLE001 — bookkeeping must not
                 pass           # interfere with message handling
 
+    # how long a live LCL acquisition may sit with NO progress before
+    # the election may retarget past it (node clock: seconds on a real
+    # node, virtual steps on the simnet — roughly two rounds)
+    ACQ_PIN_S = 10.0
+
     # -- lifecycle --------------------------------------------------------
 
     def start(self, root_account_id: bytes, close_time: int = 0) -> None:
@@ -210,7 +227,9 @@ class ValidatorNode:
             # adopting validated ledgers (the catch-up/tailing path)
             self.round = None
             return
-        self.txset_cache.clear()
+        # txsets stay cached ACROSS rounds (bounded in handle_txset):
+        # a late joiner replaying stashed proposals needs the candidate
+        # set that was shared before its begin_round
         self.round = LedgerConsensus(
             prev_ledger=self.lm.closed_ledger(),
             ledger_master=self.lm,
@@ -228,6 +247,16 @@ class ValidatorNode:
             voting=self.voting,
             note_byzantine=self.note_byzantine,
         )
+        # playback (reference: Consensus::playbackProposals): replay
+        # stashed positions that belong to THIS round's prior ledger.
+        # Sorted by signer so replay order never leaks PYTHONHASHSEED
+        # into round state (the PR 8 dispute-order lesson).
+        now = self.network_time()
+        for pub in sorted(self._recent_proposals):
+            for when, prop in self._recent_proposals[pub]:
+                if now - when <= 60 and \
+                        prop.prev_ledger == self.round.prev_hash:
+                    self.round.peer_proposal(prop)
 
     @_locked
     def on_timer(self) -> None:
@@ -318,10 +347,14 @@ class ValidatorNode:
         # validations, NetworkOPs.cpp:776-925)
         floor = self.lm.validated.seq if self.lm.validated is not None else 0
         val_votes: dict[bytes, int] = {}
+        val_seq: dict[bytes, int] = {}
         for v in self.validations.current_trusted():
             if v.ledger_seq is None or v.ledger_seq <= floor:
                 continue
             val_votes[v.ledger_hash] = val_votes.get(v.ledger_hash, 0) + 1
+            val_seq[v.ledger_hash] = max(
+                val_seq.get(v.ledger_hash, 0), v.ledger_seq
+            )
         # peer-LCL votes from current proposals (the reference's
         # nodesUsing, NetworkOPs.cpp:821-843) — these break a symmetric
         # validation split (every closed chain diverged 1-1-...-1) that
@@ -345,7 +378,19 @@ class ValidatorNode:
         if key(best) <= key(ours_hash):  # covers best == ours_hash
             self._lcl_candidate = None
             return
-        if self._lcl_candidate != best and not self.follower:
+        # hysteresis bypass when we are clearly LAGGING: the two-tick
+        # confirm protects a healthy node's mid-accept transient, where
+        # peer validations momentarily beat its own same-seq close. A
+        # candidate >= 2 seqs ahead of our closed chain is not that
+        # transient — it is catch-up, and paying the hysteresis there
+        # put a straggler in a permanent limit cycle: elect -> confirm
+        # -> acquire -> adopt costs one full round, so it tracked the
+        # net at a constant 2-ledger offset and a high-quorum net
+        # (e.g. 5-of-6 after an even partition healed) could never
+        # re-assemble a validation quorum on one seq (found by the
+        # scenario fuzzer; corpus fuzz_convergence pins it)
+        lagging = val_seq.get(best, 0) >= ours.seq + 2
+        if self._lcl_candidate != best and not self.follower and not lagging:
             # hysteresis: confirm next tick. A follower skips it — it
             # never closes rounds of its own, so there is no healthy
             # mid-accept transient to protect, and tailing latency is
@@ -375,10 +420,32 @@ class ValidatorNode:
             cur = self._lcl_acquiring
             if cur is not None and cur in self.inbound.live:
                 il = self.inbound.live[cur]
-                if cur == best or il.header is not None:
+                # the pin holds only while the session is (a) still
+                # progressing, (b) not already resolvable locally (we
+                # may have closed/acquired the target through another
+                # path since), and (c) chasing a target the election
+                # has not left far behind. Violating any of these held
+                # a node hostage to a moot transfer — the scenario
+                # fuzzer caught a validator wedged ~70 rounds acquiring
+                # a deep order-book tree for its OWN orphaned close
+                # while the net validated 6 seqs past it.
+                fresh = (
+                    self.clock() - il.last_progress <= self.ACQ_PIN_S
+                )
+                have_local = self.lm.get_ledger_by_hash(cur) is not None
+                superseded = (
+                    self._lcl_acquiring_seq is not None
+                    and val_seq.get(best, 0)
+                    > self._lcl_acquiring_seq + 2
+                )
+                if (
+                    (cur == best or il.header is not None)
+                    and fresh and not have_local and not superseded
+                ):
                     return
                 self.inbound.abandon(cur)
             self._lcl_acquiring = best
+            self._lcl_acquiring_seq = val_seq.get(best)
             self.inbound.acquire(best, for_lcl=True)
             # a cold/lagging node kicking off catch-up also starts the
             # segment bulk transfer: whole store segments land locally
@@ -628,6 +695,13 @@ class ValidatorNode:
                 self._peer_prevs[prop.node_public] = (
                     prop.prev_ledger, self.network_time()
                 )
+                # stash for playback into a later begin_round (bounded
+                # per signer; see _recent_proposals)
+                stash = self._recent_proposals.setdefault(
+                    prop.node_public, []
+                )
+                stash.append((self.network_time(), prop))
+                del stash[:-8]
             if self.round is None:
                 return False
             return self.round.peer_proposal(prop)
@@ -756,7 +830,12 @@ class ValidatorNode:
         """A shared/acquired candidate set arrived
         (reference: TMHaveTransactionSet/TransactionAcquire completion)."""
         h = txset.hash()
+        self.txset_cache.pop(h, None)  # refresh insertion order
         self.txset_cache[h] = txset
+        while len(self.txset_cache) > 16:
+            # bounded cross-round cache (was: cleared per round; late
+            # round joins need the sets shared before their begin_round)
+            del self.txset_cache[next(iter(self.txset_cache))]
         if self.round is not None:
             self.round.have_tx_set(h, txset)
 

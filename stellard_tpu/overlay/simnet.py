@@ -77,6 +77,7 @@ class SimValidator(ConsensusAdapter):
         idle_interval: int,
         proposing: bool = True,
         voting=None,
+        follower: bool = False,
     ):
         self.net = net
         self.nid = nid
@@ -100,6 +101,7 @@ class SimValidator(ConsensusAdapter):
             idle_interval=idle_interval,
             proposing=proposing,
             voting=voting,
+            follower=follower,
         )
 
     # -- ConsensusAdapter -------------------------------------------------
@@ -399,6 +401,7 @@ class SimNet:
         squelch_size: int = 0,
         squelch_rotate: int = SQUELCH_ROTATE,
         resources: bool = False,
+        n_followers: int = 0,
     ):
         self.step_ms = step_ms
         self.latency_ms = latency_steps * step_ms
@@ -451,7 +454,22 @@ class SimNet:
         self.peers = [
             RelayPeer(self, n_validators + j) for j in range(n_peers)
         ]
-        self.nodes: list = list(self.validators) + list(self.peers)
+        # follower tier ([node] mode=follower, the PR 9 read plane):
+        # non-consensus full nodes (nids after the relay tier) whose
+        # chains advance ONLY by ingesting trusted validations and
+        # acquiring the validated ledgers — scenarios partition/kill
+        # them like any node and assert they end on the honest chain
+        self.followers = [
+            SimValidator(
+                self, n_validators + n_peers + j,
+                KeyPair.from_passphrase(f"sim-follower-{j}"),
+                unl, q, idle_interval, follower=True,
+            )
+            for j in range(n_followers)
+        ]
+        self.nodes: list = (
+            list(self.validators) + list(self.peers) + list(self.followers)
+        )
         # validator-message squelching (0 = full flood, byte-for-byte
         # today's behavior — the [overlay] squelch=0 kill-switch)
         self.squelch_size = squelch_size
@@ -617,6 +635,14 @@ class SimNet:
         fault = self._link_faults.get((src, dst))
         copies = 1
         if fault is not None:
+            # exposure evidence for the scenario plane's anti-vacuity
+            # check: the fault was ARMED on live traffic (whether any
+            # message then dropped/duplicated is probabilistic — a
+            # lucky streak must not read as a silently-dead fault).
+            # Key materializes lazily so legacy nets keep their shape.
+            self.net_stats["fault_exposed"] = (
+                self.net_stats.get("fault_exposed", 0) + 1
+            )
             if fault["drop"] and self.rng.random() < fault["drop"]:
                 self.net_stats["dropped_fault"] += 1
                 return
@@ -649,6 +675,8 @@ class SimNet:
         root = self.genesis_account
         for v in self.validators:
             v.node.start(root, close_time=self.network_time())
+        for f in self.followers:
+            f.node.start(root, close_time=self.network_time())
 
     def step(self, n: int = 1) -> None:
         for _ in range(n):
@@ -665,6 +693,9 @@ class SimNet:
             for v in self.validators:
                 if v.nid not in self._down:
                     v.node.on_timer()
+            for f in self.followers:
+                if f.nid not in self._down:
+                    f.node.on_timer()
 
     def run_until(
         self, pred: Callable[[], bool], max_steps: int = 200

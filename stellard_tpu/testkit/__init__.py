@@ -19,7 +19,7 @@ injection; this package makes those paths a regression-gated surface
 
 from .schedule import FaultSchedule
 from .scenario import Scenario, run_simnet
-from .scenarios import MATRIX, build_scenario
+from .scenarios import MATRIX, build_scenario, corpus_scenarios, load_corpus
 
 __all__ = [
     "FaultSchedule",
@@ -27,4 +27,6 @@ __all__ = [
     "run_simnet",
     "MATRIX",
     "build_scenario",
+    "load_corpus",
+    "corpus_scenarios",
 ]

@@ -28,7 +28,7 @@ Scorecard fields (doc/scenarios.md):
 from __future__ import annotations
 
 import random
-from dataclasses import dataclass, field as dc_field
+from dataclasses import dataclass, field as dc_field, fields as dc_fields
 from typing import Callable, Optional
 
 from ..engine.engine import TxParams
@@ -37,9 +37,20 @@ from ..overlay.wire import frame
 from ..protocol.sttx import SerializedTransaction
 from ..protocol.ter import TER
 from .schedule import FaultSchedule
-from .workloads import TxFactory
+from .workloads import TxFactory, build_spec_workload
 
-__all__ = ["Scenario", "run_simnet", "apply_event"]
+__all__ = ["Scenario", "run_simnet", "apply_event", "SYNTH_BUG"]
+
+# Test-only planted bug (the fuzz gate's ground truth): while armed,
+# every replayed `synth_plant` fault event accumulates its magnitude on
+# the net, the scorecard reports it under "synth", and the search
+# plane's `synthetic_bug` invariant fires at total >= 3. The sweep must
+# FIND a violating schedule and SHRINK it to the known minimum (two
+# plant events, magnitudes summing to exactly 3); disarming is "the
+# fix" — the same corpus entry must then replay clean. Never armed in
+# production scenarios; tools/scenariofuzz.py --smoke and the tests arm
+# it around their sweeps.
+SYNTH_BUG = {"armed": False}
 
 
 @dataclass
@@ -54,6 +65,14 @@ class Scenario:
     # builders: called with (schedule, scenario) / (factory, rng, scenario)
     build_schedule: Optional[Callable] = None
     build_workload: Optional[Callable] = None
+    # DATA forms of the two builders (lossless to_json/from_json needs
+    # schedules and workloads as data, not closures): a pre-built
+    # FaultSchedule replayed as-is, and a named-workload spec
+    # ({"kind": <workloads.WORKLOADS name>, "n": N, ...}) interpreted by
+    # build_spec_workload. Builders and data forms compose (events
+    # merge; build_workload wins over workload when both are set).
+    schedule: Optional[FaultSchedule] = None
+    workload: Optional[dict] = None
     # nid -> behavior tuple (testkit.byzantine.BEHAVIORS subset)
     byzantine: dict = dc_field(default_factory=dict)
     # production fan-in plane (ISSUE 11): a lightweight relay-peer tier
@@ -87,10 +106,70 @@ class Scenario:
     # HASH IDENTITY: the final chain must match the workers=1 run of
     # the same seed byte-for-byte (tools/scenariosmoke.py).
     spec_workers: int = 1
+    # follower read-plane tier (PR 9): n_followers non-consensus full
+    # nodes (nids after the relay tier) ingesting the validated chain;
+    # the scorecard's `followers` block carries their sync evidence
+    n_followers: int = 0
     # convergence tail
     converge_extra: int = 2
     max_tail_steps: int = 240
     transports: tuple = ("simnet",)
+
+    # -- serialization (corpus entries / the shrinker need scenarios as
+    #    data; digest-pinned round trip) ---------------------------------
+
+    def to_json(self) -> dict:
+        """Lossless JSON form. Raises if the scenario still carries
+        closure builders (``build_schedule``/``build_workload``) — only
+        the data forms (``schedule``/``workload``) serialize."""
+        if self.build_schedule is not None or self.build_workload is not None:
+            raise ValueError(
+                "scenario carries closure builders; only data-form "
+                "scenarios (schedule=/workload=) serialize"
+            )
+        out = {}
+        for f in dc_fields(self):
+            if f.name in ("build_schedule", "build_workload"):
+                continue
+            v = getattr(self, f.name)
+            if f.name == "schedule":
+                v = v.to_json() if v is not None else None
+            elif f.name == "byzantine":
+                v = {str(k): list(bs) for k, bs in sorted(v.items())}
+            elif f.name == "flooders":
+                v = {str(k): dict(sp) for k, sp in sorted(v.items())}
+            elif isinstance(v, tuple):
+                v = list(v)
+            out[f.name] = v
+        return out
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "Scenario":
+        kw = dict(obj)
+        if kw.get("schedule") is not None:
+            kw["schedule"] = FaultSchedule.from_json(kw["schedule"])
+        kw["byzantine"] = {
+            int(k): tuple(bs)
+            for k, bs in (kw.get("byzantine") or {}).items()
+        }
+        kw["flooders"] = {
+            int(k): dict(sp)
+            for k, sp in (kw.get("flooders") or {}).items()
+        }
+        for name in ("cold_nodes", "transports"):
+            if name in kw:
+                kw[name] = tuple(kw[name])
+        known = {f.name for f in dc_fields(cls)}
+        return cls(**{k: v for k, v in kw.items() if k in known})
+
+    def digest(self) -> str:
+        """Stable digest of the whole scenario-as-data (round-trip and
+        cross-process determinism pins compare this)."""
+        import hashlib
+        import json
+
+        blob = json.dumps(self.to_json(), sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
 
 
 def apply_event(net: SimNet, ev) -> None:
@@ -109,6 +188,14 @@ def apply_event(net: SimNet, ev) -> None:
         net.set_link_fault(ev.args[0], ev.args[1], **kw)
     elif ev.kind == "clear_link_fault":
         net.clear_link_fault(ev.args[0], ev.args[1])
+    elif ev.kind == "synth_plant":
+        # test-only planted bug (see SYNTH_BUG): a no-op on the network,
+        # but while armed it accumulates scorecard evidence the search
+        # plane's synthetic_bug invariant trips on
+        if SYNTH_BUG["armed"]:
+            net.synth_planted = (
+                getattr(net, "synth_planted", 0) + int(ev.args[0])
+            )
     else:
         raise ValueError(f"unknown fault kind {ev.kind!r}")
 
@@ -212,13 +299,84 @@ def _attach_txqs(net: SimNet, scn: Scenario) -> dict:
     return txqs
 
 
+_CLIENT_RETRY_STEPS = 4
+
+# admission outcomes a synchronous client retries: local shed (fee
+# escalation), account not yet on-ledger (its funding is still in the
+# queue), balance-bound chain refusal. terQUEUED/tes are successes;
+# tem/tef are permanent.
+_CLIENT_RETRY_TERS = frozenset((
+    int(TER.telINSUF_FEE_P), int(TER.terNO_ACCOUNT),
+    int(TER.terINSUF_FEE_B),
+))
+
+
+def _client_should_retry(got: Optional[tuple]) -> bool:
+    return got is None or int(got[0]) in _CLIENT_RETRY_TERS
+
+
+def _outcome_rank(ter: int, applied: bool) -> int:
+    """Admission-outcome precedence for the fairness record: a tx's
+    final story is the BEST outcome its reporting gate ever gave it
+    (applied > queued > final reject > retryable shed) — a shed that
+    later re-admits as terQUEUED must count as queued, or retry-heavy
+    scenarios under-count the queue and skew the starvation ratio."""
+    if applied:
+        return 3
+    if ter == int(TER.terQUEUED):
+        return 2
+    if ter in _CLIENT_RETRY_TERS:
+        return 0
+    return 1
+
+
+def _record_admission(admissions: dict, gate_of: dict, gate: int,
+                      tx: SerializedTransaction, ter: int,
+                      applied: bool) -> None:
+    """Record/upgrade the admission story of one tx as seen at its
+    REPORTING gate (the first live validator that answered; retries at
+    that gate upgrade the record by outcome precedence)."""
+    txid = tx.txid()
+    if gate_of.setdefault(txid, gate) != gate:
+        return
+    new = (int(ter), bool(applied), tx.fee.mantissa, tx.account,
+           tx.sequence)
+    old = admissions.get(txid)
+    if old is None or _outcome_rank(new[0], new[1]) >= \
+            _outcome_rank(old[0], old[1]):
+        admissions[txid] = new
+
+
+def _admit_at(net: SimNet, txqs: dict, i: int,
+              blob: bytes) -> Optional[tuple]:
+    """Admit one client tx copy at validator i's gate; None while the
+    validator is down. Returns (ter, applied, parsed copy)."""
+    if net.is_down(i):
+        return None
+    copy = SerializedTransaction.from_bytes(blob)
+    copy.set_sig_verdict(True)  # pre-verified client submission
+    v = net.validators[i]
+    with v.node.lock:
+        ter, applied = txqs[i].admit(
+            copy, v.node.lm, TxParams.OPEN_LEDGER | TxParams.RETRY
+        )
+    return ter, applied, copy
+
+
 def _inject(net: SimNet, scn: Scenario, nid: int,
             tx: SerializedTransaction, txqs: dict,
-            admissions: list) -> None:
+            admissions: dict, step: int = 0,
+            retry_q: Optional[list] = None,
+            gate_of: Optional[dict] = None) -> None:
     """One workload item enters the net. Without an admission plane it
     rides the normal client path (apply locally + flood). With TxQs
     attached, EVERY honest validator runs admit() on its own copy — the
-    production shape where a flood reaches each node's admission gate."""
+    production shape where a flood reaches each node's admission gate.
+    A client SEES a local shed (telINSUF_FEE_P) or a dead node
+    synchronously and retries; fire-and-forget here manufactured
+    permanent per-account sequence gaps behind which whole queued
+    chains starved (a scenario-fuzzer false-positive class) — down/shed
+    admissions defer onto `retry_q` instead."""
     if not txqs:
         if net.is_down(nid) or nid in scn.byzantine:
             nid = next(
@@ -227,22 +385,46 @@ def _inject(net: SimNet, scn: Scenario, nid: int,
             )
         net.validators[nid].submit_client_tx(tx)
         return
-    params = TxParams.OPEN_LEDGER | TxParams.RETRY
     blob = tx.serialize()
-    first = True
-    for i, txq in txqs.items():
-        if net.is_down(i):
+    if gate_of is None:
+        gate_of = {}
+    for i in txqs:
+        got = _admit_at(net, txqs, i, blob)
+        if _client_should_retry(got):
+            if retry_q is not None:
+                retry_q.append((step + _CLIENT_RETRY_STEPS, i, blob, 0))
+        if got is not None:
+            ter, applied, copy = got
+            _record_admission(admissions, gate_of, i, copy,
+                              int(ter), applied)
+
+
+def _drain_client_retries(net: SimNet, txqs: dict, retry_q: list,
+                          step: int, admissions: Optional[dict] = None,
+                          gate_of: Optional[dict] = None) -> None:
+    """Re-admit deferred client submissions whose retry timer fired;
+    still-down / still-shed ones re-defer. Order-preserving (a client's
+    chain resubmits in sequence order). A retry outcome at the tx's
+    reporting gate UPGRADES its admission record — a shed that later
+    queues counts as queued in the fairness verdicts."""
+    if not retry_q:
+        return
+    keep = []
+    for due, i, blob, tries in retry_q:
+        if due > step:
+            keep.append((due, i, blob, tries))
             continue
-        copy = SerializedTransaction.from_bytes(blob)
-        copy.set_sig_verdict(True)  # pre-verified client submission
-        v = net.validators[i]
-        with v.node.lock:
-            ter, applied = txq.admit(copy, v.node.lm, params)
-        if first:
-            admissions.append(
-                (tx.txid(), int(ter), bool(applied), tx.fee.mantissa)
-            )
-            first = False
+        got = _admit_at(net, txqs, i, blob)
+        if got is not None and admissions is not None \
+                and gate_of is not None:
+            ter, applied, copy = got
+            _record_admission(admissions, gate_of, i, copy,
+                              int(ter), applied)
+        if _client_should_retry(got) and tries < 25:
+            # a real client gives up eventually too — the bound keeps
+            # the quiescence tail finite when a tx can never enter
+            keep.append((step + _CLIENT_RETRY_STEPS, i, blob, tries + 1))
+    retry_q[:] = keep
 
 
 def _count_committed(watch, workload) -> int:
@@ -268,33 +450,41 @@ def _count_committed(watch, workload) -> int:
     return len(pairs)
 
 
-def _fairness(admissions: list, commits: dict) -> dict:
+def _fairness(admissions: dict, commits: dict) -> dict:
     """Admission-plane fairness verdicts from observable outcomes on
     validator 0's chain: fee-ordered drain (queued high-fee txs commit
     no later, on average, than queued low-fee ones), no-starvation
     (every queued tx eventually commits), replace-by-fee (a replaced
     sequence commits at most once)."""
-    queued = [
-        (txid, fee) for txid, ter, _applied, fee in admissions
-        if ter == int(TER.terQUEUED)
-    ]
+    # replace-by-fee: only ONE bid per (account, seq) can ever commit,
+    # so queued bids collapse onto their chain slot — a replaced
+    # original is not a starved tx (the fuzzer caught the per-txid
+    # accounting under-reporting no_starvation on replacement-heavy
+    # streams)
+    slots: dict[tuple, list] = {}
+    for txid, (ter, _applied, fee, acct, seq) in admissions.items():
+        if ter == int(TER.terQUEUED):
+            slots.setdefault((acct, seq), []).append((txid, fee))
+    recs = admissions.values()
     out = {
-        "admitted": sum(1 for _t, ter, a, _f in admissions if a),
-        "queued": len(queued),
+        "admitted": sum(1 for _ter, a, _f, _a, _s in recs if a),
+        "queued": len(slots),
         "rejected": sum(
-            1 for _t, ter, a, _f in admissions
+            1 for ter, a, _f, _a, _s in recs
             if not a and ter != int(TER.terQUEUED)
         ),
     }
-    if not queued:
+    if not slots:
         out.update(fee_order_drain=True, no_starvation=True)
         return out
-    landed = [(fee, commits[txid]) for txid, fee in queued
-              if txid in commits]
+    landed = []
+    for bids in slots.values():
+        done = [(fee, commits[txid]) for txid, fee in bids
+                if txid in commits]
+        if done:
+            landed.append(max(done))  # the winning (highest) bid
     out["queued_committed"] = len(landed)
-    # replaced originals never commit, so starvation counts only the
-    # LAST bid per (account, seq) — admissions dedup by txid upstream
-    out["no_starvation"] = len(landed) >= max(1, int(0.9 * len(queued)))
+    out["no_starvation"] = len(landed) >= max(1, int(0.9 * len(slots)))
     if len(landed) >= 4:
         landed.sort(key=lambda p: -p[0])
         k = max(1, len(landed) // 4)
@@ -303,6 +493,23 @@ def _fairness(admissions: list, commits: dict) -> dict:
         out["fee_order_drain"] = top <= bot + 1e-9
     else:
         out["fee_order_drain"] = True
+    return out
+
+
+def _fork_seqs(net: SimNet, honest: list, common: int) -> list:
+    """Seqs <= the common validated floor where honest validators'
+    ledger histories disagree. After fork repair + validated-slot
+    overwrite these must agree wherever two nodes both hold an entry."""
+    out = []
+    for seq in range(1, max(0, common) + 1):
+        seen = {
+            h for h in (
+                net.validators[i].node.lm.ledger_history.get(seq)
+                for i in honest
+            ) if h is not None
+        }
+        if len(seen) > 1:
+            out.append(seq)
     return out
 
 
@@ -322,6 +529,7 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
         idle_interval=scn.idle_interval, seed=scn.seed,
         n_peers=scn.n_peers, squelch_size=scn.squelch_size,
         squelch_rotate=scn.squelch_rotate, resources=scn.resources,
+        n_followers=scn.n_followers,
     )
     # swap hostile slots in BEFORE start() so their genesis matches
     byz_validators = {}
@@ -349,8 +557,12 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
         net.nodes[nid] = fp
         flooder_peers[nid] = fp
 
-    # schedule: user events + the cold-node join choreography
+    # schedule: data-form events + user builder + the cold-node join
+    # choreography, merged onto one replayed schedule (its digest rides
+    # the scorecard, so the merge is part of the replay identity)
     sched = FaultSchedule(scn.seed)
+    if scn.schedule is not None:
+        sched.extend(scn.schedule.events)
     if scn.build_schedule is not None:
         scn.build_schedule(sched, scn)
     for nid in scn.cold_nodes:
@@ -366,12 +578,15 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
         sched.kill(scn.kill_server_at, victims[0],
                    revive_at=scn.kill_server_at + 10)
 
-    # workload
+    # workload (closure builder wins; else the named-workload spec)
     fac = TxFactory(seed=scn.seed)
     wl_rng = random.Random(0x301C ^ scn.seed)
     workload = []
-    if scn.build_workload is not None:
-        workload = scn.build_workload(fac, wl_rng, scn)
+    build_workload = scn.build_workload
+    if build_workload is None and scn.workload is not None:
+        build_workload = build_spec_workload(scn.workload)
+    if build_workload is not None:
+        workload = build_workload(fac, wl_rng, scn)
     by_step: dict[int, list] = {}
     for at, nid, tx in workload:
         by_step.setdefault(at, []).append((nid, tx))
@@ -417,14 +632,46 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
         net.validators[i].node.on_ledger.append(_record)
 
     net.start()
-    admissions: list = []
+    admissions: dict = {}
+    gate_of: dict = {}
+    retry_q: list = []
+    cur_step = [0]
+    if txqs:
+        # the client also RESUBMITS a tx the queue dropped (evicted /
+        # expired while consensus stalled) — the product signals this
+        # through TxQ.on_drop into LocalTxs, whose push_back makes the
+        # tx resubmittable; without it, entries expiring under long
+        # kill-stall windows read as admission-plane starvation.
+        # Bounded per (txid, gate) so a permanently-dead tx terminates.
+        blob_of: dict[bytes, bytes] = {}
+        resubmits: dict[tuple, int] = {}
+
+        def _mk_on_drop(i):
+            def on_drop(txid):
+                blob = blob_of.get(txid)
+                n = resubmits.get((txid, i), 0)
+                if blob is not None and n < 5:
+                    resubmits[(txid, i)] = n + 1
+                    retry_q.append((
+                        cur_step[0] + _CLIENT_RETRY_STEPS, i, blob, 20,
+                    ))
+            return on_drop
+
+        for i, txq in txqs.items():
+            txq.on_drop = _mk_on_drop(i)
     submitted = 0
     try:
         for step in range(scn.steps):
+            cur_step[0] = step
             for ev in sched.events_at(step):
                 apply_event(net, ev)
+            _drain_client_retries(net, txqs, retry_q, step,
+                                  admissions, gate_of)
             for nid, tx in by_step.get(step, ()):
-                _inject(net, scn, nid, tx, txqs, admissions)
+                if txqs:
+                    blob_of[tx.txid()] = tx.serialize()
+                _inject(net, scn, nid, tx, txqs, admissions,
+                        step=step, retry_q=retry_q, gate_of=gate_of)
                 submitted += 1
             for bv in byz_validators.values():
                 if not net.is_down(bv.nid):
@@ -451,22 +698,65 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
                 for i in honest
             ]
 
+        def _fseqs():
+            return [
+                f.node.lm.validated.seq if f.node.lm.validated else 0
+                for f in net.followers
+            ]
+
+        def _tiers_at_target(target):
+            # followers tail the validator wave one delivery-latency
+            # behind by construction; their bar tracks the CURRENT
+            # honest floor (validators keep closing during the
+            # quiescence wait — a fixed target let the tail exit with
+            # a follower legally-at-target but behind the floor the
+            # synced verdict is judged against)
+            hmin = min(_hseqs())
+            if hmin < target:
+                return False
+            return not net.followers or min(_fseqs()) >= hmin - 1
+
         # two-phase tail: first reach the convergence target, then keep
         # stepping until the committed-tx count is QUIESCENT (held /
         # queued / disputed txs land a few rounds after the flood ends —
-        # judging commit counts at first convergence undercounts them)
+        # judging commit counts at first convergence undercounts them).
+        # Quiescence additionally requires NO pending client work on
+        # any live honest validator: a held sequence chain re-fires up
+        # to ~2 retry horizons after a revive, and cutting the tail
+        # inside that window reported healthy retries as lost txs
+        # (a scenario-fuzzer false-positive class, fixed here)
+        def _pending_client_work() -> bool:
+            if retry_q:
+                return True
+            for i in honest:
+                if net.is_down(i):
+                    continue  # a frozen node's queues can't drain
+                vn = net.validators[i].node
+                if len(vn.local_txs):
+                    return True
+                if vn.lm.held:
+                    return True
+                txq = getattr(vn.lm, "txq", None)
+                if txq is not None and len(txq):
+                    return True
+            return False
+
         target = max(_hseqs()) + scn.converge_extra
         tail = 0
         last_commits, stable = -1, 0
         while tail < scn.max_tail_steps:
-            if min(_hseqs()) >= target:
+            if _tiers_at_target(target):
                 if len(commits) == last_commits:
                     stable += 1
-                    if stable >= 3 * scn.idle_interval:
+                    if stable >= 3 * scn.idle_interval and \
+                            not _pending_client_work():
                         break
                 else:
                     stable = 0
                     last_commits = len(commits)
+            cur_step[0] = scn.steps + tail
+            _drain_client_retries(net, txqs, retry_q, scn.steps + tail,
+                                  admissions, gate_of)
             net.step()
             tail += 1
         converged = min(_hseqs()) >= target
@@ -514,7 +804,34 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
             },
             "degraded_transitions": degraded_transitions,
             "fault_digest": sched.digest(),
+            # single-validated-hash-per-seq evidence: seqs at or below
+            # the common validated floor where two honest validators'
+            # repaired histories still disagree (must be empty — the
+            # search plane's invariant registry gates on it)
+            "fork_seqs": _fork_seqs(net, honest, common),
         }
+        planted = getattr(net, "synth_planted", 0)
+        if planted:
+            card["synth"] = {"planted": planted}
+        if scn.n_followers:
+            fl_seqs = _fseqs()
+            watch_hist = watch.node.lm.ledger_history
+            card["followers"] = {
+                "validated_seqs": fl_seqs,
+                # every follower within one in-flight round of the
+                # honest floor (the steady-state tailing lag) AND
+                # byte-identical to the honest chain at its OWN floor
+                "synced": bool(
+                    converged
+                    and len(hashes) == 1
+                    and all(s >= common - 1 for s in fl_seqs)
+                    and all(
+                        f.node.lm.ledger_history.get(min(s, common))
+                        == watch_hist.get(min(s, common))
+                        for f, s in zip(net.followers, fl_seqs)
+                    )
+                ),
+            }
         if scn.squelch_size or scn.n_peers:
             # relay fan-out evidence: the squelch bound the flood gate
             # asserts (fan-out <= squelch_size + n_validators, never

@@ -1,6 +1,10 @@
-"""The scenario matrix (doc/scenarios.md): the four adversarial shapes
-the ISSUE/ROADMAP name, as parameterized builders. ``build_scenario``
-is the single entry the smoke, the tests, and the bench leg share.
+"""The scenario matrix (doc/scenarios.md): the adversarial shapes the
+ISSUE/ROADMAP name, as parameterized builders, plus the permanent
+minimal-repro corpus the fuzz plane (testkit/search.py) maintains.
+``build_scenario`` is the single entry the smokes, the tests, the fuzz
+harness and the bench leg share — matrix names first, then corpus
+entries (each a shrunk, replayable scenario checked in under
+``testkit/corpus/``).
 
 (a) partition_kills   partitions healing on schedule + rotating
                       validator kills, under payment flood
@@ -11,53 +15,41 @@ is the single entry the smoke, the tests, and the bench leg share.
                       bulk path; the first server serves garbage, the
                       second is killed mid-sync
 (d) hostile workloads hot_account / order_books / fee_gaming
+(e) fan-in/read axes  flood_survival, squelch-rotation-vs-flood,
+                      chaos under spec workers, follower-under-
+                      partition
+
+Every matrix scenario is DATA-form (``schedule=``/``workload=`` rather
+than closures), so each round-trips losslessly through
+``Scenario.to_json`` — the property the shrinker and the corpus build
+on.
 """
 
 from __future__ import annotations
 
+import json
+import os
+
+from .schedule import FaultSchedule
 from .scenario import Scenario
-from .workloads import (
-    fee_gaming,
-    hot_account_flood,
-    order_book_crossfire,
-    payment_flood,
-)
 
-__all__ = ["MATRIX", "build_scenario"]
-
-
-def _funded_flood(workload_fn, n_txs, end_margin: int = 6, **wl_kw):
-    """Fund the scenario accounts during the opening steps, then run the
-    hostile stream over the remaining window (`end_margin` steps of
-    quiet tail let queues/holds drain before convergence is judged)."""
-
-    def build(fac, rng, scn):
-        items = [(0, 0, tx) for tx in fac.fund_all()]
-        items += workload_fn(
-            fac, rng, start=6, end=scn.steps - end_margin, n=n_txs,
-            n_validators=scn.n_validators, **wl_kw,
-        )
-        items.sort(key=lambda it: it[0])
-        return items
-
-    return build
+__all__ = [
+    "MATRIX", "build_scenario", "CORPUS_DIR", "load_corpus",
+    "corpus_scenarios",
+]
 
 
 def scenario_partition_kills(seed: int = 0) -> Scenario:
-    def schedule(sched, scn):
-        # an even split that must stall (safety), healing on schedule,
-        # then rotating single-validator kills under continuing flood
-        sched.partition(14, {0, 1}, {2, 3, 4}, heal_at=26)
-        sched.rotate_kills(
-            range(scn.n_validators), start=34, every=12, downtime=5,
-            count=3,
-        )
-
+    # an even split that must stall (safety), healing on schedule,
+    # then rotating single-validator kills under continuing flood
+    sched = FaultSchedule(seed)
+    sched.partition(14, {0, 1}, {2, 3, 4}, heal_at=26)
+    sched.rotate_kills(range(5), start=34, every=12, downtime=5, count=3)
     return Scenario(
         name="partition_kills", seed=seed, n_validators=5, quorum=3,
         steps=80,
-        build_schedule=schedule,
-        build_workload=_funded_flood(payment_flood, 60),
+        schedule=sched,
+        workload={"kind": "payment_flood", "n": 60},
     )
 
 
@@ -65,25 +57,33 @@ def scenario_chaos(seed: int = 0, steps: int = 120,
                    kill_every: int = 40, downtime: int = 5) -> Scenario:
     """Rotating validator kills under continuous flood — the pre-graft
     chaos-soak shape, now ONE definition driven through BOTH transports
-    (tools/chaos_soak.py runs it on the real TCP net; the smoke and the
-    matrix run it deterministically on the simnet)."""
+    (tools/scenariofuzz.py --soak runs it on the real TCP net; the
+    smoke and the matrix run it deterministically on the simnet)."""
     kills = max(1, (steps - 20) // kill_every)
-
-    def schedule(sched, scn):
-        sched.rotate_kills(
-            range(scn.n_validators), start=14, every=kill_every,
-            downtime=downtime, count=kills,
-        )
-
+    sched = FaultSchedule(seed)
+    sched.rotate_kills(
+        range(4), start=14, every=kill_every, downtime=downtime,
+        count=kills,
+    )
     return Scenario(
         name="chaos", seed=seed, n_validators=4, quorum=3,
         steps=steps,
-        build_schedule=schedule,
-        build_workload=_funded_flood(
-            payment_flood, max(24, steps // 2)
-        ),
+        schedule=sched,
+        workload={"kind": "payment_flood", "n": max(24, steps // 2)},
         transports=("simnet", "tcp"),
     )
+
+
+def scenario_chaos_spec2(seed: int = 0) -> Scenario:
+    """Chaos with [spec] workers=2 thread pools on every honest
+    validator (ROADMAP item 5's workers>1-under-fire axis as a
+    permanent matrix leg; tools/scenariosmoke.py gates hash identity
+    against the serial run of the same seed)."""
+    scn = scenario_chaos(seed)
+    scn.name = "chaos_spec2"
+    scn.spec_workers = 2
+    scn.transports = ("simnet",)
+    return scn
 
 
 def scenario_byzantine(seed: int = 0) -> Scenario:
@@ -94,7 +94,7 @@ def scenario_byzantine(seed: int = 0) -> Scenario:
             "equivocate", "duplicate", "forge", "stale", "garbage",
             "oversized",
         )},
-        build_workload=_funded_flood(payment_flood, 40),
+        workload={"kind": "payment_flood", "n": 40},
     )
 
 
@@ -107,7 +107,7 @@ def scenario_cold_catchup(seed: int = 0) -> Scenario:
         garbage_server=0,       # first pick serves garbage → per-peer
         kill_server_at=44,      # fallback, then the next server dies
                                 # right as the transfer lands on it
-        build_workload=_funded_flood(payment_flood, 70),
+        workload={"kind": "payment_flood", "n": 70},
         max_tail_steps=300,
     )
 
@@ -116,7 +116,7 @@ def scenario_hot_account(seed: int = 0) -> Scenario:
     return Scenario(
         name="hot_account", seed=seed, n_validators=4, quorum=3,
         steps=60,
-        build_workload=_funded_flood(hot_account_flood, 80),
+        workload={"kind": "hot_account_flood", "n": 80},
     )
 
 
@@ -124,7 +124,25 @@ def scenario_order_books(seed: int = 0) -> Scenario:
     return Scenario(
         name="order_books", seed=seed, n_validators=4, quorum=3,
         steps=70,
-        build_workload=_funded_flood(order_book_crossfire, 60),
+        workload={"kind": "order_book_crossfire", "n": 60},
+    )
+
+
+def scenario_follower_partition(seed: int = 0) -> Scenario:
+    """Follower-attached-under-partition (ROADMAP item 5's read-plane
+    axis): one follower node (nid 4) tails a 4-validator net under
+    flood; mid-run the follower is partitioned away from every
+    validator, then a validator dies and revives while the follower is
+    still dark, then the partition heals — the follower must re-sync
+    and end on the honest chain (scorecard `followers.synced`)."""
+    sched = FaultSchedule(seed)
+    sched.partition(18, {4}, {0, 1, 2, 3}, heal_at=38)
+    sched.kill(24, 1, revive_at=30)
+    return Scenario(
+        name="follower_partition", seed=seed, n_validators=4, quorum=3,
+        steps=64, n_followers=1,
+        schedule=sched,
+        workload={"kind": "payment_flood", "n": 48},
     )
 
 
@@ -152,7 +170,24 @@ def scenario_flood_survival(
         flooders=(
             {0: {"burst": 8, "fan": 24}} if flooder else {}
         ),
-        build_workload=_funded_flood(payment_flood, 30),
+        workload={"kind": "payment_flood", "n": 30},
+        max_tail_steps=160,
+    )
+
+
+def scenario_squelch_rotation_flood(seed: int = 0) -> Scenario:
+    """Squelching-vs-byzantine-flood (ROADMAP item 5's last missing
+    axis): the flood_survival shape with the squelch epoch rotating
+    MID-FLOOD (rotate=3 → several epochs inside one run) — the
+    rotating relay subsets must keep the fan-out bound while the PR 10
+    flooder hammers its neighbor set, and enforcement (DROP + refusal)
+    must survive the subset churn."""
+    return Scenario(
+        name="squelch_rotation_flood", seed=seed,
+        n_validators=5, quorum=4, steps=60,
+        n_peers=59, squelch_size=6, squelch_rotate=3, resources=True,
+        flooders={0: {"burst": 8, "fan": 20}},
+        workload={"kind": "payment_flood", "n": 30},
         max_tail_steps=160,
     )
 
@@ -164,21 +199,72 @@ def scenario_fee_gaming(seed: int = 0) -> Scenario:
         txq_cap=6,
         # flood ends ~36 steps before the horizon: the queue must DRAIN
         # in fee order (the fairness checks judge the drained outcome)
-        build_workload=_funded_flood(fee_gaming, 70, end_margin=36),
+        workload={"kind": "fee_gaming", "n": 70, "end_margin": 36},
     )
 
 
 MATRIX = {
     "partition_kills": scenario_partition_kills,
     "chaos": scenario_chaos,
+    "chaos_spec2": scenario_chaos_spec2,
     "byzantine": scenario_byzantine,
     "cold_catchup": scenario_cold_catchup,
     "hot_account": scenario_hot_account,
     "order_books": scenario_order_books,
+    "follower_partition": scenario_follower_partition,
     "fee_gaming": scenario_fee_gaming,
     "flood_survival": scenario_flood_survival,
+    "squelch_rotation_flood": scenario_squelch_rotation_flood,
 }
+
+# -- the minimal-repro corpus (testkit/corpus/*.json) ---------------------
+#
+# Every entry is a shrunk scenario the fuzz plane (or a human triaging
+# one of its finds) checked in: {"name", "invariant", "detail",
+# "found" provenance, "expect" ("pass" once the bug is fixed), and the
+# full data-form "scenario"}. They load through build_scenario like any
+# matrix name and replay as permanent regressions in the fuzz smoke.
+
+CORPUS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "corpus")
+
+
+def load_corpus(corpus_dir: str | None = None) -> dict[str, dict]:
+    """name -> corpus entry dict, sorted by filename (deterministic
+    replay order). Missing directory = empty corpus."""
+    d = corpus_dir or CORPUS_DIR
+    out: dict[str, dict] = {}
+    if not os.path.isdir(d):
+        return out
+    for fn in sorted(os.listdir(d)):
+        if not fn.endswith(".json"):
+            continue
+        with open(os.path.join(d, fn)) as f:
+            entry = json.load(f)
+        if entry["name"] in out:
+            # two files carrying one name would silently shadow a
+            # checked-in regression out of the replay gate
+            raise ValueError(
+                f"duplicate corpus entry name {entry['name']!r} "
+                f"(file {fn})"
+            )
+        out[entry["name"]] = entry
+    return out
+
+
+def corpus_scenarios(corpus_dir: str | None = None) -> dict[str, "Scenario"]:
+    return {
+        name: Scenario.from_json(entry["scenario"])
+        for name, entry in load_corpus(corpus_dir).items()
+    }
 
 
 def build_scenario(name: str, seed: int = 0) -> Scenario:
-    return MATRIX[name](seed)
+    if name in MATRIX:
+        return MATRIX[name](seed)
+    entry = load_corpus().get(name)
+    if entry is not None:
+        # corpus scenarios carry their own pinned seed — the repro IS
+        # the data; the seed argument does not apply
+        return Scenario.from_json(entry["scenario"])
+    raise KeyError(name)

@@ -87,6 +87,18 @@ class FaultSchedule:
             at += every
         return self
 
+    def extend(self, events) -> "FaultSchedule":
+        """Merge pre-built events (data-form schedules, corpus entries)
+        AS-IS — original `order` values preserved so replay tiebreaks
+        match the source — and renumber the build counter past them so
+        later add() calls stay unique. The one owner of that invariant
+        (run_simnet, run_tcp and from_json all merge through here)."""
+        self.events.extend(events)
+        self._order = 1 + max(
+            (e.order for e in self.events), default=-1
+        )
+        return self
+
     # -- replay ------------------------------------------------------------
 
     def events_at(self, step: int) -> list[FaultEvent]:
@@ -110,3 +122,47 @@ class FaultSchedule:
         agree on this, and the smoke pins it."""
         h = hashlib.sha256(repr(self.describe()).encode())
         return h.hexdigest()[:16]
+
+    # -- serialization (the shrinker/corpus need schedules as DATA) --------
+
+    def to_json(self) -> dict:
+        """Lossless JSON form: ``from_json(to_json())`` reproduces the
+        identical event list AND ``digest()`` (pinned by test). The
+        schedule's build-time RNG state is NOT captured — a deserialized
+        schedule is replayed/edited as data, never re-randomized."""
+        return {
+            "seed": self.seed,
+            "events": [
+                [e.at, e.order, e.kind, _jsonify(e.args),
+                 _jsonify(e.kwargs)]
+                for e in self.events
+            ],
+        }
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "FaultSchedule":
+        sched = cls(int(obj.get("seed", 0)))
+        sched.extend(
+            FaultEvent(
+                int(at), int(order), str(kind),
+                _tupleize(args), _tupleize(kwargs),
+            )
+            for at, order, kind, args, kwargs in obj["events"]
+        )
+        return sched
+
+
+def _jsonify(v):
+    """Tuples → lists, recursively (JSON has no tuple type)."""
+    if isinstance(v, tuple):
+        return [_jsonify(x) for x in v]
+    return v
+
+
+def _tupleize(v):
+    """Inverse of _jsonify: lists → tuples, recursively. Event args only
+    ever hold ints/floats/strs and (nested) tuples of them, so the
+    round trip is lossless and digest-stable."""
+    if isinstance(v, list):
+        return tuple(_tupleize(x) for x in v)
+    return v

@@ -29,7 +29,7 @@ import urllib.request
 
 from ..protocol.keys import KeyPair
 from .schedule import FaultSchedule
-from .workloads import TxFactory
+from .workloads import TxFactory, build_spec_workload
 
 __all__ = [
     "free_ports", "rpc", "wait_until", "validator_config",
@@ -278,7 +278,13 @@ def run_tcp(scn, step_seconds: float = 1.0,
     """Execute a Scenario's kill/revive + workload shape on a real
     process net; returns a (non-deterministic) scorecard with the same
     field names as the simnet one where they apply."""
+    # same data-form + builder merge as run_simnet: matrix scenarios
+    # now carry schedule=/workload= DATA rather than closures, and the
+    # TCP runner must consume both forms or a migrated scenario runs
+    # with no faults and no traffic (a vacuous soak that greenwashes)
     sched = FaultSchedule(scn.seed)
+    if scn.schedule is not None:
+        sched.extend(scn.schedule.events)
     if scn.build_schedule is not None:
         scn.build_schedule(sched, scn)
     unsupported = {
@@ -292,9 +298,12 @@ def run_tcp(scn, step_seconds: float = 1.0,
 
     fac = TxFactory(seed=scn.seed)
     wl_rng = random.Random(0x301C ^ scn.seed)
+    build_workload = scn.build_workload
+    if build_workload is None and scn.workload is not None:
+        build_workload = build_spec_workload(scn.workload)
     workload = (
-        scn.build_workload(fac, wl_rng, scn)
-        if scn.build_workload is not None else []
+        build_workload(fac, wl_rng, scn)
+        if build_workload is not None else []
     )
     by_step: dict[int, list] = {}
     for at, nid, tx in workload:

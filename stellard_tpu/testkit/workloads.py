@@ -32,6 +32,8 @@ __all__ = [
     "hot_account_flood",
     "order_book_crossfire",
     "fee_gaming",
+    "WORKLOADS",
+    "build_spec_workload",
 ]
 
 XRP = 1_000_000
@@ -206,6 +208,33 @@ def order_book_crossfire(fac: TxFactory, rng: random.Random, *,
     return items
 
 
+def build_spec_workload(spec: dict):
+    """Workloads as DATA (scenario serialization / the fuzz generator):
+    ``{"kind": <WORKLOADS name>, "n": N[, "start": S, "end_margin": M,
+    ...extra kwargs]}`` becomes the standard funded-flood builder —
+    master funds every scenario account at step 0, then the named
+    stream runs over ``[start, scn.steps - end_margin)``. The returned
+    builder is a pure function of (seed, scenario), so a serialized
+    scenario replays byte-identically."""
+    spec = dict(spec)
+    fn = WORKLOADS[spec.pop("kind")]
+    n = int(spec.pop("n"))
+    start = int(spec.pop("start", 6))
+    end_margin = int(spec.pop("end_margin", 6))
+
+    def build(fac: TxFactory, rng: random.Random, scn) -> list:
+        items = [(0, 0, tx) for tx in fac.fund_all()]
+        items += fn(
+            fac, rng, start=start,
+            end=max(start + 1, scn.steps - end_margin), n=n,
+            n_validators=scn.n_validators, **spec,
+        )
+        items.sort(key=lambda it: it[0])
+        return items
+
+    return build
+
+
 def fee_gaming(fac: TxFactory, rng: random.Random, *, start: int,
                end: int, n: int, n_validators: int,
                origin: int = 0) -> list:
@@ -227,3 +256,13 @@ def fee_gaming(fac: TxFactory, rng: random.Random, *, start: int,
             txs.append(fac.payment_at_seq(src, seq, dst, XRP,
                                           int(fee * 3 // 2)))
     return _spread(rng, txs, start, end, n_validators, origin=origin)
+
+
+# named-workload registry: the serializable half of every scenario's
+# workload axis (build_spec_workload interprets {"kind": <name>, ...})
+WORKLOADS = {
+    "payment_flood": payment_flood,
+    "hot_account_flood": hot_account_flood,
+    "order_book_crossfire": order_book_crossfire,
+    "fee_gaming": fee_gaming,
+}

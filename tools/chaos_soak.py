@@ -1,44 +1,30 @@
-"""Randomized chaos soak over a REAL 4-validator TCP+TLS net.
-
-Now a thin wrapper over the scenario plane: the SAME `chaos` scenario
-definition (stellard_tpu/testkit/scenarios.py — rotating validator
-kills under continuous flood) that tools/scenariosmoke.py replays
-deterministically on the simnet runs here against real processes via
-testkit.tcpnet.run_tcp. Ends by asserting every validator is
-quorum-validated on one advancing chain with one hash, and prints a
-JSON scorecard line. Validators are always torn down, even on a failed
-run.
+"""DEPRECATION SHIM — the chaos soak now lives in
+``tools/scenariofuzz.py --soak`` (the scenario-search CLI owns every
+harness over the scenario plane). Existing invocations keep working:
 
 Usage: python tools/chaos_soak.py [minutes] [seed] [> CHAOS_SOAK.log]
 """
 
 from __future__ import annotations
 
-import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from stellard_tpu.testkit.scenarios import scenario_chaos  # noqa: E402
-from stellard_tpu.testkit.tcpnet import run_tcp  # noqa: E402
+from tools.scenariofuzz import soak  # noqa: E402
 
 MINUTES = float(sys.argv[1]) if len(sys.argv) > 1 else 12.0
 SEED = int(sys.argv[2]) if len(sys.argv) > 2 else 7
 
 
 def main() -> None:
-    steps = max(60, int(MINUTES * 60))  # 1 step ~= 1 second
-    scn = scenario_chaos(seed=SEED, steps=steps, kill_every=45,
-                         downtime=5)
-    card = run_tcp(scn)
-    card["chaos_minutes"] = MINUTES
-    card["summary"] = True
-    print(json.dumps(card), flush=True)
-    if not card["converged"]:
-        raise SystemExit(f"no convergence: {card['validated_seqs']}")
-    if not card["single_hash"]:
-        raise SystemExit(f"FORK at {card['final_seq']}")
+    print(
+        "chaos_soak.py is deprecated; use "
+        "`python tools/scenariofuzz.py --soak [minutes] [seed]`",
+        file=sys.stderr,
+    )
+    soak(MINUTES, SEED)
 
 
 if __name__ == "__main__":

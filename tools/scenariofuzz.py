@@ -1,0 +1,292 @@
+"""Coverage-guided scenario fuzzing CLI (testkit/search.py front end).
+
+Modes:
+
+  --smoke            tier-1 gate (tools/tier1.sh): replay the permanent
+                     corpus, run a bounded ARMED sweep that must find
+                     the planted synthetic bug and shrink it to its
+                     known minimal schedule (then prove the entry
+                     replays as a violation while armed and clean once
+                     disarmed — the found→shrunk→fixed→pinned loop,
+                     end to end), and run the coverage-guided vs
+                     uniform generation comparison (novelty bias must
+                     win). Budget knob: FUZZ_N env (default 30
+                     generated scenarios per phase) — raise it for
+                     longer offline sweeps, e.g. FUZZ_N=300.
+  --sweep N          offline bug hunting: N generated scenarios,
+                     coverage-guided, shrinking every first-of-kind
+                     violation; violations land as corpus-entry JSON in
+                     --corpus-out (default /tmp, NOT the checked-in
+                     corpus — triage first, then move them in).
+  --replay NAME      replay a corpus entry (checked-in name or a JSON
+                     file path) and re-check the invariant registry.
+  --compare N        just the guided-vs-uniform comparison.
+  --soak [min] [sd]  the `chaos` scenario on the REAL TCP+TLS net
+                     (absorbed from tools/chaos_soak.py, which remains
+                     as a deprecation shim).
+
+Every phase prints one JSON line; --smoke exits non-zero on any gate
+failure. Deterministic: same seed, same machine-independent output
+(PYTHONHASHSEED-proof, pinned by tests/test_search.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stellard_tpu.testkit.scenario import (  # noqa: E402
+    SYNTH_BUG,
+    Scenario,
+    run_simnet,
+)
+from stellard_tpu.testkit.scenarios import (  # noqa: E402
+    build_scenario,
+    load_corpus,
+)
+from stellard_tpu.testkit.search import (  # noqa: E402
+    SYNTH_THRESHOLD,
+    Violation,
+    check_invariants,
+    corpus_entry,
+    counter_vector,
+    coverage_comparison,
+    shrink_scenario,
+    sweep,
+    write_corpus_entry,
+)
+
+
+def fail(msg: str) -> None:
+    print(f"SCENARIO FUZZ FAILED: {msg}", file=sys.stderr)
+    raise SystemExit(2)
+
+
+def emit(obj: dict) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+def replay_corpus() -> int:
+    """Replay every checked-in corpus entry; each must honor its
+    `expect` contract ("pass": no invariant violations)."""
+    n = 0
+    for name, entry in load_corpus().items():
+        scn = build_scenario(name)
+        card = run_simnet(scn)
+        viols = check_invariants(scn, card)
+        ok = (not viols) if entry.get("expect", "pass") == "pass" else \
+            any(v.invariant == entry["invariant"] for v in viols)
+        emit({
+            "phase": "corpus", "entry": name,
+            "invariant": entry.get("invariant"),
+            "expect": entry.get("expect", "pass"),
+            "ok": ok,
+            "violations": [f"{v.invariant}: {v.detail}" for v in viols],
+        })
+        if not ok:
+            fail(f"corpus entry {name} broke its contract "
+                 f"(expect={entry.get('expect')}, got {viols})")
+        n += 1
+    return n
+
+
+def smoke(seed: int, n: int) -> None:
+    t0 = time.perf_counter()
+
+    # (1) the permanent corpus replays clean (the real bugs the sweep
+    # found stay fixed)
+    n_corpus = replay_corpus()
+
+    # (2) armed sweep: the planted synthetic bug must be FOUND and
+    # SHRUNK; any non-synthetic violation is a NEW real bug -> red
+    res = sweep(seed, n, guided=True, allow_synth=True, shrink=True,
+                determinism_check=True)
+    synth = [v for v in res["violations"]
+             if v["invariant"] == "synthetic_bug"]
+    real = [v for v in res["violations"]
+            if v["invariant"] != "synthetic_bug"]
+    emit({
+        "phase": "armed_sweep", "seed": seed, "runs": n,
+        "distinct_signatures": res["distinct_signatures"],
+        "synthetic_found": len(synth), "real_violations": len(real),
+    })
+    if real:
+        for v in real:
+            emit({"phase": "real_violation", "invariant": v["invariant"],
+                  "detail": v["detail"], "scenario": v["scenario"]})
+        fail(f"{len(real)} real invariant violation(s) found — triage, "
+             f"fix, and pin them as corpus entries")
+    if not synth:
+        fail(f"planted synthetic bug not found in {n} runs "
+             f"(seed {seed}) — the sweep lost its ground truth")
+
+    # (3) the first synthetic find carries a full shrink: verify it
+    # reached the KNOWN minimal schedule (plant events only, magnitudes
+    # summing to exactly the threshold)
+    shrunk = next(v for v in synth if "shrunk" in v)
+    minimal = Scenario.from_json(shrunk["shrunk"])
+    events = minimal.schedule.events if minimal.schedule else []
+    kinds = sorted({e.kind for e in events})
+    total = sum(e.args[0] for e in events if e.kind == "synth_plant")
+    emit({
+        "phase": "shrink", "iteration": shrunk["iteration"],
+        "events": len(events), "kinds": kinds, "plant_total": total,
+        "shrink_attempts": len(shrunk["shrink_trajectory"]),
+        "workload": minimal.workload,
+    })
+    if kinds != ["synth_plant"] or total != SYNTH_THRESHOLD:
+        fail(f"shrinker did not reach the known minimum (kinds {kinds}, "
+             f"plant total {total}, expected only synth_plant summing "
+             f"to {SYNTH_THRESHOLD})")
+    if minimal.workload is not None or minimal.n_peers or \
+            minimal.byzantine or minimal.n_followers:
+        fail("shrinker left non-essential axes on the synthetic repro")
+
+    # (4) the corpus-entry loop end to end: armed replay reproduces the
+    # violation deterministically; disarmed ("the fix") replays clean
+    entry = corpus_entry(
+        minimal, Violation("synthetic_bug", shrunk["detail"]),
+        found={"fuzz_seed": seed, "iteration": shrunk["iteration"]},
+        expect="violation",
+    )
+    SYNTH_BUG["armed"] = True
+    try:
+        card = run_simnet(Scenario.from_json(entry["scenario"]))
+        armed_viols = check_invariants(minimal, card)
+    finally:
+        SYNTH_BUG["armed"] = False
+    card = run_simnet(Scenario.from_json(entry["scenario"]))
+    fixed_viols = check_invariants(minimal, card)
+    emit({
+        "phase": "entry_contract",
+        "armed_reproduces": any(
+            v.invariant == "synthetic_bug" for v in armed_viols
+        ),
+        "disarmed_clean": not fixed_viols,
+    })
+    if not any(v.invariant == "synthetic_bug" for v in armed_viols):
+        fail("shrunk corpus entry does not reproduce while armed")
+    if fixed_viols:
+        fail(f"shrunk corpus entry not clean after the fix: {fixed_viols}")
+
+    # (5) the novelty bias earns its keep: distinct scorecard coverage
+    # states per N runs, guided vs uniform, same seed
+    cmp_res = coverage_comparison(seed, n)
+    emit({"phase": "coverage_comparison", **cmp_res})
+    if cmp_res["guided_distinct"] < cmp_res["uniform_distinct"]:
+        fail(f"coverage-guided generation ({cmp_res['guided_distinct']} "
+             f"states) lost to uniform ({cmp_res['uniform_distinct']})")
+
+    emit({
+        "fuzz_smoke": "ok", "seed": seed, "runs_per_phase": n,
+        "corpus_entries": n_corpus,
+        "synthetic_found_and_shrunk": True,
+        "guided_distinct": cmp_res["guided_distinct"],
+        "uniform_distinct": cmp_res["uniform_distinct"],
+        "wall_s": round(time.perf_counter() - t0, 1),
+    })
+
+
+def offline_sweep(seed: int, n: int, synth: bool, corpus_out: str) -> None:
+    def progress(p):
+        if p["violations"] or p["iteration"] % 10 == 9:
+            emit({"phase": "progress", **p})
+
+    res = sweep(seed, n, guided=True, allow_synth=synth, shrink=True,
+                determinism_check=True, on_progress=progress)
+    written = []
+    for v in res["violations"]:
+        if "entry" in v:
+            written.append(write_corpus_entry(v["entry"], corpus_out))
+    emit({
+        "phase": "sweep_done", "seed": seed, "runs": n,
+        "distinct_signatures": res["distinct_signatures"],
+        "violations": [
+            {"iteration": v["iteration"], "invariant": v["invariant"],
+             "detail": v["detail"]}
+            for v in res["violations"]
+        ],
+        "corpus_entries_written": written,
+    })
+    raise SystemExit(1 if res["violations"] else 0)
+
+
+def replay(target: str) -> None:
+    if os.path.exists(target):
+        with open(target) as f:
+            entry = json.load(f)
+        scn = Scenario.from_json(entry["scenario"])
+    else:
+        entry = load_corpus().get(target)
+        if entry is None:
+            fail(f"no corpus entry or file named {target!r}")
+        scn = build_scenario(target)
+    card = run_simnet(scn)
+    viols = check_invariants(scn, card)
+    emit({
+        "phase": "replay", "entry": entry["name"],
+        "violations": [f"{v.invariant}: {v.detail}" for v in viols],
+        # the full flattened counter view, for triage
+        "counters": counter_vector(card),
+        "scorecard": card,
+    })
+    expect = entry.get("expect", "pass")
+    ok = (not viols) if expect == "pass" else bool(viols)
+    raise SystemExit(0 if ok else 1)
+
+
+def soak(minutes: float, seed: int) -> None:
+    """The chaos scenario on the REAL TCP net (ex tools/chaos_soak.py)."""
+    from stellard_tpu.testkit.scenarios import scenario_chaos
+    from stellard_tpu.testkit.tcpnet import run_tcp
+
+    steps = max(60, int(minutes * 60))  # 1 step ~= 1 second
+    scn = scenario_chaos(seed=seed, steps=steps, kill_every=45,
+                         downtime=5)
+    card = run_tcp(scn)
+    card["chaos_minutes"] = minutes
+    card["summary"] = True
+    emit(card)
+    if not card["converged"]:
+        raise SystemExit(f"no convergence: {card['validated_seqs']}")
+    if not card["single_hash"]:
+        raise SystemExit(f"FORK at {card['final_seq']}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--sweep", type=int, metavar="N")
+    ap.add_argument("--compare", type=int, metavar="N")
+    ap.add_argument("--replay", metavar="NAME_OR_FILE")
+    ap.add_argument("--soak", nargs="*", metavar=("MINUTES", "SEED"))
+    ap.add_argument("--seed", type=int,
+                    default=int(os.environ.get("FUZZ_SEED", "7")))
+    ap.add_argument("--synth", action="store_true",
+                    help="arm the planted test-only bug in --sweep")
+    ap.add_argument("--corpus-out", default="/tmp/scenariofuzz-corpus")
+    args = ap.parse_args()
+
+    if args.smoke:
+        smoke(args.seed, int(os.environ.get("FUZZ_N", "30")))
+    elif args.sweep is not None:
+        offline_sweep(args.seed, args.sweep, args.synth, args.corpus_out)
+    elif args.compare is not None:
+        emit(coverage_comparison(args.seed, args.compare))
+    elif args.replay is not None:
+        replay(args.replay)
+    elif args.soak is not None:
+        minutes = float(args.soak[0]) if len(args.soak) > 0 else 12.0
+        seed = int(args.soak[1]) if len(args.soak) > 1 else 7
+        soak(minutes, seed)
+    else:
+        ap.print_help()
+
+
+if __name__ == "__main__":
+    main()

@@ -72,6 +72,29 @@ if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/scenariosmoke.py; then
   exit 2
 fi
 
+echo "== scenario fuzz smoke gate (corpus replay + armed sweep + shrink + coverage bias) =="
+# the scenario-search plane end to end, seeded and bounded: (1) every
+# checked-in minimal-repro corpus entry (the real bugs earlier sweeps
+# found, fixed, and pinned) replays CLEAN through build_scenario;
+# (2) a coverage-guided sweep with the planted test-only bug ARMED must
+# FIND it within the budget and SHRINK it to its known minimal schedule
+# (two plant events, magnitudes summing to the threshold, every other
+# axis stripped); (3) the shrunk entry reproduces deterministically
+# while armed and replays clean once disarmed — the found->shrunk->
+# fixed->pinned loop; (4) any NON-synthetic violation is a new real bug
+# and fails the gate; (5) coverage-guided generation must reach at
+# least as many distinct scorecard dynamics states as uniform random
+# over the same budget. FUZZ_N (default 30) is the per-phase budget —
+# raise it for longer offline sweeps (e.g. FUZZ_N=300 overnight).
+# wall-clock cap scales with the budget (~130s at the default 30)
+FUZZ_N="${FUZZ_N:-30}"
+FUZZ_TIMEOUT=$((120 + FUZZ_N * 16))
+if ! JAX_PLATFORMS=cpu timeout -k 10 "$FUZZ_TIMEOUT" env FUZZ_N="$FUZZ_N" \
+    python tools/scenariofuzz.py --smoke; then
+  echo "FUZZ SMOKE FAILED — scenario search plane is broken (or found a real bug)" >&2
+  exit 2
+fi
+
 echo "== overlay flood smoke gate (200-peer simnet, byzantine flooder -> DROP, squelch bound) =="
 # runs the flood_survival scenario (5-validator core + 195 relay peers,
 # squelched relay, enforced resource pricing, one hostile flooder)
