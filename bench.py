@@ -202,8 +202,17 @@ def _write_detail() -> None:
     try:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_DETAIL.json")
+        # merge, don't clobber: a partial invocation (BENCH_ONLY, a leg
+        # re-run) must not erase the other legs' recorded evidence
+        merged: dict = {}
+        try:
+            with open(path) as f:
+                merged = json.load(f)
+        except (OSError, ValueError):
+            merged = {}
+        merged.update(_DETAIL)
         with open(path, "w") as f:
-            json.dump(_DETAIL, f, indent=1, default=str)
+            json.dump(merged, f, indent=1, default=str)
     except OSError:
         pass  # evidence is best-effort; the bench lines already printed
 
@@ -220,6 +229,16 @@ def _probe_device_backend(budget_s: float) -> bool:
     retrying until `budget_s` of wall clock is spent, not just one attempt.
     """
     import subprocess
+
+    # memoized negative result (BENCH_r04: the 240s probe timeout was
+    # re-paid by later probes in the same round): once a probe fails,
+    # the failure is recorded in the env — inherited by every
+    # subprocess leg — and re-probing is skipped for the rest of THIS
+    # bench invocation. The provenance block shows the memo hit, so an
+    # offline reader sees the fallback was decided once, not retried.
+    if os.environ.get("BENCH_PROBE_MEMO") == "failed":
+        _PROBE_HISTORY.append({"attempt": 0, "outcome": "memoized_failed"})
+        return False
 
     per_attempt = max(
         30.0, float(os.environ.get("BENCH_PROBE_ATTEMPT_TIMEOUT", "120")))
@@ -285,6 +304,10 @@ def _init_device_backend() -> str:
             print("bench: device backend unusable; falling back to cpu",
                   file=sys.stderr)
             os.environ["JAX_PLATFORMS"] = "cpu"
+            # memoize the negative result for the round: later probes
+            # in this invocation (and subprocess legs inheriting the
+            # env) skip straight to the cpu fallback
+            os.environ["BENCH_PROBE_MEMO"] = "failed"
 
     import jax
 
@@ -1568,6 +1591,98 @@ def _offer_workload(n):
     return setup, work
 
 
+def bench_ooc_state(backends):
+    """Out-of-core state plane (ISSUE 13): a ≥5M-account ledger state
+    under a flood-shaped write workload, opened three ways — eager
+    (all-in-RAM baseline), lazy with an unbounded hot-node cache, and
+    lazy with the capped [tree] cache_mb hot set. Each mode runs in its
+    OWN subprocess (clean RSS accounting) against one shared store
+    built once on disk (tools/oocbench.py). The bars: per-close ROOTS
+    byte-identical across all three modes in every rep, capped-mode
+    RSS bounded near the hot set, steady-state close p50 within 15% of
+    the eager baseline. Host-plane leg: no device involved."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    accounts = int(os.environ.get("BENCH_OOC_ACCOUNTS", "5000000"))
+    closes = int(os.environ.get("BENCH_OOC_CLOSES", "30"))
+    writes = int(os.environ.get("BENCH_OOC_WRITES", "200"))
+    keep_dir = os.environ.get("BENCH_OOC_DIR", "")
+    d = keep_dir or tempfile.mkdtemp(prefix="oocbench-")
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "oocbench.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # host-plane leg
+
+    def run(args, timeout=7200):
+        r = subprocess.run(
+            [sys.executable, tool, "--dir", d,
+             "--accounts", str(accounts), *args],
+            capture_output=True, text=True, timeout=timeout, env=env,
+        )
+        if r.returncode != 0:
+            raise RuntimeError(f"oocbench {args}: {r.stderr[-300:]}")
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    try:
+        run(["--build-only"])
+        results = {}
+        for mode in ("eager", "uncapped", "capped"):
+            results[mode] = run([
+                "--mode", mode, "--closes", str(closes),
+                "--writes", str(writes),
+            ])
+
+        def p50(res):
+            cm = sorted(res["close_ms"])
+            return cm[len(cm) // 2]
+
+        # byte-identity across ALL reps (warmup closes included): the
+        # three modes replay one seeded workload, so any divergence is
+        # a faulting bug, not noise
+        roots_ok = (
+            results["eager"]["roots"] == results["uncapped"]["roots"]
+            == results["capped"]["roots"]
+        )
+        eager_p50 = p50(results["eager"])
+        capped_p50 = p50(results["capped"])
+        from tools.oocbench import CACHE_CAPPED_MB
+
+        _emit({
+            "metric": "ooc_state_close_p50_ms",
+            "value": round(capped_p50, 2),
+            "unit": "ms",
+            # lower-is-better ratio: >= 0.87 means the capped run holds
+            # within 15% of the all-in-RAM baseline
+            "vs_baseline": round(eager_p50 / capped_p50, 3)
+            if capped_p50 else 0.0,
+            "cpu_baseline": round(eager_p50, 2),
+            "accounts": accounts,
+            "closes": closes,
+            "writes_per_close": writes,
+            "capped_cache_mb": CACHE_CAPPED_MB,
+            "roots_identical_all_reps": roots_ok,
+            "rss_mb": {
+                m: results[m]["rss_mb_final"] for m in results
+            },
+            "load_s": {m: results[m]["load_s"] for m in results},
+            "cache": {
+                m: {
+                    k: results[m]["cache"][k]
+                    for k in ("faults", "evictions", "resident_bytes",
+                              "hits", "misses")
+                }
+                for m in results
+            },
+            "fallback": False,  # host-plane leg: no device involved
+        })
+        _note_detail("ooc_state", "host", results)
+    finally:
+        if not keep_dir:
+            shutil.rmtree(d, ignore_errors=True)
+
+
 def bench_offer_mix(backends):
     """BASELINE config #2: OfferCreate/OfferCancel order-book mix
     (test/offer-test.js)."""
@@ -2320,6 +2435,7 @@ def main() -> None:
             bench_parallel_spec_flood,
             bench_tree_commit,
             bench_storage_flush,
+            bench_ooc_state,
             bench_offer_mix,
             bench_regular_key_fanout,
             bench_consensus_close,

@@ -469,6 +469,51 @@ int64_t segstore_replay(void* h, const char* path, uint32_t seg_id,
   return (int64_t)off;
 }
 
+// scan segment-format records ([u32 body_len LE | u8 flags | 32B key |
+// u8 type | blob]) in `path` starting at byte `start`, filling parallel
+// arrays: keys_out (32B each), types_out, offs_out (file offset of the
+// BLOB), lens_out (blob length). The decode-on-demand seam of the
+// out-of-core plane: history-shard opens index a whole file of packed
+// records in one C pass (key/type/offset only — blobs stay on disk and
+// are pread on fault) instead of one Python struct unpack per record.
+// Returns the number of clean records found; fills at most `cap` of
+// them (call once with cap=0 to size the arrays); -1 if the file
+// cannot be opened. Stops at the first torn record.
+int64_t segrecs_scan(const char* path, uint64_t start, uint64_t cap,
+                     uint8_t* keys_out, uint8_t* types_out,
+                     uint64_t* offs_out, uint64_t* lens_out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  fseek(f, 0, SEEK_END);
+  uint64_t end = (uint64_t)ftell(f);
+  if (start > end) start = end;
+  fseek(f, (long)start, SEEK_SET);
+  uint64_t off = start;
+  uint64_t n = 0;
+  for (;;) {
+    uint8_t hdr[37];
+    if (!read_exact(f, hdr, 37)) break;
+    uint32_t body_len;
+    memcpy(&body_len, hdr, 4);
+    if (body_len < 1 || off + 37 + body_len > end) break;  // torn tail
+    if (n < cap) {
+      memcpy(keys_out + 32 * n, hdr + 5, 32);
+      uint8_t type_byte;
+      if (!read_exact(f, &type_byte, 1)) break;
+      types_out[n] = type_byte;
+      offs_out[n] = off + 38;      // blob starts after header + type
+      lens_out[n] = body_len - 1;  // body_len counts the type byte
+      if (fseek(f, (long)(body_len - 1), SEEK_CUR) != 0) break;
+    } else {
+      if (fseek(f, (long)body_len, SEEK_CUR) != 0) break;
+    }
+    off += 37 + body_len;
+    n++;
+  }
+  fclose(f);
+  return (int64_t)n;
+}
+
 int cpplog_sync(void* handle) {
   FILE* f = ((Store*)handle)->f;
   if (!f || fflush(f) != 0) return -1;

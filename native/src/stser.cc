@@ -275,6 +275,11 @@ struct MergeCtx {
   std::vector<int> dels;  // delete-count prefix array
   PyObject *inner_cls;
   PyTypeObject *leaf_type;
+  // out-of-core lazy trees (state/shamap.py Stub): node slots on the
+  // op path may be hash-only stubs — resolved (faulted from the store
+  // through the hot-node cache) by calling their .resolve() before
+  // type dispatch. nullptr = eager tree, no checks.
+  PyTypeObject *stub_type;
 };
 
 static inline int merge_nib(const char *k, int depth) {
@@ -332,9 +337,12 @@ static PyObject *merge_build(MergeCtx *c, const char **kb, PyObject **lv,
   return merge_make_inner(c, children);
 }
 
+static PyObject *merge_node(MergeCtx *c, PyObject *node, Py_ssize_t lo,
+                            Py_ssize_t hi, int depth);
+
 // Merge ops[lo:hi) into `node` (borrowed; Py_None = empty subtree);
 // -> NEW reference (Py_None when the subtree empties), nullptr on error.
-static PyObject *merge_node(MergeCtx *c, PyObject *node, Py_ssize_t lo,
+static PyObject *merge_node_impl(MergeCtx *c, PyObject *node, Py_ssize_t lo,
                             Py_ssize_t hi, int depth) {
   if (lo >= hi) {
     Py_INCREF(node);
@@ -460,6 +468,23 @@ static PyObject *merge_node(MergeCtx *c, PyObject *node, Py_ssize_t lo,
     } else if (live == 1 && Py_TYPE(only) == c->leaf_type) {
       out = only;  // single-leaf fold-up (del_item parity)
       Py_INCREF(out);
+    } else if (live == 1 && c->stub_type != nullptr &&
+               Py_TYPE(only) == c->stub_type) {
+      // the fold-up candidate is an unmaterialized stub: fault it to
+      // learn whether it is a leaf (fold to the resolved node) or an
+      // inner (keep the stub slot — subtree unchanged)
+      PyObject *res = PyObject_CallMethod(only, "resolve", nullptr);
+      if (res == nullptr) {
+        for (int b = 0; b < 16; b++)
+          if (owned[b]) Py_DECREF(children[b]);
+        Py_DECREF(ch);
+        return nullptr;
+      }
+      if (Py_TYPE(res) == c->leaf_type) {
+        out = res;  // fold-up through the fault
+      } else {
+        Py_DECREF(res);
+      }
     }
   }
   if (out == nullptr) {
@@ -484,15 +509,40 @@ static PyObject *merge_node(MergeCtx *c, PyObject *node, Py_ssize_t lo,
   return out;
 }
 
+// dispatch shim: fault a stub on the op path (lazy trees) before the
+// Leaf/Inner type dispatch in merge_node_impl; identity for everything
+// else. The resolved node is only borrowed for the recursion — the new
+// tree keeps either fresh dirty inners or the original stub slots.
+static PyObject *merge_node(MergeCtx *c, PyObject *node, Py_ssize_t lo,
+                            Py_ssize_t hi, int depth) {
+  PyObject *resolved = nullptr;
+  if (c->stub_type != nullptr && node != Py_None &&
+      Py_TYPE(node) == c->stub_type) {
+    resolved = PyObject_CallMethod(node, "resolve", nullptr);
+    if (resolved == nullptr) return nullptr;
+    node = resolved;
+  }
+  PyObject *out = merge_node_impl(c, node, lo, hi, depth);
+  Py_XDECREF(resolved);
+  return out;
+}
+
 }  // namespace
 
-// bulk_merge(root, ops, leaf_cls, inner_cls) -> new root node | None
+// bulk_merge(root, ops, leaf_cls, inner_cls[, stub_cls]) -> new root | None
+// stub_cls (state.shamap.Stub) enables lazy trees: op-path stubs fault
+// through their .resolve() before type dispatch (out-of-core plane).
 static PyObject *stser_bulk_merge(PyObject *, PyObject *args) {
-  PyObject *root, *ops, *leaf_cls, *inner_cls;
-  if (!PyArg_ParseTuple(args, "OOOO", &root, &ops, &leaf_cls, &inner_cls))
+  PyObject *root, *ops, *leaf_cls, *inner_cls, *stub_cls = nullptr;
+  if (!PyArg_ParseTuple(args, "OOOO|O", &root, &ops, &leaf_cls, &inner_cls,
+                        &stub_cls))
     return nullptr;
   if (!PyType_Check(leaf_cls)) {
     PyErr_SetString(PyExc_TypeError, "bulk_merge: leaf_cls must be a type");
+    return nullptr;
+  }
+  if (stub_cls != nullptr && stub_cls != Py_None && !PyType_Check(stub_cls)) {
+    PyErr_SetString(PyExc_TypeError, "bulk_merge: stub_cls must be a type");
     return nullptr;
   }
   PyObject *seq = PySequence_Fast(ops, "bulk_merge expects a sequence");
@@ -532,6 +582,9 @@ static PyObject *stser_bulk_merge(PyObject *, PyObject *args) {
   c.kbytes = kbytes.data();
   c.inner_cls = inner_cls;
   c.leaf_type = reinterpret_cast<PyTypeObject *>(leaf_cls);
+  c.stub_type = (stub_cls != nullptr && stub_cls != Py_None)
+                    ? reinterpret_cast<PyTypeObject *>(stub_cls)
+                    : nullptr;
   PyObject *out = merge_node(&c, root, 0, n, 0);
   Py_DECREF(seq);
   return out;
@@ -719,7 +772,8 @@ static PyMethodDef Methods[] = {
      "pack_nodes(nodes, hp_inner, hp_txn, hp_txmd, hp_leaf)"
      " -> (buffer, offsets)"},
     {"bulk_merge", stser_bulk_merge, METH_VARARGS,
-     "bulk_merge(root, sorted_ops, leaf_cls, inner_cls) -> node | None"},
+     "bulk_merge(root, sorted_ops, leaf_cls, inner_cls[, stub_cls])"
+     " -> node | None"},
     {"register_parse", stser_register_parse, METH_VARARGS,
      "register_parse(rows, obj_factory, arr_factory, amount_cb, pathset_cb)"},
     {nullptr, nullptr, 0, nullptr},
@@ -747,7 +801,17 @@ PyMODINIT_FUNC PyInit__stser(void) {
       g_item_name == nullptr || g_ntype_name == nullptr ||
       g_tag_name == nullptr || g_data_name == nullptr)
     return nullptr;
-  return PyModule_Create(&Module);
+  PyObject *mod = PyModule_Create(&Module);
+  if (mod == nullptr) return nullptr;
+  // capability flag probed at bind time (state/shamap.py
+  // _resolve_native): a stale prebuilt library without the bulk_merge
+  // stub door simply lacks the attribute, so lazy trees take the
+  // Python merge instead of discovering a TypeError on every close
+  if (PyModule_AddIntConstant(mod, "BULK_MERGE_STUB_DOOR", 1) < 0) {
+    Py_DECREF(mod);
+    return nullptr;
+  }
+  return mod;
 }
 
 // ---------------------------------------------------------------------------

@@ -25,6 +25,7 @@ __all__ = [
     "Ed25519NativeVerify",
     "CppLogLib",
     "SegIdxNative",
+    "scan_segment_records",
 ]
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
@@ -215,6 +216,20 @@ def _bind(lib: ctypes.CDLL) -> None:
         lib.has_segstore = True
     except AttributeError:
         lib.has_segstore = False
+
+    # record-range scanner (out-of-core history shards): one C pass
+    # indexes a whole file of segment-format records by key/type/offset
+    try:
+        lib.segrecs_scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+            u8p, u8p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.segrecs_scan.restype = ctypes.c_int64
+        lib.has_segrecs_scan = True
+    except AttributeError:
+        lib.has_segrecs_scan = False
 
     try:
         lib.CPPLOG_ITER_CB = ctypes.CFUNCTYPE(
@@ -463,6 +478,38 @@ class SegIdxNative:
         if end < 0:
             raise OSError(f"segstore_replay failed: {path}")
         return int(end), int(recs.value), int(byts.value)
+
+
+def scan_segment_records(path: str, start: int = 0):
+    """Index a file of segment-format records in one native pass:
+    [(key, type_byte, blob_offset, blob_len)] for every clean record —
+    key/type/offset only, blobs stay on disk for decode-on-demand
+    (the history-shard open path). Returns None when the native seam is
+    unavailable (callers fall back to the Python struct loop)."""
+    lib = load_native()
+    if lib is None or not getattr(lib, "has_segrecs_scan", False):
+        return None
+    p = path.encode()
+    n = lib.segrecs_scan(p, start, 0, None, None, None, None)
+    if n < 0:
+        raise OSError(f"segrecs_scan failed: {path}")
+    n = int(n)
+    if n == 0:
+        return []
+    keys = (ctypes.c_uint8 * (32 * n))()
+    types = (ctypes.c_uint8 * n)()
+    offs = (ctypes.c_uint64 * n)()
+    lens = (ctypes.c_uint64 * n)()
+    got = lib.segrecs_scan(p, start, n, keys, types, offs, lens)
+    if got < 0:
+        raise OSError(f"segrecs_scan failed: {path}")
+    got = min(int(got), n)  # a concurrently-truncated tail fills fewer
+    kb = bytes(keys)
+    return [
+        (kb[32 * i: 32 * i + 32], int(types[i]), int(offs[i]),
+         int(lens[i]))
+        for i in range(got)
+    ]
 
 
 class CppLogLib:

@@ -84,6 +84,13 @@ class Config:
     # the same retention horizon on the same drain worker (the
     # NodeStore sweep alone leaves the SQL mirror growing forever)
     node_db_sql_trim: int = 1
+    # history shards ([node_db] shards=): directory where online-
+    # deletion rotation SEALS the retired range as offline-verifiable
+    # shard files before deleting it — below-floor account_tx and
+    # cold-node catch-up serve from these instead of lgrIdxInvalid
+    # (doc/storage.md "History shards"). "1" derives <path>.shards from
+    # the node_db path; empty = off (trimmed history is discarded).
+    node_db_shards: str = ""
     node_db_synchronous: str = ""      # sqlite PRAGMA synchronous= pass
     database_path: str = ""
 
@@ -120,6 +127,11 @@ class Config:
     # batches suit the device kernel, smaller ones keep less residual.
     tree_incremental_seal: bool = True
     tree_drain_batch: int = 256
+    # cache_mb bounds the process-wide hot-node cache — the resident
+    # set of the out-of-core state plane (state/hotcache.py): lazy
+    # trees fault nodes from the NodeStore through this cache and RSS
+    # stays near the budget regardless of ledger size
+    tree_cache_mb: int = 256
 
     # -- admission control ([txq]) -----------------------------------------
     # enabled=1: post-verify intake routes through the TxQ (node/txq.py)
@@ -317,6 +329,7 @@ class Config:
         ):
             if key in node_db:
                 setattr(cfg, attr, conv(node_db[key]))
+        cfg.node_db_shards = node_db.get("shards", cfg.node_db_shards)
         cfg.node_db_synchronous = node_db.get(
             "synchronous", cfg.node_db_synchronous).lower()
         cfg.database_path = one("database_path", cfg.database_path)
@@ -386,6 +399,8 @@ class Config:
             )
         if "drain_batch" in tree:
             cfg.tree_drain_batch = int(tree["drain_batch"])
+        if "cache_mb" in tree:
+            cfg.tree_cache_mb = int(tree["cache_mb"])
 
         subs = _kv(s.get("subs", []))
         for key, attr in (

@@ -27,7 +27,7 @@ from typing import Callable, Optional
 
 from ..overlay.wire import GetLedger, GetSegments, LedgerData, SegmentData
 from ..state.ledger import Ledger, parse_header, strip_ledger_prefix
-from ..state.shamap import SHAMap, TNType
+from ..state.shamap import SHAMap, TNType, resolve_node
 from ..state.shamapsync import IncompleteMap, SHAMapNodeID
 from ..utils.hashes import HP_LEDGER_MASTER, prefix_hash
 
@@ -404,6 +404,7 @@ def serve_get_ledger(ledger: Optional[Ledger], msg: GetLedger) -> Optional[Ledge
         stack = [(nid, node)]
         while stack and len(nodes) < MAX_REPLY_NODES:
             cur_id, cur = stack.pop()
+            cur = resolve_node(cur)  # lazy serving tree: fault on touch
             nodes.append((cur_id.encode(), serialize_node_prefix(cur)))
             if hasattr(cur, "children"):
                 for branch in range(len(cur.children) - 1, -1, -1):
@@ -418,10 +419,11 @@ def serve_get_ledger(ledger: Optional[Ledger], msg: GetLedger) -> Optional[Ledge
 def _descend(tree: SHAMap, nid: SHAMapNodeID):
     node = tree.root
     for nb in nid.nibbles():
+        node = resolve_node(node)
         if node is None or not hasattr(node, "children"):
             return None
         node = node.children[nb]
-    return node
+    return resolve_node(node)
 
 
 # -- segment-granular catch-up ---------------------------------------------

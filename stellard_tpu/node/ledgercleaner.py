@@ -224,8 +224,14 @@ class OnlineDeleter:
     """
 
     def __init__(self, node, retain: int, interval: int = 0,
-                 sql_trim: bool = True):
+                 sql_trim: bool = True, shardstore=None):
         self.node = node
+        # history tiering ([node_db] shards=): the retired range is
+        # sealed into an offline-verifiable shard BEFORE the sweep
+        # deletes it and before trim_below drops its SQL rows — with a
+        # shard store configured, rotation tiers history to cold
+        # storage instead of discarding it (doc/storage.md)
+        self.shardstore = shardstore
         self.retain = max(1, int(retain))
         self.interval = int(interval) if interval > 0 else max(
             1, self.retain // 2
@@ -254,6 +260,8 @@ class OnlineDeleter:
         self.last_retain_floor = 0
         self.sql_rows_trimmed = 0
         self.last_sql_trimmed = 0
+        self.shards_sealed = 0
+        self.seal_failures = 0
 
     # -- hooks -------------------------------------------------------------
 
@@ -324,6 +332,15 @@ class OnlineDeleter:
                             break
                         self._mark_seq(seq, live)
                         seq += 1
+                    if self.shardstore is not None:
+                        # tiering contract: history leaves the live
+                        # store only AFTER its shard sealed — a failed
+                        # seal skips this whole sweep generation (disk
+                        # keeps growing, loudly) rather than deleting
+                        # unsealed history
+                        if not self._seal_retired(lo, live):
+                            db.cancel_sweep()
+                            return
                     removed = db.apply_sweep(live)
                 except Exception:  # noqa: BLE001
                     db.cancel_sweep()
@@ -370,6 +387,58 @@ class OnlineDeleter:
         self.node.close_pipeline.submit_task(
             apply_task, on_failed=apply_failed
         )
+
+    def _seal_retired(self, floor: int, live: set) -> bool:
+        """Seal every stored-but-retiring ledger (seq < floor, above the
+        last sealed shard) into history shards, one shard per contiguous
+        header run. Runs ON the drain worker right before apply_sweep —
+        by drain order no flush is concurrent, so the walked blobs are
+        exactly what the sweep would delete. Returns False when a seal
+        failed (the caller must then skip the sweep)."""
+        from ..nodestore.shards import collect_retired
+
+        txdb = self.node.txdb
+        db = self.node.nodestore
+        sealed_range = self.shardstore.range()
+        start = sealed_range[1] + 1 if sealed_range else 1
+        start = max(start, getattr(txdb, "retain_floor", 0) or 1)
+        runs: list[list[dict]] = []
+        cur: list[dict] = []
+        for seq in range(start, floor):
+            hdr = txdb.get_ledger_header(seq=seq)
+            if hdr is None:
+                if cur:
+                    runs.append(cur)
+                    cur = []
+                continue
+            cur.append(hdr)
+        if cur:
+            runs.append(cur)
+
+        def fetch(h: bytes):
+            obj = db.fetch(h, populate_cache=False)
+            return obj.data if obj is not None else None
+
+        for run in runs:
+            lo_s, hi_s = run[0]["seq"], run[-1]["seq"]
+            try:
+                records = collect_retired(fetch, run, live)
+                acct_rows = txdb.account_tx_index(lo_s, hi_s)
+                self.shardstore.seal(
+                    lo_s, hi_s, records, acct_rows,
+                    first_hash=run[0]["hash"], last_hash=run[-1]["hash"],
+                )
+                with self._lock:
+                    self.shards_sealed += 1
+            except Exception:  # noqa: BLE001 — never delete unsealed
+                with self._lock:
+                    self.seal_failures += 1
+                logging.getLogger("stellard.cleaner").exception(
+                    "history-shard seal failed for [%d, %d] "
+                    "(sweep skipped; disk keeps history)", lo_s, hi_s,
+                )
+                return False
+        return True
 
     def _mark_seq(self, seq: int, live: set) -> None:
         hdr = self.node.txdb.get_ledger_header(seq=seq)
@@ -424,4 +493,7 @@ class OnlineDeleter:
                 "sql_trim": self.sql_trim,
                 "sql_rows_trimmed": self.sql_rows_trimmed,
                 "last_sql_trimmed": self.last_sql_trimmed,
+                "shards_enabled": self.shardstore is not None,
+                "shards_sealed": self.shards_sealed,
+                "seal_failures": self.seal_failures,
             }

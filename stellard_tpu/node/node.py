@@ -167,6 +167,25 @@ class Node:
         self.nodestore = make_database(type=cfg.node_db_type, **db_kwargs)
         self.txdb = TxDatabase(cfg.database_path or ":memory:")
 
+        # out-of-core state plane ([tree] cache_mb): the process-wide
+        # hot-node cache is the resident set for lazily-faulted trees —
+        # apply the operator's budget before anything loads a ledger
+        from ..state.shamap import configure_inner_cache, inner_node_cache
+
+        configure_inner_cache(cfg.tree_cache_mb)
+        inner_node_cache().tracer = self.tracer  # `cache.fault` spans
+
+        # history shards ([node_db] shards=): rotation seals retired
+        # ranges here instead of discarding them (doc/storage.md)
+        self.shardstore = None
+        if cfg.node_db_shards:
+            from ..nodestore.shards import HistoryShardStore
+
+            shards_path = cfg.node_db_shards
+            if shards_path.lower() in ("1", "true", "yes", "on"):
+                shards_path = (cfg.node_db_path or "nodestore") + ".shards"
+            self.shardstore = HistoryShardStore(shards_path)
+
         # stellar CLF plane: SQL mirror + LCL pointer (reference:
         # stellar::gLedgerMaster + workingledger.db, Application.cpp:716)
         from ..state.clf import CLFMirror, LedgerSqlDatabase
@@ -218,6 +237,7 @@ class Node:
                 retain=cfg.node_db_online_delete,
                 interval=cfg.node_db_online_delete_interval,
                 sql_trim=bool(cfg.node_db_sql_trim),
+                shardstore=self.shardstore,
             )
 
         # crypto plane (north star: pluggable cpu|tpu batch backends).
@@ -512,7 +532,18 @@ class Node:
                 from ..overlay.resource import FEE_GARBAGE_SEGMENT
 
                 vn = self.overlay.node
-                vn.segment_source = backend
+                if self.shardstore is not None:
+                    # history tiering: shard rows join the segment
+                    # manifest so a cold peer below our trim floor
+                    # syncs the gap from cold storage over the same
+                    # GetSegments door (nodestore/shards.py)
+                    from ..nodestore.shards import CombinedSegmentSource
+
+                    vn.segment_source = CombinedSegmentSource(
+                        backend, self.shardstore
+                    )
+                else:
+                    vn.segment_source = backend
                 vn.segment_catchup = SegmentCatchup(
                     send=self.overlay.send_segments_request,
                     peers=self.overlay.segment_peers,
@@ -570,7 +601,11 @@ class Node:
             if led is not None:
                 return led
             try:
-                return Ledger.load(self.nodestore, h, hash_batch=self.hasher)
+                # lazy: history reads materialize only the nodes the
+                # caller actually touches (out-of-core plane) — opening
+                # a stored ledger is O(1), not O(state)
+                return Ledger.load(self.nodestore, h,
+                                   hash_batch=self.hasher, lazy=True)
             except (KeyError, ValueError):
                 return None
 
@@ -802,14 +837,16 @@ class Node:
             # state pointer is the atomically-committed source of truth;
             # the txdb header index is the fallback
             led = self.clf.load_last_known(
-                self.nodestore, hash_batch=self.hasher
+                self.nodestore, hash_batch=self.hasher, lazy=True
             )
             if led is None:
                 hdr = self.txdb.get_ledger_header()
                 if hdr is not None:
+                    # lazy resume (out-of-core plane): boot is O(1) in
+                    # state size — the working set faults in on demand
                     led = Ledger.load(
                         self.nodestore, hdr["hash"],
-                        hash_batch=self.hasher,
+                        hash_batch=self.hasher, lazy=True,
                     )
             if led is None:
                 self.ledger_master.start_new_ledger(self.master_keys.account_id)
@@ -1108,6 +1145,8 @@ class Node:
         self.verify_plane.stop()
         self.nodestore.close()
         self.txdb.close()
+        if self.shardstore is not None:
+            self.shardstore.close()
         if self._debug_log_handler is not None:
             import logging
 

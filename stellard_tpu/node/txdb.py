@@ -308,6 +308,26 @@ class TxDatabase:
             ).fetchall()
         return [r[0] for r in rows]
 
+    def account_tx_index(self, min_ledger: int,
+                         max_ledger: int) -> list[tuple]:
+        """Export the account-tx index rows for seqs in [min, max] —
+        (account_bytes, ledger_seq, txn_seq, txid_bytes) — the rows a
+        history-shard seal captures BEFORE trim_below deletes them, so
+        below-floor account_tx pages from cold storage with the same
+        (ledger_seq, txn_seq) marker order."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT Account, LedgerSeq, TxnSeq, TransID "
+                "FROM AccountTransactions "
+                "WHERE LedgerSeq BETWEEN ? AND ? "
+                "ORDER BY LedgerSeq, TxnSeq",
+                (min_ledger, max_ledger),
+            ).fetchall()
+        return [
+            (bytes.fromhex(r[0]), r[1], r[2], bytes.fromhex(r[3]))
+            for r in rows
+        ]
+
     def trim_below(self, ledger_seq: int) -> dict:
         """Delete transaction/ledger history rows STRICTLY below the
         retention horizon — the SQL half of online deletion (the

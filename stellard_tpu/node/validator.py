@@ -769,9 +769,18 @@ class ValidatorNode:
     @_locked
     def serve_get_ledger(self, msg):
         """Answer a peer's GetLedger from our closed-ledger cache."""
+        from ..state.shamap import MissingNodeError
         from .inbound import serve_get_ledger
 
-        return serve_get_ledger(self.lm.get_ledger_by_hash(msg.ledger_hash), msg)
+        try:
+            return serve_get_ledger(
+                self.lm.get_ledger_by_hash(msg.ledger_hash), msg
+            )
+        except MissingNodeError:
+            # a lazily-opened historical ledger whose nodes a sweep has
+            # since retired: we cannot serve it — answer with silence
+            # and the requester's acquisition retries another peer
+            return None
 
     def serve_get_segments(self, msg):
         """Answer a peer's GetSegments from the wired segment source

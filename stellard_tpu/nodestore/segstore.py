@@ -612,6 +612,13 @@ class SegStoreBackend(Backend):
             self._read_fds[sid] = fd
         return fd
 
+    # speculative single-pread size: tree nodes are ≤ ~1KB (an inner is
+    # 517B with the type byte), so one read covers header + body for
+    # nearly every record; only oversized blobs pay a second pread.
+    # Sized so the out-of-core fault path (state/shamap.NodeSource) is
+    # one syscall per cold node.
+    FETCH_CHUNK = 1536
+
     def fetch(self, hash: bytes) -> Optional[NodeObject]:
         with self._lock:
             self.fetches += 1
@@ -621,13 +628,17 @@ class SegStoreBackend(Backend):
                 return None
             sid, off = _loc_split(loc)
             fd = self._read_fd(sid)
-            hdr = os.pread(fd, 5, off)
-            if len(hdr) < 5:
+            buf = os.pread(fd, self.FETCH_CHUNK, off)
+            if len(buf) < 5:
                 raise OSError(
                     f"segstore: index points past segment {sid} end"
                 )
-            body_len = struct.unpack("<I", hdr[:4])[0]
-            body = os.pread(fd, body_len, off + _REC_HEADER)
+            body_len = struct.unpack_from("<I", buf)[0]
+            end = _REC_HEADER + body_len
+            if end <= len(buf):
+                body = buf[_REC_HEADER:end]
+            else:
+                body = os.pread(fd, body_len, off + _REC_HEADER)
             if len(body) != body_len:
                 raise OSError(f"segstore: short record read in seg {sid}")
         return NodeObject(NodeObjectType(body[0]), hash, body[1:])

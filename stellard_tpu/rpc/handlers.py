@@ -52,6 +52,7 @@ from ..protocol.ter import TER
 from ..state import indexes
 from ..state.entryset import LedgerEntrySet
 from ..state.ledger import Ledger
+from ..state.shamap import MissingNodeError
 from .errors import RPCError
 from .infosub import InfoSub, SubscriptionManager
 from .txsign import transaction_sign
@@ -109,6 +110,14 @@ def dispatch(ctx: Context, method: str) -> dict:
         return cached_dispatch(ctx, method, lambda: fn(ctx))
     except RPCError as exc:
         return exc.to_json()
+    except MissingNodeError as exc:
+        # a lazily-opened historical ledger faulted a node the store no
+        # longer holds (online-deletion sweep retired it mid-cache-life)
+        # — that is "this history is gone", not an internal error
+        return RPCError(
+            "lgrNotFound",
+            f"historical state no longer retained ({exc})",
+        ).to_json()
     except Exception as exc:  # noqa: BLE001 — handler bug must not kill the door
         import traceback
 
@@ -198,8 +207,13 @@ def _load_historical(ctx: Context, ledger_hash: bytes) -> Optional[Ledger]:
     rebuilt ledger re-enters the cache so a polling client only pays the
     reconstruction once."""
     try:
+        # lazy: an RPC touching one account of a historical ledger must
+        # not deserialize the whole tree (out-of-core plane); cold: its
+        # faults enter the hot cache one epoch behind, so a deep
+        # history scan cannot thrash the serving snapshot's working set
         led = Ledger.load(
-            ctx.node.nodestore, ledger_hash, hash_batch=ctx.node.hasher
+            ctx.node.nodestore, ledger_hash, hash_batch=ctx.node.hasher,
+            lazy=True, cold=True,
         )
     except (KeyError, ValueError, AttributeError):
         return None
@@ -534,10 +548,16 @@ def do_get_counts(ctx: Context) -> dict:
     if spec_ex is not None:
         # parallel speculation plane (engine/specexec.py)
         out["spec"] = spec_ex.get_json()
-    # from_store inner-node memo (catch-up fetch path re-parse saver)
+    # out-of-core state plane: the bounded hot-node cache — hit/miss/
+    # fault/evict + resident_bytes evidence for the lazy-faulting tier
+    # (state/hotcache.py; [tree] cache_mb)
     from ..state.shamap import inner_node_cache
 
     out["shamap_inner_cache"] = inner_node_cache().get_json()
+    # history-shard tier: sealed ranges + cold-read counters
+    shardstore = getattr(node, "shardstore", None)
+    if shardstore is not None:
+        out["history_shards"] = shardstore.get_json()
     # subscription-fanout plane (`subs.*`): shards, bounded-queue drops,
     # slow-consumer evictions, publish→deliver lag, HTTP-push stats
     subs = getattr(node, "subs", None)
@@ -1056,12 +1076,41 @@ def do_account_tx(ctx: Context) -> dict:
         except (TypeError, KeyError, ValueError):
             raise RPCError("invalidParams", "malformed marker")
     # sql_trim retention floor: rows strictly below it were deleted by
-    # online-deletion rotation. A marker pointing below the floor (a
-    # pager resuming across a trim) and a window lying entirely below
-    # it must both fail CLEANLY — a silent empty page would end a
-    # well-behaved pagination loop as if history were complete
+    # online-deletion rotation. With history shards configured
+    # ([node_db] shards=, doc/storage.md) the below-floor portion
+    # routes to cold storage instead; WITHOUT them, a marker pointing
+    # below the floor (a pager resuming across a trim) and a window
+    # lying entirely below it must both fail CLEANLY — a silent empty
+    # page would end a well-behaved pagination loop as if history were
+    # complete
     floor = getattr(ctx.node.txdb, "retain_floor", 0)
-    if floor > 0:
+    shardstore = getattr(ctx.node, "shardstore", None)
+    shard_range = shardstore.range() if shardstore is not None else None
+    shards_cover_below = (
+        floor > 0 and shard_range is not None and min_l < floor
+    )
+    if shards_cover_below:
+        # the shard tier only covers [shard_lo, floor): history below
+        # the FIRST sealed shard (trimmed before shards were enabled)
+        # is gone everywhere, and must keep the clean lgrIdxInvalid /
+        # clamp-and-echo contract — never a quietly complete-looking
+        # page with a hole at the front
+        shard_lo = shard_range[0]
+        if min_l < shard_lo:
+            if after is not None and after[0] < shard_lo:
+                raise RPCError(
+                    "lgrIdxInvalid",
+                    f"marker ledger {after[0]} is below the oldest "
+                    f"sealed history shard ({shard_lo})",
+                )
+            if max_l < shard_lo:
+                raise RPCError(
+                    "lgrIdxInvalid",
+                    f"requested window ends below the oldest sealed "
+                    f"history shard ({shard_lo})",
+                )
+            min_l = shard_lo  # serve what exists; echo effective min
+    if floor > 0 and not shards_cover_below:
         if after is not None and after[0] < floor:
             raise RPCError(
                 "lgrIdxInvalid",
@@ -1082,11 +1131,44 @@ def do_account_tx(ctx: Context) -> dict:
             min_l = floor
     # fetch one extra row: its presence means the walk was truncated and
     # a resume marker must be returned (AccountTx.cpp resumeToken)
-    rows = ctx.node.txdb.account_transactions(
-        account_id, min_l, max_l, limit + 1, forward, after=after
-    )
+    want = limit + 1
+    if shards_cover_below:
+        # two-tier walk, cold shards below the floor + SQL at/above it,
+        # in one consistent (ledger_seq, txn_seq) order; the EXCLUSIVE
+        # `after` marker filters identically in both tiers, so a pager
+        # resumes seamlessly across the boundary
+        shard_hi = min(max_l, floor - 1)
+        rows = []
+        if forward:
+            # a resume marker at/above the floor already consumed the
+            # whole shard tier (every shard row is < floor and the
+            # marker is exclusive) — skip the cold-storage walk
+            if after is None or after[0] < floor:
+                rows.extend(shardstore.account_tx(
+                    account_id, min_l, shard_hi, want, True, after=after
+                ))
+            if len(rows) < want and max_l >= floor:
+                rows.extend(ctx.node.txdb.account_transactions(
+                    account_id, floor, max_l, want - len(rows), True,
+                    after=after,
+                ))
+        else:
+            if max_l >= floor:
+                rows.extend(ctx.node.txdb.account_transactions(
+                    account_id, floor, max_l, want, False, after=after,
+                ))
+            if len(rows) < want:
+                rows.extend(shardstore.account_tx(
+                    account_id, min_l, shard_hi, want - len(rows), False,
+                    after=after,
+                ))
+    else:
+        rows = ctx.node.txdb.account_transactions(
+            account_id, min_l, max_l, want, forward, after=after
+        )
     more = len(rows) > limit
     rows = rows[:limit]
+    served_from_shards = any("shard" in r for r in rows)
     txs = []
     for r in rows:
         if binary:
@@ -1105,6 +1187,10 @@ def do_account_tx(ctx: Context) -> dict:
             entry = {"tx": j, "validated": True}
             if r["meta"]:
                 entry["meta"] = STObject.from_bytes(r["meta"]).to_json()
+        if "shard" in r:
+            # cold-storage provenance: this row came off a sealed
+            # history shard, not the live SQL index
+            entry["shard"] = r["shard"]
         txs.append(entry)
     out = {
         "account": p["account"],
@@ -1113,6 +1199,8 @@ def do_account_tx(ctx: Context) -> dict:
         "limit": limit,
         "transactions": txs,
     }
+    if served_from_shards:
+        out["history_shards"] = True
     if more and rows:
         out["marker"] = {
             "ledger": rows[-1]["ledger_seq"],

@@ -108,6 +108,14 @@ class ReadPlane:
         self.published += 1
         if self.cache is not None:
             self.cache.on_new_seq(ledger.seq)
+        # out-of-core epoch contract: stamp the hot-node cache with the
+        # new validated seq — nodes the serving snapshot touches from
+        # here carry this epoch, and eviction takes older-epoch entries
+        # first, so a history scan cannot thrash the snapshot's working
+        # set out from under in-flight reads (state/hotcache.py)
+        from ..state.shamap import inner_node_cache
+
+        inner_node_cache().advance_epoch(ledger.seq)
 
     def snapshot(self):
         return self._snap

@@ -291,17 +291,20 @@ class CLFMirror:
 
     # -- resume -------------------------------------------------------------
 
-    def load_last_known(self, nodestore, hash_batch=None):
+    def load_last_known(self, nodestore, hash_batch=None, lazy=False):
         """reference loadLastKnownCLF: resume the chain from the SQL state
         pointer, rebuilding the ledger from the NodeStore; returns the
-        Ledger or None when there is nothing (or something broken) saved."""
+        Ledger or None when there is nothing (or something broken) saved.
+        `lazy` opens the trees with on-demand node faulting (O(1) boot
+        regardless of state size, out-of-core plane)."""
         from .ledger import Ledger
 
         lkcl = self.last_closed_hash
         if not lkcl:
             return None
         try:
-            led = Ledger.load(nodestore, lkcl, hash_batch=hash_batch)
+            led = Ledger.load(nodestore, lkcl, hash_batch=hash_batch,
+                              lazy=lazy)
         except (KeyError, ValueError):
             return None
         return led

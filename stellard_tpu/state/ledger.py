@@ -432,10 +432,19 @@ class Ledger:
 
     @classmethod
     def load(cls, db: Database, ledger_hash: bytes,
-             hash_batch: Optional[Callable] = None) -> "Ledger":
+             hash_batch: Optional[Callable] = None,
+             lazy: bool = False, cold: bool = False) -> "Ledger":
         """Rebuild a ledger (header + both trees) from the NodeStore —
         the checkpoint/resume path (reference: Application loadOldLedger,
-        Ledger::Ledger(blob) Ledger.cpp:120-175)."""
+        Ledger::Ledger(blob) Ledger.cpp:120-175).
+
+        With `lazy` (the out-of-core plane) only the header and the two
+        tree ROOTS are read now; every other node is a hash-only stub
+        that faults from this store through the bounded hot-node cache
+        on first touch. Opening a million-account ledger is O(1); the
+        eager path's whole-tree hash re-verification is traded for
+        per-node content verification at fault time (the same check,
+        paid lazily)."""
         obj = db.fetch(ledger_hash)
         if obj is None:
             raise KeyError(f"missing ledger {ledger_hash.hex()}")
@@ -452,7 +461,16 @@ class Ledger:
                 fetched.add(h)
             return o.data if o else None
 
-        kw = {"hash_batch": hash_batch} if hash_batch else {}
+        kw: dict = {"hash_batch": hash_batch} if hash_batch else {}
+        if lazy:
+            def fetch(h: bytes) -> Optional[bytes]:  # noqa: F811
+                o = db.fetch(h)
+                return o.data if o else None
+
+            # store_known=db.flushed marks the trees as backed by THIS
+            # store: flushing them (or descendants sharing their
+            # subtrees) back into it never faults clean cold branches
+            kw.update(lazy=True, store_known=db.flushed, cold=cold)
         led = cls(
             seq=f["seq"],
             parent_hash=f["parent_hash"],
@@ -473,7 +491,10 @@ class Ledger:
                 f"ledger hash mismatch after load: want {ledger_hash.hex()} "
                 f"got {led.hash().hex()}"
             )
-        # only after the full tree verified do the fetched nodes count as
-        # known-good in this store (a corrupt node must stay rewritable)
-        db.flushed.update(fetched)
+        if not lazy:
+            # only after the full tree verified do the fetched nodes
+            # count as known-good in this store (a corrupt node must
+            # stay rewritable); the lazy path never claims this — each
+            # node verifies at fault time instead
+            db.flushed.update(fetched)
         return led

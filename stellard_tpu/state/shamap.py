@@ -41,8 +41,21 @@ from ..utils.hashes import (
 
 __all__ = [
     "TNType", "SHAMapItem", "SHAMap", "Leaf", "Inner",
-    "encode_nodes", "inner_node_cache",
+    "Stub", "LazyInner", "NodeSource", "MissingNodeError",
+    "resolve_node",
+    "encode_nodes", "inner_node_cache", "configure_inner_cache",
 ]
+
+
+class MissingNodeError(KeyError):
+    """A tree node could not be fetched from the store. On lazy trees
+    this can surface MID-WALK, long after the tree opened — e.g. an
+    online-deletion sweep retired a cached historical ledger's nodes —
+    so consumers that used to rely on Ledger.load's all-or-nothing
+    materialization catch THIS (rpc dispatch maps it to lgrNotFound;
+    the overlay serving path answers with silence) instead of leaking
+    a bare KeyError."""
+
 
 ZERO256 = b"\x00" * 32
 
@@ -139,6 +152,165 @@ class Inner:
 EMPTY_INNER = Inner((None,) * 16, hash=ZERO256)
 
 
+# --------------------------------------------------------------------------
+# out-of-core faulting: Stub / LazyInner / NodeSource (doc/storage.md)
+#
+# A lazy tree holds *unmaterialized* child slots: a `Stub` knows only a
+# node hash and the `NodeSource` to fault it from. Stubs always carry a
+# hash (`_hash` is set at construction), so every hash-driven fast path
+# — compute_hashes skipping sealed subtrees, compare's hash
+# short-circuit, encode_nodes reading child hashes — works on a stub
+# without touching the store. Only an actual *descent* through the slot
+# faults, and the faulted node lives in the process-wide HotNodeCache
+# (state/hotcache.py), NOT in the tree: the slot keeps its stub, so
+# evicting the cache entry really frees the node and the resident set
+# stays bounded by `[tree] cache_mb` regardless of state size.
+
+
+class Stub:
+    """Unmaterialized child slot: hash + where to fault it from."""
+
+    __slots__ = ("_hash", "source")
+
+    def __init__(self, hash: bytes, source: "NodeSource"):
+        self._hash = hash
+        self.source = source
+
+    def resolve(self):
+        """Fault the node (through the hot cache). Also the native
+        bulk_merge's stub door — stser.cc calls this by name."""
+        return self.source.load(self._hash)
+
+    def __repr__(self):
+        return f"Stub({self._hash.hex()[:16]}…)"
+
+
+class LazyInner(Inner):
+    """Faulted inner node that stays PACKED: the 512-byte child-hash
+    area is kept as one bytes object (the flat-buffer seam —
+    native/src/nodestore.cc's record layout hands it over verbatim) and
+    `child(b)` resolves straight off a 32-byte slice. The 16-slot
+    `children` tuple of Stub objects materializes only when something
+    iterates it (mutation copies, whole-subtree walks); key-guided
+    descents (`get`, `succ`, bulk_update path prefaults) never pay for
+    the 16 sibling objects."""
+
+    __slots__ = ("raw", "source")
+
+    def __init__(self, raw: bytes, source: "NodeSource", hash: bytes):
+        # deliberately NOT calling Inner.__init__: the `children` slot
+        # stays unset until __getattr__ materializes it
+        self.raw = raw
+        self.source = source
+        self._hash = hash
+
+    def __getattr__(self, name):
+        if name == "children":
+            raw, src = self.raw, self.source
+            ch = tuple(
+                None if raw[i * 32: (i + 1) * 32] == ZERO256
+                else Stub(raw[i * 32: (i + 1) * 32], src)
+                for i in range(16)
+            )
+            # benign write race: concurrent materializers build equal
+            # tuples of content-addressed stubs; either assignment wins
+            self.children = ch
+            return ch
+        raise AttributeError(name)
+
+    def child_hash(self, b: int) -> bytes:
+        return self.raw[b * 32: (b + 1) * 32]
+
+    def child(self, b: int):
+        h = self.raw[b * 32: (b + 1) * 32]
+        if h == ZERO256:
+            return None
+        return self.source.load(h)
+
+    def is_empty(self) -> bool:
+        return self.raw == ZERO256 * 16
+
+    def branch_count(self) -> int:
+        raw = self.raw
+        return sum(
+            1 for i in range(16)
+            if raw[i * 32: (i + 1) * 32] != ZERO256
+        )
+
+
+class NodeSource:
+    """The fault door of a lazy tree: content-addressed loads through
+    the process-wide hot-node cache, single-flight per hash.
+
+    `known` is the identity of the backing store (the Database's
+    `flushed` set): SHAMap.flush skips any stub/lazy subtree whose
+    source carries the same `known` object — those bytes are already
+    durably in that store, so a close's save never faults the cold
+    tail just to re-write it.
+
+    `cold` marks a historical scan (an RPC touching an old ledger):
+    its faults enter the hot cache one epoch behind, so a deep history
+    walk becomes first-pass eviction fodder instead of flushing the
+    serving snapshot's working set (the readplane epoch contract)."""
+
+    __slots__ = ("fetch", "verify", "known", "cold")
+
+    def __init__(self, fetch: Callable[[bytes], Optional[bytes]],
+                 verify: bool = True, known: Optional[set] = None,
+                 cold: bool = False):
+        self.fetch = fetch
+        self.verify = verify
+        self.known = known
+        self.cold = cold
+
+    def load(self, h: bytes):
+        """Leaf | LazyInner for `h`, faulting through the hot cache."""
+        return inner_node_cache().get_or_load(h, self._load,
+                                              cold=self.cold)
+
+    def _load(self, h: bytes):
+        blob = self.fetch(h)
+        if blob is None:
+            raise MissingNodeError(f"missing node {h.hex()}")
+        if self.verify:
+            from ..utils.hashes import sha512_half
+
+            if sha512_half(blob) != h:
+                raise ValueError(
+                    f"node content hash mismatch: key {h.hex()[:16]}"
+                )
+        if len(blob) >= 4 and \
+                int.from_bytes(blob[:4], "big") == HP_INNER_NODE:
+            if len(blob) != 516:
+                raise ValueError(f"bad inner node length {len(blob) - 4}")
+            return LazyInner(blob[4:], self, h), len(blob)
+        node = deserialize_node_prefix(blob)
+        if isinstance(node, InnerStub):  # unreachable; defensive
+            raise ValueError("inner blob misclassified")
+        node._hash = h
+        return node, len(blob)
+
+
+def resolve_node(node):
+    """Fault `node` if it is a stub; identity otherwise. The accessor
+    every traversal outside this module uses before type-dispatching on
+    Leaf/Inner (state/shamapsync.py walks, node/inbound.py serving)."""
+    if type(node) is Stub:
+        return node.resolve()
+    return node
+
+
+_resolve = resolve_node
+
+
+def _step(node, b: int):
+    """Child slot `b` of an inner: plain tuple index for Inner, packed
+    raw-slice fault for LazyInner (no sibling-stub materialization)."""
+    if type(node) is Inner:
+        return node.children[b]
+    return node.child(b)
+
+
 def _nibble(key: bytes, depth: int) -> int:
     """Branch index at `depth` (reference: SHAMapNodeID::selectBranch —
     high nibble at even depths, low nibble at odd)."""
@@ -151,6 +323,7 @@ def _nibble(key: bytes, depth: int) -> int:
 
 
 def _set_item(node, key: bytes, leaf: Leaf, depth: int):
+    node = _resolve(node)
     if node is None:
         return leaf
     if isinstance(node, Leaf):
@@ -180,6 +353,7 @@ def _del_item(node, key: bytes, depth: int):
     """Returns the replacement node (None if subtree empty), or raises
     KeyError. Collapses single-leaf inners on the way up (reference:
     SHAMap::delItem single-child fold-up)."""
+    node = _resolve(node)
     if node is None:
         raise KeyError(key.hex())
     if isinstance(node, Leaf):
@@ -191,8 +365,10 @@ def _del_item(node, key: bytes, depth: int):
     children = list(node.children)
     children[b] = new_child
     live = [c for c in children if c is not None]
-    if len(live) == 1 and isinstance(live[0], Leaf):
-        return live[0]
+    if len(live) == 1:
+        only = _resolve(live[0])  # the fold-up candidate may be a stub
+        if isinstance(only, Leaf):
+            return only
     if not live:
         return None
     return Inner(tuple(children))
@@ -241,6 +417,7 @@ def _bulk_merge(node, ops: list, lo: int, hi: int, depth: int,
     property the differential suite pins."""
     if lo >= hi:
         return node
+    node = _resolve(node)
     if hi - lo == 1:
         # singleton run: the lean per-key primitives finish the path
         k, leaf = ops[lo]
@@ -299,29 +476,34 @@ def _bulk_merge(node, ops: list, lo: int, hi: int, depth: int,
     live = [c for c in children if c is not None]
     if not live:
         return None
-    if len(live) == 1 and isinstance(live[0], Leaf):
-        return live[0]  # single-leaf fold-up (del_item parity)
+    if len(live) == 1:
+        only = _resolve(live[0])  # the fold-up candidate may be a stub
+        if isinstance(only, Leaf):
+            return only  # single-leaf fold-up (del_item parity)
     return Inner(tuple(children))
 
 
 def _get(node, key: bytes, depth: int) -> Optional[SHAMapItem]:
     while node is not None:
+        node = _resolve(node)
         if isinstance(node, Leaf):
             return node.item if node.item.tag == key else None
-        node = node.children[_nibble(key, depth)]
+        node = _step(node, _nibble(key, depth))
         depth += 1
     return None
 
 
 def _walk_leaves(node) -> Iterator[Leaf]:
     """Leaves in ascending key order (radix order == numeric order)."""
+    node = _resolve(node)
     if node is None:
         return
     if isinstance(node, Leaf):
         yield node
         return
     for c in node.children:
-        yield from _walk_leaves(c)
+        if c is not None:
+            yield from _walk_leaves(c)
 
 
 # --------------------------------------------------------------------------
@@ -365,14 +547,22 @@ _PFX_LEAF = {t: p.to_bytes(4, "big") for t, p in _LEAF_PREFIX.items()}
 
 _native_pack = None
 _native_merge = None
+_native_merge_stub_ok = False
 _native_resolved = False
 
 
 def _resolve_native():
     """Bind the C fast paths (native/src/stser.cc pack_nodes +
     bulk_merge) once; pure-Python loops otherwise. Both are
-    differential-tested byte-equal against the Python implementations."""
-    global _native_pack, _native_merge, _native_resolved
+    differential-tested byte-equal against the Python implementations.
+
+    The stub door (bulk_merge's optional 5th arg, faulting lazy-tree
+    stubs on the op path) is probed HERE via the module's
+    BULK_MERGE_STUB_DOOR capability constant: a stale prebuilt library
+    lacks it, and lazy trees then take the stub-aware Python merge
+    instead of paying a TypeError round-trip on every bulk_update."""
+    global _native_pack, _native_merge, _native_merge_stub_ok, \
+        _native_resolved
     if not _native_resolved:
         _native_resolved = True
         try:
@@ -381,8 +571,13 @@ def _resolve_native():
             mod = load_stser()
             _native_pack = getattr(mod, "pack_nodes", None)
             _native_merge = getattr(mod, "bulk_merge", None)
+            _native_merge_stub_ok = (
+                _native_merge is not None
+                and getattr(mod, "BULK_MERGE_STUB_DOOR", 0) >= 1
+            )
         except Exception:  # noqa: BLE001 — toolchain-less box: python path
             _native_pack = _native_merge = None
+            _native_merge_stub_ok = False
 
 
 def _resolve_native_pack():
@@ -521,24 +716,29 @@ def serialize_node_wire(node) -> bytes:
     return item.data + item.tag + bytes([trailer])
 
 
-# process-wide memo of deserialized-and-resolved inner nodes, keyed by
-# node hash (content-addressed, so sharing across stores/trees is always
-# sound). The catch-up fetch path (Ledger.load / replay_range) re-parsed
-# every shared inner of every ledger it materialized; a hit here returns
-# the whole resolved subtree in O(1). Bounded + aged (TaggedCache), with
-# hit/miss counters surfaced in get_counts.
+# process-wide memo of deserialized-and-resolved nodes, keyed by node
+# hash (content-addressed, so sharing across stores/trees is always
+# sound). Since the out-of-core plane this is the byte-bounded,
+# epoch-aware HotNodeCache (state/hotcache.py): for lazy trees it IS
+# the resident hot set ([tree] cache_mb) and its fault counters are the
+# out-of-core evidence in get_counts.shamap_inner_cache; for the eager
+# from_store path it plays the old TaggedCache role (a hit returns a
+# whole resolved subtree in O(1)).
 _INNER_CACHE = None
 
 
 def inner_node_cache():
     global _INNER_CACHE
     if _INNER_CACHE is None:
-        from ..utils.taggedcache import TaggedCache
+        from .hotcache import HotNodeCache
 
-        _INNER_CACHE = TaggedCache(
-            "shamap_inners", target_size=4096, expiration_s=300.0
-        )
+        _INNER_CACHE = HotNodeCache("shamap_inners")
     return _INNER_CACHE
+
+
+def configure_inner_cache(cache_mb: int) -> None:
+    """Apply the `[tree] cache_mb` budget (node setup)."""
+    inner_node_cache().set_limit(max(1, int(cache_mb)) << 20)
 
 
 class InnerStub:
@@ -614,10 +814,16 @@ class SHAMap:
     """
 
     def __init__(self, leaf_type: TNType = TNType.ACCOUNT_STATE, root=None,
-                 hash_batch: Callable = _default_hasher):
+                 hash_batch: Callable = _default_hasher,
+                 source: Optional[NodeSource] = None):
         self.leaf_type = leaf_type
         self.root = root if root is not None else EMPTY_INNER
         self.hash_batch = hash_batch
+        # non-None marks a lazy tree (out-of-core faulting): descents
+        # may hit Stub slots, so bulk_update must hand the native merge
+        # the Stub class (its fault door) or take the stub-aware Python
+        # merge on a stale library
+        self._source = source
 
     # -- queries ----------------------------------------------------------
 
@@ -628,9 +834,10 @@ class SHAMap:
         """Typed leaf lookup, O(depth)."""
         node, depth = self.root, 0
         while node is not None:
+            node = _resolve(node)
             if isinstance(node, Leaf):
                 return node if node.item.tag == key else None
-            node = node.children[_nibble(key, depth)]
+            node = _step(node, _nibble(key, depth))
             depth += 1
         return None
 
@@ -661,17 +868,21 @@ class SHAMap:
         branch first, then scan higher branches for their smallest leaf."""
 
         def smallest(node) -> Optional[SHAMapItem]:
+            node = _resolve(node)
             while isinstance(node, Inner):
-                node = next((c for c in node.children if c is not None), None)
+                node = _resolve(
+                    next((c for c in node.children if c is not None), None)
+                )
             return node.item if node is not None else None
 
         def descend(node, depth) -> Optional[SHAMapItem]:
+            node = _resolve(node)
             if node is None:
                 return None
             if isinstance(node, Leaf):
                 return node.item if node.item.tag > key else None
             b = _nibble(key, depth)
-            found = descend(node.children[b], depth + 1)
+            found = descend(_step(node, b), depth + 1)
             if found is not None:
                 return found
             for c in node.children[b + 1 :]:
@@ -724,9 +935,21 @@ class SHAMap:
             return 0
         sorted_ops = sorted(ops.items())
         merge_c = _resolve_native_merge()
+        root = None
+        merged = False
         if merge_c is not None:
-            root = merge_c(self.root, sorted_ops, Leaf, Inner)
-        else:
+            if self._source is None:
+                root = merge_c(self.root, sorted_ops, Leaf, Inner)
+                merged = True
+            elif _native_merge_stub_ok:
+                # lazy tree: the native merge faults op-path stubs via
+                # Stub.resolve (stser.cc stub door); the capability was
+                # probed at bind time (_resolve_native), so a stale
+                # prebuilt library falls through to the stub-aware
+                # Python merge below
+                root = merge_c(self.root, sorted_ops, Leaf, Inner, Stub)
+                merged = True
+        if not merged:
             dels = [0] * (len(sorted_ops) + 1)
             for i, (_k, leaf) in enumerate(sorted_ops):
                 dels[i + 1] = dels[i] + (leaf is None)
@@ -759,7 +982,8 @@ class SHAMap:
 
     def snapshot(self) -> "SHAMap":
         """O(1) immutable snapshot: share the persistent root."""
-        return SHAMap(self.leaf_type, self.root, self.hash_batch)
+        return SHAMap(self.leaf_type, self.root, self.hash_batch,
+                      source=self._source)
 
     # -- delta ------------------------------------------------------------
 
@@ -782,6 +1006,9 @@ class SHAMap:
         def walk(a, b):
             if len(delta) > limit or same(a, b):
                 return
+            # resolve only AFTER the hash short-circuit: shared subtrees
+            # (stub vs anything carrying the same hash) never fault
+            a, b = _resolve(a), _resolve(b)
             if a is None or isinstance(a, Leaf):
                 a_items = {a.item.tag: a.item} if isinstance(a, Leaf) else {}
             else:
@@ -850,6 +1077,16 @@ class SHAMap:
         def visit(node):
             if node is None or node._hash in known:
                 return
+            # lazy subtrees: a stub or faulted-but-clean node whose
+            # source is backed by THIS store ("known" is the source's
+            # own flushed set) is already durably present — skip the
+            # whole subtree without faulting it. Flushing into a
+            # DIFFERENT store materializes and writes as usual.
+            src = getattr(node, "source", None)
+            if src is not None and src.known is known:
+                return
+            if type(node) is Stub:
+                node = node.source.load(node._hash)
             if isinstance(node, Inner):
                 for c in node.children:
                     visit(c)
@@ -885,6 +1122,9 @@ class SHAMap:
         hash_batch: Callable = _default_hasher,
         verify: bool = True,
         use_cache: bool = True,
+        lazy: bool = False,
+        store_known: Optional[set] = None,
+        cold: bool = False,
     ) -> "SHAMap":
         """Materialize a full tree from a content-addressed store
         (reference: SHAMap fetchNodeExternal path). Raises KeyError on a
@@ -892,6 +1132,16 @@ class SHAMap:
         with `verify` (default), ValueError when a fetched blob does not
         hash to its key (the reference verifies fetched nodes the same
         way, SHAMapTreeNode ctor hashValid path).
+
+        With `lazy` (the out-of-core plane, doc/storage.md), only the
+        ROOT node is fetched now; every child slot is a hash-only Stub
+        that faults from the store through the bounded hot-node cache
+        on first descent. Opening a million-account ledger is O(1);
+        walks, succ cursors, bulk_update's DFS and the delta-replay
+        splice all fault on demand, byte-identical to the eager tree.
+        `store_known` identifies the backing store (the Database's
+        `flushed` set) so flushing back into the same store never
+        faults clean subtrees just to re-write them.
 
         With `use_cache` (default), resolved inner nodes memoize in the
         process-wide `inner_node_cache()` keyed by node hash — a hit
@@ -901,16 +1151,29 @@ class SHAMap:
         sharing sound across stores and trees."""
         if root_hash == ZERO256:
             return cls(leaf_type, EMPTY_INNER, hash_batch)
+        if lazy:
+            source = NodeSource(fetch, verify=verify, known=store_known,
+                                cold=cold)
+            root = source.load(root_hash)
+            if isinstance(root, Leaf):
+                children = [None] * 16
+                children[_nibble(root.item.tag, 0)] = root
+                root = Inner(tuple(children))
+            return cls(leaf_type, root, hash_batch, source=source)
         cache = inner_node_cache() if use_cache else None
 
         def load(h: bytes):
             if cache is not None:
                 hit = cache.get(h)
-                if hit is not None:
+                # a LazyInner hit (faulted by the out-of-core plane)
+                # must not leak into an EAGER tree: its descendants are
+                # stubs, and eager trees (source=None) promise
+                # stub-free structure to the native merge fast path
+                if hit is not None and type(hit) is not LazyInner:
                     return hit
             blob = fetch(h)
             if blob is None:
-                raise KeyError(f"missing node {h.hex()}")
+                raise MissingNodeError(f"missing node {h.hex()}")
             node = deserialize_node_prefix(blob)
             if verify:
                 # prefix-format blob == exactly the hashed bytes
@@ -928,7 +1191,10 @@ class SHAMap:
                 )
                 node = Inner(children, hash=h)
                 if cache is not None:
-                    cache.put(h, node)
+                    # eager: this entry pins its whole materialized
+                    # subtree, so it rides the EAGER_ENTRY_CAP count
+                    # bound, not the per-node byte budget
+                    cache.put(h, node, eager=True)
             else:
                 node._hash = h
             return node

@@ -212,9 +212,12 @@ class FetchPack:
 
 
 def _walk_nodes(map: SHAMap) -> Iterator[tuple[bytes, bytes]]:
+    from .shamap import resolve_node
+
     map.get_hash()
 
     def visit(node):
+        node = resolve_node(node)  # lazy trees: fault before serving
         if node is None:
             return
         yield node._hash, serialize_node_prefix(node)
@@ -243,10 +246,13 @@ def make_fetch_pack(
                 break
         return FetchPack(pairs)
 
+    from .shamap import resolve_node
+
     base.get_hash()
     base_hashes: set[bytes] = set()
 
     def collect(node):
+        node = resolve_node(node)
         if node is None:
             return
         base_hashes.add(node._hash)
@@ -265,6 +271,7 @@ def make_fetch_pack(
     def visit(node):
         if node is None or node._hash in base_hashes or len(pairs) >= max_nodes:
             return
+        node = resolve_node(node)  # hash checks above never fault
         pairs.append((node._hash, serialize_node_prefix(node)))
         if hasattr(node, "children"):
             for c in node.children:

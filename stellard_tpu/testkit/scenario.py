@@ -93,6 +93,15 @@ class Scenario:
     join_at: int = 0
     segments: bool = False
     segment_bytes: int = 65536  # segstore floors at 64 KiB
+    # history-shard tiering (requires segments): when a serving
+    # validator's accepted chain reaches `shard_trim_seq`, every ledger
+    # BELOW it rotates out of the live segstore into a sealed history
+    # shard (nodestore/shards.py rotate_into_shards) — so a cold node
+    # joining later must sync that range entirely from shards over the
+    # combined GetSegments manifest, the production trim-then-tier
+    # shape (doc/storage.md)
+    shards: bool = False
+    shard_trim_seq: int = 0
     garbage_server: Optional[int] = None   # serving nid that corrupts
     kill_server_at: Optional[int] = None   # kill the 2nd server mid-sync
     # admission plane: attach a per-validator TxQ (pinned soft cap) and
@@ -232,6 +241,7 @@ def _setup_segments(net: SimNet, scn: Scenario, tmp_factory):
     from ..nodestore.core import NodeObjectType, make_database
 
     dbs = {}
+    shardstores = {}
     serving = [
         i for i in range(scn.n_validators)
         if i not in scn.cold_nodes and i not in scn.byzantine
@@ -244,8 +254,50 @@ def _setup_segments(net: SimNet, scn: Scenario, tmp_factory):
         )
         dbs[i] = db
         v = net.validators[i]
-        v.node.on_ledger.append(lambda led, db=db: led.save(db))
+        if scn.shards:
+            # history-shard tiering: at shard_trim_seq the pre-floor
+            # range rotates out of the live segstore into a sealed
+            # shard — a cold node joining later syncs it from cold
+            # storage over the combined manifest (the production
+            # trim-then-tier shape, deterministic: seq-driven)
+            from ..nodestore.shards import (
+                CombinedSegmentSource, HistoryShardStore,
+                rotate_into_shards,
+            )
+
+            ss = HistoryShardStore(tmp_factory(f"shards-{i}"))
+            shardstores[i] = ss
+            headers: list[dict] = []
+            rotated = [False]
+
+            def _save(led, db=db, ss=ss, headers=headers,
+                      rotated=rotated):
+                led.save(db)
+                headers.append({
+                    "hash": led.hash(), "seq": led.seq,
+                    "parent_hash": led.parent_hash,
+                    "account_hash": led.account_hash,
+                    "tx_hash": led.tx_hash,
+                })
+                if not rotated[0] and scn.shard_trim_seq > 0 \
+                        and led.seq >= scn.shard_trim_seq:
+                    rotated[0] = True
+                    retired = [
+                        h for h in headers
+                        if h["seq"] < scn.shard_trim_seq
+                    ]
+                    retained = [
+                        h for h in headers
+                        if h["seq"] >= scn.shard_trim_seq
+                    ]
+                    rotate_into_shards(db, ss, retired, retained)
+
+            v.node.on_ledger.append(_save)
+        else:
+            v.node.on_ledger.append(lambda led, db=db: led.save(db))
         src = db.backend
+        if i in shardstores:
+            src = CombinedSegmentSource(src, shardstores[i])
         if scn.garbage_server == i:
             src = _GarbageSegmentSource(src)
         v.node.segment_source = src
@@ -278,7 +330,7 @@ def _setup_segments(net: SimNet, scn: Scenario, tmp_factory):
         )
         cold.node.segment_catchup = sc
         catchups[nid] = sc
-    return dbs, catchups
+    return dbs, catchups, shardstores
 
 
 def _attach_txqs(net: SimNet, scn: Scenario) -> dict:
@@ -592,12 +644,12 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
         by_step.setdefault(at, []).append((nid, tx))
 
     own_tmp = None
-    dbs, catchups = {}, {}
+    dbs, catchups, shardstores = {}, {}, {}
     if scn.segments:
         if tmpdir is None:
             own_tmp = tempfile.mkdtemp(prefix="scn-seg-")
             tmpdir = own_tmp
-        dbs, catchups = _setup_segments(
+        dbs, catchups, shardstores = _setup_segments(
             net, scn, lambda name: os.path.join(tmpdir, name)
         )
     txqs = _attach_txqs(net, scn) if scn.txq_cap else {}
@@ -874,6 +926,21 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
                 ),
                 "segfetch": catchups[nid].get_json(),
             }
+            if shardstores:
+                # history-shard tier evidence: sealed ranges + how many
+                # cold reads the shards actually served (anti-vacuity —
+                # a shard leg where nothing read from a shard proves
+                # nothing). trimmed=True pins that the live segstores
+                # really lost the pre-floor range.
+                reads = sum(
+                    ss.segment_reads for ss in shardstores.values()
+                )
+                sealed = sum(ss.sealed for ss in shardstores.values())
+                card["catchup"]["shards"] = {
+                    "sealed": sealed,
+                    "segment_reads": reads,
+                    "trim_seq": scn.shard_trim_seq,
+                }
         if txqs:
             q0 = txqs[honest[0]]
             card["txq"] = {

@@ -112,6 +112,23 @@ def scenario_cold_catchup(seed: int = 0) -> Scenario:
     )
 
 
+def scenario_shard_cold_catchup(seed: int = 0) -> Scenario:
+    """The trim-then-tier leg (doc/storage.md): serving validators
+    rotate every pre-floor ledger out of their live segstores into
+    sealed history shards BEFORE the cold node joins, so the joiner
+    must sync that range entirely from cold storage over the combined
+    GetSegments manifest."""
+    return Scenario(
+        name="shard_cold_catchup", seed=seed, n_validators=5, quorum=3,
+        steps=90,
+        cold_nodes=(4,), join_at=50,
+        segments=True, segment_bytes=65536,
+        shards=True, shard_trim_seq=6,
+        workload={"kind": "payment_flood", "n": 70},
+        max_tail_steps=300,
+    )
+
+
 def scenario_hot_account(seed: int = 0) -> Scenario:
     return Scenario(
         name="hot_account", seed=seed, n_validators=4, quorum=3,
@@ -209,6 +226,7 @@ MATRIX = {
     "chaos_spec2": scenario_chaos_spec2,
     "byzantine": scenario_byzantine,
     "cold_catchup": scenario_cold_catchup,
+    "shard_cold_catchup": scenario_shard_cold_catchup,
     "hot_account": scenario_hot_account,
     "order_books": scenario_order_books,
     "follower_partition": scenario_follower_partition,

@@ -123,6 +123,8 @@ def counter_vector(card: dict) -> dict[str, int]:
     put("catchup.synced", cu.get("synced"))
     for k, v in (cu.get("segfetch") or {}).items():
         put(f"segfetch.{k}", v)
+    for k, v in (cu.get("shards") or {}).items():
+        put(f"shards.{k}", v)  # history-shard tier coverage axis
     fol = card.get("followers") or {}
     put("followers.synced", fol.get("synced"))
     for nid, fl in (card.get("flooders") or {}).items():
@@ -290,6 +292,18 @@ def check_invariants(
                 f"cold node at seq "
                 f"{card.get('catchup', {}).get('cold_validated_seq')}",
             ))
+        if getattr(scn, "shards", False):
+            # anti-vacuity for the shard-tier leg: the rotation must
+            # have sealed shards AND the cold sync must have actually
+            # read from one — a "passing" leg where the cold node never
+            # touched cold storage proves nothing about the tier
+            sh = (card.get("catchup") or {}).get("shards") or {}
+            if not sh.get("sealed") or not sh.get("segment_reads"):
+                v.append(Violation(
+                    "shard_tier_vacuous",
+                    f"sealed={sh.get('sealed')} "
+                    f"segment_reads={sh.get('segment_reads')}",
+                ))
         if scn.n_followers and not (card.get("followers") or {}).get(
             "synced", True
         ):
@@ -557,6 +571,13 @@ class ScenarioGenerator:
             scn.join_at = rng.randint(steps // 3, steps // 2)
             scn.segments = True
             scn.max_tail_steps = 320
+            if rng.random() < 0.40:
+                # history-shard axis: serving validators trim-then-tier
+                # the early chain into shards BEFORE the cold node
+                # joins, so the sync crosses the cold-storage boundary
+                # under whatever faults this schedule carries
+                scn.shards = True
+                scn.shard_trim_seq = rng.randint(3, 6)
         if not cold and not byz and rng.random() < 0.18:
             self._attach_overlay_tier(rng, scn)
         if rng.random() < 0.15:
@@ -736,12 +757,19 @@ def _weaken_ops(scn: Scenario) -> list[tuple[str, Scenario]]:
                     bs = tuple(x for x in behaviors if x != b)
                     c.byzantine = {**scn.byzantine, nid: bs}
                     out.append((f"drop_behavior:{b}", c))
+    if getattr(scn, "shards", False):
+        c = clone()
+        c.shards = False
+        c.shard_trim_seq = 0
+        out.append(("drop_shard_tier", c))
     if scn.cold_nodes:
         c = clone()
         c.cold_nodes = ()
         c.segments = False
         c.garbage_server = None
         c.kill_server_at = None
+        c.shards = False
+        c.shard_trim_seq = 0
         out.append(("drop_cold_node", c))
     # per-event weakenings: plant magnitude down, fault probs halved
     for i, e in enumerate(_events_of(scn)):

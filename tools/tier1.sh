@@ -60,6 +60,18 @@ if ! JAX_PLATFORMS=cpu timeout -k 10 500 python tools/storagesmoke.py; then
   exit 2
 fi
 
+echo "== out-of-core state smoke gate (lazy resume, tiny cache_mb, shard-served history) =="
+# resumes a persisted chain with LAZY tree faulting under a deliberately
+# tiny [tree] cache_mb and an effectively-unbounded one, floods 200 txs
+# through each, and asserts: per-seq state/tx roots byte-identical,
+# nonzero fault counters (anti-vacuity), bounded RSS growth, and — with
+# online deletion + history shards on — a below-floor account_tx served
+# from a sealed shard instead of lgrIdxInvalid
+if ! JAX_PLATFORMS=cpu timeout -k 10 500 python tools/oocsmoke.py; then
+  echo "OOC SMOKE FAILED — out-of-core state plane is broken" >&2
+  exit 2
+fi
+
 echo "== adversarial scenario smoke gate (partition + byzantine + catch-up, seeded) =="
 # replays three deterministic simnet scenarios twice each with one
 # seed: honest validators must converge on ONE identical chain, the two
