@@ -295,8 +295,12 @@ def _offline_tools(args, cfg) -> int:
         from .crypto.backend import make_hasher
         from .node.verifyplane import VerifyPlane
 
-        hasher = make_hasher(cfg.hash_backend)
-        plane = VerifyPlane(backend=cfg.signature_backend, window_ms=1.0)
+        hasher = make_hasher(
+            cfg.hash_backend,
+            **({"mesh": cfg.hash_mesh} if cfg.hash_backend == "tpu" else {}),
+        )
+        plane = VerifyPlane(backend=cfg.signature_backend, window_ms=1.0,
+                            backend_opts=cfg.verify_backend_opts())
         stats = replay_ledger(db, hdr["hash"], hash_batch=hasher,
                               verify_many=plane.verify_many)
         # routing evidence: without this, latency-aware routing could

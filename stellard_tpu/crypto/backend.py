@@ -14,13 +14,94 @@ implementation coalesced JobQueue-style verification batches run on.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
-from typing import Callable, Optional, Sequence
+from typing import Callable, Iterable, Optional, Sequence
 
 import numpy as np
+
+log = logging.getLogger("stellard.device")
+
+# Serializes the FIRST jax import across threads. At node setup the
+# verify prewarm thread and the genesis ledger hash (a forced-device
+# hash plane) can both trigger jax's first import concurrently, and
+# jax's internal circular imports make a concurrent first import crash
+# with "partially initialized module jax.numpy has no attribute ..." —
+# one thread must complete the whole import chain before any other
+# device path touches it.
+_JAX_IMPORT_LOCK = threading.Lock()
+
+
+def ensure_jax():
+    """Import (and fully initialize) jax under a process-wide lock;
+    returns the module. Every device-backend entry point calls this
+    instead of a bare `import jax` so two threads can never interleave
+    jax's first partial initialization."""
+    with _JAX_IMPORT_LOCK:
+        import jax
+        import jax.numpy  # noqa: F401 — force the circular tail too
+
+        return jax
+
+
+def parse_mesh(value) -> str:
+    """Canonicalize a ``mesh=`` config value (the multi-chip width axis):
+    returns ``"auto"`` or the string form of a non-negative int. ``0``
+    means "no mesh requested" — which executes as a width-1 mesh, the
+    SAME routed code path as every other width (there is no separate
+    single-device fork). Anything else raises: a width toggle must not
+    silently fail open into an unintended topology."""
+    if value is None:
+        return "0"
+    s = str(value).strip().lower()
+    if s in ("", "off"):
+        return "0"
+    if s == "auto":
+        return "auto"
+    try:
+        n = int(s)
+    except ValueError:
+        raise ValueError(
+            f"mesh= must be a non-negative integer or 'auto', got {value!r}"
+        ) from None
+    if n < 0:
+        raise ValueError(
+            f"mesh= must be a non-negative integer or 'auto', got {value!r}"
+        )
+    return str(n)
+
+
+def mesh_wants_width(value) -> bool:
+    """True when a ``mesh=`` value asks for MORE than one chip (so the
+    three-way host/1-chip/N-chip routing should grow a separate 1-chip
+    arm). "auto" counts: its effective width is only known at device
+    discovery."""
+    m = parse_mesh(value)
+    return m == "auto" or int(m) > 1
+
+
+def resolve_mesh_width(mesh, n_visible: int, pow2: bool = False) -> int:
+    """Effective mesh width for a backend: ``auto`` -> every visible
+    device, N -> min(N, visible) (clamped with a warning — a config
+    asking for more chips than exist must degrade loudly, not die),
+    0 -> 1. ``pow2=True`` additionally rounds DOWN to a power of two
+    (the hash plane's leaf batcher pads row counts to powers of two, so
+    only pow2 widths divide its batches evenly)."""
+    m = parse_mesh(mesh)
+    n_visible = max(1, n_visible)
+    want = n_visible if m == "auto" else max(1, int(m))
+    if want > n_visible:
+        log.warning(
+            "mesh=%s requests %d devices but only %d are visible — "
+            "clamping to %d", m, want, n_visible, n_visible,
+        )
+    width = max(1, min(want, n_visible))
+    if pow2:
+        width = 1 << (width.bit_length() - 1)
+    return width
 
 
 @dataclass(frozen=True)
@@ -73,28 +154,57 @@ class BatchHasher:
         return self.prefix_hash_batch(prefixes, payloads)
 
 
-_VERIFIERS: dict[str, Callable[..., BatchVerifier]] = {}
-_HASHERS: dict[str, Callable[..., BatchHasher]] = {}
+# name -> (factory, accepted-option names or None=accept anything).
+# Declared options make the factories fail LOUDLY on unknown keys: the
+# config plumbing (Config -> Node -> VerifyPlane/make_watched_hasher ->
+# here) hands operator-written kwargs through, and a typo'd or
+# unsupported option must raise at node build, never silently no-op.
+_VERIFIERS: dict[str, tuple[Callable[..., BatchVerifier],
+                            Optional[frozenset]]] = {}
+_HASHERS: dict[str, tuple[Callable[..., BatchHasher],
+                          Optional[frozenset]]] = {}
 
 
-def register_verifier(name: str, factory: Callable[..., BatchVerifier]) -> None:
-    _VERIFIERS[name] = factory
+def _check_options(kind: str, name: str, accepted: Optional[frozenset],
+                   kwargs: dict) -> None:
+    if accepted is None:
+        return  # undeclared factory (test doubles): accept anything
+    unknown = sorted(set(kwargs) - accepted)
+    if unknown:
+        raise ValueError(
+            f"{kind} backend {name!r} does not accept option(s) "
+            f"{unknown}; accepted: {sorted(accepted) or '(none)'}"
+        )
 
 
-def register_hasher(name: str, factory: Callable[..., BatchHasher]) -> None:
-    _HASHERS[name] = factory
+def register_verifier(name: str, factory: Callable[..., BatchVerifier],
+                      options: Optional[Iterable[str]] = None) -> None:
+    _VERIFIERS[name] = (
+        factory, frozenset(options) if options is not None else None
+    )
+
+
+def register_hasher(name: str, factory: Callable[..., BatchHasher],
+                    options: Optional[Iterable[str]] = None) -> None:
+    _HASHERS[name] = (
+        factory, frozenset(options) if options is not None else None
+    )
 
 
 def make_verifier(name: str, **kwargs) -> BatchVerifier:
     if name not in _VERIFIERS:
         raise KeyError(f"unknown signature backend {name!r}; have {sorted(_VERIFIERS)}")
-    return _VERIFIERS[name](**kwargs)
+    factory, accepted = _VERIFIERS[name]
+    _check_options("signature", name, accepted, kwargs)
+    return factory(**kwargs)
 
 
 def make_hasher(name: str, **kwargs) -> BatchHasher:
     if name not in _HASHERS:
         raise KeyError(f"unknown hash backend {name!r}; have {sorted(_HASHERS)}")
-    return _HASHERS[name](**kwargs)
+    factory, accepted = _HASHERS[name]
+    _check_options("hash", name, accepted, kwargs)
+    return factory(**kwargs)
 
 
 # --------------------------------------------------------------------------
@@ -211,21 +321,27 @@ class TpuVerifier(BatchVerifier):
     """Batched JAX Ed25519 kernel (ops.ed25519_jax.verify_kernel).
 
     Batches are padded to power-of-two sizes to bound XLA recompiles.
-    With more than one accelerator visible, the batch dimension shards
-    data-parallel over a 1-D device mesh (parallel/mesh.py) and XLA
-    splits the whole point-arithmetic pipeline across chips over ICI —
-    the production integration of SURVEY §2.9 mapping #3 (VERDICT r2 #3).
+    ``mesh=`` is the multi-chip width axis (GSPMD stance, Xu et al.
+    2021): the batch dimension shards data-parallel over a 1-D device
+    mesh of that width (parallel/mesh.py) and XLA splits the whole
+    point-arithmetic pipeline across chips over ICI — the production
+    integration of SURVEY §2.9 mapping #3 (VERDICT r2 #3). Width 1 and
+    width N run the SAME sharded program: there is no separate
+    single-device code path, only a narrower mesh.
     """
 
     name = "tpu"
 
     def __init__(self, min_batch: int = 256, max_batch: int = 16384,
-                 use_mesh: Optional[bool] = None):
+                 mesh="auto"):
         self.min_batch = min_batch
         self.max_batch = max_batch
         self._kernel = None  # resolved lazily (device discovery)
-        self._use_mesh = use_mesh
-        self.n_devices = 1
+        self.mesh = parse_mesh(mesh)  # validated at BUILD time, loudly
+        self.n_devices = 0  # effective width; set by _resolve_kernel
+        self.devices_visible = 0
+        self.platform = "unresolved"
+        self.kernel_selected = "unresolved"
         # mesh+pallas small-batch bypass (set by _resolve_kernel)
         self._small_kernel = None
         self._mesh_floor = 0
@@ -249,9 +365,13 @@ class TpuVerifier(BatchVerifier):
     def _resolve_kernel(self):
         if self._kernel is not None:
             return self._kernel
-        import jax
+        jax = ensure_jax()  # first import may race the hash plane
 
-        from ..ops.ed25519_jax import verify_kernel
+        from ..parallel.mesh import (
+            make_mesh,
+            sharded_verify_kernel,
+            sharded_verify_kernel_pallas,
+        )
 
         impl = os.environ.get("STELLARD_VERIFY_IMPL", "xla")
         if impl not in ("xla", "pallas"):
@@ -260,53 +380,57 @@ class TpuVerifier(BatchVerifier):
             raise ValueError(
                 f"STELLARD_VERIFY_IMPL={impl!r}: expected 'xla' or 'pallas'"
             )
-        impl_pallas = impl == "pallas"
         devices = jax.devices()
+        self.devices_visible = len(devices)
+        self.platform = devices[0].platform
         if self._pad_policy_env == "auto":
             self.pad_policy = (
                 "max" if devices[0].platform == "tpu" else "pow2"
             )
-        want_mesh = (
-            self._use_mesh
-            if self._use_mesh is not None
-            else len(devices) > 1
-        )
-        if want_mesh and len(devices) > 1:
-            from ..parallel.mesh import (
-                make_mesh,
-                sharded_verify_kernel,
-                sharded_verify_kernel_pallas,
+        # ONE code path at every width (the GSPMD stance): resolve the
+        # config axis to an effective width and build the sharded
+        # program over a mesh of exactly that many devices — width 1 is
+        # a one-device mesh of the same program, not a separate kernel.
+        width = resolve_mesh_width(self.mesh, len(devices))
+        self.n_devices = width
+        mesh = make_mesh(devices[:width])
+        if impl == "pallas":
+            from ..ops.ed25519_pallas import (
+                BLOCK,
+                verify_kernel_pallas,
             )
 
-            self.n_devices = len(devices)
-            mesh = make_mesh(devices)
-            if impl_pallas:
-                from ..ops.ed25519_pallas import (
-                    BLOCK,
-                    verify_kernel_pallas,
-                )
-
-                self._kernel = sharded_verify_kernel_pallas(mesh)
+            self._kernel = sharded_verify_kernel_pallas(mesh)
+            self.kernel_selected = f"pallas-shardmap@{width}"
+            if width > 1:
                 # each shard pads itself to a full grid BLOCK, so a
-                # batch below n_devices*BLOCK would pay n_devices
-                # blocks of mostly-zero work for single-block latency;
-                # route those to the single-chip kernel instead
+                # batch below width*BLOCK would pay `width` blocks of
+                # mostly-zero work for single-block latency; route
+                # those to the single-chip kernel instead
                 self._small_kernel = verify_kernel_pallas
-                self._mesh_floor = len(devices) * BLOCK
-            else:
-                self._kernel = sharded_verify_kernel(mesh)
-            # pad floor must divide evenly across the mesh (round UP to a
-            # multiple — doubling can never fix an odd device count)
-            nd = self.n_devices
-            self.min_batch = ((self.min_batch + nd - 1) // nd) * nd
-        elif impl_pallas:
-            # whole-verify-in-VMEM Pallas kernel (ops/ed25519_pallas.py)
-            from ..ops.ed25519_pallas import verify_kernel_pallas
-
-            self._kernel = verify_kernel_pallas
+                self._mesh_floor = width * BLOCK
         else:
-            self._kernel = verify_kernel
+            self._kernel = sharded_verify_kernel(mesh)
+            self.kernel_selected = f"xla-sharded@{width}"
+        # pad floor must divide evenly across the mesh (round UP to a
+        # multiple — doubling can never fix an odd device count)
+        self.min_batch = ((self.min_batch + width - 1) // width) * width
         return self._kernel
+
+    def describe(self) -> dict:
+        """Routing-honesty snapshot: which devices/kernel/width this
+        verifier actually resolved to (bench provenance + get_counts
+        crypto block)."""
+        return {
+            "mesh_requested": self.mesh,
+            "mesh_width": self.n_devices or None,
+            "devices_visible": self.devices_visible or None,
+            "platform": self.platform,
+            "kernel": self.kernel_selected,
+            "pad_policy": self.pad_policy,
+            "min_batch": self.min_batch,
+            "max_batch": self.max_batch,
+        }
 
     def _pad_size(self, n: int, lo: int, hi: int) -> int:
         if self.pad_policy == "max":
@@ -368,6 +492,18 @@ class TpuHasher(BatchHasher):
 
     name = "tpu"
 
+    def __init__(self, mesh="auto"):
+        self.mesh = parse_mesh(mesh)  # validated at BUILD time, loudly
+        self.n_devices = 0  # effective width; set on first kernel use
+        self.devices_visible = 0
+        self.kernel_selected = "unresolved"
+        self._masked = None
+        # whole-tree pipeline invocations (hash_tree): the scatter
+        # chain is a single-device program, so device work can be real
+        # while the SHARDED flat kernel stays unresolved — provenance
+        # must say which one ran
+        self.tree_calls = 0
+
     def prefix_hash_batch(self, prefixes, payloads):
         return self._hash_msgs(
             [p.to_bytes(4, "big") + d for p, d in zip(prefixes, payloads)]
@@ -381,6 +517,7 @@ class TpuHasher(BatchHasher):
         )
 
     def _hash_msgs(self, msgs):
+        ensure_jax()  # first import may race the verify plane
         import jax.numpy as jnp
 
         from ..ops.sha512_jax import padded_block_count
@@ -414,27 +551,50 @@ class TpuHasher(BatchHasher):
                 out[i] = raw[row * 32 : row * 32 + 32]
         return out  # type: ignore[return-value]
 
-    _MASKED = None
+    # width -> compiled sharded kernel, shared across instances so the
+    # 1-chip and N-chip arms of the three-way routing (and repeated
+    # test constructions) never recompile an already-built width
+    _KERNELS: dict[int, object] = {}
 
-    @classmethod
-    def _masked_kernel(cls):
-        if cls._MASKED is None:
-            import jax
+    def _masked_kernel(self):
+        if self._masked is None:
+            jax = ensure_jax()  # first import may race the verify plane
 
-            from ..ops.treehash_jax import sha512_blocks_masked
+            from ..parallel.mesh import make_mesh, sharded_masked_sha512
 
             devices = jax.devices()
-            n = len(devices)
-            if n > 1 and (n & (n - 1)) == 0 and n <= 8:
-                # flat-batch hashing shards data-parallel over the mesh
-                # (pad_leaf_batch rows are powers of two >= 8, so any
-                # power-of-two device count up to 8 divides them evenly)
-                from ..parallel.mesh import make_mesh, sharded_masked_sha512
+            self.devices_visible = len(devices)
+            # flat-batch hashing shards data-parallel over the mesh.
+            # pow2 widths only, capped at 8: pad_leaf_batch rows are
+            # powers of two >= 8, so any power-of-two width up to 8
+            # divides them evenly — a non-pow2 mesh= rounds DOWN.
+            width = min(
+                8, resolve_mesh_width(self.mesh, len(devices), pow2=True)
+            )
+            self.n_devices = width
+            self.kernel_selected = f"masked-sha512-sharded@{width}"
+            kern = TpuHasher._KERNELS.get(width)
+            if kern is None:
+                # one code path at every width: width 1 is a one-device
+                # mesh of the same sharded program, not a separate jit
+                kern = sharded_masked_sha512(make_mesh(devices[:width]))
+                TpuHasher._KERNELS[width] = kern
+            self._masked = kern
+        return self._masked
 
-                cls._MASKED = sharded_masked_sha512(make_mesh(devices))
-            else:
-                cls._MASKED = jax.jit(sha512_blocks_masked)
-        return cls._MASKED
+    def describe(self) -> dict:
+        """Routing-honesty snapshot (bench provenance / get_counts).
+        `kernel`/`mesh_width` describe the SHARDED flat-batch kernel;
+        `tree_pipeline_calls` counts whole-tree (unsharded, width-1)
+        pipeline runs, which can carry the device traffic while the
+        flat kernel stays unresolved."""
+        return {
+            "mesh_requested": self.mesh,
+            "mesh_width": self.n_devices or None,
+            "devices_visible": self.devices_visible or None,
+            "kernel": self.kernel_selected,
+            "tree_pipeline_calls": self.tree_calls,
+        }
 
     # -- whole-tree pipeline ----------------------------------------------
 
@@ -450,7 +610,10 @@ class TpuHasher(BatchHasher):
         stamps the whole tree before the fallback begins, or it stamps
         nothing — an abandoned (zombie) call can never interleave writes
         with the fallback's traversal."""
+        ensure_jax()  # first import may race the verify plane
         import jax.numpy as jnp
+
+        self.tree_calls += 1
 
         from ..ops.sha512_jax import padded_block_count
         from ..ops.treehash_jax import (
@@ -588,12 +751,15 @@ class TpuHasher(BatchHasher):
         return hashed_host + len(index_of)
 
 
-register_verifier("cpu", _host_verifier_factory)
-register_verifier("native", NativeVerifier)  # strict: raises if unbuildable
-register_verifier("openssl", CpuVerifier)  # always-available host library
-register_verifier("tpu", TpuVerifier)
-register_hasher("cpu", CpuHasher)
-register_hasher("tpu", TpuHasher)
+register_verifier("cpu", _host_verifier_factory, options=("threads",))
+# strict: raises if unbuildable
+register_verifier("native", NativeVerifier, options=())
+# always-available host library
+register_verifier("openssl", CpuVerifier, options=("threads",))
+register_verifier("tpu", TpuVerifier,
+                  options=("min_batch", "max_batch", "mesh"))
+register_hasher("cpu", CpuHasher, options=())
+register_hasher("tpu", TpuHasher, options=("mesh",))
 
 
 class CppHasher(BatchHasher):
@@ -622,7 +788,7 @@ class CppHasher(BatchHasher):
 # registered unconditionally: CppHasher.__init__ raises a clean error on
 # a toolchain-less box, and the (one-time) native build cost lands only
 # on callers that actually select the cpp backend — never at import
-register_hasher("cpp", CppHasher)
+register_hasher("cpp", CppHasher, options=())
 
 
 class _RoutedFlat:
@@ -653,21 +819,43 @@ DEVICE_HASH_FLOOR = 64
 
 
 def make_watched_hasher(backend: str,
-                        min_device_nodes: Optional[int] = None) -> BatchHasher:
+                        min_device_nodes: Optional[int] = None,
+                        mesh=None,
+                        routing: Optional[str] = None,
+                        first_timeout: Optional[float] = None,
+                        ) -> BatchHasher:
     """The ONE wiring for a possibly-device hasher: the tpu backend is
     wrapped in the wedge watchdog with a cpu fallback (a hung tunnel
     must degrade, not freeze) and the small-batch device floor; host
     backends pass through untouched. Used by the node and the bench
-    legs so both always measure/run the identical construction."""
-    hasher = make_hasher(backend)
+    legs so both always measure/run the identical construction.
+
+    ``mesh`` is the [hash_backend] width axis (parse_mesh values). When
+    it requests more than one chip, the watchdog gets BOTH a wide inner
+    and a width-1 inner — the N-chip and 1-chip arms of the three-way
+    measured-cost routing (host / 1-chip / N-chip), so small batches
+    stay on host, medium batches on one chip, and only batches that
+    amortize the collective go wide. ``routing`` ("cost"/"device")
+    overrides STELLARD_HASH_ROUTING; ``first_timeout`` the wedge
+    deadline."""
+    opts = {}
+    if backend == "tpu" and mesh is not None:
+        opts["mesh"] = mesh
+    hasher = make_hasher(backend, **opts)
     if backend == "tpu":
         floor = min_device_nodes
         if floor is None:  # explicit arg > env > device-backend default
             floor = int(os.environ.get(
                 "STELLARD_HASH_MIN_DEVICE_NODES", str(DEVICE_HASH_FLOOR)
             ))
+        inner_one = None
+        if mesh_wants_width(mesh if mesh is not None else "auto"):
+            # the 1-chip arm: the SAME sharded program at width 1
+            inner_one = make_hasher("tpu", mesh="0")
         hasher = WatchdogHasher(
-            hasher, make_hasher("cpu"), min_device_nodes=floor
+            hasher, make_hasher("cpu"), min_device_nodes=floor,
+            inner_one=inner_one, routing=routing,
+            first_timeout=first_timeout,
         )
     return hasher
 
@@ -726,19 +914,22 @@ def apply_kernel_tuning(path: str) -> Optional[dict]:
 
 
 class _HashCostModel:
-    """Measured-cost device-vs-host routing for the hash plane (the
-    VerifyPlane stance): per-pow2-bucket device EWMAs, first
-    (compile-laden) sample discarded, one host measurement enables the
-    comparison, and a losing device re-explores per-bucket after
-    `reexplore_every` eligible losses (a counter, not a global modulo —
-    a bucket whose calls never align with a global stride must not be
-    starved), bounded to within 4x of the winning cost. Thread-safe:
-    the hasher is shared across node threads."""
+    """Measured-cost routing for the hash plane (the VerifyPlane
+    stance), generalized from host-vs-device to host + N device ARMS
+    (the three-way host / 1-chip / N-chip split): per-pow2-bucket
+    EWMAs per arm, first (compile-laden) sample discarded per
+    (arm, bucket), one host measurement enables the comparison, and a
+    losing arm re-explores per (arm, bucket) after `reexplore_every`
+    eligible losses (a counter, not a global modulo — a bucket whose
+    calls never align with a global stride must not be starved),
+    bounded to within 4x of the winning cost. Thread-safe: the hasher
+    is shared across node threads."""
 
     EWMA = 0.3
     REEXPLORE_BOUND = 4.0
 
-    def __init__(self, reexplore_every: int, min_device_nodes: int = 0):
+    def __init__(self, reexplore_every: int, min_device_nodes: int = 0,
+                 arms: Sequence[str] = ("device",)):
         self._lock = threading.Lock()
         self._reexplore = reexplore_every
         # floor knob: batches below this size NEVER route to (or explore)
@@ -747,9 +938,11 @@ class _HashCostModel:
         # floor every tiny residual would re-trigger per-bucket
         # exploration (a device round-trip per close)
         self.min_device_nodes = max(0, int(min_device_nodes))
-        self._dev: dict[int, list] = {}   # bucket -> [n_samples, ewma]
+        self.arms = tuple(arms)
+        # arm -> bucket -> [n_samples, ewma]
+        self._dev: dict[str, dict[int, list]] = {a: {} for a in self.arms}
         self._host_unit_ms: Optional[float] = None
-        self._losses: dict[int, int] = {}  # bucket -> eligible losses
+        self._losses: dict[tuple[str, int], int] = {}
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -759,52 +952,96 @@ class _HashCostModel:
         return ms if cur is None else (1 - self.EWMA) * cur + self.EWMA * ms
 
     def get_json(self) -> dict:
-        """Routing-model snapshot (bench provenance / BENCH_DETAIL)."""
+        """Routing-model snapshot (bench provenance / BENCH_DETAIL /
+        the get_counts crypto block). `buckets` keeps the legacy
+        single-arm view (the primary device arm); `arms` is the full
+        three-way snapshot."""
         with self._lock:
+            arms = {
+                arm: {
+                    str(b): {"samples": s[0], "ewma_ms": s[1]}
+                    for b, s in sorted(slots.items())
+                }
+                for arm, slots in self._dev.items()
+            }
             return {
                 "min_device_nodes": self.min_device_nodes,
                 "host_unit_ms": self._host_unit_ms,
-                "buckets": {
-                    str(b): {"samples": s[0], "ewma_ms": s[1]}
-                    for b, s in sorted(self._dev.items())
+                "arms": arms,
+                # legacy single-arm view: the PRIMARY (widest) arm —
+                # the one that keeps accumulating after arm collapse,
+                # matching _LatencyModel's device_bucket_ms view
+                "buckets": arms[self.arms[-1]],
+                "losses": {
+                    f"{a}:{b}": v
+                    for (a, b), v in sorted(self._losses.items())
                 },
-                "losses": {str(b): v for b, v in sorted(self._losses.items())},
             }
 
-    def use_device(self, n: int) -> bool:
+    def choose(self, n: int, arms: Optional[Sequence[str]] = None) -> str:
+        """Pick the arm for an n-node batch: ``"host"`` or a device arm
+        name. Unmeasured device arms are explored first (in declared
+        order); the host is measured once before any comparison; after
+        that the cheapest measured arm wins, with bounded per-(arm,
+        bucket) re-exploration of close losers."""
+        avail = [a for a in (arms if arms is not None else self.arms)
+                 if a in self._dev]
         with self._lock:
-            if n < self.min_device_nodes:
-                return False  # below any plausible win size: never explore
+            if n < self.min_device_nodes or not avail:
+                return "host"  # below any plausible win size
             b = self._bucket(n)
-            slot = self._dev.setdefault(b, [0, None])
-            if slot[1] is None:
-                return True  # unmeasured (or compile sample only): explore
+            costs: dict[str, float] = {}
+            for a in avail:
+                slot = self._dev[a].setdefault(b, [0, None])
+                if slot[1] is None:
+                    return a  # unmeasured (or compile sample): explore
+                costs[a] = slot[1]
             if self._host_unit_ms is None:
-                return False  # measure the host side once
-            exp_dev = slot[1]
+                return "host"  # measure the host side once
             exp_host = self._host_unit_ms * n
-            if exp_dev <= exp_host:
-                self._losses[b] = 0
-                return True
-            if exp_dev > self.REEXPLORE_BOUND * exp_host:
-                return False  # hopeless: stay on the host
-            self._losses[b] = self._losses.get(b, 0) + 1
-            if self._losses[b] >= self._reexplore:
-                self._losses[b] = 0
-                return True
-            return False
+            best_arm = min(costs, key=lambda a: costs[a])
+            if costs[best_arm] <= exp_host:
+                self._losses.pop((best_arm, b), None)
+                winner, best = best_arm, costs[best_arm]
+            else:
+                winner, best = "host", exp_host
+            # losing device arms within striking distance of the winner
+            # accrue losses and periodically re-explore; hopeless arms
+            # (beyond the 4x band) never do
+            for a in avail:
+                if a == winner:
+                    continue
+                if costs[a] > self.REEXPLORE_BOUND * best:
+                    continue
+                k = (a, b)
+                self._losses[k] = self._losses.get(k, 0) + 1
+                if self._losses[k] >= self._reexplore:
+                    self._losses[k] = 0
+                    return a
+            return winner
 
-    def observe_device(self, n: int, ms: float) -> None:
+    def use_device(self, n: int) -> bool:
+        return self.choose(n) != "host"
+
+    def observe(self, arm: str, n: int, ms: float) -> None:
+        if arm == "host":
+            with self._lock:
+                self._host_unit_ms = self._ewma(self._host_unit_ms, ms / n)
+            return
         with self._lock:
-            slot = self._dev.setdefault(self._bucket(n), [0, None])
+            slot = self._dev[arm].setdefault(self._bucket(n), [0, None])
             slot[0] += 1
             if slot[0] <= 1:
                 return  # discard the compile-laden first sample
             slot[1] = self._ewma(slot[1], ms)
 
+    # legacy single-arm shims (tests / two-way callers): the primary
+    # arm is the WIDEST, same as the get_json "buckets" view
+    def observe_device(self, n: int, ms: float) -> None:
+        self.observe(self.arms[-1], n, ms)
+
     def observe_host(self, n: int, ms: float) -> None:
-        with self._lock:
-            self._host_unit_ms = self._ewma(self._host_unit_ms, ms / n)
+        self.observe("host", n, ms)
 
 
 class WatchdogHasher(BatchHasher):
@@ -829,27 +1066,38 @@ class WatchdogHasher(BatchHasher):
     def __init__(self, inner: BatchHasher, fallback: BatchHasher,
                  first_timeout: Optional[float] = None,
                  warm_timeout: Optional[float] = None,
-                 min_device_nodes: Optional[int] = None):
+                 min_device_nodes: Optional[int] = None,
+                 inner_one: Optional[BatchHasher] = None,
+                 routing: Optional[str] = None):
         from ..utils.devicewatch import resolve_timeouts
 
         self.inner = inner
         self.fallback = fallback
+        # the 1-chip arm of the three-way routing: the same device
+        # program at mesh width 1 (make_watched_hasher builds it when
+        # [hash_backend] mesh= requests more than one chip). None keeps
+        # the classic two-way host/device split.
+        self.inner_one = inner_one
         self.name = inner.name
         self._t_first, _ = resolve_timeouts(first_timeout, warm_timeout)
         self.device_wedged = False
         # measured-cost routing (same stance as VerifyPlane's model: the
         # device must EARN traffic; a losing device floors at the host
         # path instead of dragging a leg, and is re-explored bounded).
-        # STELLARD_HASH_ROUTING=device restores route-everything-device.
+        # routing="device" (or STELLARD_HASH_ROUTING=device) restores
+        # route-everything-device — the widest arm.
         # (A separate small model rather than verifyplane._LatencyModel:
         # the units differ — per-node hash rates vs per-signature verify
         # costs — and the verify model is entangled with pad-bucket
         # warmth bookkeeping this wrapper has no analog for.)
-        mode = os.environ.get("STELLARD_HASH_ROUTING", "cost")
+        mode = routing if routing else os.environ.get(
+            "STELLARD_HASH_ROUTING", "cost"
+        )
         if mode not in ("cost", "device"):
             raise ValueError(
-                f"STELLARD_HASH_ROUTING must be cost|device, got {mode!r}"
+                f"hash routing must be cost|device, got {mode!r}"
             )
+        self.routing = mode
         self._route_by_cost = mode != "device"
         # device floor: flat batches below this size never route to the
         # device, and tree hashing with a caller-supplied dirty-count
@@ -869,31 +1117,60 @@ class WatchdogHasher(BatchHasher):
                 f"{floor}"
             )
         self.min_device_nodes = floor
+        self._arm_names = (
+            ("dev1", "devN") if inner_one is not None else ("device",)
+        )
         self._flat = _HashCostModel(
-            reexplore_every=256, min_device_nodes=floor
+            reexplore_every=256, min_device_nodes=floor,
+            arms=self._arm_names,
         )
         # tree model buckets per-node RATE in the size-independent
         # bucket 1 — the floor applies via the hash_tree hint, not here
+        # (the whole-tree device pipeline is a single-program scatter
+        # chain, so it stays a two-way host/device decision)
         self._tree = _HashCostModel(reexplore_every=64)
+
+    def _live_arms(self) -> tuple:
+        """The device arms currently worth routing between. Once the
+        wide inner RESOLVES to a single device (mesh= wider than the
+        box), the 1-chip arm is the identical program — collapse it so
+        the model stops exploring a duplicate."""
+        if (self.inner_one is not None
+                and getattr(self.inner, "n_devices", 0) == 1):
+            self.inner_one = None
+        if self.inner_one is None and len(self._arm_names) > 1:
+            return self._arm_names[-1:]
+        return self._arm_names
+
+    def _inner_of(self, arm: str) -> BatchHasher:
+        if arm == "dev1" and self.inner_one is not None:
+            return self.inner_one
+        return self.inner
 
     @property
     def device_nodes(self):  # type: ignore[override]
-        return self.inner.device_nodes
+        one = self.inner_one.device_nodes if self.inner_one is not None else 0
+        return self.inner.device_nodes + one
 
     @device_nodes.setter
     def device_nodes(self, value):  # counter reset (bench legs)
         self.inner.device_nodes = value
+        if self.inner_one is not None:
+            self.inner_one.device_nodes = 0
 
     @property
     def host_nodes(self):  # type: ignore[override]
-        return self.inner.host_nodes + self.fallback.host_nodes
+        one = self.inner_one.host_nodes if self.inner_one is not None else 0
+        return self.inner.host_nodes + self.fallback.host_nodes + one
 
     @host_nodes.setter
     def host_nodes(self, value):  # counter reset (bench legs)
         # round-trips: getter sums inner + fallback, so the value goes
-        # to inner and the fallback share zeroes
+        # to inner and the other shares zero
         self.inner.host_nodes = value
         self.fallback.host_nodes = 0
+        if self.inner_one is not None:
+            self.inner_one.host_nodes = 0
 
     def _wedge(self, exc: Exception) -> None:
         from ..utils.devicewatch import log as dlog
@@ -904,7 +1181,9 @@ class WatchdogHasher(BatchHasher):
     def prefix_hash_batch(self, prefixes, payloads):
         return self._routed(
             len(prefixes),
-            lambda: self.inner.prefix_hash_batch(prefixes, payloads),
+            lambda arm: self._inner_of(arm).prefix_hash_batch(
+                prefixes, payloads
+            ),
             lambda: self.fallback.prefix_hash_batch(prefixes, payloads),
         )
 
@@ -913,25 +1192,35 @@ class WatchdogHasher(BatchHasher):
         model and wedge watchdog as the (prefix, payload) shape."""
         return self._routed(
             len(offsets) - 1,
-            lambda: self.inner.hash_packed(buf, offsets),
+            lambda arm: self._inner_of(arm).hash_packed(buf, offsets),
             lambda: self.fallback.hash_packed(buf, offsets),
         )
 
-    def _routed(self, n, device_fn, host_fn):
+    def _routed(self, n, device_call, host_fn):
+        """Three-way measured-cost dispatch: host / 1-chip / N-chip.
+        ``device_call(arm)`` runs the batch on that arm's inner hasher;
+        cost-mode picks the cheapest measured arm (exploring unmeasured
+        ones), device-mode forces the widest arm."""
         import time as _t
 
         from ..utils.devicewatch import DeviceWedged, call_with_deadline
 
-        if not self.device_wedged and n > 0 and (
-            not self._route_by_cost or self._flat.use_device(n)
-        ):
+        arm: Optional[str] = None
+        if not self.device_wedged and n > 0:
+            if not self._route_by_cost:
+                arm = self._live_arms()[-1]  # forced: the widest arm
+            else:
+                choice = self._flat.choose(n, arms=self._live_arms())
+                arm = None if choice == "host" else choice
+        if arm is not None:
             try:
                 t0 = _t.perf_counter()
                 out = call_with_deadline(
-                    device_fn, self._t_first, label="hash-device",
+                    lambda: device_call(arm), self._t_first,
+                    label="hash-device",
                 )
-                self._flat.observe_device(
-                    n, (_t.perf_counter() - t0) * 1000.0
+                self._flat.observe(
+                    arm, n, (_t.perf_counter() - t0) * 1000.0
                 )
                 return out
             except DeviceWedged as exc:
@@ -939,21 +1228,37 @@ class WatchdogHasher(BatchHasher):
         t0 = _t.perf_counter()
         out = host_fn()
         if n > 0:
-            self._flat.observe_host(n, (_t.perf_counter() - t0) * 1000.0)
+            self._flat.observe(
+                "host", n, (_t.perf_counter() - t0) * 1000.0
+            )
         return out
 
     def get_json(self) -> dict:
         """Hash-plane routing snapshot (bench legs record it next to
-        device_share so a routed-out device is self-explaining)."""
+        device_share so a routed-out device is self-explaining): mesh
+        width/kernel per arm plus the three-arm cost-model state."""
+        describe = getattr(self.inner, "describe", None)
         return {
             "backend": self.name,
             "wedged": self.device_wedged,
+            "routing": self.routing,
+            "arms": list(self._live_arms()),
+            "mesh": describe() if describe is not None else None,
             "device_nodes": self.device_nodes,
             "host_nodes": self.host_nodes,
             "min_device_nodes": self.min_device_nodes,
             "flat_model": self._flat.get_json(),
             "tree_model": self._tree.get_json(),
         }
+
+    def flat_hasher(self) -> "_RoutedFlat":
+        """This hasher's routed FLAT facade (no hash_tree attr): tree
+        hashing through it level-batches per-level pack_nodes buffers
+        into the routed hash_packed path — the sharded masked-SHA
+        kernel under device routing. The scenario plane uses it so
+        chaos runs exercise the SHARDED flat plane, not the unsharded
+        whole-tree scatter pipeline."""
+        return _RoutedFlat(self)
 
     def _host_tree(self, root) -> int:
         """Level-batched host hashing. When the device is healthy this

@@ -45,6 +45,70 @@ def _kv(lines: list[str]) -> dict[str, str]:
     return out
 
 
+def _reject_unknown(section: str, kv: dict, known: tuple) -> None:
+    """The crypto-plane sections fail LOUDLY on unknown keys: before
+    this, a typo'd (or never-plumbed) option like use_mesh= parsed
+    clean and silently did nothing — dead config an operator believes
+    is applied (ISSUE 15)."""
+    unknown = sorted(set(kv) - set(known))
+    if unknown:
+        raise ValueError(
+            f"[{section}] unknown key(s) {unknown}; known: {sorted(known)}"
+        )
+
+
+def _crypto_mesh(section: str, backend: str, kv: dict, default: str) -> str:
+    """Validated `mesh=` for a crypto section: parse_mesh canonicalizes
+    (0/N/auto; garbage raises), and a mesh request on a HOST backend is
+    a loud config error — the operator believes chips are in play."""
+    from ..crypto.backend import parse_mesh
+
+    if "mesh" not in kv:
+        return default
+    mesh = parse_mesh(kv["mesh"])
+    if mesh != "0" and backend not in ("tpu",):
+        raise ValueError(
+            f"[{section}] mesh={kv['mesh']} is meaningless with "
+            f"type={backend} (host backends have no mesh); use type=tpu "
+            "or mesh=0"
+        )
+    return mesh
+
+
+def _crypto_routing(section: str, kv: dict) -> str:
+    if "routing" not in kv:
+        return ""
+    routing = kv["routing"].strip().lower()
+    if routing not in ("cost", "device"):
+        # a routing toggle must not fail open into an unintended mode
+        raise ValueError(
+            f"[{section}] routing must be cost/device, got {routing!r}"
+        )
+    return routing
+
+
+def _crypto_backend_gate(section: str, backend: str, kv: dict,
+                         device_only: tuple, host_only: tuple = ()) -> None:
+    """Keys that only a device (tpu) backend honors are a loud error
+    with a host type, and vice versa — otherwise they would parse clean
+    and be silently dropped downstream, recreating the exact dead-config
+    class _reject_unknown exists to eliminate."""
+    if backend != "tpu":
+        bad = sorted(k for k in device_only if k in kv)
+        if bad:
+            raise ValueError(
+                f"[{section}] {bad} only apply to type=tpu "
+                f"(type={backend} would silently drop them)"
+            )
+    else:
+        bad = sorted(k for k in host_only if k in kv)
+        if bad:
+            raise ValueError(
+                f"[{section}] {bad} only apply to host backends "
+                f"(type=tpu would silently drop them)"
+            )
+
+
 # default [kernel_tuning] path, shared with Node's outcome logging
 DEFAULT_KERNEL_TUNING = "KERNEL_TUNING.json"
 
@@ -100,6 +164,29 @@ class Config:
     verify_batch_window_ms: float = 2.0  # coalescing window
     verify_max_batch: int = 16384
     verify_min_device_batch: int = 64  # below this, CPU path is used
+    # mesh= is the multi-chip width axis (GSPMD stance): 0 = no mesh
+    # (which still runs the SAME sharded program at width 1 — width is
+    # config, not a code path), N = shard the batch dimension over N
+    # chips, auto = every visible device. Widths beyond the visible
+    # device count clamp with a warning. Only meaningful on device
+    # backends — mesh= with a host type is a loud config error.
+    verify_mesh: str = "auto"
+    hash_mesh: str = "auto"
+    # routing= cost (default: measured-latency host/1-chip/N-chip
+    # routing) | device (force every eligible batch onto the widest
+    # arm — the anti-vacuity mode smokes/benches use)
+    verify_routing: str = ""  # "" = env default (STELLARD_VERIFY_ROUTING)
+    hash_routing: str = ""    # "" = env default (STELLARD_HASH_ROUTING)
+    # host-side thread pool for the cpu signature backend
+    verify_threads: int = 4
+    # device-wedge watchdog deadlines (utils.devicewatch defaults when
+    # None) — previously constructor-only, unreachable from any cfg
+    verify_device_first_timeout_s: Optional[float] = None
+    verify_device_warm_timeout_s: Optional[float] = None
+    hash_device_first_timeout_s: Optional[float] = None
+    # flat-batch device floor for the hash plane (None = the
+    # make_watched_hasher default / STELLARD_HASH_MIN_DEVICE_NODES)
+    hash_min_device_nodes: Optional[int] = None
     # [kernel_tuning]: path to an on-chip sweep's KERNEL_TUNING.json —
     # applied as env defaults at node setup so a daemon honors the
     # measured kernel winner (default: the file name in the CWD, if
@@ -335,6 +422,11 @@ class Config:
         cfg.database_path = one("database_path", cfg.database_path)
 
         sig = _kv(s.get("signature_backend", []))
+        _reject_unknown("signature_backend", sig, (
+            "type", "window_ms", "max_batch", "min_device_batch", "mesh",
+            "routing", "threads", "device_first_timeout_s",
+            "device_warm_timeout_s",
+        ))
         cfg.signature_backend = sig.get("type", one("signature_backend",
                                                     cfg.signature_backend)).lower()
         if "window_ms" in sig:
@@ -343,10 +435,49 @@ class Config:
             cfg.verify_max_batch = int(sig["max_batch"])
         if "min_device_batch" in sig:
             cfg.verify_min_device_batch = int(sig["min_device_batch"])
+        if "threads" in sig:
+            cfg.verify_threads = int(sig["threads"])
+        if "device_first_timeout_s" in sig:
+            cfg.verify_device_first_timeout_s = float(
+                sig["device_first_timeout_s"]
+            )
+        if "device_warm_timeout_s" in sig:
+            cfg.verify_device_warm_timeout_s = float(
+                sig["device_warm_timeout_s"]
+            )
+        cfg.verify_mesh = _crypto_mesh(
+            "signature_backend", cfg.signature_backend, sig, cfg.verify_mesh
+        )
+        cfg.verify_routing = _crypto_routing("signature_backend", sig)
+        _crypto_backend_gate(
+            "signature_backend", cfg.signature_backend, sig,
+            device_only=("routing", "device_first_timeout_s",
+                         "device_warm_timeout_s"),
+            host_only=("threads",),
+        )
         hsh = _kv(s.get("hash_backend", []))
+        _reject_unknown("hash_backend", hsh, (
+            "type", "mesh", "routing", "min_device_nodes",
+            "device_first_timeout_s",
+        ))
         cfg.hash_backend = hsh.get(
             "type", one("hash_backend", cfg.hash_backend)
         ).lower()
+        if "min_device_nodes" in hsh:
+            cfg.hash_min_device_nodes = int(hsh["min_device_nodes"])
+        if "device_first_timeout_s" in hsh:
+            cfg.hash_device_first_timeout_s = float(
+                hsh["device_first_timeout_s"]
+            )
+        cfg.hash_mesh = _crypto_mesh(
+            "hash_backend", cfg.hash_backend, hsh, cfg.hash_mesh
+        )
+        cfg.hash_routing = _crypto_routing("hash_backend", hsh)
+        _crypto_backend_gate(
+            "hash_backend", cfg.hash_backend, hsh,
+            device_only=("routing", "min_device_nodes",
+                         "device_first_timeout_s"),
+        )
         cfg.kernel_tuning = one("kernel_tuning", cfg.kernel_tuning)
         cp = _kv(s.get("close_pipeline", []))
         if "enabled" in cp:
@@ -498,6 +629,20 @@ class Config:
             cfg.fee_default = int(one("fee_default"))
         cfg.debug_logfile = one("debug_logfile", cfg.debug_logfile)
         return cfg
+
+    def verify_backend_opts(self) -> dict:
+        """Factory kwargs for make_verifier, built from the
+        [signature_backend] section — the plumbing that makes backend
+        options (mesh width, batch bounds, host threads) reachable from
+        a cfg file. Unknown keys fail loudly inside make_verifier."""
+        if self.signature_backend == "tpu":
+            return {
+                "mesh": self.verify_mesh,
+                "max_batch": self.verify_max_batch,
+            }
+        if self.signature_backend in ("cpu", "openssl"):
+            return {"threads": self.verify_threads}
+        return {}
 
     def thread_count(self) -> int:
         """reference: JobQueue thread heuristic from [node_size]

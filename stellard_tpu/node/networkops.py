@@ -263,6 +263,23 @@ class NetworkOPs:
         if cb:
             cb(tx, ter, applied)
 
+    def _plane_check_sign(self, tx: SerializedTransaction) -> bool:
+        """Synchronous single-tx verification THROUGH the routed verify
+        plane (the RPC submit path). Before this, process_transaction
+        verified inline via tx.check_sign(), bypassing the plane
+        entirely — a mesh-enabled node could serve a whole RPC flood
+        with device_sigs frozen at 0 and no routing/latency evidence.
+        The plane's cost model sends a 1-sig batch to the host arm
+        (same verify_signature underneath), so the common case costs
+        what check_sign did; forced-device mode and big resubmit
+        sweeps ride the configured kernel."""
+        ok = bool(self.vp.verify_many(
+            [VerifyRequest(tx.signing_pub_key, tx.signing_hash(),
+                           tx.signature)]
+        )[0])
+        tx.set_sig_verdict(ok)
+        return ok
+
     def process_transaction(
         self, tx: SerializedTransaction, admin: bool = False
     ) -> tuple[TER, bool]:
@@ -277,7 +294,7 @@ class NetworkOPs:
             return TER.temINVALID, False
         if flags & SF_SIGGOOD:
             tx.set_sig_verdict(True)
-        elif not tx.check_sign():
+        elif not self._plane_check_sign(tx):
             self.router.set_flag(txid, SF_BAD)
             self.stats["bad_sig"] += 1
             self._record_status(txid, TxStatus.INVALID)

@@ -281,12 +281,39 @@ class Node:
                 )
         from ..crypto.backend import make_watched_hasher
 
-        self.hasher = make_watched_hasher(cfg.hash_backend)
+        if cfg.signature_backend != "cpu" or cfg.hash_backend not in (
+            "cpu", "cpp"
+        ):
+            # device backends: persistent XLA compilation cache (keyed
+            # by host CPU fingerprint, utils/xlacache.py) so a daemon
+            # RESTART replays compiled programs instead of re-paying
+            # multi-minute compiles inside the prewarm — bench and the
+            # smokes already did this; the node itself never had, which
+            # left every restart cold
+            from ..utils.xlacache import enable_compilation_cache
+
+            enable_compilation_cache()
+
+        # config -> plane plumbing (ISSUE 15): every [hash_backend] /
+        # [signature_backend] option reaches its factory — mesh width,
+        # routing mode, floors and watchdog deadlines are cfg axes, and
+        # unknown keys fail loudly at build, never silently no-op
+        self.hasher = make_watched_hasher(
+            cfg.hash_backend,
+            min_device_nodes=cfg.hash_min_device_nodes,
+            mesh=cfg.hash_mesh,
+            routing=cfg.hash_routing or None,
+            first_timeout=cfg.hash_device_first_timeout_s,
+        )
         self.verify_plane = VerifyPlane(
             backend=cfg.signature_backend,
             window_ms=cfg.verify_batch_window_ms,
             max_batch=cfg.verify_max_batch,
             min_device_batch=cfg.verify_min_device_batch,
+            backend_opts=cfg.verify_backend_opts(),
+            routing=cfg.verify_routing or None,
+            device_first_timeout=cfg.verify_device_first_timeout_s,
+            device_warm_timeout=cfg.verify_device_warm_timeout_s,
             tracer=self.tracer,
         )
         self.verify_prewarm: Optional[threading.Thread] = None

@@ -505,6 +505,47 @@ def do_fee(ctx: Context) -> dict:
     return out
 
 
+def _crypto_json(node) -> dict:
+    """The get_counts crypto block: devices seen, per-plane mesh
+    provenance (requested/effective width, kernel selected, routing
+    mode) and cost-model snapshots. jax is only consulted when some
+    subsystem already initialized it — a cpu-backend node must not pay
+    device discovery for a counters RPC."""
+    import sys as _sys
+
+    vp = node.verify_plane.get_json()
+    out: dict = {
+        "verify": {
+            "backend": vp.get("backend"),
+            "routing": vp.get("routing"),
+            "mesh": vp.get("mesh"),
+            "arms": vp.get("arms"),
+            "model": vp.get("model"),
+            "device_sigs": vp.get("device_sigs"),
+            "cpu_sigs": vp.get("cpu_sigs"),
+        },
+    }
+    hasher = getattr(node, "hasher", None)
+    hj = getattr(hasher, "get_json", None)
+    if hj is not None:
+        out["hash"] = hj()
+    else:
+        out["hash"] = {
+            "backend": getattr(hasher, "name", None),
+            "device_nodes": getattr(hasher, "device_nodes", 0),
+            "host_nodes": getattr(hasher, "host_nodes", 0),
+        }
+    jx = _sys.modules.get("jax")
+    if jx is not None:
+        try:
+            out["devices"] = [str(d) for d in jx.devices()]
+        except Exception:  # noqa: BLE001 — counters must never fail the RPC
+            out["devices"] = "unavailable"
+    else:
+        out["devices"] = "jax-uninitialized"
+    return out
+
+
 @handler("get_counts", Role.ADMIN)
 def do_get_counts(ctx: Context) -> dict:
     """reference: handlers/GetCounts.cpp — object/op counters."""
@@ -513,6 +554,11 @@ def do_get_counts(ctx: Context) -> dict:
     out = {
         "jobq": node.job_queue.get_json(),
         "verify_plane": node.verify_plane.get_json(),
+        # crypto-plane routing honesty (ISSUE 15): devices actually
+        # seen, mesh width / kernel selected per plane, and the
+        # three-arm (host/1-chip/N-chip) cost-model snapshots — the
+        # counters BENCH lines and operators read to know what ran
+        "crypto": _crypto_json(node),
         "hash_router": node.hash_router.size(),
         "ledgers_cached": len(hist),
         "ledger_cache": {

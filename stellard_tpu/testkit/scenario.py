@@ -119,6 +119,15 @@ class Scenario:
     # nodes (nids after the relay tier) ingesting the validated chain;
     # the scorecard's `followers` block carries their sync evidence
     n_followers: int = 0
+    # sharded crypto plane (ISSUE 15): mesh_width>0 routes every honest
+    # validator's tree hashing through the mesh-enabled device hasher
+    # (forced-device routing for anti-vacuity), width clamped to the
+    # visible devices — width 1 on a 1-device box is the SAME routed
+    # plane, so the convergence/single-hash invariants always run
+    # against the sharded code path. The gate is HASH IDENTITY with
+    # the host-hashed run of the same seed (hashes are hashes), plus
+    # the scorecard's `mesh` block as machinery-fired evidence.
+    mesh_width: int = 0
     # convergence tail
     converge_extra: int = 2
     max_tail_steps: int = 240
@@ -657,6 +666,30 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
     honest = [
         i for i in range(scn.n_validators) if i not in scn.byzantine
     ]
+    # sharded hash plane under faults (ISSUE 15): one shared meshed
+    # watched hasher (forced-device routing — the cost model would
+    # bench a CPU-emulated kernel out and leave the leg vacuous) on
+    # every honest validator's trees. Digests are digests: the chain's
+    # bytes are identical to the host-hashed run of the same seed,
+    # which is exactly the invariant the fuzzer then checks.
+    mesh_hasher = None
+    if scn.mesh_width:
+        from ..crypto.backend import make_watched_hasher
+        from ..utils.xlacache import enable_compilation_cache
+
+        enable_compilation_cache()  # compiles reuse across runs/processes
+        mesh_hasher = make_watched_hasher(
+            "tpu", mesh=str(scn.mesh_width), routing="device"
+        )
+        # the FLAT facade (no hash_tree): tree hashing level-batches
+        # through the routed hash_packed path, i.e. the SHARDED
+        # masked-SHA kernel — the per-level pack_nodes shape the close
+        # path feeds, which is the plane this axis exists to cover
+        mesh_flat = mesh_hasher.flat_hasher()
+        for i in honest:
+            v = net.validators[i].node
+            v.hash_batch = mesh_flat
+            v.lm.hash_batch = mesh_flat
     # parallel speculation under faults: thread-mode pools (the simnet
     # is in-process; forking workers per validator would be pure
     # overhead) on every honest validator's chain
@@ -947,6 +980,18 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
                 "stats": dict(q0.stats),
                 "remaining": len(q0),
                 **_fairness(admissions, commits),
+            }
+        if mesh_hasher is not None:
+            # machinery-fired evidence for the mesh legs: the effective
+            # width the plane resolved to and whether the device kernel
+            # actually hashed nodes (booleans/config only — raw counts
+            # stay out so scorecards remain byte-identical per seed)
+            mj = mesh_hasher.get_json()
+            card["mesh"] = {
+                "width_requested": scn.mesh_width,
+                "width": (mj.get("mesh") or {}).get("mesh_width"),
+                "device_active": bool(mj.get("device_nodes")),
+                "wedged": bool(mj.get("wedged")),
             }
         if spec_execs:
             # anti-vacuity evidence for the spec-pool legs: the pools

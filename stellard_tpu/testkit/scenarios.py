@@ -209,6 +209,27 @@ def scenario_squelch_rotation_flood(seed: int = 0) -> Scenario:
     )
 
 
+def scenario_mesh_hash(seed: int = 0) -> Scenario:
+    """Sharded crypto plane under faults (ISSUE 15): partitions +
+    a kill while every honest validator's tree hashing routes through
+    the mesh-enabled device hasher (forced-device, width clamped to
+    visible devices — width 1 on a 1-device box is the same routed
+    plane). The invariants are the usual convergence/single-hash set:
+    a sharded hasher that produced different bytes would fork the net
+    on the spot, so chaos coverage IS the identity gate."""
+    sched = FaultSchedule(seed)
+    sched.partition(10, {0, 1}, {2, 3}, heal_at=20)
+    sched.kill(28, 3, revive_at=34)
+    scn = Scenario(
+        name="mesh_hash", seed=seed, n_validators=4, quorum=3,
+        steps=56,
+        schedule=sched,
+        workload={"kind": "payment_flood", "n": 28},
+    )
+    scn.mesh_width = 8
+    return scn
+
+
 def scenario_fee_gaming(seed: int = 0) -> Scenario:
     return Scenario(
         name="fee_gaming", seed=seed, n_validators=4, quorum=3,
@@ -230,6 +251,7 @@ MATRIX = {
     "hot_account": scenario_hot_account,
     "order_books": scenario_order_books,
     "follower_partition": scenario_follower_partition,
+    "mesh_hash": scenario_mesh_hash,
     "fee_gaming": scenario_fee_gaming,
     "flood_survival": scenario_flood_survival,
     "squelch_rotation_flood": scenario_squelch_rotation_flood,

@@ -127,6 +127,11 @@ def counter_vector(card: dict) -> dict[str, int]:
         put(f"shards.{k}", v)  # history-shard tier coverage axis
     fol = card.get("followers") or {}
     put("followers.synced", fol.get("synced"))
+    # sharded hash plane (triage view only — width is CONFIG echo, so
+    # it stays OUT of coverage_state's config-blind dynamics vector)
+    mesh = card.get("mesh") or {}
+    put("mesh.width", mesh.get("width"))
+    put("mesh.device_active", mesh.get("device_active"))
     for nid, fl in (card.get("flooders") or {}).items():
         put(f"flooder.{nid}.refused_by", fl.get("refused_by", 0))
     return out
@@ -582,6 +587,13 @@ class ScenarioGenerator:
             self._attach_overlay_tier(rng, scn)
         if rng.random() < 0.15:
             scn.n_followers = 1
+        # sharded hash-plane axis (ISSUE 15): derived from the already-
+        # drawn scenario seed rather than a fresh rng draw, so adding
+        # the axis leaves the generator's existing stream — and every
+        # previously generated scenario — bit-identical. ~1 in 16 runs
+        # route honest tree hashing through the meshed device hasher.
+        if scn.seed & 0xF == 0:
+            scn.mesh_width = (2, 4, 8)[(scn.seed >> 4) % 3]
 
         raw: list[tuple] = []
         hostile = n - 1 if (byz or cold) else None
@@ -746,6 +758,10 @@ def _weaken_ops(scn: Scenario) -> list[tuple[str, Scenario]]:
         c = clone()
         c.n_followers = 0
         out.append(("drop_followers", c))
+    if getattr(scn, "mesh_width", 0):
+        c = clone()
+        c.mesh_width = 0
+        out.append(("drop_mesh", c))
     if scn.byzantine:
         c = clone()
         c.byzantine = {}

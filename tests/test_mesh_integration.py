@@ -151,11 +151,35 @@ class TestMeshedHashing:
         got = tpu.prefix_hash_batch(prefixes, payloads)
         want = CpuHasher().prefix_hash_batch(prefixes, payloads)
         assert got == want
+        assert tpu.n_devices == 8  # mesh="auto" default on the 8-dev env
         # the kernel in use really is the mesh-sharded jit (its input
         # shardings name the batch axis)
-        kern = TpuHasher._masked_kernel()
+        kern = tpu._masked_kernel()
         shardings = getattr(kern, "_in_shardings", None) or getattr(
             kern, "in_shardings", None
         )
         if shardings is not None:  # jax version exposes them
             assert any(s is not None for s in shardings)
+
+    def test_every_width_matches_host_bytes(self):
+        """mesh= is a config axis: widths 1/2/4/8 of the SAME sharded
+        program produce byte-identical digests on ragged batches (37
+        messages — not divisible by any width)."""
+        from stellard_tpu.crypto.backend import CpuHasher, TpuHasher
+
+        rng = np.random.default_rng(11)
+        prefixes = [0x4D494E00] * 37
+        payloads = [rng.bytes(int(rng.integers(1, 700))) for _ in range(37)]
+        want = CpuHasher().prefix_hash_batch(prefixes, payloads)
+        for width in (1, 2, 4, 8):
+            h = TpuHasher(mesh=str(width))
+            assert h.prefix_hash_batch(prefixes, payloads) == want
+            assert h.n_devices == width
+
+    def test_non_pow2_width_rounds_down(self):
+        from stellard_tpu.crypto.backend import TpuHasher
+
+        h = TpuHasher(mesh="3")
+        h.prefix_hash_batch([0x1234], [b"x"])
+        assert h.n_devices == 2  # pow2 floor: the leaf batcher pads
+        # rows to powers of two, only pow2 widths divide them evenly

@@ -1,0 +1,156 @@
+"""Multichip bench: mesh width as a CONFIG axis, swept end to end.
+
+Unlike tools/mesh_bench.py (which drives parallel/mesh.py kernels
+directly), this leg sweeps the PRODUCT seam the node itself uses —
+``make_verifier("tpu", mesh=W)`` and ``make_watched_hasher("tpu",
+mesh=W, routing="device")`` — over widths 1/2/4/8 of a virtual CPU
+mesh, measuring verify sigs/s and packed tree-hash nodes/s per width
+and pinning byte identity against the host reference at EVERY width.
+
+Run as a SUBPROCESS (the device-count flag must be set before backend
+init). Prints one JSON line; bench.py's bench_multichip() wraps it
+into BENCH metric lines with honest fallback/provenance fields: on
+this box the "devices" are virtual CPU shards, and the line says so —
+a CPU-emulated sweep must never masquerade as a chip number
+(BENCH_r04's lesson).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import time
+
+N = int(os.environ.get("MULTICHIP_DEVICES", "8"))
+WIDTHS = [int(w) for w in
+          os.environ.get("MULTICHIP_WIDTHS", "1,2,4,8").split(",")]
+BATCH = int(os.environ.get("MULTICHIP_BATCH", "512"))
+HASH_NODES = int(os.environ.get("MULTICHIP_HASH_NODES", "2048"))
+SECONDS = float(os.environ.get("MULTICHIP_SECONDS", "3"))
+
+opt = f"--xla_force_host_platform_device_count={N}"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" in flags:
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", opt, flags)
+else:
+    flags = (flags + " " + opt).strip()
+os.environ["XLA_FLAGS"] = flags
+os.environ["JAX_PLATFORMS"] = "cpu"
+# the sweep measures the XLA formulation (the tuned production default);
+# pallas-interpret on a CPU mesh measures the interpreter, not the plane
+os.environ.setdefault("STELLARD_VERIFY_IMPL", "xla")
+# one compiled shape per width: every chunk pads to max_batch
+os.environ.setdefault("STELLARD_PAD_POLICY", "max")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from stellard_tpu.crypto.backend import (
+        CpuHasher,
+        VerifyRequest,
+        make_verifier,
+        make_watched_hasher,
+    )
+    from stellard_tpu.protocol.keys import KeyPair
+    from stellard_tpu.utils.xlacache import enable_compilation_cache
+
+    enable_compilation_cache()
+    devices = jax.devices()
+    widths = sorted({min(w, len(devices)) for w in WIDTHS})
+
+    # -- verify workload: ragged batch, bad signatures planted in every
+    #    shard position of the widest mesh ------------------------------
+    rng = np.random.default_rng(7)
+    keys = [KeyPair.from_seed(bytes(rng.integers(0, 256, 32,
+                                                 dtype=np.uint8)))
+            for _ in range(16)]
+    n_sigs = BATCH - 3  # ragged: not divisible by any width
+    corrupt = set(range(0, n_sigs, max(1, n_sigs // max(widths))))
+    reqs, want = [], []
+    for i in range(n_sigs):
+        k = keys[i % 16]
+        m = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+        s = bytearray(k.sign(m))
+        if i in corrupt:
+            s[int(rng.integers(0, 64))] ^= 1 << int(rng.integers(0, 8))
+        reqs.append(VerifyRequest(k.public, m, bytes(s)))
+        want.append(i not in corrupt)
+    want = np.array(want, bool)
+
+    verify = {}
+    for w in widths:
+        v = make_verifier("tpu", mesh=str(w), min_batch=BATCH,
+                          max_batch=BATCH)
+        got = np.asarray(v.verify_batch(reqs))  # compile + identity
+        identical = bool(np.array_equal(got, want))
+        t0 = time.time()
+        n = 0
+        while time.time() - t0 < SECONDS:
+            r = np.asarray(v.verify_batch(reqs))
+            identical = identical and bool(np.array_equal(r, want))
+            n += 1
+        rate = n_sigs * n / (time.time() - t0)
+        verify[str(w)] = {
+            "sigs_per_sec": round(rate, 1),
+            "identical_every_rep": identical,
+            **v.describe(),
+        }
+
+    # -- hash workload: the packed flat-buffer shape (pack_nodes /
+    #    seal-flush contract: blob == hashed bytes), routed through the
+    #    SAME watched construction the node runs -----------------------
+    msgs = []
+    for _ in range(HASH_NODES):
+        size = int(rng.integers(40, 300))
+        msgs.append(b"MIN\0" + bytes(rng.integers(0, 256, size,
+                                                  dtype=np.uint8)))
+    buf = b"".join(msgs)
+    offsets = [0]
+    for m in msgs:
+        offsets.append(offsets[-1] + len(m))
+    host_ref = CpuHasher().hash_packed(buf, offsets)
+
+    hashp = {}
+    for w in widths:
+        h = make_watched_hasher("tpu", mesh=str(w), routing="device",
+                                min_device_nodes=0)
+        got = h.hash_packed(buf, offsets)  # compile + identity
+        identical = got == host_ref
+        t0 = time.time()
+        n = 0
+        while time.time() - t0 < SECONDS:
+            r = h.hash_packed(buf, offsets)
+            identical = identical and (r == host_ref)
+            n += 1
+        rate = HASH_NODES * n / (time.time() - t0)
+        j = h.get_json()
+        hashp[str(w)] = {
+            "nodes_per_sec": round(rate, 1),
+            "identical_every_rep": bool(identical),
+            "device_nodes": j["device_nodes"],
+            "mesh": j["mesh"],
+            "cost_model": j["flat_model"],
+        }
+
+    print(json.dumps({
+        "widths": widths,
+        "virtual_devices": len(devices),
+        "platform": devices[0].platform,
+        "devices": [str(d) for d in devices],
+        "batch": n_sigs,
+        "hash_nodes": HASH_NODES,
+        "verify": verify,
+        "hash": hashp,
+    }))
+
+
+if __name__ == "__main__":
+    main()

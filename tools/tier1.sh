@@ -72,6 +72,19 @@ if ! JAX_PLATFORMS=cpu timeout -k 10 500 python tools/oocsmoke.py; then
   exit 2
 fi
 
+echo "== multi-chip smoke gate (mesh-enabled verify flood vs cpu, byte identity) =="
+# boots a node with [signature_backend] type=tpu mesh=auto
+# routing=device on the virtual 8-device CPU mesh, floods 200 txs
+# through the full async pipeline, and replays the identical workload
+# on a cpu-backend node: every closed ledger hash must match
+# byte-for-byte AND the mesh run must show device_sigs > 0 at
+# effective width 8 — a sharded plane that silently fell back to the
+# host (or flipped one verdict) fails CI, not a consensus round
+if ! JAX_PLATFORMS=cpu timeout -k 10 600 python tools/meshsmoke.py; then
+  echo "MESH SMOKE FAILED — sharded crypto plane is broken" >&2
+  exit 2
+fi
+
 echo "== adversarial scenario smoke gate (partition + byzantine + catch-up, seeded) =="
 # replays three deterministic simnet scenarios twice each with one
 # seed: honest validators must converge on ONE identical chain, the two
