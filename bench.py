@@ -1148,6 +1148,11 @@ def bench_parallel_spec_flood(backends):
         "validation_aborts": spec4.get("validation_aborts", 0),
         "serial_fallbacks": spec4.get("serial_fallbacks", 0),
         "drains_forced": spec4.get("drains_forced", 0),
+        # transport provenance (ISSUE 16): which wire the pool rode —
+        # shared-memory rings by default — plus the ring counters so a
+        # "ring" run that actually moved nothing is self-refuting
+        "transport": spec4.get("transport"),
+        "ring": spec4.get("ring"),
         "hashes_identical": len(stage_ids) == 1,
         "node_hashes_identical": len(node_ids) == 1,
         # scaling context: the pool's ceiling is min(cores - 1, GIL

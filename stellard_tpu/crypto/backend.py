@@ -317,6 +317,43 @@ class CpuHasher(BatchHasher):
 # tpu backend
 
 
+class TransferMeter:
+    """Host<->device transfer honesty counter (ISSUE 16): every device
+    plane counts its host->device shipments and device->host readbacks
+    so residency can't silently regress — a "fused" close that quietly
+    round-trips per level shows up as a readback count proportional to
+    tree depth instead of the pinned one-per-tree. ``uploads`` counts
+    logical shipment SETS (one per dispatched program, however many
+    arrays ride it); ``readbacks`` counts host-blocking device->host
+    transfers — the residency signal."""
+
+    __slots__ = ("uploads", "readbacks", "bytes_up", "bytes_down")
+
+    def __init__(self):
+        self.uploads = 0
+        self.readbacks = 0
+        self.bytes_up = 0
+        self.bytes_down = 0
+
+    def up(self, nbytes: int) -> None:
+        self.uploads += 1
+        self.bytes_up += int(nbytes)
+
+    def down(self, nbytes: int) -> None:
+        self.readbacks += 1
+        self.bytes_down += int(nbytes)
+
+    def get_json(self) -> dict:
+        return {
+            "uploads": self.uploads,
+            "readbacks": self.readbacks,
+            "bytes_up": self.bytes_up,
+            "bytes_down": self.bytes_down,
+            "transfers": self.uploads + self.readbacks,
+            "bytes_moved": self.bytes_up + self.bytes_down,
+        }
+
+
 class TpuVerifier(BatchVerifier):
     """Batched JAX Ed25519 kernel (ops.ed25519_jax.verify_kernel).
 
@@ -361,6 +398,7 @@ class TpuVerifier(BatchVerifier):
             )
         self._pad_policy_env = env
         self.pad_policy = "pow2" if env != "max" else "max"
+        self.transfers = TransferMeter()
 
     def _resolve_kernel(self):
         if self._kernel is not None:
@@ -464,6 +502,7 @@ class TpuVerifier(BatchVerifier):
             k = kernel
             if self._small_kernel is not None and size < self._mesh_floor:
                 k = self._small_kernel  # single chip beats 94%-zero shards
+            self.transfers.up(sum(v.nbytes for v in inputs.values()))
             res = k(
                 inputs["a_words"], inputs["r_words"], inputs["s_windows"],
                 inputs["h_digits"], inputs["s_canonical"],
@@ -471,9 +510,13 @@ class TpuVerifier(BatchVerifier):
             pending.append((start, len(chunk), res))
             if len(pending) > 1:
                 s0, n0, r0 = pending.pop(0)
-                out[s0 : s0 + n0] = np.asarray(r0)[:n0]
+                got = np.asarray(r0)
+                self.transfers.down(got.nbytes)
+                out[s0 : s0 + n0] = got[:n0]
         for s0, n0, r0 in pending:
-            out[s0 : s0 + n0] = np.asarray(r0)[:n0]
+            got = np.asarray(r0)
+            self.transfers.down(got.nbytes)
+            out[s0 : s0 + n0] = got[:n0]
         return out
 
 
@@ -498,11 +541,19 @@ class TpuHasher(BatchHasher):
         self.devices_visible = 0
         self.kernel_selected = "unresolved"
         self._masked = None
-        # whole-tree pipeline invocations (hash_tree): the scatter
-        # chain is a single-device program, so device work can be real
-        # while the SHARDED flat kernel stays unresolved — provenance
-        # must say which one ran
+        # whole-tree pipeline invocations (hash_tree): device work can
+        # be real while the SHARDED flat kernel stays unresolved —
+        # provenance must say which one ran
         self.tree_calls = 0
+        self._tree_k = None  # (leaf, inner) sharded level kernels
+        self.tree_width = 0
+        self.tree_kernel = "unresolved"
+        self.transfers = TransferMeter()
+        # separate meter for the whole-tree pipeline: the residency pin
+        # is crisp ONLY here — readbacks == tree_calls (one blocking
+        # transfer per tree, never one per level), while the flat path
+        # legitimately reads back per bucket
+        self.tree_transfers = TransferMeter()
 
     def prefix_hash_batch(self, prefixes, payloads):
         return self._hash_msgs(
@@ -542,10 +593,12 @@ class TpuHasher(BatchHasher):
         results = []  # (idxs, device_state) — dispatched async, read after
         for ladder, idxs in buckets.items():
             blocks, nblocks = pad_leaf_batch([msgs[i] for i in idxs], ladder)
+            self.transfers.up(blocks.nbytes + nblocks.nbytes)
             st = self._masked_kernel()(jnp.asarray(blocks), jnp.asarray(nblocks))
             results.append((idxs, st))
         for idxs, st in results:
             arr = np.asarray(st)  # [Mpad, 16] u32
+            self.transfers.down(arr.nbytes)
             raw = arr[:, :8].astype(">u4").tobytes()
             for row, i in enumerate(idxs):
                 out[i] = raw[row * 32 : row * 32 + 32]
@@ -582,18 +635,52 @@ class TpuHasher(BatchHasher):
             self._masked = kern
         return self._masked
 
+    # width -> compiled (leaf, inner) sharded tree-level kernels — the
+    # fused close's program set, shared across instances like _KERNELS
+    _TREE_KERNELS: dict[int, tuple] = {}
+
+    def _tree_kernels(self):
+        if self._tree_k is None:
+            jax = ensure_jax()  # first import may race the verify plane
+
+            from ..parallel.mesh import make_mesh, sharded_tree_kernels
+
+            devices = jax.devices()
+            self.devices_visible = len(devices)
+            # same width discipline as the flat kernel: every level's
+            # row count is a power of two >= 8, so pow2 widths up to 8
+            # divide them evenly at any tree shape
+            width = min(
+                8, resolve_mesh_width(self.mesh, len(devices), pow2=True)
+            )
+            self.tree_width = width
+            self.tree_kernel = f"tree-sha512-sharded@{width}"
+            pair = TpuHasher._TREE_KERNELS.get(width)
+            if pair is None:
+                # one code path at every width: width 1 is a one-device
+                # mesh of the same sharded+donated programs
+                pair = sharded_tree_kernels(make_mesh(devices[:width]))
+                TpuHasher._TREE_KERNELS[width] = pair
+            self._tree_k = pair
+        return self._tree_k
+
     def describe(self) -> dict:
         """Routing-honesty snapshot (bench provenance / get_counts).
         `kernel`/`mesh_width` describe the SHARDED flat-batch kernel;
-        `tree_pipeline_calls` counts whole-tree (unsharded, width-1)
-        pipeline runs, which can carry the device traffic while the
-        flat kernel stays unresolved."""
+        `tree_kernel`/`tree_width` the fused whole-tree program set and
+        `tree_pipeline_calls` its run count — either arm can carry the
+        device traffic while the other stays unresolved, and provenance
+        must say which one ran."""
         return {
             "mesh_requested": self.mesh,
             "mesh_width": self.n_devices or None,
             "devices_visible": self.devices_visible or None,
             "kernel": self.kernel_selected,
+            "tree_kernel": self.tree_kernel,
+            "tree_width": self.tree_width or None,
             "tree_pipeline_calls": self.tree_calls,
+            "transfers": self.transfers.get_json(),
+            "tree_transfers": self.tree_transfers.get_json(),
         }
 
     # -- whole-tree pipeline ----------------------------------------------
@@ -613,15 +700,11 @@ class TpuHasher(BatchHasher):
         ensure_jax()  # first import may race the verify plane
         import jax.numpy as jnp
 
-        self.tree_calls += 1
-
         from ..ops.sha512_jax import padded_block_count
         from ..ops.treehash_jax import (
             INNER_WORDS,
             LEAF_BLOCK_LADDER,
             build_inner_template,
-            inner_level_kernel,
-            leaf_level_kernel,
             pad_leaf_batch,
             _pow2,
         )
@@ -687,7 +770,15 @@ class TpuHasher(BatchHasher):
             self.host_nodes += hashed_host
             return hashed_host
 
+        # counted HERE, not at entry: tree_calls must pair 1:1 with the
+        # pipeline's single readback (the residency pin readbacks ==
+        # tree_calls), so already-hashed / host-only calls don't count
+        self.tree_calls += 1
         cap = _pow2(offset)
+        # the persistent device buffer: every level kernel takes it
+        # DONATED and hands back the same allocation, so the whole
+        # chain runs device-resident at any mesh width
+        leaf_k, inner_k = self._tree_kernels()
         buf = jnp.zeros((cap, 8), jnp.uint32)
         prefix_words = int(HP_INNER_NODE)
 
@@ -697,13 +788,14 @@ class TpuHasher(BatchHasher):
                 blocks, nblocks = pad_leaf_batch(
                     [msg for _n, msg in entries], ladder
                 )
-                buf = leaf_level_kernel(
+                self.tree_transfers.up(blocks.nbytes + nblocks.nbytes)
+                buf = leaf_k(
                     buf, jnp.asarray(blocks), jnp.asarray(nblocks), off
                 )
             else:
                 _k, inners, off = step
                 n = len(inners)
-                template = build_inner_template(n)
+                template = build_inner_template(n, pow2_rows=True)
                 template[:, 0] = prefix_words
                 rows, col_base, src_rows = [], [], []
                 for i, node in enumerate(inners):
@@ -720,22 +812,34 @@ class TpuHasher(BatchHasher):
                         template[i, 1 + 8 * c : 9 + 8 * c] = np.frombuffer(
                             h, dtype=">u4"
                         )
-                k_pad = _pow2(max(len(rows), 1))
-                dummy_row = template.shape[0] - 1  # scratch row
-                rows += [dummy_row] * (k_pad - len(rows))
-                col_base += [1] * (k_pad - len(col_base))
-                src_rows += [0] * (k_pad - len(src_rows))
-                buf = inner_level_kernel(
+                if rows:
+                    # quantize the scatter program to a pow2 length by
+                    # REPEATING entry 0 — duplicate scatters of one
+                    # identical (index, value) are deterministic, so no
+                    # scratch row is needed and template rows stay
+                    # pow2/mesh-divisible ([0]-length programs when
+                    # every child hash is already known)
+                    pad = _pow2(len(rows)) - len(rows)
+                    rows += [rows[0]] * pad
+                    col_base += [col_base[0]] * pad
+                    src_rows += [src_rows[0]] * pad
+                ra = np.array(rows, np.int32)
+                ca = np.array(col_base, np.int32)
+                sa = np.array(src_rows, np.int32)
+                self.tree_transfers.up(
+                    template.nbytes + ra.nbytes + ca.nbytes + sa.nbytes
+                )
+                buf = inner_k(
                     buf,
                     jnp.asarray(template),
-                    jnp.asarray(np.array(rows, np.int32)),
-                    jnp.asarray(np.array(col_base, np.int32)),
-                    jnp.asarray(np.array(src_rows, np.int32)),
+                    jnp.asarray(ra),
+                    jnp.asarray(ca),
+                    jnp.asarray(sa),
                     off,
-                    n,
                 )
 
         host = np.asarray(buf)  # ONE transfer; blocks on the whole chain
+        self.tree_transfers.down(host.nbytes)
         lock = cancel_lock if cancel_lock is not None else threading.Lock()
         with lock:
             if cancelled is not None and cancelled.is_set():
@@ -1063,6 +1167,12 @@ class WatchdogHasher(BatchHasher):
     detection for the shared process-wide verdict.
     """
 
+    # [tree] fused kill-switch surface: node.py stamps cfg.tree_fused
+    # here, and shamap.compute_hashes / ledgermaster._drain_loop consult
+    # it before taking the whole-tree device pipeline (fused=0 keeps
+    # the staged per-level hash_packed path — the identity leg)
+    fused_enabled = True
+
     def __init__(self, inner: BatchHasher, fallback: BatchHasher,
                  first_timeout: Optional[float] = None,
                  warm_timeout: Optional[float] = None,
@@ -1243,13 +1353,39 @@ class WatchdogHasher(BatchHasher):
             "wedged": self.device_wedged,
             "routing": self.routing,
             "arms": list(self._live_arms()),
+            "fused": bool(self.fused_enabled),
             "mesh": describe() if describe is not None else None,
             "device_nodes": self.device_nodes,
             "host_nodes": self.host_nodes,
             "min_device_nodes": self.min_device_nodes,
+            "transfers": self.transfer_json(),
             "flat_model": self._flat.get_json(),
             "tree_model": self._tree.get_json(),
         }
+
+    def transfer_json(self) -> Optional[dict]:
+        """Transfer-honesty aggregate over both device arms (the N-chip
+        inner and the 1-chip arm when present): per-close deltas of this
+        block are the residency proof — a fused close moves ONE readback
+        per tree, not one per level."""
+        agg: Optional[dict] = None
+        for h in (self.inner, self.inner_one):
+            if h is None:
+                continue
+            # both meters per arm: the flat hash_packed meter AND the
+            # whole-tree pipeline meter (split so the one-readback pin
+            # stays crisp on tree_transfers alone)
+            for meter in (getattr(h, "transfers", None),
+                          getattr(h, "tree_transfers", None)):
+                if meter is None:
+                    continue
+                j = meter.get_json()
+                if agg is None:
+                    agg = dict(j)
+                else:
+                    for k, v in j.items():
+                        agg[k] = agg.get(k, 0) + v
+        return agg
 
     def flat_hasher(self) -> "_RoutedFlat":
         """This hasher's routed FLAT facade (no hash_tree attr): tree

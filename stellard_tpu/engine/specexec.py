@@ -373,6 +373,13 @@ def _worker_main(cmd, res) -> None:
     so non-reply messages arriving while a read is in flight are buffered
     and handled after the current execution finishes."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    for conn in (cmd, res):
+        # ring transport: drop this process's inherited copy of the
+        # parent-side doorbell fd so parent death surfaces as EOF here
+        # (the pipe transport's Connections need no settling)
+        settle = getattr(conn, "settle", None)
+        if settle is not None:
+            settle()
     buffered: deque = deque()
     state = {"wid": None, "replica": None, "adapter": None,
              "journal": [], "committed_max": -1}
@@ -474,10 +481,13 @@ def _worker_main(cmd, res) -> None:
         return True
 
     while True:
-        msg = buffered.popleft() if buffered else cmd.recv()
         try:
+            msg = buffered.popleft() if buffered else cmd.recv()
             alive = handle(msg)
         except (EOFError, OSError):
+            # parent gone (or closed our command channel at stop):
+            # exit quietly — this IS the shutdown signal when the
+            # parent marked this worker dead and skipped its ("stop",)
             return
         if not alive:
             return
@@ -525,9 +535,18 @@ class SpecExecutor:
 
     def __init__(self, workers: int = 1, mode: str = "process",
                  max_retries: int = 3, tracer=None,
-                 drain_timeout_s: float = 10.0):
+                 drain_timeout_s: float = 10.0, transport: str = "ring"):
         self.workers = int(workers)
         self.mode = mode
+        if transport not in ("ring", "pipe"):
+            raise ValueError(
+                f"[spec] transport must be 'ring' or 'pipe', got "
+                f"{transport!r}"
+            )
+        # process-worker wire: "ring" (shared-memory SPSC rings, pickle-
+        # free codec — the default) or "pipe" (the PR 6 pickled
+        # multiprocessing.Pipe wire, kept as the comparison/fallback leg)
+        self.transport = transport
         self.max_retries = int(max_retries)
         self.drain_timeout_s = float(drain_timeout_s)
         self.tracer = tracer if tracer is not None else get_tracer()
@@ -627,15 +646,31 @@ class SpecExecutor:
 
         ctx = mp.get_context("fork")
         for i in range(self.workers):
-            cmd_r, cmd_w = ctx.Pipe(duplex=False)   # parent -> worker
-            res_r, res_w = ctx.Pipe(duplex=False)   # worker -> parent
+            if self.transport == "ring":
+                from .specring import ring_pipe
+
+                # built BEFORE fork; the child inherits the mapped
+                # segments and its doorbell fds through Process args
+                # (fork does not pickle them)
+                cmd_r, cmd_w = ring_pipe()          # parent -> worker
+                res_r, res_w = ring_pipe()          # worker -> parent
+            else:
+                cmd_r, cmd_w = ctx.Pipe(duplex=False)   # parent -> worker
+                res_r, res_w = ctx.Pipe(duplex=False)   # worker -> parent
             proc = ctx.Process(
                 target=_worker_main, args=(cmd_r, res_w),
                 name=f"spec-worker-{i}", daemon=True,
             )
             proc.start()
-            cmd_r.close()
-            res_w.close()
+            if self.transport == "ring":
+                # keep cmd_w/res_r; settle drops the parent's copies of
+                # the child-side doorbell fds so worker death surfaces
+                # as EOF on res / EPIPE on cmd, like a broken pipe did
+                cmd_w.settle()
+                res_r.settle()
+            else:
+                cmd_r.close()
+                res_w.close()
             self._procs.append(_Proc(proc, cmd_w, res_r))
 
     def stop(self) -> None:
@@ -656,6 +691,17 @@ class SpecExecutor:
                 if w.proc.is_alive():
                     w.proc.terminate()
             w.alive = False
+            for conn in (w.cmd, w.res):
+                # ring ends: release + unlink the shared segments (the
+                # creator owns teardown); pipe Connections just close.
+                # getattr both ways: tests wrap conns in minimal fakes
+                fin = getattr(conn, "destroy", None) \
+                    or getattr(conn, "close", None)
+                try:
+                    if fin is not None:
+                        fin()
+                except OSError:
+                    pass
         if self._committer is not None:
             self._committer.join(timeout=5)
             self._committer = None
@@ -672,7 +718,23 @@ class SpecExecutor:
     def get_json(self) -> dict:
         out = self.counters.snapshot()
         out.update(workers=self.workers, mode=self.mode,
-                   active=self.active, max_retries=self.max_retries)
+                   active=self.active, max_retries=self.max_retries,
+                   transport=self.transport)
+        if self.transport == "ring" and self._procs:
+            ring = {"msgs_sent": 0, "bytes_sent": 0, "msgs_recv": 0,
+                    "bytes_recv": 0, "full_waits": 0, "torn_slots": 0}
+            for w in self._procs:
+                cs = getattr(w.cmd, "counters", None)
+                rs = getattr(w.res, "counters", None)
+                if cs:
+                    ring["msgs_sent"] += cs["msgs"]
+                    ring["bytes_sent"] += cs["bytes"]
+                    ring["full_waits"] += cs["full_waits"]
+                if rs:
+                    ring["msgs_recv"] += rs["msgs"]
+                    ring["bytes_recv"] += rs["bytes"]
+                    ring["torn_slots"] += rs["torn_slots"]
+            out["ring"] = ring
         return out
 
     # -- window lifecycle (called under the chain lock) --------------------
@@ -953,13 +1015,26 @@ class SpecExecutor:
                     w = by_conn[conn]
                     try:
                         msg = conn.recv()
-                    except (EOFError, OSError):
+                    except (EOFError, OSError) as exc:
                         # guard: the same worker may already have been
                         # discovered dead this iteration via a failed
                         # send
                         if w.alive:
                             w.alive = False
                             self.counters.add("worker_deaths")
+                            # a torn ring slot (CRC/framing mismatch)
+                            # retires the connection exactly like a
+                            # death, but the distinction matters when
+                            # debugging: spec.ring.torn means corrupt
+                            # shared memory, spec.ring.dead a lost peer
+                            if self.transport == "ring":
+                                torn = (type(exc).__name__
+                                        == "TornSlotError")
+                                self.tracer.instant(
+                                    "spec.ring.torn" if torn
+                                    else "spec.ring.dead",
+                                    "spec", error=str(exc)[:120],
+                                )
                             if session is not None:
                                 self._fail_worker(w, session)
                         continue

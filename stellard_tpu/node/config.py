@@ -11,6 +11,7 @@ batch-window tuning) following the same pattern as the reference's
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -107,6 +108,29 @@ def _crypto_backend_gate(section: str, backend: str, kv: dict,
                 f"[{section}] {bad} only apply to host backends "
                 f"(type=tpu would silently drop them)"
             )
+
+
+def resolve_spec_workers(workers, cpu_count=None, log=None) -> int:
+    """Resolve ``[spec] workers`` to a concrete pool size at node setup.
+
+    Integers pass through. ``"auto"`` resolves from ``os.cpu_count()``
+    capped at 8 — and below 4 physical cores it LOUDLY disables the
+    pool (returns 1, the inline serial path) instead of silently losing
+    throughput: on a small box the pool's submit+committer overhead
+    exceeds the serial speculation cost it replaces."""
+    if workers != "auto":
+        return int(workers)
+    ncpu = cpu_count if cpu_count is not None else (os.cpu_count() or 1)
+    if ncpu < 4:
+        if log is not None:
+            log.warning(
+                "[spec] workers=auto: %d core(s) < 4 — parallel "
+                "speculation pool DISABLED (inline serial path); the "
+                "pool's IPC overhead would exceed the serial cost on "
+                "this box", ncpu,
+            )
+        return 1
+    return min(8, ncpu)
 
 
 # default [kernel_tuning] path, shared with Node's outcome logging
@@ -219,6 +243,12 @@ class Config:
     # trees fault nodes from the NodeStore through this cache and RSS
     # stays near the budget regardless of ledger size
     tree_cache_mb: int = 256
+    # fused=1 (default): whole dirty trees hash through the device
+    # hasher's fused level-chained pipeline (hash_tree) — digests stay
+    # device-resident across levels, ONE readback per tree. fused=0 is
+    # the kill-switch: the staged per-level hash_packed path, one
+    # round-trip per level — kept as the fused-vs-staged identity leg.
+    tree_fused: bool = True
 
     # -- admission control ([txq]) -----------------------------------------
     # enabled=1: post-verify intake routes through the TxQ (node/txq.py)
@@ -249,11 +279,18 @@ class Config:
     # schedules). max_retries bounds optimistic re-execution before the
     # committing thread falls back to a serial in-order apply;
     # drain_timeout_s bounds how long a close waits on the pool before
-    # completing the window serially itself.
-    spec_workers: int = 1
+    # completing the window serially itself. workers=auto resolves from
+    # os.cpu_count() at node setup (resolve_spec_workers): capped at 8,
+    # and below 4 cores the pool is LOUDLY disabled (workers=1, inline
+    # serial) instead of silently losing throughput to IPC overhead.
+    # transport selects the process-worker wire: "ring" (shared-memory
+    # SPSC rings + pickle-free codec, engine/specring.py — the default)
+    # or "pipe" (the PR 6 pickled multiprocessing.Pipe wire).
+    spec_workers: int | str = 1
     spec_mode: str = "process"
     spec_max_retries: int = 3
     spec_drain_timeout_s: float = 10.0
+    spec_transport: str = "ring"
 
     # -- ledger close ([close]) --------------------------------------------
     # delta_replay=1: the open-ledger accept also executes the tx once in
@@ -504,7 +541,26 @@ class Config:
                 setattr(cfg, attr, conv(txq[key]))
         spec = _kv(s.get("spec", []))
         if "workers" in spec:
-            cfg.spec_workers = int(spec["workers"])
+            v = spec["workers"].strip().lower()
+            if v == "auto":
+                cfg.spec_workers = "auto"
+            else:
+                try:
+                    cfg.spec_workers = int(v)
+                except ValueError:
+                    # dead-config-seam convention: a typo'd knob raises
+                    # at build ("atuo" must not silently mean serial)
+                    raise ValueError(
+                        f"[spec] workers must be an integer or 'auto', "
+                        f"got {spec['workers']!r}"
+                    ) from None
+        if "transport" in spec:
+            cfg.spec_transport = spec["transport"].lower()
+            if cfg.spec_transport not in ("ring", "pipe"):
+                raise ValueError(
+                    f"[spec] transport must be ring/pipe, "
+                    f"got {cfg.spec_transport!r}"
+                )
         if "mode" in spec:
             cfg.spec_mode = spec["mode"].lower()
             if cfg.spec_mode not in ("process", "thread", "manual"):
@@ -532,6 +588,10 @@ class Config:
             cfg.tree_drain_batch = int(tree["drain_batch"])
         if "cache_mb" in tree:
             cfg.tree_cache_mb = int(tree["cache_mb"])
+        if "fused" in tree:
+            cfg.tree_fused = tree["fused"].lower() not in (
+                "0", "false", "no", "off"
+            )
 
         subs = _kv(s.get("subs", []))
         for key, attr in (

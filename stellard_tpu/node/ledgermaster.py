@@ -449,6 +449,9 @@ class LedgerMaster:
             t0 = time.perf_counter()
             try:
                 tree_fn = getattr(hasher, "hash_tree", None)
+                if tree_fn is not None \
+                        and not getattr(hasher, "fused_enabled", True):
+                    tree_fn = None  # [tree] fused=0: staged per-level
                 if tree_fn is not None:
                     if supports_hint is None:
                         import inspect
@@ -520,36 +523,79 @@ class LedgerMaster:
         """Shared seal tail of both close paths: compute the two tree
         hashes while the persist-row materialization runs.
 
-        The tree hash is the close's crypto block — its batches run in
-        the GIL-releasing native/device hashers when configured — so it
-        computes on a helper thread while THIS thread does the pure-
-        Python persist tail (meta parse, affected-account walk, row
-        build). The SHAMap is persistent: hashing only fills node._hash
-        slots, and the row walk reads item data/children, so the two
-        traversals never write the same fields. A hashing failure on the
-        helper thread is absorbed — _push_closed recomputes serially."""
+        The tree hashes are the close's crypto block — their batches run
+        in the GIL-releasing native/device hashers when configured — so
+        the tx map and the state map each hash on their OWN helper
+        thread (the two trees are disjoint, and the device hasher's
+        routing model is thread-safe, so the two fused chains overlap on
+        the mesh) while THIS thread does the pure-Python persist tail
+        (meta parse, affected-account walk, row build). The SHAMap is
+        persistent: hashing only fills node._hash slots, and the row
+        walk reads item data/children, so the traversals never write the
+        same fields. A hashing failure on a helper thread is absorbed —
+        _push_closed recomputes serially.
+
+        Emits the transfer-honesty spans: ``close.device.fused`` (the
+        overlapped hash window + whether the fused whole-tree pipeline
+        was eligible) and ``close.device.transfer`` (per-close deltas of
+        the hash plane's TransferMeter — the device-residency proof)."""
         if self.persist_prep is None:
             return
+        t0 = time.perf_counter()
+        tj = getattr(self.hash_batch, "transfer_json", None)
+        before = tj() if tj is not None else None
+
         done = threading.Event()
+        pending = [2]
+        plock = threading.Lock()
 
-        def _hash_trees():
-            try:
-                new_lcl.tx_map.get_hash()
-                new_lcl.state_map.get_hash()
-            except Exception:  # noqa: BLE001 — recomputed serially on push
-                pass
-            finally:
-                done.set()
+        def _arm(get_hash):
+            def run():
+                try:
+                    get_hash()
+                except Exception:  # noqa: BLE001 — recomputed on push
+                    pass
+                finally:
+                    with plock:
+                        pending[0] -= 1
+                        if pending[0] == 0:
+                            done.set()
+            return run
 
-        t = threading.Thread(target=_hash_trees, name="seal-hash")
-        t.start()
+        threads = [
+            threading.Thread(target=_arm(new_lcl.tx_map.get_hash),
+                             name="seal-hash-tx"),
+            threading.Thread(target=_arm(new_lcl.state_map.get_hash),
+                             name="seal-hash-state"),
+        ]
+        for t in threads:
+            t.start()
         try:
             new_lcl.persist_rows = self.persist_prep(new_lcl, results)
         except Exception:  # noqa: BLE001 — the persist stage rebuilds rows
             pass
         finally:
             done.wait()
-            t.join()
+            for t in threads:
+                t.join()
+        t1 = time.perf_counter()
+        self.tracer.complete(
+            "close.device.fused", "seal", t0, t1,
+            fused=bool(getattr(self.hash_batch, "fused_enabled", True)),
+            seq=new_lcl.seq,
+        )
+        if before is not None:
+            after = tj()
+            if after is not None:
+                self.tracer.complete(
+                    "close.device.transfer", "seal", t0, t1,
+                    seq=new_lcl.seq,
+                    uploads=after["uploads"] - before["uploads"],
+                    readbacks=after["readbacks"] - before["readbacks"],
+                    transfers=after["transfers"] - before["transfers"],
+                    bytes_moved=(after["bytes_moved"]
+                                 - before["bytes_moved"]),
+                )
 
     def close_and_advance(
         self,

@@ -305,6 +305,10 @@ class Node:
             routing=cfg.hash_routing or None,
             first_timeout=cfg.hash_device_first_timeout_s,
         )
+        # [tree] fused=0 kill-switch: compute_hashes / the seal drainer
+        # fall back to the staged per-level hash_packed path (one
+        # round-trip per level) — the fused-vs-staged identity leg
+        self.hasher.fused_enabled = cfg.tree_fused
         self.verify_plane = VerifyPlane(
             backend=cfg.signature_backend,
             window_ms=cfg.verify_batch_window_ms,
@@ -671,13 +675,22 @@ class Node:
         # [spec]: parallel speculative executor — workers>1 dispatches
         # open-window speculation to a Block-STM worker pool with
         # optimistic validation and ordered commit (engine/specexec.py);
-        # workers=1 keeps the serial inline path byte-for-byte
+        # workers=1 keeps the serial inline path byte-for-byte.
+        # workers=auto resolves HERE (loudly disabling the pool below
+        # 4 cores); transport picks the shared-memory ring wire or the
+        # legacy pickled pipe
+        import logging
+
         from ..engine.specexec import SpecExecutor
+        from .config import resolve_spec_workers
 
         self.spec_executor = SpecExecutor(
-            workers=cfg.spec_workers, mode=cfg.spec_mode,
+            workers=resolve_spec_workers(
+                cfg.spec_workers, log=logging.getLogger("stellard.spec")),
+            mode=cfg.spec_mode,
             max_retries=cfg.spec_max_retries, tracer=self.tracer,
             drain_timeout_s=cfg.spec_drain_timeout_s,
+            transport=cfg.spec_transport,
         )
         if self.spec_executor.active:
             # fork the process workers NOW, before the window machinery

@@ -567,6 +567,22 @@ class VerifyPlane:
             self._cv.notify_all()
         self._flusher.join(timeout=10)
 
+    def _transfer_json(self):
+        """Aggregate the device arms' TransferMeters (N-chip inner plus
+        the 1-chip arm when built). None for host-only backends."""
+        agg = None
+        for v in (self.verifier, self._one_chip):
+            meter = getattr(v, "transfers", None) if v is not None else None
+            if meter is None:
+                continue
+            j = meter.get_json()
+            if agg is None:
+                agg = dict(j)
+            else:
+                for k, val in j.items():
+                    agg[k] = agg.get(k, 0) + val
+        return agg
+
     def get_json(self) -> dict:
         model = self.model.get_json()
         describe = getattr(self.verifier, "describe", None)
@@ -577,6 +593,9 @@ class VerifyPlane:
             # devices visible and the kernel actually selected — a
             # BENCH/ops reader must see what ran (ISSUE 15)
             "mesh": describe() if describe is not None else None,
+            # transfer honesty: host<->device traffic across both device
+            # arms — per-close deltas of this block pin residency
+            "transfers": self._transfer_json(),
             "arms": {
                 a: {
                     "batches": self._arm_batches.get(a, 0),

@@ -33,6 +33,7 @@ __all__ = [
     "sha512_blocks_masked",
     "leaf_level_kernel",
     "inner_level_kernel",
+    "tree_leaf_body",
     "INNER_BLOCKS",
     "INNER_WORDS",
 ]
@@ -59,12 +60,16 @@ def sha512_blocks_masked(blocks: jax.Array, nblocks: jax.Array) -> jax.Array:
     return lax.fori_loop(0, nb, body, state)
 
 
-@jax.jit
-def leaf_level_kernel(buf, blocks, nblocks, offset):
+def tree_leaf_body(buf, blocks, nblocks, offset):
     """Hash a (padded) batch of leaves and bank the 32-byte digests into
-    the global digest buffer at `offset`."""
+    the global digest buffer at `offset`. Un-jitted body: the sharded
+    close pipeline re-jits it with mesh shardings and a DONATED buffer
+    (parallel/mesh.py sharded_tree_kernels)."""
     st = sha512_blocks_masked(blocks, nblocks)  # [M, 16]
     return lax.dynamic_update_slice(buf, st[:, :8], (offset, 0))
+
+
+leaf_level_kernel = jax.jit(tree_leaf_body)
 
 
 @jax.jit
@@ -102,12 +107,21 @@ def pad_leaf_batch(payloads: list[bytes], ladder_nb: int) -> tuple[np.ndarray, n
     return blocks, nblocks
 
 
-def build_inner_template(n_nodes: int) -> np.ndarray:
-    """[Npad+1, INNER_WORDS] u32 with the invariant parts of every
-    516-byte inner payload filled: the 0x80 terminator and the 16-byte
-    big-endian bit length (the prefix + child hashes are per-node)."""
+def build_inner_template(n_nodes: int, pow2_rows: bool = False) -> np.ndarray:
+    """u32 template with the invariant parts of every 516-byte inner
+    payload filled: the 0x80 terminator and the 16-byte big-endian bit
+    length (the prefix + child hashes are per-node).
+
+    Default layout is [Npad+1, INNER_WORDS] — row Npad is the dummy-
+    scatter scratch row of the legacy ``inner_level_kernel``. With
+    ``pow2_rows`` the layout is [Npad, INNER_WORDS] with NO scratch row
+    (the sharded pipeline pads its scatter program by repeating a real
+    entry — duplicate scatters of an identical value are well-defined —
+    so every row count stays a power of two >= 8 and divides any mesh
+    width up to 8)."""
     n_pad = _pow2(n_nodes)
-    t = np.zeros((n_pad + 1, INNER_WORDS), np.uint32)
+    rows = n_pad if pow2_rows else n_pad + 1
+    t = np.zeros((rows, INNER_WORDS), np.uint32)
     # byte 516 = 0x80 -> word 129, top byte
     t[:, 129] = 0x80000000
     # length trailer: last 16 bytes of block 5 = words 158..159 hold

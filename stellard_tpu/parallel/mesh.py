@@ -105,6 +105,51 @@ def sharded_masked_sha512(mesh: Mesh):
     )
 
 
+def sharded_tree_kernels(mesh: Mesh):
+    """-> (leaf_kernel, inner_kernel): the fused close's level-chained
+    tree-hash programs, sharded over the mesh with the digest buffer
+    DONATED so the whole chain stays device-resident at any width.
+
+    The digest buffer rides every level replicated and is re-donated
+    call to call (``donate_argnums=0`` — the pjit idiom from the
+    SNIPPETS exemplars): XLA reuses the same device allocation across
+    the chain instead of materializing a fresh buffer per level, and
+    the host reads it back ONCE after the last level. Leaf batches and
+    the assembled inner payloads shard row-wise (every row count is a
+    power of two >= 8, so any width up to 8 divides them); the inner
+    scatter assembles replicated, then ``with_sharding_constraint``
+    splits the 5-block compression — the expensive part — across the
+    mesh. Width 1 is a one-device mesh of the SAME programs, not a
+    separate code path."""
+    from ..ops.treehash_jax import INNER_BLOCKS, tree_leaf_body
+
+    shard = _batch_sharding(mesh)
+    rep = NamedSharding(mesh, P())
+
+    leaf = jax.jit(
+        tree_leaf_body,
+        in_shardings=(rep, shard, shard, None),
+        out_shardings=rep,
+        donate_argnums=0,
+    )
+
+    def inner_body(buf, template, rows, col_base, src_rows, offset):
+        vals = buf[src_rows]  # [K, 8]
+        cols = col_base[:, None] + jnp.arange(8, dtype=col_base.dtype)[None, :]
+        t = template.at[rows[:, None], cols].set(vals)
+        t = jax.lax.with_sharding_constraint(t, shard)
+        st = sha512_blocks(t.reshape(t.shape[0], INNER_BLOCKS, 32))
+        return jax.lax.dynamic_update_slice(buf, st[:, :8], (offset, 0))
+
+    inner = jax.jit(
+        inner_body,
+        in_shardings=(rep, rep, rep, rep, rep, None),
+        out_shardings=rep,
+        donate_argnums=0,
+    )
+    return leaf, inner
+
+
 def verify_and_count(mesh: Mesh):
     """shard_map pipeline: verify local shard, psum the per-chip valid
     counts over ICI -> (flags [B], total_valid scalar replicated).

@@ -523,6 +523,7 @@ def _crypto_json(node) -> dict:
             "model": vp.get("model"),
             "device_sigs": vp.get("device_sigs"),
             "cpu_sigs": vp.get("cpu_sigs"),
+            "transfers": vp.get("transfers"),
         },
     }
     hasher = getattr(node, "hasher", None)
@@ -535,6 +536,17 @@ def _crypto_json(node) -> dict:
             "device_nodes": getattr(hasher, "device_nodes", 0),
             "host_nodes": getattr(hasher, "host_nodes", 0),
         }
+    # transfer honesty (ISSUE 16): total host<->device traffic across
+    # both planes — per-close deltas of transfers/bytes_moved are the
+    # device-residency proof a BENCH reader gates on
+    total_t = 0
+    total_b = 0
+    for block in (vp.get("transfers"), out["hash"].get("transfers")):
+        if isinstance(block, dict):
+            total_t += int(block.get("transfers", 0))
+            total_b += int(block.get("bytes_moved", 0))
+    out["transfers"] = total_t
+    out["bytes_moved"] = total_b
     jx = _sys.modules.get("jax")
     if jx is not None:
         try:

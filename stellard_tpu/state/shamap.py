@@ -632,9 +632,12 @@ def compute_hashes(root, hash_batch: Callable = _default_hasher) -> int:
     `hash_batch` is the device SHA-512 kernel and each level is one
     device program over all dirty nodes of that level.
     """
-    if hasattr(hash_batch, "hash_tree"):
+    if hasattr(hash_batch, "hash_tree") \
+            and getattr(hash_batch, "fused_enabled", True):
         # whole-tree device pipeline (TpuHasher.hash_tree): digests stay
-        # device-resident across levels, one host transfer at the end
+        # device-resident across levels, one host transfer at the end.
+        # [tree] fused=0 clears fused_enabled — the staged per-level
+        # path below, kept as the fused-vs-staged identity leg
         return hash_batch.hash_tree(root)
     levels = _collect_unhashed(root)
     packed = getattr(hash_batch, "hash_packed", None)

@@ -1,0 +1,371 @@
+"""Shared-memory ring transport for the Block-STM pool (ISSUE 16).
+
+The rings replace pickled pipes on the spec-pool hot path, so the seams
+they add — the tagged binary codec, torn-slot detection, wraparound,
+doorbell EOF, worker death mid-ring-write — must all degrade exactly the
+way the pipe transport did: a corrupt or dead peer looks like a worker
+death to the committer, the window completes through survivors or the
+forced-serial drain, and the close NEVER wedges. Byte identity between
+the ring and pipe transports (and serial) is pinned on the same
+workloads the pipe transport was pinned on.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+import pytest
+
+from stellard_tpu.engine.specring import (
+    TornSlotError,
+    decode_msg,
+    encode_msg,
+    ring_pipe,
+)
+from stellard_tpu.engine.specexec import SpecExecutor
+from stellard_tpu.node.config import Config, resolve_spec_workers
+from stellard_tpu.node.ledgermaster import LedgerMaster
+
+from test_parallel_spec import (
+    MASTER,
+    OPEN,
+    dependent_chain,
+    fresh,
+    hot_account_burst,
+    run_workload,
+)
+
+
+class TestCodec:
+    """The pickle-free wire codec: everything the spec protocol sends
+    must roundtrip exactly; anything else must refuse loudly."""
+
+    @pytest.mark.parametrize("obj", [
+        None, True, False, 0, 1, -1, 2**31, -(2**63), 2**200,
+        0.0, 1.5, -3.25,
+        b"", b"x" * 1000, "", "text", "é中",
+        (), (1, 2), [1, [2, [3]]], {1: 2}, {b"k": (b"v", None)},
+        set(), {1, 2, 3}, frozenset({b"a"}),
+    ])
+    def test_roundtrip(self, obj):
+        got = decode_msg(encode_msg(obj))
+        assert got == obj
+        assert type(got) is type(obj)
+
+    def test_roundtrip_wire_shapes(self):
+        """The actual spec-protocol message vocabulary."""
+        msgs = [
+            ("win", 3, 17),
+            ("exec", [(0, b"\x01" * 32, b"blob"), (1, b"\x02" * 32, b"")]),
+            ("end",),
+            ("stop",),
+            ("rr", 5, b"k" * 32),
+            ("sr", {"a": 1, "b": 2}),
+            ("r", 7, 2, True, 100,
+             [(b"succ", b"\x03" * 32), (b"gone", None)],
+             {b"rk": b"PARENT", b"rk2": (b"\x04" * 32, 9)}),
+            ("s", 1, 2, 3),
+            ("resb", 0, b"payload"),
+        ]
+        for m in msgs:
+            assert decode_msg(encode_msg(m)) == m
+
+    def test_memoryview_and_bytearray_coerce_to_bytes(self):
+        assert decode_msg(encode_msg(memoryview(b"abc"))) == b"abc"
+        assert decode_msg(encode_msg(bytearray(b"abc"))) == b"abc"
+
+    def test_unknown_tag_is_torn(self):
+        with pytest.raises(TornSlotError):
+            decode_msg(b"Qjunk")
+
+    def test_trailing_garbage_is_torn(self):
+        with pytest.raises(TornSlotError):
+            decode_msg(encode_msg(1) + b"\x00")
+
+    def test_truncation_is_torn(self):
+        buf = encode_msg((b"payload", 123456789, "text"))
+        for cut in range(1, len(buf)):
+            with pytest.raises(TornSlotError):
+                decode_msg(buf[:cut])
+
+    def test_unencodable_type_raises(self):
+        with pytest.raises(TypeError):
+            encode_msg(object())
+
+
+class TestRing:
+    def test_send_recv_order(self):
+        r, w = ring_pipe(capacity=1 << 16)
+        try:
+            msgs = [("exec", [(i, b"\x05" * 32, b"x" * i)]) for i in range(64)]
+            for m in msgs:
+                w.send(m)
+            assert [r.recv() for _ in msgs] == msgs
+            assert r.counters["msgs"] == 64
+            assert w.counters["msgs"] == 64
+        finally:
+            r.close()
+            w.destroy()
+
+    def test_poll(self):
+        r, w = ring_pipe(capacity=1 << 16)
+        try:
+            assert not r.poll(0)
+            w.send(("s", 1))
+            assert r.poll(1.0)
+            assert r.recv() == ("s", 1)
+            assert not r.poll(0)
+        finally:
+            r.close()
+            w.destroy()
+
+    def test_wraparound_hammer(self):
+        """A ring much smaller than the traffic forces wrap-split
+        records and producer full-waits; every message still arrives
+        intact and in order."""
+        r, w = ring_pipe(capacity=1 << 12)  # 4 KiB
+        got = []
+
+        def consume():
+            while True:
+                m = r.recv()
+                if m == ("stop",):
+                    return
+                got.append(m)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        try:
+            sent = []
+            for i in range(500):
+                m = ("resb", i, bytes([i & 0xFF]) * (i % 700))
+                w.send(m)
+                sent.append(m)
+            w.send(("stop",))
+            t.join(timeout=30)
+            assert not t.is_alive()
+            assert got == sent
+            assert w.counters["full_waits"] > 0  # wrap actually exercised
+        finally:
+            r.close()
+            w.destroy()
+
+    def test_seeded_thread_hammer(self):
+        """Seeded two-thread soak over one ring: random payload sizes
+        spanning empty to multi-slot, exact order + content."""
+        import random
+
+        rng = random.Random(1234)
+        r, w = ring_pipe(capacity=1 << 13)
+        sent = [
+            ("r", i, rng.randrange(4), rng.random() < 0.5,
+             rng.randrange(10**9),
+             [(rng.randbytes(32), rng.randbytes(32) if rng.random() < 0.7
+               else None)],
+             {rng.randbytes(32): b"PARENT"})
+            for i in range(300)
+        ]
+        got = []
+        t = threading.Thread(
+            target=lambda: [got.append(r.recv()) for _ in sent]
+        )
+        t.start()
+        try:
+            for m in sent:
+                w.send(m)
+            t.join(timeout=30)
+            assert not t.is_alive()
+            assert got == sent
+            assert r.counters["torn_slots"] == 0
+        finally:
+            r.close()
+            w.destroy()
+
+    def test_torn_slot_detected(self):
+        """Corrupting a published record's payload in shared memory must
+        surface as TornSlotError (an OSError — the committer's existing
+        worker-death path), never as a silently-decoded wrong message."""
+        r, w = ring_pipe(capacity=1 << 16)
+        try:
+            w.send(("exec", [(1, b"\x07" * 32, b"payload")]))
+            # flip payload bytes behind the crc's back
+            from stellard_tpu.engine.specring import _DATA_OFF
+
+            buf = w._ring.buf
+            buf[_DATA_OFF + 20] ^= 0xFF
+            with pytest.raises(TornSlotError):
+                r.recv()
+            assert r.counters["torn_slots"] == 1
+            assert isinstance(TornSlotError("x"), OSError)
+        finally:
+            r.close()
+            w.destroy()
+
+    def test_peer_close_is_eof(self):
+        """A dead producer must look exactly like a closed pipe:
+        EOFError from recv (the committer's worker-death signal)."""
+        r, w = ring_pipe(capacity=1 << 16)
+        w.send(("s", 1))
+        # both ends live in THIS process, so drop the cross-copies by
+        # hand (in the executor, settle() does this after fork) — the
+        # reader must not keep the write fd alive itself
+        r._peer_fd = -1
+        w._peer_fd = -1
+        w.close()
+        try:
+            assert r.recv() == ("s", 1)  # drained before EOF
+            with pytest.raises(EOFError):
+                r.recv()
+        finally:
+            r.destroy()
+
+
+class TestRingTransportEndToEnd:
+    def test_ring_vs_pipe_vs_serial_byte_identity(self):
+        """The three transports must agree byte-for-byte on the
+        conflict-heavy workload: serial inline, pickled pipes, rings."""
+        phases = hot_account_burst()
+        h0, r0, _s, _ = run_workload(phases, workers=1)
+        for transport in ("ring", "pipe"):
+            lm = LedgerMaster()
+            ex = lm.spec_executor = SpecExecutor(
+                workers=2, mode="process", transport=transport
+            )
+            lm.start_new_ledger(MASTER.account_id, close_time=1000)
+            try:
+                hashes, results_log = [], []
+                for i, phase in enumerate(phases):
+                    for tx in phase:
+                        lm.do_transaction(fresh(tx), OPEN)
+                    closed, results = lm.close_and_advance(2000 + i * 30, 30)
+                    hashes.append(closed.hash())
+                    results_log.append(sorted(
+                        (txid.hex(), int(t)) for txid, t in results.items()
+                    ))
+                assert hashes == h0 and results_log == r0, transport
+                j = ex.get_json()
+                assert j["transport"] == transport
+                assert j["worker_deaths"] == 0
+                if transport == "ring":
+                    # anti-vacuity: the traffic actually rode the rings
+                    assert j["ring"]["msgs_sent"] > 0
+                    assert j["ring"]["msgs_recv"] > 0
+                    assert j["ring"]["torn_slots"] == 0
+            finally:
+                ex.stop()
+
+    def test_sigkill_mid_window_recovers(self):
+        """SIGKILL one worker mid-window (it may die holding a half-
+        written ring slot); the committer must finish the window through
+        the survivor or the drain — close byte-identical, never wedged."""
+        phases = dependent_chain()
+        h0, r0, _s, _ = run_workload(phases, workers=1)
+        lm = LedgerMaster()
+        ex = lm.spec_executor = SpecExecutor(
+            workers=2, mode="process", transport="ring",
+            drain_timeout_s=2.0,
+        )
+        lm.start_new_ledger(MASTER.account_id, close_time=1000)
+        try:
+            hashes, results_log = [], []
+            killed = False
+            for i, phase in enumerate(phases):
+                for n, tx in enumerate(phase):
+                    lm.do_transaction(fresh(tx), OPEN)
+                    if not killed and i == 1 and n == len(phase) // 2:
+                        killed = True
+                        os.kill(ex._procs[0].proc.pid, signal.SIGKILL)
+                        ex._procs[0].proc.join(timeout=5)
+                closed, results = lm.close_and_advance(2000 + i * 30, 30)
+                hashes.append(closed.hash())
+                results_log.append(sorted(
+                    (txid.hex(), int(t)) for txid, t in results.items()
+                ))
+            assert hashes == h0 and results_log == r0
+            assert ex.get_json()["worker_deaths"] >= 1
+        finally:
+            ex.stop()
+
+    def test_all_workers_sigkilled_drains_serial(self):
+        """A fully dead ring pool must not wedge a close: the drain
+        completes the window serially, byte-identical."""
+        phases = dependent_chain()
+        h0, r0, _s, _ = run_workload(phases, workers=1)
+        lm = LedgerMaster()
+        ex = lm.spec_executor = SpecExecutor(
+            workers=2, mode="process", transport="ring",
+            drain_timeout_s=2.0,
+        )
+        lm.start_new_ledger(MASTER.account_id, close_time=1000)
+        try:
+            hashes, results_log = [], []
+            killed = False
+            for i, phase in enumerate(phases):
+                for n, tx in enumerate(phase):
+                    lm.do_transaction(fresh(tx), OPEN)
+                    if not killed and n == len(phase) // 2:
+                        killed = True
+                        for w in ex._procs:
+                            os.kill(w.proc.pid, signal.SIGKILL)
+                            w.proc.join(timeout=5)
+                closed, results = lm.close_and_advance(2000 + i * 30, 30)
+                hashes.append(closed.hash())
+                results_log.append(sorted(
+                    (txid.hex(), int(t)) for txid, t in results.items()
+                ))
+            assert hashes == h0 and results_log == r0
+        finally:
+            ex.stop()
+
+
+class TestWorkersAuto:
+    """[spec] workers=auto (ISSUE 16): sized from the box, disabled
+    loudly below 4 cores, typos rejected at build per the dead-config
+    convention."""
+
+    def test_auto_small_box_disables_pool(self, caplog):
+        import logging
+
+        with caplog.at_level(logging.WARNING, logger="stellard.spec"):
+            got = resolve_spec_workers(
+                "auto", cpu_count=2,
+                log=logging.getLogger("stellard.spec"),
+            )
+        assert got == 1
+        assert any("DISABLED" in r.message for r in caplog.records)
+
+    @pytest.mark.parametrize("cores,want", [
+        (4, 4), (6, 6), (8, 8), (16, 8), (64, 8),
+    ])
+    def test_auto_sizes_from_cpu_count(self, cores, want):
+        assert resolve_spec_workers("auto", cpu_count=cores) == want
+
+    def test_explicit_int_passes_through(self):
+        assert resolve_spec_workers(3, cpu_count=1) == 3
+        assert resolve_spec_workers("2", cpu_count=1) == 2
+
+    def test_ini_accepts_auto_and_int(self):
+        assert Config.from_ini(
+            "[spec]\nworkers=auto\n"
+        ).spec_workers == "auto"
+        assert Config.from_ini("[spec]\nworkers=6\n").spec_workers == 6
+
+    def test_ini_accepts_transports(self):
+        assert Config.from_ini(
+            "[spec]\ntransport=pipe\n"
+        ).spec_transport == "pipe"
+        assert Config.from_ini("[spec]\n").spec_transport == "ring"
+
+    def test_ini_rejects_typo(self):
+        with pytest.raises(ValueError, match="workers"):
+            Config.from_ini("[spec]\nworkers=lots\n")
+
+    def test_ini_rejects_bad_transport(self):
+        with pytest.raises(ValueError, match="transport"):
+            Config.from_ini("[spec]\ntransport=tcp\n")
+
+    def test_executor_rejects_bad_transport(self):
+        with pytest.raises(ValueError, match="transport"):
+            SpecExecutor(workers=2, transport="tcp")

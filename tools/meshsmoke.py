@@ -13,6 +13,9 @@ a cpu-backend node. Gates:
 - device_sigs > 0 and an effective mesh width of 8 on the mesh run
   (anti-vacuity: routing honesty means the gate fails when the "mesh"
   run silently verified on the host);
+- the fused whole-tree hash pipeline ran ([hash_backend] type=tpu
+  routing=device) and read back from the device exactly ONCE per tree
+  (transfer honesty: a per-level round-trip is a residency regression);
 - zero rejected transactions in either run.
 
 Exit 0 on all gates; 1 otherwise.
@@ -44,7 +47,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def drive(cfg, n_txs: int = 200):
     """Deterministic flood: same keys/seqs/amounts per run; returns
-    ([(seq, ledger_hash)...], verify_plane json, rejected count)."""
+    ([(seq, ledger_hash)...], {verify, hash} plane jsons, rejected
+    count)."""
     import threading
 
     from stellard_tpu.node.node import Node
@@ -88,7 +92,12 @@ def drive(cfg, n_txs: int = 200):
                 done.acquire()
             closed, _results = node.ops.accept_ledger()
             closes.append((closed.seq, closed.hash()))
-        return closes, node.verify_plane.get_json(), len(rejected)
+        hj = getattr(node.hasher, "get_json", None)
+        planes = {
+            "verify": node.verify_plane.get_json(),
+            "hash": hj() if hj is not None else None,
+        }
+        return closes, planes, len(rejected)
     finally:
         node.stop()
 
@@ -99,15 +108,23 @@ def run_smoke() -> int:
 
     enable_compilation_cache()
 
-    mesh_closes, vp, mesh_rejected = drive(Config(
+    mesh_closes, planes, mesh_rejected = drive(Config(
         signature_backend="tpu",
         verify_mesh="auto",
         verify_routing="device",
         verify_min_device_batch=1,
         verify_max_batch=256,
+        # hash plane on the same virtual mesh, device-forced: the
+        # fused whole-tree pipeline must carry the close's tree work
+        # so the transfer gate below is non-vacuous
+        hash_backend="tpu",
+        hash_mesh="auto",
+        hash_routing="device",
+        hash_min_device_nodes=0,
         kernel_tuning="none",
     ))
-    cpu_closes, _vp_cpu, cpu_rejected = drive(Config(
+    vp = planes["verify"]
+    cpu_closes, _planes_cpu, cpu_rejected = drive(Config(
         signature_backend="cpu",
         kernel_tuning="none",
     ))
@@ -138,13 +155,33 @@ def run_smoke() -> int:
         print(f"mesh smoke: effective width {mesh_info.get('mesh_width')}"
               f" != 8 (kernel={mesh_info.get('kernel')})", file=sys.stderr)
         bad += 1
+    # fused-close transfer honesty (ISSUE 16): the whole-tree pipeline
+    # ran, and it read back from the device exactly ONCE per tree — a
+    # readback count above tree_pipeline_calls means some level quietly
+    # round-tripped to the host mid-chain (residency regression)
+    hp = planes.get("hash") or {}
+    hmesh = hp.get("mesh") or {}
+    tree_calls = hmesh.get("tree_pipeline_calls") or 0
+    tree_tr = hmesh.get("tree_transfers") or {}
+    if not tree_calls:
+        print(f"mesh smoke: tree_pipeline_calls=0 — the fused hash "
+              f"pipeline never ran (wedged={hp.get('wedged')}, "
+              f"tree_kernel={hmesh.get('tree_kernel')})", file=sys.stderr)
+        bad += 1
+    elif tree_tr.get("readbacks") != tree_calls:
+        print(f"mesh smoke: {tree_tr.get('readbacks')} device readbacks "
+              f"over {tree_calls} fused trees — expected exactly one "
+              f"per tree", file=sys.stderr)
+        bad += 1
     if bad:
         return 1
     print(
         f"mesh smoke OK: {len(mesh_closes)} closes byte-identical "
         f"mesh-vs-cpu, device_sigs={vp['device_sigs']} over "
         f"width={mesh_info.get('mesh_width')} "
-        f"({mesh_info.get('kernel')}, routing={vp.get('routing')})"
+        f"({mesh_info.get('kernel')}, routing={vp.get('routing')}); "
+        f"fused trees={tree_calls} readbacks={tree_tr.get('readbacks')} "
+        f"({hmesh.get('tree_kernel')})"
     )
     return 0
 

@@ -29,7 +29,8 @@ import os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def run_flood(workers: int, n_txs: int, chunk: int = 50):
+def run_flood(workers: int, n_txs: int, chunk: int = 50,
+              transport: str = "ring"):
     """One standalone-node flood; -> per-close evidence + counters."""
     import hashlib
     import threading
@@ -42,7 +43,8 @@ def run_flood(workers: int, n_txs: int, chunk: int = 50):
     from stellard_tpu.protocol.stamount import STAmount
     from stellard_tpu.protocol.sttx import SerializedTransaction
 
-    node = Node(Config(spec_workers=workers, spec_mode="process")).setup()
+    node = Node(Config(spec_workers=workers, spec_mode="process",
+                       spec_transport=transport)).setup()
     closes = []
     try:
         # deterministic close-time schedule: the two runs happen
@@ -164,6 +166,24 @@ def run_smoke(n_txs: int = 200) -> int:
             file=sys.stderr,
         )
         return 1
+    # ring anti-vacuity (ISSUE 16): the parallel run rode the shared-
+    # memory transport, its counters moved, and no slot tore — a smoke
+    # that quietly fell back to pipes (or never touched the rings)
+    # would prove nothing about the zero-pickle dispatch path
+    ring = par_spec.get("ring") or {}
+    if par_spec.get("transport") != "ring" or not ring.get("msgs_sent"):
+        print(
+            f"spec smoke: shared-memory transport not exercised — "
+            f"transport={par_spec.get('transport')!r} "
+            f"ring_msgs={ring.get('msgs_sent', 0)}", file=sys.stderr,
+        )
+        return 1
+    if ring.get("torn_slots"):
+        print(
+            f"spec smoke: {ring['torn_slots']} torn ring slots on a "
+            f"healthy pool", file=sys.stderr,
+        )
+        return 1
     spliced = sum(c["spliced"] for c in par_closes)
     total = sum(c["n"] for c in par_closes)
     print(
@@ -172,7 +192,9 @@ def run_smoke(n_txs: int = 200) -> int:
         f"committed={par_spec['committed']} retries={par_spec['retries']} "
         f"aborts={par_spec['validation_aborts']} "
         f"serial_fallbacks={par_spec['serial_fallbacks']} "
-        f"forced_drains={par_spec['drains_forced']})"
+        f"forced_drains={par_spec['drains_forced']} "
+        f"ring_msgs={ring['msgs_sent']}+{ring.get('msgs_recv', 0)} "
+        f"ring_kb={(ring.get('bytes_sent', 0) + ring.get('bytes_recv', 0)) // 1024})"
     )
     return 0
 
