@@ -1474,3 +1474,155 @@ class WatchdogHasher(BatchHasher):
                         cancel.set()
                 self._wedge(exc)
         return self._host_tree(root)
+
+
+# --------------------------------------------------------------------------
+# path-quality plane: measured-cost routed Q16.16 candidate evaluation
+
+# candidate batches below this never route to a device: a path_find with
+# a handful of candidates can never amortize a dispatch (the sig/hash
+# planes' DEVICE_*_FLOOR stance). Env-overridable on the evaluator.
+PATHQ_DEVICE_FLOOR = 256
+
+
+class PathQualityEvaluator:
+    """Routed evaluation of flattened candidate-path rate matrices (the
+    liquidity plane's device arm — ISSUE 17 tentpole leg 3).
+
+    Same construction as the sig/hash planes: a NumPy host arm
+    (ops.pathq_jax.path_quality_host), a 1-chip arm and an optional
+    N-chip arm of the SAME sharded jit program
+    (parallel.mesh.sharded_path_quality), routed per batch by the
+    shared measured-cost model (_HashCostModel: per-pow2-bucket EWMAs,
+    compile-sample discard, bounded re-exploration, small-batch host
+    floor). Host and device arms are byte-identical at every mesh
+    width — pinned by tests/test_path_plane.py and the bench leg.
+
+    ``routing``: "cost" (default) measures; "device" forces the widest
+    device arm (identity pinning / bench anti-vacuity); "host" forces
+    the host arm.
+    """
+
+    def __init__(self, mesh=None, min_device_batch: Optional[int] = None,
+                 routing: Optional[str] = None):
+        self.mesh = parse_mesh(mesh)
+        if min_device_batch is None:
+            min_device_batch = int(os.environ.get(
+                "STELLARD_PATHQ_MIN_DEVICE_BATCH", str(PATHQ_DEVICE_FLOOR)
+            ))
+        routing = (routing or os.environ.get(
+            "STELLARD_PATHQ_ROUTING", "cost")).strip().lower()
+        if routing not in ("cost", "device", "host"):
+            raise ValueError(
+                f"path evaluator routing must be cost|device|host, "
+                f"got {routing!r}"
+            )
+        self.routing = routing
+        arms = ("dev1", "devN") if mesh_wants_width(self.mesh) else ("dev1",)
+        self._model = _HashCostModel(
+            reexplore_every=64, min_device_nodes=min_device_batch, arms=arms,
+        )
+        self._lock = threading.Lock()
+        self._kernels: dict[str, tuple] = {}  # arm -> (jit fn, width)
+        self.host_batches = 0
+        self.device_batches = 0
+        self.rows_evaluated = 0
+
+    # -- arms -------------------------------------------------------------
+
+    def _kernel(self, arm: str):
+        with self._lock:
+            hit = self._kernels.get(arm)
+            if hit is not None:
+                return hit
+        jax = ensure_jax()
+        from ..parallel.mesh import make_mesh, sharded_path_quality
+
+        devices = jax.devices()
+        want = "0" if arm == "dev1" else self.mesh
+        width = resolve_mesh_width(want, len(devices), pow2=True)
+        fn = sharded_path_quality(make_mesh(devices[:width]))
+        with self._lock:
+            self._kernels.setdefault(arm, (fn, width))
+            return self._kernels[arm]
+
+    def evaluate_host(self, rates: np.ndarray) -> np.ndarray:
+        from ..ops.pathq_jax import path_quality_host
+
+        return path_quality_host(rates)
+
+    def _evaluate_device(self, arm: str, rates: np.ndarray) -> np.ndarray:
+        from ..ops.pathq_jax import Q16_ONE
+
+        fn, width = self._kernel(arm)
+        n = rates.shape[0]
+        # pow2 padding (identity rows): one compile per bucket, and any
+        # pow2 width divides the padded batch for the sharded program
+        padded = max(width, 1 << max(0, n - 1).bit_length())
+        if padded != n:
+            pad = np.full((padded - n, rates.shape[1]), Q16_ONE,
+                          dtype=np.uint32)
+            rates = np.concatenate([rates, pad], axis=0)
+        out = np.asarray(fn(rates))
+        return out[:n]
+
+    # -- routed entry point ----------------------------------------------
+
+    def evaluate(self, rates: np.ndarray) -> np.ndarray:
+        """[B, H] uint32 -> [B] uint32 composites, routed host/1-chip/
+        N-chip by measured cost (or forced by ``routing``)."""
+        import time as _t
+
+        rates = np.ascontiguousarray(rates, dtype=np.uint32)
+        n = int(rates.shape[0])
+        if n == 0:
+            return np.zeros((0,), dtype=np.uint32)
+        if self.routing == "host":
+            arm = "host"
+        elif self.routing == "device":
+            arm = self._model.arms[-1]
+        else:
+            arm = self._model.choose(n)
+        t0 = _t.perf_counter()
+        if arm == "host":
+            out = self.evaluate_host(rates)
+        else:
+            out = self._evaluate_device(arm, rates)
+        self._model.observe(arm, n, (_t.perf_counter() - t0) * 1000.0)
+        with self._lock:
+            self.rows_evaluated += n
+            if arm == "host":
+                self.host_batches += 1
+            else:
+                self.device_batches += 1
+        return out
+
+    def device_width(self) -> int:
+        """Effective width of the widest device arm (builds it)."""
+        return self._kernel(self._model.arms[-1])[1]
+
+    def get_json(self) -> dict:
+        with self._lock:
+            widths = {a: w for a, (_f, w) in self._kernels.items()}
+            counters = {
+                "host_batches": self.host_batches,
+                "device_batches": self.device_batches,
+                "rows_evaluated": self.rows_evaluated,
+            }
+        return {
+            "mesh": self.mesh,
+            "routing": self.routing,
+            "min_device_batch": self._model.min_device_nodes,
+            "arm_widths": widths,
+            **counters,
+            "model": self._model.get_json(),
+        }
+
+
+def make_path_evaluator(mesh=None, min_device_batch: Optional[int] = None,
+                        routing: Optional[str] = None) -> PathQualityEvaluator:
+    """The ONE wiring for the path-quality evaluator (node, bench and
+    smokes all construct the identical arrangement)."""
+    return PathQualityEvaluator(
+        mesh=mesh, min_device_batch=min_device_batch, routing=routing,
+    )

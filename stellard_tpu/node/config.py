@@ -346,6 +346,29 @@ class Config:
     # bounded attempts with exponential backoff + jitter per event
     subs_push_retries: int = 5
 
+    # -- liquidity plane ([paths]) -----------------------------------------
+    # The production path_find read plane (paths/plane.py, ISSUE 17):
+    # enabled=0 removes the plane entirely (path RPCs fall back to the
+    # on-demand per-request library). incremental=0 is the kill-switch
+    # that forces a full OrderBookDB rebuild per close, pinned
+    # result-identical to the incremental write-set advance.
+    # device_prune=0 disables the device-batched candidate pre-ranking;
+    # prune_floor/prune_keep bound when/how it prunes (sets at or below
+    # the floor are never touched). max_updates_per_close caps how many
+    # path subscriptions re-rank per validated close (the rest shed,
+    # stalest-first next close). mesh/min_device_batch/routing shape the
+    # evaluator's host/1-chip/N-chip routing exactly like
+    # [hash_backend]'s (parse_mesh values; routing cost|device|host).
+    paths_enabled: bool = True
+    paths_incremental: bool = True
+    paths_device_prune: bool = True
+    paths_prune_floor: int = 64
+    paths_prune_keep: int = 32
+    paths_max_updates_per_close: int = 256
+    paths_mesh: str = "0"
+    paths_min_device_batch: int = 256
+    paths_routing: str = "cost"
+
     # -- validated-seq result cache ([rpc_cache]) --------------------------
     # whole-result memo for the hot read RPCs (account_info,
     # book_offers, ledger, account_tx), keyed by validated ledger seq —
@@ -605,6 +628,43 @@ class Config:
         rpc_cache = _kv(s.get("rpc_cache", []))
         if "size" in rpc_cache:
             cfg.rpc_cache_size = int(rpc_cache["size"])
+
+        paths = _kv(s.get("paths", []))
+        _reject_unknown("paths", paths, (
+            "enabled", "incremental", "device_prune", "prune_floor",
+            "prune_keep", "max_updates_per_close", "mesh",
+            "min_device_batch", "routing",
+        ))
+        for key, attr in (
+            ("enabled", "paths_enabled"),
+            ("incremental", "paths_incremental"),
+            ("device_prune", "paths_device_prune"),
+        ):
+            if key in paths:
+                setattr(cfg, attr, paths[key].lower() not in (
+                    "0", "false", "no", "off"
+                ))
+        for key, attr in (
+            ("prune_floor", "paths_prune_floor"),
+            ("prune_keep", "paths_prune_keep"),
+            ("max_updates_per_close", "paths_max_updates_per_close"),
+            ("min_device_batch", "paths_min_device_batch"),
+        ):
+            if key in paths:
+                setattr(cfg, attr, int(paths[key]))
+        if "mesh" in paths:
+            from ..crypto.backend import parse_mesh
+
+            cfg.paths_mesh = parse_mesh(paths["mesh"])
+        if "routing" in paths:
+            routing = paths["routing"].strip().lower()
+            if routing not in ("cost", "device", "host"):
+                # a routing toggle must not silently fail open
+                raise ValueError(
+                    f"[paths] routing must be cost|device|host, "
+                    f"got {paths['routing']!r}"
+                )
+            cfg.paths_routing = routing
 
         cfg.validation_seed = one("validation_seed", cfg.validation_seed)
         cfg.sntp_servers = [line.split()[0] for line in s.get("sntp_servers", [])]

@@ -760,6 +760,37 @@ class Node:
         # ledger — the read tier's answers are immutable and identical
         # across every follower at the same validated seq
         self.serve_validated_default = self.follower
+
+        # liquidity plane ([paths], paths/plane.py): the incremental
+        # per-close book index + device-routed candidate pre-ranking +
+        # per-subscription staleness/shedding. The close hook advances
+        # the index from each close's own write set so both the RPC
+        # door (books_if_current) and the subscription publisher serve
+        # a warm index without ever rescanning unchanged books.
+        self.path_plane = None
+        if cfg.paths_enabled:
+            from ..crypto.backend import make_path_evaluator
+            from ..paths.plane import PathPlane
+
+            evaluator = None
+            if cfg.paths_device_prune:
+                evaluator = make_path_evaluator(
+                    mesh=cfg.paths_mesh,
+                    min_device_batch=cfg.paths_min_device_batch,
+                    routing=cfg.paths_routing,
+                )
+            self.path_plane = PathPlane(
+                incremental=cfg.paths_incremental,
+                evaluator=evaluator,
+                device_prune=cfg.paths_device_prune,
+                prune_floor=cfg.paths_prune_floor,
+                prune_keep=cfg.paths_prune_keep,
+                max_updates_per_close=cfg.paths_max_updates_per_close,
+                resources=self.rpc_resources,
+            )
+            self.ops.on_ledger_closed.append(
+                lambda led, results: self.path_plane.note_close(led)
+            )
         if self.overlay is not None:
             # one master lock for consensus + RPC over the shared chain,
             # and the relay/local-retry seams (reference: the relay step
@@ -910,6 +941,8 @@ class Node:
         )
         # `server` stream: publish on load-factor movement (pubServer)
         self.fee_track.on_change.append(self.subs.pub_server_status)
+        # path subscriptions ride the liquidity plane's staleness budget
+        self.subs.path_plane = self.path_plane
         door_state_dir: list[str] = []  # one shared auto-cert dir per serve
 
         def _door_ssl(secure: int, cert: str, key: str):
@@ -1013,6 +1046,17 @@ class Node:
                     k: v
                     for k, v in self.read_cache.get_json().items()
                     if isinstance(v, (int, float))
+                },
+            )
+        if self.path_plane is not None:
+            # liquidity-plane gauges (`paths.*`): re-ranks, sheds,
+            # staleness, index continuity (doc/observability.md)
+            self.collector.hook(
+                "paths",
+                lambda: {
+                    k: v for k, v in self.path_plane.get_json().items()
+                    if isinstance(v, (int, float))
+                    and not isinstance(v, bool)
                 },
             )
         # span-derived per-stage latency percentiles (trace.<stage>.p50_ms

@@ -1,0 +1,70 @@
+"""Batched path-quality composition in Q16.16 fixed point.
+
+A candidate payment path is flattened to a fixed-width row of per-hop
+rates (hop-padded with the identity rate 1.0): book hops carry the
+book's best-tier quality, account hops the issuer's transfer rate. The
+composite rate of a path is the saturating product of its hops — lower
+is better (fewer units in per unit delivered). The fold is a pure
+uint32 pipeline so one algorithm serves two arms byte-identically:
+
+* ``path_quality_host``  — NumPy, the sequential reference arm;
+* ``path_quality_kernel``— jax.numpy, jit/shard-able over the batch dim.
+
+Q16.16 multiplies are decomposed into 16-bit limbs (the default JAX
+configuration has no uint64) with explicit carry/saturation detection,
+so host and device agree bit-for-bit at every batch size and mesh
+width — the same contract the sig/hash planes pin for their kernels.
+
+Layout: rates is [B, H] uint32; output is [B] uint32 composites.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+Q16_ONE = 1 << 16  # 1.0 in Q16.16
+Q16_MAX = (1 << 32) - 1  # saturation rail
+
+
+def _qmul(xp, a, b):
+    """Saturating Q16.16 multiply via 16-bit limbs: the true product is
+    (a*b) >> 16 over 64 bits; build it from the four 32-bit partials and
+    saturate when the high word or any partial sum overflows uint32."""
+    a_hi, a_lo = a >> 16, a & 0xFFFF
+    b_hi, b_lo = b >> 16, b & 0xFFFF
+    hh = a_hi * b_hi  # contributes << 16 after the global >> 16
+    m1 = a_hi * b_lo
+    m2 = a_lo * b_hi
+    ll = (a_lo * b_lo) >> 16
+    sat = hh > 0xFFFF
+    r = (hh & 0xFFFF) << 16
+    r1 = r + m1
+    sat = sat | (r1 < m1)
+    r2 = r1 + m2
+    sat = sat | (r2 < m2)
+    r3 = r2 + ll
+    sat = sat | (r3 < ll)
+    return xp.where(sat, xp.uint32(Q16_MAX), r3)
+
+
+def _fold(xp, rates):
+    """Composite per row: identity-seeded left fold of _qmul over the
+    hop columns. The fold order is part of the byte-identity contract —
+    both arms unroll the same static column loop."""
+    rates = rates.astype(xp.uint32)
+    n_hops = rates.shape[-1]
+    acc = xp.full(rates.shape[:-1], Q16_ONE, dtype=xp.uint32)
+    for h in range(n_hops):
+        acc = _qmul(xp, acc, rates[..., h])
+    return acc
+
+
+def path_quality_host(rates: np.ndarray) -> np.ndarray:
+    """NumPy reference arm: [B, H] uint32 -> [B] uint32."""
+    return _fold(np, np.asarray(rates, dtype=np.uint32))
+
+
+def path_quality_kernel(rates):
+    """JAX arm, shape-identical to the host arm; jit/shard over batch."""
+    return _fold(jnp, rates)

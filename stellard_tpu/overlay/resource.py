@@ -40,6 +40,7 @@ __all__ = [
     "FEE_LOW_BURDEN_RPC",
     "FEE_MEDIUM_BURDEN_RPC",
     "FEE_HIGH_BURDEN_RPC",
+    "FEE_PATH_FIND",
     "FEE_PATH_FIND_UPDATE",
     "FEE_NEW_VALID_TX",
     "FEE_SATISFIED_REQUEST",
@@ -71,6 +72,11 @@ FEE_LIGHT_RPC = Charge(5, "light RPC")
 FEE_LOW_BURDEN_RPC = Charge(20, "low RPC")
 FEE_MEDIUM_BURDEN_RPC = Charge(40, "medium RPC")
 FEE_HIGH_BURDEN_RPC = Charge(300, "heavy RPC")
+# the pathfinding surfaces get their own class ABOVE heavy RPC: one
+# path_find is a full candidate search + trial execution, the reference's
+# notorious validator-killer — two back-to-back requests put a
+# non-admin endpoint over the WARNING line (ISSUE 17 satellite)
+FEE_PATH_FIND = Charge(400, "path find")
 FEE_PATH_FIND_UPDATE = Charge(100, "path update")
 FEE_NEW_VALID_TX = Charge(10, "valid tx")
 FEE_SATISFIED_REQUEST = Charge(10, "needed data")

@@ -105,6 +105,19 @@ def sharded_masked_sha512(mesh: Mesh):
     )
 
 
+def sharded_path_quality(mesh: Mesh):
+    """jit of the Q16.16 path-quality fold with the candidate batch dim
+    sharded over the mesh — the liquidity plane's flat kernel arm,
+    shaped exactly like sharded_masked_sha512 (callers pad the batch to
+    a width multiple before dispatch)."""
+    from ..ops.pathq_jax import path_quality_kernel
+
+    shard = _batch_sharding(mesh)
+    return jax.jit(
+        path_quality_kernel, in_shardings=(shard,), out_shardings=shard
+    )
+
+
 def sharded_tree_kernels(mesh: Mesh):
     """-> (leaf_kernel, inner_kernel): the fused close's level-chained
     tree-hash programs, sharded over the mesh with the digest buffer

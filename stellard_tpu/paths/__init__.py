@@ -12,7 +12,15 @@ semantics, separable pieces.
 """
 
 from .flow import PathError, flow, plan_strand
-from .orderbook import OrderBookDB
+from .orderbook import Book, LiveBookIndex, OrderBookDB
 from .pathfinder import find_paths
 
-__all__ = ["OrderBookDB", "PathError", "find_paths", "flow", "plan_strand"]
+__all__ = [
+    "Book",
+    "LiveBookIndex",
+    "OrderBookDB",
+    "PathError",
+    "find_paths",
+    "flow",
+    "plan_strand",
+]

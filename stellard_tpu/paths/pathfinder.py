@@ -548,6 +548,7 @@ def find_paths(
     books: Optional[OrderBookDB] = None,
     include_partial: bool = False,
     level: int = PATH_SEARCH_DEFAULT,
+    pre_rank=None,
 ) -> list[dict]:
     """Liquidity-checked alternatives, best quality first:
     [{"paths": [path], "source_amount": STAmount, "delivered": STAmount}]
@@ -574,6 +575,13 @@ def find_paths(
     candidates = _candidate_paths(
         les, src, dst, dst_amount, send_max, books, level=level
     )
+    # liquidity-plane hook (paths/plane.py): an estimated-quality
+    # pre-pass over the candidate set BEFORE the expensive per-candidate
+    # trial executions. Pure reordering never changes output (results
+    # re-sort by exact cost below); pruning is the hook's contract to
+    # apply only above its floor.
+    if pre_rank is not None and candidates:
+        candidates = pre_rank(les, candidates)
 
     results = []
     partials = []

@@ -140,6 +140,7 @@ def rpc_method_fee(method: Optional[str]):
         FEE_INVALID_RPC,
         FEE_LOW_BURDEN_RPC,
         FEE_MEDIUM_BURDEN_RPC,
+        FEE_PATH_FIND,
         FEE_REFERENCE_RPC,
     )
 
@@ -147,8 +148,10 @@ def rpc_method_fee(method: Optional[str]):
         return FEE_INVALID_RPC
     if method in ("server_info", "server_state", "fee", "ping", "random"):
         return FEE_REFERENCE_RPC          # cheap reference data
+    if method in ("path_find", "ripple_path_find"):
+        return FEE_PATH_FIND              # full candidate search + trials
     if method in ("account_tx", "ledger", "ledger_data", "book_offers",
-                  "path_find", "subscribe"):
+                  "subscribe"):
         return FEE_MEDIUM_BURDEN_RPC      # history walks / tree dumps
     if method in ("sign", "submit"):
         return FEE_HIGH_BURDEN_RPC if method == "sign" else (
@@ -628,6 +631,11 @@ def do_get_counts(ctx: Context) -> dict:
     plane = getattr(node, "read_plane", None)
     if plane is not None:
         out["read_plane"] = plane.get_json()
+    # liquidity plane (`paths.*`): incremental index continuity, per-
+    # close re-rank/shed counts, staleness quantiles, evaluator routing
+    path_plane = getattr(node, "path_plane", None)
+    if path_plane is not None:
+        out["paths"] = path_plane.get_json()
     tracer = getattr(node, "tracer", None)
     if tracer is not None:
         out["trace"] = tracer.status_json()  # ADMIN method: timeline ok
@@ -1518,6 +1526,19 @@ def do_ripple_path_find(ctx: Context) -> dict:
     kwargs = {"send_max": send_max}
     if level is not None:
         kwargs["level"] = level
+    # liquidity plane (ISSUE 17): serve off the incrementally-maintained
+    # book index when it already reflects the selected ledger (never
+    # advance it here — an RPC against a historical ledger must not
+    # wreck close-to-close continuity), and let the device plane
+    # pre-rank oversized candidate sets
+    plane = getattr(ctx.node, "path_plane", None)
+    if plane is not None:
+        books = plane.books_if_current(led)
+        if books is not None:
+            kwargs["books"] = books
+        pre_rank = plane.make_pre_rank(led)
+        if pre_rank is not None:
+            kwargs["pre_rank"] = pre_rank
     alts = find_paths(led, src, dst, dst_amount, **kwargs)
     out = _ledger_ident(led)
     out["source_account"] = p["source_account"]
@@ -1563,7 +1584,15 @@ def do_path_find(ctx: Context) -> dict:
         }
     if sub_cmd != "create":
         raise RPCError("invalidParams", f"unknown subcommand {sub_cmd!r}")
-    out = do_ripple_path_find(ctx)
+    # the initial answer is the same pure function of the validated
+    # snapshot as ripple_path_find — route it through the validated-seq
+    # result cache so back-to-back creates share one search (ISSUE 17;
+    # dispatch-level wrapping keys on "path_find", which is not
+    # cacheable because create/close mutate subscription state)
+    from .readplane import cached_dispatch
+
+    out = cached_dispatch(ctx, "ripple_path_find",
+                          lambda: do_ripple_path_find(ctx))
     if ctx.infosub is not None and ctx.subs is not None:
         from ..protocol.stamount import STAmount as _STA
 

@@ -36,10 +36,13 @@ class InfoSub:
 
     _next_id = 0
 
-    def __init__(self, send: Callable[[dict], None]):
+    def __init__(self, send: Callable[[dict], None], client_ip: str = ""):
         self.send = send
         InfoSub._next_id += 1
         self.id = InfoSub._next_id
+        # resource-plane identity: path-update shedding/charging keys on
+        # the client endpoint (empty for in-process sinks: never charged)
+        self.client_ip = client_ip
         self.streams: set[str] = set()
         self.accounts: set[bytes] = set()
         self.accounts_proposed: set[bytes] = set()
@@ -177,6 +180,9 @@ class SubscriptionManager:
 
         self.ops = ops
         self.tracer = tracer
+        # liquidity plane (paths/plane.py), wired by the node when
+        # [paths] is enabled; None keeps the legacy unbudgeted publisher
+        self.path_plane = None
         self.sendq_cap = max(1, int(sendq_cap))
         self.evict_drops = max(1, int(evict_drops))
         self.push_retries = int(push_retries)
@@ -303,43 +309,73 @@ class SubscriptionManager:
 
         from ..protocol.stobject import STPathSet
 
-        for sub in self._each():
-            for rid, req in list(sub.path_requests.items()):
-                # level ramp (reference: PathRequest.cpp:370-379 —
-                # answer at PATH_SEARCH_FAST on the first update, then
-                # jump to the full PATH_SEARCH level)
-                level = (
-                    PATH_SEARCH_FAST
-                    if req.get("level", 0) < PATH_SEARCH_FAST
-                    else PATH_SEARCH_DEFAULT
-                )
-                req["level"] = level
-                try:
-                    alts = find_paths(
-                        ledger, req["src"], req["dst"], req["dst_amount"],
-                        send_max=req.get("send_max"), level=level,
-                    )
-                except Exception:  # noqa: BLE001 — a bad request must not kill publishing
+        pairs = [
+            (sub, rid, req)
+            for sub in self._each()
+            for rid, req in list(sub.path_requests.items())
+        ]
+        if not pairs:
+            return
+        # liquidity plane (ISSUE 17): all subscriptions of one close
+        # share the incrementally-advanced book index, re-rank
+        # stalest-first under the per-close budget, and shed (not queue)
+        # past it or when the endpoint is resource-throttled
+        plane = self.path_plane
+        books = pre_rank = None
+        if plane is not None:
+            plane.begin_close(ledger.seq)
+            books = plane.books_for(ledger)
+            pre_rank = plane.make_pre_rank(ledger)
+            by_key = {(sub.id, rid): (sub, rid, req)
+                      for sub, rid, req in pairs}
+            plane.sync_live(by_key.keys())
+            pairs = [by_key[k]
+                     for k in plane.order_keys(by_key.keys(), ledger.seq)]
+        for sub, rid, req in pairs:
+            if plane is not None:
+                ip = getattr(sub, "client_ip", "")
+                endpoint = (ip, 0) if ip else None
+                if not plane.claim_update((sub.id, rid), ledger.seq,
+                                          endpoint=endpoint):
                     continue
-                msg = {
-                    "type": "path_find",
-                    "id": rid,
-                    # only the full-depth search is a definitive answer;
-                    # the FAST first pass is marked partial so clients
-                    # wait for the deeper updates (reference:
-                    # PathRequest's iLastLevel / full_reply contract)
-                    "full_reply": level >= PATH_SEARCH_DEFAULT,
-                    "ledger_index": ledger.seq,
-                    "alternatives": [
-                        {
-                            "paths_computed": STPathSet(a["paths"]).to_json(),
-                            "source_amount": a["source_amount"].to_json(),
-                        }
-                        for a in alts
-                    ],
-                    **req.get("echo", {}),
-                }
-                self._deliver(sub, msg)
+            # level ramp (reference: PathRequest.cpp:370-379 —
+            # answer at PATH_SEARCH_FAST on the first update, then
+            # jump to the full PATH_SEARCH level)
+            level = (
+                PATH_SEARCH_FAST
+                if req.get("level", 0) < PATH_SEARCH_FAST
+                else PATH_SEARCH_DEFAULT
+            )
+            req["level"] = level
+            try:
+                alts = find_paths(
+                    ledger, req["src"], req["dst"], req["dst_amount"],
+                    send_max=req.get("send_max"), level=level,
+                    books=books, pre_rank=pre_rank,
+                )
+            except Exception:  # noqa: BLE001 — a bad request must not kill publishing
+                continue
+            if plane is not None:
+                plane.note_ranked((sub.id, rid), ledger.seq)
+            msg = {
+                "type": "path_find",
+                "id": rid,
+                # only the full-depth search is a definitive answer;
+                # the FAST first pass is marked partial so clients
+                # wait for the deeper updates (reference:
+                # PathRequest's iLastLevel / full_reply contract)
+                "full_reply": level >= PATH_SEARCH_DEFAULT,
+                "ledger_index": ledger.seq,
+                "alternatives": [
+                    {
+                        "paths_computed": STPathSet(a["paths"]).to_json(),
+                        "source_amount": a["source_amount"].to_json(),
+                    }
+                    for a in alts
+                ],
+                **req.get("echo", {}),
+            }
+            self._deliver(sub, msg)
 
     def unsubscribe_accounts(self, sub: InfoSub, accounts: list[bytes],
                              proposed: bool = False) -> None:

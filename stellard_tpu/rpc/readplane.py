@@ -28,9 +28,14 @@ from typing import Any, Optional
 __all__ = ["ReadPlane", "ResultCache", "CACHEABLE_METHODS"]
 
 # the hot read RPCs worth a whole-result cache (ISSUE 10); everything
-# else recomputes — these four dominate production read traffic
+# else recomputes — these dominate production read traffic.
+# ripple_path_find joined in ISSUE 17: a path search is a pure function
+# of the validated snapshot and by far the dearest entry in the fee
+# schedule (FEE_PATH_FIND), so identical back-to-back queries within
+# one validated epoch must not recompute.
 CACHEABLE_METHODS = frozenset(
-    {"account_info", "book_offers", "ledger", "account_tx"}
+    {"account_info", "book_offers", "ledger", "account_tx",
+     "ripple_path_find"}
 )
 
 

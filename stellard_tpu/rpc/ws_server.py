@@ -125,12 +125,15 @@ class WsRpcServer:
                 data = json.dumps(msg).encode()
                 asyncio.run_coroutine_threadsafe(send_async(data), loop)
 
-            sub = InfoSub(send_json_threadsafe)
             from .http_server import _role_for_peer
 
             role = _role_for_peer(self.node, writer)
             peer = writer.get_extra_info("peername")
             client_ip = peer[0] if peer else ""
+            # the sub carries its endpoint so per-close path-update
+            # shedding/charging (paths/plane.py) keys the same balance
+            # as the request door
+            sub = InfoSub(send_json_threadsafe, client_ip=client_ip)
 
             buffer = b""
             while True:

@@ -316,6 +316,31 @@ def check_invariants(
                 "follower_sync",
                 f"followers at {card.get('followers', {}).get('validated_seqs')}",
             ))
+        if getattr(scn, "path_subs", 0):
+            # liquidity plane (ISSUE 17): the incremental index must
+            # equal the full scan at every close, the budgeted
+            # re-ranker must have run (anti-vacuity), and stalest-first
+            # under a ceil(n/2) budget bounds worst-case staleness —
+            # a subscription starving past 4 closes is a scheduler bug
+            p = card.get("paths") or {}
+            if not p.get("identity_ok", True):
+                v.append(Violation(
+                    "path_index_identity",
+                    f"incremental book index diverged from the full "
+                    f"scan ({p.get('closes')} closes)",
+                ))
+            if p.get("closes", 0) > 0 and not p.get("reranked"):
+                v.append(Violation(
+                    "anti_vacuity",
+                    "path subscriptions configured but zero re-ranks",
+                ))
+            if p.get("staleness_max", 0) > 4:
+                v.append(Violation(
+                    "path_staleness",
+                    f"subscription staleness hit "
+                    f"{p.get('staleness_max')} closes under a "
+                    f"ceil(n/2) budget",
+                ))
 
     # (6) no-silent-fault anti-vacuity: every configured hostile input
     # must leave counter evidence — a scenario that silently stopped
@@ -594,6 +619,13 @@ class ScenarioGenerator:
         # route honest tree hashing through the meshed device hasher.
         if scn.seed & 0xF == 0:
             scn.mesh_width = (2, 4, 8)[(scn.seed >> 4) % 3]
+        # liquidity-plane axis (ISSUE 17): seed-derived like the mesh
+        # axis (the generator's rng stream stays bit-identical). ~1 in
+        # 8 runs ride 2-5 synthetic path subscriptions on the watch
+        # validator — per-close index identity + budgeted re-ranking
+        # under whatever faults this schedule carries.
+        if scn.seed & 0x7 == 0x3:
+            scn.path_subs = 2 + ((scn.seed >> 3) & 0x3)
 
         raw: list[tuple] = []
         hostile = n - 1 if (byz or cold) else None
@@ -762,6 +794,10 @@ def _weaken_ops(scn: Scenario) -> list[tuple[str, Scenario]]:
         c = clone()
         c.mesh_width = 0
         out.append(("drop_mesh", c))
+    if getattr(scn, "path_subs", 0):
+        c = clone()
+        c.path_subs = 0
+        out.append(("drop_path_subs", c))
     if scn.byzantine:
         c = clone()
         c.byzantine = {}

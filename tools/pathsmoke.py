@@ -1,0 +1,252 @@
+#!/usr/bin/env python3
+"""Liquidity-plane smoke gate (tools/tier1.sh, ISSUE 17).
+
+Boots a standalone node (paths plane on by default), floods an
+order-book crossfire through the full async pipeline — offer creation,
+partial-fill tier consumption, full crossings that empty books, and
+cancels — while N live path_find subscriptions (plus one resource-
+throttled path-spam flooder) ride the per-close publisher. Gates:
+
+1. identity per close: the incrementally-advanced book index equals a
+   from-scratch full state scan after EVERY close (and the incremental
+   path actually engaged — anti-vacuity via the index counters);
+2. re-ranked deliveries: every close with live subscriptions delivers
+   path_find updates (the plane's claim/rank path, not a silent skip);
+3. close cadence: the p50 close wall time during the subscribed flood
+   stays within tolerance of the pre-subscription baseline closes —
+   pathfinding must never serialize into the close;
+4. shedding: the flooder's throttled endpoint is SHED by the resource
+   plane while polite subscribers keep their deliveries.
+
+Exit 0 when every gate holds; 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_smoke(n_closes: int = 12, n_subs: int = 4) -> int:
+    from stellard_tpu.node.config import Config
+    from stellard_tpu.node.node import Node
+    from stellard_tpu.overlay.resource import FEE_PATH_FIND, ResourceManager
+    from stellard_tpu.paths import OrderBookDB
+    from stellard_tpu.protocol.formats import TxType
+    from stellard_tpu.protocol.keys import KeyPair
+    from stellard_tpu.protocol.sfields import (
+        sfAmount,
+        sfDestination,
+        sfLimitAmount,
+        sfOfferSequence,
+        sfTakerGets,
+        sfTakerPays,
+    )
+    from stellard_tpu.protocol.stamount import STAmount, currency_from_iso
+    from stellard_tpu.protocol.sttx import SerializedTransaction
+    from stellard_tpu.rpc.infosub import InfoSub
+
+    USD = currency_from_iso("USD")
+    M = 1_000_000
+
+    node = Node(Config(signature_backend="cpu")).setup()
+    bad = []
+    try:
+        plane = node.path_plane
+        if plane is None:
+            print("path smoke: [paths] plane is not wired", file=sys.stderr)
+            return 1
+        if node.rpc_resources is None:
+            node.rpc_resources = ResourceManager()
+        plane.resources = node.rpc_resources
+
+        master = KeyPair.from_passphrase("masterpassphrase")
+        gw = KeyPair.from_passphrase("path-smoke-gw")
+        traders = [KeyPair.from_passphrase(f"path-smoke-t{i}")
+                   for i in range(4)]
+        seqs: dict[bytes, int] = {master.account_id: 1}
+        done = threading.Semaphore(0)
+
+        def iou(v):
+            return STAmount.from_iou(USD, gw.account_id, v, 0)
+
+        def drops(v):
+            return STAmount.from_drops(v)
+
+        def tx_of(key, tx_type, fields):
+            s = seqs.setdefault(key.account_id, 1)
+            tx = SerializedTransaction.build(
+                tx_type, key.account_id, s, 10, fields)
+            tx.sign(key)
+            seqs[key.account_id] = s + 1
+            return tx
+
+        def submit_all(txs):
+            for tx in txs:
+                node.ops.submit_transaction(tx, lambda *_: done.release())
+            for _ in txs:
+                done.acquire()
+
+        close_times: list[float] = []
+
+        def close():
+            t0 = time.perf_counter()
+            closed, _results = node.ops.accept_ledger()
+            close_times.append(time.perf_counter() - t0)
+            return closed
+
+        def check_identity(closed):
+            inc = plane.books_for(closed).books
+            full = OrderBookDB().setup(closed).books
+            if inc != full:
+                bad.append(
+                    f"seq {closed.seq}: incremental {len(inc)} books != "
+                    f"full scan {len(full)}")
+
+        # -- seed accounts, trust lines, IOU float ------------------------
+        submit_all([
+            tx_of(master, TxType.ttPAYMENT,
+                  {sfAmount: drops(2_000 * M), sfDestination: k.account_id})
+            for k in [gw, *traders]
+        ])
+        check_identity(close())
+        submit_all([
+            tx_of(t, TxType.ttTRUST_SET,
+                  {sfLimitAmount: STAmount.from_iou(
+                      USD, gw.account_id, 1_000_000, 0)})
+            for t in traders
+        ])
+        check_identity(close())
+        submit_all([
+            tx_of(gw, TxType.ttPAYMENT,
+                  {sfAmount: iou(10_000), sfDestination: t.account_id})
+            for t in traders
+        ])
+        check_identity(close())
+
+        # -- baseline closes: crossfire, no subscriptions -----------------
+        live_offers: list[tuple] = []  # (owner, offer seq)
+        rnd_rate = [1, 2, 3]
+
+        def crossfire(i):
+            """One close's worth of book churn."""
+            txs = []
+            a, b, c = (traders[i % 4], traders[(i + 1) % 4],
+                       traders[(i + 2) % 4])
+            # a sells USD for XRP at a rotating rate (new tier, and on
+            # fresh pairs every few closes a brand-new book)
+            rate = rnd_rate[i % 3]
+            live_offers.append((a, seqs.setdefault(a.account_id, 1)))
+            txs.append(tx_of(a, TxType.ttOFFER_CREATE,
+                             {sfTakerPays: drops(10 * rate * M),
+                              sfTakerGets: iou(10)}))
+            if i % 2 == 0:
+                # b crosses the best tier (partial fill / tier consume)
+                txs.append(tx_of(b, TxType.ttOFFER_CREATE,
+                                 {sfTakerPays: iou(5),
+                                  sfTakerGets: drops(5 * 3 * M)}))
+            if i % 3 == 2 and live_offers:
+                owner, oseq = live_offers.pop(0)
+                txs.append(tx_of(owner, TxType.ttOFFER_CANCEL,
+                                 {sfOfferSequence: oseq}))
+            if i % 4 == 3:
+                # reverse-direction book: c sells XRP for USD, priced
+                # NOT to cross (demands 2 USD/XRP vs market's ~0.3-1)
+                txs.append(tx_of(c, TxType.ttOFFER_CREATE,
+                                 {sfTakerPays: iou(20),
+                                  sfTakerGets: drops(10 * M)}))
+            return txs
+
+        baseline_n = n_closes // 2
+        for i in range(baseline_n):
+            submit_all(crossfire(i))
+            check_identity(close())
+        baseline_p50 = statistics.median(close_times[-baseline_n:])
+
+        # -- subscribed flood ---------------------------------------------
+        # drive the publisher synchronously per close (normally it runs
+        # on a jtUPDATE_PF job): deliveries become deterministic and the
+        # close timing below still never includes pathfinding
+        from stellard_tpu.rpc.infosub import SubscriptionManager
+
+        mgr = SubscriptionManager(node.ops)  # node.subs waits for serve()
+        node.ops.on_ledger_closed.remove(mgr._pub_ledger)
+        mgr.path_plane = plane
+        boxes = [[] for _ in range(n_subs)]
+        for j, box in enumerate(boxes):
+            sub = InfoSub(box.append)
+            mgr.create_path_request(sub, {
+                "src": traders[j % 4].account_id,
+                "dst": traders[(j + 1) % 4].account_id,
+                "dst_amount": iou(5),
+            })
+        spam_box: list = []
+        spammer = InfoSub(spam_box.append, client_ip="6.6.6.6")
+        while not node.rpc_resources.is_throttled(("6.6.6.6", 0)):
+            node.rpc_resources.charge(("6.6.6.6", 0), FEE_PATH_FIND)
+        mgr.create_path_request(spammer, {
+            "src": traders[0].account_id,
+            "dst": traders[1].account_id,
+            "dst_amount": iou(5),
+        })
+
+        flood_times: list[float] = []
+        for i in range(baseline_n, n_closes):
+            submit_all(crossfire(i))
+            closed = close()
+            flood_times.append(close_times[-1])
+            check_identity(closed)
+            before = plane.reranked
+            mgr._pub_path_updates(closed)
+            if plane.reranked <= before:
+                bad.append(f"seq {closed.seq}: close re-ranked nothing")
+
+        # -- gates ---------------------------------------------------------
+        counters = plane.index.counters()
+        if not counters["incremental_advances"]:
+            bad.append("incremental index never advanced incrementally "
+                       f"(counters: {counters})")
+        if counters["full_rebuilds"] > 2:
+            bad.append(f"index kept falling back to full scans: {counters}")
+        delivered = sum(len(b) for b in boxes)
+        want = (n_closes - baseline_n) * n_subs
+        if delivered < want:
+            bad.append(f"polite subscribers got {delivered}/{want} updates")
+        if any(m.get("type") != "path_find" for b in boxes for m in b):
+            bad.append("non-path_find message on a path subscription")
+        if spam_box:
+            bad.append(f"throttled flooder still got {len(spam_box)} updates")
+        if plane.shed_throttled < (n_closes - baseline_n):
+            bad.append(f"resource plane shed only {plane.shed_throttled} "
+                       "flooder updates")
+        flood_p50 = statistics.median(flood_times)
+        if flood_p50 > max(baseline_p50 * 3.0, baseline_p50 + 0.05):
+            bad.append(
+                f"close cadence regressed: p50 {flood_p50 * 1e3:.1f}ms "
+                f"subscribed vs {baseline_p50 * 1e3:.1f}ms baseline")
+        if bad:
+            for b in bad:
+                print(f"path smoke: {b}", file=sys.stderr)
+            return 1
+        print(
+            f"path smoke OK: {n_closes} crossfire closes identical to the "
+            f"full scan (advances={counters['incremental_advances']} "
+            f"carries={counters['carries']} rebuilds="
+            f"{counters['full_rebuilds']} rereads={counters['book_rereads']}) "
+            f"| {delivered} updates to {n_subs} subs, flooder shed "
+            f"{plane.shed_throttled}x | close p50 "
+            f"{baseline_p50 * 1e3:.1f}ms -> {flood_p50 * 1e3:.1f}ms"
+        )
+        return 0
+    finally:
+        node.stop()
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 12
+    sys.exit(run_smoke(n))

@@ -146,6 +146,19 @@ if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/followersmoke.py; then
   exit 2
 fi
 
+echo "== liquidity-plane smoke gate (crossfire flood, live path subs, incremental==full) =="
+# boots a node with the paths plane on (default), floods an order-book
+# crossfire (creates, tier-consuming crossings, cancels) with N live
+# path_find subscriptions plus a resource-throttled path-spam flooder,
+# and asserts per close: the incremental book index byte-equals a full
+# state scan (with the incremental path provably engaged), every close
+# re-ranks and delivers subscription updates, the flooder is shed by
+# the resource plane, and close cadence holds vs the no-subs baseline
+if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/pathsmoke.py; then
+  echo "PATH SMOKE FAILED — liquidity plane is broken" >&2
+  exit 2
+fi
+
 echo "== overload-admission smoke gate (4x flood -> bounded closes, fee-order drain) =="
 # boots a node with a pinned small admission cap, floods it at 4x that
 # capacity through the full async pipeline, and asserts the RPC door
