@@ -318,6 +318,14 @@ class Config:
     # -- ops ([sntp_servers], [insight]) -----------------------------------
     sntp_servers: list[str] = field(default_factory=list)  # host[:port]
     insight: str = ""  # '' | 'statsd:host:port[:prefix]'
+    # embedded metrics history (node/metrics.py MetricsHistory): bounded
+    # ring of instrument snapshots every history_interval seconds kept
+    # for history_window seconds, served by the `metrics_history` admin
+    # RPC and scraped by the `GET /metrics` Prometheus door. history=0
+    # disables sampling (and with it the health watchdog's metric rules).
+    insight_history: bool = True
+    insight_history_interval: float = 5.0
+    insight_history_window: float = 300.0
 
     # -- tracing plane ([trace]) -------------------------------------------
     # enabled=1 (default): transaction-lifecycle spans recorded into a
@@ -329,6 +337,33 @@ class Config:
     trace_enabled: bool = True
     trace_capacity: int = 16384
     trace_sample: float = 0.125
+    # propagate=1 (default): outbound tx/proposal/validation/segment
+    # frames carry a TraceContext extension (wire field 60) so spans on
+    # different nodes join one causal tree; deterministic per-txid
+    # sampling means every node samples the same transactions.
+    # propagate=0 is the kill switch: frames are byte-identical to the
+    # pre-extension wire, and inbound contexts are stripped on decode.
+    trace_propagate: bool = True
+
+    # -- SLO health watchdog + flight recorder ([health]) ------------------
+    # node/health.py: EWMA/threshold rules over the metrics history —
+    # close cadence stalls/drift, validation lag, fanout delivery p99,
+    # verify/hash routing flips, cache hit collapse, persist backlog —
+    # surfacing ok/warn/critical (with reasons) in server_state and
+    # get_counts, plus an always-on bounded flight recorder dumped to
+    # disk on crash, degradation to TRACKING, or health transitions.
+    health_enabled: bool = True
+    health_stall_warn_s: float = 12.0
+    health_stall_crit_s: float = 45.0
+    health_drift_factor: float = 2.5
+    health_lag_warn: int = 4
+    health_lag_crit: int = 16
+    health_fanout_p99_warn_ms: float = 250.0
+    health_flips_warn: int = 8
+    health_cache_hit_warn: float = 0.10
+    health_persist_depth_warn: float = 512.0
+    health_flight_dir: str = ""  # '' = <database_path>/flight
+    health_flight_spans: int = 2048
 
     # -- subscription fanout ([subs]) --------------------------------------
     # shards=N partitions InfoSub/RPCSub event delivery across N worker
@@ -670,8 +705,28 @@ class Config:
         cfg.sntp_servers = [line.split()[0] for line in s.get("sntp_servers", [])]
         cfg.validators_file = one("validators_file", cfg.validators_file)
         cfg.validators_site = one("validators_site", cfg.validators_site)
-        cfg.insight = one("insight", cfg.insight)
+        # [insight] is a hybrid section: the legacy bare collector line
+        # ('statsd:host:port[:prefix]') plus key=value history knobs
+        insight_lines = s.get("insight", [])
+        bare = [ln for ln in insight_lines if "=" not in ln]
+        if bare:
+            cfg.insight = bare[0]
+        ikv = _kv(insight_lines)
+        _reject_unknown("insight", ikv, (
+            "history", "history_interval", "history_window",
+        ))
+        if "history" in ikv:
+            cfg.insight_history = ikv["history"].lower() not in (
+                "0", "false", "no", "off"
+            )
+        if "history_interval" in ikv:
+            cfg.insight_history_interval = float(ikv["history_interval"])
+        if "history_window" in ikv:
+            cfg.insight_history_window = float(ikv["history_window"])
         trace = _kv(s.get("trace", []))
+        _reject_unknown("trace", trace, (
+            "enabled", "capacity", "sample", "propagate",
+        ))
         if "enabled" in trace:
             cfg.trace_enabled = trace["enabled"].lower() not in (
                 "0", "false", "no", "off"
@@ -680,6 +735,37 @@ class Config:
             cfg.trace_capacity = int(trace["capacity"])
         if "sample" in trace:
             cfg.trace_sample = float(trace["sample"])
+        if "propagate" in trace:
+            cfg.trace_propagate = trace["propagate"].lower() not in (
+                "0", "false", "no", "off"
+            )
+        health = _kv(s.get("health", []))
+        _reject_unknown("health", health, (
+            "enabled", "stall_warn_s", "stall_crit_s", "drift_factor",
+            "lag_warn", "lag_crit", "fanout_p99_warn_ms", "flips_warn",
+            "cache_hit_warn", "persist_depth_warn", "flight_dir",
+            "flight_spans",
+        ))
+        if "enabled" in health:
+            cfg.health_enabled = health["enabled"].lower() not in (
+                "0", "false", "no", "off"
+            )
+        for key, attr, conv in (
+            ("stall_warn_s", "health_stall_warn_s", float),
+            ("stall_crit_s", "health_stall_crit_s", float),
+            ("drift_factor", "health_drift_factor", float),
+            ("lag_warn", "health_lag_warn", int),
+            ("lag_crit", "health_lag_crit", int),
+            ("fanout_p99_warn_ms", "health_fanout_p99_warn_ms", float),
+            ("flips_warn", "health_flips_warn", int),
+            ("cache_hit_warn", "health_cache_hit_warn", float),
+            ("persist_depth_warn", "health_persist_depth_warn", float),
+            ("flight_spans", "health_flight_spans", int),
+        ):
+            if key in health:
+                setattr(cfg, attr, conv(health[key]))
+        if "flight_dir" in health:
+            cfg.health_flight_dir = health["flight_dir"]
         cfg.validators = [
             line.split()[0] for line in s.get("validators", [])
         ]  # reference allows trailing comments per line

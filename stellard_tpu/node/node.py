@@ -409,6 +409,52 @@ class Node:
         from .netclock import SntpClient
 
         self.collector = CollectorManager.from_config(cfg.insight)
+        if cfg.insight_history:
+            # Monarch-stance embedded history: the bounded in-process
+            # ring the metrics_history RPC, the GET /metrics door and
+            # the health watchdog all read (doc/observability.md)
+            self.collector.enable_history(
+                cfg.insight_history_interval, cfg.insight_history_window
+            )
+
+        # SLO health plane ([health], node/health.py): always-on flight
+        # recorder (black box: recent spans + health transitions +
+        # counter snapshots, dumped on crash/degradation) + the EWMA/
+        # threshold watchdog riding the metrics-history sample stream
+        from .health import FlightRecorder, HealthWatchdog, _RANK
+
+        flight_dir = cfg.health_flight_dir or (
+            cfg.database_path + ".flight" if cfg.database_path else ""
+        )
+        self.flight = FlightRecorder(
+            directory=flight_dir, spans_cap=cfg.health_flight_spans
+        )
+        self.tracer.flight = self.flight
+        self._degraded_dump_done = False
+        self.health: Optional[HealthWatchdog] = None
+        if cfg.health_enabled:
+            self.health = HealthWatchdog(
+                stall_warn_s=cfg.health_stall_warn_s,
+                stall_crit_s=cfg.health_stall_crit_s,
+                drift_factor=cfg.health_drift_factor,
+                lag_warn=cfg.health_lag_warn,
+                lag_crit=cfg.health_lag_crit,
+                fanout_p99_warn_ms=cfg.health_fanout_p99_warn_ms,
+                flips_warn=cfg.health_flips_warn,
+                cache_hit_warn=cfg.health_cache_hit_warn,
+                persist_depth_warn=cfg.health_persist_depth_warn,
+                tracer=self.tracer,
+                flight=self.flight,
+            )
+            self.collector.on_sample(self.health.on_snapshot)
+
+            def _dump_on_degrade(old, new, reasons):
+                # the black box ships when health WORSENS; the recovery
+                # transition is an instant in the trace, not a dump
+                if _RANK.get(new, 0) > _RANK.get(old, 0):
+                    self.flight.dump("health-" + new)
+
+            self.health.on_transition.append(_dump_on_degrade)
         self.sntp: Optional[SntpClient] = None
         if cfg.sntp_servers:
             servers = [
@@ -721,6 +767,14 @@ class Node:
         # closes, status, staleness checks); the SNTP heartbeat COMPOSES
         # its measured correction with this base (see _heartbeat)
         self.ops.net_time_offset = int(cfg.network_time_offset)
+        if self.health is not None:
+            # close-cadence feed: fires on standalone closes AND on the
+            # networked path (publish_closed_ledger after persist), and
+            # on follower adoption — one seam covers every mode
+            hw2 = self.health
+            self.ops.on_ledger_closed.append(
+                lambda led, _res: hw2.note_close(led.seq)
+            )
 
         # RPC-door resource pricing ([overlay] rpc_resource=1): one
         # decaying charge balance per CLIENT IP, priced with the peer
@@ -754,7 +808,18 @@ class Node:
         # the validated floor: on a quorum net validations land after
         # the close persisted, and this hook is what opens the epoch
         # (the read plane publishes min(persisted, validated))
-        self.ledger_master.on_validated = self.read_plane.note_validated
+        if self.health is None:
+            self.ledger_master.on_validated = self.read_plane.note_validated
+        else:
+            # compose: the read plane opens the epoch, the watchdog's
+            # validation-lag rule sees the quorum floor advance
+            hw = self.health
+
+            def _note_validated(led):
+                self.read_plane.note_validated(led)
+                hw.note_validated(led.seq)
+
+            self.ledger_master.on_validated = _note_validated
         # follower consistency contract (doc/follower.md): selector-less
         # read RPCs serve the last VALIDATED snapshot, not the open
         # ledger — the read tier's answers are immutable and identical
@@ -1009,6 +1074,25 @@ class Node:
                 "verified": self.verify_plane.verified,
             },
         )
+        # routing-flip telemetry for the health watchdog: which side
+        # (cpu vs device) took the majority of verify batches since the
+        # last flush; a majority change is one flip — the thrashing
+        # detector's input (health.py rule 4 reads `*.flips`)
+        _route = {"side": None, "cpu": 0, "dev": 0, "flips": 0}
+
+        def _verify_routing():
+            vp = self.verify_plane
+            dc, cc = vp.device_batches, vp.cpu_batches
+            d_dev, d_cpu = dc - _route["dev"], cc - _route["cpu"]
+            _route["dev"], _route["cpu"] = dc, cc
+            if d_dev or d_cpu:
+                side = "device" if d_dev >= d_cpu else "cpu"
+                if _route["side"] is not None and side != _route["side"]:
+                    _route["flips"] += 1
+                _route["side"] = side
+            return {"flips": _route["flips"]}
+
+        self.collector.hook("verify_routing", _verify_routing)
         self.collector.hook(
             "load", lambda: {"factor": self.fee_track.load_factor}
         )
@@ -1098,6 +1182,22 @@ class Node:
         self.load_manager.arm()
         last_beat = 0.0
         last_sweep = 0.0
+        try:
+            self._run_loop(last_beat, last_sweep)
+        except BaseException:
+            # the flight recorder's whole point: the black box ships
+            # BEFORE the stack unwinds (doc/observability.md)
+            try:
+                self.flight.dump("crash")
+            except Exception:  # noqa: BLE001 — dump must not mask the crash
+                pass
+            raise
+
+    def _run_loop(self, last_beat: float, last_sweep: float) -> None:
+        import time as _time
+
+        from .jobqueue import JobType
+
         while self._running.is_set():
             # the heartbeat must flow THROUGH the job queue: a wedged
             # worker pool or master lock then starves the canary reset and
@@ -1192,8 +1292,14 @@ class Node:
                         # TRACKING honestly instead of a confident FULL
                         # from a node whose ledgers nobody signs
                         self.ops.mode = OperatingMode.TRACKING
+                        if not self._degraded_dump_done:
+                            # black box on entering degraded service —
+                            # once per episode, not per heartbeat
+                            self._degraded_dump_done = True
+                            self.flight.dump("degraded-tracking")
                     elif rounds > 0 and recently:
                         self.ops.mode = OperatingMode.FULL
+                        self._degraded_dump_done = False
                     elif self.overlay.peer_count() > 0:
                         self.ops.mode = OperatingMode.CONNECTED
                     else:

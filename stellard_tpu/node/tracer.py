@@ -33,11 +33,26 @@ Cross-thread spans use the explicit ``begin()``/``end()`` token pair
 pipeline persists on its drain worker). Same-thread nesting uses the
 ``span()`` context manager, which maintains a thread-local parent
 stack so child spans link without any caller bookkeeping.
+
+Cross-NODE propagation (``[trace] propagate``): overlay frames carry a
+compact trace context — trace id + parent span id + sampled bit — in an
+optional high-numbered wire extension (overlay/wire.py TraceContext).
+Span ids are node-unique (a per-tracer 32-bit tag in the high bits), so
+spans recorded on different nodes never collide and a merged dump
+(tools/traceview.py --merge) resolves parent links across processes.
+The deterministic per-txid sampling means every node makes the SAME
+record/skip decision, so a sampled transaction's causal tree is
+complete fleet-wide. ``wire_context()`` exports the sender side;
+``adopt_context()`` registers the foreign parent on the receiver, and
+any span recorded for that trace with no local parent links under it
+(marked ``remote`` in the dump — a single-node validation must not
+demand the foreign parent resolve locally).
 """
 
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 import zlib
@@ -46,6 +61,10 @@ from typing import Optional
 from .metrics import LatencyHist
 
 __all__ = ["Tracer", "SpanToken", "get_tracer"]
+
+# bound on the per-trace foreign-parent / last-span maps the propagation
+# plane keeps (FIFO eviction; a trace is a txid or "ledger-<seq>")
+_CTX_CAP = 4096
 
 # categories whose events feed the server_state consensus/close timeline
 _TIMELINE_CATS = frozenset({"close", "consensus", "persist"})
@@ -129,10 +148,12 @@ class Tracer:
     """Lock-light bounded ring-buffer span recorder."""
 
     def __init__(self, capacity: int = 16384, enabled: bool = True,
-                 sample: float = 0.125):
+                 sample: float = 0.125, propagate: bool = False,
+                 node_tag: Optional[int] = None):
         self.enabled = bool(enabled)
         self.capacity = max(16, int(capacity))
         self.sample = min(1.0, max(0.0, float(sample)))
+        self.propagate = bool(propagate)
         # sampling threshold in basis points of 10000, precomputed so the
         # per-tx gate is one crc32 + one compare
         self._sample_bp = int(round(self.sample * 10000))
@@ -140,10 +161,25 @@ class Tracer:
         self._ring: list = [None] * self.capacity
         self._n = 0  # total records ever pushed
         self._ids = itertools.count(1)
+        # node-unique span-id prefix: spans from different tracers
+        # (nodes / processes) occupy disjoint id ranges, so a merged
+        # multi-node dump resolves cross-node parent links directly
+        if node_tag is None:
+            node_tag = int.from_bytes(os.urandom(4), "big") or 1
+        self.node_tag = node_tag & 0xFFFFFFFF
+        self._tag = self.node_tag << 32
         self._epoch = time.perf_counter()
         self._tls = threading.local()
         # span-derived per-stage latency histograms (name -> hist)
         self.stage_hist: dict[str, LatencyHist] = {}
+        # propagation state: trace -> foreign parent span id (adopted
+        # from the wire) and trace -> last locally recorded span id
+        # (exported as the parent of outbound frames). Bounded FIFO.
+        self._foreign: dict[str, int] = {}
+        self._last: dict[str, int] = {}
+        # optional flight-recorder feed (node/health.py FlightRecorder):
+        # every recorded span/instant also lands in its black box
+        self.flight = None
 
     @classmethod
     def from_config(cls, cfg) -> "Tracer":
@@ -152,6 +188,7 @@ class Tracer:
             capacity=cfg.trace_capacity,
             enabled=cfg.trace_enabled,
             sample=cfg.trace_sample,
+            propagate=getattr(cfg, "trace_propagate", False),
         )
 
     # -- sampling ----------------------------------------------------------
@@ -203,6 +240,21 @@ class Tracer:
         stack = self._stack()
         return stack[-1].span_id if stack else None
 
+    def _next_id(self) -> int:
+        return self._tag | (next(self._ids) & 0xFFFFFFFF)
+
+    def _resolve_parent(self, parent, trace, attrs):
+        """Parent resolution order: explicit > thread-local stack >
+        foreign parent adopted from the wire for this trace. A foreign
+        parent marks the record ``remote`` so single-node validation
+        knows the link resolves on another node's dump."""
+        parent_id = self._parent_id(parent)
+        if parent_id is None and trace is not None and self._foreign:
+            parent_id = self._foreign.get(trace)
+            if parent_id is not None:
+                attrs = {**(attrs or {}), "remote": 1}
+        return parent_id, attrs
+
     def begin(self, name: str, cat: str, txid=None, seq=None, parent=None,
               **attrs) -> Optional[SpanToken]:
         """Open a span; returns a token to ``end()`` (possibly from
@@ -211,9 +263,11 @@ class Tracer:
         ``span()`` context is the parent."""
         if not self._admit(txid):
             return None
+        trace = _trace_id(txid, seq)
+        parent_id, attrs = self._resolve_parent(parent, trace, attrs)
         return SpanToken(
-            name, cat, _trace_id(txid, seq), next(self._ids),
-            self._parent_id(parent), time.perf_counter(),
+            name, cat, trace, self._next_id(),
+            parent_id, time.perf_counter(),
             threading.get_ident(), attrs or None,
         )
 
@@ -245,9 +299,11 @@ class Tracer:
         clock their stages (JobQueue, VerifyPlane, ClosePipeline)."""
         if not self._admit(txid):
             return
+        trace = _trace_id(txid, seq)
+        parent_id, attrs = self._resolve_parent(parent, trace, attrs)
         token = SpanToken(
-            name, cat, _trace_id(txid, seq), next(self._ids),
-            self._parent_id(parent), t0, threading.get_ident(),
+            name, cat, trace, self._next_id(),
+            parent_id, t0, threading.get_ident(),
             attrs or None,
         )
         self._record_complete(token, t1, (t1 - t0) * 1000.0)
@@ -268,15 +324,86 @@ class Tracer:
                 token.tid, token.attrs,
             )
             self._n += 1
+            if self.propagate and token.trace is not None:
+                self._note_last_locked(token.trace, token.span_id)
+        fl = self.flight
+        if fl is not None:
+            fl.note_span("X", token.name, token.cat, token.trace, ms)
 
-    def instant(self, name: str, cat: str, txid=None, seq=None, **attrs) -> None:
+    def instant(self, name: str, cat: str, txid=None, seq=None, parent=None,
+                **attrs) -> None:
         """Point event (consensus round events, splice/fallback marks)."""
         if not self._admit(txid):
             return
+        trace = _trace_id(txid, seq)
+        parent_id, attrs = self._resolve_parent(parent, trace, attrs)
+        span_id = self._next_id()
         self._push((
-            "i", name, cat, _trace_id(txid, seq), next(self._ids), None,
+            "i", name, cat, trace, span_id, parent_id,
             self._now_us(), 0, threading.get_ident(), attrs or None,
         ))
+        if self.propagate and trace is not None:
+            with self._lock:
+                self._note_last_locked(trace, span_id)
+        fl = self.flight
+        if fl is not None:
+            fl.note_span("i", name, cat, trace, 0.0)
+
+    # -- cross-node propagation --------------------------------------------
+
+    def _note_last_locked(self, trace: str, span_id: int) -> None:
+        last = self._last
+        if trace not in last and len(last) >= _CTX_CAP:
+            last.pop(next(iter(last)))
+        last[trace] = span_id
+
+    def adopt_context(self, trace: Optional[str], parent: int) -> None:
+        """Register a foreign parent span id for a trace (decoded from
+        an inbound frame's TraceContext): every span this node records
+        for that trace with no local parent links under it, joining the
+        sender's tree. No-op when propagation is off."""
+        if not (self.enabled and self.propagate) or not trace or not parent:
+            return
+        with self._lock:
+            fg = self._foreign
+            if trace not in fg and len(fg) >= _CTX_CAP:
+                fg.pop(next(iter(fg)))
+            fg[trace] = parent
+
+    def wire_context(self, txid=None, seq=None):
+        """Sender side of cross-node propagation: (trace_bytes, parent
+        span id, sampled) for an outbound frame, or None when there is
+        nothing to join (propagation off, tx unsampled, or no span
+        recorded for the trace yet). trace_bytes is the raw 32-byte
+        txid for tx traces, the UTF-8 trace id otherwise."""
+        if not (self.enabled and self.propagate):
+            return None
+        if txid is not None and not self.sampled(txid):
+            return None
+        trace = _trace_id(txid, seq)
+        if trace is None:
+            return None
+        with self._lock:
+            parent = self._last.get(trace) or self._foreign.get(trace)
+        if parent is None:
+            return None
+        if isinstance(txid, (bytes, bytearray)) and len(txid) == 32:
+            trace_bytes = bytes(txid)
+        else:
+            trace_bytes = trace.encode()
+        return trace_bytes, parent, True
+
+    @staticmethod
+    def trace_key(trace_bytes: bytes) -> Optional[str]:
+        """Receiver-side inverse of wire_context's trace encoding."""
+        if not trace_bytes:
+            return None
+        if len(trace_bytes) == 32:
+            return trace_bytes.hex()
+        try:
+            return trace_bytes.decode()
+        except UnicodeDecodeError:
+            return None
 
     # -- export ------------------------------------------------------------
 
@@ -384,6 +511,7 @@ class Tracer:
             "enabled": self.enabled,
             "capacity": self.capacity,
             "sample": self.sample,
+            "propagate": self.propagate,
             "recorded": n,
             "buffered": min(n, self.capacity),
             "dropped": max(0, n - self.capacity),
@@ -421,6 +549,8 @@ class Tracer:
             self._ring = [None] * self.capacity
             self._n = 0
             self.stage_hist = {}
+            self._foreign = {}
+            self._last = {}
 
 
 # module-level default: subsystems constructed outside a Node (unit

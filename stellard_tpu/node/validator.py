@@ -489,6 +489,16 @@ class ValidatorNode:
                 self.lm.tracer.complete(
                     "follower.ingest", "follower", t0, now, seq=ledger.seq
                 )
+            tracer = self.lm.tracer
+            if tracer.enabled:
+                # per-sampled-tx ingest evidence: the leaf every cross-
+                # node tx tree needs on the follower (deterministic
+                # sampling means the leader sampled the same txids)
+                for txid, _blob, _meta in ledger.tx_entries():
+                    tracer.instant(
+                        "follower.ingest.tx", "follower", txid=txid,
+                        ledger_seq=ledger.seq,
+                    )
         # a multi-ledger jump must hand EVERY resolvable intermediate
         # ledger to the persistence plane oldest-first, or the txdb gets
         # a permanent hole for the skipped range (unresolvable ancestors

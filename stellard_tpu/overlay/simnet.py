@@ -51,6 +51,7 @@ from .wire import (
     LedgerData,
     ProposeSet,
     SegmentData,
+    TraceContext,
     TxMessage,
     TxSetData,
     ValidationMessage,
@@ -104,10 +105,33 @@ class SimValidator(ConsensusAdapter):
             follower=follower,
         )
 
+    # -- cross-node trace propagation (no-ops while the module tracer
+    # keeps propagate=0, the simnet default — wire bytes and scorecards
+    # stay bit-identical) --------------------------------------------------
+
+    def _trace_stamp(self, msg, txid=None, seq=None) -> None:
+        ctx = self.node.lm.tracer.wire_context(txid=txid, seq=seq)
+        if ctx is not None:
+            msg.trace_ctx = TraceContext(*ctx)
+
+    def _trace_adopt(self, msg) -> None:
+        ctx = getattr(msg, "trace_ctx", None)
+        if ctx is None:
+            return
+        tracer = self.node.lm.tracer
+        if not (tracer.enabled and tracer.propagate):
+            msg.trace_ctx = None  # re-relays stay legacy bytes
+            return
+        if ctx.sampled:
+            tracer.adopt_context(tracer.trace_key(ctx.trace), ctx.parent)
+
     # -- ConsensusAdapter -------------------------------------------------
 
     def propose(self, proposal) -> None:
-        data = frame(ProposeSet.from_proposal(proposal))
+        msg = ProposeSet.from_proposal(proposal)
+        if self.node.round is not None:
+            self._trace_stamp(msg, seq=getattr(self.node.round, "seq", None))
+        data = frame(msg)
         if self.squelch is not None:
             self.net.relay_validator(
                 self.nid, proposal.node_public or self.node.key.public,
@@ -124,7 +148,9 @@ class SimValidator(ConsensusAdapter):
         return self.node.txset_cache.get(set_hash)
 
     def send_validation(self, val: STValidation) -> None:
-        data = frame(ValidationMessage(val.serialize()))
+        vmsg = ValidationMessage(val.serialize())
+        self._trace_stamp(vmsg, seq=val.ledger_seq)
+        data = frame(vmsg)
         if self.squelch is not None:
             self.net.relay_validator(
                 self.nid, val.signer or self.node.key.public, data,
@@ -157,7 +183,9 @@ class SimValidator(ConsensusAdapter):
         """Client submission: apply locally, flood to peers
         (reference: NetworkOPs::processTransaction relay tail)."""
         self.node.submit(tx)
-        self.net.broadcast(self.nid, frame(TxMessage(tx.serialize())))
+        msg = TxMessage(tx.serialize())
+        self._trace_stamp(msg, txid=tx.txid())
+        self.net.broadcast(self.nid, frame(msg))
 
     # -- delivery ---------------------------------------------------------
 
@@ -217,6 +245,7 @@ class SimValidator(ConsensusAdapter):
         for i, msg in enumerate(msgs):
             if isinstance(msg, TxMessage):
                 if i in parsed:
+                    self._trace_adopt(msg)
                     self.node.handle_tx(parsed[i])
             else:
                 self._dispatch(src, msg)
@@ -229,6 +258,7 @@ class SimValidator(ConsensusAdapter):
 
     def _dispatch(self, src: int, msg) -> None:
         node = self.node
+        self._trace_adopt(msg)
         # TxMessages are handled (parse-once + batched sig prefetch) in
         # deliver(), the only caller
         if isinstance(msg, ProposeSet):
@@ -285,6 +315,8 @@ class SimValidator(ConsensusAdapter):
         elif isinstance(msg, GetSegments):
             reply = node.serve_get_segments(msg)
             if reply is not None:
+                if msg.trace_ctx is not None:
+                    reply.trace_ctx = msg.trace_ctx
                 self.net.send(self.nid, src, frame(reply))
         elif isinstance(msg, SegmentData):
             node.handle_segment_data(src, msg)

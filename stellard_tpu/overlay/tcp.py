@@ -59,6 +59,7 @@ from .wire import (
     Ping,
     ProposeSet,
     SegmentData,
+    TraceContext,
     TxMessage,
     TxSetData,
     ValidationMessage,
@@ -909,10 +910,36 @@ class TcpOverlay(ConsensusAdapter):
         if self.node.router.get_flags(suppression_id) & SF_BAD:
             self._charge(peer, FEE_INVALID_SIGNATURE)
 
+    def _adopt_ctx(self, msg) -> None:
+        """Inbound trace-context handling (Dapper propagation): when the
+        extension is present and propagation is on, register the sender's
+        span as the foreign parent for that trace so every local span
+        joins the sender's causal tree; when propagation is off, STRIP
+        the extension so any re-relayed frame is byte-identical to the
+        legacy wire."""
+        ctx = getattr(msg, "trace_ctx", None)
+        if ctx is None:
+            return
+        tracer = self.node.lm.tracer
+        if not (tracer.enabled and tracer.propagate):
+            msg.trace_ctx = None
+            return
+        if ctx.sampled:
+            tracer.adopt_context(tracer.trace_key(ctx.trace), ctx.parent)
+
+    def _stamp_ctx(self, msg, txid=None, seq=None) -> None:
+        """Stamp an ORIGIN frame with this node's trace context. Relayed
+        frames are never restamped — every flooded copy of a message must
+        stay byte-identical so content-hash dedup keeps working."""
+        ctx = self.node.lm.tracer.wire_context(txid=txid, seq=seq)
+        if ctx is not None:
+            msg.trace_ctx = TraceContext(*ctx)
+
     def _dispatch(self, peer: _Peer, msg, parsed_tx=None) -> None:
         """reference: PeerImp message switch (PeerImp.cpp:1459-1738) —
         verify → apply → relay-if-new, charging abusive senders."""
         node = self.node
+        self._adopt_ctx(msg)
         if isinstance(msg, TxMessage):
             tx = (parsed_tx if parsed_tx is not None
                   else SerializedTransaction.from_bytes(msg.blob))
@@ -1043,6 +1070,10 @@ class TcpOverlay(ConsensusAdapter):
         elif isinstance(msg, GetSegments):
             reply = node.serve_get_segments(msg)
             if reply is not None:
+                if msg.trace_ctx is not None:
+                    # reply joins the requester's tree (its ctx survived
+                    # _adopt_ctx only when propagation is on here)
+                    reply.trace_ctx = msg.trace_ctx
                 peer.send(frame(reply))
             else:
                 self._charge(peer, FEE_REQUEST_NO_REPLY)
@@ -1214,9 +1245,12 @@ class TcpOverlay(ConsensusAdapter):
         # production peer counts a validator's origin broadcast is the
         # other O(peers) send path, and the gossip subsets carry the
         # message the rest of the way
+        msg = ProposeSet.from_proposal(proposal)
+        rnd = self.node.round
+        if rnd is not None:
+            self._stamp_ctx(msg, seq=getattr(rnd, "seq", None))
         self._relay_validator_msg(
-            ProposeSet.from_proposal(proposal), self.key.public,
-            kind="relay_proposal",
+            msg, self.key.public, kind="relay_proposal",
         )
 
     def share_tx_set(self, txset: TxSet) -> None:
@@ -1231,13 +1265,22 @@ class TcpOverlay(ConsensusAdapter):
 
     def send_validation(self, val: STValidation) -> None:
         self.node.router.set_flag(val.validation_id(), SF_RELAYED)
+        msg = ValidationMessage(val.serialize())
+        self._stamp_ctx(msg, seq=val.ledger_seq)
         self._relay_validator_msg(
-            ValidationMessage(val.serialize()), self.key.public,
-            kind="relay_validation",
+            msg, self.key.public, kind="relay_validation",
         )
 
     def relay_disputed_tx(self, blob: bytes) -> None:
-        self._broadcast(TxMessage(blob))
+        msg = TxMessage(blob)
+        if self.node.lm.tracer.propagate:
+            try:
+                self._stamp_ctx(
+                    msg, txid=SerializedTransaction.from_bytes(blob).txid()
+                )
+            except Exception:  # noqa: BLE001 — tracing never blocks a relay
+                pass
+        self._broadcast(msg)
 
     def request_ledger_data(self, msg: GetLedger) -> None:
         """Anycast to the best-scoring connected peer (reference:
@@ -1294,6 +1337,9 @@ class TcpOverlay(ConsensusAdapter):
             p = self.peers.get(peer_pub)
         if p is None or not p.alive:
             raise OSError("segment peer gone")
+        if getattr(msg, "trace_ctx", None) is None:
+            # best-effort: the catch-up trace is this node's ledger line
+            self._stamp_ctx(msg, seq=self.node.lm.closed_ledger().seq)
         p.acq_requests += 1
         p.send(frame(msg))
 
@@ -1310,14 +1356,18 @@ class TcpOverlay(ConsensusAdapter):
 
     def submit_client_tx(self, tx: SerializedTransaction) -> None:
         self.node.submit(tx)
-        self._broadcast(TxMessage(tx.serialize()))
+        msg = TxMessage(tx.serialize())
+        self._stamp_ctx(msg, txid=tx.txid())
+        self._broadcast(msg)
 
     def broadcast_tx(self, tx: SerializedTransaction, except_ids=None) -> None:
         """Relay an already-applied client tx (the NetworkOPs relay seam).
         `except_ids` is the HashRouter suppression peer-id set — peers the
         tx already arrived FROM are excluded from the fan-out (reference:
         the swapSet peer set drives exactly this exclusion)."""
-        data = frame(TxMessage(tx.serialize()))
+        msg = TxMessage(tx.serialize())
+        self._stamp_ctx(msg, txid=tx.txid())
+        data = frame(msg)
         with self._peers_lock:
             targets = [
                 p

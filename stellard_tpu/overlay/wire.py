@@ -40,6 +40,14 @@ against peers that answered a manifest request):
     SegmentData    (mt 55)  manifest reply or a verified-by-content
                             chunk of a store segment (nodestore/segstore
                             ``fetch_segment`` read door)
+
+One EXTENSION FIELD (outside ripple.proto, Dapper-style): TxMessage,
+ProposeSet, ValidationMessage, GetSegments and SegmentData may carry a
+nested ``TraceContext`` submessage at field 60 (trace id + parent span
+token + flags) so spans on different nodes join one causal tree. proto2
+parsers skip unknown fields, so a reference peer ignores it; when
+``[trace] propagate=0`` the field is never emitted and every frame is
+byte-identical to the legacy wire.
 """
 
 from __future__ import annotations
@@ -53,6 +61,8 @@ from .proto import Encoder, first, first_bytes, first_int, parse
 
 __all__ = [
     "MessageType",
+    "TraceContext",
+    "TRACE_CTX_FIELD",
     "Hello",
     "Ping",
     "TxMessage",
@@ -90,6 +100,47 @@ LI_TS_CANDIDATE = 3
 # ripple.proto TransactionStatus / TxSetStatus
 TS_CURRENT = 2
 TXSET_HAVE = 1
+
+
+# field number of the TraceContext extension submessage — high enough to
+# clear every ripple.proto field on the five messages that carry it
+TRACE_CTX_FIELD = 60
+
+
+@dataclass
+class TraceContext:
+    """Cross-node trace propagation extension (Dapper-style): the trace
+    id (raw 32-byte txid or a utf-8 trace string), the sender's span id
+    as the receiver's parent token, and a flags varint (bit0 = sampled).
+    Stamped ONCE at the origin and never restamped on relay, so every
+    relayed copy of a frame stays byte-identical (content-hash dedup)."""
+
+    trace: bytes = b""
+    parent: int = 0
+    sampled: bool = True
+
+
+def _enc_trace_ctx(e: Encoder, ctx: "TraceContext | None") -> None:
+    if ctx is None:
+        return
+    sub = Encoder().blob(1, ctx.trace).varint(2, ctx.parent)
+    sub.varint(3, 1 if ctx.sampled else 0)
+    e.message(TRACE_CTX_FIELD, sub)
+
+
+def _dec_trace_ctx(f: dict) -> "TraceContext | None":
+    raw = first(f, TRACE_CTX_FIELD)
+    if not isinstance(raw, (bytes, bytearray)):
+        return None
+    try:
+        cf = parse(bytes(raw))
+        return TraceContext(
+            trace=first_bytes(cf, 1),
+            parent=first_int(cf, 2),
+            sampled=bool(first_int(cf, 3)),
+        )
+    except ValueError:
+        return None  # malformed extension never drops the message
 
 
 class MessageType(IntEnum):
@@ -138,6 +189,7 @@ class Ping:
 @dataclass
 class TxMessage:
     blob: bytes  # serialized STTx
+    trace_ctx: "TraceContext | None" = None
 
 
 @dataclass
@@ -148,6 +200,7 @@ class ProposeSet:
     tx_set_hash: bytes
     node_public: bytes
     signature: bytes
+    trace_ctx: "TraceContext | None" = None
 
     @classmethod
     def from_proposal(cls, p: LedgerProposal) -> "ProposeSet":
@@ -174,6 +227,7 @@ class ProposeSet:
 @dataclass
 class ValidationMessage:
     blob: bytes  # serialized STValidation
+    trace_ctx: "TraceContext | None" = None
 
 
 @dataclass
@@ -254,6 +308,7 @@ class GetSegments:
 
     seg_id: int = -1
     offset: int = 0
+    trace_ctx: "TraceContext | None" = None
 
 
 @dataclass
@@ -267,6 +322,7 @@ class SegmentData:
     offset: int = 0
     data: bytes = b""
     segments: list = field(default_factory=list)  # (id, size, live, active)
+    trace_ctx: "TraceContext | None" = None
 
 
 @dataclass
@@ -318,11 +374,14 @@ def _dec_ping(buf: bytes) -> Ping:
 
 
 def _enc_tx(m: TxMessage) -> bytes:
-    return Encoder().blob(1, m.blob).varint(2, TS_CURRENT).data()
+    e = Encoder().blob(1, m.blob).varint(2, TS_CURRENT)
+    _enc_trace_ctx(e, m.trace_ctx)
+    return e.data()
 
 
 def _dec_tx(buf: bytes) -> TxMessage:
-    return TxMessage(first_bytes(parse(buf), 1))
+    f = parse(buf)
+    return TxMessage(first_bytes(f, 1), trace_ctx=_dec_trace_ctx(f))
 
 
 def _enc_propose(m: ProposeSet) -> bytes:
@@ -333,6 +392,7 @@ def _enc_propose(m: ProposeSet) -> bytes:
     e.varint(4, m.close_time)  # closeTime
     e.blob(5, m.signature)  # signature
     e.blob(6, m.prev_ledger)  # previousledger
+    _enc_trace_ctx(e, m.trace_ctx)
     return e.data()
 
 
@@ -345,15 +405,19 @@ def _dec_propose(buf: bytes) -> ProposeSet:
         tx_set_hash=first_bytes(f, 2),
         node_public=first_bytes(f, 3),
         signature=first_bytes(f, 5),
+        trace_ctx=_dec_trace_ctx(f),
     )
 
 
 def _enc_validation(m: ValidationMessage) -> bytes:
-    return Encoder().blob(1, m.blob).data()
+    e = Encoder().blob(1, m.blob)
+    _enc_trace_ctx(e, m.trace_ctx)
+    return e.data()
 
 
 def _dec_validation(buf: bytes) -> ValidationMessage:
-    return ValidationMessage(first_bytes(parse(buf), 1))
+    f = parse(buf)
+    return ValidationMessage(first_bytes(f, 1), trace_ctx=_dec_trace_ctx(f))
 
 
 def _enc_have_set(m: HaveTxSet) -> bytes:
@@ -528,15 +592,17 @@ def _dec_endpoints(buf: bytes) -> Endpoints:
 
 def _enc_get_segments(m: GetSegments) -> bytes:
     # seg_id rides +1 so the manifest sentinel (-1) stays a valid varint
-    return (
-        Encoder().varint(1, m.seg_id + 1).varint(2, m.offset).data()
-    )
+    e = Encoder().varint(1, m.seg_id + 1).varint(2, m.offset)
+    _enc_trace_ctx(e, m.trace_ctx)
+    return e.data()
 
 
 def _dec_get_segments(buf: bytes) -> GetSegments:
     f = parse(buf)
     return GetSegments(
-        seg_id=first_int(f, 1) - 1, offset=first_int(f, 2)
+        seg_id=first_int(f, 1) - 1,
+        offset=first_int(f, 2),
+        trace_ctx=_dec_trace_ctx(f),
     )
 
 
@@ -553,6 +619,7 @@ def _enc_segment_data(m: SegmentData) -> bytes:
             .varint(3, live).varint(4, 1 if active else 0)
         )
         e.message(5, row)
+    _enc_trace_ctx(e, m.trace_ctx)
     return e.data()
 
 
@@ -573,6 +640,7 @@ def _dec_segment_data(buf: bytes) -> SegmentData:
         offset=first_int(f, 3),
         data=first_bytes(f, 4, b""),
         segments=segments,
+        trace_ctx=_dec_trace_ctx(f),
     )
 
 

@@ -475,6 +475,11 @@ def do_server_state(ctx: Context) -> dict:
         state["trace"] = tracer.status_json(
             timeline=(ctx.role == Role.ADMIN)
         )
+    health = getattr(node, "health", None)
+    if health is not None:
+        # SLO watchdog verdict (node/health.py): status + reason
+        # strings are aggregate-only — safe on a GUEST-reachable method
+        state["health"] = health.get_json()
     return {"state": state}
 
 
@@ -676,6 +681,14 @@ def do_get_counts(ctx: Context) -> dict:
             out["acquisition"] = acq
     if resource:
         out["resource"] = resource
+    # SLO health plane: watchdog verdict + flight-recorder occupancy
+    # and the dump paths written this process (node/health.py)
+    health = getattr(node, "health", None)
+    if health is not None:
+        out["health"] = health.get_json()
+    flight = getattr(node, "flight", None)
+    if flight is not None:
+        out["flight"] = flight.get_json()
     return out
 
 
@@ -697,6 +710,36 @@ def do_trace_dump(ctx: Context) -> dict:
     return ctx.node.tracer.chrome_trace(
         reset=bool(ctx.params.get("reset"))
     )
+
+
+@handler("metrics_history", Role.ADMIN)
+def do_metrics_history(ctx: Context) -> dict:
+    """The embedded metric time-series ring ([insight] history_interval/
+    history_window, node/metrics.py MetricsHistory): bounded in-process
+    snapshots of every instrument, queryable without external scrape
+    infrastructure. Params: {"since": <ts>} lower-bounds snapshot wall
+    time, {"limit": N} keeps only the newest N rows."""
+    try:
+        since = float(ctx.params.get("since", 0.0))
+        limit = int(ctx.params.get("limit", 0))
+    except (TypeError, ValueError):
+        return {"error": "invalidParams"}
+    return ctx.node.collector.history_json(since=since, limit=limit)
+
+
+@handler("health", Role.ADMIN)
+def do_health(ctx: Context) -> dict:
+    """SLO watchdog verdict + flight-recorder state (node/health.py).
+    The watchdog block rides NESTED: the RPC envelope owns the top-level
+    `status` key and would clobber the health verdict."""
+    node = ctx.node
+    out: dict = {"enabled": node.health is not None}
+    if node.health is not None:
+        out["health"] = node.health.get_json()
+    flight = getattr(node, "flight", None)
+    if flight is not None:
+        out["flight"] = flight.get_json()
+    return out
 
 
 @handler("consensus_info", Role.ADMIN)

@@ -108,8 +108,40 @@ class HttpRpcServer:
                     await writer.drain()
                     return
                 body = await reader.readexactly(length) if length else b""
+                status_line = b"HTTP/1.1 200 OK\r\n"
+                ctype = b"Content-Type: application/json\r\n"
                 if request_line.startswith("GET"):
-                    payload = b'{"status": "ok"}'
+                    path = (
+                        request_line.split(" ", 2)[1]
+                        if " " in request_line else "/"
+                    )
+                    if path.split("?", 1)[0] == "/metrics":
+                        # Prometheus exposition door (text format 0.0.4,
+                        # node/metrics.py prometheus_text). Resource-
+                        # priced like any other RPC: a scraper hammering
+                        # the door charges its client balance and gets
+                        # 429 until it decays (admin IPs exempt).
+                        from .handlers import charge_rpc_client
+
+                        peer = writer.get_extra_info("peername")
+                        refused = charge_rpc_client(
+                            self.node, peer[0] if peer else "",
+                            "metrics", _role_for_peer(self.node, writer),
+                        )
+                        if refused is not None:
+                            status_line = (
+                                b"HTTP/1.1 429 Too Many Requests\r\n"
+                            )
+                            payload = b"slow down\n"
+                            ctype = b"Content-Type: text/plain\r\n"
+                        else:
+                            payload = self._metrics_payload()
+                            ctype = (
+                                b"Content-Type: text/plain; "
+                                b"version=0.0.4; charset=utf-8\r\n"
+                            )
+                    else:
+                        payload = b'{"status": "ok"}'
                 else:
                     peer = writer.get_extra_info("peername")
                     payload = json.dumps(
@@ -120,8 +152,7 @@ class HttpRpcServer:
                         )
                     ).encode()
                 writer.write(
-                    b"HTTP/1.1 200 OK\r\n"
-                    b"Content-Type: application/json\r\n"
+                    status_line + ctype
                     + f"Content-Length: {len(payload)}\r\n".encode()
                     + b"Connection: keep-alive\r\n\r\n"
                     + payload
@@ -134,6 +165,21 @@ class HttpRpcServer:
             pass
         finally:
             writer.close()
+
+    def _metrics_payload(self) -> bytes:
+        """One /metrics scrape: every collector instrument plus the
+        health verdict as a rank gauge (0=ok 1=warn 2=critical)."""
+        extra = {}
+        health = getattr(self.node, "health", None)
+        if health is not None:
+            from ..node.health import _RANK
+
+            extra["health_status"] = _RANK.get(health.status, 0)
+        try:
+            text = self.node.collector.prometheus_text(extra_gauges=extra)
+        except Exception:  # noqa: BLE001 — a scrape must not kill the door
+            text = ""
+        return text.encode("utf-8")
 
     # -- lifecycle --------------------------------------------------------
 

@@ -489,6 +489,15 @@ class SubscriptionManager:
 
             touched.update(affected_accounts(meta))
 
+        if self.tracer is not None and validated and self.tracer.enabled:
+            # per-sampled-tx fanout leaf: the publish stage of the tx's
+            # cross-node causal tree (subs.fanout spans stay the sampled
+            # per-subscriber delivery evidence)
+            self.tracer.instant(
+                "subs.fanout.tx", "publish", txid=tx.txid(),
+                ledger_seq=msg.get("ledger_index"),
+            )
+
         for sub in self._each():
             wants = False
             if validated and "transactions" in sub.streams:

@@ -39,7 +39,15 @@ from ..protocol.ter import TER
 from .schedule import FaultSchedule
 from .workloads import TxFactory, build_spec_workload
 
-__all__ = ["Scenario", "run_simnet", "apply_event", "SYNTH_BUG"]
+__all__ = [
+    "Scenario", "run_simnet", "apply_event", "SYNTH_BUG", "LAST_FLIGHT",
+]
+
+# the most recent run's flight recorder (node/health.py FlightRecorder,
+# fed by the scorecard health watchdog): the search plane dumps it next
+# to a corpus entry when a run violates invariants, so every repro
+# ships its black box. Single-slot list — never enters the scorecard.
+LAST_FLIGHT: list = []
 
 # Test-only planted bug (the fuzz gate's ground truth): while armed,
 # every replayed `synth_plant` fault event accumulates its magnitude on
@@ -755,6 +763,43 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
     gate_of: dict = {}
     retry_q: list = []
     cur_step = [0]
+
+    # SLO health dimension (node/health.py): a watchdog on VIRTUAL
+    # step-time over the watch validator's close cadence — status is a
+    # pure function of the replayed schedule, so the scorecard block is
+    # deterministic per seed. The search plane's health invariants gate
+    # on the (max observed gap, worst status) pair: an injected stall
+    # must trip it, a clean run must not.
+    from ..node.health import _RANK, FlightRecorder, HealthWatchdog
+
+    health_flight = FlightRecorder(spans_cap=512)
+    idle = float(max(1, scn.idle_interval))
+    health_stall_warn = 10.0 * idle
+    hw = HealthWatchdog(
+        target_close_s=idle,
+        stall_warn_s=health_stall_warn,
+        stall_crit_s=30.0 * idle,
+        drift_factor=8.0,
+        clock=lambda: float(cur_step[0]),
+        flight=health_flight,
+    )
+    health_state = {"worst": "ok", "last": None, "max_gap": 0}
+
+    def _health_close(led):
+        now = cur_step[0]
+        if health_state["last"] is not None:
+            gap = now - health_state["last"]
+            if gap > health_state["max_gap"]:
+                health_state["max_gap"] = gap
+        health_state["last"] = now
+        hw.note_close(led.seq, ts=float(now))
+
+    watch.node.on_ledger.append(_health_close)
+
+    def _health_tick():
+        st = hw.evaluate()
+        if _RANK[st] > _RANK[health_state["worst"]]:
+            health_state["worst"] = st
     if txqs:
         # the client also RESUBMITS a tx the queue dropped (evicted /
         # expired while consensus stalled) — the product signals this
@@ -799,6 +844,7 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
                 if not net.is_down(fp.nid):
                     fp.act(step)
             net.step()
+            _health_tick()
 
         # drain the remaining schedule (heals/revives past the horizon)
         for ev in sorted(
@@ -877,6 +923,7 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
             _drain_client_retries(net, txqs, retry_q, scn.steps + tail,
                                   admissions, gate_of)
             net.step()
+            _health_tick()
             tail += 1
         converged = min(_hseqs()) >= target
         common = min(_hseqs())
@@ -929,6 +976,17 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
             # search plane's invariant registry gates on it)
             "fork_seqs": _fork_seqs(net, honest, common),
         }
+        # SLO health dimension: deterministic ints/strings only (the
+        # search plane's health_missed_stall / health_false_positive
+        # invariants read the gap/worst pair)
+        card["health"] = {
+            "worst": health_state["worst"],
+            "final": hw.status,
+            "transitions": hw.transitions,
+            "max_close_gap_steps": int(health_state["max_gap"]),
+            "stall_warn_steps": int(health_stall_warn),
+        }
+        LAST_FLIGHT[:] = [health_flight]
         planted = getattr(net, "synth_planted", 0)
         if planted:
             card["synth"] = {"planted": planted}

@@ -386,6 +386,32 @@ def check_invariants(
                 "anti_vacuity", f"flooder {nid} emitted nothing"
             ))
 
+    # (7) SLO watchdog wiring (ISSUE 18): a close-cadence stall the run
+    # OBSERVED (max gap past the warn line) must trip the health
+    # dimension — a watchdog that sleeps through an injected stall is
+    # vacuous; and with no faults injected a run must stay ok — a
+    # watchdog that cries on a clean run is noise, not observability
+    h = card.get("health") or {}
+    if h:
+        gap = h.get("max_close_gap_steps", 0)
+        warn_at = h.get("stall_warn_steps", 0)
+        if warn_at and gap > warn_at and h.get("worst") == "ok":
+            v.append(Violation(
+                "health_missed_stall",
+                f"close gap {gap} steps > warn line {warn_at} but "
+                f"health stayed ok",
+            ))
+        faultless = (
+            not ev_kinds and scn.build_schedule is None
+            and not scn.byzantine and not scn.flooders
+            and not scn.cold_nodes and scn.kill_server_at is None
+        )
+        if faultless and h.get("worst", "ok") != "ok":
+            v.append(Violation(
+                "health_false_positive",
+                f"health hit {h.get('worst')} with no injected faults",
+            ))
+
     # dedup (anti-vacuity can repeat), order-preserving
     seen = set()
     out = []
@@ -918,14 +944,16 @@ def shrink_scenario(
 # -- corpus ---------------------------------------------------------------
 
 def corpus_entry(scn: Scenario, violation: Violation,
-                 found: dict, expect: str = "pass") -> dict:
+                 found: dict, expect: str = "pass",
+                 flight_dump: Optional[str] = None) -> dict:
     """A corpus entry: the shrunk data-form scenario plus provenance.
     `expect` records the entry's contract under replay — "pass" for a
     fixed bug pinned as a regression, "violation" for a live repro
     (only the planted synthetic bug ships that way, and only inside
-    the armed smoke)."""
+    the armed smoke). `flight_dump` references the violating run's
+    flight-recorder black box on disk (node/health.py)."""
     name = f"fuzz_{violation.invariant}_{scn.digest()[:8]}"
-    return {
+    entry = {
         "corpus_format": 1,
         "name": name,
         "invariant": violation.invariant,
@@ -934,6 +962,25 @@ def corpus_entry(scn: Scenario, violation: Violation,
         "expect": expect,
         "scenario": scn.to_json(),
     }
+    if flight_dump:
+        entry["flight_dump"] = flight_dump
+    return entry
+
+
+def _dump_violation_flight(scn: Scenario, violation: Violation) -> Optional[str]:
+    """Ship the violating run's black box (the most recent run_simnet's
+    FlightRecorder) to a stable temp location; -> path or None."""
+    from .scenario import LAST_FLIGHT
+
+    rec = LAST_FLIGHT[0] if LAST_FLIGHT else None
+    if rec is None:
+        return None
+    import tempfile
+
+    d = os.path.join(tempfile.gettempdir(), "stellard-flight")
+    return rec.dump(
+        f"fuzz-{violation.invariant}-{scn.digest()[:8]}", directory=d
+    )
 
 
 def write_corpus_entry(entry: dict, corpus_dir: str) -> str:
@@ -1020,6 +1067,9 @@ def sweep(
             elif parent is not None:
                 parent["energy"] = max(1, parent["energy"] - 1)
             viols = check_invariants(scn, card, recard)
+            flight_path = (
+                _dump_violation_flight(scn, viols[0]) if viols else None
+            )
             # one record per invariant CLASS per run: recording only
             # the first would let an armed synth_plant violation (always
             # ordered first) mask a co-occurring REAL violation from
@@ -1035,6 +1085,8 @@ def sweep(
                     "detail": v.detail,
                     "scenario": scn.to_json(),
                 }
+                if flight_path:
+                    rec["flight_dump"] = flight_path
                 # shrink budget: one full shrink per invariant NAME per
                 # sweep — later repros of the same class are recorded
                 # raw (the first minimal entry is the regression pin)
@@ -1052,6 +1104,7 @@ def sweep(
                         minimal, v,
                         found={"fuzz_seed": fuzz_seed, "iteration": i},
                         expect="pass",
+                        flight_dump=flight_path,
                     )
                 violations.append(rec)
             if on_progress is not None:

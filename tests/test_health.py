@@ -1,0 +1,284 @@
+"""SLO health watchdog + flight recorder (PR 18 tentpole legs 3/4).
+
+Every rule is exercised with crafted feeds/snapshots and a virtual
+clock, so each verdict is deterministic:
+
+- close cadence: stall warn/crit lines and the EWMA drift rule;
+- validation lag warn/crit, and the closed>=validated ordering
+  invariant under the note_validated feed;
+- fanout delivery p99 over registered latency hists;
+- routing flips counted as window deltas via on_snapshot;
+- cache hit collapse — ONLY with real traffic (the volume guard: a
+  fresh cache with hit_rate=0 is silent, not sick);
+- persist backlog gauges;
+- no data at all => ok (rules without evidence report nothing — the
+  anti-vacuity gate lives in the scenario fuzzer, tests/test_search.py);
+- transitions: counted once per status change, on_transition observers
+  fire, `health.*` tracer instants land, the flight recorder keeps the
+  transition;
+- FlightRecorder: bounded deques, atomic dump (valid JSON, no .tmp
+  litter, path recorded in .dumps), unwritable directory returns None
+  instead of raising.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from stellard_tpu.node.health import (
+    HEALTH_CRITICAL,
+    HEALTH_OK,
+    HEALTH_WARN,
+    FlightRecorder,
+    HealthWatchdog,
+)
+from stellard_tpu.node.tracer import Tracer
+
+
+def _wd(**kw):
+    """Watchdog on a virtual clock the test advances by hand."""
+    clock = [0.0]
+    kw.setdefault("target_close_s", 1.0)
+    kw.setdefault("stall_warn_s", 10.0)
+    kw.setdefault("stall_crit_s", 30.0)
+    wd = HealthWatchdog(clock=lambda: clock[0], **kw)
+    return wd, clock
+
+
+class TestCadenceRules:
+    def test_no_data_is_ok(self):
+        wd, clock = _wd()
+        clock[0] = 1000.0  # however late, silence is not a stall
+        assert wd.evaluate() == HEALTH_OK
+        assert wd.reasons == []
+
+    def test_stall_warn_then_critical(self):
+        # drift_factor parked high: this test isolates the stall lines,
+        # and the 31s recovery gap would legitimately trip the EWMA rule
+        wd, clock = _wd(drift_factor=100.0)
+        wd.note_close(1)
+        clock[0] = 5.0
+        assert wd.evaluate() == HEALTH_OK
+        clock[0] = 11.0
+        assert wd.evaluate() == HEALTH_WARN
+        assert any(r.startswith("close_stall") for r in wd.reasons)
+        clock[0] = 31.0
+        assert wd.evaluate() == HEALTH_CRITICAL
+        # recovery: a close clears the stall on the next evaluation
+        wd.note_close(2)
+        clock[0] = 32.0
+        assert wd.evaluate() == HEALTH_OK
+
+    def test_ewma_drift_trips_before_stall(self):
+        wd, clock = _wd(drift_factor=2.5)
+        # steady closes at 4x the 1s target: each gap is under the 10s
+        # stall line but the EWMA settles near 4s > 2.5 x 1s
+        for i in range(10):
+            clock[0] = i * 4.0
+            wd.note_close(i + 1)
+        clock[0] += 1.0
+        assert wd.evaluate() == HEALTH_WARN
+        assert any(r.startswith("close_drift") for r in wd.reasons)
+
+    def test_on_target_cadence_stays_ok(self):
+        wd, clock = _wd(drift_factor=2.5)
+        for i in range(20):
+            clock[0] = i * 1.0
+            wd.note_close(i + 1)
+        clock[0] += 0.5
+        assert wd.evaluate() == HEALTH_OK
+
+
+class TestLagRule:
+    def test_validation_lag_warn_and_crit(self):
+        wd, _ = _wd(lag_warn=4, lag_crit=16)
+        wd.note_seqs(closed=10, validated=8)
+        assert wd.evaluate() == HEALTH_OK
+        wd.note_seqs(closed=13, validated=8)
+        assert wd.evaluate() == HEALTH_WARN
+        assert wd.reasons == ["validation_lag:5"]
+        wd.note_seqs(closed=40, validated=8)
+        assert wd.evaluate() == HEALTH_CRITICAL
+
+    def test_zero_validated_never_lags(self):
+        # a node that has never seen a validation (bootstrap) is silent
+        wd, _ = _wd()
+        wd.note_seqs(closed=100, validated=0)
+        assert wd.evaluate() == HEALTH_OK
+
+    def test_note_validated_keeps_pair_ordered(self):
+        wd, _ = _wd()
+        wd.note_validated(7)  # validated implies closed
+        assert wd.get_json()["closed_seq"] == 7
+        assert wd.get_json()["validated_seq"] == 7
+        wd.note_validated(5)  # never regresses
+        assert wd.get_json()["validated_seq"] == 7
+        assert wd.evaluate() == HEALTH_OK
+
+
+class TestSnapshotRules:
+    def test_fanout_p99(self):
+        wd, _ = _wd(fanout_p99_warn_ms=250.0)
+        snap = {"hists": {"subs.fanout_lag": {"count": 50, "p99_ms": 400.0}}}
+        assert wd.evaluate(snap=snap) == HEALTH_WARN
+        assert wd.reasons == ["fanout_p99:subs.fanout_lag=400ms"]
+        # an empty hist (count 0) reports nothing
+        snap = {"hists": {"subs.fanout_lag": {"count": 0, "p99_ms": 400.0}}}
+        assert wd.evaluate(snap=snap) == HEALTH_OK
+        # unrelated hists are ignored no matter the p99
+        snap = {"hists": {"close.pipeline": {"count": 9, "p99_ms": 9000.0}}}
+        assert wd.evaluate(snap=snap) == HEALTH_OK
+
+    def test_routing_flips_window_deltas(self):
+        wd, _ = _wd(flips_warn=8)
+        # flips arrive as cumulative counters: the rule fires on the
+        # windowed DELTA sum, not the lifetime value
+        wd.on_snapshot({"ts": 1.0, "counters": {},
+                        "hooks": {"verify_routing.flips": 0}})
+        assert wd.status == HEALTH_OK
+        wd.on_snapshot({"ts": 2.0, "counters": {},
+                        "hooks": {"verify_routing.flips": 12}})
+        assert wd.status == HEALTH_WARN
+        assert wd.reasons == ["routing_flips:12"]
+
+    def test_flips_counter_name_variant(self):
+        wd, _ = _wd(flips_warn=2)
+        wd.on_snapshot({"ts": 1.0, "counters": {"hash.routing_flip": 0}})
+        wd.on_snapshot({"ts": 2.0, "counters": {"hash.routing_flip": 5}})
+        assert wd.status == HEALTH_WARN
+
+    def test_cache_collapse_needs_traffic(self):
+        wd, _ = _wd(cache_hit_warn=0.10)
+        # fresh cache: zero hit rate, zero traffic -> silent
+        snap = {"gauges": {}, "hooks": {"cache.hit_rate": 0.0,
+                                        "cache.hits": 0,
+                                        "cache.misses": 3}}
+        assert wd.evaluate(snap=snap) == HEALTH_OK
+        # same rate with real traffic -> collapse
+        snap = {"gauges": {}, "hooks": {"cache.hit_rate": 0.02,
+                                        "cache.hits": 4,
+                                        "cache.misses": 196}}
+        assert wd.evaluate(snap=snap) == HEALTH_WARN
+        assert wd.reasons == ["cache_collapse:cache.hit_rate=0.02"]
+        # healthy rate with traffic -> ok
+        snap = {"gauges": {}, "hooks": {"cache.hit_rate": 0.9,
+                                        "cache.hits": 900,
+                                        "cache.misses": 100}}
+        assert wd.evaluate(snap=snap) == HEALTH_OK
+
+    def test_persist_backlog(self):
+        wd, _ = _wd(persist_depth_warn=512.0)
+        snap = {"gauges": {"persist.queue_depth": 513.0}}
+        assert wd.evaluate(snap=snap) == HEALTH_WARN
+        snap = {"gauges": {"persist.queue_depth": 12.0}}
+        assert wd.evaluate(snap=snap) == HEALTH_OK
+
+    def test_worst_rule_wins(self):
+        wd, clock = _wd()
+        wd.note_close(1)
+        clock[0] = 31.0  # critical stall
+        snap = {"gauges": {"persist.queue_depth": 9999.0}}  # plus a warn
+        assert wd.evaluate(snap=snap) == HEALTH_CRITICAL
+        assert len(wd.reasons) == 2
+
+
+class TestTransitions:
+    def test_transition_accounting_and_observers(self):
+        flight = FlightRecorder(spans_cap=64)
+        tracer = Tracer(enabled=True, sample=1.0)
+        clock = [0.0]
+        wd = HealthWatchdog(stall_warn_s=10.0, stall_crit_s=30.0,
+                            drift_factor=100.0,
+                            tracer=tracer, flight=flight,
+                            clock=lambda: clock[0])
+        seen = []
+        wd.on_transition.append(lambda old, new, rs: seen.append((old, new)))
+        wd.note_close(1)
+        assert wd.evaluate() == HEALTH_OK
+        assert wd.transitions == 0
+        clock[0] = 11.0
+        wd.evaluate()
+        clock[0] = 12.0
+        wd.evaluate()  # still warn: NOT a second transition
+        assert wd.transitions == 1
+        assert seen == [(HEALTH_OK, HEALTH_WARN)]
+        wd.note_close(2)
+        clock[0] = 13.0
+        wd.evaluate()
+        assert wd.transitions == 2
+        assert seen == [(HEALTH_OK, HEALTH_WARN), (HEALTH_WARN, HEALTH_OK)]
+        # each transition left a health.* tracer instant...
+        names = [e["name"] for e in tracer.chrome_trace()["traceEvents"]]
+        assert "health.warn" in names and "health.ok" in names
+        # ...and a flight-recorder transition record
+        assert flight.get_json()["transitions"] == 2
+
+    def test_observer_exception_never_breaks_watchdog(self):
+        wd, clock = _wd()
+        wd.on_transition.append(lambda *_a: 1 / 0)
+        wd.note_close(1)
+        clock[0] = 11.0
+        assert wd.evaluate() == HEALTH_WARN  # no raise
+
+    def test_get_json_shape(self):
+        wd, clock = _wd()
+        wd.note_close(3)
+        clock[0] = 2.0
+        wd.note_close(4)
+        wd.evaluate()
+        j = wd.get_json()
+        assert j["status"] == HEALTH_OK
+        assert j["closed_seq"] == 4
+        assert j["evaluations"] == 1
+        assert j["ewma_close_gap_s"] == 2.0
+
+
+class TestFlightRecorder:
+    def test_bounded_feeds(self):
+        fr = FlightRecorder(spans_cap=16, events_cap=4)
+        for i in range(1000):
+            fr.note_span("X", f"s{i}", "tx", None, 1.0)
+            fr.note_transition("warn", ["r"], float(i))
+        p = fr.payload("test")
+        assert len(p["spans"]) == 16
+        assert len(p["health_transitions"]) == 4
+        # newest survive
+        assert p["spans"][-1][2] == "s999"
+
+    def test_dump_atomic_valid_json(self, tmp_path):
+        fr = FlightRecorder(directory=str(tmp_path), spans_cap=32)
+        fr.note_span("X", "close.pipeline", "ledger", "ledger-7", 12.5)
+        fr.note_transition("critical", ["close_stall:31.0s>30s"], 31.0)
+        fr.note_counters({"ts": 31.0, "counters": {"close.count": 7}})
+        path = fr.dump("degraded-tracking")
+        assert path is not None and os.path.exists(path)
+        assert fr.dumps == [path]
+        assert "degraded-tracking" in os.path.basename(path)
+        with open(path, encoding="utf-8") as f:
+            obj = json.load(f)  # complete, parseable JSON
+        assert obj["reason"] == "degraded-tracking"
+        assert obj["spans"][-1][2] == "close.pipeline"
+        assert obj["health_transitions"][0][1] == "critical"
+        assert obj["counter_snapshots"][0]["counters"]["close.count"] == 7
+        # no torn temp files left behind
+        assert [p for p in os.listdir(tmp_path) if p.endswith(".tmp")] == []
+
+    def test_dump_reason_sanitized_and_numbered(self, tmp_path):
+        fr = FlightRecorder(directory=str(tmp_path))
+        p1 = fr.dump("crash: /dev/null !!")
+        p2 = fr.dump("crash: /dev/null !!")
+        assert p1 != p2  # numbered, never overwrites
+        assert "/" not in os.path.basename(p1).replace("flight-", "", 1)
+        assert fr.dumps == [p1, p2]
+
+    def test_unwritable_directory_returns_none(self):
+        fr = FlightRecorder(directory="/proc/definitely-not-writable")
+        assert fr.dump("crash") is None
+        assert fr.dumps == []
+
+    def test_get_json_counts(self):
+        fr = FlightRecorder(spans_cap=16)
+        fr.note_span("i", "health.warn", "health", None, 0.0)
+        j = fr.get_json()
+        assert j == {"spans": 1, "transitions": 0, "dumps": []}

@@ -1,0 +1,236 @@
+"""Embedded metrics history (Monarch-style in-system time series) and
+the Prometheus exposition door.
+
+Contracts under test (PR 18 tentpole leg 2):
+
+- MetricsHistory is a BOUNDED ring: capacity = window/interval fixed at
+  construction, memory never grows past it no matter how long the node
+  runs; eviction accounting (appended - rows) is exact;
+- snapshots are monotone for counters/meters across flush_once() —
+  flushing drains a meter's interval count but never its cumulative
+  total, so the history never shows a counter going backwards;
+- Prometheus text format 0.0.4: legal metric names from dotted insight
+  names, HELP escaping, histogram buckets CUMULATIVE with a +Inf bucket
+  equal to _count;
+- copy-on-read: a rows() result taken mid-append is immutable — a
+  reader holding it is unaffected by concurrent sampling;
+- history_json / metrics_history RPC shape, since/limit filters.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from stellard_tpu.node.metrics import (
+    CollectorManager,
+    LatencyHist,
+    MetricsHistory,
+    NullCollector,
+    prometheus_escape_help,
+    prometheus_escape_label,
+    prometheus_name,
+)
+
+
+class TestHistoryRing:
+    def test_capacity_is_window_over_interval(self):
+        h = MetricsHistory(interval=5.0, window=300.0)
+        assert h.capacity == 60
+        tiny = MetricsHistory(interval=10.0, window=1.0)  # window < interval
+        assert tiny.capacity == 2  # floor: at least two rows
+
+    def test_bounded_under_long_runs(self):
+        h = MetricsHistory(interval=1.0, window=10.0)
+        for i in range(10_000):
+            h.append({"ts": float(i), "counters": {"n": i}})
+        rows = h.rows()
+        assert len(rows) == h.capacity == 10
+        # the ring kept the NEWEST rows and the eviction count is exact
+        assert [r["ts"] for r in rows] == [float(i) for i in range(9990, 10000)]
+        assert h.appended == 10_000
+        j = h.get_json()
+        assert j["rows"] == 10 and j["appended"] == 10_000
+
+    def test_since_and_limit_filters(self):
+        h = MetricsHistory(interval=1.0, window=100.0)
+        for i in range(20):
+            h.append({"ts": float(i)})
+        assert [r["ts"] for r in h.rows(since=15.0)] == [15.0, 16.0, 17.0,
+                                                         18.0, 19.0]
+        assert [r["ts"] for r in h.rows(limit=3)] == [17.0, 18.0, 19.0]
+        assert [r["ts"] for r in h.rows(since=10.0, limit=2)] == [18.0, 19.0]
+
+    def test_copy_on_read_under_concurrent_append(self):
+        h = MetricsHistory(interval=1.0, window=50.0)
+        for i in range(50):
+            h.append({"ts": float(i)})
+        held = h.rows()
+        stop = threading.Event()
+
+        def writer():
+            i = 50
+            while not stop.is_set():
+                h.append({"ts": float(i)})
+                i += 1
+
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            snapshot = list(held)
+            for _ in range(200):
+                assert held == snapshot  # a held result never mutates
+        finally:
+            stop.set()
+            t.join()
+
+
+class TestSnapshotMonotonicity:
+    def test_counters_and_meters_survive_flush_drain(self):
+        cm = CollectorManager(collector=NullCollector())
+        c = cm.counter("close.count")
+        m = cm.meter("tx.applied")
+        c.inc(3)
+        m.mark(7)
+        before = cm.instruments_snapshot()
+        lines = cm.flush_once()  # drains the meter's interval count
+        assert any(line.startswith("tx.applied:7|c") for line in lines)
+        c.inc(1)
+        m.mark(2)
+        after = cm.instruments_snapshot()
+        # cumulative view is monotone across the drain
+        assert before["counters"]["close.count"] == 3
+        assert after["counters"]["close.count"] == 4
+        assert before["counters"]["tx.applied"] == 7
+        assert after["counters"]["tx.applied"] == 9
+        cm.stop()
+
+    def test_sample_history_stamps_ts_and_notifies(self):
+        cm = CollectorManager(collector=NullCollector())
+        cm.enable_history(interval=1.0, window=10.0)
+        cm.counter("a").inc(5)
+        seen = []
+        cm.on_sample(seen.append)
+        snap = cm.sample_history(now=123.5)
+        assert snap["ts"] == 123.5
+        assert snap["counters"]["a"] == 5
+        assert seen == [snap]
+        assert cm.history.rows()[-1] is snap
+        cm.stop()
+
+    def test_history_series_monotone_across_flushes(self):
+        cm = CollectorManager(collector=NullCollector())
+        cm.enable_history(interval=1.0, window=100.0)
+        m = cm.meter("fanout.delivered")
+        for step in range(1, 6):
+            m.mark(10)
+            cm.flush_once()  # drain between every sample
+            cm.sample_history(now=float(step))
+        series = [r["counters"]["fanout.delivered"]
+                  for r in cm.history.rows()]
+        assert series == [10, 20, 30, 40, 50]
+        assert series == sorted(series)
+        cm.stop()
+
+    def test_history_json_shape(self):
+        cm = CollectorManager(collector=NullCollector())
+        assert cm.history_json() == {"enabled": False, "rows": []}
+        cm.enable_history(interval=2.0, window=20.0)
+        cm.gauge("depth").set(4)
+        cm.sample_history(now=1.0)
+        cm.sample_history(now=3.0)
+        j = cm.history_json(since=2.0)
+        assert j["enabled"] is True
+        assert j["capacity"] == 10 and j["appended"] == 2
+        assert [r["ts"] for r in j["series"]] == [3.0]
+        assert j["series"][0]["gauges"]["depth"] == 4
+        cm.stop()
+
+
+class TestPrometheusExposition:
+    def test_name_mangling(self):
+        assert prometheus_name("close.pipeline.p50-ms") == (
+            "close_pipeline_p50_ms"
+        )
+        assert prometheus_name("9lives") == "_lives"
+        assert prometheus_name("") == "_"
+
+    def test_help_and_label_escaping(self):
+        assert prometheus_escape_help("a\\b\nc") == "a\\\\b\\nc"
+        assert prometheus_escape_label('say "hi"\n') == 'say \\"hi\\"\\n'
+
+    def test_exposition_types_and_values(self):
+        cm = CollectorManager(collector=NullCollector())
+        cm.counter("tx.count").inc(12)
+        cm.gauge("queue.depth").set(3.5)
+        cm.hook("cache", lambda: {"hit_rate": 0.75})
+        text = cm.prometheus_text(extra_gauges={"health_status": 1})
+        lines = text.splitlines()
+        assert "# TYPE stellard_tx_count counter" in lines
+        assert "stellard_tx_count 12" in lines
+        assert "# TYPE stellard_queue_depth gauge" in lines
+        assert "stellard_queue_depth 3.5" in lines
+        assert "stellard_cache_hit_rate 0.75" in lines
+        assert "stellard_health_status 1" in lines
+        assert text.endswith("\n")  # 0.0.4: final line feed required
+        cm.stop()
+
+    def test_histogram_buckets_cumulative_inf_equals_count(self):
+        cm = CollectorManager(collector=NullCollector())
+        h = LatencyHist(bounds=(1.0, 10.0, 100.0))
+        for ms in (0.5, 0.7, 5.0, 50.0, 5000.0):
+            h.record(ms)
+        cm.register_hist("close.ms", h)
+        lines = cm.prometheus_text().splitlines()
+        assert "# TYPE stellard_close_ms histogram" in lines
+
+        def bucket(le):
+            row = [ln for ln in lines
+                   if ln.startswith(f'stellard_close_ms_bucket{{le="{le}"}}')]
+            return int(row[0].rsplit(" ", 1)[1])
+
+        counts = [bucket("1"), bucket("10"), bucket("100"), bucket("+Inf")]
+        assert counts == sorted(counts)  # cumulative => monotone
+        assert counts == [2, 3, 4, 5]
+        count_row = [ln for ln in lines
+                     if ln.startswith("stellard_close_ms_count ")][0]
+        assert int(count_row.rsplit(" ", 1)[1]) == counts[-1] == 5
+        sum_row = [ln for ln in lines
+                   if ln.startswith("stellard_close_ms_sum ")][0]
+        assert float(sum_row.rsplit(" ", 1)[1]) > 0
+        cm.stop()
+
+    def test_scrape_safe_under_concurrent_flush(self):
+        cm = CollectorManager(collector=NullCollector())
+        m = cm.meter("tx.relayed")
+        cm.enable_history(interval=0.1, window=1.0)
+        stop = threading.Event()
+        errors = []
+
+        def churn():
+            i = 0
+            try:
+                while not stop.is_set():
+                    m.mark(3)
+                    cm.flush_once()
+                    cm.sample_history(now=float(i))
+                    i += 1
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        t = threading.Thread(target=churn)
+        t.start()
+        try:
+            last = -1
+            for _ in range(100):
+                text = cm.prometheus_text()
+                row = [ln for ln in text.splitlines()
+                       if ln.startswith("stellard_tx_relayed ")]
+                if row:
+                    v = int(row[0].rsplit(" ", 1)[1])
+                    assert v >= last  # cumulative across scrapes
+                    last = v
+        finally:
+            stop.set()
+            t.join()
+        assert errors == []
+        cm.stop()

@@ -1,0 +1,268 @@
+"""Cross-node trace propagation: the wire extension and the join rules.
+
+The contract under test (PR 18 tentpole leg 1):
+
+- the five overlay messages that move transaction causality between
+  nodes (TxMessage, ProposeSet, ValidationMessage, GetSegments,
+  SegmentData) round-trip a TraceContext extension at proto field 60;
+- a frame WITHOUT the extension is byte-identical to the legacy wire —
+  `[trace] propagate=0` (or an unsampled tx) produces exactly the bytes
+  a pre-extension peer produced, pinned byte-for-byte;
+- a malformed extension never drops the message (protobuf tolerance);
+- sender/receiver tracers join one causal tree: wire_context() exports
+  (trace, parent span id, sampled), adopt_context() links every
+  subsequent local span under the foreign parent with `remote: 1`;
+- span ids are node-unique (node_tag high bits), so N dumps merge with
+  NO id remapping: tools/traceview.py merge_dumps + validate_merged_trace
+  accept a 3-process chain as one single-rooted tree;
+- the sampling decision is a pure function of (txid, rate): every node
+  agrees, so a sampled tx gets its whole cross-node tree and an
+  unsampled one contributes nothing anywhere.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from traceview import (  # noqa: E402
+    merge_dumps,
+    validate_chrome_trace,
+    validate_merged_trace,
+    validate_span_trees,
+)
+
+from stellard_tpu.node.tracer import Tracer  # noqa: E402
+from stellard_tpu.overlay.proto import Encoder, first, parse  # noqa: E402
+from stellard_tpu.overlay.wire import (  # noqa: E402
+    TRACE_CTX_FIELD,
+    GetSegments,
+    MessageType,
+    ProposeSet,
+    SegmentData,
+    TraceContext,
+    TxMessage,
+    ValidationMessage,
+    decode_message,
+    encode_message,
+)
+
+TXID = bytes(range(32))
+CTX = TraceContext(trace=TXID, parent=(7 << 32) | 42, sampled=True)
+
+
+def _carriers(ctx):
+    """One instance of each trace-carrying message, ctx attached."""
+    return [
+        (MessageType.TRANSACTION,
+         TxMessage(b"\x01" * 40, trace_ctx=ctx)),
+        (MessageType.PROPOSE_SET,
+         ProposeSet(3, 1234, b"\x02" * 32, b"\x03" * 32, b"\x04" * 33,
+                    b"\x05" * 64, trace_ctx=ctx)),
+        (MessageType.VALIDATION,
+         ValidationMessage(b"\x06" * 50, trace_ctx=ctx)),
+        (MessageType.GET_SEGMENTS,
+         GetSegments(seg_id=2, offset=4096, trace_ctx=ctx)),
+        (MessageType.SEGMENT_DATA,
+         SegmentData(seg_id=2, total=9000, offset=4096, data=b"\x07" * 128,
+                     segments=[(0, 10, 5, True)], trace_ctx=ctx)),
+    ]
+
+
+class TestWireRoundTrip:
+    def test_ctx_round_trips_on_all_five_carriers(self):
+        for mt, msg in _carriers(CTX):
+            got = decode_message(int(mt), encode_message(msg))
+            assert got.trace_ctx is not None, type(msg).__name__
+            assert got.trace_ctx.trace == TXID
+            assert got.trace_ctx.parent == CTX.parent
+            assert got.trace_ctx.sampled is True
+
+    def test_unsampled_bit_round_trips(self):
+        ctx = TraceContext(trace=b"ledger-9", parent=5, sampled=False)
+        got = decode_message(
+            int(MessageType.TRANSACTION),
+            encode_message(TxMessage(b"x", trace_ctx=ctx)),
+        )
+        assert got.trace_ctx.sampled is False
+        assert got.trace_ctx.trace == b"ledger-9"
+
+    def test_propagate_off_is_byte_identical_legacy_wire(self):
+        """The propagate=0 pin: a message with no ctx encodes to exactly
+        the bytes the pre-extension encoder produced — field 60 absent,
+        and stripping a received ctx restores the legacy bytes."""
+        for mt, msg in _carriers(CTX):
+            bare = type(msg)(**{
+                f: getattr(msg, f)
+                for f in msg.__dataclass_fields__ if f != "trace_ctx"
+            })
+            legacy = encode_message(bare)
+            assert first(parse(legacy), TRACE_CTX_FIELD) is None
+            traced = encode_message(msg)
+            assert traced != legacy
+            assert first(parse(traced), TRACE_CTX_FIELD) is not None
+            # decode-then-strip round-trips back to the legacy bytes
+            got = decode_message(int(mt), traced)
+            got.trace_ctx = None
+            assert encode_message(got) == legacy, type(msg).__name__
+
+    def test_malformed_ctx_never_drops_the_message(self):
+        e = Encoder().blob(1, b"\xaa" * 40).varint(2, 2)
+        e.blob(TRACE_CTX_FIELD, b"\xff\xff\xff")  # not a valid submessage
+        got = decode_message(int(MessageType.TRANSACTION), e.data())
+        assert got is not None
+        assert got.blob == b"\xaa" * 40
+        assert got.trace_ctx is None
+
+
+class TestTracerPropagation:
+    def test_wire_context_requires_propagate(self):
+        t = Tracer(enabled=True, sample=1.0, propagate=False, node_tag=1)
+        with t.span("verify", "tx", txid=TXID):
+            pass
+        assert t.wire_context(txid=TXID) is None
+
+    def test_wire_context_requires_sampled(self):
+        t = Tracer(enabled=True, sample=0.0, propagate=True, node_tag=1)
+        t.instant("relay", "tx", txid=TXID)
+        assert t.wire_context(txid=TXID) is None
+
+    def test_wire_context_exports_last_span(self):
+        t = Tracer(enabled=True, sample=1.0, propagate=True, node_tag=9)
+        assert t.wire_context(txid=TXID) is None  # nothing recorded yet
+        with t.span("verify", "tx", txid=TXID):
+            pass
+        ctx = t.wire_context(txid=TXID)
+        assert ctx is not None
+        trace_bytes, parent, sampled = ctx
+        assert trace_bytes == TXID  # raw 32-byte txid, not hex
+        assert parent >> 32 == 9  # node_tag rides the high bits
+        assert sampled is True
+
+    def test_adopt_links_foreign_parent_with_remote_mark(self):
+        a = Tracer(enabled=True, sample=1.0, propagate=True, node_tag=1)
+        b = Tracer(enabled=True, sample=1.0, propagate=True, node_tag=2)
+        with a.span("submit", "tx", txid=TXID):
+            pass
+        tb, parent, _ = a.wire_context(txid=TXID)
+        b.adopt_context(Tracer.trace_key(tb), parent)
+        with b.span("relay_ingest", "tx", txid=TXID):
+            pass
+        ev = [e for e in b.chrome_trace()["traceEvents"]
+              if e["name"] == "relay_ingest"][0]
+        assert ev["args"]["parent"] == parent
+        assert ev["args"]["remote"] == 1
+        # span ids from different node tags never collide
+        assert ev["args"]["span"] >> 32 == 2
+        assert parent >> 32 == 1
+
+    def test_adopt_noop_when_propagate_off(self):
+        b = Tracer(enabled=True, sample=1.0, propagate=False, node_tag=2)
+        b.adopt_context(TXID.hex(), (1 << 32) | 5)
+        with b.span("verify", "tx", txid=TXID):
+            pass
+        ev = [e for e in b.chrome_trace()["traceEvents"]
+              if e["name"] == "verify"][0]
+        assert ev["args"].get("parent") is None
+
+    def test_trace_key_inverts_wire_encoding(self):
+        assert Tracer.trace_key(TXID) == TXID.hex()
+        assert Tracer.trace_key(b"ledger-17") == "ledger-17"
+        assert Tracer.trace_key(b"") is None
+        assert Tracer.trace_key(b"\xff\xfe") is None  # undecodable
+
+    def test_sampling_agreement_across_tracers(self):
+        a = Tracer(enabled=True, sample=0.25, propagate=True, node_tag=1)
+        b = Tracer(enabled=True, sample=0.25, propagate=True, node_tag=2)
+        txids = [os.urandom(32) for _ in range(400)]
+        decisions = [a.sampled(t) for t in txids]
+        assert decisions == [b.sampled(t) for t in txids]
+        assert 0 < sum(decisions) < len(txids)  # rate actually partial
+
+    def test_single_node_dump_validates_with_remote_parent(self):
+        """A node's OWN dump has an unresolvable parent for adopted
+        spans — the schema validator must accept it via the remote
+        mark instead of flagging a broken tree."""
+        a = Tracer(enabled=True, sample=1.0, propagate=True, node_tag=1)
+        b = Tracer(enabled=True, sample=1.0, propagate=True, node_tag=2)
+        with a.span("submit", "tx", txid=TXID):
+            pass
+        tb, parent, _ = a.wire_context(txid=TXID)
+        b.adopt_context(Tracer.trace_key(tb), parent)
+        with b.span("relay_ingest", "tx", txid=TXID):
+            pass
+        dump = b.chrome_trace()
+        assert validate_chrome_trace(dump) == []
+        assert validate_span_trees(dump, require_stages=()) == []
+
+
+def _three_node_chain():
+    """origin -> relay -> follower: each hop adopts the previous hop's
+    exported context, exactly as tcp.py/simnet.py ingest does."""
+    nodes = [
+        Tracer(enabled=True, sample=1.0, propagate=True, node_tag=i + 1)
+        for i in range(3)
+    ]
+    with nodes[0].span("submit", "tx", txid=TXID):
+        with nodes[0].span("verify", "tx", txid=TXID):
+            pass
+    for prev, cur in zip(nodes, nodes[1:]):
+        tb, parent, _ = prev.wire_context(txid=TXID)
+        cur.adopt_context(Tracer.trace_key(tb), parent)
+        with cur.span("relay_ingest", "tx", txid=TXID):
+            with cur.span("verify", "tx", txid=TXID):
+                pass
+    return nodes
+
+
+class TestMergedDump:
+    def test_three_process_merge_single_rooted(self):
+        nodes = _three_node_chain()
+        merged = merge_dumps([
+            (f"node{i}", t.chrome_trace()) for i, t in enumerate(nodes)
+        ])
+        assert validate_chrome_trace(merged) == []
+        assert validate_merged_trace(merged, min_processes=3) == []
+        # the merge preserved per-node process lanes
+        pids = {e["pid"] for e in merged["traceEvents"]
+                if e.get("ph") != "M"}
+        assert len(pids) == 3
+        lanes = [e for e in merged["traceEvents"] if e.get("ph") == "M"]
+        assert {e["args"]["name"] for e in lanes} == {
+            "node0", "node1", "node2"
+        }
+
+    def test_merge_resolves_cross_node_parents_globally(self):
+        nodes = _three_node_chain()
+        merged = merge_dumps([
+            (f"node{i}", t.chrome_trace()) for i, t in enumerate(nodes)
+        ])
+        spans = {e["args"]["span"]: e for e in merged["traceEvents"]
+                 if e.get("ph") != "M"}
+        unresolved = [
+            e for e in spans.values()
+            if e["args"].get("parent") is not None
+            and e["args"]["parent"] not in spans
+        ]
+        assert unresolved == []
+
+    def test_merged_validator_rejects_forest(self):
+        """Anti-vacuity for the validator itself: two nodes that never
+        exchanged context produce a multi-root trace, and the merged
+        check must say so."""
+        a = Tracer(enabled=True, sample=1.0, propagate=True, node_tag=1)
+        b = Tracer(enabled=True, sample=1.0, propagate=True, node_tag=2)
+        c = Tracer(enabled=True, sample=1.0, propagate=True, node_tag=3)
+        for t in (a, b, c):
+            with t.span("submit", "tx", txid=TXID):
+                with t.span("verify", "tx", txid=TXID):
+                    pass
+        merged = merge_dumps([
+            ("a", a.chrome_trace()), ("b", b.chrome_trace()),
+            ("c", c.chrome_trace()),
+        ])
+        problems = validate_merged_trace(merged, min_processes=3)
+        assert problems != []
+        assert any("root" in p for p in problems)

@@ -1,0 +1,353 @@
+"""Tier-1 observability smoke: the fleet-wide observability plane as a
+gate, end-to-end over real TCP.
+
+Boots a LEADER (networked solo validator, quorum=1) and TWO FOLLOWERS,
+floods the leader with sampling at 1.0 and propagation ON, and asserts
+the whole PR-18 contract:
+
+- cross-node tracing: `trace_dump` fetched from all three HTTP doors,
+  merged by tools/traceview.py merge_dumps — at least one transaction's
+  causal tree spans >= 3 process lanes, every cross-node parent link
+  resolves, and each wide tree is single-rooted;
+- propagate=0 wire identity: every trace-carrying message without a
+  context encodes byte-identically to the legacy wire, and stripping a
+  received context restores the legacy bytes (checked at the encoder
+  seam, same pin as tests/test_trace_propagation.py);
+- /metrics: the Prometheus door scrapes clean MID-FLOOD on all three
+  nodes (text format 0.0.4, health gauge present), and the
+  `metrics_history` admin RPC returns sampled rows;
+- health + flight recorder: all three watchdogs read ok on the clean
+  leg (anti-false-positive), then an INJECTED cadence stall — the
+  leader is killed — flips the followers to warn and ships a
+  flight-recorder dump (anti-vacuity: the gate fails if the watchdog
+  sleeps through a real stall).
+
+Runtime: ~40-70s (clock_speed-accelerated consensus).
+
+Usage: python tools/obsmoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SPEED = 5.0
+STALL_WARN_S = 4.0
+
+
+def fail(msg: str) -> None:
+    print(f"OBSERVABILITY SMOKE FAILED: {msg}", file=sys.stderr)
+    raise SystemExit(2)
+
+
+def check_wire_identity() -> None:
+    """The propagate=0 pin at the encoder seam: no context -> legacy
+    bytes, field 60 absent; strip a received context -> legacy bytes."""
+    from stellard_tpu.overlay.proto import first, parse
+    from stellard_tpu.overlay.wire import (
+        TRACE_CTX_FIELD,
+        GetSegments,
+        MessageType,
+        ProposeSet,
+        SegmentData,
+        TraceContext,
+        TxMessage,
+        ValidationMessage,
+        decode_message,
+        encode_message,
+    )
+
+    ctx = TraceContext(trace=bytes(range(32)), parent=(3 << 32) | 9,
+                       sampled=True)
+    carriers = [
+        (MessageType.TRANSACTION, TxMessage(b"\x01" * 40)),
+        (MessageType.PROPOSE_SET,
+         ProposeSet(1, 99, b"\x02" * 32, b"\x03" * 32, b"\x04" * 33,
+                    b"\x05" * 64)),
+        (MessageType.VALIDATION, ValidationMessage(b"\x06" * 50)),
+        (MessageType.GET_SEGMENTS, GetSegments(seg_id=1, offset=0)),
+        (MessageType.SEGMENT_DATA,
+         SegmentData(seg_id=1, total=10, offset=0, data=b"\x07" * 10)),
+    ]
+    for mt, msg in carriers:
+        legacy = encode_message(msg)
+        if first(parse(legacy), TRACE_CTX_FIELD) is not None:
+            fail(f"{type(msg).__name__}: ctx field present with no ctx")
+        msg.trace_ctx = ctx
+        traced = encode_message(msg)
+        if traced == legacy:
+            fail(f"{type(msg).__name__}: ctx did not reach the wire")
+        got = decode_message(int(mt), traced)
+        got.trace_ctx = None
+        if encode_message(got) != legacy:
+            fail(f"{type(msg).__name__}: stripped frame not byte-identical "
+                 f"to the legacy wire")
+
+
+def scrape_metrics(port: int) -> str:
+    req = urllib.request.Request(f"http://127.0.0.1:{port}/metrics")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        if resp.status != 200:
+            fail(f"/metrics returned {resp.status}")
+        ctype = resp.headers.get("Content-Type", "")
+        if "version=0.0.4" not in ctype:
+            fail(f"/metrics content-type not 0.0.4: {ctype!r}")
+        return resp.read().decode("utf-8")
+
+
+def check_scrape(port: int, who: str) -> None:
+    text = scrape_metrics(port)
+    if not text.endswith("\n"):
+        fail(f"{who} /metrics payload missing final line feed")
+    if "stellard_health_status 0" not in text:
+        fail(f"{who} /metrics missing healthy stellard_health_status gauge")
+    for line in text.splitlines():
+        if not line.startswith("#") and line and " " not in line:
+            fail(f"{who} /metrics malformed sample line: {line!r}")
+
+
+def main() -> None:
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from traceview import (
+        fetch_dump,
+        merge_dumps,
+        validate_chrome_trace,
+        validate_merged_trace,
+    )
+
+    from stellard_tpu.node.config import Config
+    from stellard_tpu.node.node import Node
+    from stellard_tpu.protocol.formats import TxType
+    from stellard_tpu.protocol.keys import KeyPair
+    from stellard_tpu.protocol.sfields import sfAmount, sfDestination
+    from stellard_tpu.protocol.stamount import STAmount
+    from stellard_tpu.protocol.sttx import SerializedTransaction
+    from stellard_tpu.testkit.tcpnet import free_ports, rpc, wait_until
+
+    check_wire_identity()
+
+    tmp = tempfile.mkdtemp(prefix="obsmoke-")
+    leader_peer, f1_peer, f2_peer = free_ports(3)
+    val_key = KeyPair.from_passphrase("obsmoke-leader")
+
+    def obs_cfg(**kw) -> Config:
+        return Config(
+            standalone=False,
+            signature_backend="cpu",
+            node_db_type="segstore",
+            validation_quorum=1,
+            clock_speed=SPEED,
+            rpc_port=0,
+            trace_enabled=True,
+            trace_sample=1.0,
+            trace_propagate=True,
+            insight_history=True,
+            insight_history_interval=1.0,
+            insight_history_window=60.0,
+            health_enabled=True,
+            health_stall_warn_s=STALL_WARN_S,
+            health_stall_crit_s=600.0,
+            # cadence here is clock_speed-warped; this gate injects a
+            # hard stall, the drift EWMA is covered by tests/test_health
+            health_drift_factor=1e9,
+            **kw,
+        )
+
+    leader = Node(obs_cfg(
+        node_db_path=os.path.join(tmp, "leader-ns"),
+        database_path=os.path.join(tmp, "leader.db"),
+        validation_seed=val_key.human_seed,
+        peer_port=leader_peer,
+    )).setup().serve()
+
+    followers: list = []
+    leader_stopped = False
+    try:
+        master = leader.master_keys
+
+        def payment(seq: int, dest: bytes) -> SerializedTransaction:
+            tx = SerializedTransaction.build(
+                TxType.ttPAYMENT, master.account_id, seq, 10,
+                {sfAmount: STAmount.from_drops(250_000_000),
+                 sfDestination: dest},
+            )
+            tx.sign(master)
+            return tx
+
+        dests = [KeyPair.from_passphrase(f"obsmoke-{i}").account_id
+                 for i in range(8)]
+        acked = threading.Semaphore(0)
+
+        def cb(_tx, _ter, _applied):
+            acked.release()
+
+        def leader_validated():
+            v = leader.ledger_master.validated
+            return v.seq if v is not None else 0
+
+        next_seq = 1
+        for _ in range(20):
+            leader.ops.submit_transaction(
+                payment(next_seq, dests[next_seq % len(dests)]), cb)
+            next_seq += 1
+        for _ in range(20):
+            acked.acquire()
+        if not wait_until(lambda: leader_validated() >= 2, 90, 0.5):
+            fail(f"leader never validated 2 ledgers solo "
+                 f"(validated={leader_validated()})")
+
+        for i, port in enumerate((f1_peer, f2_peer)):
+            followers.append(Node(obs_cfg(
+                node_mode="follower",
+                node_db_path=os.path.join(tmp, f"f{i}-ns"),
+                database_path=os.path.join(tmp, f"f{i}.db"),
+                validators=[val_key.human_node_public],
+                peer_port=port,
+                ips=[f"127.0.0.1 {leader_peer}"],
+            )).setup().serve())
+
+        def fol_validated(n):
+            v = n.ledger_master.validated
+            return v.seq if v is not None else 0
+
+        # flood WHILE the followers catch up and serve scrapes: the
+        # relayed TxMessages carry the leader's trace context, so the
+        # followers' ingest spans join the leader's trees
+        stop_flood = threading.Event()
+
+        def flood():
+            nonlocal next_seq
+            while not stop_flood.is_set():
+                for _ in range(10):
+                    leader.ops.submit_transaction(
+                        payment(next_seq, dests[next_seq % len(dests)]),
+                        cb,
+                    )
+                    next_seq += 1
+                time.sleep(0.05)
+
+        flooder = threading.Thread(target=flood, daemon=True)
+        flooder.start()
+
+        ok = wait_until(
+            lambda: all(fol_validated(f) >= 3 for f in followers), 120, 0.5
+        )
+        if not ok:
+            stop_flood.set()
+            fail(f"followers never caught up "
+                 f"(leader={leader_validated()}, "
+                 f"followers={[fol_validated(f) for f in followers]})")
+
+        # gate 1: /metrics scrapes clean MID-FLOOD on all three doors
+        nodes = [("leader", leader)] + [
+            (f"follower{i}", f) for i, f in enumerate(followers)
+        ]
+        for who, n in nodes:
+            check_scrape(n.http_server.port, who)
+
+        # gate 2: the metrics history ring sampled rows mid-flood
+        hist = rpc(leader.http_server.port, "metrics_history", {"limit": 5})
+        if not hist.get("enabled") or len(hist.get("series", [])) < 1:
+            fail(f"metrics_history returned no rows: {hist}")
+
+        # gate 3: clean-leg health is ok on every node (false-positive
+        # guard — a healthy flood must not trip the watchdog)
+        time.sleep(2.0)  # one more history/health cycle
+        for who, n in nodes:
+            hj = rpc(n.http_server.port, "health", {})
+            if hj.get("health", {}).get("status") != "ok":
+                fail(f"{who} health not ok on the clean leg: {hj}")
+
+        stop_flood.set()
+        flooder.join(timeout=5)
+
+        # gate 4: merged cross-node trace — >=1 tx tree spanning all
+        # three process lanes, single-rooted, every parent resolved
+        dumps = [
+            (who, fetch_dump(f"http://127.0.0.1:{n.http_server.port}"))
+            for who, n in nodes
+        ]
+        merged = merge_dumps(dumps)
+        problems = validate_chrome_trace(merged)
+        problems += validate_merged_trace(merged, min_processes=3)
+        if problems:
+            for p in problems[:10]:
+                print(f"  merged-trace problem: {p}", file=sys.stderr)
+            fail(f"{len(problems)} merged-trace problems")
+        wide = 0
+        by_trace: dict[str, set] = {}
+        for ev in merged["traceEvents"]:
+            if ev.get("ph") == "M":
+                continue
+            tr = (ev.get("args") or {}).get("trace")
+            if isinstance(tr, str) and len(tr) == 64:
+                by_trace.setdefault(tr, set()).add(ev["pid"])
+        wide = sum(1 for pids in by_trace.values() if len(pids) >= 3)
+        out_path = os.path.join(tmp, "merged-trace.json")
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(merged, f)
+
+        # gate 5: INJECTED cadence stall — kill the leader; both
+        # followers stop seeing closes, the watchdog must flip to warn
+        # within the sampling cadence and the flight recorder must ship
+        leader_stopped = True
+        leader.stop()
+
+        def tripped():
+            return all(
+                f.health is not None and f.health.status != "ok"
+                for f in followers
+            )
+
+        if not wait_until(tripped, STALL_WARN_S + 30, 0.5):
+            fail(f"watchdog slept through an injected stall: "
+                 f"{[f.health.get_json() for f in followers]}")
+        for i, f in enumerate(followers):
+            reasons = f.health.get_json()["reasons"]
+            if not any(r.startswith("close_stall") for r in reasons):
+                fail(f"follower{i} tripped without a close_stall reason: "
+                     f"{reasons}")
+            if not f.flight.dumps:
+                fail(f"follower{i} shipped no flight dump on degrade")
+            if not os.path.exists(f.flight.dumps[-1]):
+                fail(f"follower{i} flight dump missing on disk: "
+                     f"{f.flight.dumps[-1]}")
+            with open(f.flight.dumps[-1], encoding="utf-8") as fh:
+                obj = json.load(fh)
+            if not obj.get("health_transitions"):
+                fail(f"follower{i} flight dump has no transitions")
+
+        print(json.dumps({
+            "observability_smoke": "ok",
+            "validated_seq": min(fol_validated(f) for f in followers),
+            "tx_traces_merged": len(by_trace),
+            "tx_traces_spanning_3_processes": wide,
+            "history_rows": len(hist.get("series", [])),
+            "stall_tripped": [f.health.status for f in followers],
+            "flight_dumps": [len(f.flight.dumps) for f in followers],
+        }), flush=True)
+    finally:
+        for f in followers:
+            f.stop()
+        if not leader_stopped:
+            leader.stop()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    main()

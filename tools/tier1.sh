@@ -170,6 +170,18 @@ if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/overload_smoke.py; then
   exit 2
 fi
 
+echo "== observability smoke gate (leader+2 followers, merged trace, /metrics, stall -> warn) =="
+# boots a leader and two followers over real TCP with sampling at 1.0
+# and propagation on, floods the leader, and asserts the PR-18 plane:
+# a merged Perfetto trace with >=1 tx spanning all 3 process lanes,
+# /metrics scrapes clean mid-flood, propagate=0 stays byte-identical on
+# the wire, and an injected cadence stall flips the health watchdog to
+# warn and ships a flight-recorder dump
+if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/obsmoke.py; then
+  echo "OBSERVABILITY SMOKE FAILED — cross-node tracing / health plane is broken" >&2
+  exit 2
+fi
+
 echo "== tier-1 test run (ROADMAP.md command) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

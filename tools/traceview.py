@@ -17,10 +17,19 @@ three things an operator (and the tier-1 gate) needs around that RPC:
             schema AND the causal span tree per transaction
             (submit → verify → close → persist):
                 python tools/traceview.py --smoke
+  merge     fetch trace_dump from N nodes and emit ONE Perfetto file
+            with a process lane per node — cross-node trace propagation
+            ([trace] propagate=1) makes spans on different nodes share
+            trace/parent ids, so a sampled tx renders as one causal
+            tree across lanes:
+                python tools/traceview.py --merge \\
+                    http://127.0.0.1:5005 http://127.0.0.1:5006 \\
+                    -o merged.json
 
 The schema validator is hand-rolled (no jsonschema dependency) against
-the trace-event format's documented requirements; `validate_chrome_trace`
-is importable by tests.
+the trace-event format's documented requirements; `validate_chrome_trace`,
+`validate_span_trees`, `merge_dumps` and `validate_merged_trace` are
+importable by tests.
 """
 
 from __future__ import annotations
@@ -113,12 +122,109 @@ def validate_span_trees(obj, require_stages=(
                 f"tx {trace[:16]}: missing stages {missing} (has {sorted(cats)})"
             )
         for ev in evs:
-            parent = (ev.get("args") or {}).get("parent")
+            args = ev.get("args") or {}
+            parent = args.get("parent")
+            if args.get("remote"):
+                # cross-node adoption: the parent span lives in ANOTHER
+                # node's ring — unresolvable by design in a single-node
+                # dump (the merge validator checks it across dumps)
+                continue
             if parent is not None and parent not in span_ids:
                 problems.append(
-                    f"tx {trace[:16]}: span {ev['args'].get('span')} "
+                    f"tx {trace[:16]}: span {args.get('span')} "
                     f"references unknown parent {parent}"
                 )
+    return problems
+
+
+# -- cross-node merge (tentpole leg 1) --------------------------------------
+
+
+def merge_dumps(dumps: list[tuple[str, dict]]) -> dict:
+    """N per-node `trace_dump` objects -> ONE Chrome trace with a
+    process lane per node. Span/parent ids need NO remapping: the
+    tracer folds a 32-bit node tag into the high half of every span id,
+    so ids from different nodes never collide and cross-node parent
+    links resolve as-is. Timestamps stay per-node (each tracer's epoch
+    is process-local) — lanes align structurally, not on a shared
+    clock."""
+    events: list[dict] = []
+    other: dict[str, dict] = {}
+    for pid, (label, dump) in enumerate(dumps, start=1):
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "ts": 0, "args": {"name": label},
+        })
+        for ev in dump.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            events.append(ev)
+        other[label] = dump.get("otherData", {})
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": other,
+    }
+
+
+def validate_merged_trace(obj, min_processes: int = 3) -> list[str]:
+    """Check what cross-node propagation promises a MERGED dump: at
+    least one sampled tx has events in >= min_processes distinct
+    process lanes, every cross-node parent link resolves somewhere in
+    the merged dump, and each such tx's causal tree is single-rooted
+    (exactly one CONNECTED root — a span with children but no parent;
+    orphan instants with neither don't count as roots)."""
+    problems: list[str] = []
+    events = obj.get("traceEvents", [])
+    all_spans = set()
+    by_trace: dict[str, list[dict]] = {}
+    for ev in events:
+        if ev.get("ph") == "M":
+            continue
+        args = ev.get("args") or {}
+        if "span" in args:
+            all_spans.add(args["span"])
+        trace = args.get("trace")
+        if isinstance(trace, str) and len(trace) == 64:
+            by_trace.setdefault(trace, []).append(ev)
+    if not by_trace:
+        return ["no transaction traces in merged dump"]
+    wide = 0
+    for trace, evs in sorted(by_trace.items()):
+        pids = {ev.get("pid") for ev in evs}
+        spans: dict[int, object] = {}
+        for ev in evs:
+            a = ev.get("args") or {}
+            if a.get("span") is not None:
+                spans.setdefault(a["span"], a.get("parent"))
+        for s, p in spans.items():
+            if p is not None and p not in all_spans:
+                problems.append(
+                    f"tx {trace[:16]}: span {s} parent {p} unresolved "
+                    f"in the merged dump"
+                )
+        if len(pids) < min_processes:
+            continue
+        wide += 1
+        referenced = {p for p in spans.values() if p is not None}
+        roots = sorted(
+            s for s, p in spans.items() if p is None and s in referenced
+        )
+        if not roots:
+            problems.append(
+                f"tx {trace[:16]}: no connected root span "
+                f"({len(pids)} processes)"
+            )
+        elif len(roots) > 1:
+            problems.append(
+                f"tx {trace[:16]}: multi-rooted causal tree "
+                f"({len(roots)} roots across {len(pids)} processes)"
+            )
+    if wide == 0:
+        problems.append(
+            f"no tx trace spans >= {min_processes} processes "
+            f"(propagation broken or sampling disjoint)"
+        )
     return problems
 
 
@@ -233,6 +339,12 @@ def main(argv=None) -> int:
                     help="validate an already-saved dump file")
     ap.add_argument("--smoke", action="store_true",
                     help="in-process end-to-end gate (tier-1)")
+    ap.add_argument("--merge", nargs="+", metavar="URL",
+                    help="fetch trace_dump from N nodes, emit one "
+                         "Perfetto file with a lane per node")
+    ap.add_argument("--min-processes", type=int, default=3,
+                    help="merge: require >=1 tx spanning this many "
+                         "process lanes (default 3)")
     ap.add_argument("--reset", action="store_true",
                     help="clear the node's ring after dumping")
     ap.add_argument("-o", "--out", help="write the trace JSON here")
@@ -242,6 +354,26 @@ def main(argv=None) -> int:
 
     if args.smoke:
         return run_smoke(n_txs=args.n, out=args.out)
+    if args.merge:
+        dumps = [
+            (url, fetch_dump(url, reset=args.reset)) for url in args.merge
+        ]
+        merged = merge_dumps(dumps)
+        problems = validate_chrome_trace(merged)
+        problems += validate_merged_trace(
+            merged, min_processes=min(args.min_processes, len(dumps))
+        )
+        for p in problems[:30]:
+            print(f"  - {p}", file=sys.stderr)
+        if args.out:
+            with open(args.out, "w") as fh:
+                json.dump(merged, fh)
+            print(
+                f"wrote {len(merged['traceEvents'])} events from "
+                f"{len(dumps)} nodes to {args.out} "
+                f"({'valid' if not problems else 'INVALID'})"
+            )
+        return 0 if not problems else 1
     if args.validate:
         with open(args.validate) as fh:
             obj = json.load(fh)
