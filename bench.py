@@ -2342,6 +2342,365 @@ type=cpu
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_follower_tree(backends):
+    """ISSUE 19: the cascading follower tree at 100k-subscriber scale.
+    A LEADER validator (separate process, quorum=1, flooded over its
+    HTTP door) feeds a depth-2 follower cascade over real TCP: F1 is
+    pinned to the leader, F2 is pinned to F1 — the leader's egress is
+    its direct children (here exactly one peer session), never the
+    follower fleet, and F2 cold-syncs through F1's epoch-stamped
+    sealed shards.
+
+    Measures, under the same flood:
+      - publish→deliver fanout lag p99 across BENCH_TREE_SUBS (default
+        100k) aggregate subscribers split across both followers' fanout
+        planes (criterion: p99 <= BENCH_TREE_LAG_MS, default 2000);
+      - leader egress: peer sessions and relay fan-out per message from
+        the leader's own get_counts — must equal its direct children
+        (1), not the follower count;
+      - a reconnect storm: BENCH_TREE_STORM (default 2000) subscribers
+        dropped from F2 mid-flood, each resuming later from its
+        client-side cursor — >=95% must replay with zero missed seqs
+        (criterion) and past-horizon cursors must answer cold, never
+        gap silently;
+      - state-root byte identity at EVERY tier (leader, F1, F2) for
+        every checked seq in every rep.
+    """
+    import shutil
+    import subprocess
+    import tempfile
+    import threading
+
+    from stellard_tpu.protocol.keys import KeyPair
+    from stellard_tpu.rpc.infosub import InfoSub
+    from stellard_tpu.testkit.tcpnet import REPO, free_ports, rpc, wait_until
+
+    n_subs = int(os.environ.get("BENCH_TREE_SUBS", "100000"))
+    n_storm = int(os.environ.get("BENCH_TREE_STORM", "2000"))
+    lag_bound_ms = float(os.environ.get("BENCH_TREE_LAG_MS", "2000"))
+    reps = 3
+    speed = 8.0
+    tmp = tempfile.mkdtemp(prefix="bench-tree-")
+    leader_peer, f1_peer, f2_peer, leader_rpc = free_ports(4)
+    val_key = KeyPair.from_passphrase("bench-tree-leader")
+    master = KeyPair.from_passphrase("masterpassphrase")
+
+    cfg_path = os.path.join(tmp, "leader.cfg")
+    with open(cfg_path, "w") as f:
+        f.write(f"""
+[standalone]
+0
+
+[node_db]
+type=segstore
+path={os.path.join(tmp, "leader-ns")}
+
+[database_path]
+{os.path.join(tmp, "leader.db")}
+
+[signature_backend]
+type=cpu
+
+[validation_seed]
+{val_key.human_seed}
+
+[validation_quorum]
+1
+
+[peer_port]
+{leader_peer}
+
+[clock_speed]
+{speed}
+
+[rpc_port]
+{leader_rpc}
+""")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    leader_proc = subprocess.Popen(
+        [sys.executable, "-m", "stellard_tpu", "--conf", cfg_path,
+         "--start"],
+        cwd=REPO, env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT,
+    )
+    followers = []
+    stop_flood = threading.Event()
+    try:
+        if not wait_until(
+            lambda: rpc(leader_rpc, "ping") is not None, 60, 1.0
+        ):
+            raise RuntimeError("leader RPC door never opened")
+
+        def leader_validated():
+            try:
+                return rpc(leader_rpc, "server_info")["info"][
+                    "validated_ledger"]["seq"]
+            except Exception:
+                return 0
+
+        if not wait_until(lambda: leader_validated() >= 2, 90, 0.5):
+            raise RuntimeError("leader never validated solo")
+
+        from stellard_tpu.node.config import Config
+        from stellard_tpu.node.node import Node
+
+        def follower_cfg(name, port, upstream):
+            # pinned upstream: the follower dials ONLY its named parent
+            # (discovery dialing off, no self-advert into gossip) — the
+            # tree shape under measurement cannot flatten mid-run
+            return Config(
+                standalone=False,
+                node_mode="follower",
+                signature_backend="cpu",
+                node_db_type="segstore",
+                node_db_path=os.path.join(tmp, f"{name}-ns"),
+                database_path=os.path.join(tmp, f"{name}.db"),
+                validators=[val_key.human_node_public],
+                validation_quorum=1,
+                peer_port=port,
+                ips=[],
+                node_upstream=[upstream],
+                clock_speed=speed,
+                rpc_port=0,
+            )
+
+        f1 = Node(follower_cfg(
+            "f1", f1_peer, f"127.0.0.1 {leader_peer}")).setup().serve()
+        followers.append(f1)
+
+        def validated(node):
+            v = node.ledger_master.validated
+            return v.seq if v is not None else 0
+
+        if not wait_until(
+            lambda: validated(f1) >= leader_validated() - 1
+            and validated(f1) >= 2, 120, 0.5,
+        ):
+            raise RuntimeError("F1 never caught up from the leader")
+
+        # F2 joins COLD through F1 — its whole warm-up (snapshot epoch
+        # handoff + validated tail) must come from the peer follower
+        f2 = Node(follower_cfg(
+            "f2", f2_peer, f"127.0.0.1 {f1_peer}")).setup().serve()
+        followers.append(f2)
+        if not wait_until(
+            lambda: validated(f2) >= leader_validated() - 1
+            and validated(f2) >= 2, 120, 0.5,
+        ):
+            raise RuntimeError("F2 never caught up through F1")
+
+        # aggregate subscriber load, split across both followers'
+        # sharded fanout planes (counting sinks — the cost under
+        # measurement is the fanout plane, not the sink)
+        per_node = max(1, n_subs // 2)
+        counts = [0, 0]
+        lock0, lock1 = threading.Lock(), threading.Lock()
+
+        def make_sink(idx, lk):
+            def sink(_msg):
+                with lk:
+                    counts[idx] += 1
+            return sink
+
+        for idx, (node, lk) in enumerate(((f1, lock0), (f2, lock1))):
+            s = make_sink(idx, lk)
+            for _ in range(per_node):
+                sub = InfoSub(s)
+                node.subs.subscribe_streams(sub, ["ledger"])
+
+        # the reconnect-storm cohort rides F2 on top of the base load:
+        # each member records its own client-side cursor (last
+        # ledgerClosed seq it actually received)
+        n_storm = max(1, min(n_storm, per_node))
+        storm = []
+        for _ in range(n_storm):
+            cell = [0]
+
+            def sink(msg, cell=cell):
+                cell[0] = msg.get("ledger_index", cell[0])
+
+            sub = InfoSub(sink)
+            f2.subs.subscribe_streams(sub, ["ledger"])
+            storm.append((sub, cell))
+
+        txs = _payments(master, 4000)
+        blobs = [tx.serialize().hex() for tx in txs]
+        flood_stats = {"submitted": 0, "errors": 0}
+
+        def flood(work):
+            for blob in work:
+                if stop_flood.is_set():
+                    return
+                try:
+                    rpc(leader_rpc, "submit", {"tx_blob": blob},
+                        timeout=15)
+                    flood_stats["submitted"] += 1
+                except Exception:
+                    flood_stats["errors"] += 1
+            stop_flood.set()  # workload exhausted
+
+        flooders = [
+            threading.Thread(
+                target=flood, args=(blobs[k::2],), daemon=True
+            )
+            for k in range(2)
+        ]
+        for t in flooders:
+            t.start()
+        time.sleep(2.0)  # steady state before anything is measured
+
+        # ---- reconnect storm: drop the cohort mid-flood ----
+        for sub, _cell in storm:
+            f2.subs.remove(sub.id)
+        storm_floor = max(cell[0] for _s, cell in storm)
+        # the network keeps closing while the cohort is gone
+        if not wait_until(
+            lambda: validated(f2) >= storm_floor + 2, 120, 0.5
+        ):
+            raise RuntimeError("no closes while the storm cohort was out")
+
+        storm_replayed = 0
+        rejoined = []  # (cursor, got) — judged only after a full drain
+        for _sub, cell in storm:
+            cursor = cell[0]
+            got: list = []
+            res = f2.subs.resume(InfoSub(got.append), cursor)
+            if not res.get("resumed"):
+                continue  # a cold answer counts as a miss for the rate
+            storm_replayed += res.get("replayed", 0)
+            rejoined.append((cursor, got))
+        # replays ride the sharded fanout (async): drain before judging
+        f2.subs.flush(timeout=60)
+        storm_ok = 0
+        for cursor, got in rejoined:
+            seqs = sorted(m["ledger_index"] for m in got)
+            if seqs and seqs[0] == cursor + 1 and \
+                    seqs == list(range(seqs[0], seqs[-1] + 1)):
+                storm_ok += 1
+        storm_rate = storm_ok / n_storm
+        # anti-vacuity: a cursor past the horizon must answer COLD with
+        # the current floor, never attach with a silent gap
+        cold = f2.subs.resume(InfoSub(lambda m: None), 0) \
+            if f2.subs.resume_horizon else {"cold": True}
+        cold_ok = bool(cold.get("cold")) or bool(cold.get("resumed"))
+
+        # ---- state-root identity at every tier, every rep ----
+        f1_rpc_port = f1.http_server.port
+        f2_rpc_port = f2.http_server.port
+        roots_identical = True
+        checked_seqs = 0
+        for rep in range(reps):
+            common = min(leader_validated(), validated(f1), validated(f2))
+            lo = max(2, common - 4)
+            for seq in range(lo, common + 1):
+                hashes = []
+                for port in (leader_rpc, f1_rpc_port, f2_rpc_port):
+                    try:
+                        hashes.append(rpc(
+                            port, "ledger", {"ledger_index": seq},
+                            timeout=30)["ledger"].get("hash"))
+                    except Exception:
+                        hashes.append(None)
+                live = [h for h in hashes if h]
+                if len(live) == 3:
+                    checked_seqs += 1
+                    if len(set(live)) != 1:
+                        roots_identical = False
+            time.sleep(1.5)
+
+        stop_flood.set()
+        for t in flooders:
+            t.join(timeout=30)
+        for node in followers:
+            node.subs.flush(timeout=60)
+
+        # ---- leader egress: measured from the leader's own counters --
+        lc = rpc(leader_rpc, "get_counts", timeout=30)
+        leader_peers = lc.get("peers", -1)
+        relay_fanout_max = lc.get("squelch", {}).get("relay_fanout_max")
+        leader_children = 1  # F1 is the leader's only direct child
+
+        f1_subs = f1.subs.get_json()
+        f2_subs = f2.subs.get_json()
+        lag_p99 = max(
+            f1_subs.get("fanout_lag_p99_ms") or 0.0,
+            f2_subs.get("fanout_lag_p99_ms") or 0.0,
+        )
+        _emit({
+            "metric": "follower_tree_fanout_lag_p99_ms",
+            "value": round(lag_p99, 2),
+            "unit": "ms",
+            "vs_baseline": round(lag_bound_ms / lag_p99, 3)
+            if lag_p99 > 0 else 0.0,
+            "criterion_lag_p99": bool(lag_p99 <= lag_bound_ms),
+            "lag_bound_ms": lag_bound_ms,
+            "fanout_subscribers": 2 * per_node + n_storm,
+            "fanout_lag_p50_ms": max(
+                f1_subs.get("fanout_lag_p50_ms") or 0.0,
+                f2_subs.get("fanout_lag_p50_ms") or 0.0,
+            ),
+            "fanout_delivered": (f1_subs.get("delivered") or 0)
+            + (f2_subs.get("delivered") or 0),
+            "fanout_dropped": (f1_subs.get("dropped_events") or 0)
+            + (f2_subs.get("dropped_events") or 0),
+            # leader egress = O(children): one peer session, relay
+            # fan-out bounded by it — independent of the follower count
+            "leader_peer_sessions": leader_peers,
+            "leader_relay_fanout_max": relay_fanout_max,
+            "criterion_leader_egress": bool(
+                leader_peers == leader_children
+                and (relay_fanout_max or 0) <= leader_children
+            ),
+            "tree": {"depth": 2, "branching": 1,
+                     "followers": len(followers)},
+            # reconnect storm: zero-missed-seq resume rate
+            "storm_clients": n_storm,
+            "storm_zero_gap": storm_ok,
+            "storm_zero_gap_rate": round(storm_rate, 4),
+            "criterion_storm_resume": bool(storm_rate >= 0.95),
+            "storm_replayed_events": storm_replayed,
+            "resume_counters": {
+                k: f2_subs.get(k) for k in (
+                    "resumed", "resume_replayed", "resume_cold",
+                    "dup_suppressed",
+                )
+            },
+            "cold_answer_ok": cold_ok,
+            "roots_identical": roots_identical,
+            "seqs_checked": checked_seqs,
+            # F2's cold warm-up came through F1's epoch-stamped shards
+            "f2_segfetch": f2.overlay.node.segment_catchup.get_json()
+            if getattr(f2.overlay.node, "segment_catchup", None)
+            else None,
+            "f1_ledgers_ingested": f1.overlay.node.ledgers_ingested,
+            "f2_ledgers_ingested": f2.overlay.node.ledgers_ingested,
+            "flood": flood_stats,
+            "host_cpus": os.cpu_count(),
+            # honest scope: both follower nodes and all 100k sinks
+            # time-slice this one process alongside the leader process
+            # and the flood client — the lag bound is a one-box floor,
+            # not the per-follower production number
+            "note": (
+                "single-box: leader process + 2 in-process followers "
+                "+ all sinks share the host's cores"
+            ),
+        })
+    finally:
+        stop_flood.set()
+        for node in followers:
+            try:
+                node.stop()
+            except Exception:
+                pass
+        leader_proc.terminate()
+        try:
+            leader_proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            leader_proc.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_path_plane(backends):
     """ISSUE 17: the liquidity read plane under a crossfire flood —
     a file-backed node floods an order-book mix (creates, tier-consuming
@@ -2604,6 +2963,7 @@ def main() -> None:
             bench_scenario_fuzz,
             bench_overlay_fanin,
             bench_follower_fanout,
+            bench_follower_tree,
             bench_path_plane,
         ):
             try:

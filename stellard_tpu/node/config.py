@@ -149,6 +149,13 @@ class Config:
     # reads served from the last validated snapshot with the result
     # cache on by default. "validator" is the classic networked node.
     node_mode: str = "validator"
+    # [node] upstream= "host port" lines (follower trees, doc/follower.md):
+    # a follower dials THESE instead of [ips] as its serving tier —
+    # naming a peer FOLLOWER here cascades the validated-ledger tail and
+    # the GetSegments catch-up door one tier down, so the leader's
+    # egress is bounded by its direct children, not the fleet. Empty =
+    # dial [ips] (the flat PR 9 topology). Ignored on validators.
+    node_upstream: list[str] = field(default_factory=list)
 
     # -- storage ([node_db], [database_path]) ------------------------------
     node_db_type: str = "memory"
@@ -380,6 +387,12 @@ class Config:
     # RPCSub HTTP-push retry (reference RPCSub keeps a retry deque):
     # bounded attempts with exponential backoff + jitter per event
     subs_push_retries: int = 5
+    # resume_horizon=N keeps the last N published ledgerClosed events in
+    # a bounded replay ring: a reconnecting client presents its
+    # last-delivered seq and replays the gap instead of re-subscribing
+    # cold; a cursor past the horizon gets an explicit cold-resubscribe
+    # answer, never a silent gap (doc/follower.md). 0 disables resume.
+    subs_resume_horizon: int = 1024
 
     # -- liquidity plane ([paths]) -----------------------------------------
     # The production path_find read plane (paths/plane.py, ISSUE 17):
@@ -483,6 +496,21 @@ class Config:
                     f"[node] mode must be validator/follower, "
                     f"got {cfg.node_mode!r}"
                 )
+        # upstream= repeats (one "host port" line per upstream, like
+        # [ips]); _kv would collapse duplicates so collect them raw
+        upstreams = [
+            line.split("=", 1)[1].strip()
+            for line in s.get("node", [])
+            if "=" in line and line.split("=", 1)[0].strip() == "upstream"
+        ]
+        if upstreams:
+            if cfg.node_mode != "follower":
+                # an upstream on a validator would parse clean and be
+                # silently dropped — the dead-config class again
+                raise ValueError(
+                    "[node] upstream= only applies to mode=follower"
+                )
+            cfg.node_upstream = upstreams
         if one("ledger_history"):
             cfg.ledger_history = int(one("ledger_history"))
 
@@ -657,6 +685,7 @@ class Config:
             ("sendq_cap", "subs_sendq_cap"),
             ("evict_drops", "subs_evict_drops"),
             ("push_retries", "subs_push_retries"),
+            ("resume_horizon", "subs_resume_horizon"),
         ):
             if key in subs:
                 setattr(cfg, attr, int(subs[key]))

@@ -525,7 +525,7 @@ class SegmentCatchup:
             "started", "completed", "requests", "replies", "timeouts",
             "retries", "backoffs", "peer_switches", "garbage_records",
             "garbage_peers", "fallbacks", "segments", "records", "bytes",
-            "late_replies",
+            "late_replies", "epoch_restarts",
         )
         self._reset_session()
 
@@ -542,6 +542,12 @@ class SegmentCatchup:
         self._peer = None
         self._peer_failures: dict = {}
         self._bad_peers: set = set()
+        # snapshot-handoff epoch (doc/follower.md): the serving peer's
+        # sealed-set fingerprint from the manifest reply; every chunk
+        # fetch is pinned to it and a mid-transfer move restarts the
+        # session from a fresh manifest. 0 = pre-epoch peer (don't-care)
+        self._snap_epoch = 0
+        self._snap_seq = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -613,7 +619,11 @@ class SegmentCatchup:
         if self._want[0] == "manifest":
             msg = GetSegments(-1, 0)
         else:
-            msg = GetSegments(self._want[1], len(self._buf))
+            # epoch-pinned snapshot_fetch: the request names the
+            # manifest's epoch so the server (and the wire trace) can
+            # tell which snapshot the fetcher believes it is reading
+            msg = GetSegments(self._want[1], len(self._buf),
+                              snap_epoch=self._snap_epoch)
         self.counters.add("requests")
         self._deadline = now + self.request_timeout
         try:
@@ -658,7 +668,8 @@ class SegmentCatchup:
 
     # -- replies -----------------------------------------------------------
 
-    def on_manifest(self, peer, segments: list) -> None:
+    def on_manifest(self, peer, segments: list, epoch: int = 0,
+                    snap_seq: int = 0) -> None:
         with self._lock:
             if not self.active or self._want != ("manifest",):
                 self.counters.add("late_replies")
@@ -669,6 +680,10 @@ class SegmentCatchup:
             self.counters.add("replies")
             self._attempts = 0
             self._deadline = None
+            # snapshot_offer accepted: pin this session to the offered
+            # epoch; chunk replies from a different epoch restart it
+            self._snap_epoch = int(epoch)
+            self._snap_seq = int(snap_seq)
             self._sizes = {int(s[0]): int(s[1]) for s in segments}
             self._queue = sorted(self._sizes)
             if not self._queue:
@@ -702,6 +717,27 @@ class SegmentCatchup:
             self.counters.add("replies")
             self._attempts = 0
             self._deadline = None
+            if (
+                msg.snap_epoch
+                and self._snap_epoch
+                and msg.snap_epoch != self._snap_epoch
+            ):
+                # the source's sealed set moved under us (rotation /
+                # compaction / online deletion): the manifest's sizes
+                # and this segment's byte range may describe a snapshot
+                # that no longer exists. Honest behavior, not garbage —
+                # restart from a fresh manifest on the SAME peer instead
+                # of splicing records from two different snapshots.
+                self.counters.add("epoch_restarts")
+                self.state = "manifest"
+                self._want = ("manifest",)
+                self._queue = []
+                self._sizes = {}
+                self._buf = bytearray()
+                self._cur_seg = None
+                self._snap_epoch = 0
+                self._send_current(self.clock())
+                return
             # transfer-size defense: the claimed total is bounded by the
             # manifest-advertised size (plus active-segment growth
             # slack) and a hard ceiling — a hostile total must never buy
@@ -810,6 +846,8 @@ class SegmentCatchup:
         with self._lock:
             out["state"] = self.state
             out["active"] = self.active
+            out["snap_epoch"] = self._snap_epoch
+            out["snap_seq"] = self._snap_seq
         return out
 
 

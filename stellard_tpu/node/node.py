@@ -550,12 +550,22 @@ class Node:
                 peer_tls = PeerTLS.from_state_dir(
                     tls_dir, required=(cfg.peer_ssl == "require")
                 )
+            # follower trees (doc/follower.md): [node] upstream= names
+            # this follower's serving tier — usually a peer FOLLOWER one
+            # tier up, not the leader — and replaces [ips] as the dial
+            # set, so the leader's egress is bounded by its direct
+            # children instead of the whole fleet
+            dial_addrs = (
+                _parse_peer_addrs(cfg.node_upstream)
+                if self.follower and cfg.node_upstream
+                else _parse_peer_addrs(cfg.ips)
+            )
             self.overlay = TcpOverlay(
                 key=signer,
                 unl=unl_keys,
                 quorum=cfg.validation_quorum,
                 port=cfg.peer_port,
-                peer_addrs=_parse_peer_addrs(cfg.ips),
+                peer_addrs=dial_addrs,
                 network_time=ntime,
                 clock=clock,
                 timer_interval=timer_interval,
@@ -568,6 +578,10 @@ class Node:
                 ),
                 proposing=self.validation_keys is not None,
                 follower=self.follower,
+                # upstream-pinned followers never discovery-dial past
+                # their named upstreams (the tree stays a tree even as
+                # endpoint gossip spreads the leader's address)
+                pinned_upstream=bool(self.follower and cfg.node_upstream),
                 router=self.hash_router,
                 job_dispatch=self._peer_job_dispatch,
                 peer_tls=peer_tls,
@@ -1002,6 +1016,7 @@ class Node:
             sendq_cap=cfg0.subs_sendq_cap,
             evict_drops=cfg0.subs_evict_drops,
             push_retries=cfg0.subs_push_retries,
+            resume_horizon=cfg0.subs_resume_horizon,
             tracer=self.tracer,
         )
         # `server` stream: publish on load-factor movement (pubServer)
@@ -1123,6 +1138,13 @@ class Node:
                 if isinstance(v, (int, float)) and not isinstance(v, bool)
             },
         )
+        # fanout tree scale-out observability: per-shard queue depth /
+        # drop / evict gauges plus the publish→deliver lag histogram
+        # through the Prometheus door (previously get_counts-only, so
+        # the watchdog's fanout-p99 rule couldn't be scrape-checked)
+        self.collector.hook("subs_shard", self.subs.shard_stats)
+        self.collector.register_hist("subs_fanout_lag_ms",
+                                     self.subs.lag_hist)
         if self.read_cache is not None:
             self.collector.hook(
                 "cache",

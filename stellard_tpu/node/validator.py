@@ -182,6 +182,15 @@ class ValidatorNode:
         self.ingest_hist = LatencyHist(bounds=STAGE_BOUNDS, interpolate=True)
         self.ledgers_ingested = 0
         self._ingest_t0: dict[bytes, float] = {}
+        # follower ingest kick coalescing: a close produces one trusted
+        # validation PER UNL MEMBER for the same seq, and kicking the
+        # LCL election inline on every one ran |UNL| elections (and up
+        # to |UNL| acquisition attempts) per close. One kick per target
+        # seq suffices — on_timer()'s unconditional _check_lcl remains
+        # the liveness backstop for anything the kick missed.
+        self._lcl_kick_seq = 0
+        self.lcl_inline_kicks = 0
+        self.lcl_kicks_coalesced = 0
         # honest health reporting (see DEGRADE_LAG): transitions are
         # tracer-visible and counted, state rides consensus_info and the
         # container's operating mode
@@ -302,6 +311,8 @@ class ValidatorNode:
                 self.lm.validated.seq if self.lm.validated else 0
             ),
             "acquisitions_live": len(self.inbound.live),
+            "lcl_inline_kicks": self.lcl_inline_kicks,
+            "lcl_kicks_coalesced": self.lcl_kicks_coalesced,
         }
         if self.ingest_hist.count:
             out["ingest_p50_ms"] = self.ingest_hist.quantile(0.5)
@@ -756,8 +767,17 @@ class ValidatorNode:
             if current and self.follower:
                 # steady-state tailing: a fresh trusted validation IS
                 # the new-validated-ledger announcement — elect/acquire
-                # now instead of waiting out the next timer tick
-                self._check_lcl()
+                # now instead of waiting out the next timer tick.
+                # Coalesced per target seq: the 2nd..|UNL|th validation
+                # of one close changes no election input worth a fresh
+                # run (pinned by test_follower_kick_coalescing)
+                seq = val.ledger_seq or 0
+                if seq > self._lcl_kick_seq:
+                    self._lcl_kick_seq = seq
+                    self.lcl_inline_kicks += 1
+                    self._check_lcl()
+                else:
+                    self.lcl_kicks_coalesced += 1
             return current
 
     @_locked
@@ -792,22 +812,51 @@ class ValidatorNode:
             # and the requester's acquisition retries another peer
             return None
 
+    def snapshot_epoch(self) -> int:
+        """Epoch stamp for the snapshot-handoff leg (doc/follower.md):
+        a fingerprint of the SEALED segment set served over GetSegments.
+        Rotation, compaction, and online deletion all change the sealed
+        set — exactly the moments a mid-transfer fetcher's offsets go
+        stale — while steady appends to the active segment do not.
+        Nonzero by construction; 0 on the wire means "no epoch" (a
+        pre-epoch peer), which fetchers treat as don't-care."""
+        import zlib
+
+        src = self.segment_source
+        if src is None:
+            return 0
+        sealed = sorted(
+            int(d["id"]) for d in src.segments() if not d["active"]
+        )
+        blob = ",".join(str(i) for i in sealed).encode()
+        return zlib.crc32(blob) or 1
+
     def serve_get_segments(self, msg):
         """Answer a peer's GetSegments from the wired segment source
         (segstore backend): manifest for seg_id < 0, else one bounded
         chunk of the segment's raw bytes. NOT under the master lock —
-        segment reads are pure store IO and must not stall consensus."""
+        segment reads are pure store IO and must not stall consensus.
+
+        Snapshot handoff (follower trees): every reply carries our
+        current snapshot epoch + validated seq. The manifest doubles as
+        the `snapshot_offer`; epoch-pinned chunk fetches are the
+        `snapshot_fetch` — a fetcher seeing the epoch move mid-transfer
+        restarts from a fresh manifest instead of splicing records from
+        two different snapshots."""
         from ..overlay.wire import SEGMENT_CHUNK, SegmentData
 
         src = self.segment_source
         if src is None:
             return None
+        epoch = self.snapshot_epoch()
+        snap_seq = self.lm.validated.seq if self.lm.validated else 0
         if msg.seg_id < 0:
             rows = [
                 (d["id"], d["size"], d["live_bytes"], bool(d["active"]))
                 for d in src.segments()
             ]
-            return SegmentData(seg_id=-1, segments=rows)
+            return SegmentData(seg_id=-1, segments=rows,
+                               snap_epoch=epoch, snap_seq=snap_seq)
         off = max(0, int(msg.offset))
         try:
             # chunked read: serving a multi-chunk transfer must not
@@ -822,6 +871,7 @@ class ValidatorNode:
             return SegmentData(
                 seg_id=msg.seg_id, total=len(data), offset=off,
                 data=data[off: off + SEGMENT_CHUNK],
+                snap_epoch=epoch, snap_seq=snap_seq,
             )
         if got is None:
             return None
@@ -831,6 +881,8 @@ class ValidatorNode:
             total=int(meta["size"]),
             offset=off,
             data=data,
+            snap_epoch=epoch,
+            snap_seq=snap_seq,
         )
 
     def handle_segment_data(self, peer, msg) -> None:
@@ -840,7 +892,8 @@ class ValidatorNode:
         if sc is None:
             return
         if msg.seg_id < 0:
-            sc.on_manifest(peer, msg.segments)
+            sc.on_manifest(peer, msg.segments, epoch=msg.snap_epoch,
+                           snap_seq=msg.snap_seq)
         else:
             sc.on_data(peer, msg)
 

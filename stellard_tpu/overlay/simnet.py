@@ -43,6 +43,7 @@ from .resource import (
     Disposition,
     ResourceManager,
 )
+from .followertree import plan_tree, tree_stats
 from .squelch import SQUELCH_ROTATE, SquelchPolicy
 from .wire import (
     FrameReader,
@@ -92,6 +93,10 @@ class SimValidator(ConsensusAdapter):
         self.resources: Optional[ResourceManager] = None
         # squelch policy (set by the net when squelch_size > 0)
         self.squelch: Optional[SquelchPolicy] = None
+        # cascading follower tree: nid of the preferred upstream for
+        # ledger acquisition (None = anycast over the validator core,
+        # the flat-tier behavior); set by the net from plan_tree()
+        self.upstream: Optional[int] = None
         self.node = ValidatorNode(
             key=key,
             unl=unl,
@@ -163,6 +168,15 @@ class SimValidator(ConsensusAdapter):
         self.net.broadcast(self.nid, frame(TxMessage(blob)))
 
     def request_ledger_data(self, msg: GetLedger) -> None:
+        # cascading follower tree: a follower with a named upstream
+        # acquires ledgers from THAT follower (leader egress stays
+        # O(direct children)); when every ancestor is dead the net
+        # resolves None and we re-home onto the validator anycast
+        if self.upstream is not None:
+            dst = self.net.upstream_for(self.nid)
+            if dst is not None:
+                self.net.send(self.nid, dst, frame(msg))
+                return
         # anycast to one peer, rotating (reference: PeerSet picks a peer
         # per request); broadcasting would multiply reply waves by N-1
         self._acq_rr = getattr(self, "_acq_rr", 0) + 1
@@ -434,6 +448,7 @@ class SimNet:
         squelch_rotate: int = SQUELCH_ROTATE,
         resources: bool = False,
         n_followers: int = 0,
+        follower_branching: int = 0,
     ):
         self.step_ms = step_ms
         self.latency_ms = latency_steps * step_ms
@@ -502,6 +517,23 @@ class SimNet:
         self.nodes: list = (
             list(self.validators) + list(self.peers) + list(self.followers)
         )
+        # cascading follower tree (0 = flat tier, every follower
+        # anycasts to the validator core — byte-for-byte the pre-tree
+        # behavior): plan_tree assigns each follower a parent; tier-1
+        # followers (parent -1) keep upstream=None (they ARE the
+        # leader's direct children), deeper tiers prefer their parent
+        # follower for ledger acquisition and re-home upward on kill
+        self.follower_branching = int(follower_branching)
+        self.tree_parents: list[int] = []
+        if follower_branching > 0 and n_followers > 0:
+            self.tree_parents = plan_tree(n_followers, follower_branching)
+            base = n_validators + n_peers
+            for j, p in enumerate(self.tree_parents):
+                if p >= 0:
+                    self.followers[j].upstream = base + p
+            # materialized only for tree nets: legacy scorecards keep
+            # their exact net_stats shape
+            self.net_stats["rehomed"] = 0
         # validator-message squelching (0 = full flood, byte-for-byte
         # today's behavior — the [overlay] squelch=0 kill-switch)
         self.squelch_size = squelch_size
@@ -574,6 +606,41 @@ class SimNet:
     def clear_link_fault(self, a: int, b: int) -> None:
         self._link_faults.pop((a, b), None)
         self._link_faults.pop((b, a), None)
+
+    # -- follower tree ----------------------------------------------------
+
+    def upstream_for(self, nid: int) -> Optional[int]:
+        """Resolve a tree follower's LIVE upstream: its parent if up,
+        else walk up the ancestor chain (re-home onto the grandparent,
+        then great-grandparent, ... then the leader). Returns None for
+        non-tree nodes or when the walk reaches the leader tier — the
+        caller falls back to the validator anycast, which IS the
+        leader re-home."""
+        if not self.tree_parents:
+            return None
+        base = len(self.validators) + len(self.peers)
+        j = nid - base
+        if not (0 <= j < len(self.tree_parents)):
+            return None
+        p = self.tree_parents[j]
+        hops = 0
+        while p >= 0:
+            dst = base + p
+            if dst not in self._down:
+                if hops:
+                    self.net_stats["rehomed"] += 1
+                return dst
+            hops += 1
+            p = self.tree_parents[p]
+        if hops:
+            self.net_stats["rehomed"] += 1
+        return None
+
+    def tree_json(self) -> dict:
+        """Tree-shape + re-home evidence for the scenario scorecard."""
+        out = tree_stats(self.tree_parents, self.follower_branching)
+        out["rehomed"] = self.net_stats.get("rehomed", 0)
+        return out
 
     # -- validator kill/revive --------------------------------------------
 

@@ -294,6 +294,7 @@ class TcpOverlay(ConsensusAdapter):
         job_dispatch: Optional[Callable[[str, Callable], None]] = None,
         peer_tls=None,
         follower: bool = False,
+        pinned_upstream: bool = False,
         squelch_size: int = SQUELCH_SIZE,
         squelch_rotate: int = SQUELCH_ROTATE,
         sendq_cap: int = 0,
@@ -331,9 +332,15 @@ class TcpOverlay(ConsensusAdapter):
             self.node.on_byzantine = _note_unl
         self.peers: dict[bytes, _Peer] = {}  # node pubkey -> session
         self._dialing: set[tuple[str, int]] = set()  # dials in flight
+        # cascading follower tree ([node] upstream=): a pinned follower
+        # dials ONLY its named upstreams — fixed seeds are always kept
+        # connected, but out_desired=0 disables discovery dialing, so
+        # gossip-learned endpoints (including the leader's) can never
+        # re-flatten the tree; inbound children still attach freely
+        self.pinned_upstream = bool(pinned_upstream)
         self.peerfinder = PeerFinder(
             fixed=peer_addrs,
-            out_desired=out_desired,
+            out_desired=0 if pinned_upstream else out_desired,
             max_peers=max_peers,
             bootcache_path=bootcache_path,
         )
@@ -1183,7 +1190,16 @@ class TcpOverlay(ConsensusAdapter):
             mono = time.monotonic()
             if mono - self._last_gossip >= self.gossip_interval:
                 self._last_gossip = mono
-                sample = self.peerfinder.gossip_sample(("0.0.0.0", self.port))
+                # a pinned-upstream follower never advertises its own
+                # listener: its children find it via explicit upstream=
+                # config, and an advertised endpoint would invite the
+                # wider net (the leader included) to dial down into the
+                # tree, un-bounding the very egress the tree bounds
+                own = (
+                    None if self.pinned_upstream
+                    else ("0.0.0.0", self.port)
+                )
+                sample = self.peerfinder.gossip_sample(own)
                 if sample:
                     self._broadcast(Endpoints(sample))
                 if self.fee_track is not None and self.cluster:

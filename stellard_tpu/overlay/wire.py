@@ -308,6 +308,10 @@ class GetSegments:
 
     seg_id: int = -1
     offset: int = 0
+    # snapshot handoff (doc/follower.md): the epoch the fetcher is
+    # pinned to — 0 = don't-care (manifest requests, pre-epoch peers).
+    # proto2 unknown-field skip keeps old peers wire-compatible.
+    snap_epoch: int = 0
     trace_ctx: "TraceContext | None" = None
 
 
@@ -322,6 +326,12 @@ class SegmentData:
     offset: int = 0
     data: bytes = b""
     segments: list = field(default_factory=list)  # (id, size, live, active)
+    # snapshot handoff: the serving peer's sealed-set epoch + validated
+    # seq at reply time (0 = a pre-epoch peer; fetchers treat as
+    # don't-care). An epoch that MOVES mid-transfer means the source
+    # rotated/compacted under the fetcher → restart from the manifest.
+    snap_epoch: int = 0
+    snap_seq: int = 0
     trace_ctx: "TraceContext | None" = None
 
 
@@ -593,6 +603,8 @@ def _dec_endpoints(buf: bytes) -> Endpoints:
 def _enc_get_segments(m: GetSegments) -> bytes:
     # seg_id rides +1 so the manifest sentinel (-1) stays a valid varint
     e = Encoder().varint(1, m.seg_id + 1).varint(2, m.offset)
+    if m.snap_epoch:
+        e.varint(3, m.snap_epoch)
     _enc_trace_ctx(e, m.trace_ctx)
     return e.data()
 
@@ -602,6 +614,7 @@ def _dec_get_segments(buf: bytes) -> GetSegments:
     return GetSegments(
         seg_id=first_int(f, 1) - 1,
         offset=first_int(f, 2),
+        snap_epoch=first_int(f, 3),
         trace_ctx=_dec_trace_ctx(f),
     )
 
@@ -619,6 +632,10 @@ def _enc_segment_data(m: SegmentData) -> bytes:
             .varint(3, live).varint(4, 1 if active else 0)
         )
         e.message(5, row)
+    if m.snap_epoch:
+        e.varint(6, m.snap_epoch)
+    if m.snap_seq:
+        e.varint(7, m.snap_seq)
     _enc_trace_ctx(e, m.trace_ctx)
     return e.data()
 
@@ -640,6 +657,8 @@ def _dec_segment_data(buf: bytes) -> SegmentData:
         offset=first_int(f, 3),
         data=first_bytes(f, 4, b""),
         segments=segments,
+        snap_epoch=first_int(f, 6),
+        snap_seq=first_int(f, 7),
         trace_ctx=_dec_trace_ctx(f),
     )
 

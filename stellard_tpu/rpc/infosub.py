@@ -57,6 +57,11 @@ class InfoSub:
         self.drop_run = 0        # consecutive drops (resets on delivery)
         self.dropped = 0
         self.evicted = False
+        # resume cursor: highest ledgerClosed seq ENQUEUED to this
+        # client (guarded by the manager's replay lock) — the monotonic
+        # floor that suppresses duplicates when a resume replay overlaps
+        # a live publish (doc/follower.md "Resume cursors")
+        self.last_seq = 0
 
 
 class _FanoutShard:
@@ -73,6 +78,12 @@ class _FanoutShard:
         self.idx = idx
         self.cv = threading.Condition()
         self.ready: deque[InfoSub] = deque()
+        # per-shard accounting (satellite of the tree scale-out): queue
+        # depth + drop/evict counts, scraped via GET /metrics so the
+        # watchdog's fanout rule can be cross-checked from Prometheus
+        self.depth = 0       # pending events across this shard's subs
+        self.dropped = 0
+        self.evicted = 0
         self._stop = False
         self._idle = True
         self.thread = threading.Thread(
@@ -92,12 +103,18 @@ class _FanoutShard:
                 sub.sendq.popleft()
                 sub.dropped += 1
                 sub.drop_run += 1
+                self.depth -= 1
+                self.dropped += 1
                 mgr._bump("dropped_events")
                 if sub.drop_run >= mgr.evict_drops:
                     sub.evicted = True
                     evict = True
+                    self.evicted += 1
+                    self.depth -= len(sub.sendq)
+                    sub.sendq.clear()
             if not evict:
                 sub.sendq.append((msg, now))
+                self.depth += 1
                 mgr._bump("published")
                 if not sub.queued:
                     sub.queued = True
@@ -123,6 +140,7 @@ class _FanoutShard:
                     if not sub.sendq:
                         break
                     batch.append(sub.sendq.popleft())
+                self.depth -= len(batch)
                 if sub.sendq:
                     self.ready.append(sub)  # rotate: fairness
                 else:
@@ -156,6 +174,10 @@ class _FanoutShard:
                         seq=msg.get("ledger_index"),
                     )
             if dead:
+                with self.cv:
+                    self.evicted += 1
+                    self.depth -= len(sub.sendq)
+                    sub.sendq.clear()
                 mgr._evict(sub, reason="dead")
 
     def drained(self) -> bool:
@@ -174,7 +196,7 @@ class SubscriptionManager:
 
     def __init__(self, ops, shards: int = 0, sendq_cap: int = 512,
                  evict_drops: int = 64, push_retries: int = 5,
-                 tracer=None):
+                 resume_horizon: int = 1024, tracer=None):
         from ..node.metrics import LatencyHist
         from ..node.tracer import STAGE_BOUNDS
 
@@ -198,7 +220,18 @@ class SubscriptionManager:
         self.stats = {
             "published": 0, "delivered": 0, "dropped_events": 0,
             "slow_evicted": 0, "dead_evicted": 0,
+            "resumed": 0, "resume_replayed": 0, "resume_cold": 0,
+            "dup_suppressed": 0,
         }
+        # resume-from-seq replay ring (reconnect-storm hardening): the
+        # last `resume_horizon` ledgerClosed events, so a dropped client
+        # replays its gap instead of re-subscribing cold. The replay
+        # lock ALSO serializes each sub's cursor stamp (last_seq) with
+        # resume's replay — without that, a live publish racing a replay
+        # could jump the cursor past undelivered replayed seqs.
+        self.resume_horizon = max(0, int(resume_horizon))
+        self._replay: deque = deque(maxlen=max(1, self.resume_horizon))
+        self._replay_lock = threading.Lock()
         # one lock for the shared counters + lag histogram: enqueues
         # ride per-shard locks and deliveries ride worker threads, so
         # bare `+=` across shards would lose updates
@@ -414,9 +447,12 @@ class SubscriptionManager:
             "reserve_inc": ledger.reserve_increment,
             "txn_count": len(results),
         }
+        if self.resume_horizon > 0:
+            with self._replay_lock:
+                self._replay.append((ledger.seq, msg))
         for sub in self._each():
             if "ledger" in sub.streams:
-                self._deliver(sub, msg)
+                self._deliver_ledger(sub, msg)
         # accepted transactions (reference: pubAcceptedTransaction)
         for txid, blob, meta in ledger.tx_entries():
             tx = ledger.parse_tx(txid, blob)
@@ -533,6 +569,77 @@ class SubscriptionManager:
             self.remove(sub.id)
             self._bump("dead_evicted")
 
+    def _deliver_ledger(self, sub: InfoSub, msg: dict) -> None:
+        """ledgerClosed funnel: monotonic per-client cursor stamp +
+        duplicate suppression (a resume replay overlapping a live
+        publish must deliver each seq once, in order). The stamp is
+        serialized on the replay lock with resume()'s replay loop."""
+        seq = msg.get("ledger_index", 0)
+        with self._replay_lock:
+            if seq <= sub.last_seq:
+                self._bump("dup_suppressed")
+                return
+            sub.last_seq = seq
+            self._deliver(sub, msg)
+
+    def resume(self, sub: InfoSub, last_seq: int) -> dict:
+        """Resume-from-seq cursor (reconnect-storm hardening): a
+        reconnecting client presents its last-delivered ledgerClosed
+        seq; every later event still inside the bounded replay ring is
+        re-enqueued in order and the `ledger` stream re-attaches — no
+        cold re-subscribe, no silent gap. A cursor PAST the horizon
+        gets an explicit cold answer ({"cold": True} with the current
+        replay floor) so the client knows to re-subscribe cold.
+
+        The whole replay + registration runs under the replay lock:
+        publishes that landed in the ring before we locked are replayed
+        here, publishes after we release see the registered sub and
+        deliver live, and the per-sub cursor stamp (serialized on the
+        same lock) suppresses the overlap — zero gaps, zero dups."""
+        with self._replay_lock:
+            ring = list(self._replay) if self.resume_horizon > 0 else []
+            floor = ring[0][0] if ring else 0
+            # resumable iff the client's next event (last_seq+1) is at
+            # or above the ring floor — exactly-at-horizon resumes
+            cold = (
+                self.resume_horizon <= 0
+                or last_seq + 1 < floor
+                or (not ring and last_seq > 0)
+            )
+            if cold:
+                self._bump("resume_cold")
+                return {
+                    "resumed": False, "cold": True, "replayed": 0,
+                    "horizon": floor,
+                }
+            sub.last_seq = max(sub.last_seq, int(last_seq))
+            replayed = 0
+            for seq, msg in ring:
+                if seq <= sub.last_seq:
+                    continue
+                sub.last_seq = seq
+                self._deliver(sub, msg)
+                replayed += 1
+            sub.streams.add("ledger")
+            self.add(sub)
+        self._bump("resumed")
+        self._bump("resume_replayed", replayed)
+        return {
+            "resumed": True, "cold": False, "replayed": replayed,
+            "horizon": floor,
+        }
+
+    def shard_stats(self) -> dict:
+        """Flat per-shard depth/drop/evict gauges for the Prometheus
+        hook (subs_shard.shard<N>_*)."""
+        out = {}
+        for s in self._shards:
+            with s.cv:
+                out[f"shard{s.idx}_depth"] = s.depth
+                out[f"shard{s.idx}_dropped"] = s.dropped
+                out[f"shard{s.idx}_evicted"] = s.evicted
+        return out
+
     def _evict(self, sub: InfoSub, reason: str) -> None:
         """Drop a subscriber the fanout plane gave up on (slow consumer
         past the drop threshold, or a dead sink). Idempotent: the slow
@@ -591,7 +698,9 @@ class SubscriptionManager:
             "shards": len(self._shards),
             "sendq_cap": self.sendq_cap,
             "evict_drops": self.evict_drops,
+            "resume_horizon": self.resume_horizon,
             **self.stats,
+            **self.shard_stats(),
         }
         if self.lag_hist.count:
             out["fanout_lag_p50_ms"] = self.lag_hist.quantile(0.5)

@@ -127,6 +127,13 @@ class Scenario:
     # nodes (nids after the relay tier) ingesting the validated chain;
     # the scorecard's `followers` block carries their sync evidence
     n_followers: int = 0
+    # cascading follower tree (ISSUE 19): follower_branching>0 arranges
+    # the follower tier as a branching-ary tree rooted at the validator
+    # core (overlay.followertree.plan_tree) — tier-1 followers anycast
+    # to validators, deeper tiers acquire from their parent follower
+    # and re-home UP the tree when it dies. The `followers.tree` block
+    # carries shape + re-home evidence; 0 = flat tier (legacy shape)
+    follower_branching: int = 0
     # sharded crypto plane (ISSUE 15): mesh_width>0 routes every honest
     # validator's tree hashing through the mesh-enabled device hasher
     # (forced-device routing for anti-vacuity), width clamped to the
@@ -606,6 +613,7 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
         n_peers=scn.n_peers, squelch_size=scn.squelch_size,
         squelch_rotate=scn.squelch_rotate, resources=scn.resources,
         n_followers=scn.n_followers,
+        follower_branching=scn.follower_branching,
     )
     # swap hostile slots in BEFORE start() so their genesis matches
     byz_validators = {}
@@ -1009,6 +1017,12 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
                     )
                 ),
             }
+            if scn.follower_branching:
+                # tree shape + re-home evidence (ISSUE 19): leader
+                # children bounded by branching, and a mid-tree kill
+                # leaves a nonzero re-home count while `synced` above
+                # still demands byte-identical reconvergence
+                card["followers"]["tree"] = net.tree_json()
         if scn.squelch_size or scn.n_peers:
             # relay fan-out evidence: the squelch bound the flood gate
             # asserts (fan-out <= squelch_size + n_validators, never

@@ -17,7 +17,8 @@ entries (each a shrunk, replayable scenario checked in under
 (d) hostile workloads hot_account / order_books / fee_gaming
 (e) fan-in/read axes  flood_survival, squelch-rotation-vs-flood,
                       chaos under spec workers, follower-under-
-                      partition
+                      partition, cascading follower tree with a
+                      mid-tree kill (follower_tree)
 
 Every matrix scenario is DATA-form (``schedule=``/``workload=`` rather
 than closures), so each round-trips losslessly through
@@ -163,6 +164,28 @@ def scenario_follower_partition(seed: int = 0) -> Scenario:
     )
 
 
+def scenario_follower_tree(seed: int = 0) -> Scenario:
+    """Cascading follower tree under mid-tree death (ISSUE 19): six
+    followers arranged as a branching-2 tree over a 4-validator core
+    (followers 0-1 dial the leader tier, 2-3 hang off follower 0, 4-5
+    off follower 1), squelched relay so validations cascade through
+    the tier, payment flood running — then the mid-tree follower 0
+    (nid 4) DIES under load and revives late. Its downstream subtree
+    must re-home up the tree (`followers.tree.rehomed` > 0) and every
+    follower must reconverge byte-identical to the honest chain
+    (`followers.synced`), with leader fan-out still bounded by the
+    squelch subset, never the follower count."""
+    sched = FaultSchedule(seed)
+    sched.kill(24, 4, revive_at=40)
+    return Scenario(
+        name="follower_tree", seed=seed, n_validators=4, quorum=3,
+        steps=64, n_followers=6, follower_branching=2,
+        squelch_size=4,
+        schedule=sched,
+        workload={"kind": "payment_flood", "n": 48},
+    )
+
+
 def scenario_flood_survival(
     seed: int = 0,
     n_peers: int = 495,
@@ -251,6 +274,7 @@ MATRIX = {
     "hot_account": scenario_hot_account,
     "order_books": scenario_order_books,
     "follower_partition": scenario_follower_partition,
+    "follower_tree": scenario_follower_tree,
     "mesh_hash": scenario_mesh_hash,
     "fee_gaming": scenario_fee_gaming,
     "flood_survival": scenario_flood_survival,

@@ -652,6 +652,19 @@ class ScenarioGenerator:
         # under whatever faults this schedule carries.
         if scn.seed & 0x7 == 0x3:
             scn.path_subs = 2 + ((scn.seed >> 3) & 0x3)
+        # cascading-follower-tree axis (ISSUE 19): seed-derived like
+        # the mesh/path axes, so the generator's rng stream — and every
+        # previously generated scenario — stays bit-identical. ~1 in 8
+        # runs grow a 3-6 follower tier arranged as a branching-2/3
+        # tree (squelched so validations cascade through it): upstream
+        # acquisition, re-home on mid-tree death, and byte-identical
+        # reconvergence under whatever faults this schedule carries.
+        if scn.seed & 0x7 == 0x5:
+            scn.n_followers = max(scn.n_followers,
+                                  3 + ((scn.seed >> 3) & 0x3))
+            scn.follower_branching = 2 + ((scn.seed >> 5) & 0x1)
+            if not scn.squelch_size:
+                scn.squelch_size = 4
 
         raw: list[tuple] = []
         hostile = n - 1 if (byz or cold) else None
@@ -816,6 +829,13 @@ def _weaken_ops(scn: Scenario) -> list[tuple[str, Scenario]]:
         c = clone()
         c.n_followers = 0
         out.append(("drop_followers", c))
+    if getattr(scn, "follower_branching", 0):
+        # flatten the cascade but keep the tier: isolates tree
+        # plumbing (upstream acquisition / re-home) from plain
+        # follower ingest as the failing axis
+        c = clone()
+        c.follower_branching = 0
+        out.append(("drop_follower_tree", c))
     if getattr(scn, "mesh_width", 0):
         c = clone()
         c.mesh_width = 0

@@ -1,0 +1,404 @@
+"""Cascading follower trees (ISSUE 19): deterministic topology
+planning, resume-from-seq cursors on the sharded fanout, follower
+_check_lcl kick coalescing, and the epoch-pinned snapshot handoff."""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+
+import jax
+import pytest
+
+jax.config.update("jax_platforms", "cpu")
+
+from stellard_tpu.node.config import Config  # noqa: E402
+from stellard_tpu.node.inbound import SegmentCatchup  # noqa: E402
+from stellard_tpu.node.node import Node  # noqa: E402
+from stellard_tpu.overlay.followertree import (  # noqa: E402
+    plan_tree,
+    select_children,
+    tier_of,
+    tree_stats,
+)
+from stellard_tpu.overlay.simnet import SimNet  # noqa: E402
+from stellard_tpu.overlay.wire import (  # noqa: E402
+    FrameReader,
+    GetSegments,
+    SegmentData,
+    frame,
+)
+from stellard_tpu.protocol.keys import KeyPair  # noqa: E402
+from stellard_tpu.rpc.infosub import InfoSub, SubscriptionManager  # noqa: E402
+from stellard_tpu.utils.hashes import sha512_half  # noqa: E402
+
+
+@pytest.fixture
+def node():
+    n = Node(Config(signature_backend="cpu")).setup()
+    yield n
+    n.stop()
+
+
+# -- topology planning ----------------------------------------------------
+
+
+class TestTreePlan:
+    def test_heap_layout(self):
+        # branching 2: followers 0-1 dial the leader, 2-3 hang off
+        # follower 0, 4-5 off follower 1
+        assert plan_tree(6, 2) == [-1, -1, 0, 0, 1, 1]
+        assert plan_tree(4, 3) == [-1, -1, -1, 0]
+        assert plan_tree(0, 2) == []
+
+    def test_leader_children_bounded_by_branching(self):
+        for b in (1, 2, 3, 4):
+            stats = tree_stats(plan_tree(40, b), b)
+            assert stats["leader_children"] <= b
+            assert stats["max_children"] <= b
+
+    def test_tiers(self):
+        assert tier_of(0, 2) == 1
+        assert tier_of(1, 2) == 1
+        assert tier_of(2, 2) == 2
+        assert tier_of(5, 2) == 2
+        assert tier_of(6, 2) == 3
+        assert tree_stats(plan_tree(6, 2), 2)["depth"] == 2
+
+    def test_select_children_deterministic_and_rotating(self):
+        cands = [struct.pack(">I", i) for i in range(12)]
+        a = select_children(b"parent", 5, cands, lambda c: c, 4, rotate=16)
+        b = select_children(b"parent", 5, cands, lambda c: c, 4, rotate=16)
+        assert a == b and len(a) == 4
+        # same epoch (seq 5 and 6 share epoch 0 at rotate=16)
+        assert select_children(b"parent", 6, cands, lambda c: c, 4) == a
+        # a later epoch re-randomizes the subset
+        later = select_children(b"parent", 16, cands, lambda c: c, 4)
+        assert later != a
+        # under-subscribed: everyone is a child
+        assert select_children(b"p", 0, cands[:3], lambda c: c, 4) == \
+            cands[:3]
+
+
+class TestSimnetTree:
+    def test_upstream_assignment_and_rehome(self):
+        net = SimNet(n_validators=2, quorum=2, n_followers=6,
+                     follower_branching=2)
+        base = 2
+        # tier-1 followers anycast (upstream=None); deeper tiers name
+        # their parent follower
+        assert net.followers[0].upstream is None
+        assert net.followers[1].upstream is None
+        assert net.followers[2].upstream == base + 0
+        assert net.followers[5].upstream == base + 1
+        # live parent resolves directly
+        assert net.upstream_for(base + 2) == base + 0
+        # dead parent: the child re-homes UP the tree (here: to the
+        # leader tier, i.e. validator anycast) and the move is counted
+        net.kill(base + 0)
+        assert net.upstream_for(base + 2) is None
+        assert net.net_stats["rehomed"] == 1
+        # revive: back to the parent
+        net.revive(base + 0)
+        assert net.upstream_for(base + 2) == base + 0
+
+    def test_flat_tier_unchanged(self):
+        net = SimNet(n_validators=2, quorum=2, n_followers=2)
+        assert all(f.upstream is None for f in net.followers)
+        assert net.upstream_for(2 + 0) is None
+        assert "rehomed" not in net.net_stats  # legacy stats shape
+
+
+# -- resume-from-seq cursors (satellite c) ---------------------------------
+
+
+class TestResumeCursors:
+    def _mgr(self, node, **kw):
+        return SubscriptionManager(node.ops, **kw)
+
+    def _fill(self, node, mgr, n):
+        """Close n ledgers through the real publish hook; returns the
+        published seqs."""
+        seqs = []
+        for _ in range(n):
+            node.close_ledger()
+            seqs.append(node.ledger_master.closed_ledger().seq)
+        return seqs
+
+    def test_resume_exactly_at_horizon(self, node):
+        mgr = self._mgr(node, resume_horizon=3)
+        seqs = self._fill(node, mgr, 5)
+        ring = seqs[-3:]  # bounded ring kept only the newest 3
+        got: list = []
+        sub = InfoSub(got.append)
+        # cursor exactly at the horizon: next event == ring floor
+        res = mgr.resume(sub, ring[0] - 1)
+        assert res["resumed"] and not res["cold"]
+        assert res["replayed"] == 3
+        assert [m["ledger_index"] for m in got] == ring
+        # registered live: the next close flows without a re-subscribe
+        node.close_ledger()
+        assert got[-1]["ledger_index"] == ring[-1] + 1
+
+    def test_resume_past_horizon_explicit_cold(self, node):
+        mgr = self._mgr(node, resume_horizon=3)
+        seqs = self._fill(node, mgr, 5)
+        got: list = []
+        sub = InfoSub(got.append)
+        res = mgr.resume(sub, seqs[-3] - 2)  # next event below the floor
+        assert res["cold"] and not res["resumed"]
+        assert res["horizon"] == seqs[-3]  # the floor, so the client
+        assert got == []                   # knows WHERE cold starts
+        with mgr._lock:
+            assert sub.id not in mgr._subs  # never silently attached
+        assert mgr.get_json()["resume_cold"] == 1
+
+    def test_resume_disabled_always_cold(self, node):
+        mgr = self._mgr(node, resume_horizon=0)
+        self._fill(node, mgr, 2)
+        res = mgr.resume(InfoSub(lambda m: None), 2)
+        assert res["cold"]
+
+    def test_fresh_client_empty_ring_resumes(self, node):
+        # a from-genesis client (last_seq 0) against an empty ring is a
+        # valid attach, not a cold refusal
+        mgr = self._mgr(node, resume_horizon=8)
+        res = mgr.resume(InfoSub(lambda m: None), 0)
+        assert res["resumed"] and res["replayed"] == 0
+        # but a real cursor against an empty ring IS cold (history aged
+        # out entirely)
+        res = mgr.resume(InfoSub(lambda m: None), 7)
+        assert res["cold"]
+
+    def test_duplicate_suppression_on_overlapping_replay(self, node):
+        """A live publish racing the replay must not double-deliver:
+        the per-sub cursor (serialized on the replay lock) suppresses
+        the overlap."""
+        mgr = self._mgr(node, resume_horizon=8)
+        seqs = self._fill(node, mgr, 4)
+        got: list = []
+        sub = InfoSub(got.append)
+        mgr.subscribe_streams(sub, ["ledger"])
+        node.close_ledger()
+        top = node.ledger_master.closed_ledger().seq
+        assert [m["ledger_index"] for m in got] == [top]
+        # a replayed/raced event AT or BELOW the cursor is suppressed
+        before = mgr.get_json()["dup_suppressed"]
+        mgr._deliver_ledger(sub, {"type": "ledgerClosed",
+                                  "ledger_index": top})
+        mgr._deliver_ledger(sub, {"type": "ledgerClosed",
+                                  "ledger_index": seqs[-1]})
+        assert [m["ledger_index"] for m in got] == [top]
+        assert mgr.get_json()["dup_suppressed"] == before + 2
+        # resume with a stale cursor on the SAME sub replays nothing
+        # (its cursor already advanced past the whole ring)
+        res = mgr.resume(sub, seqs[0])
+        assert res["resumed"] and res["replayed"] == 0
+        assert [m["ledger_index"] for m in got] == [top]
+
+    def test_cursor_survives_eviction_and_reconnect(self, node):
+        """The fanout plane evicts a dying subscriber; the CLIENT still
+        holds its last-delivered seq and resumes from it — replaying the
+        events it lost while evicted, with zero gaps."""
+        mgr = self._mgr(node, shards=1, sendq_cap=64, resume_horizon=32)
+        try:
+            delivered: list = []
+
+            def dying(msg):
+                if delivered:
+                    raise RuntimeError("sink died")
+                delivered.append(msg)
+
+            sub = InfoSub(dying)
+            mgr.subscribe_streams(sub, ["ledger"])
+            node.close_ledger()
+            assert mgr.flush(timeout=10.0)
+            assert len(delivered) == 1
+            last_seen = delivered[0]["ledger_index"]
+            node.close_ledger()  # this send raises -> dead-sink evict
+            assert mgr.flush(timeout=10.0)
+            assert sub.evicted
+            # the network keeps closing while the client is gone
+            for _ in range(3):
+                node.close_ledger()
+            assert mgr.flush(timeout=10.0)
+            top = node.ledger_master.closed_ledger().seq
+            # reconnect: a fresh InfoSub presents the client's cursor
+            got: list = []
+            sub2 = InfoSub(got.append)
+            res = mgr.resume(sub2, last_seen)
+            assert res["resumed"], res
+            assert mgr.flush(timeout=10.0)
+            replayed = [m["ledger_index"] for m in got]
+            assert replayed == list(range(last_seen + 1, top + 1)), (
+                f"gap after eviction+resume: {replayed}"
+            )
+            assert mgr.get_json()["dead_evicted"] == 1
+        finally:
+            mgr.stop()
+
+    def test_shard_stats_exposed(self, node):
+        # satellite (b): per-shard depth/drop/evict gauges ride
+        # get_json and the subs_shard collector hook shape
+        mgr = self._mgr(node, shards=2)
+        try:
+            j = mgr.get_json()
+            for i in range(2):
+                for k in ("depth", "dropped", "evicted"):
+                    assert f"shard{i}_{k}" in j
+            assert set(mgr.shard_stats()) == {
+                f"shard{i}_{k}" for i in range(2)
+                for k in ("depth", "dropped", "evicted")
+            }
+        finally:
+            mgr.stop()
+
+
+# -- follower kick coalescing (satellite a) --------------------------------
+
+
+class _NullAdapter:
+    def request_ledger_data(self, msg):
+        pass
+
+
+class TestFollowerKickCoalescing:
+    def _follower(self, n_keys=4):
+        from stellard_tpu.node.validator import ValidatorNode
+
+        keys = [
+            KeyPair.from_seed(hashlib.sha256(bytes([i]) * 4).digest())
+            for i in range(n_keys)
+        ]
+        now = [10_000]
+        vn = ValidatorNode(
+            key=KeyPair.from_passphrase("tree-follower"),
+            unl={k.public for k in keys},
+            adapter=_NullAdapter(),
+            quorum=3,
+            network_time=lambda: now[0],
+            clock=lambda: float(now[0]),
+            follower=True,
+        )
+        vn.start(b"\x07" * 20, close_time=now[0])
+        return vn, keys, now
+
+    def test_follower_kick_coalescing(self):
+        """A validation burst of |UNL| for ONE target seq runs ONE
+        inline election, not |UNL| (the remaining kicks coalesce)."""
+        from stellard_tpu.consensus.validation import STValidation
+
+        vn, keys, now = self._follower()
+        kicks = []
+        vn._check_lcl = lambda: kicks.append(1)  # count, no side effects
+        target = hashlib.sha256(b"tree-target").digest()
+        for k in keys:
+            v = STValidation.build(target, signing_time=now[0],
+                                   ledger_seq=5)
+            v.sign(k)
+            assert vn.handle_validation(v)
+        assert len(kicks) == 1
+        assert vn.lcl_inline_kicks == 1
+        assert vn.lcl_kicks_coalesced == len(keys) - 1
+        # a HIGHER seq kicks again (progress is never coalesced away)
+        v = STValidation.build(hashlib.sha256(b"t6").digest(),
+                               signing_time=now[0] + 1, ledger_seq=6)
+        v.sign(keys[0])
+        assert vn.handle_validation(v)
+        assert len(kicks) == 2
+        assert vn.lcl_inline_kicks == 2
+        j = vn.follower_json()
+        assert j["lcl_inline_kicks"] == 2
+        assert j["lcl_kicks_coalesced"] == len(keys) - 1
+
+
+# -- epoch-pinned snapshot handoff -----------------------------------------
+
+
+def _record(blob: bytes, type_byte: int = 3) -> bytes:
+    key = sha512_half(blob)
+    body = bytes([type_byte]) + blob
+    return struct.pack("<IB", len(body), 0) + key + body
+
+
+class _FakeNet:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, peer, msg):
+        self.sent.append((peer, msg))
+
+
+class TestEpochPinnedHandoff:
+    def _mk(self, net, peers=("a", "b")):
+        stored = []
+        clock = [0.0]
+        sc = SegmentCatchup(
+            send=net.send,
+            peers=lambda: list(peers),
+            store=lambda tb, k, b: stored.append((tb, k, b)),
+            clock=lambda: clock[0],
+            seed=1,
+        )
+        return sc, stored, clock
+
+    def test_wire_fields_round_trip_and_compat(self):
+        # nonzero snap fields survive the codec
+        g = GetSegments(2, 64, snap_epoch=77)
+        fr = FrameReader()
+        (g2,) = fr.feed(frame(g))
+        assert (g2.seg_id, g2.offset, g2.snap_epoch) == (2, 64, 77)
+        d = SegmentData(2, 100, 0, b"xy", snap_epoch=77, snap_seq=41)
+        (d2,) = fr.feed(frame(d))
+        assert (d2.snap_epoch, d2.snap_seq) == (77, 41)
+        # zero fields are NOT emitted: byte-identical legacy wire
+        assert len(frame(GetSegments(2, 64))) < len(frame(g))
+        (d3,) = fr.feed(frame(SegmentData(2, 100, 0, b"xy")))
+        assert d3.snap_epoch == 0 and d3.snap_seq == 0
+
+    def test_epoch_move_restarts_from_fresh_manifest(self):
+        """A chunk stamped with a DIFFERENT epoch than the manifest's
+        (the server rotated/compacted mid-transfer) restarts the
+        session from a fresh manifest — never a peer condemnation,
+        never a torn buffer."""
+        net = _FakeNet()
+        sc, stored, _clock = self._mk(net)
+        sc.start()
+        peer, m0 = net.sent.pop()
+        assert isinstance(m0, GetSegments) and m0.seg_id == -1
+        seg = _record(b"epoch-node")
+        sc.on_manifest(peer, [(0, len(seg), len(seg), False)],
+                       epoch=5, snap_seq=9)
+        assert sc._snap_epoch == 5 and sc._snap_seq == 9
+        peer2, m1 = net.sent.pop()
+        # the chunk fetch is PINNED to the offered epoch
+        assert m1.seg_id == 0 and m1.snap_epoch == 5
+        # server's sealed set moved: chunk arrives under epoch 6
+        sc.on_data(peer2, SegmentData(0, len(seg), 0, seg, snap_epoch=6))
+        assert sc.counters["epoch_restarts"] == 1
+        assert sc.state == "manifest"
+        assert not stored  # nothing torn was kept
+        _peer3, m2 = net.sent.pop()
+        assert m2.seg_id == -1  # fresh manifest request
+        # the retried handoff under the new epoch completes
+        sc.on_manifest(_peer3, [(0, len(seg), len(seg), False)],
+                       epoch=6, snap_seq=10)
+        peer4, m3 = net.sent.pop()
+        assert m3.snap_epoch == 6
+        sc.on_data(peer4, SegmentData(0, len(seg), 0, seg, snap_epoch=6))
+        assert sc.state == "done"
+        assert len(stored) == 1
+
+    def test_same_epoch_and_epochless_chunks_flow(self):
+        net = _FakeNet()
+        sc, stored, _clock = self._mk(net)
+        sc.start()
+        peer, _ = net.sent.pop()
+        seg = _record(b"zz")
+        sc.on_manifest(peer, [(0, len(seg), len(seg), False)], epoch=5)
+        peer2, _ = net.sent.pop()
+        # pre-epoch server: chunks without a stamp are accepted (0 on
+        # the wire means "no epoch", not a mismatch)
+        sc.on_data(peer2, SegmentData(0, len(seg), 0, seg))
+        assert sc.state == "done" and len(stored) == 1
+        assert sc.counters["epoch_restarts"] == 0

@@ -1,23 +1,32 @@
-"""Tier-1 follower smoke: the read-plane scale-out tier as a gate.
+"""Tier-1 follower-TREE smoke: the cascading read tier as a gate.
 
-Boots a LEADER (networked solo validator, quorum=1) and a FOLLOWER
-([node] mode=follower) over a real TCP peer link, floods the leader,
-and asserts the whole follower contract end-to-end:
+Boots a LEADER (networked solo validator, quorum=1), a mid-tier
+follower F1 dialing the leader, and a leaf follower F2 whose
+``[node] upstream=`` names F1 — a depth-2 cascade over real TCP — then
+floods the leader and asserts the whole tree contract end-to-end:
 
-- ingest identity: the follower's ledger hash at EVERY validated seq is
-  byte-identical to the leader's (the ledger hash covers the state and
-  tx tree roots, so this is state-root identity);
-- cold catch-up: the follower boots AFTER the leader has closed
-  ledgers and must join the validated chain (bulk segment path armed);
-- serving mid-flood: read RPCs answered from the follower's real HTTP
-  door WHILE the leader floods, resolved against the validated
-  snapshot, with the validated-seq result cache taking hits;
-- subscription order: ledgerClosed events delivered through the
-  sharded fanout arrive in strictly increasing seq order, and per-tx
-  events ride along;
-- no rounds: the follower never runs consensus (rounds_completed == 0).
+- ingest identity at EVERY tier: F1's and F2's ledger hash at every
+  validated seq is byte-identical to the leader's (the ledger hash
+  covers the state and tx tree roots, so this is state-root identity);
+- O(children) leader egress: F2 lists the leader in [ips] but its
+  upstream= override dials F1 instead, so the leader's peer table
+  holds exactly ONE session (F1) while both followers sync — the
+  leader's fan-out is bounded by its direct children, not the tier;
+- cascade serving: F2 acquires ledgers/segments FROM F1 (its only
+  session), i.e. a follower re-publishes the validated chain
+  downstream;
+- cold catch-up through the tree: both followers boot AFTER the
+  leader has closed ledgers and must join the validated chain;
+- serving mid-flood: read RPCs answered from F1's real HTTP door
+  WHILE the leader floods, with the validated-seq result cache
+  taking hits;
+- resume cursors (reconnect-storm hardening): a subscriber on F2 is
+  dropped mid-stream and a reconnecting client presents its
+  last-delivered seq — the replay ring fills the gap with ZERO missed
+  seqs, and a cursor past the horizon gets the explicit cold answer;
+- no rounds: neither follower ever runs consensus.
 
-Runtime: ~30-60s (clock_speed-accelerated consensus).
+Runtime: ~45-90s (clock_speed-accelerated consensus).
 
 Usage: python tools/followersmoke.py
 """
@@ -58,7 +67,7 @@ def main() -> None:
     from stellard_tpu.testkit.tcpnet import free_ports, rpc, wait_until
 
     tmp = tempfile.mkdtemp(prefix="followersmoke-")
-    leader_peer, follower_peer = free_ports(2)
+    leader_peer, f1_peer, f2_peer = free_ports(3)
     val_key = KeyPair.from_passphrase("followersmoke-leader")
 
     leader = Node(Config(
@@ -74,10 +83,10 @@ def main() -> None:
         rpc_port=0,
     )).setup().serve()
 
-    follower = None
+    f1 = f2 = None
     try:
-        # phase 1: leader alone closes a few ledgers so the follower
-        # later boots COLD and must catch up
+        # phase 1: leader alone closes a few ledgers so the followers
+        # later boot COLD and must catch up
         master = leader.master_keys
 
         def payment(seq: int, dest: bytes) -> SerializedTransaction:
@@ -112,43 +121,74 @@ def main() -> None:
             fail(f"leader never validated 3 ledgers solo "
                  f"(validated={leader_validated()})")
 
-        # phase 2: boot the follower cold
-        follower = Node(Config(
-            standalone=False,
-            node_mode="follower",
-            signature_backend="cpu",
-            node_db_type="segstore",
-            node_db_path=os.path.join(tmp, "follower-ns"),
-            database_path=os.path.join(tmp, "follower.db"),
-            validators=[val_key.human_node_public],
-            validation_quorum=1,
-            peer_port=follower_peer,
-            ips=[f"127.0.0.1 {leader_peer}"],
-            clock_speed=SPEED,
-            rpc_port=0,
+        def follower_cfg(name: str, port: int, dial: list[str],
+                         upstream: list[str]) -> Config:
+            return Config(
+                standalone=False,
+                node_mode="follower",
+                signature_backend="cpu",
+                node_db_type="segstore",
+                node_db_path=os.path.join(tmp, f"{name}-ns"),
+                database_path=os.path.join(tmp, f"{name}.db"),
+                validators=[val_key.human_node_public],
+                validation_quorum=1,
+                peer_port=port,
+                ips=dial,
+                node_upstream=upstream,
+                clock_speed=SPEED,
+                rpc_port=0,
+            )
+
+        # phase 2: boot the mid-tier follower F1 (upstream= names the
+        # leader: tier-1 followers ARE the leader's direct children),
+        # then the leaf F2 — F2 lists the LEADER in [ips] but its
+        # upstream= override must dial F1 instead (the config contract
+        # the tree topology rides on)
+        f1 = Node(follower_cfg(
+            "f1", f1_peer, [], [f"127.0.0.1 {leader_peer}"],
         )).setup().serve()
-        fport = follower.http_server.port
+        f1port = f1.http_server.port
 
-        # subscription plane: ledger + account streams through the
-        # sharded fanout (in-process sink; the WS door rides the same
-        # manager and is covered by the RPC-server suite)
-        events: list[dict] = []
-        sub = InfoSub(events.append)
-        follower.subs.subscribe_streams(sub, ["ledger", "transactions"])
-        follower.subs.subscribe_accounts(sub, [dests[0]])
-
-        def follower_validated():
-            v = follower.ledger_master.validated
+        def validated_of(node):
+            v = node.ledger_master.validated
             return v.seq if v is not None else 0
 
         if not wait_until(
-            lambda: follower_validated() >= leader_validated() - 1
-            and follower_validated() >= 3, 120, 0.5,
+            lambda: validated_of(f1) >= leader_validated() - 1
+            and validated_of(f1) >= 3, 120, 0.5,
         ):
-            fail(f"follower never caught up (follower="
-                 f"{follower_validated()}, leader={leader_validated()})")
+            fail(f"F1 never caught up (f1={validated_of(f1)}, "
+                 f"leader={leader_validated()})")
 
-        # phase 3: flood the leader WHILE reading from the follower
+        f2 = Node(follower_cfg(
+            "f2", f2_peer, [f"127.0.0.1 {leader_peer}"],
+            [f"127.0.0.1 {f1_peer}"],
+        )).setup().serve()
+
+        # resume-cursor leg, part 1: a ledger-stream subscriber on the
+        # LEAF follower accumulates events it will later resume past
+        events_a: list[dict] = []
+        sub_a = InfoSub(events_a.append)
+        f2.subs.subscribe_streams(sub_a, ["ledger"])
+
+        if not wait_until(
+            lambda: validated_of(f2) >= leader_validated() - 1
+            and validated_of(f2) >= 3, 120, 0.5,
+        ):
+            fail(f"F2 never caught up through F1 (f2={validated_of(f2)}, "
+                 f"f1={validated_of(f1)}, leader={leader_validated()})")
+
+        # gate 1: O(children) leader egress — the leader holds exactly
+        # ONE peer session (F1); F2's upstream= kept it off the leader
+        leader_peers = len(leader.overlay.peers)
+        if leader_peers != 1:
+            fail(f"leader egress not bounded by direct children: "
+                 f"{leader_peers} peer sessions (want 1 — F1 only)")
+        if len(f2.overlay.peers) != 1:
+            fail(f"F2 should hold exactly its upstream session, has "
+                 f"{len(f2.overlay.peers)}")
+
+        # phase 3: flood the leader WHILE reading from F1
         reads = {"ok": 0, "err": 0}
         stop_flood = threading.Event()
 
@@ -165,16 +205,16 @@ def main() -> None:
 
         flooder = threading.Thread(target=flood, daemon=True)
         flooder.start()
-        t_end = time.monotonic() + 15.0
+        t_end = time.monotonic() + 12.0
         master_id = master.human_account_id
         while time.monotonic() < t_end:
             try:
-                r = rpc(fport, "account_info", {"account": master_id})
+                r = rpc(f1port, "account_info", {"account": master_id})
                 if r.get("status") == "success" and "account_data" in r:
                     reads["ok"] += 1
                 else:
                     reads["err"] += 1
-                r = rpc(fport, "ledger", {"ledger_index": "validated"})
+                r = rpc(f1port, "ledger", {"ledger_index": "validated"})
                 if r.get("status") != "success":
                     reads["err"] += 1
             except Exception:
@@ -184,86 +224,138 @@ def main() -> None:
         flooder.join(timeout=5)
 
         if reads["ok"] < 20:
-            fail(f"follower served too few reads mid-flood: {reads}")
+            fail(f"F1 served too few reads mid-flood: {reads}")
         if reads["err"] > reads["ok"] // 10:
-            fail(f"follower read errors mid-flood: {reads}")
+            fail(f"F1 read errors mid-flood: {reads}")
 
-        # let the tail drain: follower converges on the leader's tip
+        # resume-cursor leg, part 2: the client "drops" (unregisters)
+        # holding a cursor, misses a few closes, then reconnects and
+        # resumes — the ring must replay the gap with zero missed seqs
+        f2.subs.flush(timeout=10.0)
+        a_seqs = [e["ledger_index"] for e in events_a
+                  if e.get("type") == "ledgerClosed"]
+        if len(a_seqs) < 3:
+            fail(f"too few ledgerClosed events before the drop: {a_seqs}")
+        cursor = max(a_seqs)
+        f2.subs.remove(sub_a.id)
+
+        if not wait_until(
+            lambda: validated_of(f2) >= cursor + 2, 120, 0.5,
+        ):
+            fail(f"F2 never advanced past the dropped cursor "
+                 f"(cursor={cursor}, f2={validated_of(f2)})")
+
+        events_b: list[dict] = []
+        sub_b = InfoSub(events_b.append)
+        res = f2.subs.resume(sub_b, cursor)
+        if not res.get("resumed") or res.get("cold"):
+            fail(f"resume from live cursor {cursor} answered cold: {res}")
+        if res.get("replayed", 0) < 1:
+            fail(f"resume replayed nothing past cursor {cursor}: {res}")
+        f2.subs.flush(timeout=10.0)
+        b_seqs = [e["ledger_index"] for e in events_b
+                  if e.get("type") == "ledgerClosed"]
+        if not b_seqs:
+            fail("resumed subscriber received no events")
+        if b_seqs != sorted(set(b_seqs)):
+            fail(f"resumed stream out of order or duplicated: {b_seqs}")
+        combined = sorted(set(a_seqs) | set(b_seqs))
+        expect = list(range(combined[0], combined[-1] + 1))
+        if combined != expect:
+            fail(f"resume left a gap: delivered {combined}, "
+                 f"want contiguous {expect[0]}..{expect[-1]}")
+        if min(b_seqs) != cursor + 1:
+            fail(f"resume did not restart at cursor+1: first replayed "
+                 f"{min(b_seqs)}, cursor {cursor}")
+
+        # a cursor past the horizon must get the EXPLICIT cold answer
+        # (never a silent gap): seq 0 predates any ring entry
+        probe = f2.subs.resume(InfoSub(lambda m: None), 0)
+        if not probe.get("cold"):
+            fail(f"past-horizon resume not answered cold: {probe}")
+
+        # let the tail drain: both tiers converge on the leader's tip
         target = leader_validated()
-        if not wait_until(lambda: follower_validated() >= target, 120, 0.5):
-            fail(f"follower stalled at {follower_validated()} "
-                 f"(leader={target})")
+        if not wait_until(
+            lambda: validated_of(f1) >= target
+            and validated_of(f2) >= target, 120, 0.5,
+        ):
+            fail(f"tree stalled (f1={validated_of(f1)}, "
+                 f"f2={validated_of(f2)}, leader={target})")
 
-        # gate 1: state-root byte identity at EVERY validated seq
-        common = min(leader_validated(), follower_validated())
+        # gate 2: state-root byte identity at EVERY validated seq,
+        # at EVERY tier
+        common = min(leader_validated(), validated_of(f1),
+                     validated_of(f2))
         lh = leader.ledger_master.ledger_history
-        fh = follower.ledger_master.ledger_history
         checked = 0
         for seq in range(2, common + 1):
-            a, b = lh.get(seq), fh.get(seq)
-            if a is None or b is None:
+            a = lh.get(seq)
+            b1 = f1.ledger_master.ledger_history.get(seq)
+            b2 = f2.ledger_master.ledger_history.get(seq)
+            if a is None:
                 continue  # aged out of the bounded index
-            if a != b:
-                fail(f"ledger hash mismatch at seq {seq}: "
-                     f"{a.hex()} != {b.hex()}")
-            checked += 1
+            for tier, b in (("f1", b1), ("f2", b2)):
+                if b is not None and a != b:
+                    fail(f"ledger hash mismatch at {tier} seq {seq}: "
+                         f"{a.hex()} != {b.hex()}")
+            if b1 is not None and b2 is not None:
+                checked += 1
         if checked < 3:
             fail(f"too few comparable seqs ({checked})")
 
-        # gate 2: the follower never ran consensus, and actually
+        # gate 3: neither follower ever ran consensus, both actually
         # ingested (anti-vacuity)
-        vn = follower.overlay.node
-        if vn.rounds_completed != 0:
-            fail(f"follower completed {vn.rounds_completed} consensus "
-                 f"rounds — it must never close")
-        if vn.ledgers_ingested < 3:
-            fail(f"follower ingested only {vn.ledgers_ingested} ledgers")
+        for name, f in (("f1", f1), ("f2", f2)):
+            vn = f.overlay.node
+            if vn.rounds_completed != 0:
+                fail(f"{name} completed {vn.rounds_completed} consensus "
+                     f"rounds — followers must never close")
+            if vn.ledgers_ingested < 3:
+                fail(f"{name} ingested only {vn.ledgers_ingested} ledgers")
 
-        # gate 3: the result cache took hits (repeated identical read
-        # against one validated seq) and reads resolved from the
-        # validated snapshot
+        # gate 4: the result cache took hits on the serving tier
         for _ in range(5):
-            rpc(fport, "account_info", {"account": master_id})
-        cj = follower.read_cache.get_json()
+            rpc(f1port, "account_info", {"account": master_id})
+        cj = f1.read_cache.get_json()
         if cj["hits"] <= 0:
             fail(f"validated-seq result cache never hit: {cj}")
-        if follower.read_plane.snapshot() is None:
-            fail("follower read plane never published a snapshot")
+        if f1.read_plane.snapshot() is None:
+            fail("F1 read plane never published a snapshot")
 
-        # gate 4: subscription events delivered IN ORDER through the
-        # sharded fanout
-        if not follower.subs.flush(timeout=10.0):
-            fail("fanout shards never drained")
-        closed_seqs = [e["ledger_index"] for e in events
-                       if e.get("type") == "ledgerClosed"]
-        if len(closed_seqs) < 3:
-            fail(f"too few ledgerClosed events: {closed_seqs}")
-        if closed_seqs != sorted(closed_seqs) or len(set(closed_seqs)) != len(
-            closed_seqs
-        ):
-            fail(f"ledgerClosed events out of order: {closed_seqs}")
-        if not any(e.get("type") == "transaction" for e in events):
-            fail("no transaction events delivered")
-
-        sj = follower.subs.get_json()
+        vn1 = f1.overlay.node
+        vn2 = f2.overlay.node
+        sj = f2.subs.get_json()
         print(json.dumps({
             "follower_smoke": "ok",
             "validated_seq": common,
             "seqs_hash_checked": checked,
-            "ledgers_ingested": vn.ledgers_ingested,
+            "leader_peer_sessions": leader_peers,
+            "ledgers_ingested": {
+                "f1": vn1.ledgers_ingested, "f2": vn2.ledgers_ingested,
+            },
+            "lcl_kicks": {
+                "inline": vn2.lcl_inline_kicks,
+                "coalesced": vn2.lcl_kicks_coalesced,
+            },
             "reads_mid_flood": reads,
             "cache": {k: cj[k] for k in ("hits", "misses", "hit_rate")},
-            "subs": {k: sj[k] for k in ("published", "delivered",
-                                        "dropped_events")},
-            "segfetch_started": (
-                vn.segment_catchup.get_json()["started"]
-                if vn.segment_catchup is not None else 0
-            ),
-            "ledger_closed_events": len(closed_seqs),
+            "resume": res,
+            "resume_counters": {
+                k: sj[k] for k in ("resumed", "resume_replayed",
+                                   "resume_cold", "dup_suppressed")
+            },
+            "segfetch": {
+                name: (vn.segment_catchup.get_json()
+                       if vn.segment_catchup is not None else {})
+                for name, vn in (("f1", vn1), ("f2", vn2))
+            },
         }), flush=True)
     finally:
-        if follower is not None:
-            follower.stop()
+        if f2 is not None:
+            f2.stop()
+        if f1 is not None:
+            f1.stop()
         leader.stop()
         import shutil
 

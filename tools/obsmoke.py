@@ -314,6 +314,9 @@ def main() -> None:
         if not wait_until(tripped, STALL_WARN_S + 30, 0.5):
             fail(f"watchdog slept through an injected stall: "
                  f"{[f.health.get_json() for f in followers]}")
+        # the status flips BEFORE the transition callback finishes its
+        # fsync'd dump — give the watchdog thread a beat to land it
+        wait_until(lambda: all(f.flight.dumps for f in followers), 30, 0.5)
         for i, f in enumerate(followers):
             reasons = f.health.get_json()["reasons"]
             if not any(r.startswith("close_stall") for r in reasons):

@@ -134,13 +134,17 @@ if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/floodsmoke.py; then
   exit 2
 fi
 
-echo "== follower read-plane smoke gate (leader+follower over TCP, identity + serving) =="
-# boots a solo leader validator and a cold follower over a real TCP
-# peer link, floods the leader, and asserts: follower ledger hashes
-# byte-identical to the leader's at every validated seq, read RPCs
-# served from the follower's HTTP door mid-flood with the validated-seq
-# cache hitting, subscription events in order through the sharded
-# fanout, and zero consensus rounds on the follower
+echo "== follower tree smoke gate (leader <- F1 <- F2 cascade over TCP, identity + resume) =="
+# boots a solo leader and a depth-2 follower cascade (F1 pinned to the
+# leader, F2 pinned to F1 — the leader holds exactly ONE peer session,
+# its egress is O(children) not O(followers)), floods the leader, and
+# asserts: BOTH tiers' ledger hashes byte-identical to the leader's at
+# every validated seq, F2 cold-syncs through F1's epoch-stamped sealed
+# shards (snapshot handoff via the GetSegments door), read RPCs served
+# from F1 mid-flood with the validated-seq cache hitting, a dropped
+# subscriber on F2 resuming from its seq cursor with zero gap while a
+# past-horizon cursor gets the explicit cold answer, and zero consensus
+# rounds on either follower
 if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/followersmoke.py; then
   echo "FOLLOWER SMOKE FAILED — read-plane tier is broken" >&2
   exit 2
