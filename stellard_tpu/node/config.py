@@ -143,11 +143,15 @@ class Config:
     standalone: bool = True
     start_up: str = "fresh"  # fresh | load
     ledger_history: int = 256  # reference [ledger_history]
-    # [node] mode=validator|follower — follower is the read-only tier
-    # (doc/follower.md): no consensus rounds, validated ledgers ingested
-    # from the net (bulk GetSegments catch-up + validation tailing),
-    # reads served from the last validated snapshot with the result
-    # cache on by default. "validator" is the classic networked node.
+    # [node] mode=validator|follower|archive — follower is the read-only
+    # tier (doc/follower.md): no consensus rounds, validated ledgers
+    # ingested from the net (bulk GetSegments catch-up + validation
+    # tailing), reads served from the last validated snapshot with the
+    # result cache on by default. "archive" is the full-history
+    # reporting tier (doc/archive.md): follower ingest of the validated
+    # tail PLUS deep-history backfill of sealed shards from peers, a
+    # txdb that never trims, and forever-cached immutable-seq results.
+    # "validator" is the classic networked node.
     node_mode: str = "validator"
     # [node] upstream= "host port" lines (follower trees, doc/follower.md):
     # a follower dials THESE instead of [ips] as its serving tier —
@@ -156,6 +160,17 @@ class Config:
     # egress is bounded by its direct children, not the fleet. Empty =
     # dial [ips] (the flat PR 9 topology). Ignored on validators.
     node_upstream: list[str] = field(default_factory=list)
+
+    # -- archive tier ([archive], doc/archive.md) --------------------------
+    # shard-import directory for mode=archive (the archive's OWN sealed
+    # set, distinct from [node_db] shards=). "" derives
+    # <node_db path or database_path>.archive-shards.
+    archive_path: str = ""
+    # backfill=0 disables the deep-history fetcher (tail-only archive);
+    # on by default — an archive that never backfills is a follower
+    archive_backfill: int = 1
+    # re-poll peers' manifests for newly sealed shards every N seconds
+    archive_rescan_s: float = 30.0
 
     # -- storage ([node_db], [database_path]) ------------------------------
     node_db_type: str = "memory"
@@ -489,11 +504,11 @@ class Config:
         node_sec = _kv(s.get("node", []))
         if "mode" in node_sec:
             cfg.node_mode = node_sec["mode"].lower()
-            if cfg.node_mode not in ("validator", "follower"):
+            if cfg.node_mode not in ("validator", "follower", "archive"):
                 # a mode toggle must not fail open into a validator that
                 # proposes when the operator believes it is read-only
                 raise ValueError(
-                    f"[node] mode must be validator/follower, "
+                    f"[node] mode must be validator/follower/archive, "
                     f"got {cfg.node_mode!r}"
                 )
         # upstream= repeats (one "host port" line per upstream, like
@@ -504,13 +519,32 @@ class Config:
             if "=" in line and line.split("=", 1)[0].strip() == "upstream"
         ]
         if upstreams:
-            if cfg.node_mode != "follower":
+            if cfg.node_mode not in ("follower", "archive"):
                 # an upstream on a validator would parse clean and be
                 # silently dropped — the dead-config class again
                 raise ValueError(
-                    "[node] upstream= only applies to mode=follower"
+                    "[node] upstream= only applies to mode=follower/archive"
                 )
             cfg.node_upstream = upstreams
+        archive_sec = _kv(s.get("archive", []))
+        if archive_sec:
+            if cfg.node_mode != "archive":
+                # [archive] on a validator/follower would parse clean
+                # and be silently dropped — the dead-config class again
+                raise ValueError(
+                    "[archive] only applies to [node] mode=archive"
+                )
+            _reject_unknown("archive", archive_sec,
+                            ("path", "backfill", "rescan_s"))
+            cfg.archive_path = archive_sec.get("path", cfg.archive_path)
+            if "backfill" in archive_sec:
+                cfg.archive_backfill = int(archive_sec["backfill"])
+            if "rescan_s" in archive_sec:
+                cfg.archive_rescan_s = float(archive_sec["rescan_s"])
+                if cfg.archive_rescan_s <= 0:
+                    raise ValueError(
+                        "[archive] rescan_s must be positive"
+                    )
         if one("ledger_history"):
             cfg.ledger_history = int(one("ledger_history"))
 

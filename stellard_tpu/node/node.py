@@ -165,7 +165,17 @@ class Node:
         if cfg.node_db_type == "sqlite" and cfg.node_db_synchronous:
             db_kwargs["synchronous"] = cfg.node_db_synchronous
         self.nodestore = make_database(type=cfg.node_db_type, **db_kwargs)
-        self.txdb = TxDatabase(cfg.database_path or ":memory:")
+        # [node] mode=archive (doc/archive.md): the full-history
+        # reporting tier — follower ingest + deep-history shard
+        # backfill + a txdb that NEVER trims + forever-cached
+        # immutable-seq results
+        self.archive = cfg.node_mode == "archive"
+        if self.archive:
+            from .archive import ArchiveTxDatabase
+
+            self.txdb = ArchiveTxDatabase(cfg.database_path or ":memory:")
+        else:
+            self.txdb = TxDatabase(cfg.database_path or ":memory:")
 
         # out-of-core state plane ([tree] cache_mb): the process-wide
         # hot-node cache is the resident set for lazily-faulted trees —
@@ -185,6 +195,19 @@ class Node:
             if shards_path.lower() in ("1", "true", "yes", "on"):
                 shards_path = (cfg.node_db_path or "nodestore") + ".shards"
             self.shardstore = HistoryShardStore(shards_path)
+        elif self.archive:
+            # an archive ALWAYS has a shard store: it is the import
+            # target of the backfill, the serving source for deep
+            # account_tx, and (via the segment manifest) this node's
+            # own advertisement in the shard distribution network —
+            # imported shards re-serve downstream ([archive] path=)
+            from ..nodestore.shards import HistoryShardStore
+
+            self.shardstore = HistoryShardStore(
+                cfg.archive_path
+                or (cfg.node_db_path or cfg.database_path or "archive")
+                + ".archive-shards"
+            )
 
         # stellar CLF plane: SQL mirror + LCL pointer (reference:
         # stellar::gLedgerMaster + workingledger.db, Application.cpp:716)
@@ -221,6 +244,15 @@ class Node:
         # validator's disk bounded near the live set ([node_db]
         # online_delete=N; requires a backend with liveness — segstore)
         self.online_deleter = None
+        if self.archive and cfg.node_db_online_delete > 0:
+            # the archive contract is FULL history; a rotation sweep
+            # would silently contradict it (and ArchiveTxDatabase
+            # refuses the SQL trim anyway) — reject the config loudly
+            raise ValueError(
+                "[node_db] online_delete is incompatible with [node] "
+                "mode=archive: the archive tier keeps full history "
+                "(doc/archive.md)"
+            )
         if cfg.node_db_online_delete > 0:
             if not getattr(
                 self.nodestore.backend, "supports_online_delete", False
@@ -482,12 +514,14 @@ class Node:
         self.overlay = None
         # [node] mode=follower (doc/follower.md): the read-only serving
         # tier — no consensus rounds, validated ledgers ingested from
-        # the net, reads served from the last validated snapshot
-        self.follower = cfg.node_mode == "follower"
+        # the net, reads served from the last validated snapshot.
+        # mode=archive (doc/archive.md) runs the follower ingest plane
+        # unchanged and layers deep-history backfill on top.
+        self.follower = cfg.node_mode in ("follower", "archive")
         if self.follower and (cfg.standalone or not cfg.peer_port):
             raise ValueError(
-                "[node] mode=follower requires a networked node "
-                "([peer_port] set, standalone=0) — a follower ingests "
+                f"[node] mode={cfg.node_mode} requires a networked node "
+                "([peer_port] set, standalone=0) — it ingests "
                 "validated ledgers from its peers"
             )
         if cfg.peer_port and not cfg.standalone:
@@ -651,6 +685,51 @@ class Node:
                     # excludes WARN-or-worse endpoints)
                     on_condemn=lambda pub: self.overlay.charge_peer(
                         pub, FEE_GARBAGE_SEGMENT
+                    ),
+                )
+
+            # archive deep-history backfill (doc/archive.md): a second
+            # fetcher on the same GetSegments door — peers' manifests
+            # advertise sealed shard ranges, the backfill pulls whole
+            # verified shard files for every range this node lacks and
+            # fans each import out to the nodestore + full-history txdb
+            if self.archive and cfg.archive_backfill:
+                from ..nodestore.core import NodeObjectType as _NOT
+                from ..overlay.resource import (
+                    FEE_GARBAGE_SEGMENT as _FEE_GS,
+                )
+                from .archive import ShardBackfill, feed_shard
+
+                vn = self.overlay.node
+                if vn.segment_source is None:
+                    # no segment-capable live backend: the archive
+                    # still advertises + re-serves its imported shards
+                    # (the distribution network's re-serve half)
+                    vn.segment_source = self.shardstore
+
+                def _on_shard_imported(res: dict) -> None:
+                    feed_shard(
+                        self.shardstore, res["id"],
+                        store=lambda tb, key, blob: self.nodestore.store(
+                            _NOT(tb), key, blob
+                        ),
+                        txdb=self.txdb,
+                    )
+                    self._update_archive_floor()
+
+                vn.shard_backfill = ShardBackfill(
+                    send=self.overlay.send_segments_request,
+                    peers=self.overlay.segment_peers,
+                    shardstore=self.shardstore,
+                    clock=self.overlay._clock,
+                    rescan_s=cfg.archive_rescan_s,
+                    note_byzantine=vn.note_byzantine,
+                    on_imported=_on_shard_imported,
+                    # unified peer scoring (same stance as catch-up): a
+                    # peer whose shard fails verification takes the
+                    # garbage-segment charge on its overlay endpoint
+                    on_condemn=lambda pub: self.overlay.charge_peer(
+                        pub, _FEE_GS
                     ),
                 )
 
@@ -819,6 +898,12 @@ class Node:
         )
         self.read_plane = ReadPlane(cache=self.read_cache)
         self.ops.read_plane = self.read_plane
+        if self.archive:
+            # forever-cache eligibility (doc/archive.md): results whose
+            # window closes at or below the verified floor are
+            # immutable. A restarted archive re-publishes the floor of
+            # whatever it already holds before any backfill runs.
+            self._update_archive_floor()
         # the validated floor: on a quorum net validations land after
         # the close persisted, and this hook is what opens the epoch
         # (the read plane publishes min(persisted, validated))
@@ -1370,6 +1455,17 @@ class Node:
 
             shutil.rmtree(self._tmp_tls_dir, ignore_errors=True)
             self._tmp_tls_dir = None
+
+    def _update_archive_floor(self) -> None:
+        """Publish the archive's verified floor — the contiguous
+        sealed-shard coverage hi (``HistoryShardStore.contiguous_floor``)
+        — to the read plane's forever tier: any result whose request
+        window closes at or below it is backed by offline-verified
+        shard bytes and immutable, so it is cached forever instead of
+        per epoch."""
+        rp = getattr(self, "read_plane", None)
+        if rp is not None and self.shardstore is not None:
+            rp.set_archive_floor(self.shardstore.contiguous_floor())
 
     # -- persistence on close (reference: pendSaveValidated + CLF commit) --
 

@@ -266,6 +266,27 @@ class TxDatabase:
             )
             self._commit()
 
+    def save_header_dicts(self, headers: list[dict]) -> None:
+        """Header rows from parsed header DICTS (state.ledger.parse_header
+        keys plus ``hash``) — the shard-import feed holds raw header
+        records, never Ledger objects. One transaction for the batch."""
+        rows = [
+            (
+                h["hash"].hex(), h["seq"], h["parent_hash"].hex(),
+                h.get("tot_coins", 0), h.get("close_time", 0),
+                h.get("parent_close_time", 0),
+                h.get("close_resolution", 0), h.get("close_flags", 0),
+                h["account_hash"].hex(), h["tx_hash"].hex(),
+            )
+            for h in headers
+        ]
+        with self._lock:
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO Ledgers VALUES (?,?,?,?,?,?,?,?,?,?)",
+                rows,
+            )
+            self._commit()
+
     def get_ledger_header(self, seq: Optional[int] = None,
                           ledger_hash: Optional[bytes] = None) -> Optional[dict]:
         q = "SELECT LedgerHash, LedgerSeq, PrevHash, TotalCoins, ClosingTime, \

@@ -173,6 +173,9 @@ class ValidatorNode:
         # segments()/fetch_segment(), i.e. the segstore backend).
         self.segment_catchup = None
         self.segment_source = None
+        # archive mode: the deep-history shard backfill driver
+        # (node/archive.ShardBackfill), ticked next to segment_catchup
+        self.shard_backfill = None
         # follower ingest observability (`follower.ingest` spans +
         # get_counts block): validation-seen -> adopted latency per
         # ingested ledger, plus plain counters
@@ -284,6 +287,8 @@ class ValidatorNode:
         # the segment bulk path's timeout/retry/backoff clock
         if self.segment_catchup is not None:
             self.segment_catchup.tick(self.clock())
+        if self.shard_backfill is not None:
+            self.shard_backfill.tick(self.clock())
         self._update_health()
 
     # -- health ------------------------------------------------------------
@@ -320,6 +325,9 @@ class ValidatorNode:
         sc = self.segment_catchup
         if sc is not None:
             out["segfetch"] = sc.get_json()
+        sb = self.shard_backfill
+        if sb is not None:
+            out["shard_backfill"] = sb.get_json()
         return out
 
     def _update_health(self) -> None:
@@ -851,8 +859,13 @@ class ValidatorNode:
         epoch = self.snapshot_epoch()
         snap_seq = self.lm.validated.seq if self.lm.validated else 0
         if msg.seg_id < 0:
+            # shard rows carry their sealed seq range + full file size
+            # (nonzero-only on the wire: segstore rows encode exactly
+            # as before) so range-selecting peers never probe
             rows = [
-                (d["id"], d["size"], d["live_bytes"], bool(d["active"]))
+                (d["id"], d["size"], d["live_bytes"], bool(d["active"]),
+                 int(d.get("lo", 0)), int(d.get("hi", 0)),
+                 int(d.get("file_bytes", 0)))
                 for d in src.segments()
             ]
             return SegmentData(seg_id=-1, segments=rows,
@@ -887,14 +900,27 @@ class ValidatorNode:
 
     def handle_segment_data(self, peer, msg) -> None:
         """Route a SegmentData reply into the bulk catch-up machinery
-        (`peer` is the transport's peer id — simnet nid / node public)."""
+        (`peer` is the transport's peer id — simnet nid / node public).
+        Archive nodes run a second fetcher on the same door: manifests
+        feed BOTH (each selects its own rows), whole-shard-file chunks
+        (ids at or above SHARD_FILE_BASE) go to the backfill."""
+        from ..nodestore.shards import SHARD_FILE_BASE
+
+        sb = self.shard_backfill
         sc = self.segment_catchup
-        if sc is None:
-            return
         if msg.seg_id < 0:
-            sc.on_manifest(peer, msg.segments, epoch=msg.snap_epoch,
-                           snap_seq=msg.snap_seq)
-        else:
+            if sc is not None:
+                sc.on_manifest(peer, msg.segments, epoch=msg.snap_epoch,
+                               snap_seq=msg.snap_seq)
+            if sb is not None:
+                sb.on_manifest(peer, msg.segments, epoch=msg.snap_epoch,
+                               snap_seq=msg.snap_seq)
+            return
+        if msg.seg_id >= SHARD_FILE_BASE:
+            if sb is not None:
+                sb.on_data(peer, msg)
+            return
+        if sc is not None:
             sc.on_data(peer, msg)
 
     @_locked

@@ -46,7 +46,8 @@ from ..utils.hashes import (
 
 __all__ = [
     "HistoryShardStore", "CombinedSegmentSource", "collect_retired",
-    "mark_live", "rotate_into_shards", "SHARD_SEG_BASE",
+    "mark_live", "rotate_into_shards", "verify_shard_blob",
+    "SHARD_SEG_BASE", "SHARD_FILE_BASE",
 ]
 
 _MAGIC = b"SHARD1\x00\x00"
@@ -60,6 +61,14 @@ _REC_HEADER = 37  # u32 body_len + u8 flags + 32B key (segstore layout)
 # far above any plausible segstore segment id, well below the 44-bit
 # loc shift, so the two id spaces can never collide
 SHARD_SEG_BASE = 1 << 30
+
+# id offset for the WHOLE-FILE shard distribution door (archive
+# backfill): ``SHARD_FILE_BASE + sid`` serves the complete shard file
+# (header + records + account index + CRC) so a fetching archive can
+# run the full offline-verification contract against the transferred
+# image before installing it. Disjoint from — and above — the
+# record-section id space at SHARD_SEG_BASE.
+SHARD_FILE_BASE = 1 << 31
 
 # NodeObjectType values (nodestore.core) — plain ints here so the shard
 # format is self-contained for offline verifiers
@@ -163,6 +172,90 @@ def mark_live(fetch, headers: list[dict], live: set) -> None:
                         stack.append(blob[4 + 32 * i: 36 + 32 * i])
 
 
+def verify_shard_blob(blob: bytes) -> dict:
+    """The offline verification contract run against RAW SHARD BYTES
+    alone — the archive-import gate (doc/archive.md). Checks magic +
+    header geometry, the whole-file CRC, every record's content hash,
+    and the lo..hi ledger-header chain anchored at the header's
+    first/last ledger hashes; the records count is DERIVED during the
+    pass (it lives in the store index, not the file), so a fetched
+    image is installable without trusting anything but its bytes. On
+    success the report carries the parsed geometry (`lo`/`hi`/
+    `rec_off`/`rec_len`/`acct_off`/`acct_len`/`records`/`first_hash`/
+    `last_hash`) an importer needs to index the file."""
+    report: dict = {"ok": False}
+    if len(blob) < _HDR_SIZE + 4 or blob[:8] != _MAGIC:
+        report["error"] = "bad magic/size"
+        return report
+    version, lo, hi, rec_off, rec_len, acct_off, acct_len = \
+        _HDR.unpack_from(blob, len(_MAGIC))
+    first_hash = blob[len(_MAGIC) + _HDR.size: len(_MAGIC) + _HDR.size + 32]
+    last_hash = blob[len(_MAGIC) + _HDR.size + 32: _HDR_SIZE]
+    report.update({"lo": lo, "hi": hi})
+    if version != _VERSION:
+        report["error"] = "bad version"
+        return report
+    if not (0 < lo <= hi):
+        report["error"] = "bad range"
+        return report
+    if (rec_off != _HDR_SIZE or acct_off != rec_off + rec_len
+            or acct_len < 4 or acct_off + acct_len + 4 != len(blob)):
+        report["error"] = "bad geometry"
+        return report
+    body, crc = blob[:-4], struct.unpack("<I", blob[-4:])[0]
+    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+        report["error"] = "crc mismatch"
+        return report
+    (n_acct,) = struct.unpack_from("<I", blob, acct_off)
+    if 4 + n_acct * _ACCT_ROW.size != acct_len:
+        report["error"] = "bad acct index"
+        return report
+    rec_img = blob[rec_off: rec_off + rec_len]
+    n_checked = bad = consumed = 0
+    headers: dict[int, dict] = {}
+    ledger_prefix = HP_LEDGER_MASTER.to_bytes(4, "big")
+    for key, type_byte, off, ln in _iter_records_py(rec_img):
+        node = rec_img[off: off + ln]
+        if sha512_half(node) != key:
+            bad += 1
+        n_checked += 1
+        consumed = off + ln
+        if type_byte == _T_LEDGER and node[:4] == ledger_prefix:
+            from ..state.ledger import parse_header
+
+            h = parse_header(node[4:])
+            headers[h["seq"]] = {
+                "hash": key, "parent_hash": h["parent_hash"],
+            }
+    report["records"] = n_checked
+    report["bad_records"] = bad
+    chain_ok = True
+    for seq in range(lo, hi + 1):
+        if seq not in headers:
+            chain_ok = False
+            break
+        if seq > lo and \
+                headers[seq]["parent_hash"] != headers[seq - 1]["hash"]:
+            chain_ok = False
+            break
+    report["header_chain_ok"] = chain_ok
+    report["first_hash_ok"] = headers.get(lo, {}).get("hash") == first_hash
+    report["last_hash_ok"] = headers.get(hi, {}).get("hash") == last_hash
+    report["ok"] = (
+        bad == 0 and consumed == rec_len and chain_ok
+        and report["first_hash_ok"] and report["last_hash_ok"]
+    )
+    if report["ok"]:
+        report.update({
+            "rec_off": rec_off, "rec_len": rec_len,
+            "acct_off": acct_off, "acct_len": acct_len,
+            "first_hash": first_hash, "last_hash": last_hash,
+        })
+    elif "error" not in report:
+        report["error"] = "content verification failed"
+    return report
+
+
 class _Shard:
     __slots__ = ("sid", "path", "lo", "hi", "rec_off", "rec_len",
                  "acct_off", "acct_len", "records", "bytes",
@@ -208,6 +301,10 @@ class HistoryShardStore:
         self.account_tx_rows = 0
         self.tx_faults = 0
         self.verifies = 0
+        # archive-backfill import counters
+        self.imported = 0
+        self.imported_bytes = 0
+        self.import_rejects = 0
         self._load_index()
 
     # -- open --------------------------------------------------------------
@@ -365,14 +462,41 @@ class HistoryShardStore:
                 "account_tx_rows": self.account_tx_rows,
                 "tx_faults": self.tx_faults,
                 "verifies": self.verifies,
+                "imported": self.imported,
+                "imported_bytes": self.imported_bytes,
+                "import_rejects": self.import_rejects,
+                "contiguous_floor": self.contiguous_floor(),
             }
+
+    def contiguous_floor(self) -> int:
+        """Highest seq covered by an UNBROKEN run of sealed shards
+        starting at the store's lowest covered seq (0 = empty). This is
+        the archive's verified floor: every result whose window closes
+        at or below it is backed by offline-verified shard bytes and
+        immutable, so the read plane may cache it forever."""
+        with self._lock:
+            spans = sorted((sh.lo, sh.hi) for sh in self._shards.values())
+        if not spans:
+            return 0
+        hi = spans[0][1]
+        for s_lo, s_hi in spans[1:]:
+            if s_lo > hi + 1:
+                break
+            hi = max(hi, s_hi)
+        return hi
 
     # -- the segment-manifest door (cold catch-up) -------------------------
 
     def segments(self) -> list[dict]:
         """Manifest rows in the segstore ``segments()`` shape, ids
         offset by SHARD_SEG_BASE — the record section is byte-served so
-        the existing SegmentCatchup ingest verifies it unchanged."""
+        the existing SegmentCatchup ingest verifies it unchanged.
+
+        Shard rows additionally advertise the sealed range (``lo``/
+        ``hi``) and the full on-disk file size (``file_bytes``) so
+        catch-up and archive peers SELECT by seq range without probing;
+        the wire encoder rides all three nonzero-only, keeping legacy
+        manifest frames byte-identical."""
         with self._lock:
             return [
                 {
@@ -380,6 +504,9 @@ class HistoryShardStore:
                     "size": sh.rec_len,
                     "live_bytes": sh.rec_len,
                     "active": False,
+                    "lo": sh.lo,
+                    "hi": sh.hi,
+                    "file_bytes": sh.bytes,
                 }
                 for sh in sorted(self._shards.values(),
                                  key=lambda s: s.sid)
@@ -396,7 +523,11 @@ class HistoryShardStore:
                       length: Optional[int] = None,
                       ) -> Optional[tuple[dict, bytes]]:
         """One bounded chunk of a shard's RECORD section (same contract
-        as segstore.fetch_segment: meta carries the full section size)."""
+        as segstore.fetch_segment: meta carries the full section size).
+        Ids at or above SHARD_FILE_BASE serve the WHOLE shard file
+        instead — the archive-backfill distribution door."""
+        if seg_id >= SHARD_FILE_BASE:
+            return self._fetch_file(seg_id, offset, length)
         sid = seg_id - SHARD_SEG_BASE
         with self._lock:
             sh = self._shards.get(sid)
@@ -419,6 +550,124 @@ class HistoryShardStore:
                 },
                 data,
             )
+
+    def _fetch_file(self, seg_id: int, offset: int = 0,
+                    length: Optional[int] = None,
+                    ) -> Optional[tuple[dict, bytes]]:
+        """One bounded chunk of the COMPLETE shard file (header +
+        records + account index + CRC): the transferred image is
+        exactly what ``verify_shard_blob`` checks and ``import_shard``
+        installs, so a fetching archive trusts nothing but the bytes."""
+        sid = seg_id - SHARD_FILE_BASE
+        with self._lock:
+            sh = self._shards.get(sid)
+            if sh is None:
+                return None
+            off = max(0, int(offset))
+            n = sh.bytes - off
+            if length is not None:
+                n = min(n, int(length))
+            data = b""
+            if n > 0:
+                data = os.pread(self._fd(sh), n, off)
+            self.segment_reads += 1
+            return (
+                {
+                    "id": seg_id,
+                    "size": sh.bytes,
+                    "live_bytes": sh.bytes,
+                    "active": False,
+                },
+                data,
+            )
+
+    # -- archive import (shard distribution network) -----------------------
+
+    def import_shard(self, data: bytes) -> dict:
+        """Verify-then-install a peer-fetched shard image. The bytes
+        run the FULL offline contract in memory (``verify_shard_blob``)
+        BEFORE anything touches the store directory — a failed
+        verification retains zero hostile bytes. A range the store
+        already holds is an idempotent duplicate; a partial overlap is
+        rejected (two honest seals never straddle a rotation point)."""
+        report = verify_shard_blob(data)
+        if not report["ok"]:
+            with self._lock:
+                self.import_rejects += 1
+            return {
+                "ok": False,
+                "error": report.get("error", "verify failed"),
+                "report": report,
+            }
+        lo, hi = report["lo"], report["hi"]
+        with self._lock:
+            for sh in self._shards.values():
+                if sh.lo == lo and sh.hi == hi:
+                    return {"ok": True, "duplicate": True, "id": sh.sid,
+                            "lo": lo, "hi": hi}
+                if sh.hi >= lo and sh.lo <= hi:
+                    self.import_rejects += 1
+                    return {"ok": False, "error": "overlapping range"}
+            sid = max(self._shards, default=0) + 1
+        path = os.path.join(self.root, f"shard-{sid:06d}.shard")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        with self._lock:
+            sh = _Shard(sid, path, lo, hi,
+                        report["rec_off"], report["rec_len"],
+                        report["acct_off"], report["acct_len"],
+                        report["records"], len(data),
+                        report["first_hash"], report["last_hash"])
+            self._shards[sid] = sh
+            self._write_index_locked()
+            self.imported += 1
+            self.imported_bytes += len(data)
+        return {"ok": True, "id": sid, "lo": lo, "hi": hi,
+                "records": report["records"]}
+
+    def iter_records(self, sid: int) -> Iterator[tuple[bytes, int, bytes]]:
+        """(key, type_byte, blob) per record of one shard — the import
+        fan-out walk (archive nodestore + txdb feed)."""
+        with self._lock:
+            sh = self._shards.get(sid)
+            if sh is None:
+                return
+            data = os.pread(self._fd(sh), sh.rec_len, sh.rec_off)
+        for key, type_byte, off, ln in _iter_records_py(data):
+            yield key, type_byte, data[off: off + ln]
+
+    def acct_rows(self, sid: int) -> list[tuple[bytes, int, int, bytes]]:
+        """(account20, ledger_seq, txn_seq, txid) rows of one shard."""
+        with self._lock:
+            sh = self._shards.get(sid)
+        if sh is None:
+            return []
+        raw = self._acct_rows(sh)
+        if len(raw) < 4:
+            return []
+        (n,) = struct.unpack_from("<I", raw, 0)
+        out = []
+        pos = 4
+        for _ in range(n):
+            if pos + _ACCT_ROW.size > len(raw):
+                break
+            out.append(_ACCT_ROW.unpack_from(raw, pos))
+            pos += _ACCT_ROW.size
+        return out
+
+    def tx_blob(self, sid: int, txid: bytes,
+                ) -> Optional[tuple[bytes, bytes]]:
+        """(raw_tx, meta) for one txid of one shard (import feed +
+        byte-match audits)."""
+        with self._lock:
+            sh = self._shards.get(sid)
+        if sh is None:
+            return None
+        return self._tx_blob(sh, txid)
 
     # -- account_tx below the retain floor ---------------------------------
 

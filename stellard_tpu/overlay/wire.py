@@ -325,7 +325,11 @@ class SegmentData:
     total: int = 0
     offset: int = 0
     data: bytes = b""
-    segments: list = field(default_factory=list)  # (id, size, live, active)
+    # manifest rows: (id, size, live, active[, lo, hi, file_bytes]).
+    # lo/hi advertise a sealed shard's ledger-seq range and file_bytes
+    # its full on-disk size (the SHARD_FILE door serves whole files);
+    # all three ride nonzero-only so legacy rows stay byte-identical.
+    segments: list = field(default_factory=list)
     # snapshot handoff: the serving peer's sealed-set epoch + validated
     # seq at reply time (0 = a pre-epoch peer; fetchers treat as
     # don't-care). An epoch that MOVES mid-transfer means the source
@@ -626,11 +630,23 @@ def _enc_segment_data(m: SegmentData) -> bytes:
     e.varint(3, m.offset)
     if m.data:
         e.blob(4, m.data)
-    for sid, size, live, active in m.segments:
+    for seg in m.segments:
+        sid, size, live, active = seg[0], seg[1], seg[2], seg[3]
         row = (
             Encoder().varint(1, sid + 1).varint(2, size)
             .varint(3, live).varint(4, 1 if active else 0)
         )
+        # sealed-shard range advertisement (nonzero-only: a legacy
+        # 4-tuple row and a zero-extended 7-tuple encode identically)
+        lo = seg[4] if len(seg) > 4 else 0
+        hi = seg[5] if len(seg) > 5 else 0
+        fbytes = seg[6] if len(seg) > 6 else 0
+        if lo:
+            row.varint(5, lo)
+        if hi:
+            row.varint(6, hi)
+        if fbytes:
+            row.varint(7, fbytes)
         e.message(5, row)
     if m.snap_epoch:
         e.varint(6, m.snap_epoch)
@@ -650,6 +666,9 @@ def _dec_segment_data(buf: bytes) -> SegmentData:
             first_int(rf, 2),
             first_int(rf, 3),
             bool(first_int(rf, 4)),
+            first_int(rf, 5),
+            first_int(rf, 6),
+            first_int(rf, 7),
         ))
     return SegmentData(
         seg_id=first_int(f, 1) - 1,

@@ -664,6 +664,17 @@ def do_get_counts(ctx: Context) -> dict:
                 # follower ingest plane: ledgers adopted, validation-
                 # seen -> adopted latency, live acquisitions, segfetch
                 out["follower"] = vn.follower_json()
+            sb = getattr(vn, "shard_backfill", None)
+            if sb is not None:
+                # archive tier (doc/archive.md): backfill session
+                # state + the verified floor gating the forever cache
+                out["archive"] = {
+                    "backfill": sb.get_json(),
+                    "verified_floor": (
+                        plane.archive_floor if plane is not None else 0
+                    ),
+                    "txdb": node.txdb.counts(),
+                }
             # byzantine-defense counters: hostile inputs recognized and
             # neutralized (bad sigs, equivocation, oversized/forged
             # txsets, malformed frames, garbage segments)
@@ -1194,87 +1205,106 @@ def do_account_tx(ctx: Context) -> dict:
     # complete
     floor = getattr(ctx.node.txdb, "retain_floor", 0)
     shardstore = getattr(ctx.node, "shardstore", None)
-    shard_range = shardstore.range() if shardstore is not None else None
-    shards_cover_below = (
-        floor > 0 and shard_range is not None and min_l < floor
-    )
-    if shards_cover_below:
-        # the shard tier only covers [shard_lo, floor): history below
-        # the FIRST sealed shard (trimmed before shards were enabled)
-        # is gone everywhere, and must keep the clean lgrIdxInvalid /
-        # clamp-and-echo contract — never a quietly complete-looking
-        # page with a hole at the front
-        shard_lo = shard_range[0]
-        if min_l < shard_lo:
-            if after is not None and after[0] < shard_lo:
-                raise RPCError(
-                    "lgrIdxInvalid",
-                    f"marker ledger {after[0]} is below the oldest "
-                    f"sealed history shard ({shard_lo})",
-                )
-            if max_l < shard_lo:
-                raise RPCError(
-                    "lgrIdxInvalid",
-                    f"requested window ends below the oldest sealed "
-                    f"history shard ({shard_lo})",
-                )
-            min_l = shard_lo  # serve what exists; echo effective min
-    if floor > 0 and not shards_cover_below:
-        if after is not None and after[0] < floor:
-            raise RPCError(
-                "lgrIdxInvalid",
-                f"marker ledger {after[0]} is below the retained "
-                f"history floor {floor}",
-            )
-        if max_l < floor:
-            raise RPCError(
-                "lgrIdxInvalid",
-                f"requested window ends below the retained history "
-                f"floor {floor}",
-            )
-        if min_l < floor:
-            # window straddles the floor: serve what exists and REPORT
-            # the effective (clamped) minimum — the reference's
-            # effective-range echo — so a pager can see the truncation
-            # instead of reading a quietly complete-looking history
-            min_l = floor
+    req_min = min_l
     # fetch one extra row: its presence means the walk was truncated and
     # a resume marker must be returned (AccountTx.cpp resumeToken)
     want = limit + 1
-    if shards_cover_below:
-        # two-tier walk, cold shards below the floor + SQL at/above it,
-        # in one consistent (ledger_seq, txn_seq) order; the EXCLUSIVE
-        # `after` marker filters identically in both tiers, so a pager
-        # resumes seamlessly across the boundary
-        shard_hi = min(max_l, floor - 1)
-        rows = []
-        if forward:
-            # a resume marker at/above the floor already consumed the
-            # whole shard tier (every shard row is < floor and the
-            # marker is exclusive) — skip the cold-storage walk
-            if after is None or after[0] < floor:
-                rows.extend(shardstore.account_tx(
-                    account_id, min_l, shard_hi, want, True, after=after
-                ))
-            if len(rows) < want and max_l >= floor:
-                rows.extend(ctx.node.txdb.account_transactions(
-                    account_id, floor, max_l, want - len(rows), True,
-                    after=after,
-                ))
-        else:
-            if max_l >= floor:
-                rows.extend(ctx.node.txdb.account_transactions(
-                    account_id, floor, max_l, want, False, after=after,
-                ))
-            if len(rows) < want:
-                rows.extend(shardstore.account_tx(
-                    account_id, min_l, shard_hi, want - len(rows), False,
-                    after=after,
-                ))
-    else:
-        rows = ctx.node.txdb.account_transactions(
-            account_id, min_l, max_l, want, forward, after=after
+    # the tier split is planned against one floor reading, but sql_trim
+    # runs on other threads: a trim landing between the shard walk
+    # (< floor) and the SQL walk (>= floor) deletes rows in
+    # [floor, new_floor) that neither tier served. The floor is
+    # monotonic, so re-checking it after the walk and re-planning
+    # against the new value closes the window; the bound only caps
+    # pathological back-to-back trims
+    for _ in range(4):
+        min_l = req_min
+        shard_range = (
+            shardstore.range() if shardstore is not None else None
         )
+        shards_cover_below = (
+            floor > 0 and shard_range is not None and min_l < floor
+        )
+        if shards_cover_below:
+            # the shard tier only covers [shard_lo, floor): history
+            # below the FIRST sealed shard (trimmed before shards were
+            # enabled) is gone everywhere, and must keep the clean
+            # lgrIdxInvalid / clamp-and-echo contract — never a quietly
+            # complete-looking page with a hole at the front
+            shard_lo = shard_range[0]
+            if min_l < shard_lo:
+                if after is not None and after[0] < shard_lo:
+                    raise RPCError(
+                        "lgrIdxInvalid",
+                        f"marker ledger {after[0]} is below the oldest "
+                        f"sealed history shard ({shard_lo})",
+                    )
+                if max_l < shard_lo:
+                    raise RPCError(
+                        "lgrIdxInvalid",
+                        f"requested window ends below the oldest sealed "
+                        f"history shard ({shard_lo})",
+                    )
+                min_l = shard_lo  # serve what exists; echo effective min
+        if floor > 0 and not shards_cover_below:
+            if after is not None and after[0] < floor:
+                raise RPCError(
+                    "lgrIdxInvalid",
+                    f"marker ledger {after[0]} is below the retained "
+                    f"history floor {floor}",
+                )
+            if max_l < floor:
+                raise RPCError(
+                    "lgrIdxInvalid",
+                    f"requested window ends below the retained history "
+                    f"floor {floor}",
+                )
+            if min_l < floor:
+                # window straddles the floor: serve what exists and
+                # REPORT the effective (clamped) minimum — the
+                # reference's effective-range echo — so a pager can see
+                # the truncation instead of reading a quietly
+                # complete-looking history
+                min_l = floor
+        if shards_cover_below:
+            # two-tier walk, cold shards below the floor + SQL at/above
+            # it, in one consistent (ledger_seq, txn_seq) order; the
+            # EXCLUSIVE `after` marker filters identically in both
+            # tiers, so a pager resumes seamlessly across the boundary
+            shard_hi = min(max_l, floor - 1)
+            rows = []
+            if forward:
+                # a resume marker at/above the floor already consumed
+                # the whole shard tier (every shard row is < floor and
+                # the marker is exclusive) — skip the cold-storage walk
+                if after is None or after[0] < floor:
+                    rows.extend(shardstore.account_tx(
+                        account_id, min_l, shard_hi, want, True,
+                        after=after,
+                    ))
+                if len(rows) < want and max_l >= floor:
+                    rows.extend(ctx.node.txdb.account_transactions(
+                        account_id, floor, max_l, want - len(rows), True,
+                        after=after,
+                    ))
+            else:
+                if max_l >= floor:
+                    rows.extend(ctx.node.txdb.account_transactions(
+                        account_id, floor, max_l, want, False,
+                        after=after,
+                    ))
+                if len(rows) < want:
+                    rows.extend(shardstore.account_tx(
+                        account_id, min_l, shard_hi, want - len(rows),
+                        False, after=after,
+                    ))
+        else:
+            rows = ctx.node.txdb.account_transactions(
+                account_id, min_l, max_l, want, forward, after=after
+            )
+        new_floor = getattr(ctx.node.txdb, "retain_floor", 0)
+        if new_floor == floor:
+            break
+        floor = new_floor
     more = len(rows) > limit
     rows = rows[:limit]
     served_from_shards = any("shard" in r for r in rows)
@@ -1500,6 +1530,27 @@ def do_subscribe(ctx: Context) -> dict:
             for a in (p.get("accounts_proposed") or p.get("rt_accounts"))
         ]
         ctx.subs.subscribe_accounts(ctx.infosub, accts, proposed=True)
+    if "resume" in p:
+        # WS-door resume cursor (doc/follower.md reconnect-storm
+        # hardening): `resume: N` (or `{"last_seq": N}`) replays every
+        # ledgerClosed event after N still inside the bounded replay
+        # ring and re-attaches the ledger stream — zero gaps, zero
+        # dups. A cursor past the horizon gets the EXPLICIT cold
+        # answer ({"cold": true} + the current floor), never a silent
+        # re-subscribe.
+        r = p["resume"]
+        if isinstance(r, dict):
+            r = r.get("last_seq")
+        if isinstance(r, bool) or not isinstance(r, (int, str)):
+            raise RPCError("invalidParams", "malformed resume cursor")
+        try:
+            last_seq = int(r)
+        except (TypeError, ValueError) as exc:
+            raise RPCError("invalidParams",
+                           "malformed resume cursor") from exc
+        if last_seq < 0:
+            raise RPCError("invalidParams", "malformed resume cursor")
+        result.update(ctx.subs.resume(ctx.infosub, last_seq))
     return result
 
 

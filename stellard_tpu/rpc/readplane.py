@@ -25,7 +25,8 @@ import json
 import threading
 from typing import Any, Optional
 
-__all__ = ["ReadPlane", "ResultCache", "CACHEABLE_METHODS"]
+__all__ = ["ReadPlane", "ResultCache", "CACHEABLE_METHODS",
+           "forever_slot"]
 
 # the hot read RPCs worth a whole-result cache (ISSUE 10); everything
 # else recomputes — these dominate production read traffic.
@@ -65,6 +66,17 @@ class ReadPlane:
         # must not open before _await_history can see its ledger).
         self._persisted = None
         self._validated_tip = None
+        # archive mode (doc/archive.md): the verified floor — the
+        # contiguous sealed-shard coverage hi. 0 = not an archive (or
+        # nothing verified yet); > 0 arms the forever cache tier for
+        # results whose window closes at or below it.
+        self.archive_floor = 0
+
+    def set_archive_floor(self, floor: int) -> None:
+        """Publish the archive's verified floor. Monotonic: verified
+        history never un-verifies, so the floor only rises."""
+        with self._lock:
+            self.archive_floor = max(self.archive_floor, int(floor))
 
     def note_persisted(self, ledger) -> None:
         """A closed ledger finished its persistence sinks."""
@@ -130,6 +142,7 @@ class ReadPlane:
         return {
             "published": self.published,
             "snapshot_seq": snap.seq if snap is not None else 0,
+            "archive_floor": self.archive_floor,
         }
 
 
@@ -153,6 +166,16 @@ class ResultCache:
         self.inserts = 0
         self.overflow = 0
         self.invalidated = 0
+        # the forever tier (archive mode, doc/archive.md): results of
+        # IMMUTABLE windows — closed at or below the archive's verified
+        # floor — keyed by (method, params) alone. The epoch swap in
+        # on_new_seq never touches it: sealed history cannot change, so
+        # re-deriving these per epoch would be pure waste. Bounded by
+        # the same capacity as a generation.
+        self._forever: dict[tuple, dict] = {}
+        self.forever_hits = 0
+        self.forever_inserts = 0
+        self.forever_overflow = 0
 
     def on_new_seq(self, seq: int) -> None:
         with self._lock:
@@ -161,6 +184,8 @@ class ResultCache:
             self.invalidated += len(self._gen)
             self._seq = seq
             self._gen = {}
+            # self._forever survives by design — immutable-seq results
+            # outlive every epoch (doc/archive.md)
 
     def get(self, seq: int, method: str, key: str) -> Optional[dict]:
         with self._lock:
@@ -185,6 +210,24 @@ class ResultCache:
             self._gen[(method, key)] = result
             self.inserts += 1
 
+    def get_forever(self, method: str, key: str) -> Optional[dict]:
+        """Forever-tier lookup: no seq — the key IS the whole identity
+        of an immutable-window result."""
+        with self._lock:
+            hit = self._forever.get((method, key))
+            if hit is None:
+                return None
+            self.forever_hits += 1
+        return dict(hit)
+
+    def put_forever(self, method: str, key: str, result: dict) -> None:
+        with self._lock:
+            if len(self._forever) >= self.capacity:
+                self.forever_overflow += 1
+                return
+            self._forever[(method, key)] = result
+            self.forever_inserts += 1
+
     def get_json(self) -> dict:
         with self._lock:
             total = self.hits + self.misses
@@ -198,6 +241,10 @@ class ResultCache:
                 "inserts": self.inserts,
                 "overflow": self.overflow,
                 "invalidated": self.invalidated,
+                "forever_entries": len(self._forever),
+                "forever_hits": self.forever_hits,
+                "forever_inserts": self.forever_inserts,
+                "forever_overflow": self.forever_overflow,
             }
 
 
@@ -268,6 +315,61 @@ def cache_slot(ctx, method: str):
     return snap, key
 
 
+def forever_slot(ctx, method: str) -> Optional[str]:
+    """Canonical params key when this request is IMMUTABLE — its window
+    closes at or below the archive's verified floor (doc/archive.md) —
+    else None.
+
+    An immutable result is a pure function of offline-verified sealed
+    history, so it survives every epoch swap: caching it per validated
+    seq (the epoch tier) would re-derive the same bytes forever. Only
+    two methods qualify, and only with an EXPLICITLY bounded window:
+
+    - ``account_tx`` with ``0 <= ledger_index_max <= floor`` (an
+      unbounded max keeps growing with the chain; above the floor the
+      window includes un-verified — and on a validator, trimmable —
+      history);
+    - ``ledger`` addressed by a numeric seq at or below the floor
+      ("validated"/"closed"/"current" selectors are moving targets).
+
+    The floor itself only rises (verified history never un-verifies),
+    so eligibility decided against an older floor stays correct."""
+    node = ctx.node
+    cache = getattr(node, "read_cache", None)
+    plane = getattr(node, "read_plane", None)
+    if cache is None or plane is None:
+        return None
+    floor = getattr(plane, "archive_floor", 0)
+    if floor <= 0:
+        return None
+    p = ctx.params
+    if method == "account_tx":
+        try:
+            max_l = int(p.get("ledger_index_max", -1))
+        except (TypeError, ValueError):
+            return None
+        if max_l < 0 or max_l > floor:
+            return None
+    elif method == "ledger":
+        if p.get("ledger_hash"):
+            return None
+        sel = p.get("ledger_index")
+        if isinstance(sel, bool):
+            return None
+        try:
+            seq = int(sel)
+        except (TypeError, ValueError):
+            return None
+        if seq <= 0 or seq > floor:
+            return None
+    else:
+        return None
+    try:
+        return json.dumps(p, sort_keys=True, separators=(",", ":"))
+    except (TypeError, ValueError):
+        return None  # non-JSON params (embedded callers): uncacheable
+
+
 def cached_dispatch(ctx, method: str, compute) -> dict:
     """Wrap one handler call with the validated-seq result cache.
     ``compute()`` runs the real handler; error results are never
@@ -275,18 +377,39 @@ def cached_dispatch(ctx, method: str, compute) -> dict:
     pipeline). The serving ledger is PINNED into the context so the
     handler resolves exactly the ledger the cache key names — without
     the pin, a validated tip advancing between keying and compute
-    would cache a newer ledger's answer under the older epoch."""
+    would cache a newer ledger's answer under the older epoch.
+
+    Archive mode: the forever tier is consulted FIRST — an immutable-
+    window result (forever_slot) hits across epoch swaps; a computed
+    one is admitted to the forever tier (and, when also epoch-
+    eligible, the per-seq generation)."""
+    fkey = forever_slot(ctx, method)
+    cache: Optional[ResultCache] = getattr(ctx.node, "read_cache", None)
+    if fkey is not None and cache is not None:
+        hit = cache.get_forever(method, fkey)
+        if hit is not None:
+            return hit
     slot = cache_slot(ctx, method)
     if slot is None:
-        return compute()
+        result = compute()
+        if (fkey is not None and cache is not None
+                and isinstance(result, dict) and "error" not in result):
+            cache.put_forever(method, fkey, result)
+            return dict(result)
+        return result
     snap, key = slot
     ctx.pinned_validated = snap
-    cache: ResultCache = ctx.node.read_cache
     hit = cache.get(snap.seq, method, key)
     if hit is not None:
+        if fkey is not None:
+            # promote an epoch-tier hit whose window is immutable: the
+            # next epoch swap must not evict it
+            cache.put_forever(method, fkey, hit)
         return hit
     result = compute()
     if isinstance(result, dict) and "error" not in result:
+        if fkey is not None:
+            cache.put_forever(method, fkey, result)
         cache.put(snap.seq, method, key, result)
         return dict(result)  # callers may annotate; keep the cached copy clean
     return result

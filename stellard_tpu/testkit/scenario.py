@@ -40,7 +40,8 @@ from .schedule import FaultSchedule
 from .workloads import TxFactory, build_spec_workload
 
 __all__ = [
-    "Scenario", "run_simnet", "apply_event", "SYNTH_BUG", "LAST_FLIGHT",
+    "Scenario", "run_simnet", "apply_event", "SYNTH_BUG",
+    "ARCHIVE_CORRUPT", "LAST_FLIGHT",
 ]
 
 # the most recent run's flight recorder (node/health.py FlightRecorder,
@@ -59,6 +60,16 @@ LAST_FLIGHT: list = []
 # production scenarios; tools/scenariofuzz.py --smoke and the tests arm
 # it around their sweeps.
 SYNTH_BUG = {"armed": False}
+
+# Test-only planted corruption for the archive leg (the shard-byte-match
+# invariant's ground truth, mirroring SYNTH_BUG): while armed, the
+# archive leg flips one key byte inside its FIRST imported shard file
+# after import — so every clean stage (wire transfer, verify-gated
+# import) passed, but the archive's served answers no longer match the
+# sealed source contents. search.check_invariants' `archive_byte_match`
+# must fire on the armed run and stay silent on clean ones (anti-vacuity
+# both ways). Never armed in production scenarios.
+ARCHIVE_CORRUPT = {"armed": False}
 
 
 @dataclass
@@ -150,6 +161,16 @@ class Scenario:
     # under a deliberately tight ceil(n/2) budget so shedding leaves
     # scorecard evidence. The `paths` block is deterministic per seed.
     path_subs: int = 0
+    # archive tier (ISSUE 20, requires shards): after convergence a
+    # synthetic archive node cold-backfills every sealed shard from the
+    # serving validators' segment sources through the REAL wire codec
+    # (ShardBackfill + whole-file SHARD_FILE door, verify-gated
+    # import), then every historical answer it serves — account-index
+    # rows, tx blobs, raw records — is byte-compared against the sealed
+    # source's verified contents. The `archive` scorecard block carries
+    # imported/reject/condemnation counts and the byte-match verdict;
+    # a garbage_server scenario exercises condemnation on this leg too.
+    archive: bool = False
     # convergence tail
     converge_extra: int = 2
     max_tail_steps: int = 240
@@ -362,6 +383,139 @@ def _setup_segments(net: SimNet, scn: Scenario, tmp_factory):
         cold.node.segment_catchup = sc
         catchups[nid] = sc
     return dbs, catchups, shardstores
+
+
+def _run_archive_leg(scn: Scenario, net: SimNet, shardstores: dict,
+                     tmp_factory) -> dict:
+    """Archive-tier leg (ISSUE 20): a synthetic archive node backfills
+    every sealed shard from the serving validators' segment sources —
+    a synchronous, deterministic pump (seeded peer discipline, fake
+    clock, no net stepping) that round-trips EVERY message through the
+    real wire codec so the range-row encoding is exercised, not
+    shortcut. After backfill, every historical answer the archive can
+    serve (account-index rows, tx blobs, raw records) is byte-compared
+    against the sealed source's verified contents; the scorecard block
+    is ints/bools only so scorecards stay byte-identical per seed."""
+    import os as _os
+
+    from ..node.archive import ShardBackfill
+    from ..nodestore.shards import HistoryShardStore
+    from ..overlay import wire as W
+
+    serving = sorted(shardstores)
+    sources = {i: net.validators[i].node.segment_source for i in serving}
+    adir = tmp_factory("archive")
+    ass = HistoryShardStore(adir)
+    mt_gs = int(W._ENCODERS[W.GetSegments][0])
+    mt_sd = int(W._ENCODERS[W.SegmentData][0])
+    clock = [0.0]
+    pending: list = []
+    noted: list = []
+
+    def send(peer, msg):
+        pending.append(
+            (peer, W.decode_message(mt_gs, W.encode_message(msg)))
+        )
+
+    sb = ShardBackfill(
+        send=send, peers=lambda: list(serving), shardstore=ass,
+        clock=lambda: clock[0], request_timeout=4.0, rescan_s=1e9,
+        seed=scn.seed,
+        note_byzantine=lambda kind, **kw: noted.append(kind),
+    )
+    sb.start()
+    guard = 0
+    while sb.active and guard < 50_000:
+        guard += 1
+        if not pending:
+            clock[0] += 5.0  # starved request: drive the timeout path
+            sb.tick(clock[0])
+            continue
+        peer, msg = pending.pop(0)
+        src = sources.get(peer)
+        if src is None:
+            continue
+        if msg.seg_id < 0:
+            rows = [
+                (d["id"], d["size"], d["live_bytes"], bool(d["active"]),
+                 int(d.get("lo", 0)), int(d.get("hi", 0)),
+                 int(d.get("file_bytes", 0)))
+                for d in src.segments()
+            ]
+            reply = W.SegmentData(-1, 0, 0, b"", segments=rows,
+                                  snap_epoch=1)
+        else:
+            got = src.fetch_segment(msg.seg_id, offset=msg.offset,
+                                    length=1 << 15)
+            if got is None:
+                continue  # unanswerable: the timeout path handles it
+            meta, data = got
+            reply = W.SegmentData(msg.seg_id, meta["size"], msg.offset,
+                                  data, snap_epoch=1)
+        reply = W.decode_message(mt_sd, W.encode_message(reply))
+        if reply.seg_id < 0:
+            sb.on_manifest(peer, reply.segments, epoch=reply.snap_epoch)
+        else:
+            sb.on_data(peer, reply)
+
+    if ARCHIVE_CORRUPT["armed"] and ass.shards():
+        # planted post-import corruption (see ARCHIVE_CORRUPT): flip
+        # one key byte of the first imported shard's first record —
+        # structure-preserving, so serving still works but the served
+        # bytes no longer match the sealed source
+        from ..nodestore.shards import _HDR_SIZE
+
+        sid0 = ass.shards()[0]["id"]
+        path = _os.path.join(adir, f"shard-{sid0:06d}.shard")
+        with open(path, "r+b") as f:
+            f.seek(_HDR_SIZE + 5)  # first record's key, first byte
+            b = f.read(1)
+            f.seek(_HDR_SIZE + 5)
+            f.write(bytes([b[0] ^ 0xFF]))
+
+    # byte-match sweep: the invariant surface. Every acct-index row's
+    # tx blob AND every raw record the archive would serve must equal
+    # the sealed source's verified contents.
+    queries = 0
+    mismatches = 0
+    src_stores = list(shardstores.values())
+    for sh in ass.shards():
+        sid = sh["id"]
+        src_ss = next(
+            (s for s in src_stores if s.covers(sh["lo"]) is not None),
+            None,
+        )
+        if src_ss is None:
+            continue
+        src_sid = src_ss.covers(sh["lo"])
+        src_recs = {
+            k: (tb, blob) for k, tb, blob in src_ss.iter_records(src_sid)
+        }
+        for k, tb, blob in ass.iter_records(sid):
+            queries += 1
+            if src_recs.get(k) != (tb, blob):
+                mismatches += 1
+        for _acct, lseq, _tseq, txid in ass.acct_rows(sid):
+            queries += 1
+            if ass.tx_blob(sid, txid) != src_ss.tx_blob(
+                src_ss.covers(lseq), txid
+            ):
+                mismatches += 1
+    out = {
+        "imported": sb.counters["imported"],
+        "duplicates": sb.counters["duplicates"],
+        "import_rejects": sb.counters["import_rejects"],
+        "garbage_peers": sb.counters["garbage_peers"],
+        "fallbacks": sb.counters["fallbacks"],
+        "completed": sb.counters["completed"],
+        "byzantine_noted": len(noted),
+        "verified_floor": ass.contiguous_floor(),
+        "queries": queries,
+        "byte_match_failures": mismatches,
+        "corrupt_armed": bool(ARCHIVE_CORRUPT["armed"]),
+    }
+    ass.close()
+    return out
 
 
 def _attach_txqs(net: SimNet, scn: Scenario) -> dict:
@@ -1080,6 +1234,13 @@ def run_simnet(scn: Scenario, tmpdir: Optional[str] = None) -> dict:
                     "segment_reads": reads,
                     "trim_seq": scn.shard_trim_seq,
                 }
+        if scn.archive and shardstores:
+            # archive tier (ISSUE 20): shard-network backfill into a
+            # synthetic archive node + the byte-match invariant sweep
+            card["archive"] = _run_archive_leg(
+                scn, net, shardstores,
+                lambda name: os.path.join(tmpdir, name),
+            )
         if txqs:
             q0 = txqs[honest[0]]
             card["txq"] = {

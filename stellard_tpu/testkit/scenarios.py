@@ -130,6 +130,27 @@ def scenario_shard_cold_catchup(seed: int = 0) -> Scenario:
     )
 
 
+def scenario_archive_backfill(seed: int = 0) -> Scenario:
+    """The archive-tier leg (ISSUE 20): on top of the trim-then-tier
+    shape, a synthetic archive node backfills every sealed shard from
+    the serving validators over the shard distribution network and
+    byte-matches its served history against the sealed contents.
+    garbage_server=0 makes the first-pick peer serve corrupted bytes,
+    so the leg ALSO exercises verify-gated rejection + condemnation +
+    refetch-elsewhere before the byte-match sweep runs."""
+    return Scenario(
+        name="archive_backfill", seed=seed, n_validators=5, quorum=3,
+        steps=90,
+        cold_nodes=(4,), join_at=50,
+        segments=True, segment_bytes=65536,
+        shards=True, shard_trim_seq=6,
+        archive=True,
+        garbage_server=0,
+        workload={"kind": "payment_flood", "n": 70},
+        max_tail_steps=300,
+    )
+
+
 def scenario_hot_account(seed: int = 0) -> Scenario:
     return Scenario(
         name="hot_account", seed=seed, n_validators=4, quorum=3,
@@ -271,6 +292,7 @@ MATRIX = {
     "byzantine": scenario_byzantine,
     "cold_catchup": scenario_cold_catchup,
     "shard_cold_catchup": scenario_shard_cold_catchup,
+    "archive_backfill": scenario_archive_backfill,
     "hot_account": scenario_hot_account,
     "order_books": scenario_order_books,
     "follower_partition": scenario_follower_partition,

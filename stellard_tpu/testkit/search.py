@@ -188,6 +188,11 @@ def coverage_state(card: dict) -> tuple:
         txq.get("fee_order_drain"),
         (card.get("followers") or {}).get("synced"),
         _bucket((card.get("synth") or {}).get("planted", 0)),
+        # archive tier (ISSUE 20) — appended at the END so every
+        # pre-existing coverage signature stays stable
+        (card.get("archive") or {}).get("imported", 0) > 0,
+        (card.get("archive") or {}).get("byte_match_failures", 0) > 0,
+        (card.get("archive") or {}).get("garbage_peers", 0) > 0,
     )
 
 
@@ -308,6 +313,26 @@ def check_invariants(
                     "shard_tier_vacuous",
                     f"sealed={sh.get('sealed')} "
                     f"segment_reads={sh.get('segment_reads')}",
+                ))
+        if getattr(scn, "archive", False):
+            # archive tier (ISSUE 20): every historical answer the
+            # archive serves must byte-match the sealed shard's
+            # verified contents — and the leg must have actually
+            # imported and queried something (a backfill that moved
+            # zero shards or compared zero bytes proves nothing)
+            ar = card.get("archive") or {}
+            if ar.get("byte_match_failures", 0) > 0:
+                v.append(Violation(
+                    "archive_byte_match",
+                    f"{ar.get('byte_match_failures')}/"
+                    f"{ar.get('queries')} archive answers diverged "
+                    f"from sealed shard contents",
+                ))
+            if not ar.get("imported") or not ar.get("queries"):
+                v.append(Violation(
+                    "archive_tier_vacuous",
+                    f"imported={ar.get('imported')} "
+                    f"queries={ar.get('queries')}",
                 ))
         if scn.n_followers and not (card.get("followers") or {}).get(
             "synced", True
@@ -634,6 +659,14 @@ class ScenarioGenerator:
                 # under whatever faults this schedule carries
                 scn.shards = True
                 scn.shard_trim_seq = rng.randint(3, 6)
+                # archive-tier axis (ISSUE 20): derived from the
+                # already-drawn scenario seed rather than a fresh rng
+                # draw, so the generator's stream — and every
+                # previously generated scenario — stays bit-identical.
+                # ~1 in 4 shard runs also backfill a synthetic archive
+                # from the sealed tier and byte-match its answers.
+                if scn.seed & 0x3 == 0x1:
+                    scn.archive = True
         if not cold and not byz and rng.random() < 0.18:
             self._attach_overlay_tier(rng, scn)
         if rng.random() < 0.15:
@@ -855,10 +888,17 @@ def _weaken_ops(scn: Scenario) -> list[tuple[str, Scenario]]:
                     bs = tuple(x for x in behaviors if x != b)
                     c.byzantine = {**scn.byzantine, nid: bs}
                     out.append((f"drop_behavior:{b}", c))
+    if getattr(scn, "archive", False):
+        # keep the shard tier but drop the archive backfill: isolates
+        # the distribution-network leg from the cold-sync leg
+        c = clone()
+        c.archive = False
+        out.append(("drop_archive", c))
     if getattr(scn, "shards", False):
         c = clone()
         c.shards = False
         c.shard_trim_seq = 0
+        c.archive = False
         out.append(("drop_shard_tier", c))
     if scn.cold_nodes:
         c = clone()
@@ -868,6 +908,7 @@ def _weaken_ops(scn: Scenario) -> list[tuple[str, Scenario]]:
         c.kill_server_at = None
         c.shards = False
         c.shard_trim_seq = 0
+        c.archive = False
         out.append(("drop_cold_node", c))
     # per-event weakenings: plant magnitude down, fault probs halved
     for i, e in enumerate(_events_of(scn)):

@@ -1,0 +1,1009 @@
+"""Archive tier (ISSUE 20 / ROADMAP item 4): the shard distribution
+network and full-history reporting nodes.
+
+Covers the wire-level shard-range manifest rows (legacy byte-identity
+pinned), the offline verify/import door (`verify_shard_blob` /
+`import_shard` — zero hostile bytes retained), the ShardBackfill
+fetcher (peer discipline, condemnation, epoch restarts, self-arming
+rescans), the full-history index feed (`feed_shard` into a
+never-trimming ArchiveTxDatabase), the forever result-cache tier
+(immutable seqs survive epoch swaps; mutable windows never admitted),
+the WS-door `resume` cursor (explicit cold answer past the horizon),
+account_tx paging across the shard/live boundary under a concurrent
+sql_trim, and the archive config gates (dead-config rejection).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import pytest
+
+jax.config.update("jax_platforms", "cpu")
+
+from stellard_tpu.node.archive import (  # noqa: E402
+    ArchiveTxDatabase,
+    ShardBackfill,
+    feed_shard,
+)
+from stellard_tpu.node.config import Config  # noqa: E402
+from stellard_tpu.node.node import Node  # noqa: E402
+from stellard_tpu.nodestore.shards import (  # noqa: E402
+    SHARD_FILE_BASE,
+    SHARD_SEG_BASE,
+    HistoryShardStore,
+    collect_retired,
+    verify_shard_blob,
+)
+from stellard_tpu.protocol.formats import TxType  # noqa: E402
+from stellard_tpu.protocol.keys import KeyPair  # noqa: E402
+from stellard_tpu.protocol.sfields import sfAmount, sfDestination  # noqa: E402
+from stellard_tpu.protocol.stamount import STAmount  # noqa: E402
+from stellard_tpu.protocol.sttx import SerializedTransaction  # noqa: E402
+from stellard_tpu.rpc.handlers import Context, Role, dispatch  # noqa: E402
+
+
+# -- shared fixture: a tx-bearing chain sealed into source shards ---------
+
+
+def _sealed_chain(tmp_path, n_ledgers=6, splits=((1, 3), (4, 6)),
+                  txs_per_ledger=2):
+    """A real mini-chain with payments, sealed into a SOURCE shard
+    store along `splits` (inclusive seq ranges). Returns a dict with
+    the store, header dicts, per-range acct rows and txids."""
+    from stellard_tpu.nodestore.core import make_database
+    from stellard_tpu.state.ledger import Ledger
+
+    master = KeyPair.from_passphrase("masterpassphrase")
+    dest = KeyPair.from_passphrase("archive-dest").account_id
+    db = make_database(type="segstore", path=str(tmp_path / "src-ns"),
+                       async_writes=False)
+    led = Ledger.genesis(master.account_id)
+    headers, acct_rows = [], []
+    txids_by_seq: dict[int, list[bytes]] = {}
+    seq_counter = 0
+    for i in range(n_ledgers):
+        nxt = led.open_successor() if i else led
+        if i:
+            for t in range(txs_per_ledger):
+                seq_counter += 1
+                tx = SerializedTransaction.build(
+                    TxType.ttPAYMENT, master.account_id, seq_counter, 10,
+                    {sfAmount: STAmount.from_drops(1000),
+                     sfDestination: dest},
+                )
+                tx.sign(master)
+                txid = nxt.add_transaction(tx.serialize(), b"\x01\x02")
+                acct_rows.append((master.account_id, nxt.seq, t, txid))
+                txids_by_seq.setdefault(nxt.seq, []).append(txid)
+        nxt.close(close_time=1000 + 30 * i, close_resolution=30)
+        nxt.save(db)
+        headers.append({
+            "hash": nxt.hash(), "seq": nxt.seq,
+            "parent_hash": nxt.parent_hash,
+            "account_hash": nxt.account_hash,
+            "tx_hash": nxt.tx_hash,
+        })
+        led = nxt
+
+    def fetch(h):
+        o = db.fetch(h, populate_cache=False)
+        return o.data if o else None
+
+    ss = HistoryShardStore(str(tmp_path / "src-shards"))
+    by_seq = {h["seq"]: h for h in headers}
+    sids = []
+    for lo, hi in splits:
+        hs = [by_seq[s] for s in range(lo, hi + 1)]
+        recs = collect_retired(fetch, hs, set())
+        rows = [r for r in acct_rows if lo <= r[1] <= hi]
+        sid = ss.seal(lo, hi, recs, rows,
+                      first_hash=by_seq[lo]["hash"],
+                      last_hash=by_seq[hi]["hash"])
+        sids.append(sid)
+    db.close()
+    return {
+        "ss": ss, "sids": sids, "headers": headers,
+        "acct_rows": acct_rows, "txids_by_seq": txids_by_seq,
+        "master": master, "dest": dest,
+    }
+
+
+def _file_blob(ss: HistoryShardStore, sid: int) -> bytes:
+    """The shard's whole on-disk image via the distribution door."""
+    fid = SHARD_FILE_BASE + sid
+    out = bytearray()
+    meta, chunk = ss.fetch_segment(fid)
+    out += chunk
+    while len(out) < meta["size"]:
+        _m, chunk = ss.fetch_segment(fid, offset=len(out), length=1 << 16)
+        out += chunk
+    return bytes(out)
+
+
+# -- wire: shard-range manifest rows --------------------------------------
+
+
+class TestShardManifestWireRows:
+    def _mt(self, W):
+        return int(W._ENCODERS[W.SegmentData][0])
+
+    def test_legacy_rows_byte_identical(self):
+        """The range fields ride nonzero-only: a legacy 4-tuple row and
+        its zero-extended 7-tuple encode to the SAME bytes — old peers
+        see an unchanged wire."""
+        import stellard_tpu.overlay.wire as W
+
+        legacy = W.SegmentData(seg_id=-1, segments=[(3, 100, 90, True)])
+        extended = W.SegmentData(
+            seg_id=-1, segments=[(3, 100, 90, True, 0, 0, 0)]
+        )
+        assert W.encode_message(legacy) == W.encode_message(extended)
+
+    def test_range_rows_roundtrip(self):
+        import stellard_tpu.overlay.wire as W
+
+        rows = [
+            (0, 10, 10, True),
+            (SHARD_SEG_BASE + 1, 4096, 4096, False, 5, 9, 123456),
+        ]
+        m = W.SegmentData(seg_id=-1, segments=rows)
+        out = W.decode_message(self._mt(W), W.encode_message(m))
+        assert out.segments[0] == (0, 10, 10, True, 0, 0, 0)
+        assert out.segments[1] == rows[1]
+
+    def test_store_advertises_ranges(self, tmp_path):
+        env = _sealed_chain(tmp_path)
+        rows = env["ss"].segments()
+        shard_rows = sorted(
+            (r for r in rows if r["id"] >= SHARD_SEG_BASE),
+            key=lambda r: r["lo"],
+        )
+        assert [(r["lo"], r["hi"]) for r in shard_rows] == [(1, 3), (4, 6)]
+        for r in shard_rows:
+            assert r["file_bytes"] > 0
+        env["ss"].close()
+
+
+# -- verify_shard_blob / import_shard -------------------------------------
+
+
+class TestVerifyImport:
+    def test_verify_ok_and_report(self, tmp_path):
+        env = _sealed_chain(tmp_path)
+        blob = _file_blob(env["ss"], env["sids"][0])
+        rep = verify_shard_blob(blob)
+        assert rep["ok"], rep
+        assert (rep["lo"], rep["hi"]) == (1, 3)
+        assert rep["records"] > 0
+        env["ss"].close()
+
+    def test_verify_rejects_corruption_and_truncation(self, tmp_path):
+        env = _sealed_chain(tmp_path)
+        blob = _file_blob(env["ss"], env["sids"][0])
+        env["ss"].close()
+        bad = bytearray(blob)
+        bad[len(bad) // 2] ^= 0xFF
+        assert not verify_shard_blob(bytes(bad))["ok"]
+        assert not verify_shard_blob(blob[:-7])["ok"]
+        assert not verify_shard_blob(b"")["ok"]
+        assert not verify_shard_blob(b"NOTSHARD" + blob[8:])["ok"]
+
+    def test_import_installs_and_serves(self, tmp_path):
+        env = _sealed_chain(tmp_path)
+        src, master = env["ss"], env["master"]
+        dst = HistoryShardStore(str(tmp_path / "dst"))
+        for sid in env["sids"]:
+            res = dst.import_shard(_file_blob(src, sid))
+            assert res["ok"] and not res.get("duplicate"), res
+        assert dst.range() == (1, 6)
+        assert dst.contiguous_floor() == 6
+        # imported shards serve account_tx byte-identically to the src
+        want = src.account_tx(master.account_id, 1, 6, limit=100,
+                              forward=True)
+        got = dst.account_tx(master.account_id, 1, 6, limit=100,
+                             forward=True)
+        assert [r["txid"] for r in got] == [r["txid"] for r in want]
+        # ... and RE-SERVE over the distribution door (an archive is
+        # itself a source in the shard network)
+        for row in dst.segments():
+            if row["id"] >= SHARD_SEG_BASE:
+                assert row["lo"] > 0 and row["file_bytes"] > 0
+        sid0 = dst.shards()[0]["id"]
+        assert verify_shard_blob(_file_blob(dst, sid0))["ok"]
+        # reopen: imported shards survive restart
+        dst.close()
+        dst2 = HistoryShardStore(str(tmp_path / "dst"))
+        assert dst2.contiguous_floor() == 6
+        src.close()
+        dst2.close()
+
+    def test_import_duplicate_and_overlap(self, tmp_path):
+        env = _sealed_chain(tmp_path, splits=((1, 3), (2, 5)))
+        src = env["ss"]
+        dst = HistoryShardStore(str(tmp_path / "dst"))
+        assert dst.import_shard(_file_blob(src, env["sids"][0]))["ok"]
+        res = dst.import_shard(_file_blob(src, env["sids"][0]))
+        assert res["ok"] and res["duplicate"]
+        # partial overlap ([2,5] vs held [1,3]) is an inconsistency,
+        # not mergeable data: rejected
+        res = dst.import_shard(_file_blob(src, env["sids"][1]))
+        assert not res["ok"]
+        assert len(dst.shards()) == 1
+        src.close()
+        dst.close()
+
+    def test_import_reject_retains_zero_bytes(self, tmp_path):
+        import os
+
+        env = _sealed_chain(tmp_path)
+        blob = bytearray(_file_blob(env["ss"], env["sids"][0]))
+        env["ss"].close()
+        blob[-10] ^= 0x01
+        dst = HistoryShardStore(str(tmp_path / "dst"))
+        before = sorted(os.listdir(tmp_path / "dst"))
+        res = dst.import_shard(bytes(blob))
+        assert not res["ok"] and "error" in res
+        assert dst.imported == 0 and dst.import_rejects == 1
+        assert sorted(os.listdir(tmp_path / "dst")) == before
+        assert dst.range() is None
+        dst.close()
+
+    def test_contiguous_floor_gap_semantics(self, tmp_path):
+        env = _sealed_chain(
+            tmp_path, n_ledgers=7, splits=((1, 2), (3, 4), (6, 7))
+        )
+        src = env["ss"]
+        dst = HistoryShardStore(str(tmp_path / "dst"))
+        assert dst.contiguous_floor() == 0
+        dst.import_shard(_file_blob(src, env["sids"][0]))  # [1,2]
+        assert dst.contiguous_floor() == 2
+        dst.import_shard(_file_blob(src, env["sids"][2]))  # [6,7]: gap at 5
+        assert dst.contiguous_floor() == 2
+        dst.import_shard(_file_blob(src, env["sids"][1]))  # [3,4]
+        assert dst.contiguous_floor() == 4  # 5 still missing
+        assert dst.get_json()["contiguous_floor"] == 4
+        src.close()
+        dst.close()
+
+
+# -- ShardBackfill ---------------------------------------------------------
+
+
+class _FakeNet:
+    def __init__(self):
+        self.sent = []  # (peer, msg)
+
+    def send(self, peer, msg):
+        self.sent.append((peer, msg))
+
+
+def _manifest_rows(ss: HistoryShardStore) -> list:
+    return [
+        (r["id"], r["size"], r["live_bytes"], bool(r["active"]),
+         r.get("lo", 0), r.get("hi", 0), r.get("file_bytes", 0))
+        for r in ss.segments()
+    ]
+
+
+def _serve_file(ss: HistoryShardStore, msg, chunk=1 << 15, epoch=0):
+    """One SegmentData chunk reply for a whole-file GetSegments."""
+    import stellard_tpu.overlay.wire as W
+
+    meta, data = ss.fetch_segment(msg.seg_id, offset=msg.offset,
+                                  length=chunk)
+    return W.SegmentData(msg.seg_id, meta["size"], msg.offset, data,
+                         snap_epoch=epoch)
+
+
+class TestShardBackfill:
+    def _mk(self, tmp_path, net, peers=("a", "b", "c"), **kw):
+        dst = HistoryShardStore(str(tmp_path / "bf-dst"))
+        clock = [0.0]
+        imported = []
+        sb = ShardBackfill(
+            send=net.send,
+            peers=lambda: list(peers),
+            shardstore=dst,
+            clock=lambda: clock[0],
+            request_timeout=2.0,
+            backoff_base=1.0,
+            backoff_max=4.0,
+            rescan_s=30.0,
+            seed=1,
+            on_imported=imported.append,
+            **kw,
+        )
+        return sb, dst, clock, imported
+
+    def _drain(self, sb, net, src, epoch=0):
+        """Serve every outstanding request from `src` until idle."""
+        guard = 0
+        while net.sent:
+            guard += 1
+            assert guard < 10_000
+            peer, msg = net.sent.pop(0)
+            if msg.seg_id < 0:
+                sb.on_manifest(peer, _manifest_rows(src), epoch=epoch)
+            else:
+                sb.on_data(peer, _serve_file(src, msg, epoch=epoch))
+
+    def test_backfill_oldest_first_chunked(self, tmp_path):
+        env = _sealed_chain(tmp_path)
+        src = env["ss"]
+        net = _FakeNet()
+        sb, dst, clock, imported = self._mk(tmp_path, net)
+        assert sb.start()
+        peer, msg = net.sent.pop(0)
+        assert msg.seg_id == -1
+        sb.on_manifest(peer, _manifest_rows(src), epoch=7)
+        # oldest history first: the [1,3] shard's file id is requested
+        # before [4,6]'s
+        first_fid = net.sent[0][1].seg_id
+        assert first_fid == SHARD_FILE_BASE + env["sids"][0]
+        self._drain(sb, net, src, epoch=7)
+        assert sb.state == "done" and not sb.active
+        assert sb.counters["imported"] == 2
+        assert dst.contiguous_floor() == 6
+        assert [r["lo"] for r in imported] == [1, 4]
+        assert sb.get_json()["verified_floor"] == 6
+        src.close()
+        dst.close()
+
+    def test_covered_shards_skipped(self, tmp_path):
+        env = _sealed_chain(tmp_path)
+        src = env["ss"]
+        net = _FakeNet()
+        sb, dst, clock, _imp = self._mk(tmp_path, net)
+        dst.import_shard(_file_blob(src, env["sids"][0]))  # pre-held [1,3]
+        sb.start()
+        peer, _ = net.sent.pop(0)
+        sb.on_manifest(peer, _manifest_rows(src))
+        fids = [m.seg_id for _p, m in net.sent]
+        assert SHARD_FILE_BASE + env["sids"][0] not in fids
+        self._drain(sb, net, src)
+        assert sb.counters["imported"] == 1
+        assert dst.contiguous_floor() == 6
+        src.close()
+        dst.close()
+
+    def test_nothing_to_do_completes(self, tmp_path):
+        env = _sealed_chain(tmp_path)
+        net = _FakeNet()
+        sb, dst, clock, _imp = self._mk(tmp_path, net)
+        sb.start()
+        peer, _ = net.sent.pop(0)
+        sb.on_manifest(peer, [(0, 10, 10, True)])  # live rows only
+        assert sb.state == "done" and sb.counters["completed"] == 1
+        env["ss"].close()
+        dst.close()
+
+    def test_garbage_peer_condemned_refetched_zero_retained(self, tmp_path):
+        import os
+
+        env = _sealed_chain(tmp_path, splits=((1, 3),))
+        src = env["ss"]
+        net = _FakeNet()
+        noted, charged = [], []
+        sb, dst, clock, _imp = self._mk(
+            tmp_path, net,
+            note_byzantine=lambda kind, **kw: noted.append(kind),
+            on_condemn=charged.append,
+        )
+        sb.start()
+        peer, _ = net.sent.pop(0)
+        sb.on_manifest(peer, _manifest_rows(src))
+        peer2, msg2 = net.sent.pop(0)
+        meta, _ = src.fetch_segment(msg2.seg_id)
+        bad = bytearray(_file_blob(src, env["sids"][0]))
+        bad[40] ^= 0xFF  # hostile image: fails the offline contract
+        files_before = sorted(os.listdir(tmp_path / "bf-dst"))
+        sb.on_data(peer2, __import__(
+            "stellard_tpu.overlay.wire", fromlist=["wire"]
+        ).SegmentData(msg2.seg_id, len(bad), 0, bytes(bad)))
+        assert sb.counters["import_rejects"] == 1
+        assert sb.counters["garbage_peers"] == 1
+        assert noted == ["garbage_segment"]
+        assert charged == [peer2]
+        # zero hostile bytes retained
+        assert sorted(os.listdir(tmp_path / "bf-dst")) == files_before
+        # the SAME shard refetches from another peer and completes
+        peer3, msg3 = net.sent.pop(0)
+        assert peer3 != peer2 and msg3.seg_id == msg2.seg_id
+        net.sent.insert(0, (peer3, msg3))
+        self._drain(sb, net, src)
+        assert sb.state == "done" and sb.counters["imported"] == 1
+        assert dst.contiguous_floor() == 3
+        src.close()
+        dst.close()
+
+    def test_all_peers_garbage_falls_back(self, tmp_path):
+        env = _sealed_chain(tmp_path, splits=((1, 3),))
+        src = env["ss"]
+        net = _FakeNet()
+        sb, dst, clock, _imp = self._mk(tmp_path, net, peers=("a", "b"))
+        sb.start()
+        peer, _ = net.sent.pop(0)
+        sb.on_manifest(peer, _manifest_rows(src))
+        blob = _file_blob(src, env["sids"][0])
+        bad = bytearray(blob)
+        bad[40] ^= 0xFF
+        import stellard_tpu.overlay.wire as W
+
+        for _ in range(2):
+            p, m = net.sent.pop(0)
+            sb.on_data(p, W.SegmentData(m.seg_id, len(bad), 0, bytes(bad)))
+        assert sb.state == "fallback" and not sb.active
+        assert sb.counters["garbage_peers"] == 2
+        assert sb.counters["fallbacks"] == 1
+        src.close()
+        dst.close()
+
+    def test_oversized_transfer_condemned(self, tmp_path):
+        env = _sealed_chain(tmp_path, splits=((1, 3),))
+        src = env["ss"]
+        net = _FakeNet()
+        sb, dst, clock, _imp = self._mk(tmp_path, net)
+        sb.start()
+        peer, _ = net.sent.pop(0)
+        sb.on_manifest(peer, _manifest_rows(src))
+        p, m = net.sent.pop(0)
+        import stellard_tpu.overlay.wire as W
+
+        # a total far past advertised+slack never buys unbounded RAM
+        sb.on_data(p, W.SegmentData(
+            m.seg_id, ShardBackfill.MAX_SHARD_TRANSFER, 0, b"x"
+        ))
+        assert sb.counters["garbage_peers"] == 1
+        src.close()
+        dst.close()
+
+    def test_epoch_move_restarts_from_manifest(self, tmp_path):
+        env = _sealed_chain(tmp_path)
+        src = env["ss"]
+        net = _FakeNet()
+        sb, dst, clock, _imp = self._mk(tmp_path, net)
+        sb.start()
+        peer, _ = net.sent.pop(0)
+        sb.on_manifest(peer, _manifest_rows(src), epoch=3)
+        p, m = net.sent.pop(0)
+        # the source rotated mid-transfer: its epoch moved
+        sb.on_data(p, _serve_file(src, m, epoch=4))
+        assert sb.counters["epoch_restarts"] == 1
+        assert sb.state == "manifest"
+        p2, m2 = net.sent.pop(0)
+        assert m2.seg_id == -1  # fresh manifest, never splice snapshots
+        src.close()
+        dst.close()
+
+    def test_timeout_switches_peer_then_rescan_rearms(self, tmp_path):
+        env = _sealed_chain(tmp_path, splits=((1, 3),))
+        src = env["ss"]
+        net = _FakeNet()
+        sb, dst, clock, _imp = self._mk(tmp_path, net)
+        sb.start()
+        first_peer, _ = net.sent.pop(0)
+        clock[0] = 2.5  # past request_timeout: manifest never answered
+        sb.tick(clock[0])
+        assert sb.counters["timeouts"] == 1
+        clock[0] += 2.0
+        sb.tick(clock[0])
+        assert sb.counters["retries"] == 1
+        retry_peer, _ = net.sent.pop(0)
+        assert retry_peer != first_peer
+        # finish the session, then the self-arming rescan starts a new
+        # one after rescan_s without any external trigger
+        sb.on_manifest(retry_peer, _manifest_rows(src))
+        self._drain(sb, net, src)
+        assert sb.state == "done"
+        sb.tick(clock[0] + 1.0)
+        assert not sb.active
+        clock[0] += 40.0
+        sb.tick(clock[0])
+        assert sb.active and sb.counters["started"] == 2
+        assert sb.counters["rescans"] >= 1
+        src.close()
+        dst.close()
+
+
+# -- feed_shard / ArchiveTxDatabase ---------------------------------------
+
+
+class TestFeedShard:
+    def test_archive_txdb_never_trims(self):
+        db = ArchiveTxDatabase(":memory:")
+        with pytest.raises(RuntimeError, match="never trims"):
+            db.trim_below(5)
+        assert db.retain_floor == 0
+        db.close()
+
+    def test_feed_populates_all_three_stores(self, tmp_path):
+        env = _sealed_chain(tmp_path)
+        src, master = env["ss"], env["master"]
+        txdb = ArchiveTxDatabase(":memory:")
+        sunk: dict[bytes, bytes] = {}
+        total = {"records": 0, "txs": 0}
+        for sid in env["sids"]:
+            out = feed_shard(
+                src, sid,
+                store=lambda tb, key, blob: sunk.__setitem__(key, blob),
+                txdb=txdb,
+            )
+            total["records"] += out["records"]
+            total["txs"] += out["txs"]
+        assert total["txs"] == len(env["acct_rows"])
+        assert len(sunk) > 0
+        # ledger headers queryable (deep `ledger` RPCs resolve these)
+        for h in env["headers"][:6]:
+            got = txdb.get_ledger_header(seq=h["seq"])
+            assert got is not None and got["hash"] == h["hash"]
+        # account_tx pages in (ledger_seq, txn_seq) order, bytes
+        # matching the sealed shard's verified contents
+        rows = txdb.account_transactions(master.account_id, 1, 6,
+                                         limit=100, forward=True)
+        assert [(r["ledger_seq"], r["txn_seq"]) for r in rows] == [
+            (r[1], r[2]) for r in env["acct_rows"]
+        ]
+        for row in rows:
+            sid = src.covers(row["ledger_seq"])
+            raw, meta = src.tx_blob(sid, row["txid"])
+            assert row["raw"] == raw and row["meta"] == meta
+            assert row["status"] == "tesSUCCESS"
+        src.close()
+        txdb.close()
+
+
+# -- forever cache (immutable historical seqs) ----------------------------
+
+
+@pytest.fixture
+def std_node():
+    n = Node(Config(signature_backend="cpu")).setup()
+    yield n
+    n.stop()
+
+
+def _fund(n: Node, kp: KeyPair, drops: int = 1_000_000_000) -> None:
+    from stellard_tpu.protocol.sfields import sfSequence
+
+    master = n.master_keys
+    root = n.ledger_master.current_ledger().account_root(master.account_id)
+    tx = SerializedTransaction.build(
+        TxType.ttPAYMENT, master.account_id, root[sfSequence], 10,
+        {sfAmount: STAmount.from_drops(drops),
+         sfDestination: kp.account_id},
+    )
+    tx.sign(master)
+    ter, applied = n.submit(tx)
+    assert applied, ter
+
+
+def _call(n: Node, method: str, **params) -> dict:
+    return dispatch(Context(n, params, Role.ADMIN), method)
+
+
+class TestForeverCache:
+    def _flood(self, node, n_closes=5):
+        alice = KeyPair.from_passphrase("forever-alice")
+        _fund(node, alice)
+        node.close_ledger()
+        for _ in range(n_closes - 1):
+            _fund(node, alice, drops=1_000_000)
+            node.close_ledger()
+        return alice
+
+    def test_below_floor_account_tx_survives_epoch_swap(self, std_node):
+        node = std_node
+        alice = self._flood(node)
+        node.read_plane.set_archive_floor(4)
+        r1 = _call(node, "account_tx", account=alice.human_account_id,
+                   ledger_index_min=1, ledger_index_max=4)
+        assert "error" not in r1
+        cj = node.read_cache.get_json()
+        assert cj["forever_entries"] == 1 and cj["forever_inserts"] == 1
+        r2 = _call(node, "account_tx", account=alice.human_account_id,
+                   ledger_index_min=1, ledger_index_max=4)
+        assert node.read_cache.get_json()["forever_hits"] == 1
+        assert r2["transactions"] == r1["transactions"]
+        # an epoch swap (new validated seq) evicts the per-seq tier but
+        # NEVER the forever tier: immutable history is immutable
+        node.read_cache.on_new_seq(10_000)
+        r3 = _call(node, "account_tx", account=alice.human_account_id,
+                   ledger_index_min=1, ledger_index_max=4)
+        cj = node.read_cache.get_json()
+        assert cj["forever_hits"] == 2 and cj["forever_entries"] == 1
+        assert r3["transactions"] == r1["transactions"]
+
+    def test_unbounded_or_above_floor_never_forever(self, std_node):
+        node = std_node
+        alice = self._flood(node)
+        node.read_plane.set_archive_floor(3)
+        # unbounded max: the window grows with the chain
+        r = _call(node, "account_tx", account=alice.human_account_id)
+        assert "error" not in r
+        # bounded above the floor: includes un-verified history
+        r = _call(node, "account_tx", account=alice.human_account_id,
+                  ledger_index_min=1, ledger_index_max=5)
+        assert "error" not in r
+        assert node.read_cache.get_json()["forever_entries"] == 0
+
+    def test_ledger_by_seq_forever_but_selectors_never(self, std_node):
+        node = std_node
+        self._flood(node)
+        node.read_plane.set_archive_floor(3)
+        r1 = _call(node, "ledger", ledger_index=2)
+        assert "error" not in r1, r1
+        assert node.read_cache.get_json()["forever_entries"] == 1
+        node.read_cache.on_new_seq(10_000)
+        _call(node, "ledger", ledger_index=2)
+        assert node.read_cache.get_json()["forever_hits"] == 1
+        # moving-target selectors are never admitted
+        _call(node, "ledger", ledger_index="validated")
+        assert node.read_cache.get_json()["forever_entries"] == 1
+
+    def test_no_floor_means_no_forever_tier(self, std_node):
+        node = std_node
+        alice = self._flood(node)
+        r = _call(node, "account_tx", account=alice.human_account_id,
+                  ledger_index_min=1, ledger_index_max=3)
+        assert "error" not in r
+        assert node.read_cache.get_json()["forever_entries"] == 0
+
+    def test_floor_is_monotonic(self, std_node):
+        node = std_node
+        node.read_plane.set_archive_floor(9)
+        node.read_plane.set_archive_floor(4)  # verified never un-verifies
+        assert node.read_plane.archive_floor == 9
+        assert node.read_plane.get_json()["archive_floor"] == 9
+
+
+# -- WS-door resume cursors (satellite: PR 19 resume on the raw door) -----
+
+
+@pytest.fixture(scope="module")
+def ws_node():
+    cfg = Config()
+    cfg.rpc_port = 0
+    cfg.websocket_port = 0
+    cfg.subs_resume_horizon = 3
+    n = Node(cfg).setup().serve()
+    yield n
+    n.stop()
+
+
+class TestWsResumeDoor:
+    def _ws(self, node):
+        from test_rpc_server import WsClient
+
+        return WsClient(node.ws_server.port)
+
+    def _close(self, node, n=1):
+        for _ in range(n):
+            node.close_ledger()
+
+    def test_resume_replays_missed_events(self, ws_node):
+        node = ws_node
+        ws1 = self._ws(node)
+        try:
+            resp = ws1.call("subscribe", streams=["ledger"])
+            assert resp["status"] == "success"
+            self._close(node)
+            ws1.sock.settimeout(10)
+            msg = ws1.recv()
+            while msg.get("type") != "ledgerClosed":
+                msg = ws1.recv()
+            last_seen = msg["ledger_index"]
+        finally:
+            ws1.close()
+        self._close(node, 2)  # missed while disconnected
+        ws2 = self._ws(node)
+        try:
+            ws2.send({"id": 1, "command": "subscribe",
+                      "streams": ["ledger"], "resume": last_seen})
+            ws2.sock.settimeout(10)
+            replayed_events, result = [], None
+            while result is None or len(replayed_events) < 2:
+                msg = ws2.recv()
+                if msg.get("type") == "response":
+                    result = msg["result"]
+                elif msg.get("type") == "ledgerClosed":
+                    replayed_events.append(msg["ledger_index"])
+            assert result["resumed"] is True and result["cold"] is False
+            assert result["replayed"] >= 2
+            # gap-free: replay starts exactly after the cursor
+            assert replayed_events[0] == last_seen + 1
+            assert replayed_events == sorted(replayed_events)
+        finally:
+            ws2.close()
+
+    def test_resume_past_horizon_explicit_cold(self, ws_node):
+        node = ws_node
+        self._close(node, 5)  # horizon=3: early events fell off the ring
+        ws = self._ws(node)
+        try:
+            resp = ws.call("subscribe", streams=["ledger"], resume=1)
+            assert resp["status"] == "success"
+            r = resp["result"]
+            assert r["cold"] is True and r["resumed"] is False
+            assert r["replayed"] == 0 and r["horizon"] > 2
+        finally:
+            ws.close()
+
+    def test_malformed_resume_rejected(self, ws_node):
+        ws = self._ws(ws_node)
+        try:
+            for bad in (True, {"last_seq": -1}, "nope", [3], -2):
+                resp = ws.call("subscribe", streams=["ledger"], resume=bad)
+                assert resp["status"] == "error", (bad, resp)
+                assert resp["result"]["error"] == "invalidParams", (bad, resp)
+        finally:
+            ws.close()
+
+    def test_http_door_resume_also_works(self, ws_node):
+        # the embedded dispatch path (no infosub) must not crash on a
+        # resume param; it has no stream connection to resume
+        from test_rpc_server import rpc
+
+        r = rpc(ws_node, "server_info")
+        assert r["status"] == "success"
+
+
+# -- account_tx paging across the shard/live boundary under trim ----------
+
+
+class TestBoundaryPagingUnderTrim:
+    def _node_with_shards(self, tmp_path):
+        cfg = Config(signature_backend="cpu")
+        cfg.node_db_shards = str(tmp_path / "live-shards")
+        return Node(cfg).setup()
+
+    def _flood(self, node, n_closes=8):
+        alice = KeyPair.from_passphrase("boundary-alice")
+        _fund(node, alice)
+        node.close_ledger()
+        for _ in range(n_closes - 1):
+            _fund(node, alice, drops=1_000_000)
+            node.close_ledger()
+        return alice
+
+    def _seal_range(self, node, lo, hi):
+        headers = [node.txdb.get_ledger_header(seq=s)
+                   for s in range(lo, hi + 1)]
+        assert all(h is not None for h in headers)
+
+        def fetch(h):
+            o = node.nodestore.fetch(h, populate_cache=False)
+            return o.data if o else None
+
+        recs = collect_retired(fetch, headers, set())
+        rows = node.txdb.account_tx_index(lo, hi)
+        node.shardstore.seal(lo, hi, recs, rows,
+                             first_hash=headers[0]["hash"],
+                             last_hash=headers[-1]["hash"])
+
+    def _page_all(self, node, alice, limit, on_page=None):
+        pages, marker = [], None
+        for _ in range(64):
+            params = {"account": alice.human_account_id,
+                      "ledger_index_min": 1, "ledger_index_max": 99,
+                      "limit": limit, "forward": True}
+            if marker is not None:
+                params["marker"] = marker
+            r = _call(node, "account_tx", **params)
+            assert "error" not in r, r
+            pages.append(r)
+            if on_page is not None:
+                on_page(len(pages), r)
+            marker = r.get("marker")
+            if marker is None:
+                break
+        return pages
+
+    def test_trim_mid_pagination_no_gap_no_empty_page(self, tmp_path):
+        node = self._node_with_shards(tmp_path)
+        try:
+            alice = self._flood(node)
+            full = _call(node, "account_tx",
+                         account=alice.human_account_id,
+                         ledger_index_min=1, ledger_index_max=99,
+                         limit=400, forward=True)
+            want = [t["tx"]["hash"] for t in full["transactions"]]
+            assert len(want) >= 8
+            # seal [2,3] and [4,5]; trim to 4: shard tier serves 2..3
+            self._seal_range(node, 2, 3)
+            self._seal_range(node, 4, 5)
+            node.txdb.trim_below(4)
+
+            def raise_floor(page_no, _r):
+                if page_no == 1:
+                    # CONCURRENT sql_trim raising the retain floor
+                    # mid-pagination: [4,5] drops from SQL but stays
+                    # served from its sealed shard
+                    node.txdb.trim_below(6)
+
+            pages = self._page_all(node, alice, limit=2,
+                                   on_page=raise_floor)
+            got = [t["tx"]["hash"] for p in pages
+                   for t in p["transactions"]]
+            assert got == want  # no gap, no duplicate, no silent loss
+            # every page that advertised a marker carried rows
+            for p in pages[:-1]:
+                assert p["transactions"], "silent empty page"
+            # effective-range echo: the floor is the oldest shard, not
+            # the (raised) SQL retain floor
+            assert all(p["ledger_index_min"] == 2 for p in pages)
+        finally:
+            node.stop()
+
+    def test_marker_straddles_boundary_after_trim(self, tmp_path):
+        node = self._node_with_shards(tmp_path)
+        try:
+            alice = self._flood(node)
+            self._seal_range(node, 2, 4)
+            node.txdb.trim_below(5)
+            # a marker INSIDE the sealed range resumes from the shard
+            # tier and crosses into live SQL seamlessly
+            r = _call(node, "account_tx",
+                      account=alice.human_account_id,
+                      ledger_index_min=1, ledger_index_max=99,
+                      limit=3, forward=True,
+                      marker={"ledger": 3, "seq": 0})
+            assert "error" not in r, r
+            seqs = [t["tx"]["ledger_index"] for t in r["transactions"]]
+            assert seqs and seqs[0] >= 3
+            # a marker below the oldest shard stays a loud error
+            r = _call(node, "account_tx",
+                      account=alice.human_account_id,
+                      marker={"ledger": 1, "seq": 0})
+            assert r.get("error") == "lgrIdxInvalid"
+        finally:
+            node.stop()
+
+    def test_threaded_trim_race(self, tmp_path):
+        """A real concurrent trim thread: pagination never sees an
+        error or a gap while the floor rises under it."""
+        node = self._node_with_shards(tmp_path)
+        try:
+            alice = self._flood(node)
+            full = _call(node, "account_tx",
+                         account=alice.human_account_id,
+                         ledger_index_min=1, ledger_index_max=99,
+                         limit=400, forward=True)
+            want = [t["tx"]["hash"] for t in full["transactions"]]
+            self._seal_range(node, 2, 3)
+            self._seal_range(node, 4, 5)
+            node.txdb.trim_below(4)
+            started = threading.Event()
+
+            def trimmer():
+                started.wait(5)
+                node.txdb.trim_below(6)
+
+            th = threading.Thread(target=trimmer)
+            th.start()
+            try:
+                pages = self._page_all(
+                    node, alice, limit=2,
+                    on_page=lambda n, _r: started.set(),
+                )
+            finally:
+                th.join(10)
+            got = [t["tx"]["hash"] for p in pages
+                   for t in p["transactions"]]
+            assert got == want
+        finally:
+            node.stop()
+
+
+# -- fuzzer archive leg ----------------------------------------------------
+
+
+class TestFuzzerArchiveLeg:
+    def test_archive_backfill_scenario_clean(self):
+        from stellard_tpu.testkit.scenario import run_simnet
+        from stellard_tpu.testkit.scenarios import build_scenario
+        from stellard_tpu.testkit.search import (
+            check_invariants,
+            coverage_state,
+        )
+
+        scn = build_scenario("archive_backfill", seed=3)
+        card = run_simnet(scn)
+        assert card["converged"]
+        ar = card["archive"]
+        assert ar["imported"] >= 1 and ar["queries"] > 0
+        assert ar["byte_match_failures"] == 0
+        # the garbage first-pick peer served a corrupt image: condemned
+        # (verify-gated reject), then the honest refetch imported
+        assert ar["import_rejects"] >= 1 and ar["garbage_peers"] >= 1
+        assert ar["verified_floor"] > 0
+        assert check_invariants(scn, card) == []
+        # archive dynamics ride the END of the coverage vector
+        assert coverage_state(card)[-3:] == (True, False, True)
+
+    def test_planted_corruption_trips_byte_match(self):
+        from stellard_tpu.testkit.scenario import (
+            ARCHIVE_CORRUPT,
+            run_simnet,
+        )
+        from stellard_tpu.testkit.scenarios import build_scenario
+        from stellard_tpu.testkit.search import check_invariants
+
+        scn = build_scenario("archive_backfill", seed=3)
+        ARCHIVE_CORRUPT["armed"] = True
+        try:
+            card = run_simnet(scn)
+        finally:
+            ARCHIVE_CORRUPT["armed"] = False
+        assert card["archive"]["byte_match_failures"] > 0
+        inv = [v.invariant for v in check_invariants(scn, card)]
+        assert "archive_byte_match" in inv
+
+    def test_shrinker_offers_drop_archive(self):
+        from stellard_tpu.testkit.scenarios import build_scenario
+        from stellard_tpu.testkit.search import _weaken_ops
+
+        scn = build_scenario("archive_backfill", seed=3)
+        ops = dict(_weaken_ops(scn))
+        assert "drop_archive" in ops
+        assert ops["drop_archive"].shards is True  # tier kept, leg cut
+        # dropping the shard tier also drops the dependent archive leg
+        assert ops["drop_shard_tier"].archive is False
+        assert ops["drop_cold_node"].archive is False
+
+    def test_scenario_roundtrips_with_archive_field(self):
+        from stellard_tpu.testkit.scenario import Scenario
+        from stellard_tpu.testkit.scenarios import build_scenario
+
+        scn = build_scenario("archive_backfill", seed=3)
+        assert scn.archive is True
+        rt = Scenario.from_json(scn.to_json())
+        assert rt.archive is True
+        assert rt.digest() == scn.digest()
+
+
+# -- config gates ----------------------------------------------------------
+
+
+class TestArchiveConfigGates:
+    def test_mode_archive_parses_with_stanza(self):
+        cfg = Config.from_ini(
+            "[node]\nmode=archive\nupstream=127.0.0.1:5005\n"
+            "[archive]\npath=/tmp/x\nbackfill=1\nrescan_s=9.5\n"
+        )
+        assert cfg.node_mode == "archive"
+        assert cfg.archive_path == "/tmp/x"
+        assert cfg.archive_backfill == 1
+        assert cfg.archive_rescan_s == 9.5
+
+    def test_unknown_archive_key_rejected(self):
+        with pytest.raises(ValueError, match="archive"):
+            Config.from_ini(
+                "[node]\nmode=archive\n[archive]\nbackfil=1\n"
+            )
+
+    def test_archive_stanza_requires_archive_mode(self):
+        with pytest.raises(ValueError, match="mode=archive"):
+            Config.from_ini("[node]\nmode=validator\n[archive]\npath=/x\n")
+
+    def test_nonpositive_rescan_rejected(self):
+        with pytest.raises(ValueError, match="rescan_s"):
+            Config.from_ini(
+                "[node]\nmode=archive\n[archive]\nrescan_s=0\n"
+            )
+
+    def test_archive_requires_networked_node(self, tmp_path):
+        with pytest.raises(ValueError, match="networked"):
+            Node(Config(node_mode="archive", standalone=True,
+                        archive_path=str(tmp_path / "a")))
+
+    def test_online_delete_incompatible_with_archive(self, tmp_path):
+        with pytest.raises(ValueError, match="online_delete"):
+            Node(Config(node_mode="archive",
+                        archive_path=str(tmp_path / "a"),
+                        node_db_online_delete=4))
+
+    def test_unknown_mode_still_rejected(self):
+        with pytest.raises(ValueError, match="mode"):
+            Config.from_ini("[node]\nmode=reporting\n")

@@ -1,0 +1,385 @@
+"""Tier-1 archive-tier smoke: the shard distribution network as a gate.
+
+Boots a LEADER (networked solo validator, quorum=1) with online
+deletion + history shards on, floods it until at least two shard files
+are sealed and the SQL retain floor has climbed past them — deep
+history now exists ONLY in cold storage — then exercises the archive
+tier end to end over real TCP, in two phases:
+
+- Phase A (hostile upstream): an archive node boots cold while the
+  leader's segment source is wrapped by a corrupting proxy that flips
+  one byte in every whole-shard-file transfer. The archive's backfill
+  must REJECT every poisoned image at the ``verify_shard_blob`` gate,
+  condemn the peer (resource-charged on its overlay endpoint AND
+  excluded from the segment-peer candidate set), and retain ZERO
+  hostile bytes — no shard file ever touches the archive directory.
+- Phase B (honest restart): the corruption is removed and a fresh
+  archive boots against the SAME (still-empty) archive directory. It
+  must backfill >= 2 sealed shards over the wire from cold start,
+  ingest the validated tail like a follower (zero consensus rounds),
+  and serve deep-history RPCs BELOW the leader's retain floor —
+  ``account_tx`` / ``tx`` / ``ledger`` — whose bytes are compared
+  row-for-row against the leader's sealed shard contents (the
+  verify-checked source of truth). The forever tier of the result
+  cache must take hits on repeated immutable-window queries.
+
+Runtime: ~60-120s (clock_speed-accelerated consensus).
+
+Usage: python tools/archivesmoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SPEED = 5.0
+
+
+def fail(msg: str) -> None:
+    print(f"ARCHIVE SMOKE FAILED: {msg}", file=sys.stderr)
+    raise SystemExit(2)
+
+
+class _CorruptingSource:
+    """Hostile-peer stand-in: delegates to the leader's real segment
+    source but flips one byte in the first chunk of every whole-shard-
+    FILE transfer (ids at or above SHARD_FILE_BASE). Manifests and live
+    tail segments pass through honestly, so only the deep-history
+    backfill sees poisoned bytes — exactly the garbage-peer scenario
+    the verify gate + condemnation path exists for."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.corrupted = 0
+
+    def segments(self):
+        return self._inner.segments()
+
+    def fetch_segment(self, seg_id, offset=0, length=None):
+        from stellard_tpu.nodestore.shards import SHARD_FILE_BASE
+
+        got = self._inner.fetch_segment(seg_id, offset=offset,
+                                        length=length)
+        if got is None or seg_id < SHARD_FILE_BASE or offset != 0:
+            return got
+        meta, data = got
+        b = bytearray(data)
+        if len(b) > 40:
+            b[40] ^= 0xFF  # inside the header's reserved area: CRC breaks
+            self.corrupted += 1
+        return meta, bytes(b)
+
+
+def main() -> None:
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from stellard_tpu.node.config import Config
+    from stellard_tpu.node.node import Node
+    from stellard_tpu.protocol.formats import TxType
+    from stellard_tpu.protocol.keys import KeyPair, encode_account_id
+    from stellard_tpu.protocol.sfields import sfAmount, sfDestination
+    from stellard_tpu.protocol.stamount import STAmount
+    from stellard_tpu.protocol.sttx import SerializedTransaction
+    from stellard_tpu.testkit.tcpnet import free_ports, rpc, wait_until
+
+    tmp = tempfile.mkdtemp(prefix="archivesmoke-")
+    leader_peer, arch_a_peer, arch_b_peer = free_ports(3)
+    val_key = KeyPair.from_passphrase("archivesmoke-leader")
+    archive_dir = os.path.join(tmp, "archive-shards")
+
+    leader = Node(Config(
+        standalone=False,
+        signature_backend="cpu",
+        node_db_type="segstore",
+        node_db_path=os.path.join(tmp, "leader-ns"),
+        database_path=os.path.join(tmp, "leader.db"),
+        node_db_segment_mb=1,
+        node_db_online_delete=4,
+        node_db_online_delete_interval=2,
+        node_db_shards="1",
+        validation_seed=val_key.human_seed,
+        validation_quorum=1,
+        peer_port=leader_peer,
+        clock_speed=SPEED,
+        rpc_port=0,
+    )).setup().serve()
+
+    arch = None
+    try:
+        # phase 0: flood the leader until >= 2 shards are sealed and the
+        # retain floor has climbed past them — from here on, the ONLY
+        # place the deep rows exist is the leader's cold shard files
+        master = leader.master_keys
+        dests = [KeyPair.from_passphrase(f"asmoke-{i}").account_id
+                 for i in range(8)]
+        acked = threading.Semaphore(0)
+
+        def cb(_tx, _ter, _applied):
+            acked.release()
+
+        next_seq = 1
+
+        def submit_batch(n: int) -> None:
+            nonlocal next_seq
+            for _ in range(n):
+                tx = SerializedTransaction.build(
+                    TxType.ttPAYMENT, master.account_id, next_seq, 10,
+                    {sfAmount: STAmount.from_drops(250_000_000),
+                     sfDestination: dests[next_seq % len(dests)]},
+                )
+                tx.sign(master)
+                leader.ops.submit_transaction(tx, cb)
+                next_seq += 1
+            for _ in range(n):
+                acked.acquire()
+
+        def sealed_deep() -> bool:
+            shs = leader.shardstore.shards()
+            return (len(shs) >= 2
+                    and leader.txdb.retain_floor > shs[1]["hi"])
+
+        t_end = time.monotonic() + 180
+        while not sealed_deep():
+            if time.monotonic() > t_end:
+                fail(f"leader never sealed 2 deep shards "
+                     f"(shards={leader.shardstore.shards()}, "
+                     f"floor={leader.txdb.retain_floor})")
+            submit_batch(10)
+            time.sleep(0.2)
+
+        lshards = leader.shardstore.shards()
+        floor = leader.txdb.retain_floor
+        deep = [sh for sh in lshards if sh["hi"] < floor][:2]
+        if len(deep) < 2:
+            fail(f"sealed shards not below the floor: {lshards}, "
+                 f"floor={floor}")
+
+        def archive_cfg(name: str, port: int) -> Config:
+            return Config(
+                standalone=False,
+                node_mode="archive",
+                signature_backend="cpu",
+                node_db_type="segstore",
+                node_db_path=os.path.join(tmp, f"{name}-ns"),
+                database_path=os.path.join(tmp, f"{name}.db"),
+                archive_path=archive_dir,
+                archive_rescan_s=2.0,
+                validators=[val_key.human_node_public],
+                validation_quorum=1,
+                peer_port=port,
+                node_upstream=[f"127.0.0.1 {leader_peer}"],
+                clock_speed=SPEED,
+                rpc_port=0,
+            )
+
+        # phase A: poison every whole-shard-file transfer at the source
+        lvn = leader.overlay.node
+        honest_src = lvn.segment_source
+        proxy = _CorruptingSource(honest_src)
+        lvn.segment_source = proxy
+
+        arch = Node(archive_cfg("arch-a", arch_a_peer)).setup().serve()
+        sb_a = arch.overlay.node.shard_backfill
+        if sb_a is None:
+            fail("archive node booted without a shard backfill")
+
+        if not wait_until(
+            lambda: sb_a.get_json()["import_rejects"] >= 1
+            and sb_a.get_json()["garbage_peers"] >= 1, 120, 0.2,
+        ):
+            fail(f"hostile upstream never condemned: {sb_a.get_json()} "
+                 f"(proxy corrupted {proxy.corrupted} chunks)")
+        if proxy.corrupted < 1:
+            fail("anti-vacuity: the corrupting proxy never fired")
+
+        # charged + excluded: the garbage-segment charge lands on the
+        # leader's endpoint in the ARCHIVE's resource table, pushing it
+        # to WARN — segment_peers() then refuses it the bulk-transfer
+        # privilege (the balance decays, so check promptly)
+        charged = False
+        excluded = False
+        t_end = time.monotonic() + 30
+        while time.monotonic() < t_end and not (charged and excluded):
+            with arch.overlay._peers_lock:
+                remotes = [p.remote for p in arch.overlay.peers.values()]
+            for r in remotes:
+                if arch.overlay.resources.balance(r) > 0:
+                    charged = True
+            if not arch.overlay.segment_peers() and remotes:
+                excluded = True
+            if not remotes:
+                # one charge short of DROP normally, but repeated
+                # garbage rounds can stack to a disconnect — that IS
+                # charged-and-excluded
+                charged = excluded = True
+            time.sleep(0.05)
+        if not charged or not excluded:
+            fail(f"condemned peer not charged+excluded "
+                 f"(charged={charged}, excluded={excluded}, "
+                 f"backfill={sb_a.get_json()})")
+
+        # zero hostile bytes retained: no shard file ever landed
+        aj = sb_a.get_json()
+        if aj["imported"] != 0:
+            fail(f"archive imported a poisoned shard: {aj}")
+        if arch.shardstore.shards():
+            fail(f"hostile bytes installed: {arch.shardstore.shards()}")
+        leftovers = [f for f in os.listdir(archive_dir)
+                     if f.endswith(".shard")]
+        if leftovers:
+            fail(f"hostile shard files retained on disk: {leftovers}")
+        phase_a = {k: aj[k] for k in
+                   ("import_rejects", "garbage_peers", "imported")}
+        arch.stop()
+        arch = None
+
+        # phase B: honest leader, fresh archive process, SAME cold
+        # archive directory — backfill >= 2 shards over the wire
+        lvn.segment_source = honest_src
+        arch = Node(archive_cfg("arch-b", arch_b_peer)).setup().serve()
+        vn = arch.overlay.node
+        sb = vn.shard_backfill
+
+        if not wait_until(
+            lambda: sb.get_json()["imported"] >= 2
+            and arch.shardstore.contiguous_floor() >= deep[1]["hi"],
+            180, 0.2,
+        ):
+            fail(f"honest backfill incomplete: {sb.get_json()}, "
+                 f"archive shards={arch.shardstore.shards()}")
+        bj = sb.get_json()
+        if bj["garbage_peers"] != 0:
+            fail(f"honest leader condemned in phase B: {bj}")
+        if arch.read_plane.archive_floor <= 0:
+            fail("verified floor never published to the read plane")
+
+        # tail ingest: the archive follows the live chain like a
+        # follower and never runs consensus
+        def validated_of(node):
+            v = node.ledger_master.validated
+            return v.seq if v is not None else 0
+
+        submit_batch(10)
+        target = validated_of(leader)
+        if not wait_until(lambda: validated_of(arch) >= target, 120, 0.5):
+            fail(f"archive tail ingest stalled "
+                 f"(arch={validated_of(arch)}, leader={target})")
+        if vn.rounds_completed != 0:
+            fail(f"archive completed {vn.rounds_completed} consensus "
+                 f"rounds — the archive tier must never close")
+
+        # deep-history serving, byte-matched against the leader's
+        # sealed shard contents (below the leader's retain floor, these
+        # rows exist nowhere else)
+        aport = arch.http_server.port
+        rows_checked = 0
+        for sh in deep:
+            sid = sh["id"]
+            by_acct: dict = {}
+            for acct, lseq, tseq, txid in leader.shardstore.acct_rows(sid):
+                by_acct.setdefault(acct, []).append((lseq, tseq, txid))
+            for acct, ents in sorted(by_acct.items()):
+                ents.sort()
+                r = rpc(aport, "account_tx", {
+                    "account": encode_account_id(acct),
+                    "ledger_index_min": sh["lo"],
+                    "ledger_index_max": sh["hi"],
+                    "forward": True, "binary": True, "limit": 500,
+                })
+                if r.get("status") != "success":
+                    fail(f"deep account_tx refused below the leader "
+                         f"floor {floor}: {r}")
+                got = r["transactions"]
+                if len(got) != len(ents):
+                    fail(f"deep account_tx row count mismatch shard "
+                         f"{sid}: served {len(got)}, shard has "
+                         f"{len(ents)}")
+                for entry, (lseq, _tseq, txid) in zip(got, ents):
+                    want = leader.shardstore.tx_blob(sid, txid)
+                    if want is None:
+                        fail(f"shard {sid} lost txid {txid.hex()}")
+                    if entry["tx_blob"] != want[0].hex().upper():
+                        fail(f"deep tx bytes diverge from sealed shard "
+                             f"{sid} at seq {lseq}: {txid.hex()}")
+                    if int(entry["ledger_index"]) != lseq:
+                        fail(f"deep row seq mismatch: "
+                             f"{entry['ledger_index']} != {lseq}")
+                    rows_checked += 1
+            # the shard's anchor header must resolve through the deep
+            # `ledger` door with the sealed first-ledger hash
+            r = rpc(aport, "ledger", {"ledger_index": sh["lo"]})
+            if r.get("status") != "success":
+                fail(f"deep ledger {sh['lo']} refused: {r}")
+            if r["ledger"]["hash"] != sh["first_hash"].upper():
+                fail(f"deep ledger hash diverges at seq {sh['lo']}: "
+                     f"{r['ledger']['hash']} != shard "
+                     f"{sh['first_hash'].upper()}")
+        if rows_checked < 1:
+            fail("anti-vacuity: the sealed shards held zero account "
+                 "rows — the byte-match leg never ran")
+
+        # one deep tx by hash, byte-anchored via its ledger seq
+        sid0 = deep[0]["id"]
+        arows = leader.shardstore.acct_rows(sid0)
+        if arows:
+            _acct, lseq, _tseq, txid = arows[0]
+            r = rpc(aport, "tx", {"transaction": txid.hex()})
+            if r.get("status") != "success":
+                fail(f"deep tx {txid.hex()} refused: {r}")
+            if int(r["ledger_index"]) != lseq:
+                fail(f"deep tx seq mismatch: {r['ledger_index']} != "
+                     f"{lseq}")
+
+        # the forever tier: an immutable below-floor window must hit
+        # across repeats (it was admitted during the sweep above)
+        probe = {
+            "account": master.human_account_id,
+            "ledger_index_min": deep[0]["lo"],
+            "ledger_index_max": deep[0]["hi"],
+            "forward": True, "binary": True, "limit": 500,
+        }
+        rpc(aport, "account_tx", probe)
+        h0 = arch.read_cache.get_json()["forever_hits"]
+        rpc(aport, "account_tx", probe)
+        cj = arch.read_cache.get_json()
+        if cj["forever_entries"] <= 0 or cj["forever_hits"] <= h0:
+            fail(f"forever cache never engaged on an immutable deep "
+                 f"window: {cj}")
+
+        print(json.dumps({
+            "archive_smoke": "ok",
+            "leader_floor": floor,
+            "deep_shards": [(sh["id"], sh["lo"], sh["hi"])
+                            for sh in deep],
+            "phase_a_hostile": phase_a,
+            "proxy_corrupted_chunks": proxy.corrupted,
+            "phase_b_backfill": {
+                k: bj[k] for k in ("imported", "duplicates", "requests",
+                                   "bytes", "garbage_peers")
+            },
+            "verified_floor": arch.read_plane.archive_floor,
+            "deep_rows_byte_checked": rows_checked,
+            "forever_cache": cj,
+            "ledgers_ingested": vn.ledgers_ingested,
+        }), flush=True)
+    finally:
+        if arch is not None:
+            arch.stop()
+        leader.stop()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -150,6 +150,21 @@ if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/followersmoke.py; then
   exit 2
 fi
 
+echo "== archive tier smoke gate (hostile then honest backfill over TCP, deep-history byte match) =="
+# boots a solo leader with online deletion + history shards, floods it
+# until deep history exists ONLY in sealed shard files, then runs the
+# archive tier twice: against a byte-flipping upstream (every poisoned
+# image rejected at the verify gate, the peer resource-charged AND
+# excluded, ZERO hostile bytes retained) and against the honest leader
+# (>=2 shards backfilled over the wire from cold start, deep
+# account_tx/tx/ledger served below the leader's retain floor with
+# every row byte-matched against the sealed shard contents, the
+# forever-tier result cache taking hits on immutable windows)
+if ! JAX_PLATFORMS=cpu timeout -k 10 300 python tools/archivesmoke.py; then
+  echo "ARCHIVE SMOKE FAILED — archive tier / shard distribution network is broken" >&2
+  exit 2
+fi
+
 echo "== liquidity-plane smoke gate (crossfire flood, live path subs, incremental==full) =="
 # boots a node with the paths plane on (default), floods an order-book
 # crossfire (creates, tier-consuming crossings, cancels) with N live
